@@ -1,0 +1,21 @@
+"""TPU-native code-interpreter framework.
+
+A sandboxed code-execution service for LLM agents, built from scratch for TPU:
+
+- Control plane (this package): asyncio gRPC + HTTP APIs, a warm pool of
+  single-use sandboxes, content-addressed file storage for stateless session
+  persistence.
+- In-sandbox runtime (``executor/``): a C++ HTTP server that confines paths,
+  auto-installs dependencies, and runs user code under a timeout — with a warm
+  persistent Python runner that pre-initializes JAX/libtpu so user array code
+  hits a hot TPU.
+- TPU compute path (``ops/``, ``parallel/``, ``models/``): numpy→jax.numpy
+  dispatch shim, device-mesh/sharding helpers, ring-attention sequence
+  parallelism, and flagship JAX models used as Execute payloads.
+
+Capability parity target: the reference service surveyed in SURVEY.md
+(gRPC/HTTP Execute, ParseCustomTool, ExecuteCustomTool; file round-tripping;
+warm Kubernetes pod pool; native in-sandbox executor).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,125 @@
+"""Service configuration, overridable via ``APP_``-prefixed environment vars.
+
+Parity notes: mirrors the reference's pydantic-settings `Config` with env
+prefix ``APP_`` and its 12 knobs (src/code_interpreter/config.py:18-80):
+logging config, listen addrs, TLS material, executor image/resources/pod-spec
+hooks, storage path, pool target length, pod name prefix. Added TPU-native
+knobs: executor backend selection (local subprocess vs kubernetes), warm-runner
+toggle, TPU topology/chip-count defaults, JAX persistent compilation cache
+path, and default execution timeout. pydantic-settings is not available in
+this environment, so env parsing is implemented directly (JSON for structured
+fields, plain strings otherwise).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from pydantic import BaseModel, Field
+
+ENV_PREFIX = "APP_"
+
+
+def _default_logging_config() -> dict:
+    return {
+        "version": 1,
+        "disable_existing_loggers": False,
+        "filters": {
+            "request_id": {"()": "bee_code_interpreter_fs_tpu.utils.logs.RequestIdFilter"}
+        },
+        "formatters": {
+            "standard": {
+                "format": "%(levelname)s [%(request_id)s] %(name)s: %(message)s"
+            }
+        },
+        "handlers": {
+            "default": {
+                "class": "logging.StreamHandler",
+                "formatter": "standard",
+                "filters": ["request_id"],
+                "stream": "ext://sys.stdout",
+            }
+        },
+        "root": {"level": "INFO", "handlers": ["default"]},
+        "loggers": {
+            "bee_code_interpreter_fs_tpu": {"level": "INFO"},
+            "aiohttp.access": {"level": "WARNING"},
+        },
+    }
+
+
+class Config(BaseModel):
+    # -- logging ------------------------------------------------------------
+    logging_config: dict = Field(default_factory=_default_logging_config)
+
+    # -- listen addresses ---------------------------------------------------
+    http_listen_addr: str = "0.0.0.0:8000"
+    grpc_listen_addr: str = "0.0.0.0:50051"
+
+    # -- optional gRPC TLS --------------------------------------------------
+    grpc_tls_cert: bytes | None = None
+    grpc_tls_cert_key: bytes | None = None
+    grpc_tls_ca_cert: bytes | None = None
+
+    # -- executor orchestration --------------------------------------------
+    executor_backend: str = "local"  # "local" | "kubernetes"
+    executor_image: str = "localhost/tpu-code-executor:local"
+    executor_container_resources: dict = Field(default_factory=dict)
+    executor_pod_spec_extra: dict = Field(default_factory=dict)
+    executor_pod_queue_target_length: int = 5
+    executor_pod_name_prefix: str = "tpu-code-executor-"
+    executor_pod_ready_timeout: float = 60.0
+
+    # -- local backend ------------------------------------------------------
+    # Path to the compiled C++ executor server; resolved relative to repo root
+    # when not absolute. Empty string → auto-discover.
+    executor_binary: str = ""
+    local_sandbox_root: str = "/tmp/tpu-code-interpreter/sandboxes"
+
+    # -- storage ------------------------------------------------------------
+    file_storage_path: str = "/tmp/tpu-code-interpreter/storage"
+
+    # -- execution ----------------------------------------------------------
+    default_execution_timeout: float = 60.0
+    max_execution_timeout: float = 600.0
+
+    # -- TPU ----------------------------------------------------------------
+    # Warm runner pre-imports jax (initializing libtpu) at sandbox boot so the
+    # Execute p50 cold-start excludes TPU init; see executor/runner.py.
+    executor_warm_runner: bool = True
+    # Default accelerator request for kubernetes backend pods, merged into the
+    # container resources (e.g. {"google.com/tpu": "4"}). Empty → CPU pods.
+    tpu_resource_requests: dict = Field(default_factory=dict)
+    # Node-selector hints for TPU slice topology, e.g.
+    # {"cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+    #  "cloud.google.com/gke-tpu-topology": "2x2"}.
+    tpu_node_selector: dict = Field(default_factory=dict)
+    # Default chip count an Execute request gets when it doesn't ask.
+    default_chip_count: int = 0  # 0 = whatever the sandbox has
+    # Persistent XLA compilation cache shared across sandbox generations.
+    jax_compilation_cache_dir: str = "/tmp/tpu-code-interpreter/jax-cache"
+
+    @classmethod
+    def from_env(cls, environ: dict[str, str] | None = None) -> "Config":
+        env = os.environ if environ is None else environ
+        values: dict[str, Any] = {}
+        for name, field in cls.model_fields.items():
+            key = ENV_PREFIX + name.upper()
+            if key not in env:
+                continue
+            raw = env[key]
+            ann = str(field.annotation)
+            if "dict" in ann or "list" in ann:
+                try:
+                    values[name] = json.loads(raw)
+                except json.JSONDecodeError as e:
+                    raise ValueError(
+                        f"environment variable {key} must be valid JSON: {e}"
+                    ) from None
+            elif "bytes" in ann:
+                values[name] = raw.encode()
+            else:
+                values[name] = raw  # pydantic coerces int/float/bool/str
+        return cls(**values)

@@ -1,0 +1,122 @@
+"""Content-addressed object storage for workspace file round-trips.
+
+Files flowing into/out of executions and through the ``/v1/files`` API live
+here, keyed by the SHA-256 of their content. This fixes the reference's lie
+(its docstring claims content addressing but names objects with
+``secrets.token_hex(32)`` — src/code_interpreter/services/storage.py:36-52,
+SURVEY.md §0.3): real content addressing dedups the repeated file round-trips
+that stateless session persistence produces (the same unchanged file is
+re-uploaded on every Execute in a session).
+
+API shape parity: async streaming ``writer()``/``reader()`` context managers
+and whole-object ``write/read/exists/delete`` (storage.py:44-101), with ids
+kept opaque to clients.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import secrets
+from contextlib import asynccontextmanager
+from pathlib import Path
+
+import anyio
+
+from ..utils.validation import OBJECT_ID_RE, SHA256_HEX_RE
+
+CHUNK_SIZE = 1 << 20
+
+
+class StorageObjectNotFound(KeyError):
+    pass
+
+
+class _HashingWriter:
+    """File sink that hashes content as it streams in.
+
+    The final object id is available as ``.hash`` only after the surrounding
+    context manager exits (matching the reference writer's contract where the
+    id is assigned up-front; here it can't be, because the id IS the digest).
+    """
+
+    def __init__(self, file: anyio.AsyncFile) -> None:
+        self._file = file
+        self._digest = hashlib.sha256()
+        self.size = 0
+        self.hash: str | None = None
+
+    async def write(self, data: bytes) -> None:
+        self._digest.update(data)
+        self.size += len(data)
+        await self._file.write(data)
+
+    def _finalize(self) -> str:
+        self.hash = self._digest.hexdigest()
+        return self.hash
+
+
+class Storage:
+    def __init__(self, storage_path: str | os.PathLike) -> None:
+        self.path = Path(storage_path)
+        self.path.mkdir(parents=True, exist_ok=True)
+        self._tmp = self.path / ".tmp"
+        self._tmp.mkdir(exist_ok=True)
+
+    def _object_path(self, object_id: str) -> Path:
+        if not OBJECT_ID_RE.match(object_id):
+            raise ValueError(f"invalid object id: {object_id!r}")
+        return self.path / object_id
+
+    @asynccontextmanager
+    async def writer(self):
+        """Stream an object in; its content hash becomes the object id."""
+        tmp_path = self._tmp / secrets.token_hex(16)
+        async with await anyio.open_file(tmp_path, "wb") as f:
+            w = _HashingWriter(f)
+            try:
+                yield w
+            except BaseException:
+                await anyio.Path(tmp_path).unlink(missing_ok=True)
+                raise
+        object_id = w._finalize()
+        final = self.path / object_id
+        if await anyio.Path(final).exists():
+            # Dedup: identical content already stored.
+            await anyio.Path(tmp_path).unlink(missing_ok=True)
+        else:
+            os.replace(tmp_path, final)
+        assert SHA256_HEX_RE.match(object_id), object_id
+
+    @asynccontextmanager
+    async def reader(self, object_id: str):
+        p = self._object_path(object_id)
+        try:
+            f = await anyio.open_file(p, "rb")
+        except FileNotFoundError:
+            raise StorageObjectNotFound(object_id) from None
+        async with f:
+            yield f
+
+    async def write(self, data: bytes) -> str:
+        async with self.writer() as w:
+            await w.write(data)
+        assert w.hash is not None
+        return w.hash
+
+    async def read(self, object_id: str) -> bytes:
+        async with self.reader(object_id) as f:
+            return await f.read()
+
+    async def exists(self, object_id: str) -> bool:
+        return await anyio.Path(self._object_path(object_id)).exists()
+
+    async def size(self, object_id: str) -> int:
+        try:
+            stat = await anyio.Path(self._object_path(object_id)).stat()
+        except FileNotFoundError:
+            raise StorageObjectNotFound(object_id) from None
+        return stat.st_size
+
+    async def delete(self, object_id: str) -> None:
+        await anyio.Path(self._object_path(object_id)).unlink(missing_ok=True)

@@ -1,0 +1,65 @@
+"""Structured logging with per-request correlation ids.
+
+Parity notes: the reference injects a per-request UUID through a ContextVar +
+logging.Filter pair wired in application_context.py:40-53 and set per-RPC in
+code_interpreter_servicer.py:60. Same design here, shared by gRPC and HTTP
+layers, plus a helper to time request phases (queue-wait / upload / exec /
+download) that the reference lacks (SURVEY.md §5 "Tracing / profiling").
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import logging.config
+import time
+import uuid
+from contextvars import ContextVar
+
+request_id_var: ContextVar[str] = ContextVar("request_id", default="-")
+
+
+class RequestIdFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.request_id = request_id_var.get()
+        return True
+
+
+def new_request_id() -> str:
+    rid = uuid.uuid4().hex[:12]
+    request_id_var.set(rid)
+    return rid
+
+
+def setup_logging(config: dict | None = None) -> None:
+    if config:
+        logging.config.dictConfig(config)
+    root = logging.getLogger()
+    if not root.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("%(levelname)s [%(request_id)s] %(name)s: %(message)s")
+        )
+        root.addHandler(handler)
+        root.setLevel(logging.INFO)
+    for handler in logging.getLogger().handlers:
+        if not any(isinstance(f, RequestIdFilter) for f in handler.filters):
+            handler.addFilter(RequestIdFilter())
+
+
+class PhaseTimer:
+    """Accumulates named phase durations for one request (seconds)."""
+
+    def __init__(self) -> None:
+        self.phases: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases[name] = self.phases.get(name, 0.0) + time.perf_counter() - start
+
+    def as_dict(self) -> dict[str, float]:
+        return dict(self.phases)

@@ -1,0 +1,86 @@
+"""Validation primitives shared by the API and storage layers.
+
+Parity notes (reference: src/code_interpreter/utils/validation.py:19-22): the
+reference validates object ids with ``^[0-9a-zA-Z_-]{1,255}$`` and absolute
+paths with ``^/[^/].*$``, and its "hashes" are actually random tokens
+(storage.py:52). Here object ids are *real* SHA-256 digests (``Sha256Hex``)
+while the API keeps accepting the broader legacy pattern (``ObjectId``) so
+clients holding older ids keep working. Path confinement (absent in the
+reference executor — see SURVEY.md §0.4) is implemented in `confine_path`.
+"""
+
+from __future__ import annotations
+
+import os
+import posixpath
+import re
+from pathlib import Path
+from typing import Annotated
+
+from pydantic import StringConstraints
+
+# Ids accepted by APIs (superset: covers real sha256 hex and legacy opaque ids).
+OBJECT_ID_RE = re.compile(r"^[0-9a-zA-Z_-]{1,255}$")
+# Ids produced by Storage: lowercase sha-256 hex.
+SHA256_HEX_RE = re.compile(r"^[0-9a-f]{64}$")
+ABSOLUTE_PATH_RE = re.compile(r"^/[^/].*$")
+
+ObjectId = Annotated[str, StringConstraints(pattern=OBJECT_ID_RE)]
+Sha256Hex = Annotated[str, StringConstraints(pattern=SHA256_HEX_RE)]
+AbsolutePath = Annotated[str, StringConstraints(pattern=ABSOLUTE_PATH_RE)]
+
+# Kept name-compatible with the reference's `Hash` annotation.
+Hash = ObjectId
+
+
+class PathEscapeError(ValueError):
+    """A user-supplied path would escape its confinement root."""
+
+
+def normalize_workspace_path(path: str) -> str:
+    """Normalize a user path to a relative POSIX path inside the workspace.
+
+    Accepts both absolute (``/workspace/foo.txt`` style or ``/foo.txt``) and
+    relative inputs; rejects anything that climbs out via ``..``.
+    """
+    p = posixpath.normpath(path.replace("\\", "/"))
+    p = p.lstrip("/")
+    if p in ("", "."):
+        raise PathEscapeError(f"empty path: {path!r}")
+    parts = p.split("/")
+    if ".." in parts:
+        raise PathEscapeError(f"path escapes workspace: {path!r}")
+    return p
+
+
+def confine_path(base: str | Path, user_path: str) -> Path:
+    """Join `user_path` under `base`, guaranteeing the result stays under base.
+
+    The reference executor joined attacker-controlled paths with
+    ``PathBuf::join`` which *replaces* the base for absolute inputs
+    (executor/server.rs:83, SURVEY.md §0.4) — i.e. no confinement at all.
+    Here we normalize, forbid ``..``, and verify the resolved path after
+    symlink resolution of the base.
+    """
+    base_p = Path(base).resolve()
+    rel = normalize_workspace_path(user_path)
+    candidate = (base_p / rel).absolute()
+    # realpath also resolves symlinks *inside* the workspace (user code can
+    # create ws/link -> /etc, then ask for link/passwd); the confinement check
+    # must run on the fully resolved target, not the lexical join.
+    resolved = Path(os.path.realpath(candidate))
+    if os.path.commonpath([base_p, resolved]) != str(base_p):
+        raise PathEscapeError(f"path escapes {base_p}: {user_path!r}")
+    return resolved
+
+
+def validate_object_id(value: str) -> str:
+    if not OBJECT_ID_RE.match(value):
+        raise ValueError(f"invalid object id: {value!r}")
+    return value
+
+
+def validate_absolute_path(value: str) -> str:
+    if not ABSOLUTE_PATH_RE.match(value):
+        raise ValueError(f"invalid absolute path: {value!r}")
+    return value

@@ -1,0 +1,47 @@
+"""Test harness config.
+
+- Forces JAX onto a virtual 8-device CPU mesh so all sharding/collective logic
+  is exercised without TPU hardware (the driver separately dry-runs the
+  multi-chip path via __graft_entry__.dryrun_multichip).
+- Provides a minimal async-test runner (pytest-asyncio is not available in
+  this environment): any ``async def test_*`` is run via asyncio.run().
+"""
+
+import asyncio
+import inspect
+import os
+import sys
+from pathlib import Path
+
+# Must happen before anything imports jax.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+import pytest  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
+
+
+@pytest.fixture
+def tmp_storage(tmp_path):
+    from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+    return Storage(tmp_path / "storage")

@@ -1,0 +1,42 @@
+from bee_code_interpreter_fs_tpu.config import Config
+
+
+def test_defaults():
+    cfg = Config()
+    assert cfg.executor_pod_queue_target_length == 5
+    assert cfg.http_listen_addr == "0.0.0.0:8000"
+    assert cfg.executor_backend == "local"
+    assert cfg.default_execution_timeout == 60.0
+
+
+def test_env_override():
+    cfg = Config.from_env(
+        {
+            "APP_HTTP_LISTEN_ADDR": "127.0.0.1:9000",
+            "APP_EXECUTOR_POD_QUEUE_TARGET_LENGTH": "2",
+            "APP_EXECUTOR_WARM_RUNNER": "false",
+            "APP_TPU_RESOURCE_REQUESTS": '{"google.com/tpu": "4"}',
+            "APP_EXECUTOR_POD_SPEC_EXTRA": '{"nodeSelector": {"pool": "tpu"}}',
+            "APP_GRPC_TLS_CERT": "PEMDATA",
+            "UNRELATED": "ignored",
+        }
+    )
+    assert cfg.http_listen_addr == "127.0.0.1:9000"
+    assert cfg.executor_pod_queue_target_length == 2
+    assert cfg.executor_warm_runner is False
+    assert cfg.tpu_resource_requests == {"google.com/tpu": "4"}
+    assert cfg.executor_pod_spec_extra == {"nodeSelector": {"pool": "tpu"}}
+    assert cfg.grpc_tls_cert == b"PEMDATA"
+
+
+def test_logging_config_shape():
+    cfg = Config()
+    assert cfg.logging_config["version"] == 1
+    assert "request_id" in cfg.logging_config["filters"]
+
+
+def test_bad_json_env_names_variable():
+    import pytest
+
+    with pytest.raises(ValueError, match="APP_TPU_RESOURCE_REQUESTS"):
+        Config.from_env({"APP_TPU_RESOURCE_REQUESTS": "not-json"})

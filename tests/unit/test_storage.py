@@ -1,0 +1,67 @@
+import hashlib
+
+import pytest
+
+from bee_code_interpreter_fs_tpu.services.storage import (
+    Storage,
+    StorageObjectNotFound,
+)
+
+
+async def test_write_read_roundtrip(tmp_storage: Storage):
+    data = b"hello tpu"
+    object_id = await tmp_storage.write(data)
+    assert object_id == hashlib.sha256(data).hexdigest()
+    assert await tmp_storage.read(object_id) == data
+    assert await tmp_storage.exists(object_id)
+    assert await tmp_storage.size(object_id) == len(data)
+
+
+async def test_content_addressing_dedups(tmp_storage: Storage):
+    a = await tmp_storage.write(b"same bytes")
+    b = await tmp_storage.write(b"same bytes")
+    assert a == b
+    files = [p for p in tmp_storage.path.iterdir() if p.is_file()]
+    assert len(files) == 1
+
+
+async def test_streaming_writer(tmp_storage: Storage):
+    async with tmp_storage.writer() as w:
+        await w.write(b"part1-")
+        await w.write(b"part2")
+    assert w.hash == hashlib.sha256(b"part1-part2").hexdigest()
+    assert await tmp_storage.read(w.hash) == b"part1-part2"
+
+
+async def test_reader_streams(tmp_storage: Storage):
+    object_id = await tmp_storage.write(b"x" * 100)
+    chunks = []
+    async with tmp_storage.reader(object_id) as r:
+        while chunk := await r.read(7):
+            chunks.append(chunk)
+    assert b"".join(chunks) == b"x" * 100
+
+
+async def test_missing_object(tmp_storage: Storage):
+    with pytest.raises(StorageObjectNotFound):
+        await tmp_storage.read("0" * 64)
+    with pytest.raises(ValueError):
+        await tmp_storage.read("bad/id")
+
+
+async def test_delete(tmp_storage: Storage):
+    object_id = await tmp_storage.write(b"to delete")
+    await tmp_storage.delete(object_id)
+    assert not await tmp_storage.exists(object_id)
+    # idempotent
+    await tmp_storage.delete(object_id)
+
+
+async def test_aborted_writer_leaves_no_object(tmp_storage: Storage):
+    with pytest.raises(RuntimeError):
+        async with tmp_storage.writer() as w:
+            await w.write(b"partial")
+            raise RuntimeError("boom")
+    files = [p for p in tmp_storage.path.iterdir() if p.is_file()]
+    assert files == []
+    assert list(tmp_storage._tmp.iterdir()) == []

@@ -1,0 +1,64 @@
+import pytest
+
+from bee_code_interpreter_fs_tpu.utils.validation import (
+    PathEscapeError,
+    confine_path,
+    normalize_workspace_path,
+    validate_absolute_path,
+    validate_object_id,
+)
+
+
+def test_object_id_patterns():
+    validate_object_id("a" * 64)
+    validate_object_id("legacy-ID_123")
+    with pytest.raises(ValueError):
+        validate_object_id("")
+    with pytest.raises(ValueError):
+        validate_object_id("x" * 256)
+    with pytest.raises(ValueError):
+        validate_object_id("has/slash")
+    with pytest.raises(ValueError):
+        validate_object_id("../escape")
+
+
+def test_absolute_path():
+    validate_absolute_path("/workspace/foo.txt")
+    with pytest.raises(ValueError):
+        validate_absolute_path("relative.txt")
+    with pytest.raises(ValueError):
+        validate_absolute_path("//double")
+
+
+def test_normalize_workspace_path():
+    assert normalize_workspace_path("/workspace/foo.txt") == "workspace/foo.txt"
+    assert normalize_workspace_path("foo/bar.txt") == "foo/bar.txt"
+    assert normalize_workspace_path("./a/./b") == "a/b"
+    assert normalize_workspace_path("a/b/../c") == "a/c"
+    with pytest.raises(PathEscapeError):
+        normalize_workspace_path("../../etc/passwd")
+    with pytest.raises(PathEscapeError):
+        normalize_workspace_path("a/../../etc")
+    with pytest.raises(PathEscapeError):
+        normalize_workspace_path("/")
+
+
+def test_confine_path(tmp_path):
+    base = tmp_path / "ws"
+    base.mkdir()
+    p = confine_path(base, "/workspace-escape-attempt.txt")
+    assert str(p).startswith(str(base))
+    # The reference's Rust join() would have replaced the base entirely for
+    # absolute inputs (SURVEY.md §0.4); ours must keep it confined.
+    p2 = confine_path(base, "/etc/passwd")
+    assert str(p2) == str(base / "etc/passwd")
+    with pytest.raises(PathEscapeError):
+        confine_path(base, "../outside.txt")
+
+
+def test_confine_path_symlink_escape(tmp_path):
+    base = tmp_path / "ws"
+    base.mkdir()
+    (base / "link").symlink_to("/etc")
+    with pytest.raises(PathEscapeError):
+        confine_path(base, "link/passwd")
