@@ -1,0 +1,100 @@
+"""Dependency auto-install scanner: prints pip package names a script needs
+but the sandbox lacks, one per line.
+
+TPU-native replacement for the reference's `upm guess` subprocess + sqlite
+import→package DB (executor/server.rs:174-195, executor/Dockerfile:122-124):
+an AST walk over the user script collects imported top-level modules, filters
+the stdlib (sys.stdlib_module_names) and anything already importable, then
+maps import names to pip names via a small alias table. A skip list
+(requirements-skip.txt in the runtime-packages dir, reference parity:
+executor/requirements-skip.txt) suppresses OS-packaged aliases.
+
+Usage: python deps.py <script.py> [runtime_packages_dir]
+"""
+
+import ast
+import importlib.util
+import re
+import sys
+from pathlib import Path
+
+# import name -> pip distribution name, for the common divergent cases.
+IMPORT_TO_PIP = {
+    "cv2": "opencv-python-headless",
+    "PIL": "pillow",
+    "sklearn": "scikit-learn",
+    "skimage": "scikit-image",
+    "bs4": "beautifulsoup4",
+    "yaml": "pyyaml",
+    "Crypto": "pycryptodome",
+    "fitz": "pymupdf",
+    "dateutil": "python-dateutil",
+    "docx": "python-docx",
+    "pptx": "python-pptx",
+    "kubernetes": "kubernetes",
+    "serial": "pyserial",
+    "OpenSSL": "pyopenssl",
+    "jwt": "pyjwt",
+    "magic": "python-magic",
+    "Levenshtein": "python-Levenshtein",
+    "moviepy": "moviepy",
+    "gi": None,  # system-only
+    "libtpu": None,
+}
+
+
+def imported_top_modules(source: str) -> set[str]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return set()
+    mods: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                mods.add(alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.level == 0:
+                mods.add(node.module.split(".")[0])
+    return mods
+
+
+def load_skip_list(runtime_packages: Path) -> set[str]:
+    skip: set[str] = set()
+    for name in ("requirements.txt", "requirements-skip.txt"):
+        p = runtime_packages / name
+        if not p.exists():
+            continue
+        for line in p.read_text().splitlines():
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            # strip extras/version specifiers: "pandas[excel]>=2" -> "pandas"
+            pkg = re.split(r"[\[<>=!~;]", line, 1)[0].strip().lower()
+            if pkg:
+                skip.add(pkg)
+    return skip
+
+
+def main() -> None:
+    script = Path(sys.argv[1])
+    runtime_packages = Path(sys.argv[2]) if len(sys.argv) > 2 else None
+    mods = imported_top_modules(script.read_text())
+    skip = load_skip_list(runtime_packages) if runtime_packages else set()
+    missing: list[str] = []
+    for mod in sorted(mods):
+        if mod in sys.stdlib_module_names:
+            continue
+        if importlib.util.find_spec(mod) is not None:
+            continue
+        pip_name = IMPORT_TO_PIP.get(mod, mod)
+        if pip_name is None:
+            continue
+        if pip_name.lower() in skip or mod.lower() in skip:
+            continue
+        missing.append(pip_name)
+    print("\n".join(missing))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,374 @@
+// Small threaded HTTP/1.1 server for the in-sandbox executor.
+//
+// Design notes (TPU build): the executor serves one sandbox — a handful of
+// concurrent file transfers plus one /execute at a time — so a clear,
+// auditable thread-per-connection loop beats an async state machine. Bodies
+// stream to/from disk (uploads can be model checkpoints), with both
+// Content-Length and chunked transfer encodings supported.
+#pragma once
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/sendfile.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace minihttp {
+
+inline std::string lower(std::string s) {
+  for (auto& c : s) c = static_cast<char>(tolower(static_cast<unsigned char>(c)));
+  return s;
+}
+
+struct Request {
+  std::string method;
+  std::string target;  // raw path (no query handling beyond split)
+  std::string query;
+  std::map<std::string, std::string> headers;  // lowercased names
+  std::string header(const std::string& name, const std::string& dflt = "") const {
+    auto it = headers.find(lower(name));
+    return it == headers.end() ? dflt : it->second;
+  }
+};
+
+// Reads from a connection, buffered; decodes request bodies.
+class Conn {
+ public:
+  explicit Conn(int fd) : fd_(fd) {}
+  ~Conn() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+  Conn(const Conn&) = delete;
+  Conn& operator=(const Conn&) = delete;
+
+  int fd() const { return fd_; }
+
+  // Returns false on clean EOF before any byte of a new request.
+  bool read_request(Request& req) {
+    std::string line;
+    if (!read_line(line, /*eof_ok=*/true)) return false;
+    if (line.empty()) {
+      if (!read_line(line, true)) return false;  // tolerate stray CRLF
+    }
+    size_t sp1 = line.find(' ');
+    size_t sp2 = line.rfind(' ');
+    if (sp1 == std::string::npos || sp2 == sp1)
+      throw std::runtime_error("bad request line");
+    req.method = line.substr(0, sp1);
+    std::string target = line.substr(sp1 + 1, sp2 - sp1 - 1);
+    size_t q = target.find('?');
+    if (q != std::string::npos) {
+      req.query = target.substr(q + 1);
+      target = target.substr(0, q);
+    }
+    req.target = target;
+    req.headers.clear();
+    while (true) {
+      if (!read_line(line, false)) throw std::runtime_error("eof in headers");
+      if (line.empty()) break;
+      size_t colon = line.find(':');
+      if (colon == std::string::npos) continue;
+      std::string name = lower(line.substr(0, colon));
+      size_t vstart = line.find_first_not_of(" \t", colon + 1);
+      req.headers[name] = vstart == std::string::npos ? "" : line.substr(vstart);
+    }
+    init_body(req);
+    return true;
+  }
+
+  // Read next chunk of the current request body into `out` (appends).
+  // Returns number of bytes read; 0 at end of body.
+  size_t read_body_some(std::string& out, size_t max = 1 << 16) {
+    if (chunked_) return read_chunked_some(out, max);
+    if (remaining_ == 0) return 0;
+    size_t want = std::min(max, remaining_);
+    size_t got = read_some_into(out, want);
+    remaining_ -= got;
+    if (got == 0 && remaining_ > 0) throw std::runtime_error("eof in body");
+    return got;
+  }
+
+  std::string read_body(size_t limit = 64ull << 20) {
+    std::string body;
+    std::string chunk;
+    while (true) {
+      chunk.clear();
+      if (read_body_some(chunk) == 0) break;
+      body += chunk;
+      if (body.size() > limit) throw std::runtime_error("body too large");
+    }
+    return body;
+  }
+
+  // Stream body to an open fd; returns total bytes.
+  size_t read_body_to_fd(int out_fd) {
+    size_t total = 0;
+    std::string chunk;
+    while (true) {
+      chunk.clear();
+      if (read_body_some(chunk, 1 << 20) == 0) break;
+      size_t off = 0;
+      while (off < chunk.size()) {
+        ssize_t n = ::write(out_fd, chunk.data() + off, chunk.size() - off);
+        if (n < 0) {
+          if (errno == EINTR) continue;
+          throw std::runtime_error("write failed");
+        }
+        off += static_cast<size_t>(n);
+      }
+      total += chunk.size();
+    }
+    return total;
+  }
+
+  void drain_body() {
+    std::string sink;
+    while (read_body_some(sink, 1 << 16) != 0) sink.clear();
+  }
+
+  // ---- responses ----
+  void send_response(int status, const std::string& content_type,
+                     const std::string& body,
+                     const std::vector<std::pair<std::string, std::string>>& extra = {}) {
+    std::string head = "HTTP/1.1 " + std::to_string(status) + " " + reason(status) +
+                       "\r\nContent-Type: " + content_type +
+                       "\r\nContent-Length: " + std::to_string(body.size()) + "\r\n";
+    for (const auto& [k, v] : extra) head += k + ": " + v + "\r\n";
+    head += "\r\n";
+    write_all(head);
+    write_all(body);
+  }
+
+  // Sends a file with sendfile(2); returns false if open/stat fails.
+  bool send_file(const std::string& path) {
+    int f = ::open(path.c_str(), O_RDONLY | O_NOFOLLOW);
+    if (f < 0) return false;
+    return send_file_fd(f);
+  }
+
+  // Same, from an already-open fd (always closes it).
+  bool send_file_fd(int f) {
+    struct stat st;
+    if (fstat(f, &st) != 0 || !S_ISREG(st.st_mode)) {
+      ::close(f);
+      return false;
+    }
+    std::string head =
+        "HTTP/1.1 200 OK\r\nContent-Type: application/octet-stream\r\nContent-Length: " +
+        std::to_string(st.st_size) + "\r\n\r\n";
+    write_all(head);
+    off_t offset = 0;
+    while (offset < st.st_size) {
+      ssize_t n = ::sendfile(fd_, f, &offset, static_cast<size_t>(st.st_size - offset));
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        ::close(f);
+        throw std::runtime_error("sendfile failed");
+      }
+      if (n == 0) break;
+    }
+    ::close(f);
+    return true;
+  }
+
+ private:
+  void init_body(const Request& req) {
+    chunked_ = lower(req.header("transfer-encoding")) == "chunked";
+    chunk_remaining_ = 0;
+    chunked_done_ = false;
+    remaining_ = 0;
+    if (!chunked_) {
+      std::string cl = req.header("content-length", "0");
+      remaining_ = cl.empty() ? 0 : std::stoull(cl);
+    }
+  }
+
+  size_t read_chunked_some(std::string& out, size_t max) {
+    if (chunked_done_) return 0;
+    if (chunk_remaining_ == 0) {
+      std::string line;
+      if (!read_line(line, false)) throw std::runtime_error("eof in chunk size");
+      if (line.empty() && !read_line(line, false))
+        throw std::runtime_error("eof in chunk size");
+      chunk_remaining_ = std::stoull(line, nullptr, 16);
+      if (chunk_remaining_ == 0) {
+        // trailing headers until blank line
+        while (read_line(line, false) && !line.empty()) {
+        }
+        chunked_done_ = true;
+        return 0;
+      }
+    }
+    size_t want = std::min(max, chunk_remaining_);
+    size_t got = read_some_into(out, want);
+    if (got == 0) throw std::runtime_error("eof in chunk");
+    chunk_remaining_ -= got;
+    if (chunk_remaining_ == 0) {
+      std::string crlf;
+      read_line(crlf, false);  // consume trailing CRLF
+    }
+    return got;
+  }
+
+  bool fill() {
+    char tmp[1 << 16];
+    ssize_t n;
+    do {
+      n = ::recv(fd_, tmp, sizeof(tmp), 0);
+    } while (n < 0 && errno == EINTR);
+    if (n <= 0) return false;
+    buf_.append(tmp, static_cast<size_t>(n));
+    return true;
+  }
+
+  bool read_line(std::string& line, bool eof_ok) {
+    while (true) {
+      size_t nl = buf_.find('\n', pos_);
+      if (nl != std::string::npos) {
+        line = buf_.substr(pos_, nl - pos_);
+        if (!line.empty() && line.back() == '\r') line.pop_back();
+        pos_ = nl + 1;
+        compact();
+        return true;
+      }
+      if (!fill()) {
+        if (eof_ok && pos_ >= buf_.size()) return false;
+        throw std::runtime_error("eof mid-line");
+      }
+    }
+  }
+
+  size_t read_some_into(std::string& out, size_t want) {
+    if (pos_ >= buf_.size()) {
+      buf_.clear();
+      pos_ = 0;
+      if (!fill()) return 0;
+    }
+    size_t avail = buf_.size() - pos_;
+    size_t take = std::min(avail, want);
+    out.append(buf_, pos_, take);
+    pos_ += take;
+    compact();
+    return take;
+  }
+
+  void compact() {
+    if (pos_ > (1 << 20)) {
+      buf_.erase(0, pos_);
+      pos_ = 0;
+    }
+  }
+
+  void write_all(const std::string& data) {
+    size_t off = 0;
+    while (off < data.size()) {
+      ssize_t n = ::send(fd_, data.data() + off, data.size() - off, MSG_NOSIGNAL);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        throw std::runtime_error("send failed");
+      }
+      off += static_cast<size_t>(n);
+    }
+  }
+
+  static const char* reason(int status) {
+    switch (status) {
+      case 200: return "OK";
+      case 201: return "Created";
+      case 400: return "Bad Request";
+      case 403: return "Forbidden";
+      case 404: return "Not Found";
+      case 408: return "Request Timeout";
+      case 500: return "Internal Server Error";
+      default: return "Unknown";
+    }
+  }
+
+  int fd_;
+  std::string buf_;
+  size_t pos_ = 0;
+  bool chunked_ = false;
+  bool chunked_done_ = false;
+  size_t chunk_remaining_ = 0;
+  size_t remaining_ = 0;
+};
+
+using Handler = std::function<void(const Request&, Conn&)>;
+
+class Server {
+ public:
+  // addr "host:port"; port 0 picks an ephemeral port (reported by port()).
+  explicit Server(const std::string& addr, Handler handler)
+      : handler_(std::move(handler)) {
+    signal(SIGPIPE, SIG_IGN);
+    size_t colon = addr.rfind(':');
+    std::string host = colon == std::string::npos ? "0.0.0.0" : addr.substr(0, colon);
+    int port = colon == std::string::npos ? 8000 : std::stoi(addr.substr(colon + 1));
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) throw std::runtime_error("socket failed");
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host.c_str(), &sa.sin_addr) != 1)
+      throw std::runtime_error("bad listen host: " + host);
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0)
+      throw std::runtime_error("bind failed: " + addr);
+    if (listen(listen_fd_, 64) != 0) throw std::runtime_error("listen failed");
+    socklen_t len = sizeof(sa);
+    getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&sa), &len);
+    port_ = ntohs(sa.sin_port);
+  }
+
+  int port() const { return port_; }
+
+  [[noreturn]] void serve_forever() {
+    while (true) {
+      int cfd = ::accept(listen_fd_, nullptr, nullptr);
+      if (cfd < 0) {
+        if (errno == EINTR) continue;
+        throw std::runtime_error("accept failed");
+      }
+      int one = 1;
+      setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::thread([this, cfd] { handle_conn(cfd); }).detach();
+    }
+  }
+
+ private:
+  void handle_conn(int cfd) {
+    Conn conn(cfd);
+    try {
+      Request req;
+      while (conn.read_request(req)) {
+        handler_(req, conn);
+        // Consume any body bytes the handler didn't read (e.g. GET with a
+        // body) so the next keep-alive request parses from a clean boundary.
+        conn.drain_body();
+        if (lower(req.header("connection")) == "close") break;
+      }
+    } catch (const std::exception&) {
+      // connection-level error: drop the connection
+    }
+  }
+
+  Handler handler_;
+  int listen_fd_;
+  int port_ = 0;
+};
+
+}  // namespace minihttp

@@ -1,0 +1,332 @@
+// Minimal JSON parser/emitter for the in-sandbox executor server.
+// No external dependencies; supports the full JSON grammar (objects, arrays,
+// strings with \uXXXX escapes, numbers, bools, null) — enough for the
+// /execute request/response protocol and the runner wire format.
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace minijson {
+
+class Value;
+using Object = std::map<std::string, Value>;
+using Array = std::vector<Value>;
+
+class Value {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Value() : type_(Type::Null) {}
+  Value(std::nullptr_t) : type_(Type::Null) {}
+  Value(bool b) : type_(Type::Bool), bool_(b) {}
+  Value(double d) : type_(Type::Number), num_(d) {}
+  Value(int i) : type_(Type::Number), num_(i) {}
+  Value(int64_t i) : type_(Type::Number), num_(static_cast<double>(i)) {}
+  Value(const char* s) : type_(Type::String), str_(s) {}
+  Value(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Value(Array a) : type_(Type::Array), arr_(std::move(a)) {}
+  Value(Object o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const { return type_ == Type::Number; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_bool() const { return type_ == Type::Bool; }
+
+  bool as_bool() const { check(Type::Bool); return bool_; }
+  double as_number() const { check(Type::Number); return num_; }
+  const std::string& as_string() const { check(Type::String); return str_; }
+  const Array& as_array() const { check(Type::Array); return arr_; }
+  const Object& as_object() const { check(Type::Object); return obj_; }
+  Object& as_object() { check(Type::Object); return obj_; }
+
+  // Object convenience: returns Null value for missing keys.
+  const Value& get(const std::string& key) const {
+    static const Value null_value;
+    if (type_ != Type::Object) return null_value;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? null_value : it->second;
+  }
+
+  std::string get_string(const std::string& key, const std::string& dflt = "") const {
+    const Value& v = get(key);
+    return v.is_string() ? v.as_string() : dflt;
+  }
+  double get_number(const std::string& key, double dflt = 0) const {
+    const Value& v = get(key);
+    return v.is_number() ? v.as_number() : dflt;
+  }
+  bool get_bool(const std::string& key, bool dflt = false) const {
+    const Value& v = get(key);
+    return v.is_bool() ? v.as_bool() : dflt;
+  }
+
+  std::string dump() const {
+    std::string out;
+    dump_to(out);
+    return out;
+  }
+
+ private:
+  void check(Type t) const {
+    if (type_ != t) throw std::runtime_error("minijson: wrong type access");
+  }
+
+  static void escape_to(const std::string& s, std::string& out) {
+    out += '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': out += "\\\""; break;
+        case '\\': out += "\\\\"; break;
+        case '\b': out += "\\b"; break;
+        case '\f': out += "\\f"; break;
+        case '\n': out += "\\n"; break;
+        case '\r': out += "\\r"; break;
+        case '\t': out += "\\t"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out += buf;
+          } else {
+            out += static_cast<char>(c);
+          }
+      }
+    }
+    out += '"';
+  }
+
+  void dump_to(std::string& out) const {
+    switch (type_) {
+      case Type::Null: out += "null"; break;
+      case Type::Bool: out += bool_ ? "true" : "false"; break;
+      case Type::Number: {
+        if (std::isfinite(num_) && num_ == static_cast<int64_t>(num_)) {
+          out += std::to_string(static_cast<int64_t>(num_));
+        } else {
+          char buf[32];
+          snprintf(buf, sizeof(buf), "%.17g", num_);
+          out += buf;
+        }
+        break;
+      }
+      case Type::String: escape_to(str_, out); break;
+      case Type::Array: {
+        out += '[';
+        for (size_t i = 0; i < arr_.size(); ++i) {
+          if (i) out += ',';
+          arr_[i].dump_to(out);
+        }
+        out += ']';
+        break;
+      }
+      case Type::Object: {
+        out += '{';
+        bool first = true;
+        for (const auto& [k, v] : obj_) {
+          if (!first) out += ',';
+          first = false;
+          escape_to(k, out);
+          out += ':';
+          v.dump_to(out);
+        }
+        out += '}';
+        break;
+      }
+    }
+  }
+
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  Array arr_;
+  Object obj_;
+};
+
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : s_(text) {}
+
+  Value parse() {
+    Value v = parse_value();
+    skip_ws();
+    if (pos_ != s_.size()) throw std::runtime_error("minijson: trailing data");
+    return v;
+  }
+
+ private:
+  void skip_ws() {
+    while (pos_ < s_.size() &&
+           (s_[pos_] == ' ' || s_[pos_] == '\t' || s_[pos_] == '\n' || s_[pos_] == '\r'))
+      ++pos_;
+  }
+
+  char peek() {
+    skip_ws();
+    if (pos_ >= s_.size()) throw std::runtime_error("minijson: unexpected end");
+    return s_[pos_];
+  }
+
+  void expect(char c) {
+    if (peek() != c) throw std::runtime_error(std::string("minijson: expected ") + c);
+    ++pos_;
+  }
+
+  bool consume(char c) {
+    if (pos_ < s_.size() && peek() == c) {
+      ++pos_;
+      return true;
+    }
+    return false;
+  }
+
+  Value parse_value() {
+    char c = peek();
+    switch (c) {
+      case '{': return parse_object();
+      case '[': return parse_array();
+      case '"': return Value(parse_string());
+      case 't': literal("true"); return Value(true);
+      case 'f': literal("false"); return Value(false);
+      case 'n': literal("null"); return Value(nullptr);
+      default: return parse_number();
+    }
+  }
+
+  void literal(const char* lit) {
+    size_t n = strlen(lit);
+    if (s_.compare(pos_, n, lit) != 0) throw std::runtime_error("minijson: bad literal");
+    pos_ += n;
+  }
+
+  Value parse_object() {
+    expect('{');
+    Object obj;
+    if (consume('}')) return Value(std::move(obj));
+    while (true) {
+      std::string key = parse_string_at();
+      expect(':');
+      obj[key] = parse_value();
+      if (consume('}')) break;
+      expect(',');
+    }
+    return Value(std::move(obj));
+  }
+
+  Value parse_array() {
+    expect('[');
+    Array arr;
+    if (consume(']')) return Value(std::move(arr));
+    while (true) {
+      arr.push_back(parse_value());
+      if (consume(']')) break;
+      expect(',');
+    }
+    return Value(std::move(arr));
+  }
+
+  std::string parse_string_at() {
+    if (peek() != '"') throw std::runtime_error("minijson: expected string");
+    return parse_string();
+  }
+
+  static void utf8_append(std::string& out, uint32_t cp) {
+    if (cp < 0x80) {
+      out += static_cast<char>(cp);
+    } else if (cp < 0x800) {
+      out += static_cast<char>(0xC0 | (cp >> 6));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      out += static_cast<char>(0xE0 | (cp >> 12));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else {
+      out += static_cast<char>(0xF0 | (cp >> 18));
+      out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    }
+  }
+
+  uint32_t parse_hex4() {
+    if (pos_ + 4 > s_.size()) throw std::runtime_error("minijson: bad \\u escape");
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) {
+      char c = s_[pos_++];
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= c - '0';
+      else if (c >= 'a' && c <= 'f') v |= c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') v |= c - 'A' + 10;
+      else throw std::runtime_error("minijson: bad hex digit");
+    }
+    return v;
+  }
+
+  std::string parse_string() {
+    expect('"');
+    std::string out;
+    while (true) {
+      if (pos_ >= s_.size()) throw std::runtime_error("minijson: unterminated string");
+      char c = s_[pos_++];
+      if (c == '"') break;
+      if (c == '\\') {
+        if (pos_ >= s_.size()) throw std::runtime_error("minijson: bad escape");
+        char e = s_[pos_++];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            uint32_t cp = parse_hex4();
+            if (cp >= 0xD800 && cp <= 0xDBFF) {
+              // surrogate pair
+              if (pos_ + 1 < s_.size() && s_[pos_] == '\\' && s_[pos_ + 1] == 'u') {
+                pos_ += 2;
+                uint32_t lo = parse_hex4();
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+              }
+            }
+            utf8_append(out, cp);
+            break;
+          }
+          default: throw std::runtime_error("minijson: bad escape char");
+        }
+      } else {
+        out += c;
+      }
+    }
+    return out;
+  }
+
+  Value parse_number() {
+    size_t start = pos_;
+    if (pos_ < s_.size() && s_[pos_] == '-') ++pos_;
+    while (pos_ < s_.size() &&
+           (isdigit(static_cast<unsigned char>(s_[pos_])) || s_[pos_] == '.' ||
+            s_[pos_] == 'e' || s_[pos_] == 'E' || s_[pos_] == '+' || s_[pos_] == '-'))
+      ++pos_;
+    if (pos_ == start) throw std::runtime_error("minijson: bad number");
+    return Value(std::stod(s_.substr(start, pos_ - start)));
+  }
+
+  const std::string& s_;
+  size_t pos_ = 0;
+};
+
+inline Value parse(const std::string& text) { return Parser(text).parse(); }
+
+}  // namespace minijson

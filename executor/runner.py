@@ -1,0 +1,135 @@
+"""Warm execution runner: a persistent Python process that pre-initializes
+JAX/TPU at sandbox boot and then executes user scripts on demand.
+
+Why it exists (TPU design, SURVEY.md §7 hard part #2): libtpu init + device
+enumeration costs seconds. The reference spawned a fresh interpreter per
+execution (via xonsh, executor/server.rs:202-218), which is fine on CPU but
+would put TPU init on every Execute's critical path. Here the executor server
+(server.cpp) starts this runner when the sandbox boots — i.e. while the
+sandbox is still sitting in the warm pool — so by the time an Execute arrives,
+`import jax` and device init are already done and user code sees a hot TPU.
+
+Protocol: newline-delimited JSON. fd 3 = requests in, fd 4 = responses out.
+Request:  {"source_path": ..., "stdout_path": ..., "stderr_path": ..., "env": {...}}
+Response: {"exit_code": int}
+Ready line (sent once at boot): {"ready": true, "backend": ..., "device_count": n}
+
+User scripts run in-process via runpy with stdout/stderr redirected at the fd
+level, fresh sys.argv, and __main__ semantics. Sandboxes are single-use (one
+Execute per sandbox, enforced by the control plane pool), so in-process state
+leakage between requests is not a concern in production; local dev reuses a
+runner only within one logical session.
+"""
+
+import json
+import os
+import runpy
+import sys
+import traceback
+
+REQ_FD = 3
+RESP_FD = 4
+
+
+def _send(obj: dict) -> None:
+    os.write(RESP_FD, (json.dumps(obj) + "\n").encode())
+
+
+def _warm_import() -> dict:
+    """Pre-import jax and touch the devices so TPU init happens now."""
+    info = {"ready": True, "backend": "none", "device_count": 0}
+    if os.environ.get("APP_WARM_IMPORT_JAX", "1") in ("0", "false"):
+        return info
+    try:
+        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        import jax
+
+        if cache_dir:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+        devices = jax.devices()
+        info["backend"] = devices[0].platform if devices else "none"
+        info["device_count"] = len(devices)
+        # Trigger one tiny compile so the XLA pipeline is paged in.
+        import jax.numpy as jnp
+
+        jnp.add(jnp.ones(()), 1.0).block_until_ready()
+    except Exception:  # noqa: BLE001 — sandbox must still run CPU-only code
+        traceback.print_exc()
+        info["backend"] = "import-failed"
+    return info
+
+
+def _run_one(req: dict) -> int:
+    source_path = req["source_path"]
+    env = req.get("env") or {}
+    saved_env = {k: os.environ.get(k) for k in env}
+    os.environ.update({k: str(v) for k, v in env.items()})
+
+    out_fd = os.open(req["stdout_path"], os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    err_fd = os.open(req["stderr_path"], os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    saved_out, saved_err = os.dup(1), os.dup(2)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.dup2(out_fd, 1)
+    os.dup2(err_fd, 2)
+    os.close(out_fd)
+    os.close(err_fd)
+    saved_argv = sys.argv
+    exit_code = 0
+    try:
+        sys.argv = [source_path]
+        runpy.run_path(source_path, run_name="__main__")
+    except SystemExit as e:
+        code = e.code
+        exit_code = code if isinstance(code, int) else (0 if code is None else 1)
+    except BaseException:  # noqa: BLE001 — report, don't die
+        traceback.print_exc()
+        exit_code = 1
+    finally:
+        sys.argv = saved_argv
+        try:
+            sys.stdout.flush()
+            sys.stderr.flush()
+        except Exception:  # noqa: BLE001
+            pass
+        os.dup2(saved_out, 1)
+        os.dup2(saved_err, 2)
+        os.close(saved_out)
+        os.close(saved_err)
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return exit_code
+
+
+def main() -> None:
+    # Detach stdin; keep stdout/stderr (they reach the executor's log).
+    devnull = os.open(os.devnull, os.O_RDONLY)
+    os.dup2(devnull, 0)
+    os.close(devnull)
+
+    _send(_warm_import())
+
+    buf = b""
+    while True:
+        chunk = os.read(REQ_FD, 65536)
+        if not chunk:
+            return
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            if not line.strip():
+                continue
+            try:
+                req = json.loads(line)
+                exit_code = _run_one(req)
+                _send({"exit_code": exit_code})
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+                _send({"exit_code": -2})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,797 @@
+// In-sandbox executor server (TPU-native rebuild of the reference's Rust
+// executor; behavior parity with executor/server.rs:68-241 — file
+// upload/download routes, POST /execute with timeout and changed-file
+// detection — re-designed for TPU):
+//
+//   * Paths are explicitly confined to their base directory (the reference
+//     joined attacker-controlled absolute paths, server.rs:83).
+//   * User code runs under plain CPython, not xonsh (reclaims the ~80 ms
+//     startup acknowledged in server.rs:204) — or, by default, inside a warm
+//     persistent runner process that has already imported JAX and initialized
+//     the TPU at sandbox boot, so Execute latency excludes libtpu init and
+//     device enumeration (seconds on TPU — the pool amortizes it; SURVEY.md §7
+//     hard part #2).
+//   * Changed-file detection is a recursive mtime+size diff, not the
+//     reference's top-level-only ctime scan (server.rs:117-137).
+//   * Dependency auto-install uses an AST import scan (deps.py) instead of
+//     `upm guess` (server.rs:174-195), gated by APP_AUTO_INSTALL_DEPS.
+//
+// Env knobs: APP_LISTEN_ADDR (0.0.0.0:8000; port 0 = ephemeral, printed),
+// APP_WORKSPACE (/workspace), APP_RUNTIME_PACKAGES (/runtime-packages),
+// APP_PYTHON (python3), APP_WARM_RUNNER (1), APP_AUTO_INSTALL_DEPS (0),
+// APP_DEFAULT_TIMEOUT (60), APP_MAX_OUTPUT_BYTES (10485760).
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "http.hpp"
+#include "json.hpp"
+
+namespace {
+
+std::string env_or(const char* name, const std::string& dflt) {
+  const char* v = getenv(name);
+  return v && *v ? std::string(v) : dflt;
+}
+
+double env_num(const char* name, double dflt) {
+  const char* v = getenv(name);
+  return v && *v ? atof(v) : dflt;
+}
+
+bool env_flag(const char* name, bool dflt) {
+  const char* v = getenv(name);
+  if (!v || !*v) return dflt;
+  return strcmp(v, "0") != 0 && strcasecmp(v, "false") != 0;
+}
+
+void log_msg(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  fprintf(stderr, "[executor] ");
+  vfprintf(stderr, fmt, ap);
+  fprintf(stderr, "\n");
+  va_end(ap);
+}
+
+// ---------------------------------------------------------------------------
+// Path confinement (SURVEY.md §0.4 fix).
+
+// Normalizes a URL path to a safe relative path: strips leading slashes,
+// resolves "." segments, rejects "..". Returns empty string on rejection.
+std::string sanitize_rel_path(const std::string& raw) {
+  std::vector<std::string> parts;
+  std::string cur;
+  for (size_t i = 0; i <= raw.size(); ++i) {
+    char c = i < raw.size() ? raw[i] : '/';
+    if (c == '/') {
+      if (cur == ".." ) return "";
+      if (!cur.empty() && cur != ".") parts.push_back(cur);
+      cur.clear();
+    } else {
+      cur += c;
+    }
+  }
+  if (parts.empty()) return "";
+  std::string out;
+  for (size_t i = 0; i < parts.size(); ++i) {
+    if (i) out += '/';
+    out += parts[i];
+  }
+  return out;
+}
+
+// Joins base+rel and verifies the realpath of the existing prefix stays under
+// the realpath of base (guards against symlinks planted by user code).
+bool confine(const std::string& base, const std::string& rel, std::string& out) {
+  char base_real[PATH_MAX];
+  if (!realpath(base.c_str(), base_real)) return false;
+  std::string candidate = std::string(base_real) + "/" + rel;
+  // Resolve the deepest existing ancestor of candidate.
+  std::string probe = candidate;
+  std::string suffix;
+  while (true) {
+    char resolved[PATH_MAX];
+    if (realpath(probe.c_str(), resolved)) {
+      std::string r(resolved);
+      std::string full = suffix.empty() ? r : r + "/" + suffix;
+      std::string base_s(base_real);
+      if (full == base_s || full.compare(0, base_s.size() + 1, base_s + "/") == 0) {
+        out = full;
+        return true;
+      }
+      return false;
+    }
+    size_t slash = probe.rfind('/');
+    if (slash == std::string::npos || probe == base_real) return false;
+    std::string last = probe.substr(slash + 1);
+    suffix = suffix.empty() ? last : last + "/" + suffix;
+    probe = probe.substr(0, slash);
+  }
+}
+
+// Race-free confined open: walks `rel` one component at a time from an open
+// base-dir fd, with O_NOFOLLOW at every step, so user code cannot swap a
+// symlink into place between a check and the use (TOCTOU). `create_dirs`
+// makes intermediate directories. Returns an open fd for the final component
+// (opened with `flags|O_NOFOLLOW`) or -1.
+int open_confined(const std::string& base, const std::string& rel, int flags,
+                  mode_t mode, bool create_dirs) {
+  int cur = open(base.c_str(), O_DIRECTORY | O_RDONLY | O_CLOEXEC);
+  if (cur < 0) return -1;
+  size_t start = 0;
+  while (true) {
+    size_t slash = rel.find('/', start);
+    bool last = slash == std::string::npos;
+    std::string comp = rel.substr(start, last ? std::string::npos : slash - start);
+    if (last) {
+      int fd = openat(cur, comp.c_str(), flags | O_NOFOLLOW | O_CLOEXEC, mode);
+      int saved = errno;
+      close(cur);
+      errno = saved;
+      return fd;
+    }
+    if (create_dirs) {
+      if (mkdirat(cur, comp.c_str(), 0777) != 0 && errno != EEXIST) {
+        close(cur);
+        return -1;
+      }
+    }
+    int next = openat(cur, comp.c_str(), O_DIRECTORY | O_RDONLY | O_NOFOLLOW | O_CLOEXEC);
+    int saved = errno;
+    close(cur);
+    errno = saved;
+    if (next < 0) return -1;
+    cur = next;
+    start = slash + 1;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Workspace snapshot / diff (recursive; replaces server.rs:117-137).
+
+struct FileSig {
+  int64_t mtime_ns;
+  int64_t size;
+  bool operator==(const FileSig& o) const {
+    return mtime_ns == o.mtime_ns && size == o.size;
+  }
+};
+
+void scan_dir(const std::string& base, const std::string& rel,
+              std::map<std::string, FileSig>& out) {
+  std::string dir = rel.empty() ? base : base + "/" + rel;
+  DIR* d = opendir(dir.c_str());
+  if (!d) return;
+  while (dirent* e = readdir(d)) {
+    std::string name = e->d_name;
+    if (name == "." || name == "..") continue;
+    std::string rel_child = rel.empty() ? name : rel + "/" + name;
+    std::string full = base + "/" + rel_child;
+    struct stat st;
+    if (lstat(full.c_str(), &st) != 0) continue;
+    if (S_ISDIR(st.st_mode)) {
+      scan_dir(base, rel_child, out);
+    } else if (S_ISREG(st.st_mode)) {
+      out[rel_child] = FileSig{
+          st.st_mtim.tv_sec * 1000000000LL + st.st_mtim.tv_nsec, st.st_size};
+    }
+  }
+  closedir(d);
+}
+
+std::vector<std::string> diff_snapshots(const std::map<std::string, FileSig>& before,
+                                        const std::map<std::string, FileSig>& after) {
+  std::vector<std::string> changed;
+  for (const auto& [path, sig] : after) {
+    auto it = before.find(path);
+    if (it == before.end() || !(it->second == sig)) changed.push_back(path);
+  }
+  return changed;
+}
+
+// ---------------------------------------------------------------------------
+// Subprocess plumbing.
+
+std::string read_file_capped(const std::string& path, size_t cap, bool* truncated) {
+  std::string out;
+  int fd = open(path.c_str(), O_RDONLY);
+  if (fd < 0) return out;
+  char buf[1 << 16];
+  while (out.size() < cap) {
+    ssize_t n = read(fd, buf, std::min(sizeof(buf), cap - out.size()));
+    if (n <= 0) break;
+    out.append(buf, static_cast<size_t>(n));
+  }
+  // detect truncation: one more byte available?
+  char extra;
+  if (read(fd, &extra, 1) == 1 && truncated) *truncated = true;
+  close(fd);
+  return out;
+}
+
+bool write_file(const std::string& path, const std::string& data) {
+  int fd = open(path.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return false;
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = write(fd, data.data() + off, data.size() - off);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      close(fd);
+      return false;
+    }
+    off += static_cast<size_t>(n);
+  }
+  close(fd);
+  return true;
+}
+
+struct ExecOutcome {
+  int exit_code = -1;
+  bool timed_out = false;
+};
+
+// Runs argv with stdout/stderr redirected to files, cwd=workspace, its own
+// process group; kills the whole group on timeout.
+ExecOutcome run_subprocess(const std::vector<std::string>& argv,
+                           const std::string& cwd, const std::string& stdout_path,
+                           const std::string& stderr_path, double timeout_s,
+                           const minijson::Value* extra_env) {
+  ExecOutcome out;
+  pid_t pid = fork();
+  if (pid < 0) return out;
+  if (pid == 0) {
+    setsid();
+    if (!cwd.empty()) {
+      if (chdir(cwd.c_str()) != 0) _exit(127);
+    }
+    int so = open(stdout_path.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    int se = open(stderr_path.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (so >= 0) dup2(so, 1);
+    if (se >= 0) dup2(se, 2);
+    if (extra_env && extra_env->is_object()) {
+      for (const auto& [k, v] : extra_env->as_object()) {
+        // stringify non-strings for parity with the warm runner (str(v))
+        std::string sv = v.is_string() ? v.as_string() : v.dump();
+        setenv(k.c_str(), sv.c_str(), 1);
+      }
+    }
+    std::vector<char*> cargv;
+    for (const auto& a : argv) cargv.push_back(const_cast<char*>(a.c_str()));
+    cargv.push_back(nullptr);
+    execvp(cargv[0], cargv.data());
+    _exit(127);
+  }
+  // Parent: poll for exit until deadline.
+  const int tick_ms = 20;
+  double waited = 0;
+  int status = 0;
+  while (true) {
+    pid_t r = waitpid(pid, &status, WNOHANG);
+    if (r == pid) {
+      if (WIFEXITED(status)) out.exit_code = WEXITSTATUS(status);
+      else if (WIFSIGNALED(status)) out.exit_code = 128 + WTERMSIG(status);
+      return out;
+    }
+    if (timeout_s > 0 && waited >= timeout_s) {
+      kill(-pid, SIGKILL);
+      waitpid(pid, &status, 0);
+      out.timed_out = true;
+      out.exit_code = -1;
+      return out;
+    }
+    usleep(tick_ms * 1000);
+    waited += tick_ms / 1000.0;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Warm runner: a persistent Python process that pre-imports JAX (initializing
+// the TPU) at sandbox boot and then executes scripts on demand. Protocol:
+// newline-delimited JSON over the runner's fd 3 (requests) and fd 4
+// (responses); user stdout/stderr go to files named in each request.
+
+class WarmRunner {
+ public:
+  WarmRunner(std::string python, std::string runner_script, std::string workspace)
+      : python_(std::move(python)),
+        runner_script_(std::move(runner_script)),
+        workspace_(std::move(workspace)) {}
+
+  bool start() {
+    int req_pipe[2];   // server writes → runner fd 3
+    int resp_pipe[2];  // runner fd 4 → server reads
+    if (pipe(req_pipe) != 0 || pipe(resp_pipe) != 0) return false;
+    pid_ = fork();
+    if (pid_ < 0) return false;
+    if (pid_ == 0) {
+      setsid();
+      if (chdir(workspace_.c_str()) != 0) _exit(127);
+      // Shuffle pipe ends to fds 3/4 via safe high fds (the pipe fds may
+      // themselves be 3/4, so a direct dup2 could clobber an end).
+      int r = fcntl(req_pipe[0], F_DUPFD, 10);
+      int w = fcntl(resp_pipe[1], F_DUPFD, 10);
+      close(req_pipe[0]);
+      close(req_pipe[1]);
+      close(resp_pipe[0]);
+      close(resp_pipe[1]);
+      dup2(r, 3);
+      dup2(w, 4);
+      close(r);
+      close(w);
+      execlp(python_.c_str(), python_.c_str(), "-u", runner_script_.c_str(),
+             (char*)nullptr);
+      _exit(127);
+    }
+    close(req_pipe[0]);
+    close(resp_pipe[1]);
+    req_fd_ = req_pipe[1];
+    resp_fd_ = resp_pipe[0];
+    // Wait for the ready line (runner imports jax → can take seconds on TPU;
+    // that's the point: it happens at sandbox boot, not at Execute time).
+    std::string line;
+    if (!read_line(line, 120.0)) {
+      log_msg("warm runner failed to become ready");
+      stop();
+      return false;
+    }
+    try {
+      auto msg = minijson::parse(line);
+      ready_ = msg.get_bool("ready", false);
+      backend_ = msg.get_string("backend", "unknown");
+      device_count_ = static_cast<int>(msg.get_number("device_count", 0));
+    } catch (...) {
+      ready_ = false;
+    }
+    log_msg("warm runner ready=%d backend=%s devices=%d", (int)ready_,
+            backend_.c_str(), device_count_);
+    return ready_;
+  }
+
+  bool alive() const { return pid_ > 0 && ready_; }
+  const std::string& backend() const { return backend_; }
+  int device_count() const { return device_count_; }
+
+  enum class ExecResult { kOk, kTimeout, kDied };
+
+  // kTimeout = deadline expired (runner killed); kDied = runner crashed or
+  // spoke garbage (killed). The two must be distinguished so a crash isn't
+  // misreported to the user as slow code.
+  ExecResult execute(const std::string& request_json, double timeout_s,
+                     minijson::Value& response) {
+    std::string line = request_json + "\n";
+    size_t off = 0;
+    while (off < line.size()) {
+      ssize_t n = write(req_fd_, line.data() + off, line.size() - off);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        kill_runner();
+        return ExecResult::kDied;
+      }
+      off += static_cast<size_t>(n);
+    }
+    std::string resp_line;
+    bool timed_out = false;
+    if (!read_line(resp_line, timeout_s, &timed_out)) {
+      kill_runner();
+      return timed_out ? ExecResult::kTimeout : ExecResult::kDied;
+    }
+    try {
+      response = minijson::parse(resp_line);
+      return ExecResult::kOk;
+    } catch (...) {
+      kill_runner();
+      return ExecResult::kDied;
+    }
+  }
+
+  void kill_runner() {
+    if (pid_ > 0) {
+      kill(-pid_, SIGKILL);
+      waitpid(pid_, nullptr, 0);
+    }
+    pid_ = -1;
+    ready_ = false;
+    if (req_fd_ >= 0) close(req_fd_);
+    if (resp_fd_ >= 0) close(resp_fd_);
+    req_fd_ = resp_fd_ = -1;
+    resp_buf_.clear();  // stale bytes from a dead runner must not leak forward
+  }
+
+  void stop() { kill_runner(); }
+
+ private:
+  bool read_line(std::string& line, double timeout_s, bool* timed_out = nullptr) {
+    double waited = 0;
+    while (true) {
+      size_t nl = resp_buf_.find('\n');
+      if (nl != std::string::npos) {
+        line = resp_buf_.substr(0, nl);
+        resp_buf_.erase(0, nl + 1);
+        return true;
+      }
+      struct pollfd pfd{resp_fd_, POLLIN, 0};
+      int tick = 100;
+      int r = poll(&pfd, 1, tick);
+      if (r < 0 && errno != EINTR) return false;
+      if (r > 0) {
+        char buf[1 << 14];
+        ssize_t n = read(resp_fd_, buf, sizeof(buf));
+        if (n <= 0) return false;
+        resp_buf_.append(buf, static_cast<size_t>(n));
+        continue;
+      }
+      waited += tick / 1000.0;
+      if (timeout_s > 0 && waited >= timeout_s) {
+        if (timed_out) *timed_out = true;
+        return false;
+      }
+    }
+  }
+
+  std::string python_, runner_script_, workspace_;
+  pid_t pid_ = -1;
+  int req_fd_ = -1, resp_fd_ = -1;
+  bool ready_ = false;
+  std::string backend_ = "none";
+  int device_count_ = 0;
+  std::string resp_buf_;
+};
+
+// ---------------------------------------------------------------------------
+
+struct ServerState {
+  std::string workspace;
+  std::string runtime_packages;
+  std::string python;
+  std::string runner_script;
+  std::string deps_script;
+  bool warm_enabled = true;
+  bool auto_install = false;
+  double default_timeout = 60.0;
+  size_t max_output = 10 * 1024 * 1024;
+  WarmRunner* runner = nullptr;
+  std::mutex exec_mutex;
+  std::mutex runner_mutex;
+};
+
+ServerState g_state;
+
+const std::string* prefix_base(const std::string& prefix) {
+  if (prefix == "workspace") return &g_state.workspace;
+  if (prefix == "runtime-packages") return &g_state.runtime_packages;
+  return nullptr;
+}
+
+// Splits "/workspace/a/b" → ("workspace", "a/b"). Tolerates the reference
+// control plane's double-prefix URLs ("/workspace//workspace/x" — SURVEY.md
+// §0.4) by stripping a repeated leading prefix segment.
+bool split_target(const std::string& target, std::string& prefix, std::string& rel) {
+  std::string t = target;
+  while (!t.empty() && t[0] == '/') t.erase(0, 1);
+  size_t slash = t.find('/');
+  if (slash == std::string::npos) return false;
+  prefix = t.substr(0, slash);
+  rel = sanitize_rel_path(t.substr(slash + 1));
+  if (rel.empty()) return false;
+  // strip duplicated prefix ("workspace/workspace/x" from legacy clients)
+  std::string dup = prefix + "/";
+  if (rel.compare(0, dup.size(), dup) == 0) rel = rel.substr(dup.size());
+  return !rel.empty();
+}
+
+void handle_upload(const minihttp::Request& req, minihttp::Conn& conn) {
+  std::string prefix, rel;
+  if (!split_target(req.target, prefix, rel)) {
+    conn.drain_body();
+    conn.send_response(400, "application/json", "{\"error\":\"bad path\"}");
+    return;
+  }
+  const std::string* base = prefix_base(prefix);
+  if (!base) {
+    conn.drain_body();
+    conn.send_response(404, "application/json", "{\"error\":\"unknown prefix\"}");
+    return;
+  }
+  int fd = open_confined(*base, rel, O_WRONLY | O_CREAT | O_TRUNC, 0644,
+                         /*create_dirs=*/true);
+  if (fd < 0) {
+    conn.drain_body();
+    int status = errno == ELOOP || errno == ENOTDIR ? 403 : 500;
+    conn.send_response(status, "application/json",
+                       "{\"error\":\"open failed (confined)\"}");
+    return;
+  }
+  size_t total = conn.read_body_to_fd(fd);
+  close(fd);
+  minijson::Object resp;
+  resp["path"] = minijson::Value("/" + prefix + "/" + rel);
+  resp["size"] = minijson::Value(static_cast<int64_t>(total));
+  conn.send_response(200, "application/json", minijson::Value(resp).dump());
+}
+
+void handle_download(const minihttp::Request& req, minihttp::Conn& conn) {
+  std::string prefix, rel;
+  if (!split_target(req.target, prefix, rel)) {
+    conn.send_response(400, "application/json", "{\"error\":\"bad path\"}");
+    return;
+  }
+  const std::string* base = prefix_base(prefix);
+  if (!base) {
+    conn.send_response(404, "application/json", "{\"error\":\"unknown prefix\"}");
+    return;
+  }
+  int fd = open_confined(*base, rel, O_RDONLY, 0, /*create_dirs=*/false);
+  if (fd < 0) {
+    // Linux reports a refused symlink component as ELOOP (final) or ENOTDIR
+    // (O_DIRECTORY|O_NOFOLLOW on an intermediate symlink).
+    int status = errno == ELOOP || errno == ENOTDIR ? 403 : 404;
+    conn.send_response(status, "application/json", "{\"error\":\"not found\"}");
+    return;
+  }
+  if (!conn.send_file_fd(fd)) {  // closes fd
+    conn.send_response(404, "application/json", "{\"error\":\"not a file\"}");
+  }
+}
+
+void maybe_install_deps(const std::string& script_path) {
+  if (!g_state.auto_install) return;
+  std::string out_path = "/tmp/deps-out-" + std::to_string(getpid());
+  ExecOutcome guess = run_subprocess(
+      {g_state.python, g_state.deps_script, script_path, g_state.runtime_packages},
+      "", out_path, "/dev/null", 30.0, nullptr);
+  if (guess.exit_code != 0) return;
+  std::string missing = read_file_capped(out_path, 1 << 16, nullptr);
+  unlink(out_path.c_str());
+  std::vector<std::string> pkgs;
+  std::string cur;
+  for (char c : missing + "\n") {
+    if (c == '\n') {
+      if (!cur.empty()) pkgs.push_back(cur);
+      cur.clear();
+    } else if (c != '\r') {
+      cur += c;
+    }
+  }
+  if (pkgs.empty()) return;
+  std::vector<std::string> argv = {g_state.python, "-m", "pip", "install",
+                                   "--no-cache-dir"};
+  for (const auto& p : pkgs) argv.push_back(p);
+  log_msg("auto-installing %zu missing deps", pkgs.size());
+  run_subprocess(argv, "", "/dev/null", "/dev/null", 240.0, nullptr);
+}
+
+void handle_execute(const minihttp::Request& /*req*/, minihttp::Conn& conn) {
+  std::string body = conn.read_body();
+  minijson::Value parsed;
+  try {
+    parsed = minijson::parse(body);
+  } catch (const std::exception& e) {
+    conn.send_response(400, "application/json", "{\"error\":\"bad json\"}");
+    return;
+  }
+  std::string source_code = parsed.get_string("source_code");
+  std::string source_file = parsed.get_string("source_file");
+  double timeout_s = parsed.get_number("timeout", g_state.default_timeout);
+  const minijson::Value& extra_env = parsed.get("env");
+
+  if (source_code.empty() && source_file.empty()) {
+    conn.send_response(400, "application/json",
+                       "{\"error\":\"source_code or source_file required\"}");
+    return;
+  }
+
+  std::lock_guard<std::mutex> lock(g_state.exec_mutex);
+
+  // Resolve the script path.
+  std::string script_path;
+  char tmpl[] = "/tmp/exec-XXXXXX";
+  if (!source_code.empty()) {
+    if (!mkdtemp(tmpl)) {
+      conn.send_response(500, "application/json", "{\"error\":\"mkdtemp failed\"}");
+      return;
+    }
+    script_path = std::string(tmpl) + "/script.py";
+    if (!write_file(script_path, source_code)) {
+      conn.send_response(500, "application/json", "{\"error\":\"write failed\"}");
+      return;
+    }
+  } else {
+    std::string rel = sanitize_rel_path(source_file);
+    std::string dup = "workspace/";
+    if (rel.compare(0, dup.size(), dup) == 0) rel = rel.substr(dup.size());
+    if (rel.empty() || !confine(g_state.workspace, rel, script_path)) {
+      conn.send_response(403, "application/json",
+                         "{\"error\":\"source_file escapes workspace\"}");
+      return;
+    }
+  }
+
+  maybe_install_deps(script_path);
+
+  std::map<std::string, FileSig> before;
+  scan_dir(g_state.workspace, "", before);
+
+  std::string stdout_path = script_path + ".stdout";
+  std::string stderr_path = script_path + ".stderr";
+
+  struct timespec t0, t1;
+  clock_gettime(CLOCK_MONOTONIC, &t0);
+
+  int exit_code = -1;
+  bool timed_out = false;
+  bool runner_died = false;
+  bool ran_warm = false;
+
+  if (g_state.warm_enabled && g_state.runner) {
+    std::lock_guard<std::mutex> rlock(g_state.runner_mutex);
+    if (!g_state.runner->alive()) {
+      // runner died (previous timeout) — restart for this sandbox
+      g_state.runner->start();
+    }
+    if (g_state.runner->alive()) {
+      minijson::Object reqo;
+      reqo["source_path"] = minijson::Value(script_path);
+      reqo["stdout_path"] = minijson::Value(stdout_path);
+      reqo["stderr_path"] = minijson::Value(stderr_path);
+      if (extra_env.is_object()) reqo["env"] = extra_env;
+      minijson::Value resp;
+      WarmRunner::ExecResult r = g_state.runner->execute(
+          minijson::Value(reqo).dump(), timeout_s > 0 ? timeout_s + 0.5 : 0, resp);
+      ran_warm = true;
+      switch (r) {
+        case WarmRunner::ExecResult::kOk:
+          exit_code = static_cast<int>(resp.get_number("exit_code", -1));
+          break;
+        case WarmRunner::ExecResult::kTimeout:
+          timed_out = true;
+          break;
+        case WarmRunner::ExecResult::kDied:
+          runner_died = true;
+          break;
+      }
+    }
+  }
+
+  if (!ran_warm) {
+    ExecOutcome out =
+        run_subprocess({g_state.python, script_path}, g_state.workspace,
+                       stdout_path, stderr_path, timeout_s, &extra_env);
+    exit_code = out.exit_code;
+    timed_out = out.timed_out;
+  }
+
+  clock_gettime(CLOCK_MONOTONIC, &t1);
+  double duration =
+      (t1.tv_sec - t0.tv_sec) + (t1.tv_nsec - t0.tv_nsec) / 1e9;
+
+  std::map<std::string, FileSig> after;
+  scan_dir(g_state.workspace, "", after);
+
+  bool out_trunc = false, err_trunc = false;
+  std::string out_s = read_file_capped(stdout_path, g_state.max_output, &out_trunc);
+  std::string err_s = read_file_capped(stderr_path, g_state.max_output, &err_trunc);
+  if (out_trunc) out_s += "\n[stdout truncated]";
+  if (err_trunc) err_s += "\n[stderr truncated]";
+  if (timed_out) {
+    err_s += err_s.empty() ? "Execution timed out" : "\nExecution timed out";
+  } else if (runner_died) {
+    err_s += err_s.empty() ? "Executor runner crashed" : "\nExecutor runner crashed";
+  }
+  unlink(stdout_path.c_str());
+  unlink(stderr_path.c_str());
+  if (!source_code.empty()) {
+    // source_code mode owns /tmp/exec-XXXXXX; remove it (submitted source may
+    // contain secrets, and a long-lived dev server must not fill /tmp).
+    unlink(script_path.c_str());
+    rmdir(tmpl);
+  }
+
+  minijson::Array files;
+  for (const auto& rel : diff_snapshots(before, after)) {
+    files.push_back(minijson::Value(rel));
+  }
+
+  minijson::Object resp;
+  resp["stdout"] = minijson::Value(out_s);
+  resp["stderr"] = minijson::Value(err_s);
+  resp["exit_code"] = minijson::Value(exit_code);
+  resp["files"] = minijson::Value(files);
+  resp["duration_s"] = minijson::Value(duration);
+  resp["warm"] = minijson::Value(ran_warm);
+  conn.send_response(200, "application/json", minijson::Value(resp).dump());
+}
+
+void handle_healthz(const minihttp::Request&, minihttp::Conn& conn) {
+  minijson::Object resp;
+  resp["status"] = minijson::Value("ok");
+  bool warm = g_state.runner && g_state.runner->alive();
+  resp["warm"] = minijson::Value(warm);
+  if (warm) {
+    resp["backend"] = minijson::Value(g_state.runner->backend());
+    resp["device_count"] = minijson::Value(g_state.runner->device_count());
+  }
+  conn.send_response(200, "application/json", minijson::Value(resp).dump());
+}
+
+void route(const minihttp::Request& req, minihttp::Conn& conn) {
+  if (req.method == "POST" && req.target == "/execute") {
+    handle_execute(req, conn);
+  } else if (req.method == "GET" && req.target == "/healthz") {
+    handle_healthz(req, conn);
+  } else if (req.method == "PUT") {
+    handle_upload(req, conn);
+  } else if (req.method == "GET" || req.method == "HEAD") {
+    handle_download(req, conn);
+  } else {
+    conn.drain_body();
+    conn.send_response(404, "application/json", "{\"error\":\"no route\"}");
+  }
+}
+
+std::string self_dir() {
+  char buf[PATH_MAX];
+  ssize_t n = readlink("/proc/self/exe", buf, sizeof(buf) - 1);
+  if (n <= 0) return ".";
+  buf[n] = 0;
+  std::string p(buf);
+  size_t slash = p.rfind('/');
+  return slash == std::string::npos ? "." : p.substr(0, slash);
+}
+
+}  // namespace
+
+int main() {
+  std::string listen_addr = env_or("APP_LISTEN_ADDR", "0.0.0.0:8000");
+  g_state.workspace = env_or("APP_WORKSPACE", "/workspace");
+  g_state.runtime_packages = env_or("APP_RUNTIME_PACKAGES", "/runtime-packages");
+  g_state.python = env_or("APP_PYTHON", "python3");
+  std::string exe_dir = self_dir();
+  auto sibling = [&exe_dir](const std::string& name) {
+    std::string p = exe_dir + "/" + name;
+    if (access(p.c_str(), R_OK) == 0) return p;
+    return exe_dir + "/../" + name;  // binary lives in build/, scripts beside it
+  };
+  g_state.runner_script = env_or("APP_RUNNER_SCRIPT", sibling("runner.py"));
+  g_state.deps_script = env_or("APP_DEPS_SCRIPT", sibling("deps.py"));
+  g_state.warm_enabled = env_flag("APP_WARM_RUNNER", true);
+  g_state.auto_install = env_flag("APP_AUTO_INSTALL_DEPS", false);
+  g_state.default_timeout = env_num("APP_DEFAULT_TIMEOUT", 60.0);
+  g_state.max_output = static_cast<size_t>(env_num("APP_MAX_OUTPUT_BYTES", 10485760));
+
+  mkdir(g_state.workspace.c_str(), 0777);
+  mkdir(g_state.runtime_packages.c_str(), 0777);
+
+  WarmRunner runner(g_state.python, g_state.runner_script, g_state.workspace);
+  if (g_state.warm_enabled) {
+    if (runner.start()) {
+      g_state.runner = &runner;
+    } else {
+      log_msg("warm runner unavailable; falling back to cold subprocess mode");
+    }
+  }
+
+  minihttp::Server server(listen_addr, route);
+  // Port 0 → ephemeral; announce the bound port for the parent process.
+  printf("LISTENING port=%d\n", server.port());
+  fflush(stdout);
+  log_msg("executor-server listening on port %d (workspace=%s warm=%d)",
+          server.port(), g_state.workspace.c_str(), g_state.runner != nullptr);
+  server.serve_forever();
+}

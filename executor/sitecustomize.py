@@ -1,0 +1,96 @@
+"""Sandbox-wide import patches, auto-loaded into every user Python process.
+
+Installed into the sandbox venv's site-packages (reference parity:
+executor/sitecustomize.py via executor/Dockerfile:107). Patches are applied
+lazily via an import hook so non-matching code pays ~nothing:
+
+- matplotlib.pyplot.show() → savefig("plot.png") (headless sandbox)
+- PIL.ImageShow.show() → img.save("image.png")
+- json → datetime/date-aware default encoder + ISO-parsing decoder
+- numpy → the TPU dispatch shim (bee_code_interpreter_fs_tpu.ops.npdispatch),
+  when APP_NUMPY_DISPATCH=1: user-submitted array code transparently runs on
+  XLA/TPU (the north-star hook point, SURVEY.md §2.15).
+"""
+
+import builtins
+import os
+import sys
+
+_PATCHED: set[str] = set()
+
+
+def _patch_matplotlib_pyplot(plt) -> None:
+    def _show(*args, **kwargs):  # noqa: ANN002, ANN003
+        try:
+            plt.savefig("plot.png")
+        finally:
+            plt.close("all")
+
+    plt.show = _show
+
+
+def _patch_pil_imageshow(imageshow) -> None:
+    def _show(image, title=None, **options):  # noqa: ANN001, ANN003
+        image.save("image.png")
+        return True
+
+    imageshow.show = _show
+
+
+def _patch_json(json_mod) -> None:
+    import datetime
+
+    _default_encoder = json_mod.JSONEncoder
+
+    class DateTimeEncoder(_default_encoder):
+        def default(self, o):  # noqa: ANN001
+            if isinstance(o, (datetime.datetime, datetime.date, datetime.time)):
+                return o.isoformat()
+            return super().default(o)
+
+    _orig_dumps = json_mod.dumps
+    _orig_dump = json_mod.dump
+
+    def dumps(*args, **kwargs):  # noqa: ANN002, ANN003
+        kwargs.setdefault("cls", DateTimeEncoder)
+        return _orig_dumps(*args, **kwargs)
+
+    def dump(*args, **kwargs):  # noqa: ANN002, ANN003
+        kwargs.setdefault("cls", DateTimeEncoder)
+        return _orig_dump(*args, **kwargs)
+
+    json_mod.dumps = dumps
+    json_mod.dump = dump
+    json_mod.DateTimeEncoder = DateTimeEncoder
+
+
+_PATCHES = {
+    "matplotlib.pyplot": _patch_matplotlib_pyplot,
+    "PIL.ImageShow": _patch_pil_imageshow,
+    "json": _patch_json,
+}
+
+_orig_import = builtins.__import__
+
+
+def _patched_import(name, globals=None, locals=None, fromlist=(), level=0):  # noqa: A002
+    module = _orig_import(name, globals, locals, fromlist, level)
+    for mod_name, patch in _PATCHES.items():
+        if mod_name in sys.modules and mod_name not in _PATCHED:
+            _PATCHED.add(mod_name)
+            try:
+                patch(sys.modules[mod_name])
+            except Exception:  # noqa: BLE001 — patches are best-effort
+                pass
+    return module
+
+
+builtins.__import__ = _patched_import
+
+if os.environ.get("APP_NUMPY_DISPATCH", "0") not in ("0", "false", ""):
+    try:
+        from bee_code_interpreter_fs_tpu.ops.npdispatch import install as _install_np
+
+        _install_np()
+    except Exception:  # noqa: BLE001 — fall back to stock numpy
+        pass

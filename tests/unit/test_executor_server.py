@@ -1,0 +1,187 @@
+"""Tests driving the real C++ executor server binary over HTTP.
+
+The reference had no tests for its executor at all (SURVEY.md §4); these
+exercise upload/download with path confinement, /execute (warm-runner mode
+with JAX import disabled for speed), timeout kill + runner restart, and
+recursive changed-file detection.
+"""
+
+import json
+import re
+import subprocess
+import time
+from pathlib import Path
+
+import httpx
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+EXECUTOR_DIR = REPO_ROOT / "executor"
+BINARY = EXECUTOR_DIR / "build" / "executor-server"
+
+
+@pytest.fixture(scope="module")
+def executor(tmp_path_factory):
+    subprocess.run(["make", "-C", str(EXECUTOR_DIR)], check=True, capture_output=True)
+    root = tmp_path_factory.mktemp("executor")
+    ws = root / "ws"
+    rp = root / "rp"
+    ws.mkdir()
+    rp.mkdir()
+    proc = subprocess.Popen(
+        [str(BINARY)],
+        env={
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+            "APP_LISTEN_ADDR": "127.0.0.1:0",
+            "APP_WORKSPACE": str(ws),
+            "APP_RUNTIME_PACKAGES": str(rp),
+            "APP_WARM_IMPORT_JAX": "0",
+        },
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+    )
+    line = proc.stdout.readline().decode()
+    port = int(re.search(r"port=(\d+)", line).group(1))
+    client = httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=30.0)
+    # wait until responsive
+    for _ in range(100):
+        try:
+            client.get("/healthz")
+            break
+        except httpx.TransportError:
+            time.sleep(0.1)
+    yield client, ws
+    client.close()
+    proc.kill()
+    proc.wait()
+
+
+def execute(client, source, **kwargs):
+    resp = client.post("/execute", json={"source_code": source, **kwargs})
+    assert resp.status_code == 200, resp.text
+    return resp.json()
+
+
+def test_healthz_warm(executor):
+    client, _ = executor
+    health = client.get("/healthz").json()
+    assert health["status"] == "ok"
+    assert health["warm"] is True
+
+
+def test_upload_download_roundtrip(executor):
+    client, ws = executor
+    resp = client.put("/workspace/dir/sub/file.txt", content=b"payload")
+    assert resp.status_code == 200
+    assert (ws / "dir/sub/file.txt").read_bytes() == b"payload"
+    got = client.get("/workspace/dir/sub/file.txt")
+    assert got.status_code == 200
+    assert got.content == b"payload"
+
+
+def test_double_prefix_tolerated(executor):
+    # The reference control plane produced /workspace//workspace/x URLs
+    # (SURVEY.md §0.4); they must land at workspace root, not a nested dir.
+    client, ws = executor
+    client.put("/workspace//workspace/legacy.txt", content=b"legacy")
+    assert (ws / "legacy.txt").read_bytes() == b"legacy"
+
+
+def test_path_traversal_blocked(executor):
+    client, _ = executor
+    assert client.put("/workspace/../escape.txt", content=b"x").status_code in (400, 403)
+    assert client.get("/workspace/../../etc/passwd").status_code in (400, 403, 404)
+    assert client.get("/unknown-prefix/foo").status_code == 404
+
+
+def test_symlink_escape_blocked(executor):
+    client, ws = executor
+    (ws / "link").symlink_to("/etc")
+    resp = client.get("/workspace/link/passwd")
+    assert resp.status_code == 403
+
+
+def test_execute_stdout_stderr_exit(executor):
+    client, _ = executor
+    result = execute(client, "import sys\nprint('out')\nprint('err', file=sys.stderr)\nsys.exit(5)")
+    assert result["stdout"] == "out\n"
+    assert result["stderr"].strip() == "err"
+    assert result["exit_code"] == 5
+    assert result["warm"] is True
+
+
+def test_execute_changed_files_recursive(executor):
+    client, _ = executor
+    result = execute(
+        client,
+        "import os\nos.makedirs('deep/nested', exist_ok=True)\n"
+        "open('deep/nested/new.txt', 'w').write('x')\nopen('top.txt', 'w').write('y')",
+    )
+    assert result["exit_code"] == 0
+    assert "deep/nested/new.txt" in result["files"]
+    assert "top.txt" in result["files"]
+
+
+def test_execute_timeout_and_recovery(executor):
+    client, _ = executor
+    result = execute(client, "while True: pass", timeout=1)
+    assert result["exit_code"] == -1
+    assert "timed out" in result["stderr"]
+    # runner restarts; next request works
+    result = execute(client, "print('recovered')")
+    assert result["stdout"] == "recovered\n"
+    assert result["exit_code"] == 0
+
+
+def test_execute_exception_traceback(executor):
+    client, _ = executor
+    result = execute(client, "1/0")
+    assert result["exit_code"] == 1
+    assert "ZeroDivisionError" in result["stderr"]
+
+
+def test_execute_env_passthrough(executor):
+    client, _ = executor
+    result = execute(
+        client, "import os\nprint(os.environ['MY_FLAG'])", env={"MY_FLAG": "tpu"}
+    )
+    assert result["stdout"] == "tpu\n"
+
+
+def test_execute_source_file(executor):
+    client, _ = executor
+    client.put("/workspace/prog.py", content=b"print('from file')")
+    resp = client.post("/execute", json={"source_file": "/workspace/prog.py"})
+    assert resp.json()["stdout"] == "from file\n"
+    # and confinement on source_file
+    resp = client.post("/execute", json={"source_file": "/../../etc/passwd"})
+    assert resp.status_code == 403
+
+
+def test_execute_bad_request(executor):
+    client, _ = executor
+    assert client.post("/execute", content=b"not json").status_code == 400
+    assert client.post("/execute", json={}).status_code == 400
+
+
+def test_unicode_roundtrip(executor):
+    client, _ = executor
+    result = execute(client, "print('héllo ✓ 日本語')")
+    assert result["stdout"] == "héllo ✓ 日本語\n"
+
+
+def test_deps_scanner():
+    out = subprocess.run(
+        [
+            "python",
+            str(EXECUTOR_DIR / "deps.py"),
+            "/dev/stdin",
+        ],
+        input=b"import os\nimport numpy\nimport definitely_not_installed_pkg\nfrom PIL import Image\n",
+        capture_output=True,
+        check=True,
+    )
+    missing = out.stdout.decode().split()
+    assert "definitely_not_installed_pkg" in missing
+    assert "numpy" not in missing  # installed
+    assert "os" not in missing  # stdlib
