@@ -1,0 +1,60 @@
+"""Service entry point: `python -m bee_code_interpreter_fs_tpu`.
+
+Starts the HTTP API and the gRPC API concurrently and kicks off warm-pool
+prefill (parity: src/code_interpreter/__main__.py:22-36, which gathers
+uvicorn + grpc; prefill starts at context construction there — here it is
+explicit and awaits alongside the servers).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from aiohttp import web
+
+from .application_context import ApplicationContext
+
+logger = logging.getLogger(__name__)
+
+
+def _split_addr(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "0.0.0.0", int(port)
+
+
+async def main(ctx: ApplicationContext | None = None) -> None:
+    ctx = ctx or ApplicationContext()
+
+    host, port = _split_addr(ctx.config.http_listen_addr)
+    runner = web.AppRunner(ctx.http_app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    logger.info("HTTP API listening on %s:%d", host, port)
+
+    grpc_task = None
+    try:
+        server = ctx.grpc_server
+        await server.start()
+        grpc_task = asyncio.create_task(server.wait_for_termination())
+    except Exception:  # noqa: BLE001 — HTTP-only mode still works
+        logger.exception("gRPC server failed to start; continuing HTTP-only")
+
+    ctx.code_executor.fill_pool_soon()
+
+    try:
+        if grpc_task is not None:
+            await grpc_task
+        else:
+            await asyncio.Event().wait()
+    finally:
+        await ctx.code_executor.close()
+        await runner.cleanup()
+
+
+if __name__ == "__main__":
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass

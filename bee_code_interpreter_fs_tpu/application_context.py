@@ -1,0 +1,65 @@
+"""DI container: lazily-wired singletons for the service process.
+
+Parity with the reference's ApplicationContext of @cached_property singletons
+(src/code_interpreter/application_context.py:36-126): config, logging with
+request-id filter, storage, executor, tool executor, servers — plus backend
+selection (local subprocess vs kubernetes) which the reference hard-wired.
+"""
+
+from __future__ import annotations
+
+from functools import cached_property
+
+from .config import Config
+from .services.backends.base import SandboxBackend
+from .services.code_executor import CodeExecutor
+from .services.custom_tool_executor import CustomToolExecutor
+from .services.storage import Storage
+from .utils.logs import setup_logging
+
+
+class ApplicationContext:
+    def __init__(self, config: Config | None = None) -> None:
+        self.config = config or Config.from_env()
+        setup_logging(self.config.logging_config)
+
+    @cached_property
+    def storage(self) -> Storage:
+        return Storage(self.config.file_storage_path)
+
+    @cached_property
+    def backend(self) -> SandboxBackend:
+        if self.config.executor_backend == "kubernetes":
+            try:
+                from .services.backends.kubernetes import KubernetesSandboxBackend
+            except ImportError as e:
+                raise ValueError(f"kubernetes backend unavailable: {e}") from e
+
+            return KubernetesSandboxBackend(self.config)
+        if self.config.executor_backend == "local":
+            from .services.backends.local import LocalSandboxBackend
+
+            return LocalSandboxBackend(self.config)
+        raise ValueError(f"unknown executor backend: {self.config.executor_backend}")
+
+    @cached_property
+    def code_executor(self) -> CodeExecutor:
+        return CodeExecutor(self.backend, self.storage, self.config)
+
+    @cached_property
+    def custom_tool_executor(self) -> CustomToolExecutor:
+        return CustomToolExecutor(self.code_executor)
+
+    @cached_property
+    def http_app(self):
+        from .services.http_server import create_http_app
+
+        return create_http_app(self.code_executor, self.custom_tool_executor, self.storage)
+
+    @cached_property
+    def grpc_server(self):
+        from .services.grpc_server import GrpcServer
+
+        return GrpcServer(
+            self.config, self.code_executor, self.custom_tool_executor, self.storage
+        )

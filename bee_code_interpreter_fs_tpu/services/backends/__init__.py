@@ -1,0 +1,3 @@
+from .base import Sandbox, SandboxBackend, SandboxSpawnError
+
+__all__ = ["Sandbox", "SandboxBackend", "SandboxSpawnError"]

@@ -1,0 +1,48 @@
+"""Sandbox backend abstraction.
+
+The reference hard-wired its orchestrator to Kubernetes
+(services/kubernetes_code_executor.py); here the pool logic is backend-
+agnostic so the same orchestrator runs against a local subprocess backend
+(tests, dev, single-host TPU) or the Kubernetes backend (production,
+TPU-slice pods). This is also what makes the e2e logic testable without a
+cluster — the gap called out in SURVEY.md §4.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Protocol, runtime_checkable
+
+
+class SandboxSpawnError(RuntimeError):
+    pass
+
+
+@dataclass
+class Sandbox:
+    """A live single-use execution sandbox reachable over HTTP.
+
+    `chip_count` is the number of TPU chips attached (0 = CPU-only); the pool
+    keeps one lane per chip_count so an Execute asking for a v5e-4 slice never
+    steals a single-chip sandbox and vice versa.
+    """
+
+    id: str
+    url: str  # base URL of the in-sandbox executor server
+    chip_count: int = 0
+    meta: dict = field(default_factory=dict)
+
+
+@runtime_checkable
+class SandboxBackend(Protocol):
+    async def spawn(self, chip_count: int = 0) -> Sandbox:
+        """Create a sandbox and wait until its executor server is ready."""
+        ...
+
+    async def delete(self, sandbox: Sandbox) -> None:
+        """Tear the sandbox down (idempotent, must not raise)."""
+        ...
+
+    async def close(self) -> None:
+        """Release backend resources (delete all live sandboxes)."""
+        ...

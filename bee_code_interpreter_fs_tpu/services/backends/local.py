@@ -1,0 +1,153 @@
+"""Local subprocess sandbox backend.
+
+Spawns the C++ executor server (executor/server.cpp) as a local process with a
+fresh workspace directory per sandbox. Serves three roles:
+
+1. The fake-executor test backend the reference lacked (SURVEY.md §4) — full
+   e2e coverage of the orchestrator/API stack without Kubernetes.
+2. Single-host TPU dev mode: the sandbox's warm runner initializes the local
+   TPU and user code runs on it directly.
+3. The bench path: bench.py drives Execute through this backend on real TPU.
+
+All sandboxes share one JAX persistent compilation cache directory, so XLA
+compiles survive across sandbox generations (SURVEY.md §7 hard part #2 —
+single-use sandboxes must not mean recompiling every request).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import re
+import shutil
+import sys
+import uuid
+from pathlib import Path
+
+from ...config import Config
+from .base import Sandbox, SandboxBackend, SandboxSpawnError
+
+logger = logging.getLogger(__name__)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent.parent
+DEFAULT_BINARY = REPO_ROOT / "executor" / "build" / "executor-server"
+
+
+class LocalSandboxBackend(SandboxBackend):
+    def __init__(
+        self,
+        config: Config | None = None,
+        *,
+        warm_import_jax: bool | None = None,
+        numpy_dispatch: bool = False,
+    ) -> None:
+        self.config = config or Config()
+        binary = self.config.executor_binary or str(DEFAULT_BINARY)
+        self.binary = Path(binary)
+        if not self.binary.is_absolute():
+            self.binary = REPO_ROOT / self.binary
+        self.root = Path(self.config.local_sandbox_root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.warm_import_jax = (
+            self.config.executor_warm_runner
+            if warm_import_jax is None
+            else warm_import_jax
+        )
+        self.numpy_dispatch = numpy_dispatch
+        self._procs: dict[str, tuple[asyncio.subprocess.Process, str]] = {}
+
+    async def spawn(self, chip_count: int = 0) -> Sandbox:
+        if not self.binary.exists():
+            raise SandboxSpawnError(
+                f"executor binary not found at {self.binary}; run `make -C executor`"
+            )
+        sandbox_id = self.config.executor_pod_name_prefix + uuid.uuid4().hex[:6]
+        sandbox_dir = self.root / sandbox_id
+        workspace = sandbox_dir / "workspace"
+        runtime_packages = sandbox_dir / "runtime-packages"
+        workspace.mkdir(parents=True)
+        runtime_packages.mkdir(parents=True)
+
+        cache_dir = self.config.jax_compilation_cache_dir
+        if cache_dir:
+            Path(cache_dir).mkdir(parents=True, exist_ok=True)
+
+        env = dict(os.environ)
+        env.update(
+            {
+                "APP_LISTEN_ADDR": "127.0.0.1:0",
+                "APP_WORKSPACE": str(workspace),
+                "APP_RUNTIME_PACKAGES": str(runtime_packages),
+                "APP_WARM_RUNNER": "1" if self.config.executor_warm_runner else "0",
+                "APP_WARM_IMPORT_JAX": "1" if self.warm_import_jax else "0",
+                "APP_PYTHON": sys.executable,
+                "APP_DEFAULT_TIMEOUT": str(self.config.default_execution_timeout),
+            }
+        )
+        if cache_dir:
+            env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+        if self.numpy_dispatch:
+            env["APP_NUMPY_DISPATCH"] = "1"
+            # Make the shim package + sitecustomize importable in the sandbox.
+            env["PYTHONPATH"] = os.pathsep.join(
+                [str(REPO_ROOT / "executor"), str(REPO_ROOT)]
+                + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+            )
+
+        proc = await asyncio.create_subprocess_exec(
+            str(self.binary),
+            env=env,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.DEVNULL,
+            start_new_session=True,
+        )
+
+        async def abort_spawn(reason: str):
+            proc.kill()
+            await proc.wait()  # reap; no zombie
+            await asyncio.to_thread(shutil.rmtree, sandbox_dir, True)
+            raise SandboxSpawnError(f"sandbox {sandbox_id} {reason}")
+
+        try:
+            line = await asyncio.wait_for(
+                proc.stdout.readline(), timeout=self.config.executor_pod_ready_timeout
+            )
+        except asyncio.TimeoutError:
+            await abort_spawn("did not become ready")
+        match = re.search(rb"port=(\d+)", line)
+        if not match:
+            await abort_spawn(f"spoke garbage at startup: {line!r}")
+        port = int(match.group(1))
+        self._procs[sandbox_id] = (proc, str(sandbox_dir))
+        logger.info("spawned local sandbox %s on port %d", sandbox_id, port)
+        return Sandbox(
+            id=sandbox_id,
+            url=f"http://127.0.0.1:{port}",
+            chip_count=chip_count,
+            meta={"dir": str(sandbox_dir)},
+        )
+
+    async def delete(self, sandbox: Sandbox) -> None:
+        entry = self._procs.pop(sandbox.id, None)
+        if entry is not None:
+            proc, _ = entry
+            try:
+                proc.kill()
+                await proc.wait()
+            except ProcessLookupError:
+                pass
+        sandbox_dir = sandbox.meta.get("dir")
+        if sandbox_dir:
+            await asyncio.to_thread(shutil.rmtree, sandbox_dir, True)
+        logger.info("deleted local sandbox %s", sandbox.id)
+
+    async def close(self) -> None:
+        for sandbox_id, (proc, sandbox_dir) in list(self._procs.items()):
+            try:
+                proc.kill()
+                await proc.wait()
+            except ProcessLookupError:
+                pass
+            await asyncio.to_thread(shutil.rmtree, sandbox_dir, True)
+            self._procs.pop(sandbox_id, None)

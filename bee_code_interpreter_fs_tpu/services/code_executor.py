@@ -1,0 +1,275 @@
+"""The orchestrator: pooled single-use sandboxes + file round-trips.
+
+Behavior parity with the reference's KubernetesCodeExecutor
+(src/code_interpreter/services/kubernetes_code_executor.py:48-279), rebuilt
+backend-agnostic and TPU-aware:
+
+- `execute()` accepts BOTH inline `source_code` and file-based `source_file`
+  coherently (the reference fork broke mid-refactor and its gRPC path crashed
+  on the old kwarg — SURVEY.md §0.1; here both surfaces work).
+- Warm pool is keyed by chip_count lanes: an Execute asking for a 4-chip
+  slice gets a sandbox whose warm runner already initialized that topology
+  (kubernetes_code_executor.py:163-201 pooled only "a pod"; a TPU pool must
+  pool "a topology" — SURVEY.md §2 census).
+- Input files upload in parallel, changed files download in parallel into
+  content-addressed Storage (dedup makes session round-trips cheap).
+- Infrastructure failures retry up to 3× with exponential backoff
+  (kubernetes_code_executor.py:76-80); user-code failures never retry.
+- Per-request phase timings (queue-wait/upload/exec/download) are returned —
+  the observability the reference lacked (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import deque
+from dataclasses import dataclass, field
+
+import httpx
+from tenacity import (
+    retry,
+    retry_if_exception_type,
+    stop_after_attempt,
+    wait_exponential,
+)
+
+from ..config import Config
+from ..utils.logs import PhaseTimer
+from ..utils.validation import normalize_workspace_path
+from .backends.base import Sandbox, SandboxBackend, SandboxSpawnError
+from .storage import Storage
+
+logger = logging.getLogger(__name__)
+
+
+class ExecutorError(RuntimeError):
+    """Infrastructure-level execution failure (retried, then surfaced)."""
+
+
+@dataclass
+class Result:
+    stdout: str
+    stderr: str
+    exit_code: int
+    files: dict[str, str]  # absolute workspace path -> storage object id
+    phases: dict[str, float] = field(default_factory=dict)
+    warm: bool = False
+
+
+class CodeExecutor:
+    def __init__(
+        self,
+        backend: SandboxBackend,
+        storage: Storage,
+        config: Config | None = None,
+    ) -> None:
+        self.backend = backend
+        self.storage = storage
+        self.config = config or Config()
+        self._pools: dict[int, deque[Sandbox]] = {}
+        self._spawning: dict[int, int] = {}
+        self._fill_tasks: set[asyncio.Task] = set()
+        self._closed = False
+
+    # ------------------------------------------------------------------ pool
+
+    def _pool(self, chip_count: int) -> deque[Sandbox]:
+        return self._pools.setdefault(chip_count, deque())
+
+    async def fill_pool(self, chip_count: int = 0) -> None:
+        """Top the lane up to the target length, tracking in-flight spawns."""
+        if self._closed:
+            return
+        pool = self._pool(chip_count)
+        target = self.config.executor_pod_queue_target_length
+        missing = target - len(pool) - self._spawning.get(chip_count, 0)
+        if missing <= 0:
+            return
+        self._spawning[chip_count] = self._spawning.get(chip_count, 0) + missing
+
+        async def spawn_one() -> None:
+            try:
+                sandbox = await self._spawn_with_retry(chip_count)
+                if self._closed:
+                    await self.backend.delete(sandbox)
+                else:
+                    pool.append(sandbox)
+            except SandboxSpawnError:
+                # degraded pool: log and continue (parity: reference logs and
+                # keeps going, kubernetes_code_executor.py:184-194)
+                logger.exception("pool prefill spawn failed (lane=%d)", chip_count)
+            finally:
+                self._spawning[chip_count] -= 1
+
+        await asyncio.gather(*(spawn_one() for _ in range(missing)))
+
+    def fill_pool_soon(self, chip_count: int = 0) -> None:
+        if self._closed:
+            return
+        task = asyncio.create_task(self.fill_pool(chip_count))
+        self._fill_tasks.add(task)
+        task.add_done_callback(self._fill_tasks.discard)
+
+    @retry(
+        retry=retry_if_exception_type(SandboxSpawnError),
+        stop=stop_after_attempt(3),
+        wait=wait_exponential(multiplier=0.5, max=5),
+        reraise=True,
+    )
+    async def _spawn_with_retry(self, chip_count: int) -> Sandbox:
+        return await self.backend.spawn(chip_count)
+
+    async def _acquire(self, chip_count: int) -> Sandbox:
+        pool = self._pool(chip_count)
+        if pool:
+            sandbox = pool.popleft()
+        else:
+            sandbox = await self._spawn_with_retry(chip_count)
+        self.fill_pool_soon(chip_count)
+        return sandbox
+
+    # --------------------------------------------------------------- execute
+
+    @retry(
+        retry=retry_if_exception_type(ExecutorError),
+        stop=stop_after_attempt(3),
+        wait=wait_exponential(multiplier=0.5, max=5),
+        reraise=True,
+    )
+    async def execute(
+        self,
+        source_code: str | None = None,
+        *,
+        source_file: str | None = None,
+        files: dict[str, str] | None = None,
+        timeout: float | None = None,
+        env: dict[str, str] | None = None,
+        chip_count: int | None = None,
+    ) -> Result:
+        """Run user code in a fresh sandbox; returns output + changed files.
+
+        Exactly one of `source_code` (inline) / `source_file` (an absolute
+        workspace path that must appear in `files`) is required.
+        """
+        if (source_code is None) == (source_file is None):
+            raise ValueError("exactly one of source_code/source_file is required")
+        files = files or {}
+        lane = self.config.default_chip_count if chip_count is None else chip_count
+        timeout = min(
+            timeout or self.config.default_execution_timeout,
+            self.config.max_execution_timeout,
+        )
+        timer = PhaseTimer()
+
+        with timer.phase("queue_wait"):
+            sandbox = await self._acquire(lane)
+        try:
+            async with httpx.AsyncClient(
+                base_url=sandbox.url, timeout=httpx.Timeout(30.0)
+            ) as client:
+                with timer.phase("upload"):
+                    await asyncio.gather(
+                        *(
+                            self._upload_file(client, path, object_id)
+                            for path, object_id in files.items()
+                        )
+                    )
+                with timer.phase("exec"):
+                    payload: dict = {"timeout": timeout}
+                    if env:
+                        payload["env"] = env
+                    if source_code is not None:
+                        payload["source_code"] = source_code
+                    else:
+                        payload["source_file"] = source_file
+                    try:
+                        resp = await client.post(
+                            "/execute",
+                            json=payload,
+                            timeout=httpx.Timeout(timeout + 30.0),
+                        )
+                    except httpx.HTTPError as e:
+                        raise ExecutorError(f"sandbox {sandbox.id} unreachable: {e}")
+                    if resp.status_code == 403:
+                        raise ValueError(resp.json().get("error", "forbidden path"))
+                    if resp.status_code != 200:
+                        raise ExecutorError(
+                            f"sandbox {sandbox.id} /execute -> {resp.status_code}: "
+                            f"{resp.text[:500]}"
+                        )
+                    try:
+                        body = resp.json()
+                    except ValueError as e:
+                        raise ExecutorError(
+                            f"sandbox {sandbox.id} returned malformed JSON: {e}"
+                        )
+                with timer.phase("download"):
+                    changed = await asyncio.gather(
+                        *(
+                            self._download_file(client, rel)
+                            for rel in body.get("files", [])
+                        )
+                    )
+            return Result(
+                stdout=body.get("stdout", ""),
+                stderr=body.get("stderr", ""),
+                exit_code=int(body.get("exit_code", -1)),
+                files={f"/workspace/{rel}": object_id for rel, object_id in changed},
+                phases=timer.as_dict(),
+                warm=bool(body.get("warm", False)),
+            )
+        finally:
+            # single-use sandbox: dispose off the hot path
+            task = asyncio.get_running_loop().create_task(self._dispose(sandbox))
+            self._fill_tasks.add(task)
+            task.add_done_callback(self._fill_tasks.discard)
+
+    async def _upload_file(
+        self, client: httpx.AsyncClient, path: str, object_id: str
+    ) -> None:
+        rel = normalize_workspace_path(path)
+        if rel.startswith("workspace/"):
+            rel = rel[len("workspace/") :]
+        try:
+            async with self.storage.reader(object_id) as reader:
+                data = await reader.read()
+        except KeyError:
+            raise ValueError(f"unknown file object id: {object_id}")
+        resp = await client.put(f"/workspace/{rel}", content=data)
+        if resp.status_code != 200:
+            raise ExecutorError(
+                f"upload of {path} failed: {resp.status_code} {resp.text[:200]}"
+            )
+
+    async def _download_file(
+        self, client: httpx.AsyncClient, rel: str
+    ) -> tuple[str, str]:
+        async with self.storage.writer() as writer:
+            async with client.stream("GET", f"/workspace/{rel}") as resp:
+                if resp.status_code != 200:
+                    raise ExecutorError(f"download of {rel} failed: {resp.status_code}")
+                async for chunk in resp.aiter_bytes():
+                    await writer.write(chunk)
+        assert writer.hash is not None
+        return rel, writer.hash
+
+    async def _dispose(self, sandbox: Sandbox) -> None:
+        try:
+            await self.backend.delete(sandbox)
+        except Exception:  # noqa: BLE001
+            logger.exception("failed to delete sandbox %s", sandbox.id)
+
+    # ----------------------------------------------------------------- admin
+
+    async def close(self) -> None:
+        self._closed = True
+        # Let in-flight dispose/fill tasks finish so no subprocess transport
+        # outlives the event loop.
+        pending = list(self._fill_tasks)
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+        sandboxes = [s for pool in self._pools.values() for s in pool]
+        self._pools.clear()
+        await asyncio.gather(*(self._dispose(s) for s in sandboxes))
+        await self.backend.close()

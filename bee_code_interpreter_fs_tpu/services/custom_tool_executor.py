@@ -1,0 +1,281 @@
+"""LLM "custom tool" support: parse a Python function into a JSON-Schema tool
+definition, and execute it with JSON input.
+
+Behavior parity with the reference's CustomToolExecutor
+(src/code_interpreter/services/custom_tool_executor.py:28-264): a tool source
+is import statements followed by exactly one annotated function; `parse()`
+maps annotations to JSON Schema (int/float/str/bool/Any, list/dict[str,·],
+tuple, Optional/Union, nested) and pulls parameter/return descriptions from a
+ReST docstring; `execute()` wraps the tool in a generated script (imports
+re-emitted at top level so dependency auto-install sees them —
+custom_tool_executor.py:174-181), suppresses tool prints, and emits the JSON
+result on the last stdout line. Wired to the fixed executor signature
+(SURVEY.md §0.1: the reference called a kwarg that no longer existed).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from .code_executor import CodeExecutor
+
+
+class CustomToolParseError(ValueError):
+    def __init__(self, errors: list[str]) -> None:
+        super().__init__("; ".join(errors))
+        self.errors = errors
+
+
+class CustomToolExecuteError(RuntimeError):
+    def __init__(self, stderr: str) -> None:
+        super().__init__(stderr)
+        self.stderr = stderr
+
+
+@dataclass
+class CustomTool:
+    name: str
+    description: str
+    input_schema: dict
+
+
+_BASIC_TYPES = {
+    "int": {"type": "integer"},
+    "float": {"type": "number"},
+    "str": {"type": "string"},
+    "bool": {"type": "boolean"},
+    "NoneType": {"type": "null"},
+    "None": {"type": "null"},
+    "Any": {},
+    "typing.Any": {},
+}
+
+
+def _annotation_to_schema(node: ast.expr) -> dict:
+    """Map a type-annotation AST node to JSON Schema; raises ValueError."""
+    if isinstance(node, ast.Constant) and node.value is None:
+        return {"type": "null"}
+    if isinstance(node, ast.Name):
+        if node.id in _BASIC_TYPES:
+            return dict(_BASIC_TYPES[node.id])
+        if node.id in ("list", "List"):
+            return {"type": "array"}
+        if node.id in ("dict", "Dict"):
+            return {"type": "object"}
+        if node.id in ("tuple", "Tuple"):
+            return {"type": "array"}
+        raise ValueError(f"unsupported type annotation: {node.id}")
+    if isinstance(node, ast.Attribute):
+        full = ast.unparse(node)
+        if full in _BASIC_TYPES:
+            return dict(_BASIC_TYPES[full])
+        if full in ("typing.List", "typing.Sequence"):
+            return {"type": "array"}
+        if full in ("typing.Dict", "typing.Mapping"):
+            return {"type": "object"}
+        raise ValueError(f"unsupported type annotation: {full}")
+    if isinstance(node, ast.Subscript):
+        base = ast.unparse(node.value)
+        args = (
+            list(node.slice.elts) if isinstance(node.slice, ast.Tuple) else [node.slice]
+        )
+        if base in ("list", "List", "typing.List", "typing.Sequence", "set", "Set",
+                    "typing.Set", "frozenset"):
+            return {"type": "array", "items": _annotation_to_schema(args[0])}
+        if base in ("dict", "Dict", "typing.Dict", "typing.Mapping"):
+            if len(args) != 2:
+                raise ValueError("dict annotation needs two type parameters")
+            key_schema = _annotation_to_schema(args[0])
+            if key_schema.get("type") != "string":
+                raise ValueError("dict keys must be str for JSON mapping")
+            return {
+                "type": "object",
+                "additionalProperties": _annotation_to_schema(args[1]),
+            }
+        if base in ("tuple", "Tuple", "typing.Tuple"):
+            return {
+                "type": "array",
+                "prefixItems": [_annotation_to_schema(a) for a in args],
+                "minItems": len(args),
+                "maxItems": len(args),
+            }
+        if base in ("Optional", "typing.Optional"):
+            return {"anyOf": [_annotation_to_schema(args[0]), {"type": "null"}]}
+        if base in ("Union", "typing.Union"):
+            return {"anyOf": [_annotation_to_schema(a) for a in args]}
+        raise ValueError(f"unsupported generic type: {base}")
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        # PEP 604 unions: int | None
+        return {
+            "anyOf": [
+                _annotation_to_schema(node.left),
+                _annotation_to_schema(node.right),
+            ]
+        }
+    raise ValueError(f"unsupported type annotation: {ast.unparse(node)}")
+
+
+_PARAM_RE = re.compile(
+    r"^\s*:param\s+(?P<name>\w+)\s*:\s*(?P<desc>.*?)(?=^\s*:|\Z)",
+    re.MULTILINE | re.DOTALL,
+)
+_RETURN_RE = re.compile(
+    r"^\s*:returns?\s*:\s*(?P<desc>.*?)(?=^\s*:|\Z)", re.MULTILINE | re.DOTALL
+)
+
+
+def _parse_docstring(docstring: str) -> tuple[str, dict[str, str], str]:
+    """Returns (summary, {param: description}, return_description)."""
+    if not docstring:
+        return "", {}, ""
+    first_field = re.search(r"^\s*:", docstring, re.MULTILINE)
+    summary = (
+        docstring[: first_field.start()] if first_field else docstring
+    ).strip()
+    params = {
+        m.group("name"): re.sub(r"\s+", " ", m.group("desc")).strip()
+        for m in _PARAM_RE.finditer(docstring)
+    }
+    ret_match = _RETURN_RE.search(docstring)
+    ret = re.sub(r"\s+", " ", ret_match.group("desc")).strip() if ret_match else ""
+    return summary, params, ret
+
+
+def _split_tool_source(tool_source_code: str) -> tuple[list[str], ast.FunctionDef]:
+    errors: list[str] = []
+    try:
+        tree = ast.parse(tool_source_code)
+    except SyntaxError as e:
+        raise CustomToolParseError([f"syntax error: {e}"])
+    imports: list[str] = []
+    fn: ast.FunctionDef | None = None
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            if fn is not None:
+                errors.append("imports must precede the function definition")
+            imports.append(ast.unparse(node))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if fn is not None:
+                errors.append("tool source must define exactly one function")
+            if isinstance(node, ast.AsyncFunctionDef):
+                errors.append("async functions are not supported")
+            else:
+                fn = node
+        else:
+            errors.append(
+                f"unexpected top-level statement: {ast.unparse(node)[:60]}"
+            )
+    if fn is None:
+        errors.append("tool source must define a function")
+    if errors:
+        raise CustomToolParseError(errors)
+    assert fn is not None
+    return imports, fn
+
+
+class CustomToolExecutor:
+    def __init__(self, code_executor: "CodeExecutor") -> None:
+        self.code_executor = code_executor
+
+    def parse(self, tool_source_code: str) -> CustomTool:
+        imports, fn = _split_tool_source(tool_source_code)
+        errors: list[str] = []
+        args = fn.args
+        if args.posonlyargs:
+            errors.append("positional-only parameters are not supported")
+        if args.vararg:
+            errors.append("*args is not supported")
+        if args.kwarg:
+            errors.append("**kwargs is not supported")
+
+        summary, param_docs, _ = _parse_docstring(ast.get_docstring(fn) or "")
+
+        properties: dict[str, dict] = {}
+        required: list[str] = []
+        defaults_count = len(args.defaults)
+        positional_required = len(args.args) - defaults_count
+        for i, arg in enumerate(args.args):
+            if arg.annotation is None:
+                errors.append(f"parameter '{arg.arg}' is missing a type annotation")
+                continue
+            try:
+                schema = _annotation_to_schema(arg.annotation)
+            except ValueError as e:
+                errors.append(f"parameter '{arg.arg}': {e}")
+                continue
+            if arg.arg in param_docs:
+                schema["description"] = param_docs[arg.arg]
+            properties[arg.arg] = schema
+            if i < positional_required:
+                required.append(arg.arg)
+        for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+            if arg.annotation is None:
+                errors.append(f"parameter '{arg.arg}' is missing a type annotation")
+                continue
+            try:
+                schema = _annotation_to_schema(arg.annotation)
+            except ValueError as e:
+                errors.append(f"parameter '{arg.arg}': {e}")
+                continue
+            if arg.arg in param_docs:
+                schema["description"] = param_docs[arg.arg]
+            properties[arg.arg] = schema
+            if default is None:
+                required.append(arg.arg)
+        if errors:
+            raise CustomToolParseError(errors)
+
+        input_schema = {
+            "type": "object",
+            "properties": properties,
+            "required": required,
+            "additionalProperties": False,
+        }
+        return CustomTool(
+            name=fn.name, description=summary, input_schema=input_schema
+        )
+
+    async def execute(
+        self, tool_source_code: str, tool_input: dict, **execute_kwargs
+    ) -> object:
+        imports, fn = _split_tool_source(tool_source_code)
+        script = self._build_wrapper(tool_source_code, imports, fn.name, tool_input)
+        result = await self.code_executor.execute(source_code=script, **execute_kwargs)
+        if result.exit_code != 0:
+            raise CustomToolExecuteError(result.stderr)
+        last_line = result.stdout.strip().splitlines()[-1] if result.stdout.strip() else "null"
+        try:
+            return json.loads(last_line)
+        except json.JSONDecodeError:
+            raise CustomToolExecuteError(
+                f"tool did not produce JSON output: {result.stdout[-500:]!r}"
+            )
+
+    @staticmethod
+    def _build_wrapper(
+        tool_source_code: str, imports: list[str], fn_name: str, tool_input: dict
+    ) -> str:
+        # Imports re-emitted at top level so the AST dependency scanner
+        # (executor/deps.py) can see and auto-install them.
+        lines = list(imports)
+        lines += [
+            "import contextlib as _contextlib",
+            "import io as _io",
+            "import json as _json",
+            "import sys as _sys",
+            f"_SOURCE = {tool_source_code!r}",
+            f"_INPUT = {json.dumps(tool_input)!r}",
+            "_ns = {}",
+            "exec(compile(_SOURCE, '<tool>', 'exec'), _ns)",
+            f"_fn = _ns[{fn_name!r}]",
+            "_sink = _io.StringIO()",
+            "with _contextlib.redirect_stdout(_sink):",
+            "    _result = _fn(**_json.loads(_INPUT))",
+            "print(_json.dumps(_result))",
+        ]
+        return "\n".join(lines)

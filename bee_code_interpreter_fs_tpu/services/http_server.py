@@ -1,0 +1,222 @@
+"""HTTP API (aiohttp): Execute, custom tools, and the files CRUD.
+
+Endpoint parity with the reference's FastAPI app
+(src/code_interpreter/services/http_server.py:75-215): POST /v1/execute,
+POST /v1/parse-custom-tool, POST /v1/execute-custom-tool, PUT /v1/files,
+GET/DELETE /v1/files/{hash}. Differences by design:
+
+- /v1/execute accepts BOTH inline `source_code` and `source_file` (the
+  reference required source_file while its own tests posted source_code —
+  SURVEY.md §0.1); plus TPU fields `chip_count` and `env`.
+- Responses include per-phase timings; GET /healthz is a cheap liveness probe.
+- FastAPI/uvicorn are not available in this environment; aiohttp serves the
+  same surface.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+from aiohttp import web
+from pydantic import BaseModel, Field, ValidationError
+
+from ..utils.logs import new_request_id
+from ..utils.validation import OBJECT_ID_RE
+from .backends.base import SandboxSpawnError
+from .code_executor import CodeExecutor, ExecutorError
+from .custom_tool_executor import (
+    CustomToolExecuteError,
+    CustomToolExecutor,
+    CustomToolParseError,
+)
+from .storage import Storage, StorageObjectNotFound
+
+logger = logging.getLogger(__name__)
+
+
+class ExecuteRequest(BaseModel):
+    source_code: str | None = None
+    source_file: str | None = None
+    files: dict[str, str] = Field(default_factory=dict)
+    timeout: float | None = Field(default=None, gt=0)
+    env: dict[str, str] | None = None
+    chip_count: int | None = Field(default=None, ge=0)
+
+
+class ParseCustomToolRequest(BaseModel):
+    tool_source_code: str
+
+
+class ExecuteCustomToolRequest(BaseModel):
+    tool_source_code: str
+    tool_input_json: str
+
+
+@web.middleware
+async def request_id_middleware(request: web.Request, handler):
+    new_request_id()
+    return await handler(request)
+
+
+def create_http_app(
+    code_executor: CodeExecutor,
+    custom_tool_executor: CustomToolExecutor,
+    storage: Storage,
+) -> web.Application:
+    app = web.Application(middlewares=[request_id_middleware], client_max_size=256 * 2**20)
+    routes = web.RouteTableDef()
+
+    def bad_request(message, **extra) -> web.Response:
+        return web.json_response({"error": message, **extra}, status=400)
+
+    async def parse_model(request: web.Request, model):
+        try:
+            return model.model_validate(await request.json())
+        except json.JSONDecodeError:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": "invalid JSON body"}),
+                content_type="application/json",
+            )
+        except ValidationError as e:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": "validation failed", "detail": e.errors(include_url=False)}),
+                content_type="application/json",
+            )
+
+    @routes.get("/healthz")
+    async def healthz(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    @routes.post("/v1/execute")
+    async def execute(request: web.Request) -> web.Response:
+        req = await parse_model(request, ExecuteRequest)
+        if (req.source_code is None) == (req.source_file is None):
+            return bad_request("exactly one of source_code/source_file is required")
+        for path, object_id in req.files.items():
+            if not OBJECT_ID_RE.match(object_id):
+                return bad_request(f"invalid file object id for {path}")
+        try:
+            result = await code_executor.execute(
+                req.source_code,
+                source_file=req.source_file,
+                files=req.files,
+                timeout=req.timeout,
+                env=req.env,
+                chip_count=req.chip_count,
+            )
+        except ValueError as e:
+            return bad_request(str(e))
+        except (ExecutorError, SandboxSpawnError) as e:
+            logger.exception("execute failed")
+            return web.json_response({"error": str(e)}, status=502)
+        return web.json_response(
+            {
+                "stdout": result.stdout,
+                "stderr": result.stderr,
+                "exit_code": result.exit_code,
+                "files": result.files,
+                "phases": result.phases,
+                "warm": result.warm,
+            }
+        )
+
+    @routes.post("/v1/parse-custom-tool")
+    async def parse_custom_tool(request: web.Request) -> web.Response:
+        req = await parse_model(request, ParseCustomToolRequest)
+        try:
+            tool = custom_tool_executor.parse(req.tool_source_code)
+        except CustomToolParseError as e:
+            return web.json_response({"error_messages": e.errors}, status=400)
+        return web.json_response(
+            {
+                "tool_name": tool.name,
+                "tool_description": tool.description,
+                "tool_input_schema_json": json.dumps(tool.input_schema),
+            }
+        )
+
+    @routes.post("/v1/execute-custom-tool")
+    async def execute_custom_tool(request: web.Request) -> web.Response:
+        req = await parse_model(request, ExecuteCustomToolRequest)
+        try:
+            tool_input = json.loads(req.tool_input_json)
+        except json.JSONDecodeError:
+            return bad_request("tool_input_json is not valid JSON")
+        try:
+            output = await custom_tool_executor.execute(req.tool_source_code, tool_input)
+        except CustomToolParseError as e:
+            return web.json_response({"error_messages": e.errors}, status=400)
+        except CustomToolExecuteError as e:
+            return web.json_response({"stderr": e.stderr}, status=400)
+        except (ExecutorError, SandboxSpawnError) as e:
+            logger.exception("custom tool execute failed")
+            return web.json_response({"error": str(e)}, status=502)
+        return web.json_response({"tool_output_json": json.dumps(output)})
+
+    @routes.put("/v1/files")
+    async def upload_file(request: web.Request) -> web.Response:
+        # multipart/form-data with a `file` part, or a raw body
+        object_id: str | None = None
+        if request.content_type.startswith("multipart/"):
+            reader = await request.multipart()
+            async with storage.writer() as writer:
+                part = await reader.next()
+                while part is not None and part.name != "file":
+                    part = await reader.next()
+                if part is None:
+                    return bad_request("multipart body must contain a 'file' part")
+                while chunk := await part.read_chunk(1 << 20):
+                    await writer.write(chunk)
+            object_id = writer.hash
+        else:
+            async with storage.writer() as writer:
+                async for chunk in request.content.iter_chunked(1 << 20):
+                    await writer.write(chunk)
+            object_id = writer.hash
+        return web.json_response({"hash": object_id})
+
+    @routes.get("/v1/files/{hash}")
+    async def download_file(request: web.Request) -> web.StreamResponse:
+        object_id = request.match_info["hash"]
+        if not OBJECT_ID_RE.match(object_id):
+            return bad_request("invalid object id")
+        delete_after = request.query.get("delete", "").lower() in ("1", "true", "yes")
+        # Open the reader BEFORE preparing the response: once headers are sent
+        # a late StorageObjectNotFound could no longer become a clean 404 (and
+        # an open fd keeps the content alive even if a concurrent delete wins).
+        reader_cm = storage.reader(object_id)
+        try:
+            reader = await reader_cm.__aenter__()
+        except StorageObjectNotFound:
+            return web.json_response({"error": "file not found"}, status=404)
+        try:
+            size = os.fstat(reader.wrapped.fileno()).st_size
+            response = web.StreamResponse(
+                status=200,
+                headers={
+                    "Content-Type": "application/octet-stream",
+                    "Content-Length": str(size),
+                },
+            )
+            await response.prepare(request)
+            while chunk := await reader.read(1 << 20):
+                await response.write(chunk)
+            await response.write_eof()
+        finally:
+            await reader_cm.__aexit__(None, None, None)
+        if delete_after:
+            await storage.delete(object_id)
+        return response
+
+    @routes.delete("/v1/files/{hash}")
+    async def delete_file(request: web.Request) -> web.Response:
+        object_id = request.match_info["hash"]
+        if not OBJECT_ID_RE.match(object_id):
+            return bad_request("invalid object id")
+        await storage.delete(object_id)
+        return web.json_response({"deleted": object_id})
+
+    app.add_routes(routes)
+    return app

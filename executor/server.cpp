@@ -599,16 +599,24 @@ void handle_execute(const minihttp::Request& /*req*/, minihttp::Conn& conn) {
 
   std::lock_guard<std::mutex> lock(g_state.exec_mutex);
 
-  // Resolve the script path.
-  std::string script_path;
+  // Per-request scratch dir: holds the script (source_code mode) and the
+  // stdout/stderr capture files. Never inside the workspace — capture files
+  // must not appear in the changed-file diff.
   char tmpl[] = "/tmp/exec-XXXXXX";
+  if (!mkdtemp(tmpl)) {
+    conn.send_response(500, "application/json", "{\"error\":\"mkdtemp failed\"}");
+    return;
+  }
+  std::string scratch(tmpl);
+  std::string script_path;
+  auto drop_scratch = [&scratch, &script_path]() {
+    if (!script_path.empty()) unlink(script_path.c_str());
+    rmdir(scratch.c_str());
+  };
   if (!source_code.empty()) {
-    if (!mkdtemp(tmpl)) {
-      conn.send_response(500, "application/json", "{\"error\":\"mkdtemp failed\"}");
-      return;
-    }
-    script_path = std::string(tmpl) + "/script.py";
+    script_path = scratch + "/script.py";
     if (!write_file(script_path, source_code)) {
+      drop_scratch();
       conn.send_response(500, "application/json", "{\"error\":\"write failed\"}");
       return;
     }
@@ -617,6 +625,7 @@ void handle_execute(const minihttp::Request& /*req*/, minihttp::Conn& conn) {
     std::string dup = "workspace/";
     if (rel.compare(0, dup.size(), dup) == 0) rel = rel.substr(dup.size());
     if (rel.empty() || !confine(g_state.workspace, rel, script_path)) {
+      drop_scratch();
       conn.send_response(403, "application/json",
                          "{\"error\":\"source_file escapes workspace\"}");
       return;
@@ -628,8 +637,8 @@ void handle_execute(const minihttp::Request& /*req*/, minihttp::Conn& conn) {
   std::map<std::string, FileSig> before;
   scan_dir(g_state.workspace, "", before);
 
-  std::string stdout_path = script_path + ".stdout";
-  std::string stderr_path = script_path + ".stderr";
+  std::string stdout_path = scratch + "/cap.stdout";
+  std::string stderr_path = scratch + "/cap.stderr";
 
   struct timespec t0, t1;
   clock_gettime(CLOCK_MONOTONIC, &t0);
@@ -694,14 +703,12 @@ void handle_execute(const minihttp::Request& /*req*/, minihttp::Conn& conn) {
   } else if (runner_died) {
     err_s += err_s.empty() ? "Executor runner crashed" : "\nExecutor runner crashed";
   }
+  // Remove the scratch dir (submitted source may contain secrets, and a
+  // long-lived dev server must not fill /tmp).
   unlink(stdout_path.c_str());
   unlink(stderr_path.c_str());
-  if (!source_code.empty()) {
-    // source_code mode owns /tmp/exec-XXXXXX; remove it (submitted source may
-    // contain secrets, and a long-lived dev server must not fill /tmp).
-    unlink(script_path.c_str());
-    rmdir(tmpl);
-  }
+  if (source_code.empty()) script_path.clear();  // workspace file: keep it
+  drop_scratch();
 
   minijson::Array files;
   for (const auto& rel : diff_snapshots(before, after)) {

@@ -29,15 +29,36 @@ import pytest  # noqa: E402
 
 
 def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests via asyncio.run, driving async-generator
+    fixtures (which plugin-less pytest passes through unresolved) in the same
+    event loop as the test."""
     fn = pyfuncitem.obj
-    if inspect.iscoroutinefunction(fn):
-        kwargs = {
-            name: pyfuncitem.funcargs[name]
-            for name in pyfuncitem._fixtureinfo.argnames
-        }
-        asyncio.run(fn(**kwargs))
-        return True
-    return None
+    if not inspect.iscoroutinefunction(fn):
+        return None
+
+    async def run():
+        import contextlib
+
+        kwargs = {}
+        cleanups = []
+        for name in pyfuncitem._fixtureinfo.argnames:
+            value = pyfuncitem.funcargs[name]
+            if inspect.isasyncgen(value):
+                kwargs[name] = await value.__anext__()
+                cleanups.append(value)
+            elif inspect.iscoroutine(value):
+                kwargs[name] = await value
+            else:
+                kwargs[name] = value
+        try:
+            await fn(**kwargs)
+        finally:
+            for gen in reversed(cleanups):
+                with contextlib.suppress(StopAsyncIteration):
+                    await gen.__anext__()
+
+    asyncio.run(run())
+    return True
 
 
 @pytest.fixture

@@ -1,0 +1,249 @@
+"""End-to-end tests: HTTP API → orchestrator pool → real C++ executor.
+
+These run the full Execute stack with the local subprocess backend — the
+cluster-free e2e coverage the reference could not do (its tests required a
+live k8s deployment, SURVEY.md §4). Scenario parity with the reference's
+test/e2e/test_http.py and test_grpc.py: stdlib execution, file create →
+returned id → feed back → read in a second execution, custom tool parse /
+execute / error propagation, plus our additions (timeout, phases, probes).
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.backends.local import LocalSandboxBackend
+from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor
+from bee_code_interpreter_fs_tpu.services.custom_tool_executor import CustomToolExecutor
+from bee_code_interpreter_fs_tpu.services.http_server import create_http_app
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+
+@pytest.fixture
+async def client(tmp_path):
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        local_sandbox_root=str(tmp_path / "sandboxes"),
+        executor_pod_queue_target_length=1,
+        jax_compilation_cache_dir="",
+        default_execution_timeout=30.0,
+    )
+    backend = LocalSandboxBackend(config, warm_import_jax=False)
+    storage = Storage(config.file_storage_path)
+    executor = CodeExecutor(backend, storage, config)
+    tools = CustomToolExecutor(executor)
+    app = create_http_app(executor, tools, storage)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    yield client
+    await client.close()
+    await executor.close()
+
+
+async def test_execute_hello(client):
+    resp = await client.post("/v1/execute", json={"source_code": "print(21 * 2)"})
+    assert resp.status == 200
+    body = await resp.json()
+    assert body["stdout"] == "42\n"
+    assert body["exit_code"] == 0
+    assert set(body["phases"]) >= {"queue_wait", "upload", "exec", "download"}
+
+
+async def test_execute_validation(client):
+    resp = await client.post("/v1/execute", json={})
+    assert resp.status == 400
+    resp = await client.post(
+        "/v1/execute", json={"source_code": "x", "source_file": "/workspace/y"}
+    )
+    assert resp.status == 400
+    resp = await client.post("/v1/execute", data=b"not json")
+    assert resp.status == 400
+
+
+async def test_file_roundtrip_through_executions(client):
+    # execution 1 creates a file
+    resp = await client.post(
+        "/v1/execute",
+        json={"source_code": "open('result.txt', 'w').write('persisted state')"},
+    )
+    body = await resp.json()
+    assert body["exit_code"] == 0
+    assert "/workspace/result.txt" in body["files"]
+    object_id = body["files"]["/workspace/result.txt"]
+
+    # execution 2 (a different sandbox) reads it back via the files map
+    resp = await client.post(
+        "/v1/execute",
+        json={
+            "source_code": "print(open('result.txt').read())",
+            "files": {"/workspace/result.txt": object_id},
+        },
+    )
+    body = await resp.json()
+    assert body["stdout"] == "persisted state\n"
+
+
+async def test_execute_source_file_flow(client):
+    # upload source as a file object, then execute by path (the fork's flow)
+    resp = await client.put("/v1/files", data=b"print('ran from file')")
+    object_id = (await resp.json())["hash"]
+    resp = await client.post(
+        "/v1/execute",
+        json={
+            "source_file": "/workspace/prog.py",
+            "files": {"/workspace/prog.py": object_id},
+        },
+    )
+    body = await resp.json()
+    assert body["exit_code"] == 0
+    assert body["stdout"] == "ran from file\n"
+
+
+async def test_files_crud(client):
+    resp = await client.put("/v1/files", data=b"file body")
+    assert resp.status == 200
+    object_id = (await resp.json())["hash"]
+    assert len(object_id) == 64
+
+    resp = await client.get(f"/v1/files/{object_id}")
+    assert resp.status == 200
+    assert await resp.read() == b"file body"
+
+    # delete-on-read
+    resp = await client.get(f"/v1/files/{object_id}?delete=true")
+    assert await resp.read() == b"file body"
+    resp = await client.get(f"/v1/files/{object_id}")
+    assert resp.status == 404
+
+    resp = await client.delete(f"/v1/files/{object_id}")
+    assert resp.status == 200
+
+    resp = await client.get("/v1/files/not%2Fvalid")
+    assert resp.status in (400, 404)
+
+
+async def test_multipart_upload(client):
+    import aiohttp
+
+    form = aiohttp.FormData()
+    form.add_field("file", b"multipart content", filename="f.bin")
+    resp = await client.put("/v1/files", data=form)
+    assert resp.status == 200
+    object_id = (await resp.json())["hash"]
+    resp = await client.get(f"/v1/files/{object_id}")
+    assert await resp.read() == b"multipart content"
+
+
+async def test_execute_timeout(client):
+    resp = await client.post(
+        "/v1/execute",
+        json={"source_code": "while True: pass", "timeout": 1.5},
+    )
+    body = await resp.json()
+    assert body["exit_code"] == -1
+    assert "timed out" in body["stderr"]
+
+
+async def test_execute_nonzero_exit(client):
+    resp = await client.post(
+        "/v1/execute", json={"source_code": "import sys; sys.exit(7)"}
+    )
+    body = await resp.json()
+    assert body["exit_code"] == 7
+
+
+async def test_parse_custom_tool(client):
+    source = '''
+import typing
+
+def find_items(query: str, limit: int = 10, tags: typing.Optional[list[str]] = None) -> dict:
+    """Search the catalog.
+
+    :param query: free-text search query
+    :param limit: maximum number of results
+    :param tags: restrict to these tags
+    :return: matching items
+    """
+    return {}
+'''
+    resp = await client.post("/v1/parse-custom-tool", json={"tool_source_code": source})
+    assert resp.status == 200
+    body = await resp.json()
+    assert body["tool_name"] == "find_items"
+    assert body["tool_description"] == "Search the catalog."
+    schema = json.loads(body["tool_input_schema_json"])
+    assert schema["required"] == ["query"]
+    assert schema["properties"]["query"] == {
+        "type": "string",
+        "description": "free-text search query",
+    }
+    assert schema["properties"]["limit"]["type"] == "integer"
+    assert schema["properties"]["tags"]["anyOf"][0] == {
+        "type": "array",
+        "items": {"type": "string"},
+    }
+
+
+async def test_parse_custom_tool_errors(client):
+    resp = await client.post(
+        "/v1/parse-custom-tool",
+        json={"tool_source_code": "def f(*args): pass"},
+    )
+    assert resp.status == 400
+    body = await resp.json()
+    assert any("*args" in m for m in body["error_messages"])
+
+
+async def test_execute_custom_tool(client):
+    source = "def add(a: int, b: int) -> int:\n    return a + b"
+    resp = await client.post(
+        "/v1/execute-custom-tool",
+        json={"tool_source_code": source, "tool_input_json": '{"a": 2, "b": 40}'},
+    )
+    assert resp.status == 200
+    body = await resp.json()
+    assert json.loads(body["tool_output_json"]) == 42
+
+
+async def test_execute_custom_tool_suppresses_prints(client):
+    source = (
+        "def noisy(x: int) -> int:\n"
+        "    print('debug chatter')\n"
+        "    return x * 2"
+    )
+    resp = await client.post(
+        "/v1/execute-custom-tool",
+        json={"tool_source_code": source, "tool_input_json": '{"x": 21}'},
+    )
+    body = await resp.json()
+    assert json.loads(body["tool_output_json"]) == 42
+
+
+async def test_execute_custom_tool_error_propagates(client):
+    source = "def boom(x: int) -> int:\n    return x / 0"
+    resp = await client.post(
+        "/v1/execute-custom-tool",
+        json={"tool_source_code": source, "tool_input_json": '{"x": 1}'},
+    )
+    assert resp.status == 400
+    body = await resp.json()
+    assert "division by zero" in body["stderr"]
+
+
+async def test_concurrent_executes(client):
+    async def one(i: int):
+        resp = await client.post(
+            "/v1/execute", json={"source_code": f"print({i} * 10)"}
+        )
+        return (await resp.json())["stdout"]
+
+    results = await asyncio.gather(*(one(i) for i in range(4)))
+    assert results == [f"{i * 10}\n" for i in range(4)]
+
+
+async def test_healthz(client):
+    resp = await client.get("/healthz")
+    assert resp.status == 200

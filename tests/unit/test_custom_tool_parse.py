@@ -1,0 +1,101 @@
+import pytest
+
+from bee_code_interpreter_fs_tpu.services.custom_tool_executor import (
+    CustomToolExecutor,
+    CustomToolParseError,
+)
+
+parser = CustomToolExecutor(code_executor=None)
+
+
+def parse(source: str):
+    return parser.parse(source)
+
+
+def test_basic_types():
+    tool = parse(
+        "def f(a: int, b: float, c: str, d: bool) -> str:\n    return ''"
+    )
+    props = tool.input_schema["properties"]
+    assert props["a"]["type"] == "integer"
+    assert props["b"]["type"] == "number"
+    assert props["c"]["type"] == "string"
+    assert props["d"]["type"] == "boolean"
+    assert tool.input_schema["required"] == ["a", "b", "c", "d"]
+
+
+def test_nested_generics():
+    tool = parse(
+        "import typing\n"
+        "def f(m: dict[str, list[typing.Optional[int]]], "
+        "t: tuple[int, str]) -> None:\n    return None"
+    )
+    m = tool.input_schema["properties"]["m"]
+    assert m["type"] == "object"
+    assert m["additionalProperties"]["type"] == "array"
+    assert m["additionalProperties"]["items"]["anyOf"][1] == {"type": "null"}
+    t = tool.input_schema["properties"]["t"]
+    assert t["prefixItems"] == [{"type": "integer"}, {"type": "string"}]
+
+
+def test_pep604_union():
+    tool = parse("def f(x: int | None = None) -> None:\n    return None")
+    x = tool.input_schema["properties"]["x"]
+    assert {"type": "integer"} in x["anyOf"]
+    assert {"type": "null"} in x["anyOf"]
+    assert tool.input_schema["required"] == []
+
+
+def test_kwonly_required():
+    tool = parse("def f(*, x: int, y: int = 3) -> int:\n    return x")
+    assert tool.input_schema["required"] == ["x"]
+
+
+def test_docstring_extraction():
+    tool = parse(
+        'def f(x: int) -> int:\n'
+        '    """Do the thing.\n\n'
+        '    Longer prose here.\n\n'
+        '    :param x: the x\n'
+        '       continued over lines\n'
+        '    :return: doubled x\n'
+        '    """\n'
+        '    return 2 * x'
+    )
+    assert tool.description.startswith("Do the thing.")
+    assert tool.input_schema["properties"]["x"]["description"] == (
+        "the x continued over lines"
+    )
+
+
+@pytest.mark.parametrize(
+    "source,fragment",
+    [
+        ("def f(*args): pass", "*args"),
+        ("def f(**kw): pass", "**kwargs"),
+        ("def f(a, /, b: int): pass", "positional-only"),
+        ("def f(a): pass", "missing a type annotation"),
+        ("x = 1\ndef f(a: int): pass", "unexpected top-level"),
+        ("def f(a: int): pass\ndef g(b: int): pass", "exactly one function"),
+        ("async def f(a: int): pass", "async"),
+        ("import os", "must define a function"),
+        ("def f(a: SomeUnknownClass): pass", "unsupported type"),
+        ("def f(a: dict[int, str]): pass", "keys must be str"),
+        ("def f(:", "syntax error"),
+    ],
+)
+def test_parse_errors(source, fragment):
+    with pytest.raises(CustomToolParseError) as exc_info:
+        parse(source)
+    assert any(fragment in m for m in exc_info.value.errors), exc_info.value.errors
+
+
+def test_wrapper_script_shape():
+    script = CustomToolExecutor._build_wrapper(
+        "import math\ndef f(x: int) -> float:\n    return math.sqrt(x)",
+        ["import math"],
+        "f",
+        {"x": 16},
+    )
+    assert script.startswith("import math")
+    compile(script, "<wrapper>", "exec")  # must be valid python
