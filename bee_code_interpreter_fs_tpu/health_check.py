@@ -1,0 +1,61 @@
+"""End-to-end health probe CLI: `python -m bee_code_interpreter_fs_tpu.health_check`.
+
+Parity with the reference (src/code_interpreter/health_check.py:28-53): builds
+an insecure-or-TLS channel from the same Config and asserts that
+Execute("print(21 * 2)") returns "42\\n" — a probe through the entire stack
+including a real sandbox. Exits 0 on success, 1 on failure.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+
+import grpc
+
+from .config import Config
+from .proto import SERVICE_NAME, code_interpreter_pb2 as pb2
+
+
+def _channel(config: Config, target: str) -> grpc.aio.Channel:
+    if config.grpc_tls_ca_cert or config.grpc_tls_cert:
+        creds = grpc.ssl_channel_credentials(
+            root_certificates=config.grpc_tls_ca_cert,
+            private_key=config.grpc_tls_cert_key,
+            certificate_chain=config.grpc_tls_cert,
+        )
+        return grpc.aio.secure_channel(target, creds)
+    return grpc.aio.insecure_channel(target)
+
+
+async def check(config: Config | None = None, target: str | None = None) -> None:
+    config = config or Config.from_env()
+    if target is None:
+        host, _, port = config.grpc_listen_addr.rpartition(":")
+        if host in ("0.0.0.0", "[::]", ""):
+            host = "127.0.0.1"
+        target = f"{host}:{port}"
+    async with _channel(config, target) as channel:
+        execute = channel.unary_unary(
+            f"/{SERVICE_NAME}/Execute",
+            request_serializer=pb2.ExecuteRequest.SerializeToString,
+            response_deserializer=pb2.ExecuteResponse.FromString,
+        )
+        response = await execute(
+            pb2.ExecuteRequest(source_code="print(21 * 2)"), timeout=120.0
+        )
+    assert response.stdout == "42\n", f"unexpected stdout: {response.stdout!r}"
+    assert response.exit_code == 0, f"unexpected exit code: {response.exit_code}"
+
+
+def main() -> None:
+    try:
+        asyncio.run(check())
+    except Exception as e:  # noqa: BLE001
+        print(f"health check FAILED: {e}", file=sys.stderr)
+        sys.exit(1)
+    print("health check OK")
+
+
+if __name__ == "__main__":
+    main()

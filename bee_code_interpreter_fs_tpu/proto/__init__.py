@@ -1,0 +1,11 @@
+"""Generated protobuf modules (checked in; regenerate with scripts/genproto.sh).
+
+Source of truth: /proto/*.proto. The reference's contract lived in an
+unvendored git submodule (SURVEY.md §0.2); here both the .proto files and the
+generated code are in-repo.
+"""
+
+from . import code_interpreter_pb2, health_pb2  # noqa: F401
+
+SERVICE_NAME = "code_interpreter.v1.CodeInterpreterService"
+HEALTH_SERVICE_NAME = "grpc.health.v1.Health"

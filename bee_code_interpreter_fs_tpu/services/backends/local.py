@@ -104,7 +104,10 @@ class LocalSandboxBackend(SandboxBackend):
         )
 
         async def abort_spawn(reason: str):
-            proc.kill()
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
             await proc.wait()  # reap; no zombie
             await asyncio.to_thread(shutil.rmtree, sandbox_dir, True)
             raise SandboxSpawnError(f"sandbox {sandbox_id} {reason}")

@@ -236,7 +236,10 @@ class CodeExecutor:
                 data = await reader.read()
         except KeyError:
             raise ValueError(f"unknown file object id: {object_id}")
-        resp = await client.put(f"/workspace/{rel}", content=data)
+        try:
+            resp = await client.put(f"/workspace/{rel}", content=data)
+        except httpx.HTTPError as e:
+            raise ExecutorError(f"upload of {path} failed: {e}")
         if resp.status_code != 200:
             raise ExecutorError(
                 f"upload of {path} failed: {resp.status_code} {resp.text[:200]}"
@@ -245,12 +248,17 @@ class CodeExecutor:
     async def _download_file(
         self, client: httpx.AsyncClient, rel: str
     ) -> tuple[str, str]:
-        async with self.storage.writer() as writer:
-            async with client.stream("GET", f"/workspace/{rel}") as resp:
-                if resp.status_code != 200:
-                    raise ExecutorError(f"download of {rel} failed: {resp.status_code}")
-                async for chunk in resp.aiter_bytes():
-                    await writer.write(chunk)
+        try:
+            async with self.storage.writer() as writer:
+                async with client.stream("GET", f"/workspace/{rel}") as resp:
+                    if resp.status_code != 200:
+                        raise ExecutorError(
+                            f"download of {rel} failed: {resp.status_code}"
+                        )
+                    async for chunk in resp.aiter_bytes():
+                        await writer.write(chunk)
+        except httpx.HTTPError as e:
+            raise ExecutorError(f"download of {rel} failed: {e}")
         assert writer.hash is not None
         return rel, writer.hash
 

@@ -197,36 +197,27 @@ class CustomToolExecutor:
 
         properties: dict[str, dict] = {}
         required: list[str] = []
-        defaults_count = len(args.defaults)
-        positional_required = len(args.args) - defaults_count
+
+        def add_param(arg: ast.arg, is_required: bool) -> None:
+            if arg.annotation is None:
+                errors.append(f"parameter '{arg.arg}' is missing a type annotation")
+                return
+            try:
+                schema = _annotation_to_schema(arg.annotation)
+            except ValueError as e:
+                errors.append(f"parameter '{arg.arg}': {e}")
+                return
+            if arg.arg in param_docs:
+                schema["description"] = param_docs[arg.arg]
+            properties[arg.arg] = schema
+            if is_required:
+                required.append(arg.arg)
+
+        positional_required = len(args.args) - len(args.defaults)
         for i, arg in enumerate(args.args):
-            if arg.annotation is None:
-                errors.append(f"parameter '{arg.arg}' is missing a type annotation")
-                continue
-            try:
-                schema = _annotation_to_schema(arg.annotation)
-            except ValueError as e:
-                errors.append(f"parameter '{arg.arg}': {e}")
-                continue
-            if arg.arg in param_docs:
-                schema["description"] = param_docs[arg.arg]
-            properties[arg.arg] = schema
-            if i < positional_required:
-                required.append(arg.arg)
+            add_param(arg, is_required=i < positional_required)
         for arg, default in zip(args.kwonlyargs, args.kw_defaults):
-            if arg.annotation is None:
-                errors.append(f"parameter '{arg.arg}' is missing a type annotation")
-                continue
-            try:
-                schema = _annotation_to_schema(arg.annotation)
-            except ValueError as e:
-                errors.append(f"parameter '{arg.arg}': {e}")
-                continue
-            if arg.arg in param_docs:
-                schema["description"] = param_docs[arg.arg]
-            properties[arg.arg] = schema
-            if default is None:
-                required.append(arg.arg)
+            add_param(arg, is_required=default is None)
         if errors:
             raise CustomToolParseError(errors)
 

@@ -1,0 +1,114 @@
+"""gRPC server shell: service registration, TLS, health service.
+
+Parity with the reference (src/code_interpreter/services/grpc_server.py:28-71)
+— grpc.aio server, insecure or TLS port from config — plus the health service
+the reference left as a TODO (grpc_server.py:71). grpcio's codegen plugin and
+the reflection/health add-on packages are unavailable in this environment, so
+services are registered via generic handlers against the vendored protos
+(proto/*.proto), which needs no generated service stubs.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import grpc
+
+from ..config import Config
+from ..proto import HEALTH_SERVICE_NAME, SERVICE_NAME, health_pb2
+from .code_executor import CodeExecutor
+from .custom_tool_executor import CustomToolExecutor
+from .grpc_servicers.code_interpreter_servicer import CodeInterpreterServicer
+from .storage import Storage
+
+logger = logging.getLogger(__name__)
+
+
+class HealthServicer:
+    """grpc.health.v1.Health — Check + Watch (single-update stream)."""
+
+    def __init__(self) -> None:
+        self.serving = True
+
+    async def Check(self, request, context) -> health_pb2.HealthCheckResponse:
+        if request.service not in ("", SERVICE_NAME, HEALTH_SERVICE_NAME):
+            await context.abort(grpc.StatusCode.NOT_FOUND, "unknown service")
+        status = (
+            health_pb2.HealthCheckResponse.SERVING
+            if self.serving
+            else health_pb2.HealthCheckResponse.NOT_SERVING
+        )
+        return health_pb2.HealthCheckResponse(status=status)
+
+    async def Watch(self, request, context):
+        yield await self.Check(request, context)
+
+    def method_handlers(self) -> dict[str, grpc.RpcMethodHandler]:
+        return {
+            "Check": grpc.unary_unary_rpc_method_handler(
+                self.Check,
+                request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+            ),
+            "Watch": grpc.unary_stream_rpc_method_handler(
+                self.Watch,
+                request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+            ),
+        }
+
+
+class GrpcServer:
+    def __init__(
+        self,
+        config: Config,
+        code_executor: CodeExecutor,
+        custom_tool_executor: CustomToolExecutor,
+        storage: Storage,
+    ) -> None:
+        self.config = config
+        self.servicer = CodeInterpreterServicer(code_executor, custom_tool_executor)
+        self.health = HealthServicer()
+        self.server = grpc.aio.server()
+        self.server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    SERVICE_NAME, self.servicer.method_handlers()
+                ),
+                grpc.method_handlers_generic_handler(
+                    HEALTH_SERVICE_NAME, self.health.method_handlers()
+                ),
+            )
+        )
+        self.port: int | None = None
+
+    def _credentials(self) -> grpc.ServerCredentials | None:
+        cfg = self.config
+        if cfg.grpc_tls_cert and cfg.grpc_tls_cert_key:
+            return grpc.ssl_server_credentials(
+                [(cfg.grpc_tls_cert_key, cfg.grpc_tls_cert)],
+                root_certificates=cfg.grpc_tls_ca_cert,
+                require_client_auth=bool(cfg.grpc_tls_ca_cert),
+            )
+        return None
+
+    async def start(self) -> int:
+        addr = self.config.grpc_listen_addr
+        creds = self._credentials()
+        if creds is not None:
+            self.port = self.server.add_secure_port(addr, creds)
+        else:
+            self.port = self.server.add_insecure_port(addr)
+        if self.port == 0:
+            raise RuntimeError(f"failed to bind gRPC port at {addr}")
+        await self.server.start()
+        logger.info(
+            "gRPC listening on %s (tls=%s)", addr, "on" if creds else "off"
+        )
+        return self.port
+
+    async def wait_for_termination(self) -> None:
+        await self.server.wait_for_termination()
+
+    async def stop(self, grace: float = 5.0) -> None:
+        await self.server.stop(grace)

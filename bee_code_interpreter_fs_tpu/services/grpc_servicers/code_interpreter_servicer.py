@@ -1,0 +1,145 @@
+"""gRPC servicer for CodeInterpreterService.
+
+Parity with the reference servicer (src/code_interpreter/services/
+grpc_servicers/code_interpreter_servicer.py:40-136): per-request id into the
+logging ContextVar, request validation → INVALID_ARGUMENT abort, domain
+errors mapped into the response oneof error variants. Wired to the fixed
+executor signature supporting both source_code and source_file (the reference
+called `execute(source_code=...)` which its own executor no longer accepted —
+SURVEY.md §0.1).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import grpc
+
+from ...proto import code_interpreter_pb2 as pb2
+from ...utils.logs import new_request_id
+from ...utils.validation import OBJECT_ID_RE
+from ..code_executor import CodeExecutor, ExecutorError
+from ..custom_tool_executor import (
+    CustomToolExecuteError,
+    CustomToolExecutor,
+    CustomToolParseError,
+)
+from ..backends.base import SandboxSpawnError
+
+logger = logging.getLogger(__name__)
+
+
+class CodeInterpreterServicer:
+    def __init__(
+        self, code_executor: CodeExecutor, custom_tool_executor: CustomToolExecutor
+    ) -> None:
+        self.code_executor = code_executor
+        self.custom_tool_executor = custom_tool_executor
+
+    async def Execute(
+        self, request: pb2.ExecuteRequest, context: grpc.aio.ServicerContext
+    ) -> pb2.ExecuteResponse:
+        request_id = new_request_id()
+        logger.info("Execute [%s] chip_count=%d", request_id, request.chip_count)
+        has_code = bool(request.source_code)
+        has_file = bool(request.source_file)
+        if has_code == has_file:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "exactly one of source_code/source_file is required",
+            )
+        for path, object_id in request.files.items():
+            if not OBJECT_ID_RE.match(object_id):
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"invalid file object id for {path}",
+                )
+        try:
+            result = await self.code_executor.execute(
+                request.source_code if has_code else None,
+                source_file=request.source_file if has_file else None,
+                files=dict(request.files),
+                timeout=request.timeout or None,
+                env=dict(request.env) or None,
+                chip_count=request.chip_count or None,
+            )
+        except ValueError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except (ExecutorError, SandboxSpawnError) as e:
+            logger.exception("Execute failed [%s]", request_id)
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+        response = pb2.ExecuteResponse(
+            stdout=result.stdout, stderr=result.stderr, exit_code=result.exit_code
+        )
+        for path, object_id in result.files.items():
+            response.files[path] = object_id
+        return response
+
+    async def ParseCustomTool(
+        self, request: pb2.ParseCustomToolRequest, context: grpc.aio.ServicerContext
+    ) -> pb2.ParseCustomToolResponse:
+        new_request_id()
+        try:
+            tool = self.custom_tool_executor.parse(request.tool_source_code)
+        except CustomToolParseError as e:
+            return pb2.ParseCustomToolResponse(
+                error=pb2.ParseCustomToolResponse.Error(error_messages=e.errors)
+            )
+        return pb2.ParseCustomToolResponse(
+            success=pb2.ParseCustomToolResponse.Success(
+                tool_name=tool.name,
+                tool_input_schema_json=json.dumps(tool.input_schema),
+                tool_description=tool.description,
+            )
+        )
+
+    async def ExecuteCustomTool(
+        self, request: pb2.ExecuteCustomToolRequest, context: grpc.aio.ServicerContext
+    ) -> pb2.ExecuteCustomToolResponse:
+        request_id = new_request_id()
+        try:
+            tool_input = json.loads(request.tool_input_json)
+        except json.JSONDecodeError:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "tool_input_json is not valid JSON"
+            )
+        try:
+            output = await self.custom_tool_executor.execute(
+                request.tool_source_code, tool_input
+            )
+        except CustomToolParseError as e:
+            return pb2.ExecuteCustomToolResponse(
+                error=pb2.ExecuteCustomToolResponse.Error(stderr="\n".join(e.errors))
+            )
+        except CustomToolExecuteError as e:
+            return pb2.ExecuteCustomToolResponse(
+                error=pb2.ExecuteCustomToolResponse.Error(stderr=e.stderr)
+            )
+        except (ExecutorError, SandboxSpawnError) as e:
+            logger.exception("ExecuteCustomTool failed [%s]", request_id)
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+        return pb2.ExecuteCustomToolResponse(
+            success=pb2.ExecuteCustomToolResponse.Success(
+                tool_output_json=json.dumps(output)
+            )
+        )
+
+    def method_handlers(self) -> dict[str, grpc.RpcMethodHandler]:
+        return {
+            "Execute": grpc.unary_unary_rpc_method_handler(
+                self.Execute,
+                request_deserializer=pb2.ExecuteRequest.FromString,
+                response_serializer=pb2.ExecuteResponse.SerializeToString,
+            ),
+            "ParseCustomTool": grpc.unary_unary_rpc_method_handler(
+                self.ParseCustomTool,
+                request_deserializer=pb2.ParseCustomToolRequest.FromString,
+                response_serializer=pb2.ParseCustomToolResponse.SerializeToString,
+            ),
+            "ExecuteCustomTool": grpc.unary_unary_rpc_method_handler(
+                self.ExecuteCustomTool,
+                request_deserializer=pb2.ExecuteCustomToolRequest.FromString,
+                response_serializer=pb2.ExecuteCustomToolResponse.SerializeToString,
+            ),
+        }

@@ -161,12 +161,12 @@ def create_http_app(
         object_id: str | None = None
         if request.content_type.startswith("multipart/"):
             reader = await request.multipart()
-            async with storage.writer() as writer:
+            part = await reader.next()
+            while part is not None and part.name != "file":
                 part = await reader.next()
-                while part is not None and part.name != "file":
-                    part = await reader.next()
-                if part is None:
-                    return bad_request("multipart body must contain a 'file' part")
+            if part is None:
+                return bad_request("multipart body must contain a 'file' part")
+            async with storage.writer() as writer:
                 while chunk := await part.read_chunk(1 << 20):
                     await writer.write(chunk)
             object_id = writer.hash

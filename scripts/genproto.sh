@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Regenerates the checked-in protobuf modules from /proto.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+protoc --python_out=bee_code_interpreter_fs_tpu/proto -I proto \
+  proto/code_interpreter.proto proto/health.proto
+echo "regenerated bee_code_interpreter_fs_tpu/proto/*_pb2.py"

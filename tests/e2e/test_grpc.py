@@ -1,0 +1,163 @@
+"""gRPC e2e tests: real grpc.aio server + real executor binary, no cluster.
+
+Scenario parity with the reference's test/e2e/test_grpc.py (preinstalled
+imports, file create → id → feed back → read, custom tool parse/execute and
+error propagation) plus the health service and TPU request fields.
+"""
+
+import json
+
+import grpc
+import pytest
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.proto import (
+    HEALTH_SERVICE_NAME,
+    SERVICE_NAME,
+    code_interpreter_pb2 as pb2,
+    health_pb2,
+)
+from bee_code_interpreter_fs_tpu.services.backends.local import LocalSandboxBackend
+from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor
+from bee_code_interpreter_fs_tpu.services.custom_tool_executor import CustomToolExecutor
+from bee_code_interpreter_fs_tpu.services.grpc_server import GrpcServer
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+
+class Client:
+    def __init__(self, channel: grpc.aio.Channel):
+        def u(method, req, resp, service=SERVICE_NAME):
+            return channel.unary_unary(
+                f"/{service}/{method}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString,
+            )
+
+        self.execute = u("Execute", pb2.ExecuteRequest, pb2.ExecuteResponse)
+        self.parse_tool = u(
+            "ParseCustomTool", pb2.ParseCustomToolRequest, pb2.ParseCustomToolResponse
+        )
+        self.execute_tool = u(
+            "ExecuteCustomTool",
+            pb2.ExecuteCustomToolRequest,
+            pb2.ExecuteCustomToolResponse,
+        )
+        self.health_check = u(
+            "Check",
+            health_pb2.HealthCheckRequest,
+            health_pb2.HealthCheckResponse,
+            service=HEALTH_SERVICE_NAME,
+        )
+
+
+@pytest.fixture
+async def client(tmp_path):
+    config = Config(
+        grpc_listen_addr="127.0.0.1:0",
+        file_storage_path=str(tmp_path / "storage"),
+        local_sandbox_root=str(tmp_path / "sandboxes"),
+        executor_pod_queue_target_length=1,
+        jax_compilation_cache_dir="",
+        default_execution_timeout=30.0,
+    )
+    backend = LocalSandboxBackend(config, warm_import_jax=False)
+    storage = Storage(config.file_storage_path)
+    executor = CodeExecutor(backend, storage, config)
+    tools = CustomToolExecutor(executor)
+    server = GrpcServer(config, executor, tools, storage)
+    port = await server.start()
+    channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+    yield Client(channel)
+    await channel.close()
+    await server.stop(grace=0.1)
+    await executor.close()
+
+
+async def test_execute(client):
+    resp = await client.execute(pb2.ExecuteRequest(source_code="print(21 * 2)"))
+    assert resp.stdout == "42\n"
+    assert resp.exit_code == 0
+
+
+async def test_execute_validation_abort(client):
+    with pytest.raises(grpc.aio.AioRpcError) as e:
+        await client.execute(pb2.ExecuteRequest())
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    with pytest.raises(grpc.aio.AioRpcError) as e:
+        await client.execute(
+            pb2.ExecuteRequest(source_code="x", files={"/workspace/a": "bad/id"})
+        )
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+async def test_file_roundtrip(client):
+    resp = await client.execute(
+        pb2.ExecuteRequest(source_code="open('note.txt','w').write('hello from run 1')")
+    )
+    assert resp.exit_code == 0
+    object_id = resp.files["/workspace/note.txt"]
+
+    resp = await client.execute(
+        pb2.ExecuteRequest(
+            source_code="print(open('note.txt').read())",
+            files={"/workspace/note.txt": object_id},
+        )
+    )
+    assert resp.stdout == "hello from run 1\n"
+
+
+async def test_parse_custom_tool(client):
+    resp = await client.parse_tool(
+        pb2.ParseCustomToolRequest(
+            tool_source_code=(
+                'def greet(name: str) -> str:\n'
+                '    """Say hi.\n\n    :param name: who to greet\n    """\n'
+                '    return f"hi {name}"'
+            )
+        )
+    )
+    assert resp.WhichOneof("response") == "success"
+    assert resp.success.tool_name == "greet"
+    schema = json.loads(resp.success.tool_input_schema_json)
+    assert schema["properties"]["name"]["description"] == "who to greet"
+
+
+async def test_parse_custom_tool_error(client):
+    resp = await client.parse_tool(
+        pb2.ParseCustomToolRequest(tool_source_code="def f(**kw): pass")
+    )
+    assert resp.WhichOneof("response") == "error"
+    assert any("**kwargs" in m for m in resp.error.error_messages)
+
+
+async def test_execute_custom_tool(client):
+    resp = await client.execute_tool(
+        pb2.ExecuteCustomToolRequest(
+            tool_source_code="def add(a: int, b: int) -> int:\n    return a + b",
+            tool_input_json='{"a": 40, "b": 2}',
+        )
+    )
+    assert resp.WhichOneof("response") == "success"
+    assert json.loads(resp.success.tool_output_json) == 42
+
+
+async def test_execute_custom_tool_error(client):
+    resp = await client.execute_tool(
+        pb2.ExecuteCustomToolRequest(
+            tool_source_code="def div(a: int) -> float:\n    return a / 0",
+            tool_input_json='{"a": 1}',
+        )
+    )
+    assert resp.WhichOneof("response") == "error"
+    assert "division by zero" in resp.error.stderr
+
+
+async def test_health_service(client):
+    resp = await client.health_check(health_pb2.HealthCheckRequest())
+    assert resp.status == health_pb2.HealthCheckResponse.SERVING
+    resp = await client.health_check(health_pb2.HealthCheckRequest(service=SERVICE_NAME))
+    assert resp.status == health_pb2.HealthCheckResponse.SERVING
+    with pytest.raises(grpc.aio.AioRpcError) as e:
+        await client.health_check(health_pb2.HealthCheckRequest(service="nope"))
+    assert e.value.code() == grpc.StatusCode.NOT_FOUND
