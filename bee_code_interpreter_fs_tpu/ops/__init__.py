@@ -1,0 +1,1 @@
+"""TPU compute-path ops: the numpy dispatch shim and Pallas kernels."""

@@ -1,0 +1,481 @@
+"""Core of the numpy→jax.numpy dispatch shim: TpuArray + module builders.
+
+Dispatch policy (see package docstring): real numpy for small/structural work,
+XLA for big arrays. An operation goes to the device when any array argument is
+already a TpuArray, or when a creation/conversion produces at least
+``threshold`` elements.
+"""
+
+from __future__ import annotations
+
+import types
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as real_np
+
+# Ops where falling back to numpy is preferred for object/str dtypes etc.
+_FALLBACK_ERRORS = (TypeError, NotImplementedError)
+
+
+def _result_wrap(value):
+    if isinstance(value, jax.Array):
+        return TpuArray(value)
+    if isinstance(value, tuple):
+        return tuple(_result_wrap(v) for v in value)
+    if isinstance(value, list):
+        return [_result_wrap(v) for v in value]
+    return value
+
+
+def _unwrap_jnp(value):
+    """Convert shim-level values into jnp-compatible ones."""
+    if isinstance(value, TpuArray):
+        return value._arr
+    if isinstance(value, (tuple, list)):
+        return type(value)(_unwrap_jnp(v) for v in value)
+    return value
+
+
+def _unwrap_np(value):
+    """Convert shim-level values into host numpy ones (for fallback)."""
+    if isinstance(value, TpuArray):
+        return real_np.asarray(value._arr)
+    if isinstance(value, (tuple, list)):
+        return type(value)(_unwrap_np(v) for v in value)
+    return value
+
+
+def _contains_tpu_array(values) -> bool:
+    for v in values:
+        if isinstance(v, TpuArray):
+            return True
+        if isinstance(v, (tuple, list)) and _contains_tpu_array(v):
+            return True
+    return False
+
+
+class TpuArray:
+    """Device-resident array with an ndarray-like mutable surface.
+
+    Wraps an immutable ``jax.Array``; in-place mutation (``a[i] = v``,
+    ``a += b``) is implemented by functional ``.at[].set`` rebinding, which
+    XLA turns into in-place updates under jit and the donation rules.
+
+    Known divergence from numpy: slicing returns a COPY, not a view. Writes
+    through a slice (``b = a[:10]; b[0] = 5``) do not propagate to the parent
+    array. This is inherent to the functional device representation and is an
+    explicit contract of the shim.
+    """
+
+    __slots__ = ("_arr",)
+    # Make numpy defer binary ops to us (real_np.ndarray.__add__ would
+    # otherwise try to coerce us elementwise).
+    __array_priority__ = 1000
+
+    def __init__(self, arr) -> None:
+        if isinstance(arr, TpuArray):
+            arr = arr._arr
+        self._arr = arr if isinstance(arr, jax.Array) else jnp.asarray(arr)
+
+    # -- interop -----------------------------------------------------------
+    def __array__(self, dtype=None, copy=None):
+        host = real_np.asarray(self._arr)
+        return host.astype(dtype) if dtype is not None else host
+
+    def __jax_array__(self):
+        return self._arr
+
+    def block_until_ready(self):
+        self._arr.block_until_ready()
+        return self
+
+    @property
+    def device_array(self):
+        return self._arr
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def shape(self):
+        return self._arr.shape
+
+    @property
+    def dtype(self):
+        return real_np.dtype(self._arr.dtype)
+
+    @property
+    def ndim(self):
+        return self._arr.ndim
+
+    @property
+    def size(self):
+        return self._arr.size
+
+    @property
+    def nbytes(self):
+        return self._arr.nbytes
+
+    @property
+    def T(self):
+        return TpuArray(self._arr.T)
+
+    @property
+    def real(self):
+        return TpuArray(self._arr.real)
+
+    @property
+    def imag(self):
+        return TpuArray(self._arr.imag)
+
+    @property
+    def flat(self):
+        return iter(real_np.asarray(self._arr).flat)
+
+    # -- indexing ------------------------------------------------------------
+    def __getitem__(self, idx):
+        return _result_wrap(self._arr[_unwrap_jnp(idx)])
+
+    def __setitem__(self, idx, value):
+        self._arr = self._arr.at[_unwrap_jnp(idx)].set(_unwrap_jnp(value))
+
+    def __len__(self):
+        return len(self._arr)
+
+    def __iter__(self):
+        if self._arr.ndim == 0:
+            raise TypeError("iteration over a 0-d array")
+        if self._arr.ndim == 1:
+            # iterate on host: per-element device reads would be pathological
+            return iter(real_np.asarray(self._arr))
+        return (TpuArray(row) for row in self._arr)
+
+    # -- scalar coercion ------------------------------------------------------
+    def __bool__(self):
+        return bool(self._arr)
+
+    def __float__(self):
+        return float(self._arr)
+
+    def __int__(self):
+        return int(self._arr)
+
+    def __index__(self):
+        return int(self._arr)
+
+    def __complex__(self):
+        return complex(self._arr)
+
+    def __repr__(self):
+        return repr(real_np.asarray(self._arr)).replace("array(", "tpuarray(", 1)
+
+    def __format__(self, spec):
+        if self._arr.ndim == 0:
+            return format(self._arr.item(), spec)
+        return format(real_np.asarray(self._arr), spec)
+
+    def __hash__(self):
+        raise TypeError("unhashable type: 'TpuArray'")
+
+    # -- ndarray methods ------------------------------------------------------
+    def astype(self, dtype, **kwargs):
+        return TpuArray(self._arr.astype(dtype))
+
+    def copy(self):
+        return TpuArray(jnp.array(self._arr, copy=True))
+
+    def tolist(self):
+        return real_np.asarray(self._arr).tolist()
+
+    def item(self, *args):
+        return self._arr.item(*args)
+
+    def tobytes(self, order="C"):
+        return real_np.asarray(self._arr).tobytes(order)
+
+    def fill(self, value):
+        self._arr = jnp.full_like(self._arr, value)
+
+    def sort(self, axis=-1):
+        self._arr = jnp.sort(self._arr, axis=axis)
+
+    def __getattr__(self, name):
+        # Delegate the long tail (reshape, sum, mean, dot, ...) to the jax
+        # array, wrapping any array results.
+        attr = getattr(self._arr, name)
+        if callable(attr):
+
+            def method(*args, **kwargs):
+                return _result_wrap(
+                    attr(*_unwrap_jnp(list(args)), **{
+                        k: _unwrap_jnp(v) for k, v in kwargs.items()
+                    })
+                )
+
+            return method
+        return _result_wrap(attr)
+
+
+def _binop(name: str):
+    def op(self, other):
+        other_u = _unwrap_jnp(other)
+        try:
+            result = getattr(self._arr, name)(other_u)
+        except _FALLBACK_ERRORS:
+            return NotImplemented
+        if result is NotImplemented:
+            return NotImplemented
+        return _result_wrap(result)
+
+    op.__name__ = name
+    return op
+
+
+for _name in (
+    "__add__", "__radd__", "__sub__", "__rsub__", "__mul__", "__rmul__",
+    "__truediv__", "__rtruediv__", "__floordiv__", "__rfloordiv__",
+    "__mod__", "__rmod__", "__pow__", "__rpow__", "__matmul__", "__rmatmul__",
+    "__and__", "__rand__", "__or__", "__ror__", "__xor__", "__rxor__",
+    "__lshift__", "__rlshift__", "__rshift__", "__rrshift__",
+    "__lt__", "__le__", "__gt__", "__ge__", "__eq__", "__ne__",
+    "__divmod__", "__rdivmod__",
+):
+    setattr(TpuArray, _name, _binop(_name))
+
+for _name, _jnp_name in (
+    ("__neg__", "negative"),
+    ("__pos__", "positive"),
+    ("__abs__", "abs"),
+    ("__invert__", "invert"),
+):
+    def _unop(jnp_name):
+        def op(self):
+            return TpuArray(getattr(jnp, jnp_name)(self._arr))
+        return op
+    setattr(TpuArray, _name, _unop(_jnp_name))
+
+for _name in (
+    "__iadd__", "__isub__", "__imul__", "__itruediv__", "__ifloordiv__",
+    "__imod__", "__ipow__", "__iand__", "__ior__", "__ixor__",
+):
+    def _iop(base_name):
+        def op(self, other):
+            result = getattr(self, base_name)(other)
+            if result is NotImplemented:
+                return NotImplemented
+            self._arr = result._arr if isinstance(result, TpuArray) else jnp.asarray(result)
+            return self
+        return op
+    setattr(TpuArray, _name, _iop(_name.replace("__i", "__", 1)))
+
+
+# ---------------------------------------------------------------------------
+# Dispatching module functions
+
+# Compute functions overridden on the shim module. Everything else passes
+# through to real numpy untouched.
+CREATION_FNS = (
+    "zeros", "ones", "empty", "full", "arange", "linspace", "logspace",
+    "eye", "identity",
+)
+CONVERT_FNS = ("array", "asarray", "ascontiguousarray", "asfarray")
+LIKE_FNS = ("zeros_like", "ones_like", "empty_like", "full_like")
+COMPUTE_FNS = (
+    # elementwise
+    "add", "subtract", "multiply", "divide", "true_divide", "floor_divide",
+    "power", "sqrt", "cbrt", "square", "exp", "expm1", "log", "log1p", "log2",
+    "log10", "sin", "cos", "tan", "arcsin", "arccos", "arctan", "arctan2",
+    "sinh", "cosh", "tanh", "arcsinh", "arccosh", "arctanh", "abs",
+    "absolute", "fabs", "sign", "floor", "ceil", "rint", "trunc",
+    "clip", "maximum", "minimum", "fmax", "fmin", "where", "isnan", "isinf",
+    "isfinite", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "mod", "remainder", "hypot", "deg2rad", "rad2deg", "reciprocal", "exp2",
+    # reductions
+    "sum", "prod", "mean", "std", "var", "min", "max", "amin", "amax",
+    "argmin", "argmax", "median", "percentile", "quantile", "average",
+    "cumsum", "cumprod", "all", "any", "count_nonzero", "nansum", "nanmean",
+    "nanstd", "nanvar", "nanmin", "nanmax", "ptp",
+    # linear algebra / contraction
+    "dot", "vdot", "matmul", "inner", "outer", "tensordot", "einsum",
+    "trace", "kron", "cross",
+    # shape / rearrangement
+    "transpose", "reshape", "ravel", "concatenate", "stack", "vstack",
+    "hstack", "dstack", "column_stack", "split", "array_split", "tile",
+    "repeat", "expand_dims", "squeeze", "flip", "fliplr", "flipud", "roll",
+    "rot90", "swapaxes", "moveaxis", "broadcast_to", "pad", "take",
+    "take_along_axis", "searchsorted", "digitize",
+    # sorting / sets
+    "sort", "argsort", "partition", "argpartition", "unique", "diff",
+    "gradient", "convolve", "correlate", "interp", "histogram", "bincount",
+    "round", "around", "heaviside", "nan_to_num",
+    "real", "imag", "conj", "conjugate", "angle", "allclose", "isclose",
+    "array_equal", "triu", "tril", "diag", "diagonal", "meshgrid", "cov",
+    "corrcoef", "apply_along_axis", "atleast_1d", "atleast_2d", "atleast_3d",
+)
+
+
+def _shape_size(shape) -> int:
+    if isinstance(shape, (int, real_np.integer)):
+        return int(shape)
+    try:
+        size = 1
+        for dim in shape:
+            size *= int(dim)
+        return size
+    except TypeError:
+        return 0
+
+
+def _has_big_ndarray(values, threshold: int) -> bool:
+    """True if any (possibly list/tuple-nested) ndarray reaches the threshold."""
+    for v in values:
+        if isinstance(v, real_np.ndarray) and v.size >= threshold:
+            return True
+        if isinstance(v, (tuple, list)) and _has_big_ndarray(v, threshold):
+            return True
+    return False
+
+
+class _Dispatcher:
+    """Callable that routes one numpy function to jnp or real numpy.
+
+    Mirrors the wrapped numpy function's metadata (__name__, __doc__, …) —
+    libraries like scipy introspect numpy callables at import time.
+    """
+
+    def __init__(self, name, np_fn, jnp_fn, threshold, kind):
+        self.name = name
+        self.np_fn = np_fn
+        self.jnp_fn = jnp_fn
+        self.threshold = threshold
+        self.kind = kind
+        self.__name__ = getattr(np_fn, "__name__", name.rsplit(".", 1)[-1])
+        self.__qualname__ = self.__name__
+        self.__doc__ = getattr(np_fn, "__doc__", None)
+        self.__module__ = getattr(np_fn, "__module__", "numpy")
+        self.__wrapped__ = np_fn
+
+    def _use_device(self, args, kwargs) -> bool:
+        if self.jnp_fn is None:
+            return False
+        if self.kind == "creation":
+            shape = args[0] if args else kwargs.get("shape", kwargs.get("N", 0))
+            if self.name in ("arange", "linspace", "logspace"):
+                # arange(stop) / arange(start, stop[, step]) / linspace(a,b,n)
+                if self.name == "arange":
+                    if len(args) == 1:
+                        n = _shape_size(args[0])
+                    elif len(args) >= 2:
+                        try:
+                            step = args[2] if len(args) > 2 else 1
+                            n = int((args[1] - args[0]) / step)
+                        except Exception:  # noqa: BLE001
+                            n = 0
+                    else:
+                        n = 0
+                else:
+                    n = int(args[2]) if len(args) > 2 else int(kwargs.get("num", 50))
+                return n >= self.threshold
+            return _shape_size(shape) >= self.threshold
+        values = list(args) + list(kwargs.values())
+        if _contains_tpu_array(values):
+            return True
+        return _has_big_ndarray(values, self.threshold)
+
+    def __call__(self, *args, **kwargs):
+        if self._use_device(args, kwargs):
+            try:
+                result = self.jnp_fn(
+                    *_unwrap_jnp(list(args)),
+                    **{k: _unwrap_jnp(v) for k, v in kwargs.items()},
+                )
+                return _result_wrap(result)
+            except _FALLBACK_ERRORS:
+                pass  # e.g. object dtype, unsupported kwarg — use host numpy
+        return self.np_fn(
+            *_unwrap_np(list(args)), **{k: _unwrap_np(v) for k, v in kwargs.items()}
+        )
+
+    def __repr__(self):
+        return f"<tpu-dispatched numpy.{self.name}>"
+
+
+class _SubmoduleShim(types.ModuleType):
+    """Proxy for numpy.linalg / numpy.fft: jnp first for device arrays."""
+
+    def __init__(self, name, np_mod, jnp_mod, threshold):
+        super().__init__(name)
+        self._np_mod = np_mod
+        self._jnp_mod = jnp_mod
+        self._threshold = threshold
+        self._cache: dict[str, Any] = {}
+
+    def __getattr__(self, name):
+        if name.startswith("__"):
+            return getattr(self._np_mod, name)
+        if name in self._cache:
+            return self._cache[name]
+        np_attr = getattr(self._np_mod, name)
+        jnp_attr = getattr(self._jnp_mod, name, None)
+        if callable(np_attr) and jnp_attr is not None:
+            value = _Dispatcher(
+                f"{self.__name__}.{name}", np_attr, jnp_attr, self._threshold,
+                kind="compute",
+            )
+        else:
+            value = np_attr
+        self._cache[name] = value
+        return value
+
+
+class _NumpyShim(types.ModuleType):
+    """The module installed as ``numpy``. Structural attributes pass through;
+    compute attributes are replaced by dispatchers (built lazily, cached)."""
+
+    def __init__(self, threshold: int):
+        super().__init__("numpy")
+        self._threshold = threshold
+        self.__dict__["__doc__"] = real_np.__doc__
+        self.__dict__["__version__"] = real_np.__version__
+        self.__dict__["__file__"] = getattr(real_np, "__file__", None)
+        self.__dict__["__path__"] = getattr(real_np, "__path__", [])
+        self._overrides: dict[str, Any] = {}
+        self._build_overrides()
+
+    def _build_overrides(self):
+        threshold = self._threshold
+        for name in CREATION_FNS:
+            self._overrides[name] = _Dispatcher(
+                name, getattr(real_np, name), getattr(jnp, name, None), threshold,
+                kind="creation",
+            )
+        for name in CONVERT_FNS + LIKE_FNS + COMPUTE_FNS:
+            np_fn = getattr(real_np, name, None)
+            if np_fn is None:
+                continue
+            self._overrides[name] = _Dispatcher(
+                name, np_fn, getattr(jnp, name, None), threshold, kind="compute"
+            )
+        from .random import RandomShim
+
+        self._overrides["random"] = RandomShim(threshold)
+        self._overrides["linalg"] = _SubmoduleShim(
+            "numpy.linalg", real_np.linalg, jnp.linalg, threshold
+        )
+        self._overrides["fft"] = _SubmoduleShim(
+            "numpy.fft", real_np.fft, jnp.fft, threshold
+        )
+        # The wrapper type is exposed for explicit use / isinstance checks.
+        self._overrides["TpuArray"] = TpuArray
+
+    def __getattr__(self, name):
+        if name in self._overrides:
+            return self._overrides[name]
+        return getattr(real_np, name)
+
+    def __dir__(self):
+        return sorted(set(dir(real_np)) | set(self._overrides))
+
+
+def build_shim_module(threshold: int) -> _NumpyShim:
+    return _NumpyShim(threshold)

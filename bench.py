@@ -1,0 +1,128 @@
+"""Driver benchmark: the BASELINE.json headline metric through the full stack.
+
+Runs examples/benchmark-numpy.py (sum of squares over 1e8 random doubles) via
+a real Execute — orchestrator → pooled sandbox → C++ executor → warm JAX
+runner → numpy dispatch shim → XLA on whatever accelerator this machine
+exposes — and compares against a measured in-sandbox CPU/numpy baseline
+(dispatch shim off), i.e. exactly what the reference stack would do.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <TPU GFLOPS>, "unit": "GFLOPS", "vs_baseline": <x over CPU numpy>}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+import statistics
+import sys
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from bee_code_interpreter_fs_tpu.config import Config  # noqa: E402
+from bee_code_interpreter_fs_tpu.services.backends.local import (  # noqa: E402
+    LocalSandboxBackend,
+)
+from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor  # noqa: E402
+from bee_code_interpreter_fs_tpu.services.storage import Storage  # noqa: E402
+
+BENCH_SOURCE = (REPO_ROOT / "examples" / "benchmark-numpy.py").read_text()
+GFLOPS_RE = re.compile(r"GFLOPS=([0-9.]+)")
+
+
+async def run_gflops(dispatch: bool, runs: int, tmp: Path) -> tuple[float, dict]:
+    config = Config(
+        file_storage_path=str(tmp / f"storage-{dispatch}"),
+        local_sandbox_root=str(tmp / f"sb-{dispatch}"),
+        executor_pod_queue_target_length=1,
+        default_execution_timeout=600.0,
+        jax_compilation_cache_dir=str(tmp / "jax-cache"),
+    )
+    backend = LocalSandboxBackend(
+        config, warm_import_jax=dispatch, numpy_dispatch=dispatch
+    )
+    executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
+    try:
+        await executor.fill_pool()
+        best = 0.0
+        info: dict = {}
+        for i in range(runs):
+            t0 = time.perf_counter()
+            result = await executor.execute(BENCH_SOURCE, timeout=600.0)
+            elapsed = time.perf_counter() - t0
+            if result.exit_code != 0:
+                raise RuntimeError(f"bench execute failed: {result.stderr[-800:]}")
+            match = GFLOPS_RE.search(result.stdout)
+            if not match:
+                raise RuntimeError(f"no GFLOPS line in: {result.stdout[-400:]}")
+            gflops = float(match.group(1))
+            backend_line = next(
+                (l for l in result.stdout.splitlines() if l.startswith("backend:")),
+                "backend: ?",
+            )
+            info = {
+                "run": i,
+                "execute_wall_s": round(elapsed, 3),
+                "array_type": backend_line.split(":", 1)[1].strip(),
+                "phases": {k: round(v, 4) for k, v in result.phases.items()},
+            }
+            best = max(best, gflops)
+        return best, info
+    finally:
+        await executor.close()
+
+
+async def cold_start_p50(tmp: Path, samples: int = 5) -> float:
+    """Execute RPC latency with a warm pool (the p50 the user sees)."""
+    config = Config(
+        file_storage_path=str(tmp / "storage-lat"),
+        local_sandbox_root=str(tmp / "sb-lat"),
+        executor_pod_queue_target_length=2,
+        jax_compilation_cache_dir=str(tmp / "jax-cache"),
+    )
+    backend = LocalSandboxBackend(config, warm_import_jax=True, numpy_dispatch=True)
+    executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
+    try:
+        await executor.fill_pool()
+        latencies = []
+        for _ in range(samples):
+            t0 = time.perf_counter()
+            result = await executor.execute("print(21 * 2)")
+            latencies.append(time.perf_counter() - t0)
+            assert result.exit_code == 0
+            # let the refill task restore the pool before the next sample
+            await executor.fill_pool()
+        return statistics.median(latencies)
+    finally:
+        await executor.close()
+
+
+async def main() -> None:
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="bench-") as tmp_str:
+        tmp = Path(tmp_str)
+        tpu_gflops, tpu_info = await run_gflops(dispatch=True, runs=2, tmp=tmp)
+        cpu_gflops, _ = await run_gflops(dispatch=False, runs=1, tmp=tmp)
+        p50 = await cold_start_p50(tmp)
+
+    line = {
+        "metric": "benchmark-numpy.py GFLOPS/chip via Execute (1e8 sum-of-squares)",
+        "value": round(tpu_gflops, 3),
+        "unit": "GFLOPS",
+        "vs_baseline": round(tpu_gflops / cpu_gflops, 2) if cpu_gflops else None,
+        "extra": {
+            "cpu_numpy_gflops": round(cpu_gflops, 3),
+            "execute_p50_warm_pool_s": round(p50, 4),
+            "tpu_run": tpu_info,
+        },
+    }
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,26 @@
+"""Headline array benchmark (parity: reference examples/benchmark-numpy.py —
+sum of squares over 1e8 random doubles, self-reported wall clock).
+
+Submitted through Execute, the sandbox's numpy dispatch shim routes the array
+work onto the TPU; the printed GFLOPS is the BASELINE.json headline metric.
+"""
+
+import time
+
+import numpy as np
+
+N = 100_000_000
+
+t0 = time.perf_counter()
+a = np.random.rand(N)
+# float() forces device sync, so the timings below include materialization.
+_ = float(a[0])
+t1 = time.perf_counter()
+s = float((a * a).sum())
+t2 = time.perf_counter()
+
+flops = 2 * N  # one multiply + one add per element
+print(f"backend: {type(a).__name__}")
+print(f"sum(x*x) over {N:_} doubles = {s:.6f}")
+print(f"alloc_s={t1 - t0:.4f} compute_s={t2 - t1:.4f} total_s={t2 - t0:.4f}")
+print(f"GFLOPS={flops / (t2 - t1) / 1e9:.3f}")

@@ -1,0 +1,6 @@
+"""Nonzero-exit probe (parity: reference examples/crash.py)."""
+
+import sys
+
+print("about to crash")
+sys.exit(3)

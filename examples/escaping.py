@@ -1,0 +1,7 @@
+"""Quoting/escaping safety probe (parity: reference examples/escaping.py —
+the reference ran code via xonsh, where quoting was a real hazard; we run
+plain CPython, so this documents that gnarly strings survive unmangled)."""
+
+tricky = "quotes: ' \" backtick: ` dollar: $HOME newline-escape: \\n brace: {x}"
+print(tricky)
+print(f"f-string ok: {1 + 1}")

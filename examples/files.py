@@ -1,0 +1,15 @@
+"""Workspace filesystem probe (parity: reference examples/files.py family —
+write, list, read back)."""
+
+import os
+
+os.makedirs("out/nested", exist_ok=True)
+with open("out/nested/report.txt", "w") as f:
+    f.write("generated artifact\n")
+with open("top.txt", "w") as f:
+    f.write("top-level artifact\n")
+
+for root, _dirs, files in os.walk("."):
+    for name in sorted(files):
+        print(os.path.join(root, name))
+print(open("out/nested/report.txt").read().strip())

@@ -1,0 +1,11 @@
+"""Network egress probe (parity: reference examples/tcp.py). In a properly
+sandboxed deployment this should FAIL (no egress); locally it reports what it
+can reach."""
+
+import socket
+
+try:
+    with socket.create_connection(("1.1.1.1", 53), timeout=2):
+        print("egress: OPEN (tcp 1.1.1.1:53 reachable)")
+except OSError as e:
+    print(f"egress: BLOCKED ({e})")

@@ -1,0 +1,8 @@
+"""TPU visibility probe: what devices does user code see in the sandbox?"""
+
+import jax
+
+devices = jax.devices()
+print(f"backend={devices[0].platform if devices else 'none'} count={len(devices)}")
+for d in devices:
+    print(f"  {d.id}: {d.device_kind}")

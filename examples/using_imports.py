@@ -1,0 +1,17 @@
+"""Preinstalled scientific stack probe (parity: reference
+examples/using_imports.py — numpy/pandas/scipy t-test). Verifies the dispatch
+shim coexists with pandas and scipy.
+"""
+
+import numpy as np
+import pandas as pd
+from scipy import stats
+
+rng_a = np.random.normal(loc=5.0, scale=2.0, size=500)
+rng_b = np.random.normal(loc=5.5, scale=2.0, size=500)
+
+frame = pd.DataFrame({"a": np.asarray(rng_a), "b": np.asarray(rng_b)})
+t_stat, p_value = stats.ttest_ind(frame["a"], frame["b"])
+print(f"mean_a={frame['a'].mean():.3f} mean_b={frame['b'].mean():.3f}")
+print(f"t={float(t_stat):.3f} p={float(p_value):.4f}")
+print("ok")

@@ -13,8 +13,10 @@ import os
 import sys
 from pathlib import Path
 
-# Must happen before anything imports jax.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must happen before anything imports jax. Force (not default) CPU: the host
+# machine may pin JAX_PLATFORMS to a TPU plugin platform, but tests need the
+# virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

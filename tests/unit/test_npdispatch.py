@@ -1,0 +1,163 @@
+"""Tests for the numpy→jax.numpy dispatch shim (on the CPU JAX backend)."""
+
+import sys
+
+import pytest
+
+from bee_code_interpreter_fs_tpu.ops import npdispatch
+from bee_code_interpreter_fs_tpu.ops.npdispatch.shim import TpuArray
+
+THRESHOLD = 1000
+
+
+@pytest.fixture
+def np_shim():
+    npdispatch.install(threshold=THRESHOLD)
+    import numpy as np
+
+    yield np
+    npdispatch.uninstall()
+
+
+def test_install_replaces_module(np_shim):
+    import numpy
+
+    assert numpy is np_shim
+    assert sys.modules["numpy.random"] is np_shim.random
+    npdispatch.uninstall()
+    import numpy as real
+
+    assert hasattr(real, "ndarray") and not hasattr(real, "TpuArray")
+    npdispatch.install(threshold=THRESHOLD)  # fixture will uninstall again
+
+
+def test_small_arrays_stay_on_host(np_shim):
+    import numpy.random  # the shimmed submodule
+
+    small = np_shim.zeros(10)
+    assert type(small).__name__ == "ndarray"
+    r = numpy.random.rand(5)
+    assert type(r).__name__ == "ndarray"
+    assert isinstance(np_shim.sum(small), np_shim.floating)
+
+
+def test_big_arrays_go_to_device(np_shim):
+    big = np_shim.zeros(THRESHOLD * 2)
+    assert isinstance(big, TpuArray)
+    r = np_shim.random.rand(THRESHOLD * 2)
+    assert isinstance(r, TpuArray)
+    assert r.shape == (THRESHOLD * 2,)
+
+
+def test_benchmark_numpy_shape(np_shim):
+    # the reference's headline workload (examples/benchmark-numpy.py):
+    # sum of squares over random doubles
+    a = np_shim.random.rand(THRESHOLD * 10)
+    result = (a * a).sum()
+    assert isinstance(result, TpuArray)
+    value = float(result)
+    assert 0.25 * THRESHOLD * 10 < value < 0.42 * THRESHOLD * 10
+
+
+def test_matmul_and_einsum(np_shim):
+    a = np_shim.ones((64, 64))
+    b = np_shim.arange(64 * 128, dtype="float32").reshape(64, -1)
+    big = np_shim.asarray(b)
+    product = np_shim.matmul(np_shim.asarray(a), big)
+    assert isinstance(product, TpuArray)
+    reference = np_shim.einsum("ij,jk->ik", np_shim.asarray(a), big)
+    assert bool(np_shim.allclose(product, reference))
+
+
+def test_mutation_setitem(np_shim):
+    a = np_shim.zeros(THRESHOLD * 2)
+    a[3] = 7.0
+    a[10:20] = 1.0
+    assert float(a[3]) == 7.0
+    assert float(a.sum()) == 7.0 + 10.0
+    a += 1
+    assert float(a[0]) == 1.0
+    assert isinstance(a, TpuArray)
+
+
+def test_reductions_and_methods(np_shim):
+    a = np_shim.arange(THRESHOLD * 2, dtype="float32")
+    assert float(a.mean()) == pytest.approx((THRESHOLD * 2 - 1) / 2)
+    assert int(a.argmax()) == THRESHOLD * 2 - 1
+    assert a.reshape(2, -1).shape == (2, THRESHOLD)
+    assert isinstance(a.astype("int32"), TpuArray)
+    assert a.tolist()[:3] == [0.0, 1.0, 2.0]
+
+
+def test_mixed_host_device_ops(np_shim):
+    big = np_shim.ones(THRESHOLD * 2)
+    small_host = np_shim.zeros(1)  # real ndarray
+    out = big + 2.0
+    assert isinstance(out, TpuArray)
+    out2 = np_shim.maximum(big, 0.5)
+    assert isinstance(out2, TpuArray)
+    host = np_shim.asarray(small_host)
+    assert type(host).__name__ == "ndarray"
+
+
+def test_interop_with_real_numpy(np_shim):
+    big = np_shim.ones(THRESHOLD * 2)
+    host = big.__array__()  # explicit host materialization stays ndarray
+    assert type(host).__name__ == "ndarray"
+    assert host.sum() == THRESHOLD * 2
+    # numpy defers to TpuArray via __array_priority__
+    import numpy as np
+
+    mixed = np.float64(2.0) * big
+    assert isinstance(mixed, TpuArray)
+    assert float(mixed[0]) == 2.0
+
+
+def test_linalg_fft(np_shim):
+    a = np_shim.random.randn(THRESHOLD * 2)
+    norm = np_shim.linalg.norm(a)
+    assert isinstance(norm, TpuArray)
+    assert float(norm) > 0
+    spectrum = np_shim.fft.fft(a)
+    assert isinstance(spectrum, TpuArray)
+    assert spectrum.shape == a.shape
+
+
+def test_random_seeded_reproducible(np_shim):
+    np_shim.random.seed(42)
+    a = np_shim.random.rand(THRESHOLD * 2)
+    np_shim.random.seed(42)
+    b = np_shim.random.rand(THRESHOLD * 2)
+    assert bool(np_shim.allclose(a, b))
+    # distinct draws differ
+    c = np_shim.random.rand(THRESHOLD * 2)
+    assert not bool(np_shim.allclose(b, c))
+
+
+def test_structural_passthrough(np_shim):
+    assert np_shim.pi == pytest.approx(3.14159265)
+    assert np_shim.dtype("float32").itemsize == 4
+    assert np_shim.ndarray is sys.modules["numpy"].__getattr__("ndarray")
+    # object arrays fall back to host numpy without error
+    obj = np_shim.array(["a", "b"])
+    assert type(obj).__name__ == "ndarray"
+
+
+def test_sum_matches_numpy(np_shim):
+    import numpy  # the shim
+
+    data = list(range(THRESHOLD * 3))
+    device = np_shim.asarray(numpy.array(data, dtype="float64"))
+    host_total = sum(data)
+    assert float(device.sum()) == pytest.approx(host_total, rel=1e-6)
+
+
+def test_iteration_and_len(np_shim):
+    a = np_shim.arange(THRESHOLD * 2)
+    assert len(a) == THRESHOLD * 2
+    first_three = []
+    for value in a:
+        first_three.append(float(value))
+        if len(first_three) == 3:
+            break
+    assert first_three == [0.0, 1.0, 2.0]
