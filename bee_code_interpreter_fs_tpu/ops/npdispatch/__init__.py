@@ -42,10 +42,15 @@ def install(threshold: int | None = None) -> None:
         return
     import numpy as _real_numpy  # noqa: F401 — ensure real numpy is loaded first
 
-    if os.environ.get("APP_NUMPY_DISPATCH_X64", "0") not in ("0", "false", ""):
-        import jax
+    import jax
 
+    if os.environ.get("APP_NUMPY_DISPATCH_X64", "0") not in ("0", "false", ""):
         jax.config.update("jax_enable_x64", True)
+    # numpy users expect float32 matmuls to be float32: on TPU the MXU would
+    # otherwise run bf16 passes and round (e.g. 257.0 -> 256.0). "highest"
+    # keeps numpy-compatible accuracy; ops that want speed can opt down.
+    precision = os.environ.get("APP_NUMPY_DISPATCH_MATMUL_PRECISION", "highest")
+    jax.config.update("jax_default_matmul_precision", precision)
 
     from . import shim
 

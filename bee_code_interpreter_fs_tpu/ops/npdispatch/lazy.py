@@ -1,0 +1,294 @@
+"""Lazy fusion engine for the numpy dispatch shim.
+
+Eager op-at-a-time dispatch is the wrong shape for XLA: every op pays a
+dispatch/round-trip cost and materializes its output in HBM. This module makes
+TpuArray operations build an expression DAG instead; when a value is actually
+needed (float(), print, np.asarray, control flow), the whole graph is compiled
+ONCE by jax.jit into a single fused XLA computation and executed. Graphs with
+identical structure (same ops, statics, and leaf shapes/dtypes) share one
+compiled executable via a structure-keyed cache, and jit executables persist
+across sandbox processes through the JAX compilation cache.
+
+Effect: `a = np.random.rand(N); s = (a*a).sum(); float(s)` is one XLA
+execution instead of three, and re-running the same program shape skips
+tracing entirely.
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as real_np
+
+logger = logging.getLogger(__name__)
+
+# Cap on nodes in a single graph: beyond this, inputs are forced concrete so
+# unbounded program loops degrade to chunked fused executions, not OOM.
+MAX_GRAPH_NODES = 200
+
+_REF_NODE = 0
+_REF_LEAF = 1
+_REF_STATIC = 2
+
+
+class Node:
+    """One operation in the lazy DAG."""
+
+    __slots__ = ("op_name", "fn", "arg_refs", "kwargs", "aval", "n_nodes",
+                 "owners")
+
+    def __init__(self, op_name, fn, arg_refs, kwargs, aval, n_nodes):
+        self.op_name = op_name
+        self.fn = fn
+        # arg_refs: list of (kind, value) — kind NODE -> Node, LEAF -> jax/np
+        # array, STATIC -> hashable python value
+        self.arg_refs = arg_refs
+        self.kwargs = kwargs  # static-only
+        self.aval = aval  # jax.ShapeDtypeStruct
+        self.n_nodes = n_nodes
+        # weakrefs to TpuArrays currently backed by this node; when a graph
+        # containing this node materializes, their values are written back so
+        # user-held arrays become concrete instead of being recomputed by the
+        # next expression that uses them.
+        self.owners: list = []
+
+    def live_owners(self):
+        return [o for ref in self.owners if (o := ref()) is not None
+                and o._node is self]
+
+
+_MAX_STATIC_CONTAINER = 64
+
+
+def _static_ok(value) -> bool:
+    if isinstance(value, (int, float, bool, complex, str, bytes, type(None))):
+        return True
+    if isinstance(value, (tuple, list)):
+        # Big literal containers must become device leaves, not baked
+        # constants with megabyte repr() cache keys.
+        return len(value) <= _MAX_STATIC_CONTAINER and all(
+            _static_ok(v) for v in value
+        )
+    if isinstance(value, slice):
+        return _static_ok((value.start, value.stop, value.step))
+    if isinstance(value, (type, real_np.dtype)) or value is Ellipsis:
+        return True
+    if isinstance(value, real_np.generic):
+        return True
+    return False
+
+
+def _static_key(value) -> str:
+    # Type-qualified: python 2.0 and np.float64(2.0) repr identically but
+    # trace to different dtypes, so they must not share a cached runner.
+    if isinstance(value, (tuple, list)):
+        inner = ",".join(_static_key(v) for v in value)
+        return f"{type(value).__name__}({inner})"
+    return f"{type(value).__name__}:{value!r}"
+
+
+def build_node(op_name: str, fn: Callable, args, kwargs) -> Node | None:
+    """Try to create a lazy node; None means 'do it eagerly instead'.
+
+    `args` may contain TpuArray (lazy or concrete), jax/np arrays, and
+    statics. kwargs must be static.
+    """
+    from .shim import TpuArray
+
+    for v in kwargs.values():
+        if not _static_ok(v):
+            return None
+
+    arg_refs: list[tuple[int, Any]] = []
+    abstract_args = []
+    n_nodes = 1
+    for a in args:
+        if isinstance(a, TpuArray):
+            node = a._node
+            if node is not None:
+                arg_refs.append((_REF_NODE, node))
+                abstract_args.append(node.aval)
+                n_nodes += node.n_nodes
+            else:
+                arr = a._concrete
+                arg_refs.append((_REF_LEAF, arr))
+                abstract_args.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+        elif isinstance(a, (jax.Array, real_np.ndarray)):
+            arg_refs.append((_REF_LEAF, a))
+            abstract_args.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+        elif _static_ok(a):
+            arg_refs.append((_REF_STATIC, a))
+            abstract_args.append(a)
+        else:
+            return None
+
+    if n_nodes > MAX_GRAPH_NODES:
+        # Force child graphs concrete; retry with flat leaves.
+        new_args = []
+        for a in args:
+            if isinstance(a, TpuArray) and a._node is not None:
+                a._force()
+            new_args.append(a)
+        return build_node(op_name, fn, new_args, kwargs)
+
+    def abstract_call(*arrays):
+        it = iter(arrays)
+        call_args = [
+            next(it) if kind != _REF_STATIC else value
+            for kind, value in arg_refs
+        ]
+        return fn(*call_args, **kwargs)
+
+    arrays_only = [a for a in abstract_args if isinstance(a, jax.ShapeDtypeStruct)]
+    try:
+        aval = jax.eval_shape(abstract_call, *arrays_only)
+    except Exception:  # noqa: BLE001 — anything weird: run it eagerly
+        return None
+    if not isinstance(aval, jax.ShapeDtypeStruct):
+        return None  # multi-output ops stay eager
+    return Node(op_name, fn, arg_refs, kwargs, aval, n_nodes)
+
+
+# --------------------------------------------------------------------------
+# Materialization: linearize DAG -> structure key -> cached jitted runner.
+
+_exec_cache: dict[tuple, Callable] = {}
+_CACHE_LIMIT = 512
+
+
+def _linearize(root: Node):
+    """Topo-order the DAG; returns (spec, leaves, nodes, key).
+
+    spec: per node, (fn, [(kind, index_or_static)], kwargs)
+    leaves: deduped concrete arrays in first-seen order
+    nodes: the Node object at each spec index
+    key: structural tuple — equal keys guarantee the same spec shape.
+    """
+    node_index: dict[int, int] = {}
+    leaf_index: dict[int, int] = {}
+    leaves: list[Any] = []
+    nodes: list[Node] = []
+    spec: list[tuple] = []
+    key_parts: list[tuple] = []
+
+    def visit(node: Node) -> int:
+        idx = node_index.get(id(node))
+        if idx is not None:
+            return idx
+        refs = []
+        ref_keys = []
+        for kind, value in node.arg_refs:
+            if kind == _REF_NODE:
+                child = visit(value)
+                refs.append((_REF_NODE, child))
+                ref_keys.append(("n", child))
+            elif kind == _REF_LEAF:
+                li = leaf_index.get(id(value))
+                if li is None:
+                    li = len(leaves)
+                    leaf_index[id(value)] = li
+                    leaves.append(value)
+                refs.append((_REF_LEAF, li))
+                ref_keys.append(
+                    ("l", li, tuple(value.shape), str(value.dtype))
+                )
+            else:
+                refs.append((_REF_STATIC, value))
+                ref_keys.append(("s", _static_key(value)))
+        idx = len(spec)
+        node_index[id(node)] = idx
+        nodes.append(node)
+        spec.append((node.fn, refs, node.kwargs))
+        key_parts.append(
+            (node.op_name, tuple(ref_keys), _static_key(sorted(node.kwargs.items())))
+        )
+        return idx
+
+    visit(root)
+    return spec, leaves, nodes, tuple(key_parts)
+
+
+def _make_runner(spec, out_indices):
+    def run(leaves):
+        vals = []
+        for fn, refs, kwargs in spec:
+            args = [
+                vals[v] if kind == _REF_NODE
+                else leaves[v] if kind == _REF_LEAF
+                else v
+                for kind, v in refs
+            ]
+            vals.append(fn(*args, **kwargs))
+        return tuple(vals[i] for i in out_indices)
+
+    return run
+
+
+def materialize(root: Node) -> jax.Array:
+    spec, leaves, nodes, struct_key = _linearize(root)
+    root_idx = len(spec) - 1
+    # Besides the root, also emit any interior node some live TpuArray still
+    # points at: its owner gets the computed value written back, so user-held
+    # intermediates become concrete instead of being recomputed by the next
+    # expression that uses them. The writeback set shapes the compiled
+    # output tuple, so it is part of the cache key.
+    writebacks = []
+    for i, node in enumerate(nodes):
+        if i == root_idx:
+            continue
+        owners = node.live_owners()
+        if owners:
+            writebacks.append((i, owners))
+    out_indices = [root_idx] + [i for i, _ in writebacks]
+    key = (struct_key, tuple(out_indices))
+    runner = _exec_cache.get(key)
+    if runner is None:
+        if len(_exec_cache) >= _CACHE_LIMIT:
+            _exec_cache.clear()
+        runner = jax.jit(_make_runner(spec, out_indices))
+        _exec_cache[key] = runner
+    device_leaves = [
+        leaf if isinstance(leaf, jax.Array) else jnp.asarray(leaf)
+        for leaf in leaves
+    ]
+    outs = runner(device_leaves)
+    for (_, owners), value in zip(writebacks, outs[1:]):
+        for owner in owners:
+            owner._concrete = value
+            owner._node = None
+    return outs[0]
+
+
+# --------------------------------------------------------------------------
+# Op registry helpers used by the shim layer.
+
+# Op helpers. IMPORTANT: statics (indices, dtypes, shapes) must be passed as
+# ARGUMENTS, never captured in closures — only arguments enter the structure
+# key, and a cached runner is reused for any graph with an equal key.
+
+def getitem_op(arr, idx):
+    return arr[idx]
+
+
+def setitem_op(arr, value, idx):
+    return arr.at[idx].set(value)
+
+
+def astype_op(arr, dtype):
+    return arr.astype(dtype)
+
+
+def reshape_op(arr, shape):
+    return jnp.reshape(arr, shape)
+
+
+def random_uniform_op(key, shape):
+    return jax.random.uniform(key, shape)
+
+
+def random_normal_op(key, shape):
+    return jax.random.normal(key, shape)

@@ -20,7 +20,17 @@ import jax
 import jax.numpy as jnp
 import numpy as real_np
 
+from . import lazy
 from .shim import TpuArray, _shape_size
+
+
+def _lazy_draw(op_name, op, key, shape, *extra) -> TpuArray:
+    """Build a lazy node for a random draw; key is a concrete leaf, shape a
+    static arg (so it enters the structure key)."""
+    node = lazy.build_node(op_name, op, (key, shape, *extra), {})
+    if node is not None:
+        return TpuArray._from_node(node)
+    return TpuArray(op(key, shape, *extra))
 
 
 def _normalize_shape(size) -> tuple:
@@ -61,19 +71,24 @@ class RandomShim(types.ModuleType):
     # -- draws ---------------------------------------------------------------
     def rand(self, *shape):
         if self._big(shape):
-            return TpuArray(jax.random.uniform(self._next_key(), shape))
+            return _lazy_draw(
+                "random.uniform", lazy.random_uniform_op, self._next_key(), shape
+            )
         return real_np.random.rand(*shape)
 
     def randn(self, *shape):
         if self._big(shape):
-            return TpuArray(jax.random.normal(self._next_key(), shape))
+            return _lazy_draw(
+                "random.normal", lazy.random_normal_op, self._next_key(), shape
+            )
         return real_np.random.randn(*shape)
 
     def random(self, size=None):
         shape = _normalize_shape(size)
         if self._big(shape):
-            result = jax.random.uniform(self._next_key(), shape)
-            return TpuArray(result)
+            return _lazy_draw(
+                "random.uniform", lazy.random_uniform_op, self._next_key(), shape
+            )
         return real_np.random.random(size)
 
     random_sample = random

@@ -4,6 +4,12 @@ Dispatch policy (see package docstring): real numpy for small/structural work,
 XLA for big arrays. An operation goes to the device when any array argument is
 already a TpuArray, or when a creation/conversion produces at least
 ``threshold`` elements.
+
+Execution is LAZY (see lazy.py): device ops build an expression DAG and only
+run — as one fused, structure-cached jitted computation — when a concrete
+value is demanded (float(), print, np.asarray, bool(), iteration, host
+fallback). Shape/dtype/len are answered from abstract evaluation without
+running anything.
 """
 
 from __future__ import annotations
@@ -14,6 +20,8 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 import numpy as real_np
+
+from . import lazy
 
 # Ops where falling back to numpy is preferred for object/str dtypes etc.
 _FALLBACK_ERRORS = (TypeError, NotImplementedError)
@@ -30,7 +38,7 @@ def _result_wrap(value):
 
 
 def _unwrap_jnp(value):
-    """Convert shim-level values into jnp-compatible ones."""
+    """Convert shim-level values into jnp-compatible ones (forces lazy)."""
     if isinstance(value, TpuArray):
         return value._arr
     if isinstance(value, (tuple, list)):
@@ -56,12 +64,21 @@ def _contains_tpu_array(values) -> bool:
     return False
 
 
+def _has_big_ndarray(values, threshold: int) -> bool:
+    """True if any (possibly list/tuple-nested) ndarray reaches the threshold."""
+    for v in values:
+        if isinstance(v, real_np.ndarray) and v.size >= threshold:
+            return True
+        if isinstance(v, (tuple, list)) and _has_big_ndarray(v, threshold):
+            return True
+    return False
+
+
 class TpuArray:
     """Device-resident array with an ndarray-like mutable surface.
 
-    Wraps an immutable ``jax.Array``; in-place mutation (``a[i] = v``,
-    ``a += b``) is implemented by functional ``.at[].set`` rebinding, which
-    XLA turns into in-place updates under jit and the donation rules.
+    Holds either a concrete ``jax.Array`` or a lazy expression node; in-place
+    mutation (``a[i] = v``, ``a += b``) rebinds to a functional update node.
 
     Known divergence from numpy: slicing returns a COPY, not a view. Writes
     through a slice (``b = a[:10]; b[0] = 5``) do not propagate to the parent
@@ -69,15 +86,64 @@ class TpuArray:
     explicit contract of the shim.
     """
 
-    __slots__ = ("_arr",)
+    __slots__ = ("_concrete", "_node", "__weakref__")
     # Make numpy defer binary ops to us (real_np.ndarray.__add__ would
     # otherwise try to coerce us elementwise).
     __array_priority__ = 1000
 
     def __init__(self, arr) -> None:
+        self._node = None
         if isinstance(arr, TpuArray):
-            arr = arr._arr
-        self._arr = arr if isinstance(arr, jax.Array) else jnp.asarray(arr)
+            self._concrete = arr._concrete
+            if arr._node is not None:
+                self._set_node(arr._node)
+        elif isinstance(arr, lazy.Node):
+            self._concrete = None
+            self._set_node(arr)
+        elif isinstance(arr, jax.Array):
+            self._concrete = arr
+        else:
+            self._concrete = jnp.asarray(arr)
+
+    def _set_node(self, node: "lazy.Node") -> None:
+        import weakref
+
+        self._concrete = None
+        self._node = node
+        node.owners.append(weakref.ref(self))
+
+    @classmethod
+    def _from_node(cls, node: "lazy.Node") -> "TpuArray":
+        return cls(node)
+
+    def _force(self) -> jax.Array:
+        if self._concrete is None:
+            self._concrete = lazy.materialize(self._node)
+            self._node = None
+        return self._concrete
+
+    @property
+    def _arr(self) -> jax.Array:
+        return self._force()
+
+    @property
+    def _aval(self):
+        if self._node is not None:
+            return self._node.aval
+        return self._concrete
+
+    def _lazy_or_eager(self, op_name: str, fn: Callable, args, kwargs):
+        node = lazy.build_node(op_name, fn, args, kwargs)
+        if node is not None:
+            return TpuArray._from_node(node)
+        try:
+            result = fn(
+                *_unwrap_jnp(list(args)),
+                **{k: _unwrap_jnp(v) for k, v in kwargs.items()},
+            )
+        except _FALLBACK_ERRORS:
+            return NotImplemented
+        return _result_wrap(result)
 
     # -- interop -----------------------------------------------------------
     def __array__(self, dtype=None, copy=None):
@@ -88,45 +154,48 @@ class TpuArray:
         return self._arr
 
     def block_until_ready(self):
-        self._arr.block_until_ready()
+        self._force().block_until_ready()
         return self
 
     @property
     def device_array(self):
         return self._arr
 
-    # -- properties ---------------------------------------------------------
+    # -- properties (answered lazily from the aval) -------------------------
     @property
     def shape(self):
-        return self._arr.shape
+        return tuple(self._aval.shape)
 
     @property
     def dtype(self):
-        return real_np.dtype(self._arr.dtype)
+        return real_np.dtype(self._aval.dtype)
 
     @property
     def ndim(self):
-        return self._arr.ndim
+        return len(self._aval.shape)
 
     @property
     def size(self):
-        return self._arr.size
+        n = 1
+        for d in self._aval.shape:
+            n *= int(d)
+        return n
 
     @property
     def nbytes(self):
-        return self._arr.nbytes
+        return self.size * self.dtype.itemsize
 
     @property
     def T(self):
-        return TpuArray(self._arr.T)
+        return self._lazy_or_eager("transpose", jnp.transpose, (self,), {})
 
     @property
     def real(self):
-        return TpuArray(self._arr.real)
+        return self._lazy_or_eager("real", jnp.real, (self,), {})
 
     @property
     def imag(self):
-        return TpuArray(self._arr.imag)
+        return self._lazy_or_eager("imag", jnp.imag, (self,), {})
 
     @property
     def flat(self):
@@ -134,18 +203,34 @@ class TpuArray:
 
     # -- indexing ------------------------------------------------------------
     def __getitem__(self, idx):
+        # index as static argument when possible: keeps slicing lazy
+        if lazy._static_ok(idx):
+            node = lazy.build_node("getitem", lazy.getitem_op, (self, idx), {})
+            if node is not None:
+                return TpuArray._from_node(node)
         return _result_wrap(self._arr[_unwrap_jnp(idx)])
 
     def __setitem__(self, idx, value):
-        self._arr = self._arr.at[_unwrap_jnp(idx)].set(_unwrap_jnp(value))
+        if lazy._static_ok(idx):
+            node = lazy.build_node(
+                "setitem", lazy.setitem_op, (self, value, idx), {}
+            )
+            if node is not None:
+                self._set_node(node)
+                return
+        arr = self._force()
+        self._concrete = arr.at[_unwrap_jnp(idx)].set(_unwrap_jnp(value))
 
     def __len__(self):
-        return len(self._arr)
+        shape = self.shape
+        if not shape:
+            raise TypeError("len() of unsized object")
+        return int(shape[0])
 
     def __iter__(self):
-        if self._arr.ndim == 0:
+        if self.ndim == 0:
             raise TypeError("iteration over a 0-d array")
-        if self._arr.ndim == 1:
+        if self.ndim == 1:
             # iterate on host: per-element device reads would be pathological
             return iter(real_np.asarray(self._arr))
         return (TpuArray(row) for row in self._arr)
@@ -170,7 +255,7 @@ class TpuArray:
         return repr(real_np.asarray(self._arr)).replace("array(", "tpuarray(", 1)
 
     def __format__(self, spec):
-        if self._arr.ndim == 0:
+        if self.ndim == 0:
             return format(self._arr.item(), spec)
         return format(real_np.asarray(self._arr), spec)
 
@@ -179,7 +264,28 @@ class TpuArray:
 
     # -- ndarray methods ------------------------------------------------------
     def astype(self, dtype, **kwargs):
-        return TpuArray(self._arr.astype(dtype))
+        return self._lazy_or_eager("astype", lazy.astype_op, (self, dtype), {})
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return self._lazy_or_eager("reshape", lazy.reshape_op, (self, shape), {})
+
+    def transpose(self, *axes):
+        # numpy supports both a.transpose(1, 0) and a.transpose((1, 0))
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        kwargs = {"axes": axes} if axes else {}
+        result = self._lazy_or_eager("transpose", jnp.transpose, (self,), kwargs)
+        if result is NotImplemented:
+            raise TypeError("transpose failed on TpuArray")
+        return result
+
+    def __divmod__(self, other):
+        return _result_wrap(divmod(self._arr, _unwrap_jnp(other)))
+
+    def __rdivmod__(self, other):
+        return _result_wrap(divmod(_unwrap_jnp(other), self._arr))
 
     def copy(self):
         return TpuArray(jnp.array(self._arr, copy=True))
@@ -194,14 +300,18 @@ class TpuArray:
         return real_np.asarray(self._arr).tobytes(order)
 
     def fill(self, value):
-        self._arr = jnp.full_like(self._arr, value)
+        self.__setitem__(Ellipsis, value)
 
     def sort(self, axis=-1):
-        self._arr = jnp.sort(self._arr, axis=axis)
+        node = lazy.build_node("sort", jnp.sort, (self,), {"axis": axis})
+        if node is not None:
+            self._set_node(node)
+        else:
+            self._concrete = jnp.sort(self._force(), axis=axis)
 
     def __getattr__(self, name):
-        # Delegate the long tail (reshape, sum, mean, dot, ...) to the jax
-        # array, wrapping any array results.
+        # Delegate the long tail to the concrete jax array (forces the graph),
+        # wrapping any array results.
         attr = getattr(self._arr, name)
         if callable(attr):
 
@@ -216,31 +326,63 @@ class TpuArray:
         return _result_wrap(attr)
 
 
-def _binop(name: str):
-    def op(self, other):
-        other_u = _unwrap_jnp(other)
-        try:
-            result = getattr(self._arr, name)(other_u)
-        except _FALLBACK_ERRORS:
-            return NotImplemented
+# Lazily-dispatched ndarray methods (stay on device, stay lazy).
+def _lazy_method(np_name: str, jnp_fn):
+    def method(self, *args, **kwargs):
+        result = self._lazy_or_eager(np_name, jnp_fn, (self, *args), kwargs)
         if result is NotImplemented:
-            return NotImplemented
-        return _result_wrap(result)
+            raise TypeError(f"{np_name} failed on TpuArray")
+        return result
+
+    method.__name__ = np_name
+    return method
+
+
+for _name in (
+    "sum", "mean", "std", "var", "prod", "min", "max", "argmin", "argmax",
+    "cumsum", "cumprod", "all", "any", "clip", "round", "ravel", "squeeze",
+    "dot", "matmul", "conj", "flatten", "repeat", "take",
+    "trace", "swapaxes", "diagonal",
+):
+    _fn = getattr(jnp, _name, None)
+    if _fn is not None:
+        setattr(TpuArray, _name, _lazy_method(_name, _fn))
+
+
+def _binop(name: str, jnp_fn, swap: bool = False):
+    def op(self, other):
+        if isinstance(other, (list, tuple)):
+            # numpy semantics: array + [..] coerces; make it a device leaf
+            try:
+                other = jnp.asarray(other)
+            except (TypeError, ValueError):
+                return NotImplemented
+        if isinstance(other, (TpuArray, jax.Array, real_np.ndarray, int, float,
+                              bool, complex, real_np.generic)):
+            args = (other, self) if swap else (self, other)
+            result = self._lazy_or_eager(name, jnp_fn, args, {})
+            return result
+        return NotImplemented
 
     op.__name__ = name
     return op
 
 
-for _name in (
-    "__add__", "__radd__", "__sub__", "__rsub__", "__mul__", "__rmul__",
-    "__truediv__", "__rtruediv__", "__floordiv__", "__rfloordiv__",
-    "__mod__", "__rmod__", "__pow__", "__rpow__", "__matmul__", "__rmatmul__",
-    "__and__", "__rand__", "__or__", "__ror__", "__xor__", "__rxor__",
-    "__lshift__", "__rlshift__", "__rshift__", "__rrshift__",
-    "__lt__", "__le__", "__gt__", "__ge__", "__eq__", "__ne__",
-    "__divmod__", "__rdivmod__",
-):
-    setattr(TpuArray, _name, _binop(_name))
+_BINOPS = {
+    "__add__": jnp.add, "__sub__": jnp.subtract, "__mul__": jnp.multiply,
+    "__truediv__": jnp.true_divide, "__floordiv__": jnp.floor_divide,
+    "__mod__": jnp.mod, "__pow__": jnp.power, "__matmul__": jnp.matmul,
+    "__and__": jnp.bitwise_and, "__or__": jnp.bitwise_or,
+    "__xor__": jnp.bitwise_xor, "__lshift__": jnp.left_shift,
+    "__rshift__": jnp.right_shift, "__lt__": jnp.less,
+    "__le__": jnp.less_equal, "__gt__": jnp.greater,
+    "__ge__": jnp.greater_equal, "__eq__": jnp.equal, "__ne__": jnp.not_equal,
+}
+for _name, _fn in _BINOPS.items():
+    setattr(TpuArray, _name, _binop(_name, _fn))
+    reflected = "__r" + _name[2:]
+    if _name not in ("__lt__", "__le__", "__gt__", "__ge__", "__eq__", "__ne__"):
+        setattr(TpuArray, reflected, _binop(reflected, _fn, swap=True))
 
 for _name, _jnp_name in (
     ("__neg__", "negative"),
@@ -249,8 +391,13 @@ for _name, _jnp_name in (
     ("__invert__", "invert"),
 ):
     def _unop(jnp_name):
+        fn = getattr(jnp, jnp_name)
+
         def op(self):
-            return TpuArray(getattr(jnp, jnp_name)(self._arr))
+            result = self._lazy_or_eager(jnp_name, fn, (self,), {})
+            if result is NotImplemented:
+                raise TypeError(f"{jnp_name} failed on TpuArray")
+            return result
         return op
     setattr(TpuArray, _name, _unop(_jnp_name))
 
@@ -263,7 +410,13 @@ for _name in (
             result = getattr(self, base_name)(other)
             if result is NotImplemented:
                 return NotImplemented
-            self._arr = result._arr if isinstance(result, TpuArray) else jnp.asarray(result)
+            if isinstance(result, TpuArray):
+                if result._node is not None:
+                    self._set_node(result._node)
+                else:
+                    self._concrete, self._node = result._concrete, None
+            else:
+                self._concrete, self._node = jnp.asarray(result), None
             return self
         return op
     setattr(TpuArray, _name, _iop(_name.replace("__i", "__", 1)))
@@ -278,7 +431,7 @@ CREATION_FNS = (
     "zeros", "ones", "empty", "full", "arange", "linspace", "logspace",
     "eye", "identity",
 )
-CONVERT_FNS = ("array", "asarray", "ascontiguousarray", "asfarray")
+CONVERT_FNS = ("array", "asarray", "ascontiguousarray")
 LIKE_FNS = ("zeros_like", "ones_like", "empty_like", "full_like")
 COMPUTE_FNS = (
     # elementwise
@@ -313,6 +466,11 @@ COMPUTE_FNS = (
     "corrcoef", "apply_along_axis", "atleast_1d", "atleast_2d", "atleast_3d",
 )
 
+# Functions whose results are scalars/bools used in control flow — keep eager
+# (lazy would immediately force anyway, with extra tracing overhead).
+_EAGER_ONLY = {"allclose", "array_equal", "histogram", "meshgrid", "unique",
+               "split", "array_split"}
+
 
 def _shape_size(shape) -> int:
     if isinstance(shape, (int, real_np.integer)):
@@ -326,18 +484,8 @@ def _shape_size(shape) -> int:
         return 0
 
 
-def _has_big_ndarray(values, threshold: int) -> bool:
-    """True if any (possibly list/tuple-nested) ndarray reaches the threshold."""
-    for v in values:
-        if isinstance(v, real_np.ndarray) and v.size >= threshold:
-            return True
-        if isinstance(v, (tuple, list)) and _has_big_ndarray(v, threshold):
-            return True
-    return False
-
-
 class _Dispatcher:
-    """Callable that routes one numpy function to jnp or real numpy.
+    """Callable that routes one numpy function to jnp (lazily) or real numpy.
 
     Mirrors the wrapped numpy function's metadata (__name__, __doc__, …) —
     libraries like scipy introspect numpy callables at import time.
@@ -349,6 +497,7 @@ class _Dispatcher:
         self.jnp_fn = jnp_fn
         self.threshold = threshold
         self.kind = kind
+        self.lazy_ok = name.rsplit(".", 1)[-1] not in _EAGER_ONLY
         self.__name__ = getattr(np_fn, "__name__", name.rsplit(".", 1)[-1])
         self.__qualname__ = self.__name__
         self.__doc__ = getattr(np_fn, "__doc__", None)
@@ -361,7 +510,6 @@ class _Dispatcher:
         if self.kind == "creation":
             shape = args[0] if args else kwargs.get("shape", kwargs.get("N", 0))
             if self.name in ("arange", "linspace", "logspace"):
-                # arange(stop) / arange(start, stop[, step]) / linspace(a,b,n)
                 if self.name == "arange":
                     if len(args) == 1:
                         n = _shape_size(args[0])
@@ -384,6 +532,10 @@ class _Dispatcher:
 
     def __call__(self, *args, **kwargs):
         if self._use_device(args, kwargs):
+            if self.lazy_ok:
+                node = lazy.build_node(self.name, self.jnp_fn, args, kwargs)
+                if node is not None:
+                    return TpuArray._from_node(node)
             try:
                 result = self.jnp_fn(
                     *_unwrap_jnp(list(args)),

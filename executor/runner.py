@@ -46,6 +46,12 @@ def _warm_import() -> dict:
 
         if cache_dir:
             jax.config.update("jax_compilation_cache_dir", cache_dir)
+            # Persist every kernel: the default 1s min-compile-time filter
+            # would skip most eager-op kernels, so fresh sandboxes would
+            # recompile everything and the pool's cache amortization
+            # (SURVEY.md §7 hard part #2) would never engage.
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         devices = jax.devices()
         info["backend"] = devices[0].platform if devices else "none"
         info["device_count"] = len(devices)

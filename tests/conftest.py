@@ -17,6 +17,14 @@ from pathlib import Path
 # machine may pin JAX_PLATFORMS to a TPU plugin platform, but tests need the
 # virtual 8-device CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The TPU plugin's own sitecustomize may have already pinned the platform via
+# jax.config (which beats the env var) — override it back, and strip the
+# plugin's trigger env so sandbox subprocesses spawned by e2e tests also run
+# on CPU.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
