@@ -1,0 +1,27 @@
+"""Model payloads shipped with the framework.
+
+The reference ships no model code (SURVEY.md §2 census) — but its BASELINE
+configs 3–5 (MNIST train, ICI allreduce, Llama-class inference through
+Execute) need a real model to exercise the TPU path, and the framework's own
+capstone benchmark payloads live here rather than being pasted into test
+strings. Everything is pure JAX (jit/NamedSharding/shard_map), bfloat16 on
+the matmul path, static shapes.
+"""
+
+from bee_code_interpreter_fs_tpu.models.llama import (
+    LlamaConfig,
+    init_params,
+    forward,
+    loss_fn,
+    make_train_step,
+    param_specs,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "make_train_step",
+    "param_specs",
+]

@@ -1,0 +1,43 @@
+"""TPU parallelism toolkit: mesh construction, sharding rules, collectives.
+
+The reference framework has no distributed compute at all (SURVEY.md §2
+parallelism census) — its only "parallelism" is asyncio request concurrency.
+For a TPU-native code interpreter, multi-chip is first-class: sandboxes are
+scheduled onto TPU slices (chip_count pool lanes), and the runtime inside the
+sandbox pre-establishes a device mesh so both user code and the framework's
+own model payloads (models/) run SPMD over ICI.
+
+Everything here is pure JAX: `jax.sharding.Mesh` + NamedSharding + shard_map,
+with XLA inserting the collectives. No NCCL/MPI — ICI/DCN routing is XLA's
+job once shardings are laid out.
+"""
+
+from bee_code_interpreter_fs_tpu.parallel.mesh import (
+    MeshSpec,
+    best_mesh_shape,
+    make_mesh,
+)
+from bee_code_interpreter_fs_tpu.parallel.sharding import (
+    named_sharding,
+    shard_pytree,
+)
+from bee_code_interpreter_fs_tpu.parallel.collectives import (
+    all_gather,
+    all_reduce_mean,
+    all_reduce_sum,
+    ring_permute,
+)
+from bee_code_interpreter_fs_tpu.parallel.ring_attention import ring_attention
+
+__all__ = [
+    "MeshSpec",
+    "best_mesh_shape",
+    "make_mesh",
+    "named_sharding",
+    "shard_pytree",
+    "all_gather",
+    "all_reduce_mean",
+    "all_reduce_sum",
+    "ring_permute",
+    "ring_attention",
+]

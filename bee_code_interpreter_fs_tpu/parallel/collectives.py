@@ -1,0 +1,40 @@
+"""Named-axis collectives for use inside shard_map'd functions.
+
+Wrappers over `jax.lax` primitives so framework code (and user payloads that
+import this package inside the sandbox) speak one vocabulary. XLA lowers
+these to ICI collectives on TPU slices; on the CPU test mesh they execute via
+the host transfer layer with identical semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_reduce_sum(x, axis: str):
+    return lax.psum(x, axis_name=axis)
+
+
+def all_reduce_mean(x, axis: str):
+    return lax.pmean(x, axis_name=axis)
+
+
+def all_gather(x, axis: str, *, tiled: bool = True, gather_axis: int = 0):
+    return lax.all_gather(x, axis_name=axis, axis=gather_axis, tiled=tiled)
+
+
+def ring_permute(x, axis: str, *, shift: int = 1):
+    """Send this shard to the next device on `axis` (ring topology).
+
+    perm[i] -> (i + shift) % n: the building block of ring attention and
+    ring all-reduce; on TPU this is a single neighbor-ICI hop per step.
+    """
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)

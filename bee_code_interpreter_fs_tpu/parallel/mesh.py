@@ -1,0 +1,99 @@
+"""Device-mesh construction.
+
+TPU slices have a physical ICI topology (e.g. v5e-4 is a 2x2 ring); mapping
+logical mesh axes onto it well decides whether collectives ride neighbor ICI
+links or bounce across the slice. `jax.experimental.mesh_utils`'s
+`create_device_mesh` knows the TPU topologies, so we delegate to it and only
+solve the layer above: choosing a logical shape (dp, sp, tp) for a given
+device count, and naming the axes consistently across the framework.
+
+Axis conventions (used by models/ and __graft_entry__):
+  dp — data parallel: batch is split, gradients all-reduced.
+  sp — sequence/context parallel: sequence dimension split (ring attention).
+  tp — tensor parallel: attention heads / MLP hidden split, activations
+       all-reduced per block.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXES = ("dp", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """A logical mesh shape over named axes (order matters: ICI-nearest last)."""
+
+    shape: tuple[int, ...]
+    axes: tuple[str, ...] = AXES
+
+    def __post_init__(self):
+        if len(self.shape) != len(self.axes):
+            raise ValueError(f"shape {self.shape} vs axes {self.axes}")
+
+    @property
+    def n_devices(self) -> int:
+        return int(np.prod(self.shape))
+
+
+def best_mesh_shape(
+    n_devices: int,
+    *,
+    tp: int | None = None,
+    sp: int | None = None,
+) -> MeshSpec:
+    """Pick a (dp, sp, tp) factorization of n_devices.
+
+    Heuristic: tp wants the ICI-nearest (fastest, last) axis and benefits most
+    up to the MXU-efficient head count, so give tp the largest power-of-two
+    factor <= 4 unless pinned; sp defaults to 1 unless pinned; dp absorbs the
+    rest. All axes must divide n_devices.
+    """
+    if n_devices < 1:
+        raise ValueError("n_devices must be >= 1")
+    if tp is None:
+        tp = 1
+        for cand in (4, 2):
+            if n_devices % cand == 0:
+                tp = cand
+                break
+    if n_devices % tp != 0:
+        raise ValueError(f"tp={tp} does not divide n_devices={n_devices}")
+    rest = n_devices // tp
+    if sp is None:
+        sp = 1
+    if rest % sp != 0:
+        raise ValueError(f"sp={sp} does not divide n_devices/tp={rest}")
+    dp = rest // sp
+    return MeshSpec(shape=(dp, sp, tp))
+
+
+def make_mesh(
+    spec: MeshSpec | None = None,
+    *,
+    n_devices: int | None = None,
+    devices=None,
+) -> Mesh:
+    """Build a `jax.sharding.Mesh` from a spec (or a device count).
+
+    `create_device_mesh` handles the physical->logical assignment: on TPU it
+    orders devices so the last mesh axis lands on nearest-neighbor ICI; on CPU
+    (tests, driver dry-run) it is a plain reshape.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if spec is None:
+        spec = best_mesh_shape(n_devices if n_devices is not None else len(devices))
+    if spec.n_devices > len(devices):
+        raise ValueError(
+            f"mesh needs {spec.n_devices} devices, only {len(devices)} present"
+        )
+    devices = devices[: spec.n_devices]
+    device_array = mesh_utils.create_device_mesh(spec.shape, devices=devices)
+    return Mesh(device_array, spec.axes)

@@ -1,0 +1,78 @@
+"""Ring attention: exact causal attention over a sequence-sharded axis.
+
+Long-context support (SURVEY.md §5 "long-context" row; first-class here even
+though the reference has no model code). The sequence dimension is sharded
+over the mesh's `sp` axis; each device holds its local Q chunk and streams
+K/V chunks around the ring with `ppermute` — one neighbor-ICI hop per step —
+accumulating flash-style online softmax. Memory per device is O(T/n · T/n)
+per step instead of O(T²); comms overlap naturally because XLA schedules the
+ppermute of step i+1 against the matmul of step i.
+
+Called inside `shard_map` with q/k/v already local chunks:
+    out = ring_attention(q, k, v, axis_name="sp")   # [B, Tc, H, D] each
+
+Reference pattern: Liu et al., "Ring Attention with Blockwise Transformers"
+(PAPERS.md); implementation is original, built on lax.ppermute/fori_loop.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30  # finite mask value: keeps online-softmax max finite everywhere
+
+
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, scale=None):
+    """Exact attention where q, k, v are per-device sequence chunks.
+
+    Args:
+      q, k, v: [batch, chunk_len, heads, head_dim] local shards.
+      axis_name: mesh axis the sequence is sharded over.
+      causal: apply a global causal mask (positions are global, computed from
+        the device's ring index).
+      scale: softmax scale; defaults to head_dim**-0.5.
+
+    Returns local output chunk [batch, chunk_len, heads, head_dim].
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+
+    q_pos = my * t + jnp.arange(t)  # global positions of local queries
+
+    def step(i, carry):
+        kc, vc, acc, m, l = carry
+        # K/V chunk currently held was originated by device (my - i) mod n.
+        src = (my - i) % n
+        k_pos = src * t + jnp.arange(t)
+
+        # [b, h, tq, tk]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kc) * scale
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None, :, :], s, _NEG)
+
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        acc = acc * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vc)
+        l = l * corr + p.sum(axis=-1)
+
+        # Rotate K/V to the next device; shift every step including the last
+        # so chunks end where they started (keeps the loop-carried shape story
+        # simple; XLA elides nothing here but it is one tiny extra hop).
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return kc, vc, acc, m_new, l
+
+    acc0 = jnp.zeros((b, h, t, d), q.dtype)
+    m0 = jnp.full((b, h, t), _NEG, q.dtype)
+    l0 = jnp.zeros((b, h, t), q.dtype)
+    _, _, acc, _, l = lax.fori_loop(0, n, step, (k, v, acc0, m0, l0))
+
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3)  # -> [b, t, h, d]

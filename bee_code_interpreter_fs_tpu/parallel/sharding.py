@@ -1,0 +1,33 @@
+"""Sharding helpers: PartitionSpec plumbing over named meshes.
+
+Thin on purpose — NamedSharding + jit's in_shardings/out_shardings IS the
+TPU-native distribution mechanism; there is nothing to hand-schedule. These
+helpers only remove the boilerplate of pairing a mesh with pytrees of
+PartitionSpecs.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    """`named_sharding(mesh, "dp", None)` -> NamedSharding(mesh, P("dp", None))."""
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_pytree(mesh: Mesh, tree, specs):
+    """Device-put a pytree with a matching pytree of PartitionSpecs.
+
+    `specs` may be a single PartitionSpec (applied to every leaf) or a pytree
+    with the same structure as `tree`.
+    """
+    if isinstance(specs, P):
+        return jax.device_put(tree, NamedSharding(mesh, specs))
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        tree,
+        specs,
+        is_leaf=lambda x: x is None,
+    )

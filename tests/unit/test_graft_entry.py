@@ -1,0 +1,23 @@
+"""__graft_entry__: the driver's compile checks must pass in-repo too."""
+
+import jax
+
+import __graft_entry__ as ge
+
+
+def test_entry_compiles_and_runs():
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[0] == args[1].shape[0]
+
+
+def test_dryrun_multichip_8():
+    ge.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_4():
+    ge.dryrun_multichip(4)
+
+
+def test_dryrun_multichip_1():
+    ge.dryrun_multichip(1)

@@ -1,0 +1,86 @@
+"""parallel/: mesh factorization, sharding helpers, ring attention vs the
+plain-attention oracle on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from functools import partial
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from bee_code_interpreter_fs_tpu.parallel import (
+    best_mesh_shape,
+    make_mesh,
+    ring_attention,
+    shard_pytree,
+)
+from bee_code_interpreter_fs_tpu.models.llama import _plain_causal_attention
+
+
+def test_best_mesh_shape_factors():
+    assert best_mesh_shape(8).shape == (2, 1, 4)
+    assert best_mesh_shape(8, tp=2, sp=2).shape == (2, 2, 2)
+    assert best_mesh_shape(1).shape == (1, 1, 1)
+    assert best_mesh_shape(6, tp=2).shape == (3, 1, 2)
+    with pytest.raises(ValueError):
+        best_mesh_shape(8, tp=3)
+
+
+def test_make_mesh_axes():
+    mesh = make_mesh(best_mesh_shape(8, tp=2, sp=2))
+    assert mesh.shape == {"dp": 2, "sp": 2, "tp": 2}
+    assert len(mesh.devices.flatten()) == 8
+
+
+def test_shard_pytree_places_shards():
+    mesh = make_mesh(best_mesh_shape(8, tp=2, sp=2))
+    tree = {"a": jnp.arange(16.0).reshape(4, 4), "b": jnp.ones((8,))}
+    specs = {"a": P("dp", "tp"), "b": P(None)}
+    out = shard_pytree(mesh, tree, specs)
+    assert out["a"].sharding.spec == P("dp", "tp")
+    np.testing.assert_allclose(out["a"], tree["a"])
+
+
+def test_ring_attention_matches_plain():
+    """Exact match (fp32) against single-device causal attention."""
+    mesh = make_mesh(best_mesh_shape(8, tp=2, sp=2))
+    b, t, h, d = 2, 32, 4, 8
+    key = jax.random.PRNGKey(1)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, t, h, d), jnp.float32)
+    v = jax.random.normal(kv, (b, t, h, d), jnp.float32)
+
+    expected = _plain_causal_attention(q, k, v, d ** -0.5)
+
+    ring = shard_map(
+        partial(ring_attention, axis_name="sp"),
+        mesh=mesh,
+        in_specs=(P("dp", "sp", "tp", None),) * 3,
+        out_specs=P("dp", "sp", "tp", None),
+        check_rep=False,
+    )
+    got = jax.jit(ring)(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_sp4():
+    """Different ring size (sp=4) still exact."""
+    mesh = make_mesh(best_mesh_shape(8, tp=1, sp=4))
+    b, t, h, d = 2, 64, 2, 4
+    key = jax.random.PRNGKey(2)
+    q, k, v = (jax.random.normal(s, (b, t, h, d), jnp.float32)
+               for s in jax.random.split(key, 3))
+    expected = _plain_causal_attention(q, k, v, d ** -0.5)
+    ring = shard_map(
+        partial(ring_attention, axis_name="sp"),
+        mesh=mesh,
+        in_specs=(P("dp", "sp", None, None),) * 3,
+        out_specs=P("dp", "sp", None, None),
+        check_rep=False,
+    )
+    got = jax.jit(ring)(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
