@@ -105,19 +105,33 @@ def build_node(op_name: str, fn: Callable, args, kwargs) -> Node | None:
 
     arg_refs: list[tuple[int, Any]] = []
     abstract_args = []
-    n_nodes = 1
+    host_memo: dict[int, Any] = {}  # id(host ndarray) -> device snapshot,
+    # so np.op(h, h) still dedupes to one leaf/transfer after snapshotting
     for a in args:
         if isinstance(a, TpuArray):
             node = a._node
             if node is not None:
                 arg_refs.append((_REF_NODE, node))
                 abstract_args.append(node.aval)
-                n_nodes += node.n_nodes
             else:
                 arr = a._concrete
                 arg_refs.append((_REF_LEAF, arr))
                 abstract_args.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
         elif isinstance(a, (jax.Array, real_np.ndarray)):
+            if isinstance(a, real_np.ndarray):
+                # numpy semantics read the value at CALL time; the graph runs
+                # later, so in-place mutation of the caller's array between
+                # build and forcing must not leak in. Snapshot by transferring
+                # to device now — same move materialize() would do anyway, so
+                # it costs nothing extra and keeps id-based leaf dedup intact
+                # for repeated operands.
+                cached = host_memo.get(id(a))
+                if cached is None:
+                    try:
+                        cached = host_memo[id(a)] = jnp.asarray(a)
+                    except (TypeError, ValueError):
+                        return None  # e.g. object dtype: run eagerly instead
+                a = cached
             arg_refs.append((_REF_LEAF, a))
             abstract_args.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
         elif _static_ok(a):
@@ -125,6 +139,19 @@ def build_node(op_name: str, fn: Callable, args, kwargs) -> Node | None:
             abstract_args.append(a)
         else:
             return None
+
+    # Unique-node count: shared subexpressions (diamonds, x+x chains) count
+    # once, matching what actually gets compiled — per-reference summing
+    # would inflate exponentially and force early materializations.
+    seen: set[int] = set()
+    stack = [v for kind, v in arg_refs if kind == _REF_NODE]
+    while stack:
+        nd = stack.pop()
+        if id(nd) in seen:
+            continue
+        seen.add(id(nd))
+        stack.extend(v for kind, v in nd.arg_refs if kind == _REF_NODE)
+    n_nodes = 1 + len(seen)
 
     if n_nodes > MAX_GRAPH_NODES:
         # Force child graphs concrete; retry with flat leaves.

@@ -20,16 +20,16 @@ import jax
 import jax.numpy as jnp
 import numpy as real_np
 
-from . import lazy
+from . import lazy, shim
 from .shim import TpuArray, _shape_size
 
 
 def _lazy_draw(op_name, op, key, shape, *extra) -> TpuArray:
     """Build a lazy node for a random draw; key is a concrete leaf, shape a
     static arg (so it enters the structure key)."""
-    node = lazy.build_node(op_name, op, (key, shape, *extra), {})
-    if node is not None:
-        return TpuArray._from_node(node)
+    result = shim.try_lazy(op_name, op, (key, shape, *extra), {})
+    if result is not None:
+        return result
     return TpuArray(op(key, shape, *extra))
 
 
@@ -142,7 +142,9 @@ class RandomShim(types.ModuleType):
 
     def shuffle(self, x):
         if isinstance(x, TpuArray):
-            x._arr = jax.random.permutation(self._next_key(), x._arr)
+            # In-place contract: rebind the array's backing value.
+            x._concrete = jax.random.permutation(self._next_key(), x._arr)
+            x._node = None
             return None
         return real_np.random.shuffle(x)
 

@@ -55,6 +55,30 @@ def _unwrap_np(value):
     return value
 
 
+def try_lazy(op_name, fn, args, kwargs):
+    """Build a lazy node for this op; None means 'not lazily representable'.
+
+    The single lazy/eager handoff point shared by TpuArray methods, the
+    module-level _Dispatcher, and random draws — fixes to the handoff apply
+    everywhere at once.
+    """
+    node = lazy.build_node(op_name, fn, args, kwargs)
+    return TpuArray._from_node(node) if node is not None else None
+
+
+def eager_device(fn, args, kwargs):
+    """Run the jnp op eagerly on device; NotImplemented on fallback errors
+    (object dtype, unsupported kwarg, ...) so callers can try host numpy."""
+    try:
+        result = fn(
+            *_unwrap_jnp(list(args)),
+            **{k: _unwrap_jnp(v) for k, v in kwargs.items()},
+        )
+    except _FALLBACK_ERRORS:
+        return NotImplemented
+    return _result_wrap(result)
+
+
 def _contains_tpu_array(values) -> bool:
     for v in values:
         if isinstance(v, TpuArray):
@@ -133,17 +157,10 @@ class TpuArray:
         return self._concrete
 
     def _lazy_or_eager(self, op_name: str, fn: Callable, args, kwargs):
-        node = lazy.build_node(op_name, fn, args, kwargs)
-        if node is not None:
-            return TpuArray._from_node(node)
-        try:
-            result = fn(
-                *_unwrap_jnp(list(args)),
-                **{k: _unwrap_jnp(v) for k, v in kwargs.items()},
-            )
-        except _FALLBACK_ERRORS:
-            return NotImplemented
-        return _result_wrap(result)
+        result = try_lazy(op_name, fn, args, kwargs)
+        if result is None:
+            result = eager_device(fn, args, kwargs)
+        return result
 
     # -- interop -----------------------------------------------------------
     def __array__(self, dtype=None, copy=None):
@@ -264,11 +281,24 @@ class TpuArray:
 
     # -- ndarray methods ------------------------------------------------------
     def astype(self, dtype, **kwargs):
-        return self._lazy_or_eager("astype", lazy.astype_op, (self, dtype), {})
+        # order=/casting= carry numpy semantics jnp does not model — do those
+        # on host so e.g. casting="safe" actually raises. copy= is a no-op
+        # for immutable device arrays.
+        if kwargs.get("order", "K") not in ("K", "C") or kwargs.get(
+            "casting", "unsafe"
+        ) != "unsafe":
+            return real_np.asarray(self._arr).astype(dtype, **kwargs)
+        result = self._lazy_or_eager("astype", lazy.astype_op, (self, dtype), {})
+        if result is NotImplemented:  # e.g. object dtype — host numpy semantics
+            return real_np.asarray(self._arr).astype(dtype, **kwargs)
+        return result
 
-    def reshape(self, *shape, **kwargs):
+    def reshape(self, *shape, order="C"):
         if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
             shape = tuple(shape[0])
+        # Device arrays are C-contiguous, so order="A" == order="C".
+        if order not in ("C", "A"):
+            return _result_wrap(jnp.reshape(self._arr, shape, order=order))
         return self._lazy_or_eager("reshape", lazy.reshape_op, (self, shape), {})
 
     def transpose(self, *axes):
@@ -532,18 +562,12 @@ class _Dispatcher:
 
     def __call__(self, *args, **kwargs):
         if self._use_device(args, kwargs):
-            if self.lazy_ok:
-                node = lazy.build_node(self.name, self.jnp_fn, args, kwargs)
-                if node is not None:
-                    return TpuArray._from_node(node)
-            try:
-                result = self.jnp_fn(
-                    *_unwrap_jnp(list(args)),
-                    **{k: _unwrap_jnp(v) for k, v in kwargs.items()},
-                )
-                return _result_wrap(result)
-            except _FALLBACK_ERRORS:
-                pass  # e.g. object dtype, unsupported kwarg — use host numpy
+            result = try_lazy(self.name, self.jnp_fn, args, kwargs) if self.lazy_ok else None
+            if result is None:
+                result = eager_device(self.jnp_fn, args, kwargs)
+            if result is not NotImplemented:
+                return result
+            # e.g. object dtype, unsupported kwarg — use host numpy
         return self.np_fn(
             *_unwrap_np(list(args)), **{k: _unwrap_np(v) for k, v in kwargs.items()}
         )

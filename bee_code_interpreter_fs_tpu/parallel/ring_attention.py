@@ -40,6 +40,7 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, scale=None):
     b, t, h, d = q.shape
     if scale is None:
         scale = d ** -0.5
+    in_dtype = q.dtype
 
     q_pos = my * t + jnp.arange(t)  # global positions of local queries
 
@@ -49,8 +50,10 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, scale=None):
         src = (my - i) % n
         k_pos = src * t + jnp.arange(t)
 
-        # [b, h, tq, tk]
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, kc) * scale
+        # [b, h, tq, tk]; statistics in float32 regardless of input dtype
+        # (matches _plain_causal_attention — bf16 maxes/exps drift over the
+        # ring steps otherwise).
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kc).astype(jnp.float32) * scale
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask[None, None, :, :], s, _NEG)
@@ -58,7 +61,9 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, scale=None):
         m_new = jnp.maximum(m, s.max(axis=-1))
         corr = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[..., None])
-        acc = acc * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vc)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vc.astype(jnp.float32)
+        )
         l = l * corr + p.sum(axis=-1)
 
         # Rotate K/V to the next device; shift every step including the last
@@ -69,10 +74,10 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, scale=None):
         vc = lax.ppermute(vc, axis_name, perm)
         return kc, vc, acc, m_new, l
 
-    acc0 = jnp.zeros((b, h, t, d), q.dtype)
-    m0 = jnp.full((b, h, t), _NEG, q.dtype)
-    l0 = jnp.zeros((b, h, t), q.dtype)
+    acc0 = jnp.zeros((b, h, t, d), jnp.float32)
+    m0 = jnp.full((b, h, t), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
     _, _, acc, _, l = lax.fori_loop(0, n, step, (k, v, acc0, m0, l0))
 
     out = acc / jnp.maximum(l, 1e-30)[..., None]
-    return out.transpose(0, 2, 1, 3)  # -> [b, t, h, d]
+    return out.transpose(0, 2, 1, 3).astype(in_dtype)  # -> [b, t, h, d]

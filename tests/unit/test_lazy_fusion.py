@@ -32,10 +32,10 @@ def test_ops_stay_lazy_until_forced(np_shim):
 def test_whole_chain_is_one_graph(np_shim):
     a = np_shim.random.rand(N)
     s = (a * a).sum()
-    # rand -> mul -> sum is one DAG, not three executions (n_nodes counts
-    # per-reference, so a*a counts its shared child twice: 1+ (1+1) + 1)
+    # rand -> mul -> sum is one DAG of 3 unique nodes (a*a's shared child
+    # counts once), not three executions
     assert s._node is not None
-    assert s._node.n_nodes == 4
+    assert s._node.n_nodes == 3
     value = float(s)
     assert 0.25 * N < value < 0.42 * N
 
@@ -140,3 +140,55 @@ def test_big_list_operand_not_baked_static(np_shim):
     a = np_shim.ones(N)
     b = a + [0.5] * N  # must become a leaf/eager path, not a giant static
     assert float(b[0]) == 1.5
+
+
+def test_host_array_snapshot_at_call_time(np_shim):
+    """numpy reads operand values at call time: mutating the caller's array
+    between graph build and forcing must not change the result."""
+    import numpy as real_np
+
+    h = real_np.zeros(N)  # genuine host ndarray, big enough to dispatch
+    c = np_shim.array(h)  # np.array must copy at call time
+    big = np_shim.ones(N)
+    b = big + h  # host leaf inside a lazy device graph
+    h[:] = 7.0
+    assert float(np_shim.asarray(c).sum()) == 0.0
+    assert float(b.sum()) == float(N)
+
+
+def test_reshape_order_f(np_shim):
+    m = np_shim.arange(6 * THRESHOLD, dtype="float32").reshape(2, 3 * THRESHOLD)
+    out = np_shim.asarray(m.reshape(3 * THRESHOLD, 2, order="F"))
+    import numpy as real_np
+
+    expected = real_np.asarray(np_shim.asarray(m)).reshape(3 * THRESHOLD, 2, order="F")
+    assert (out == expected).all()
+
+
+def test_shared_subexpression_stays_fused(np_shim):
+    """x = x + x doubling: 9 unique nodes, far under the graph cap — the
+    per-reference count would have exploded past 200 and forced splits."""
+    x = np_shim.ones(N)
+    for _ in range(8):
+        x = x + x
+    assert x._node is not None
+    assert x._node.n_nodes == 9
+    assert float(x[0]) == 256.0
+
+
+def test_astype_casting_semantics(np_shim):
+    a = np_shim.ones(N, dtype="float64")
+    with pytest.raises(TypeError):
+        a.astype("int32", casting="safe")
+
+
+def test_reshape_order_a(np_shim):
+    m = np_shim.arange(6 * THRESHOLD, dtype="float32").reshape(2, 3 * THRESHOLD)
+    out = m.reshape(3 * THRESHOLD, 2, order="A")  # == C for device arrays
+    assert float(np_shim.asarray(out)[0, 1]) == 1.0
+
+
+def test_random_shuffle_tpuarray(np_shim):
+    a = np_shim.arange(N, dtype="float32")
+    np_shim.random.shuffle(a)
+    assert float(a.sum()) == float(N * (N - 1) / 2)
