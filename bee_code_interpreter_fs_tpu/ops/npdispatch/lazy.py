@@ -105,8 +105,6 @@ def build_node(op_name: str, fn: Callable, args, kwargs) -> Node | None:
 
     arg_refs: list[tuple[int, Any]] = []
     abstract_args = []
-    host_memo: dict[int, Any] = {}  # id(host ndarray) -> device snapshot,
-    # so np.op(h, h) still dedupes to one leaf/transfer after snapshotting
     for a in args:
         if isinstance(a, TpuArray):
             node = a._node
@@ -118,20 +116,6 @@ def build_node(op_name: str, fn: Callable, args, kwargs) -> Node | None:
                 arg_refs.append((_REF_LEAF, arr))
                 abstract_args.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
         elif isinstance(a, (jax.Array, real_np.ndarray)):
-            if isinstance(a, real_np.ndarray):
-                # numpy semantics read the value at CALL time; the graph runs
-                # later, so in-place mutation of the caller's array between
-                # build and forcing must not leak in. Snapshot by transferring
-                # to device now — same move materialize() would do anyway, so
-                # it costs nothing extra and keeps id-based leaf dedup intact
-                # for repeated operands.
-                cached = host_memo.get(id(a))
-                if cached is None:
-                    try:
-                        cached = host_memo[id(a)] = jnp.asarray(a)
-                    except (TypeError, ValueError):
-                        return None  # e.g. object dtype: run eagerly instead
-                a = cached
             arg_refs.append((_REF_LEAF, a))
             abstract_args.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
         elif _static_ok(a):
@@ -177,6 +161,23 @@ def build_node(op_name: str, fn: Callable, args, kwargs) -> Node | None:
         return None
     if not isinstance(aval, jax.ShapeDtypeStruct):
         return None  # multi-output ops stay eager
+
+    # Snapshot host ndarray leaves LAST, once the node is certain to be built
+    # (cap-retry and eval_shape bail-outs above must not waste transfers):
+    # numpy semantics read operand values at CALL time, so in-place mutation
+    # of the caller's array between build and forcing must not leak in.
+    # Transferring to device is the same move materialize() would do anyway;
+    # the memo keeps np.op(h, h) deduped to one leaf/transfer.
+    host_memo: dict[int, Any] = {}
+    for i, (kind, value) in enumerate(arg_refs):
+        if kind == _REF_LEAF and isinstance(value, real_np.ndarray):
+            snapshot = host_memo.get(id(value))
+            if snapshot is None:
+                try:
+                    snapshot = host_memo[id(value)] = jnp.asarray(value)
+                except (TypeError, ValueError):
+                    return None  # e.g. object dtype: run eagerly instead
+            arg_refs[i] = (_REF_LEAF, snapshot)
     return Node(op_name, fn, arg_refs, kwargs, aval, n_nodes)
 
 

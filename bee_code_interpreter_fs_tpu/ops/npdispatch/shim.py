@@ -284,7 +284,7 @@ class TpuArray:
         # order=/casting= carry numpy semantics jnp does not model — do those
         # on host so e.g. casting="safe" actually raises. copy= is a no-op
         # for immutable device arrays.
-        if kwargs.get("order", "K") not in ("K", "C") or kwargs.get(
+        if kwargs.get("order", "K") not in ("K", "C", "A") or kwargs.get(
             "casting", "unsafe"
         ) != "unsafe":
             return real_np.asarray(self._arr).astype(dtype, **kwargs)
@@ -299,7 +299,10 @@ class TpuArray:
         # Device arrays are C-contiguous, so order="A" == order="C".
         if order not in ("C", "A"):
             return _result_wrap(jnp.reshape(self._arr, shape, order=order))
-        return self._lazy_or_eager("reshape", lazy.reshape_op, (self, shape), {})
+        result = self._lazy_or_eager("reshape", lazy.reshape_op, (self, shape), {})
+        if result is NotImplemented:
+            raise TypeError(f"cannot reshape TpuArray to {shape!r}")
+        return result
 
     def transpose(self, *axes):
         # numpy supports both a.transpose(1, 0) and a.transpose((1, 0))

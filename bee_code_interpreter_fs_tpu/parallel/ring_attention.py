@@ -51,9 +51,12 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, scale=None):
         k_pos = src * t + jnp.arange(t)
 
         # [b, h, tq, tk]; statistics in float32 regardless of input dtype
-        # (matches _plain_causal_attention — bf16 maxes/exps drift over the
-        # ring steps otherwise).
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, kc).astype(jnp.float32) * scale
+        # (bf16 maxes/exps drift over the ring steps otherwise). The MXU
+        # takes bf16 inputs with f32 accumulation via preferred_element_type,
+        # so this costs no extra HBM copies or f32 matmuls.
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, kc, preferred_element_type=jnp.float32
+        ) * scale
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask[None, None, :, :], s, _NEG)
@@ -62,7 +65,10 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, scale=None):
         corr = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[..., None])
         acc = acc * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, vc.astype(jnp.float32)
+            "bhqk,bkhd->bhqd",
+            p.astype(v.dtype),
+            vc,
+            preferred_element_type=jnp.float32,
         )
         l = l * corr + p.sum(axis=-1)
 

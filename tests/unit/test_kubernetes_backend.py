@@ -1,0 +1,204 @@
+"""Kubernetes backend + kubectl adapter against a fake kubectl binary.
+
+The fake records every invocation (argv + stdin) into a directory and plays
+back canned responses, so manifest shape, TPU scheduling fields, wait/delete
+flows, and error paths are all testable without a cluster — the gap the
+reference left open (SURVEY.md §4: no unit layer, no fake backends).
+"""
+
+import json
+import os
+import stat
+from pathlib import Path
+
+import pytest
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.backends.base import SandboxSpawnError
+from bee_code_interpreter_fs_tpu.services.backends.kubernetes import (
+    KubernetesSandboxBackend,
+    deep_merge,
+)
+from bee_code_interpreter_fs_tpu.services.kubectl import Kubectl, KubectlError
+
+FAKE_KUBECTL = r"""#!/usr/bin/env python3
+import json, os, sys
+state = os.environ["FAKE_KUBECTL_DIR"]
+stdin = sys.stdin.read() if not sys.stdin.isatty() else ""
+with open(os.path.join(state, "calls.jsonl"), "a") as f:
+    f.write(json.dumps({"argv": sys.argv[1:], "stdin": stdin}) + "\n")
+args = sys.argv[1:]
+verb = args[0] if args else ""
+if os.path.exists(os.path.join(state, "fail_" + verb)):
+    sys.stderr.write(verb + " exploded\n")
+    sys.exit(1)
+if verb == "create":
+    manifest = json.loads(stdin)
+    with open(os.path.join(state, manifest["metadata"]["name"] + ".json"), "w") as f:
+        json.dump(manifest, f)
+    print(json.dumps(manifest))
+elif verb == "get":
+    name = args[2] if len(args) > 2 and not args[2].startswith("-") else None
+    path = os.path.join(state, (name or "none") + ".json")
+    if name and os.path.exists(path):
+        manifest = json.load(open(path))
+        manifest.setdefault("status", {})["podIP"] = "10.0.0.7"
+        manifest["metadata"]["uid"] = "uid-" + name
+        print(json.dumps(manifest))
+    else:
+        sys.stderr.write("NotFound\n")
+        sys.exit(1)
+elif verb == "wait":
+    print("pod condition met")
+elif verb == "delete":
+    print("pod deleted")
+else:
+    sys.exit(2)
+"""
+
+
+@pytest.fixture
+def fake_kubectl(tmp_path, monkeypatch):
+    state = tmp_path / "state"
+    state.mkdir()
+    binary = tmp_path / "kubectl"
+    binary.write_text(FAKE_KUBECTL)
+    binary.chmod(binary.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("FAKE_KUBECTL_DIR", str(state))
+    monkeypatch.delenv("HOSTNAME", raising=False)
+
+    def calls():
+        path = state / "calls.jsonl"
+        if not path.exists():
+            return []
+        return [json.loads(line) for line in path.read_text().splitlines()]
+
+    return Kubectl(binary=str(binary)), state, calls
+
+
+def _backend(kubectl, **config_kwargs) -> KubernetesSandboxBackend:
+    config = Config(
+        tpu_node_selector={
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "2x2",
+        },
+        **config_kwargs,
+    )
+    return KubernetesSandboxBackend(config, kubectl=kubectl)
+
+
+async def test_spawn_cpu_pod(fake_kubectl):
+    kubectl, state, calls = fake_kubectl
+    backend = _backend(kubectl)
+    sandbox = await backend.spawn(chip_count=0)
+    assert sandbox.url == "http://10.0.0.7:8000"
+    manifest = json.loads((state / (sandbox.id + ".json")).read_text())
+    container = manifest["spec"]["containers"][0]
+    assert manifest["metadata"]["labels"]["app"] == "code-executor"
+    assert "nodeSelector" not in manifest["spec"]
+    assert "google.com/tpu" not in json.dumps(container["resources"])
+    verbs = [c["argv"][0] for c in calls()]
+    assert verbs == ["create", "wait", "get"]
+
+
+async def test_spawn_tpu_pod_gets_chips_and_selector(fake_kubectl):
+    kubectl, state, _ = fake_kubectl
+    backend = _backend(kubectl)
+    sandbox = await backend.spawn(chip_count=4)
+    manifest = json.loads((state / (sandbox.id + ".json")).read_text())
+    container = manifest["spec"]["containers"][0]
+    assert container["resources"]["limits"]["google.com/tpu"] == "4"
+    assert container["resources"]["requests"]["google.com/tpu"] == "4"
+    assert (
+        manifest["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x2"
+    )
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["APP_CHIP_COUNT"] == "4"
+    assert env["APP_NUMPY_DISPATCH"] == "1"
+
+
+async def test_pod_spec_extra_merges(fake_kubectl):
+    kubectl, state, _ = fake_kubectl
+    backend = _backend(
+        kubectl,
+        executor_pod_spec_extra={
+            "tolerations": [{"key": "google.com/tpu", "operator": "Exists"}],
+            "containers": [],  # list merge keeps the executor container
+        },
+        executor_container_resources={"limits": {"memory": "2Gi"}},
+    )
+    sandbox = await backend.spawn(chip_count=4)
+    manifest = json.loads((state / (sandbox.id + ".json")).read_text())
+    assert manifest["spec"]["tolerations"][0]["key"] == "google.com/tpu"
+    limits = manifest["spec"]["containers"][0]["resources"]["limits"]
+    assert limits == {"memory": "2Gi", "google.com/tpu": "4"}
+
+
+async def test_spawn_failure_deletes_pod(fake_kubectl):
+    kubectl, state, calls = fake_kubectl
+    (state / "fail_wait").touch()
+    backend = _backend(kubectl)
+    with pytest.raises(SandboxSpawnError):
+        await backend.spawn(chip_count=0)
+    import asyncio
+
+    await asyncio.sleep(0.2)  # fire-and-forget delete
+    assert "delete" in [c["argv"][0] for c in calls()]
+
+
+async def test_delete_and_close(fake_kubectl):
+    kubectl, state, calls = fake_kubectl
+    backend = _backend(kubectl)
+    s1 = await backend.spawn()
+    s2 = await backend.spawn()
+    await backend.delete(s1)
+    await backend.close()
+    deletes = [c["argv"] for c in calls() if c["argv"][0] == "delete"]
+    deleted = {argv[2] for argv in deletes}
+    assert deleted == {s1.id, s2.id}
+    assert any("--ignore-not-found" in argv for argv in deletes[0:1])
+
+
+async def test_owner_reference_attached_in_cluster(fake_kubectl, monkeypatch):
+    kubectl, state, _ = fake_kubectl
+    # Pretend we run as pod "control-plane-0".
+    (state / "control-plane-0.json").write_text(
+        json.dumps({"metadata": {"name": "control-plane-0"}})
+    )
+    monkeypatch.setenv("HOSTNAME", "control-plane-0")
+    backend = _backend(kubectl)
+    sandbox = await backend.spawn()
+    manifest = json.loads((state / (sandbox.id + ".json")).read_text())
+    owner = manifest["metadata"]["ownerReferences"][0]
+    assert owner["name"] == "control-plane-0"
+    assert owner["uid"] == "uid-control-plane-0"
+
+
+async def test_kubectl_error_surface(fake_kubectl):
+    kubectl, state, _ = fake_kubectl
+    (state / "fail_create").touch()
+    backend = _backend(kubectl)
+    with pytest.raises(SandboxSpawnError, match="create failed"):
+        await backend.spawn()
+
+
+async def test_kubectl_flags_and_json(fake_kubectl):
+    kubectl, state, calls = fake_kubectl
+    ns = Kubectl(binary=kubectl.binary, namespace="bee")
+    await ns.wait("pod", "p1", **{"for": "condition=Ready"}, timeout="60s")
+    argv = calls()[-1]["argv"]
+    assert argv[:2] == ["wait", "pod/p1"]
+    assert "--namespace=bee" in argv
+    assert "--for=condition=Ready" in argv
+    assert "--timeout=60s" in argv
+
+
+def test_deep_merge():
+    base = {"a": {"x": 1}, "list": [1], "keep": True}
+    extra = {"a": {"y": 2}, "list": [2], "new": "v"}
+    assert deep_merge(base, extra) == {
+        "a": {"x": 1, "y": 2},
+        "list": [1, 2],
+        "keep": True,
+        "new": "v",
+    }
