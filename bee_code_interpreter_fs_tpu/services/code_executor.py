@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -36,6 +37,7 @@ from tenacity import (
 
 from ..config import Config
 from ..utils.logs import PhaseTimer
+from ..utils.metrics import ExecutorMetrics
 from ..utils.validation import normalize_workspace_path
 from .backends.base import Sandbox, SandboxBackend, SandboxSpawnError
 from .storage import Storage
@@ -63,14 +65,17 @@ class CodeExecutor:
         backend: SandboxBackend,
         storage: Storage,
         config: Config | None = None,
+        metrics: ExecutorMetrics | None = None,
     ) -> None:
         self.backend = backend
         self.storage = storage
         self.config = config or Config()
+        self.metrics = metrics or ExecutorMetrics()
         self._pools: dict[int, deque[Sandbox]] = {}
         self._spawning: dict[int, int] = {}
         self._fill_tasks: set[asyncio.Task] = set()
         self._closed = False
+        self.metrics.bind_pool(self._pools)
 
     # ------------------------------------------------------------------ pool
 
@@ -118,7 +123,12 @@ class CodeExecutor:
         reraise=True,
     )
     async def _spawn_with_retry(self, chip_count: int) -> Sandbox:
-        return await self.backend.spawn(chip_count)
+        start = time.perf_counter()
+        sandbox = await self.backend.spawn(chip_count)
+        self.metrics.spawn_seconds.observe(
+            time.perf_counter() - start, chip_count=str(chip_count)
+        )
+        return sandbox
 
     async def _acquire(self, chip_count: int) -> Sandbox:
         pool = self._pool(chip_count)
@@ -131,12 +141,6 @@ class CodeExecutor:
 
     # --------------------------------------------------------------- execute
 
-    @retry(
-        retry=retry_if_exception_type(ExecutorError),
-        stop=stop_after_attempt(3),
-        wait=wait_exponential(multiplier=0.5, max=5),
-        reraise=True,
-    )
     async def execute(
         self,
         source_code: str | None = None,
@@ -146,12 +150,54 @@ class CodeExecutor:
         timeout: float | None = None,
         env: dict[str, str] | None = None,
         chip_count: int | None = None,
+        profile: bool = False,
     ) -> Result:
         """Run user code in a fresh sandbox; returns output + changed files.
 
         Exactly one of `source_code` (inline) / `source_file` (an absolute
-        workspace path that must appear in `files`) is required.
+        workspace path that must appear in `files`) is required. With
+        ``profile=True`` the sandbox captures a JAX profiler trace of the run
+        and ships it back as ``/workspace/profile.zip``.
         """
+        if profile:
+            env = {**(env or {}), "APP_JAX_PROFILE": "1"}
+        try:
+            result = await self._execute_with_retry(
+                source_code,
+                source_file=source_file,
+                files=files,
+                timeout=timeout,
+                env=env,
+                chip_count=chip_count,
+            )
+        except (ExecutorError, SandboxSpawnError):
+            self.metrics.executions.inc(outcome="infra_error")
+            raise
+        self.metrics.executions.inc(
+            outcome="ok" if result.exit_code == 0 else "user_error"
+        )
+        if result.warm:
+            self.metrics.warm_hits.inc()
+        for phase, seconds in result.phases.items():
+            self.metrics.phase_seconds.observe(seconds, phase=phase)
+        return result
+
+    @retry(
+        retry=retry_if_exception_type(ExecutorError),
+        stop=stop_after_attempt(3),
+        wait=wait_exponential(multiplier=0.5, max=5),
+        reraise=True,
+    )
+    async def _execute_with_retry(
+        self,
+        source_code: str | None = None,
+        *,
+        source_file: str | None = None,
+        files: dict[str, str] | None = None,
+        timeout: float | None = None,
+        env: dict[str, str] | None = None,
+        chip_count: int | None = None,
+    ) -> Result:
         if (source_code is None) == (source_file is None):
             raise ValueError("exactly one of source_code/source_file is required")
         files = files or {}

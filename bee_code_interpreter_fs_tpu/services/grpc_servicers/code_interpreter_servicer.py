@@ -63,6 +63,7 @@ class CodeInterpreterServicer:
                 timeout=request.timeout or None,
                 env=dict(request.env) or None,
                 chip_count=request.chip_count or None,
+                profile=request.profile,
             )
         except ValueError as e:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))

@@ -43,6 +43,7 @@ class ExecuteRequest(BaseModel):
     timeout: float | None = Field(default=None, gt=0)
     env: dict[str, str] | None = None
     chip_count: int | None = Field(default=None, ge=0)
+    profile: bool = False
 
 
 class ParseCustomToolRequest(BaseModel):
@@ -89,6 +90,14 @@ def create_http_app(
     async def healthz(request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
 
+    @routes.get("/metrics")
+    async def metrics(request: web.Request) -> web.Response:
+        return web.Response(
+            text=code_executor.metrics.registry.render(),
+            content_type="text/plain",
+            charset="utf-8",
+        )
+
     @routes.post("/v1/execute")
     async def execute(request: web.Request) -> web.Response:
         req = await parse_model(request, ExecuteRequest)
@@ -105,6 +114,7 @@ def create_http_app(
                 timeout=req.timeout,
                 env=req.env,
                 chip_count=req.chip_count,
+                profile=req.profile,
             )
         except ValueError as e:
             return bad_request(str(e))

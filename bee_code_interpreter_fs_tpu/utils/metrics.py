@@ -1,0 +1,245 @@
+"""Minimal Prometheus-text-format metrics registry (dependency-free).
+
+The reference has no metrics at all (SURVEY.md §5 "Metrics / logging /
+observability": "No metrics endpoint, no Prometheus"). This closes that gap
+for the control plane: counters, gauges (incl. scrape-time callbacks for pool
+depth), and histograms with request-latency buckets, rendered at
+``GET /metrics`` by the HTTP server. prometheus_client is not in this
+environment, so the text exposition format is emitted directly.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections.abc import Callable, Iterable
+
+# Buckets tuned for the quantities this service measures: sub-100ms warm-pool
+# hits through multi-second TPU cold spawns and minute-scale user code.
+DEFAULT_BUCKETS = (
+    0.005,
+    0.01,
+    0.025,
+    0.05,
+    0.1,
+    0.25,
+    0.5,
+    1.0,
+    2.5,
+    5.0,
+    10.0,
+    30.0,
+    60.0,
+    120.0,
+    300.0,
+)
+
+
+def _escape_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{key}="{_escape_label(str(value))}"' for key, value in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _fmt_value(value: float) -> str:
+    if value == float("inf"):
+        return "+Inf"
+    if float(value).is_integer():
+        return str(int(value))
+    return repr(float(value))
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str, label_names: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = label_names
+        self._values: dict[tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for key, value in items:
+            labels = dict(zip(self.label_names, key))
+            yield f"{self.name}{_fmt_labels(labels)} {_fmt_value(value)}"
+
+
+class Gauge:
+    """A settable gauge; ``callback`` makes it computed at scrape time
+    (used for pool depth, where the deque is the source of truth)."""
+
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        label_names: tuple[str, ...] = (),
+        callback: Callable[[], dict[tuple[str, ...], float]] | None = None,
+    ):
+        self.name = name
+        self.help = help_text
+        self.label_names = label_names
+        self.callback = callback
+        self._values: dict[tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels: str) -> None:
+        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        if self.callback is not None:
+            items = sorted(self.callback().items())
+        else:
+            with self._lock:
+                items = sorted(self._values.items())
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for key, value in items:
+            labels = dict(zip(self.label_names, key))
+            yield f"{self.name}{_fmt_labels(labels)} {_fmt_value(value)}"
+
+
+class Histogram:
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        label_names: tuple[str, ...] = (),
+        buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+    ):
+        self.name = name
+        self.help = help_text
+        self.label_names = label_names
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple[str, ...], list[int]] = {}
+        self._sums: dict[tuple[str, ...], float] = {}
+        self._totals: dict[tuple[str, ...], int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(str(labels.get(n, "")) for n in self.label_names)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        with self._lock:
+            keys = sorted(self._counts)
+            snapshot = {
+                key: (list(self._counts[key]), self._sums[key], self._totals[key])
+                for key in keys
+            }
+        for key, (counts, total_sum, total) in snapshot.items():
+            labels = dict(zip(self.label_names, key))
+            for bound, count in zip(self.buckets, counts):
+                bucket_labels = {**labels, "le": _fmt_value(bound)}
+                yield f"{self.name}_bucket{_fmt_labels(bucket_labels)} {count}"
+            inf_labels = {**labels, "le": "+Inf"}
+            yield f"{self.name}_bucket{_fmt_labels(inf_labels)} {total}"
+            yield f"{self.name}_sum{_fmt_labels(labels)} {_fmt_value(total_sum)}"
+            yield f"{self.name}_count{_fmt_labels(labels)} {total}"
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._metrics: list[Counter | Gauge | Histogram] = []
+        self._lock = threading.Lock()
+
+    def register(self, metric):
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def counter(self, name: str, help_text: str, label_names: tuple[str, ...] = ()):
+        return self.register(Counter(name, help_text, label_names))
+
+    def gauge(
+        self,
+        name: str,
+        help_text: str,
+        label_names: tuple[str, ...] = (),
+        callback=None,
+    ):
+        return self.register(Gauge(name, help_text, label_names, callback))
+
+    def histogram(
+        self,
+        name: str,
+        help_text: str,
+        label_names: tuple[str, ...] = (),
+        buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+    ):
+        return self.register(Histogram(name, help_text, label_names, buckets))
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics)
+        lines: list[str] = []
+        for metric in metrics:
+            lines.extend(metric.render())
+        return "\n".join(lines) + "\n"
+
+
+class ExecutorMetrics:
+    """The service's metric set, bound to one CodeExecutor."""
+
+    def __init__(self, registry: MetricsRegistry | None = None) -> None:
+        self.registry = registry or MetricsRegistry()
+        self.executions = self.registry.counter(
+            "code_interpreter_executions_total",
+            "Execute requests by outcome (ok/user_error/infra_error).",
+            ("outcome",),
+        )
+        self.warm_hits = self.registry.counter(
+            "code_interpreter_warm_runner_executions_total",
+            "Executions served by a pre-initialized (warm) sandbox runner.",
+        )
+        self.phase_seconds = self.registry.histogram(
+            "code_interpreter_phase_seconds",
+            "Per-request phase latency (queue_wait/upload/exec/download).",
+            ("phase",),
+        )
+        self.spawn_seconds = self.registry.histogram(
+            "code_interpreter_sandbox_spawn_seconds",
+            "Sandbox spawn-to-ready latency by chip-count lane.",
+            ("chip_count",),
+        )
+        self.pool_depth: Gauge | None = None
+
+    def bind_pool(self, pools) -> None:
+        """Expose warm-pool depth per chip-count lane, read at scrape time."""
+
+        def sample() -> dict[tuple[str, ...], float]:
+            return {(str(lane),): float(len(pool)) for lane, pool in pools.items()}
+
+        self.pool_depth = self.registry.gauge(
+            "code_interpreter_pool_depth",
+            "Warm sandboxes currently pooled, by chip-count lane.",
+            ("chip_count",),
+            callback=sample,
+        )

@@ -1,0 +1,42 @@
+"""Shared JAX profiler trace capture, used by both execution paths:
+
+- executor/runner.py (warm in-process path) wraps each profiled run directly;
+- executor/sitecustomize.py (cold subprocess path) starts a trace at first
+  jax import and finishes it atexit.
+
+Deployed next to both importers (the executor/ dir locally; the sandbox
+image installs it into site-packages alongside sitecustomize.py).
+"""
+
+import os
+import shutil
+import tempfile
+import zipfile
+
+PROFILE_ZIP = "profile.zip"
+
+
+def start_trace() -> str:
+    """Begin a JAX profiler trace into a scratch dir; returns the dir."""
+    import jax
+
+    trace_dir = tempfile.mkdtemp(prefix="jax-profile-")
+    jax.profiler.start_trace(trace_dir)
+    return trace_dir
+
+
+def finish_trace(trace_dir: str, dest: str = PROFILE_ZIP) -> None:
+    """Stop the trace and zip it to ``dest`` (relative to cwd, which both
+    execution paths set to the workspace — so the changed-file scan ships
+    the zip back to the client)."""
+    import jax
+
+    try:
+        jax.profiler.stop_trace()
+        with zipfile.ZipFile(dest, "w", zipfile.ZIP_DEFLATED) as zf:
+            for root, _dirs, names in os.walk(trace_dir):
+                for name in names:
+                    full = os.path.join(root, name)
+                    zf.write(full, os.path.relpath(full, trace_dir))
+    finally:
+        shutil.rmtree(trace_dir, ignore_errors=True)

@@ -65,11 +65,47 @@ def _warm_import() -> dict:
     return info
 
 
+def _profile_requested(env: dict) -> bool:
+    return str(env.get("APP_JAX_PROFILE", "")).lower() not in ("", "0", "false")
+
+
+def _import_jax_profile():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import jax_profile
+
+        return jax_profile
+    finally:
+        sys.path.pop(0)
+
+
+def _start_profile() -> str | None:
+    """Begin a JAX profiler trace; returns the trace dir, or None."""
+    try:
+        return _import_jax_profile().start_trace()
+    except Exception:  # noqa: BLE001 — profiling is best-effort
+        traceback.print_exc()
+        return None
+
+
+def _finish_profile(trace_dir: str) -> None:
+    """Stop the trace and zip it to ./profile.zip (cwd = workspace, so the
+    changed-file scan returns it to the client)."""
+    try:
+        _import_jax_profile().finish_trace(trace_dir)
+    except Exception:  # noqa: BLE001
+        traceback.print_exc()
+
+
 def _run_one(req: dict) -> int:
     source_path = req["source_path"]
     env = req.get("env") or {}
-    saved_env = {k: os.environ.get(k) for k in env}
-    os.environ.update({k: str(v) for k, v in env.items()})
+    # APP_JAX_PROFILE stays out of os.environ: the warm runner profiles the
+    # run itself, and leaking the var would make a sitecustomize on the path
+    # double-start the profiler at first jax import.
+    env_to_set = {k: v for k, v in env.items() if k != "APP_JAX_PROFILE"}
+    saved_env = {k: os.environ.get(k) for k in env_to_set}
+    os.environ.update({k: str(v) for k, v in env_to_set.items()})
 
     out_fd = os.open(req["stdout_path"], os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
     err_fd = os.open(req["stderr_path"], os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
@@ -82,6 +118,7 @@ def _run_one(req: dict) -> int:
     os.close(err_fd)
     saved_argv = sys.argv
     exit_code = 0
+    trace_dir = _start_profile() if _profile_requested(env) else None
     try:
         sys.argv = [source_path]
         runpy.run_path(source_path, run_name="__main__")
@@ -93,6 +130,9 @@ def _run_one(req: dict) -> int:
         exit_code = 1
     finally:
         sys.argv = saved_argv
+        if trace_dir is not None:
+            # Inside the redirect so profiler chatter lands in the capture.
+            _finish_profile(trace_dir)
         try:
             sys.stdout.flush()
             sys.stderr.flush()

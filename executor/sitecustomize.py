@@ -93,10 +93,32 @@ def _patch_json(json_mod) -> None:
     json_mod.DateTimeEncoder = DateTimeEncoder
 
 
+def _patch_jax_profile(jax_mod) -> None:
+    """APP_JAX_PROFILE=1 (cold-subprocess path; the warm runner handles this
+    itself): start a profiler trace at first jax import, stop + zip it to
+    ./profile.zip at exit so the changed-file scan ships it back."""
+    if str(os.environ.get("APP_JAX_PROFILE", "")).lower() in ("", "0", "false"):
+        return
+    import atexit
+
+    import jax_profile  # deployed alongside this file
+
+    trace_dir = jax_profile.start_trace()
+
+    def _finish() -> None:
+        try:
+            jax_profile.finish_trace(trace_dir)
+        except Exception:  # noqa: BLE001 — profiling is best-effort
+            pass
+
+    atexit.register(_finish)
+
+
 _PATCHES = {
     "matplotlib.pyplot": _patch_matplotlib_pyplot,
     "PIL.ImageShow": _patch_pil_imageshow,
     "json": _patch_json,
+    "jax": _patch_jax_profile,
 }
 
 _orig_import = builtins.__import__
@@ -106,9 +128,17 @@ def _patched_import(name, globals=None, locals=None, fromlist=(), level=0):  # n
     module = _orig_import(name, globals, locals, fromlist, level)
     for mod_name, patch in _PATCHES.items():
         if mod_name in sys.modules and mod_name not in _PATCHED:
+            target = sys.modules[mod_name]
+            # The hook also fires on imports nested inside mod_name's own
+            # __init__ (where the module exists in sys.modules but is only
+            # partially initialized — e.g. jax has no `profiler` attr yet).
+            # Defer until the module finishes importing.
+            spec = getattr(target, "__spec__", None)
+            if spec is not None and getattr(spec, "_initializing", False):
+                continue
             _PATCHED.add(mod_name)
             try:
-                patch(sys.modules[mod_name])
+                patch(target)
             except Exception:  # noqa: BLE001 — patches are best-effort
                 pass
     return module

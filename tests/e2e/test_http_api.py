@@ -247,3 +247,39 @@ async def test_concurrent_executes(client):
 async def test_healthz(client):
     resp = await client.get("/healthz")
     assert resp.status == 200
+
+
+async def test_metrics_endpoint(client):
+    resp = await client.post("/v1/execute", json={"source_code": "print('hi')"})
+    assert resp.status == 200
+    resp = await client.get("/metrics")
+    assert resp.status == 200
+    text = await resp.text()
+    assert 'code_interpreter_executions_total{outcome="ok"} 1' in text
+    assert "code_interpreter_phase_seconds_bucket" in text
+    assert "code_interpreter_pool_depth" in text
+    assert "code_interpreter_sandbox_spawn_seconds_count" in text
+
+    # user errors are counted separately from infra errors
+    await client.post("/v1/execute", json={"source_code": "raise SystemExit(3)"})
+    text = await (await client.get("/metrics")).text()
+    assert 'code_interpreter_executions_total{outcome="user_error"} 1' in text
+
+
+async def test_profile_capture(client):
+    source = (
+        "import jax.numpy as jnp\n"
+        "print(float(jnp.dot(jnp.ones(64), jnp.ones(64))))\n"
+    )
+    resp = await client.post(
+        "/v1/execute", json={"source_code": source, "profile": True, "timeout": 120}
+    )
+    assert resp.status == 200
+    body = await resp.json()
+    assert body["exit_code"] == 0, body["stderr"]
+    assert "/workspace/profile.zip" in body["files"], body
+    # the trace zip is a real, non-empty zip
+    object_id = body["files"]["/workspace/profile.zip"]
+    resp = await client.get(f"/v1/files/{object_id}")
+    data = await resp.read()
+    assert data[:2] == b"PK"

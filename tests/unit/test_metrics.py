@@ -1,0 +1,91 @@
+"""Unit tests for the dependency-free Prometheus metrics registry."""
+
+from bee_code_interpreter_fs_tpu.utils.metrics import (
+    ExecutorMetrics,
+    MetricsRegistry,
+)
+
+
+def test_counter_render():
+    reg = MetricsRegistry()
+    c = reg.counter("requests_total", "Total requests.", ("outcome",))
+    c.inc(outcome="ok")
+    c.inc(outcome="ok")
+    c.inc(outcome="err")
+    text = reg.render()
+    assert "# TYPE requests_total counter" in text
+    assert 'requests_total{outcome="ok"} 2' in text
+    assert 'requests_total{outcome="err"} 1' in text
+
+
+def test_unlabelled_counter_renders_zero():
+    reg = MetricsRegistry()
+    reg.counter("events_total", "Events.")
+    assert "events_total 0" in reg.render()
+
+
+def test_gauge_set_and_callback():
+    reg = MetricsRegistry()
+    g = reg.gauge("depth", "Depth.", ("lane",))
+    g.set(3, lane="0")
+    g.set(1.5, lane="4")
+    text = reg.render()
+    assert 'depth{lane="0"} 3' in text
+    assert 'depth{lane="4"} 1.5' in text
+
+    pools = {0: [1, 2], 4: []}
+    reg2 = MetricsRegistry()
+    reg2.gauge(
+        "pool_depth",
+        "Pool.",
+        ("lane",),
+        callback=lambda: {(str(k),): float(len(v)) for k, v in pools.items()},
+    )
+    assert 'pool_depth{lane="0"} 2' in reg2.render()
+    pools[0].append(3)
+    assert 'pool_depth{lane="0"} 3' in reg2.render()
+
+
+def test_histogram_buckets_sum_count():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", "Latency.", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = reg.render()
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1"} 2' in text
+    assert 'lat_bucket{le="10"} 3' in text
+    assert 'lat_bucket{le="+Inf"} 4' in text
+    assert "lat_count 4" in text
+    assert "lat_sum 55.55" in text
+
+
+def test_histogram_labels():
+    reg = MetricsRegistry()
+    h = reg.histogram("phase_s", "Phase.", ("phase",), buckets=(1.0,))
+    h.observe(0.5, phase="upload")
+    h.observe(2.0, phase="exec")
+    text = reg.render()
+    assert 'phase_s_bucket{le="1",phase="upload"} 1' in text
+    assert 'phase_s_bucket{le="+Inf",phase="exec"} 1' in text
+
+
+def test_label_escaping():
+    reg = MetricsRegistry()
+    c = reg.counter("weird", "Weird labels.", ("val",))
+    c.inc(val='a"b\\c')
+    assert 'weird{val="a\\"b\\\\c"} 1' in reg.render()
+
+
+def test_executor_metrics_pool_binding():
+    m = ExecutorMetrics()
+    pools = {0: [object()], 4: [object(), object()]}
+    m.bind_pool(pools)
+    m.executions.inc(outcome="ok")
+    m.phase_seconds.observe(0.01, phase="exec")
+    m.spawn_seconds.observe(2.0, chip_count="4")
+    text = m.registry.render()
+    assert 'code_interpreter_pool_depth{chip_count="0"} 1' in text
+    assert 'code_interpreter_pool_depth{chip_count="4"} 2' in text
+    assert 'code_interpreter_executions_total{outcome="ok"} 1' in text
+    assert "code_interpreter_sandbox_spawn_seconds_count" in text
