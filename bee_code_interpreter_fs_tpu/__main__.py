@@ -9,7 +9,9 @@ explicit and awaits alongside the servers).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
+import signal
 
 from aiohttp import web
 
@@ -24,6 +26,16 @@ def _split_addr(addr: str) -> tuple[str, int]:
 
 
 async def main(ctx: ApplicationContext | None = None) -> None:
+    # Signal handling first — a SIGTERM during slow startup (jax import,
+    # pool prefill) must already take the graceful path that reaps sandboxes.
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # non-unix
+            pass
+
     ctx = ctx or ApplicationContext()
 
     host, port = _split_addr(ctx.config.http_listen_addr)
@@ -44,11 +56,16 @@ async def main(ctx: ApplicationContext | None = None) -> None:
     ctx.code_executor.fill_pool_soon()
 
     try:
-        if grpc_task is not None:
-            await grpc_task
-        else:
-            await asyncio.Event().wait()
+        stop_task = asyncio.create_task(stop.wait())
+        waiters = [stop_task] + ([grpc_task] if grpc_task is not None else [])
+        await asyncio.wait(waiters, return_when=asyncio.FIRST_COMPLETED)
     finally:
+        stop_task.cancel()
+        if grpc_task is not None:
+            await ctx.grpc_server.stop(grace=2.0)
+            grpc_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await grpc_task
         await ctx.code_executor.close()
         await runner.cleanup()
 

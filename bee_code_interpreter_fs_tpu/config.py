@@ -98,6 +98,13 @@ class Config(BaseModel):
     tpu_node_selector: dict = Field(default_factory=dict)
     # Default chip count an Execute request gets when it doesn't ask.
     default_chip_count: int = 0  # 0 = whatever the sandbox has
+    # Chips attached to one host of a slice. chip_count above this → a
+    # multi-host sandbox group: one executor per host, jax.distributed
+    # coordinator bootstrap over DCN, ICI collectives inside (v5e = 4
+    # chips/host; v4/v5p = 4 chips/host for most topologies).
+    tpu_chips_per_host: int = 4
+    # Port the jax.distributed coordinator (host 0) listens on.
+    coordinator_port: int = 8476
     # Persistent XLA compilation cache shared across sandbox generations.
     jax_compilation_cache_dir: str = "/tmp/tpu-code-interpreter/jax-cache"
 

@@ -18,6 +18,27 @@ class SandboxSpawnError(RuntimeError):
     pass
 
 
+def num_hosts_for(chip_count: int, chips_per_host: int) -> int:
+    """Hosts needed for a slice of `chip_count` chips (0 chips = 1 CPU host).
+
+    Shared by every backend so the same chip_count always produces the same
+    group shape locally and on Kubernetes. Sub-host counts (e.g. 1 chip of a
+    4-chip host) are fine — one pod requests exactly that many chips. Above
+    one host, the count must tile exactly: chip_count=6 on 4-chip hosts
+    would silently reserve 8 chips while everything downstream (pool lane,
+    metrics, user-visible device count) said 6.
+    """
+    per_host = max(1, chips_per_host)
+    if chip_count <= 0:
+        return 1
+    if chip_count > per_host and chip_count % per_host != 0:
+        raise ValueError(
+            f"chip_count={chip_count} does not tile onto {per_host}-chip "
+            f"hosts; use a multiple of {per_host}"
+        )
+    return -(-chip_count // per_host)
+
+
 @dataclass
 class Sandbox:
     """A live single-use execution sandbox reachable over HTTP.
@@ -25,12 +46,27 @@ class Sandbox:
     `chip_count` is the number of TPU chips attached (0 = CPU-only); the pool
     keeps one lane per chip_count so an Execute asking for a v5e-4 slice never
     steals a single-chip sandbox and vice versa.
+
+    A multi-host slice (chip_count > chips-per-host) is ONE sandbox with one
+    executor per host: `host_urls` lists every host's executor server, `url`
+    is host 0 (the jax.distributed coordinator). The hosts share a JAX mesh
+    over ICI but have separate workspaces; the orchestrator fans file
+    transfers and /execute out to all of them (SURVEY.md §7.6).
     """
 
     id: str
-    url: str  # base URL of the in-sandbox executor server
+    url: str  # base URL of the in-sandbox executor server (host 0)
     chip_count: int = 0
     meta: dict = field(default_factory=dict)
+    host_urls: list[str] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if not self.host_urls:
+            self.host_urls = [self.url]
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.host_urls)
 
 
 @runtime_checkable

@@ -29,11 +29,21 @@ from typing import Any
 
 from ...config import Config
 from ..kubectl import Kubectl, KubectlError
-from .base import Sandbox, SandboxBackend, SandboxSpawnError
+from .base import Sandbox, SandboxBackend, SandboxSpawnError, num_hosts_for
 
 logger = logging.getLogger(__name__)
 
 EXECUTOR_PORT = 8000
+
+
+def _raise_first(results: list, group: str) -> None:
+    """Surface the first failure from a settled gather as SandboxSpawnError."""
+    failure = next((r for r in results if isinstance(r, BaseException)), None)
+    if failure is None:
+        return
+    if isinstance(failure, SandboxSpawnError):
+        raise failure
+    raise SandboxSpawnError(f"slice group {group} spawn failed: {failure!r}")
 
 
 def deep_merge(base: dict, extra: dict) -> dict:
@@ -64,6 +74,16 @@ class KubernetesSandboxBackend(SandboxBackend):
         self._owner_ref: dict | None | bool = None  # None = not looked up yet
         self._owner_lock = asyncio.Lock()
         self._live: dict[str, Sandbox] = {}
+        self._cleanup_tasks: set[asyncio.Task] = set()
+
+    def _delete_soon(self, name: str) -> None:
+        """Fire-and-track pod deletion: off the caller's critical path (and
+        safe inside CancelledError handlers), but guaranteed to be awaited by
+        close() — a fire-and-FORGET delete can die with the event loop and
+        leak the pod."""
+        task = asyncio.get_running_loop().create_task(self.delete_by_name(name))
+        self._cleanup_tasks.add(task)
+        task.add_done_callback(self._cleanup_tasks.discard)
 
     # ------------------------------------------------------------ manifest
 
@@ -92,7 +112,15 @@ class KubernetesSandboxBackend(SandboxBackend):
                     self._owner_ref = False
             return self._owner_ref or None
 
-    def pod_manifest(self, name: str, chip_count: int, owner: dict | None) -> dict:
+    def pod_manifest(
+        self,
+        name: str,
+        chip_count: int,
+        owner: dict | None,
+        *,
+        env_extra: list[dict] | None = None,
+        group: str | None = None,
+    ) -> dict:
         resources = deep_merge({}, self.config.executor_container_resources)
         spec: dict[str, Any] = {}
         if chip_count > 0:
@@ -125,6 +153,8 @@ class KubernetesSandboxBackend(SandboxBackend):
             )
         if self.numpy_dispatch:
             env.append({"name": "APP_NUMPY_DISPATCH", "value": "1"})
+        if env_extra:
+            env.extend(env_extra)
 
         spec = deep_merge(
             {
@@ -157,20 +187,40 @@ class KubernetesSandboxBackend(SandboxBackend):
                 "code-executor/chip-count": str(chip_count),
             },
         }
+        if group:
+            metadata["labels"]["code-executor/slice-group"] = group
         if owner:
             metadata["ownerReferences"] = [owner]
         return {"apiVersion": "v1", "kind": "Pod", "metadata": metadata, "spec": spec}
 
     # ------------------------------------------------------------ lifecycle
 
-    async def spawn(self, chip_count: int = 0) -> Sandbox:
-        name = self.config.executor_pod_name_prefix + uuid.uuid4().hex[:6]
-        owner = await self._owner_reference()
-        manifest = self.pod_manifest(name, chip_count, owner)
+    async def _create_pod(self, manifest: dict) -> None:
+        """kubectl-create a pod, cancellation-safely: a cancel landing
+        mid-create (service shutdown during prefill) does not kill the
+        kubectl subprocess, which goes on to create the pod anyway — so on
+        cancellation the create is allowed to finish in a tracked cleanup
+        task and the resulting pod is deleted."""
+        name = manifest["metadata"]["name"]
+        create = asyncio.get_running_loop().create_task(self.kubectl.create(manifest))
         try:
-            await self.kubectl.create(manifest)
+            await asyncio.shield(create)
+        except asyncio.CancelledError:
+            async def finish_then_delete() -> None:
+                try:
+                    await create
+                except Exception:  # noqa: BLE001 — create failed: nothing to delete
+                    return
+                await self.delete_by_name(name)
+
+            task = asyncio.get_running_loop().create_task(finish_then_delete())
+            self._cleanup_tasks.add(task)
+            task.add_done_callback(self._cleanup_tasks.discard)
+            raise
         except KubectlError as e:
             raise SandboxSpawnError(f"pod {name} create failed: {e}") from e
+
+    async def _wait_ready_ip(self, name: str) -> str:
         try:
             await self.kubectl.wait(
                 "pod",
@@ -182,10 +232,43 @@ class KubernetesSandboxBackend(SandboxBackend):
             pod_ip = pod["status"].get("podIP")
             if not pod_ip:
                 raise SandboxSpawnError(f"pod {name} Ready but has no podIP")
-        except (KubectlError, SandboxSpawnError) as e:
-            # Failed spawn must not leak a pod (reference :257-261).
-            asyncio.ensure_future(self.delete_by_name(name))
+            return pod_ip
+        except KubectlError as e:
             raise SandboxSpawnError(f"pod {name} did not become ready: {e}") from e
+
+    async def _wait_pod_ip(self, name: str) -> str:
+        """Poll until the pod is scheduled and addressable. Distinct from
+        Ready: a multi-host coordinator pod can't pass its readiness probe
+        until its peers join, but peers need its IP to be created at all."""
+        deadline = (
+            asyncio.get_running_loop().time() + self.config.executor_pod_ready_timeout
+        )
+        while True:
+            try:
+                pod = await self.kubectl.get("pod", name)
+            except KubectlError as e:
+                raise SandboxSpawnError(f"pod {name} vanished while starting: {e}")
+            pod_ip = pod.get("status", {}).get("podIP")
+            if pod_ip:
+                return pod_ip
+            if asyncio.get_running_loop().time() > deadline:
+                raise SandboxSpawnError(f"pod {name} was never assigned an IP")
+            await asyncio.sleep(0.5)
+
+    async def spawn(self, chip_count: int = 0) -> Sandbox:
+        num_hosts = num_hosts_for(chip_count, self.config.tpu_chips_per_host)
+        if num_hosts > 1:
+            return await self._spawn_group(chip_count, num_hosts)
+        name = self.config.executor_pod_name_prefix + uuid.uuid4().hex[:6]
+        owner = await self._owner_reference()
+        await self._create_pod(self.pod_manifest(name, chip_count, owner))
+        try:
+            pod_ip = await self._wait_ready_ip(name)
+        except (SandboxSpawnError, asyncio.CancelledError):
+            # Failed or cancelled spawn must not leak a pod (reference
+            # :257-261; cancellation happens on service shutdown).
+            self._delete_soon(name)
+            raise
         sandbox = Sandbox(
             id=name,
             url=f"http://{pod_ip}:{EXECUTOR_PORT}",
@@ -196,6 +279,87 @@ class KubernetesSandboxBackend(SandboxBackend):
         logger.info("spawned executor pod %s (%d chips) at %s", name, chip_count, pod_ip)
         return sandbox
 
+    async def _spawn_group(self, chip_count: int, num_hosts: int) -> Sandbox:
+        """A multi-host TPU slice: one executor pod per host (SURVEY.md §7.6).
+
+        Host 0 runs the jax.distributed coordinator; its IP must be known to
+        the peers at creation, so pod 0 is created first, the peers are
+        created as soon as it is scheduled, and only then does the group
+        rendezvous — every pod turns Ready exactly when the whole slice's
+        mesh is up (the readiness probe waits on the warm runner, which
+        blocks in jax.distributed.initialize until all hosts join).
+        """
+        group = self.config.executor_pod_name_prefix + uuid.uuid4().hex[:6]
+        names = [f"{group}-h{i}" for i in range(num_hosts)]
+        chips_per_host = max(1, self.config.tpu_chips_per_host)
+        owner = await self._owner_reference()
+        coord_port = self.config.coordinator_port
+
+        def host_env(host_id: int, coordinator: str) -> list[dict]:
+            return [
+                {"name": "APP_NUM_HOSTS", "value": str(num_hosts)},
+                {"name": "APP_HOST_ID", "value": str(host_id)},
+                {"name": "APP_COORDINATOR_ADDR", "value": coordinator},
+            ]
+
+        try:
+            # Host 0 binds the coordinator port itself; 0.0.0.0 is valid for
+            # the binding side of jax.distributed.initialize.
+            await self._create_pod(
+                self.pod_manifest(
+                    names[0],
+                    chips_per_host,
+                    owner,
+                    env_extra=host_env(0, f"0.0.0.0:{coord_port}"),
+                    group=group,
+                )
+            )
+            coordinator_ip = await self._wait_pod_ip(names[0])
+            # return_exceptions on both gathers: every sibling create/wait
+            # must settle before cleanup runs, or an in-flight create could
+            # land after its delete and leak a pod holding TPU chips.
+            created = await asyncio.gather(
+                *(
+                    self._create_pod(
+                        self.pod_manifest(
+                            names[i],
+                            chips_per_host,
+                            owner,
+                            env_extra=host_env(i, f"{coordinator_ip}:{coord_port}"),
+                            group=group,
+                        )
+                    )
+                    for i in range(1, num_hosts)
+                ),
+                return_exceptions=True,
+            )
+            _raise_first(created, group)
+            ips = await asyncio.gather(
+                *(self._wait_ready_ip(n) for n in names), return_exceptions=True
+            )
+            _raise_first(ips, group)
+        except (SandboxSpawnError, asyncio.CancelledError):
+            for name in names:  # no partial slices
+                self._delete_soon(name)
+            raise
+        urls = [f"http://{ip}:{EXECUTOR_PORT}" for ip in ips]
+        sandbox = Sandbox(
+            id=group,
+            url=urls[0],
+            chip_count=chip_count,
+            host_urls=urls,
+            meta={"pods": names, "coordinator_ip": coordinator_ip},
+        )
+        self._live[group] = sandbox
+        logger.info(
+            "spawned executor slice group %s (%d hosts × %d chips) at %s",
+            group,
+            num_hosts,
+            chips_per_host,
+            ips,
+        )
+        return sandbox
+
     async def delete_by_name(self, name: str) -> None:
         self._live.pop(name, None)
         try:
@@ -204,10 +368,18 @@ class KubernetesSandboxBackend(SandboxBackend):
             logger.warning("pod %s delete failed: %s", name, e)
 
     async def delete(self, sandbox: Sandbox) -> None:
-        await self.delete_by_name(sandbox.id)
+        pods = sandbox.meta.get("pods")
+        if pods:
+            self._live.pop(sandbox.id, None)
+            await asyncio.gather(*(self.delete_by_name(name) for name in pods))
+        else:
+            await self.delete_by_name(sandbox.id)
 
     async def close(self) -> None:
+        pending = list(self._cleanup_tasks)
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
         await asyncio.gather(
-            *(self.delete_by_name(name) for name in list(self._live)),
+            *(self.delete(sandbox) for sandbox in list(self._live.values())),
             return_exceptions=True,
         )

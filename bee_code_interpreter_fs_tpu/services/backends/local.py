@@ -26,12 +26,41 @@ import uuid
 from pathlib import Path
 
 from ...config import Config
-from .base import Sandbox, SandboxBackend, SandboxSpawnError
+from .base import Sandbox, SandboxBackend, SandboxSpawnError, num_hosts_for
 
 logger = logging.getLogger(__name__)
 
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent.parent
 DEFAULT_BINARY = REPO_ROOT / "executor" / "build" / "executor-server"
+
+
+def _kill_group(proc: asyncio.subprocess.Process) -> None:
+    """SIGKILL the sandbox's whole process group (the server was spawned with
+    start_new_session=True, so pgid == its pid). Killing only the server
+    would orphan the warm runner and any user-code subprocesses — which keep
+    the server's stdout pipe open, making asyncio's Process.wait() (which
+    waits for pipe EOF, not just exit) hang until they die on their own."""
+    import signal
+
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    try:
+        proc.kill()
+    except ProcessLookupError:
+        pass
+
+
+def _free_port() -> int:
+    """An OS-assigned free TCP port for the group's jax.distributed
+    coordinator. Racy in principle, but the window is the group spawn and
+    local dev/test is the only user of this path."""
+    import socket
+
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
 
 
 class LocalSandboxBackend(SandboxBackend):
@@ -63,7 +92,63 @@ class LocalSandboxBackend(SandboxBackend):
                 f"executor binary not found at {self.binary}; run `make -C executor`"
             )
         sandbox_id = self.config.executor_pod_name_prefix + uuid.uuid4().hex[:6]
-        sandbox_dir = self.root / sandbox_id
+        num_hosts = num_hosts_for(chip_count, self.config.tpu_chips_per_host)
+        if num_hosts == 1:
+            port = await self._spawn_host(sandbox_id)
+            logger.info("spawned local sandbox %s on port %d", sandbox_id, port)
+            return Sandbox(
+                id=sandbox_id,
+                url=f"http://127.0.0.1:{port}",
+                chip_count=chip_count,
+                meta={"dir": str(self.root / sandbox_id)},
+            )
+
+        # Multi-host slice group: one executor process per "host", all joined
+        # into a single jax.distributed cluster via a localhost coordinator.
+        # The host processes block in distributed init until the whole group
+        # is up, so they MUST be spawned concurrently.
+        coord_port = _free_port()
+        host_ids = [f"{sandbox_id}-h{i}" for i in range(num_hosts)]
+        results = await asyncio.gather(
+            *(
+                self._spawn_host(
+                    host_id,
+                    env_extra={
+                        "APP_NUM_HOSTS": str(num_hosts),
+                        "APP_HOST_ID": str(i),
+                        "APP_COORDINATOR_ADDR": f"127.0.0.1:{coord_port}",
+                    },
+                )
+                for i, host_id in enumerate(host_ids)
+            ),
+            return_exceptions=True,
+        )
+        failure = next((r for r in results if isinstance(r, BaseException)), None)
+        if failure is not None:
+            for host_id in host_ids:  # no partial groups
+                await self._kill_host(host_id)
+            if isinstance(failure, SandboxSpawnError):
+                raise failure
+            raise SandboxSpawnError(f"group {sandbox_id} spawn failed: {failure!r}")
+        ports = list(results)
+        logger.info(
+            "spawned local multi-host sandbox %s (%d hosts, ports %s)",
+            sandbox_id,
+            num_hosts,
+            ports,
+        )
+        return Sandbox(
+            id=sandbox_id,
+            url=f"http://127.0.0.1:{ports[0]}",
+            chip_count=chip_count,
+            host_urls=[f"http://127.0.0.1:{p}" for p in ports],
+            meta={"hosts": host_ids, "dirs": [str(self.root / h) for h in host_ids]},
+        )
+
+    async def _spawn_host(
+        self, host_id: str, env_extra: dict[str, str] | None = None
+    ) -> int:
+        sandbox_dir = self.root / host_id
         workspace = sandbox_dir / "workspace"
         runtime_packages = sandbox_dir / "runtime-packages"
         workspace.mkdir(parents=True)
@@ -81,6 +166,7 @@ class LocalSandboxBackend(SandboxBackend):
                 "APP_RUNTIME_PACKAGES": str(runtime_packages),
                 "APP_WARM_RUNNER": "1" if self.config.executor_warm_runner else "0",
                 "APP_WARM_IMPORT_JAX": "1" if self.warm_import_jax else "0",
+                "APP_PARENT_DEATH_EXIT": "1",  # die with the control plane
                 "APP_PYTHON": sys.executable,
                 "APP_DEFAULT_TIMEOUT": str(self.config.default_execution_timeout),
             }
@@ -94,6 +180,8 @@ class LocalSandboxBackend(SandboxBackend):
                 [str(REPO_ROOT / "executor"), str(REPO_ROOT)]
                 + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
             )
+        if env_extra:
+            env.update(env_extra)
 
         proc = await asyncio.create_subprocess_exec(
             str(self.binary),
@@ -102,15 +190,13 @@ class LocalSandboxBackend(SandboxBackend):
             stderr=asyncio.subprocess.DEVNULL,
             start_new_session=True,
         )
+        # Register BEFORE waiting for readiness: a close() racing this spawn
+        # (service shutdown mid-prefill) must be able to kill the process.
+        self._procs[host_id] = (proc, str(sandbox_dir))
 
         async def abort_spawn(reason: str):
-            try:
-                proc.kill()
-            except ProcessLookupError:
-                pass
-            await proc.wait()  # reap; no zombie
-            await asyncio.to_thread(shutil.rmtree, sandbox_dir, True)
-            raise SandboxSpawnError(f"sandbox {sandbox_id} {reason}")
+            await self._kill_host(host_id)
+            raise SandboxSpawnError(f"sandbox {host_id} {reason}")
 
         try:
             line = await asyncio.wait_for(
@@ -118,39 +204,36 @@ class LocalSandboxBackend(SandboxBackend):
             )
         except asyncio.TimeoutError:
             await abort_spawn("did not become ready")
+        except asyncio.CancelledError:
+            await self._kill_host(host_id)
+            raise
         match = re.search(rb"port=(\d+)", line)
         if not match:
             await abort_spawn(f"spoke garbage at startup: {line!r}")
-        port = int(match.group(1))
-        self._procs[sandbox_id] = (proc, str(sandbox_dir))
-        logger.info("spawned local sandbox %s on port %d", sandbox_id, port)
-        return Sandbox(
-            id=sandbox_id,
-            url=f"http://127.0.0.1:{port}",
-            chip_count=chip_count,
-            meta={"dir": str(sandbox_dir)},
-        )
+        return int(match.group(1))
+
+    async def _kill_host(self, host_id: str) -> None:
+        entry = self._procs.pop(host_id, None)
+        if entry is None:
+            return
+        proc, sandbox_dir = entry
+        _kill_group(proc)
+        try:
+            # wait() resolves only after the server's pipes fully close; the
+            # runner's server-watchdog makes that prompt, but never let a
+            # straggler (e.g. a user-code subprocess holding the pipe) hang
+            # service shutdown.
+            await asyncio.wait_for(proc.wait(), timeout=10.0)
+        except asyncio.TimeoutError:
+            logger.warning("sandbox %s did not reap within 10s; abandoning", host_id)
+        await asyncio.to_thread(shutil.rmtree, sandbox_dir, True)
 
     async def delete(self, sandbox: Sandbox) -> None:
-        entry = self._procs.pop(sandbox.id, None)
-        if entry is not None:
-            proc, _ = entry
-            try:
-                proc.kill()
-                await proc.wait()
-            except ProcessLookupError:
-                pass
-        sandbox_dir = sandbox.meta.get("dir")
-        if sandbox_dir:
-            await asyncio.to_thread(shutil.rmtree, sandbox_dir, True)
+        for host_id in sandbox.meta.get("hosts", [sandbox.id]):
+            await self._kill_host(host_id)
         logger.info("deleted local sandbox %s", sandbox.id)
 
     async def close(self) -> None:
-        for sandbox_id, (proc, sandbox_dir) in list(self._procs.items()):
-            try:
-                proc.kill()
-                await proc.wait()
-            except ProcessLookupError:
-                pass
-            await asyncio.to_thread(shutil.rmtree, sandbox_dir, True)
-            self._procs.pop(sandbox_id, None)
+        await asyncio.gather(
+            *(self._kill_host(host_id) for host_id in list(self._procs))
+        )

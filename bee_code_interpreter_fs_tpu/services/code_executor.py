@@ -39,7 +39,7 @@ from ..config import Config
 from ..utils.logs import PhaseTimer
 from ..utils.metrics import ExecutorMetrics
 from ..utils.validation import normalize_workspace_path
-from .backends.base import Sandbox, SandboxBackend, SandboxSpawnError
+from .backends.base import Sandbox, SandboxBackend, SandboxSpawnError, num_hosts_for
 from .storage import Storage
 
 logger = logging.getLogger(__name__)
@@ -74,6 +74,7 @@ class CodeExecutor:
         self._pools: dict[int, deque[Sandbox]] = {}
         self._spawning: dict[int, int] = {}
         self._fill_tasks: set[asyncio.Task] = set()
+        self._dispose_tasks: set[asyncio.Task] = set()
         self._closed = False
         self.metrics.bind_pool(self._pools)
 
@@ -202,6 +203,9 @@ class CodeExecutor:
             raise ValueError("exactly one of source_code/source_file is required")
         files = files or {}
         lane = self.config.default_chip_count if chip_count is None else chip_count
+        # Fail a non-tiling chip_count here, before any pool machinery runs
+        # (surfaces as an invalid-argument error, not a spawn failure).
+        num_hosts_for(lane, self.config.tpu_chips_per_host)
         timeout = min(
             timeout or self.config.default_execution_timeout,
             self.config.max_execution_timeout,
@@ -211,14 +215,27 @@ class CodeExecutor:
         with timer.phase("queue_wait"):
             sandbox = await self._acquire(lane)
         try:
-            async with httpx.AsyncClient(
-                base_url=sandbox.url, timeout=httpx.Timeout(30.0)
-            ) as client:
+            async with httpx.AsyncClient(timeout=httpx.Timeout(30.0)) as client:
+                # A multi-host slice is one sandbox with an executor per host:
+                # inputs go to every host, /execute fires on every host (the
+                # hosts rendezvous via their pre-established jax.distributed
+                # mesh), and outputs merge with host-0 precedence.
+                hosts = sandbox.host_urls
                 with timer.phase("upload"):
+                    # One storage read per object, shared across hosts.
+                    contents = dict(
+                        zip(
+                            files,
+                            await asyncio.gather(
+                                *(self._read_object(oid) for oid in files.values())
+                            ),
+                        )
+                    )
                     await asyncio.gather(
                         *(
-                            self._upload_file(client, path, object_id)
-                            for path, object_id in files.items()
+                            self._upload_file(client, base, path, contents[path])
+                            for base in hosts
+                            for path in files
                         )
                     )
                 with timer.phase("exec"):
@@ -229,61 +246,111 @@ class CodeExecutor:
                         payload["source_code"] = source_code
                     else:
                         payload["source_file"] = source_file
-                    try:
-                        resp = await client.post(
-                            "/execute",
-                            json=payload,
-                            timeout=httpx.Timeout(timeout + 30.0),
-                        )
-                    except httpx.HTTPError as e:
-                        raise ExecutorError(f"sandbox {sandbox.id} unreachable: {e}")
-                    if resp.status_code == 403:
-                        raise ValueError(resp.json().get("error", "forbidden path"))
-                    if resp.status_code != 200:
-                        raise ExecutorError(
-                            f"sandbox {sandbox.id} /execute -> {resp.status_code}: "
-                            f"{resp.text[:500]}"
-                        )
-                    try:
-                        body = resp.json()
-                    except ValueError as e:
-                        raise ExecutorError(
-                            f"sandbox {sandbox.id} returned malformed JSON: {e}"
-                        )
+                    bodies = await asyncio.gather(
+                        *(
+                            self._post_execute(client, base, payload, timeout, sandbox)
+                            for base in hosts
+                        ),
+                        # Let every host finish before surfacing a failure —
+                        # a half-cancelled slice group would leak in-flight
+                        # requests into the dispose path.
+                        return_exceptions=True,
+                    )
+                    failure = next(
+                        (b for b in bodies if isinstance(b, BaseException)), None
+                    )
+                    if failure is not None:
+                        raise failure
                 with timer.phase("download"):
+                    # Host 0 wins path conflicts (it is the coordinator and,
+                    # per JAX convention, the process that does singular side
+                    # effects); per-shard files unique to other hosts are
+                    # still captured. Resolving the winner BEFORE downloading
+                    # fetches each path exactly once — no N-way duplicate
+                    # downloads, no orphaned storage objects.
+                    winner: dict[str, str] = {}
+                    for base, body in zip(hosts, bodies):
+                        for rel in body.get("files", []):
+                            winner.setdefault(rel, base)
                     changed = await asyncio.gather(
                         *(
-                            self._download_file(client, rel)
-                            for rel in body.get("files", [])
+                            self._download_file(client, base, rel)
+                            for rel, base in winner.items()
                         )
                     )
+            merged_files = {
+                f"/workspace/{rel}": object_id for rel, object_id in changed
+            }
+            primary = bodies[0]
+            stderr = primary.get("stderr", "")
+            exit_code = int(primary.get("exit_code", -1))
+            for host_index, body in enumerate(bodies[1:], start=1):
+                host_exit = int(body.get("exit_code", -1))
+                if host_exit != 0 and exit_code == 0:
+                    exit_code = host_exit
+                if host_exit != 0 and body.get("stderr"):
+                    stderr += ("\n" if stderr else "") + (
+                        f"[host {host_index}] {body['stderr']}"
+                    )
             return Result(
-                stdout=body.get("stdout", ""),
-                stderr=body.get("stderr", ""),
-                exit_code=int(body.get("exit_code", -1)),
-                files={f"/workspace/{rel}": object_id for rel, object_id in changed},
+                stdout=primary.get("stdout", ""),
+                stderr=stderr,
+                exit_code=exit_code,
+                files=merged_files,
                 phases=timer.as_dict(),
-                warm=bool(body.get("warm", False)),
+                warm=bool(primary.get("warm", False)),
             )
         finally:
             # single-use sandbox: dispose off the hot path
             task = asyncio.get_running_loop().create_task(self._dispose(sandbox))
-            self._fill_tasks.add(task)
-            task.add_done_callback(self._fill_tasks.discard)
+            self._dispose_tasks.add(task)
+            task.add_done_callback(self._dispose_tasks.discard)
+
+    async def _post_execute(
+        self,
+        client: httpx.AsyncClient,
+        base: str,
+        payload: dict,
+        timeout: float,
+        sandbox: Sandbox,
+    ) -> dict:
+        try:
+            resp = await client.post(
+                f"{base}/execute",
+                json=payload,
+                timeout=httpx.Timeout(timeout + 30.0),
+            )
+        except httpx.HTTPError as e:
+            raise ExecutorError(f"sandbox {sandbox.id} ({base}) unreachable: {e}")
+        if resp.status_code == 403:
+            raise ValueError(resp.json().get("error", "forbidden path"))
+        if resp.status_code != 200:
+            raise ExecutorError(
+                f"sandbox {sandbox.id} ({base}) /execute -> {resp.status_code}: "
+                f"{resp.text[:500]}"
+            )
+        try:
+            return resp.json()
+        except ValueError as e:
+            raise ExecutorError(
+                f"sandbox {sandbox.id} ({base}) returned malformed JSON: {e}"
+            )
+
+    async def _read_object(self, object_id: str) -> bytes:
+        try:
+            async with self.storage.reader(object_id) as reader:
+                return await reader.read()
+        except KeyError:
+            raise ValueError(f"unknown file object id: {object_id}")
 
     async def _upload_file(
-        self, client: httpx.AsyncClient, path: str, object_id: str
+        self, client: httpx.AsyncClient, base: str, path: str, data: bytes
     ) -> None:
         rel = normalize_workspace_path(path)
         if rel.startswith("workspace/"):
             rel = rel[len("workspace/") :]
         try:
-            async with self.storage.reader(object_id) as reader:
-                data = await reader.read()
-        except KeyError:
-            raise ValueError(f"unknown file object id: {object_id}")
-        try:
-            resp = await client.put(f"/workspace/{rel}", content=data)
+            resp = await client.put(f"{base}/workspace/{rel}", content=data)
         except httpx.HTTPError as e:
             raise ExecutorError(f"upload of {path} failed: {e}")
         if resp.status_code != 200:
@@ -292,11 +359,11 @@ class CodeExecutor:
             )
 
     async def _download_file(
-        self, client: httpx.AsyncClient, rel: str
+        self, client: httpx.AsyncClient, base: str, rel: str
     ) -> tuple[str, str]:
         try:
             async with self.storage.writer() as writer:
-                async with client.stream("GET", f"/workspace/{rel}") as resp:
+                async with client.stream("GET", f"{base}/workspace/{rel}") as resp:
                     if resp.status_code != 200:
                         raise ExecutorError(
                             f"download of {rel} failed: {resp.status_code}"
@@ -318,9 +385,14 @@ class CodeExecutor:
 
     async def close(self) -> None:
         self._closed = True
-        # Let in-flight dispose/fill tasks finish so no subprocess transport
-        # outlives the event loop.
-        pending = list(self._fill_tasks)
+        # Cancel in-flight pool refills — a spawn can take tens of seconds
+        # (TPU warm-up) and shutdown must not wait for it; the backend kills
+        # half-spawned sandboxes because they register before readiness.
+        fills = list(self._fill_tasks)
+        for task in fills:
+            task.cancel()
+        # Disposals run to completion so no subprocess outlives the loop.
+        pending = fills + list(self._dispose_tasks)
         if pending:
             await asyncio.gather(*pending, return_exceptions=True)
         sandboxes = [s for pool in self._pools.values() for s in pool]

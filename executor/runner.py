@@ -32,18 +32,52 @@ RESP_FD = 4
 
 
 def _send(obj: dict) -> None:
-    os.write(RESP_FD, (json.dumps(obj) + "\n").encode())
+    try:
+        os.write(RESP_FD, (json.dumps(obj) + "\n").encode())
+    except OSError:
+        # Server died while we were executing; nothing left to report to.
+        # Skip atexit (jax.distributed shutdown would block on dead peers).
+        os._exit(0)
+
+
+def _distributed_init(jax) -> None:
+    """Multi-host slice bootstrap (SURVEY.md §7.6): the backend spawns one
+    executor per host with APP_NUM_HOSTS / APP_HOST_ID / APP_COORDINATOR_ADDR;
+    host 0 binds the coordinator, peers dial it over DCN, and after this call
+    every host sees the slice's full device set — user code gets a
+    pre-established global mesh without any cooperation on its part (the
+    reference's NCCL/MPI role, done the JAX way)."""
+    num_hosts = int(os.environ.get("APP_NUM_HOSTS", "1") or "1")
+    if num_hosts <= 1:
+        return
+    coordinator = os.environ["APP_COORDINATOR_ADDR"]
+    host_id = int(os.environ.get("APP_HOST_ID", "0"))
+    # On the CPU platform (tests, dev) cross-process collectives need gloo;
+    # the knob is ignored by the TPU backend, which uses ICI.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 — older jaxlib without the knob
+        pass
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_hosts,
+        process_id=host_id,
+    )
 
 
 def _warm_import() -> dict:
     """Pre-import jax and touch the devices so TPU init happens now."""
     info = {"ready": True, "backend": "none", "device_count": 0}
+    num_hosts = int(os.environ.get("APP_NUM_HOSTS", "1") or "1")
     if os.environ.get("APP_WARM_IMPORT_JAX", "1") in ("0", "false"):
+        # Explicit escape hatch (plumbing tests / no-JAX dev); on a slice
+        # this forgoes the mesh knowingly.
         return info
     try:
         cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
         import jax
 
+        _distributed_init(jax)
         if cache_dir:
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             # Persist every kernel: the default 1s min-compile-time filter
@@ -54,13 +88,26 @@ def _warm_import() -> dict:
             jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         devices = jax.devices()
         info["backend"] = devices[0].platform if devices else "none"
-        info["device_count"] = len(devices)
+        info["device_count"] = len(devices)  # global across the slice
+        if jax.process_count() > 1:
+            info["process_count"] = jax.process_count()
+            info["process_index"] = jax.process_index()
+            info["local_device_count"] = jax.local_device_count()
         # Trigger one tiny compile so the XLA pipeline is paged in.
         import jax.numpy as jnp
 
         jnp.add(jnp.ones(()), 1.0).block_until_ready()
     except Exception:  # noqa: BLE001 — sandbox must still run CPU-only code
         traceback.print_exc()
+        if num_hosts > 1:
+            # A host that failed jax/distributed init must NOT report ready:
+            # the pod would pass its probe and hand out a slice whose mesh
+            # silently doesn't exist. Exiting keeps the server from ever
+            # listening (server.cpp refuses multi-host without the runner).
+            sys.stderr.write(
+                "[runner] fatal: jax init failed on a multi-host slice\n"
+            )
+            os._exit(1)
         info["backend"] = "import-failed"
     return info
 
@@ -150,19 +197,43 @@ def _run_one(req: dict) -> int:
     return exit_code
 
 
+def _start_server_watchdog() -> None:
+    """Die the instant the executor server does — even while the main thread
+    is blocked in jax init / jax.distributed rendezvous (where it cannot see
+    the request pipe's EOF). POLLHUP on the request pipe fires when the
+    server's write end closes; polling without POLLIN steals no request
+    bytes from the main loop."""
+    import select
+    import threading
+
+    def watch() -> None:
+        poller = select.poll()
+        poller.register(REQ_FD, 0)  # HUP/ERR are always reported
+        while True:
+            for _, event in poller.poll():
+                if event & (select.POLLHUP | select.POLLERR):
+                    os._exit(0)
+
+    threading.Thread(target=watch, name="server-watchdog", daemon=True).start()
+
+
 def main() -> None:
     # Detach stdin; keep stdout/stderr (they reach the executor's log).
     devnull = os.open(os.devnull, os.O_RDONLY)
     os.dup2(devnull, 0)
     os.close(devnull)
 
+    _start_server_watchdog()
     _send(_warm_import())
 
     buf = b""
     while True:
         chunk = os.read(REQ_FD, 65536)
         if not chunk:
-            return
+            # Server is gone; this sandbox is dead. Skip atexit — nothing
+            # needs flushing, and jax.distributed's shutdown barrier would
+            # block for minutes waiting for peers that are dying too.
+            os._exit(0)
         buf += chunk
         while b"\n" in buf:
             line, buf = buf.split(b"\n", 1)

@@ -25,6 +25,7 @@
 #include <fcntl.h>
 #include <poll.h>
 #include <signal.h>
+#include <sys/prctl.h>
 #include <sys/stat.h>
 #include <sys/types.h>
 #include <sys/wait.h>
@@ -254,10 +255,18 @@ ExecOutcome run_subprocess(const std::vector<std::string>& argv,
                            const std::string& stderr_path, double timeout_s,
                            const minijson::Value* extra_env) {
   ExecOutcome out;
+  pid_t parent = getpid();
   pid_t pid = fork();
   if (pid < 0) return out;
   if (pid == 0) {
     setsid();
+    // setsid() detaches us from the server's process group, so an external
+    // SIGKILL of the server's group would orphan user code — die with the
+    // server instead (checking for the fork↔prctl race). Thread-exit
+    // semantics of PDEATHSIG are safe here: the forking handler thread
+    // blocks in the waitpid loop below until this child is gone.
+    prctl(PR_SET_PDEATHSIG, SIGKILL);
+    if (getppid() != parent) _exit(127);
     if (!cwd.empty()) {
       if (chdir(cwd.c_str()) != 0) _exit(127);
     }
@@ -318,10 +327,18 @@ class WarmRunner {
     int req_pipe[2];   // server writes → runner fd 3
     int resp_pipe[2];  // runner fd 4 → server reads
     if (pipe(req_pipe) != 0 || pipe(resp_pipe) != 0) return false;
+    pid_t parent = getpid();
     pid_ = fork();
     if (pid_ < 0) return false;
     if (pid_ == 0) {
       setsid();
+      // No PR_SET_PDEATHSIG here: it fires when the FORKING THREAD exits,
+      // and runner restarts happen on short-lived per-request handler
+      // threads — the fresh runner would be killed as soon as that request
+      // finished. Server-death cleanup is handled by the runner itself: its
+      // request-pipe read returns EOF when the server dies and it _exits
+      // immediately (runner.py main loop).
+      if (getppid() != parent) _exit(127);
       if (chdir(workspace_.c_str()) != 0) _exit(127);
       // Shuffle pipe ends to fds 3/4 via safe high fds (the pipe fds may
       // themselves be 3/4, so a direct dup2 could clobber an end).
@@ -464,6 +481,7 @@ struct ServerState {
   std::string deps_script;
   bool warm_enabled = true;
   bool auto_install = false;
+  int num_hosts = 1;  // >1 → this sandbox is one host of a multi-host slice
   double default_timeout = 60.0;
   size_t max_output = 10 * 1024 * 1024;
   WarmRunner* runner = nullptr;
@@ -679,6 +697,17 @@ void handle_execute(const minihttp::Request& /*req*/, minihttp::Conn& conn) {
   }
 
   if (!ran_warm) {
+    if (g_state.num_hosts > 1) {
+      // A multi-host slice only exists through the warm runner's
+      // jax.distributed mesh; a cold subprocess here would run user code
+      // with a silently missing mesh — fail loudly instead.
+      if (source_code.empty()) script_path.clear();  // workspace file: keep it
+      drop_scratch();
+      conn.send_response(500, "application/json",
+                         "{\"error\":\"warm runner unavailable on a multi-host "
+                         "slice; cannot execute\"}");
+      return;
+    }
     ExecOutcome out =
         run_subprocess({g_state.python, script_path}, g_state.workspace,
                        stdout_path, stderr_path, timeout_s, &extra_env);
@@ -779,6 +808,13 @@ int main() {
   g_state.deps_script = env_or("APP_DEPS_SCRIPT", sibling("deps.py"));
   g_state.warm_enabled = env_flag("APP_WARM_RUNNER", true);
   g_state.auto_install = env_flag("APP_AUTO_INSTALL_DEPS", false);
+  g_state.num_hosts = static_cast<int>(env_num("APP_NUM_HOSTS", 1));
+  // Local-subprocess backend sets this so a SIGKILLed control plane can't
+  // orphan sandboxes. Off in pods, where the server is the container's PID 1
+  // and GC is the ownerReference's job.
+  if (env_flag("APP_PARENT_DEATH_EXIT", false)) {
+    prctl(PR_SET_PDEATHSIG, SIGKILL);
+  }
   g_state.default_timeout = env_num("APP_DEFAULT_TIMEOUT", 60.0);
   g_state.max_output = static_cast<size_t>(env_num("APP_MAX_OUTPUT_BYTES", 10485760));
 
@@ -789,9 +825,19 @@ int main() {
   if (g_state.warm_enabled) {
     if (runner.start()) {
       g_state.runner = &runner;
+    } else if (g_state.num_hosts > 1) {
+      // One host of a multi-host slice: the warm runner IS the slice's
+      // jax.distributed membership. Coming up without it would present a
+      // healthy sandbox whose user code silently sees no mesh — refuse to
+      // start instead (the pod never turns Ready; the spawn fails loudly).
+      log_msg("warm runner failed on a multi-host slice; exiting");
+      return 1;
     } else {
       log_msg("warm runner unavailable; falling back to cold subprocess mode");
     }
+  } else if (g_state.num_hosts > 1) {
+    log_msg("APP_NUM_HOSTS>1 requires the warm runner; exiting");
+    return 1;
   }
 
   minihttp::Server server(listen_addr, route);

@@ -1,0 +1,119 @@
+"""Multi-host slice e2e through the local backend (SURVEY.md §7.6).
+
+Two layers, both hardware-free:
+
+1. Fan-out mechanics with the warm runner's JAX import disabled: uploads
+   reach every host, /execute fires on every host, per-host output files are
+   all captured, stdout comes from host 0, a non-zero exit on any host fails
+   the Execute.
+2. The real thing on the CPU platform: two executor processes bootstrap one
+   jax.distributed cluster (gloo collectives), user code sees the global
+   device view and runs a cross-host collective — exactly the flow a v5e-16
+   slice uses with ICI instead of gloo.
+"""
+
+import pytest
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.backends.local import LocalSandboxBackend
+from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+
+def _config(tmp_path, **kwargs) -> Config:
+    return Config(
+        file_storage_path=str(tmp_path / "storage"),
+        local_sandbox_root=str(tmp_path / "sandboxes"),
+        executor_pod_queue_target_length=0,
+        tpu_chips_per_host=1,  # every "chip" is its own local host process
+        jax_compilation_cache_dir="",
+        **kwargs,
+    )
+
+
+@pytest.fixture
+async def mechanics_executor(tmp_path):
+    config = _config(tmp_path)
+    backend = LocalSandboxBackend(config, warm_import_jax=False)
+    executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
+    yield executor
+    await executor.close()
+
+
+async def test_fanout_mechanics(mechanics_executor):
+    executor = mechanics_executor
+    # files uploaded once are visible on every host; each host writes its own
+    # output; stdout is host 0's
+    object_id = await executor.storage.write(b"shared input\n")
+    result = await executor.execute(
+        "import os\n"
+        "host = os.environ.get('APP_HOST_ID', '?')\n"
+        "assert open('shared.txt').read() == 'shared input\\n'\n"  # cwd=workspace
+        "with open(f'host{host}.txt', 'w') as f:\n"
+        "    f.write(f'from host {host}')\n"
+        "print(f'hello from host {host}')\n",
+        files={"/workspace/shared.txt": object_id},
+        chip_count=2,
+    )
+    assert result.exit_code == 0, result.stderr
+    assert result.stdout == "hello from host 0\n"
+    assert set(result.files) >= {"/workspace/host0.txt", "/workspace/host1.txt"}
+    data = await executor.storage.read(result.files["/workspace/host1.txt"])
+    assert data == b"from host 1"
+
+
+async def test_fanout_peer_failure_fails_execute(mechanics_executor):
+    result = await mechanics_executor.execute(
+        "import os, sys\n"
+        "if os.environ.get('APP_HOST_ID') == '1':\n"
+        "    print('boom on host 1', file=sys.stderr)\n"
+        "    sys.exit(3)\n"
+        "print('host 0 fine')\n",
+        chip_count=2,
+    )
+    assert result.exit_code == 3
+    assert result.stdout == "host 0 fine\n"
+    assert "[host 1]" in result.stderr and "boom on host 1" in result.stderr
+
+
+async def test_single_host_lane_unaffected(mechanics_executor):
+    result = await mechanics_executor.execute("print(21 * 2)", chip_count=0)
+    assert result.exit_code == 0
+    assert result.stdout == "42\n"
+
+
+async def test_jax_distributed_two_host_slice(tmp_path, monkeypatch):
+    """Full coordinator bootstrap: 2 hosts × CPU, gloo collectives, global
+    mesh visible to user code with zero user cooperation."""
+    # 1 CPU device per host process (not the conftest's 8) → 2 gloo ranks,
+    # much faster rendezvous; the sandbox env inherits this.
+    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    config = _config(tmp_path, executor_pod_ready_timeout=180.0)
+    backend = LocalSandboxBackend(config, warm_import_jax=True)
+    executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
+    try:
+        result = await executor.execute(
+            # The mesh is pre-established by the warm runner before this code
+            # runs; user code just uses jax as if the slice were one machine.
+            "import jax, jax.numpy as jnp, numpy as np\n"
+            "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+            "assert jax.process_count() == 2, jax.process_count()\n"
+            "mesh = Mesh(np.array(jax.devices()), ('d',))\n"
+            "sharding = NamedSharding(mesh, P('d'))\n"
+            "n = len(jax.devices())\n"
+            "local = np.ones(n // 2, np.float32) * (jax.process_index() + 1)\n"
+            "x = jax.make_array_from_process_local_data(sharding, local, (n,))\n"
+            "total = jax.jit(lambda v: jnp.sum(v), out_shardings=NamedSharding(mesh, P()))(x)\n"
+            "print('total:', float(total))\n"
+            "with open(f'host{jax.process_index()}.ok', 'w') as f:\n"  # cwd=workspace
+            "    f.write('ok')\n",
+            chip_count=2,
+            timeout=240.0,
+        )
+        assert result.exit_code == 0, result.stderr[-2000:]
+        # devices split evenly: sum = n/2 * 1 + n/2 * 2 = 1.5n; n = 2 local
+        # device counts — just check the line exists and both hosts ran
+        assert "total:" in result.stdout
+        assert set(result.files) >= {"/workspace/host0.ok", "/workspace/host1.ok"}
+    finally:
+        await executor.close()

@@ -202,3 +202,95 @@ def test_deep_merge():
         "keep": True,
         "new": "v",
     }
+
+
+# ----------------------------------------------------------- multi-host slices
+
+
+async def test_spawn_multihost_group(fake_kubectl):
+    """chip_count > chips-per-host → one pod per host, coordinator bootstrap
+    (SURVEY.md §7.6): pod 0 is created first, peers get its IP as the
+    jax.distributed coordinator address, every pod requests only its own
+    host's chips, and the Sandbox aggregates all host URLs."""
+    kubectl, state, calls = fake_kubectl
+    backend = _backend(kubectl, tpu_chips_per_host=4, coordinator_port=8476)
+    sandbox = await backend.spawn(chip_count=8)
+
+    assert sandbox.chip_count == 8
+    assert sandbox.num_hosts == 2
+    assert sandbox.host_urls == ["http://10.0.0.7:8000", "http://10.0.0.7:8000"]
+    assert sandbox.url == sandbox.host_urls[0]
+    assert sandbox.meta["pods"] == [f"{sandbox.id}-h0", f"{sandbox.id}-h1"]
+
+    manifests = [
+        json.loads((state / f"{sandbox.id}-h{i}.json").read_text()) for i in range(2)
+    ]
+    for i, manifest in enumerate(manifests):
+        container = manifest["spec"]["containers"][0]
+        # each host requests its own 4 chips, not the slice's 8
+        assert container["resources"]["limits"]["google.com/tpu"] == "4"
+        env = {e["name"]: e["value"] for e in container["env"]}
+        assert env["APP_NUM_HOSTS"] == "2"
+        assert env["APP_HOST_ID"] == str(i)
+        assert manifest["metadata"]["labels"]["code-executor/slice-group"] == sandbox.id
+    env0 = {e["name"]: e["value"] for e in manifests[0]["spec"]["containers"][0]["env"]}
+    env1 = {e["name"]: e["value"] for e in manifests[1]["spec"]["containers"][0]["env"]}
+    assert env0["APP_COORDINATOR_ADDR"] == "0.0.0.0:8476"  # host 0 binds
+    assert env1["APP_COORDINATOR_ADDR"] == "10.0.0.7:8476"  # peers dial host 0
+
+    # pod 0 created → IP polled → peer created → both waited on
+    verbs = [c["argv"][0] for c in calls()]
+    assert verbs[0] == "create"
+    assert "get" in verbs[1:verbs.index("create", 1)]  # IP poll before peer create
+    assert verbs.count("create") == 2
+    assert verbs.count("wait") == 2
+
+
+async def test_multihost_delete_removes_all_pods(fake_kubectl):
+    kubectl, state, calls = fake_kubectl
+    backend = _backend(kubectl, tpu_chips_per_host=4)
+    sandbox = await backend.spawn(chip_count=16)
+    assert sandbox.num_hosts == 4
+    await backend.delete(sandbox)
+    deleted = {c["argv"][2] for c in calls() if c["argv"][0] == "delete"}
+    assert deleted == {f"{sandbox.id}-h{i}" for i in range(4)}
+
+
+async def test_multihost_spawn_failure_cleans_whole_group(fake_kubectl):
+    import asyncio
+
+    kubectl, state, calls = fake_kubectl
+    (state / "fail_wait").touch()
+    backend = _backend(kubectl, tpu_chips_per_host=4)
+    with pytest.raises(SandboxSpawnError):
+        await backend.spawn(chip_count=8)
+    await asyncio.sleep(0.2)  # fire-and-forget deletes
+    deleted = {c["argv"][2] for c in calls() if c["argv"][0] == "delete"}
+    assert len(deleted) == 2  # no partial slices left behind
+
+
+def test_num_hosts_for_tiling():
+    from bee_code_interpreter_fs_tpu.services.backends.base import num_hosts_for
+
+    assert num_hosts_for(0, 4) == 1      # CPU lane
+    assert num_hosts_for(1, 4) == 1      # sub-host slice (v5e-1)
+    assert num_hosts_for(4, 4) == 1      # full host
+    assert num_hosts_for(8, 4) == 2
+    assert num_hosts_for(16, 4) == 4
+    with pytest.raises(ValueError, match="does not tile"):
+        num_hosts_for(6, 4)              # would silently reserve 8 chips
+    with pytest.raises(ValueError, match="does not tile"):
+        num_hosts_for(9, 4)
+
+
+async def test_non_tiling_chip_count_rejected_before_spawn(fake_kubectl, tmp_path):
+    from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor
+    from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+    kubectl, state, calls = fake_kubectl
+    backend = _backend(kubectl, tpu_chips_per_host=4)
+    executor = CodeExecutor(backend, Storage(tmp_path / "storage"), backend.config)
+    with pytest.raises(ValueError, match="does not tile"):
+        await executor.execute("print(1)", chip_count=6)
+    assert calls() == []  # rejected before any kubectl traffic
+    await executor.close()
