@@ -173,13 +173,18 @@ class LocalSandboxBackend(SandboxBackend):
         )
         if cache_dir:
             env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+        # sitecustomize (media/json patches + the gated numpy shim) is always
+        # on the path — in the sandbox image it lives in site-packages
+        # unconditionally; only the dispatch shim inside it is env-gated.
+        # REPO_ROOT (which exposes the npdispatch package, and with it the
+        # whole control-plane tree) is added only when the shim is on.
+        path_entries = [str(REPO_ROOT / "executor")]
         if self.numpy_dispatch:
             env["APP_NUMPY_DISPATCH"] = "1"
-            # Make the shim package + sitecustomize importable in the sandbox.
-            env["PYTHONPATH"] = os.pathsep.join(
-                [str(REPO_ROOT / "executor"), str(REPO_ROOT)]
-                + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
-            )
+            path_entries.append(str(REPO_ROOT))
+        env["PYTHONPATH"] = os.pathsep.join(
+            path_entries + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+        )
         if env_extra:
             env.update(env_extra)
 

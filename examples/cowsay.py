@@ -1,0 +1,7 @@
+"""Ad-hoc dependency auto-install probe (parity: reference examples/cowsay.py
+— imports a package NOT in the preinstalled sandbox stack, exercising the
+deps.py AST-scan + pip-install path that replaces the reference's upm)."""
+
+import cowsay
+
+cowsay.cow("moo from the TPU sandbox")

@@ -1,0 +1,6 @@
+"""Read a file the previous Execute produced — run hello_world_write_file.py
+first and pass its returned hash as files={"/workspace/hello.txt": <hash>}
+(parity: reference examples/hello_world_read_file.py; session state =
+the files map, SURVEY.md §3.4)."""
+
+print(open("hello.txt").read().strip())

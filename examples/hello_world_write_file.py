@@ -1,0 +1,7 @@
+"""Write a workspace file; the changed-file scan ships it back as a content
+hash the client can thread into the next Execute (parity: reference
+examples/hello_world_write_file.py)."""
+
+with open("hello.txt", "w") as f:
+    f.write("Hello, World!\n")
+print("wrote hello.txt")

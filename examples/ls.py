@@ -1,0 +1,7 @@
+"""List the sandbox workspace (parity: reference examples/ls.py)."""
+
+import os
+
+for entry in sorted(os.listdir(".")):
+    kind = "dir " if os.path.isdir(entry) else "file"
+    print(f"{kind} {entry}")

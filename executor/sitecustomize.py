@@ -66,6 +66,31 @@ def _patch_pil_imageshow(imageshow) -> None:
     imageshow.show = _show
 
 
+def _patch_moviepy(module) -> None:
+    """Force quiet, loggerless video writes: moviepy's progress bars flood
+    the captured stdout that Execute returns to the client. Keyed on both
+    the 1.x (`moviepy.editor`, has a `verbose` kwarg) and 2.x (`moviepy`,
+    logger-only) module layouts; the signature decides what to force."""
+    import inspect
+
+    clip_cls = getattr(module, "VideoClip", None)
+    if clip_cls is None or not hasattr(clip_cls, "write_videofile"):
+        return
+    original = clip_cls.write_videofile
+    try:
+        has_verbose = "verbose" in inspect.signature(original).parameters
+    except (TypeError, ValueError):
+        has_verbose = False
+
+    def write_videofile(self, *args, **kwargs):  # noqa: ANN001, ANN002, ANN003
+        if has_verbose:
+            kwargs["verbose"] = False
+        kwargs["logger"] = None
+        return original(self, *args, **kwargs)
+
+    clip_cls.write_videofile = write_videofile
+
+
 def _patch_json(json_mod) -> None:
     import datetime
 
@@ -117,6 +142,8 @@ def _patch_jax_profile(jax_mod) -> None:
 _PATCHES = {
     "matplotlib.pyplot": _patch_matplotlib_pyplot,
     "PIL.ImageShow": _patch_pil_imageshow,
+    "moviepy.editor": _patch_moviepy,  # moviepy 1.x
+    "moviepy": _patch_moviepy,  # moviepy 2.x (flat layout)
     "json": _patch_json,
     "jax": _patch_jax_profile,
 }

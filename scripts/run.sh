@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Build both images, deploy to the current kube context, port-forward, tail.
+# Reference parity: scripts/run.sh (build, re-apply, wait Ready, forward
+# 8000 + 50051, tail logs).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MANIFEST="${1:-k8s/local.yaml}"
+
+docker build -t localhost/tpu-code-interpreter:local .
+docker build -f executor/Dockerfile -t localhost/tpu-code-executor:local .
+
+kubectl delete pod tpu-code-interpreter --ignore-not-found --wait=true
+kubectl apply -f "$MANIFEST"
+kubectl wait --for=condition=Ready pod/tpu-code-interpreter --timeout=180s
+
+kubectl port-forward pod/tpu-code-interpreter 8000:8000 50051:50051 &
+echo $! > .port-forward.pid
+trap 'kill "$(cat .port-forward.pid)" 2>/dev/null || true' EXIT
+
+echo "HTTP  : http://127.0.0.1:8000  (try: curl -s -X POST http://127.0.0.1:8000/v1/execute -H 'content-type: application/json' -d '{\"source_code\": \"print(21*2)\"}')"
+echo "gRPC  : 127.0.0.1:50051 (reflection on; health check: python -m bee_code_interpreter_fs_tpu.health_check)"
+kubectl logs -f tpu-code-interpreter

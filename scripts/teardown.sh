@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Tear down the local deployment: stop the port-forward, delete the service
+# pod (executor pods cascade via ownerReferences) and the RBAC objects.
+# Reference parity: scripts/teardown.sh.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if [[ -f .port-forward.pid ]]; then
+  kill "$(cat .port-forward.pid)" 2>/dev/null || true
+  rm -f .port-forward.pid
+fi
+
+kubectl delete -f k8s/local.yaml --ignore-not-found --wait=false
+# Belt & braces: reap any executor pods that lost their owner.
+kubectl delete pods -l app=code-executor --ignore-not-found --wait=false

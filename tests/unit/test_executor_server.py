@@ -7,6 +7,7 @@ recursive changed-file detection.
 """
 
 import json
+import os
 import re
 import subprocess
 import time
@@ -17,12 +18,19 @@ import pytest
 
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 EXECUTOR_DIR = REPO_ROOT / "executor"
-BINARY = EXECUTOR_DIR / "build" / "executor-server"
+# CI points this at the ASan/TSan builds to run the same suite under
+# sanitizers (SURVEY.md §5: the C++ rebuild earns its safety story in CI).
+BINARY = Path(
+    os.environ.get("TEST_EXECUTOR_BINARY", EXECUTOR_DIR / "build" / "executor-server")
+)
 
 
 @pytest.fixture(scope="module")
 def executor(tmp_path_factory):
-    subprocess.run(["make", "-C", str(EXECUTOR_DIR)], check=True, capture_output=True)
+    if "TEST_EXECUTOR_BINARY" not in os.environ:
+        subprocess.run(
+            ["make", "-C", str(EXECUTOR_DIR)], check=True, capture_output=True
+        )
     root = tmp_path_factory.mktemp("executor")
     ws = root / "ws"
     rp = root / "rp"

@@ -91,3 +91,59 @@ def test_matplotlib_show_saves_png(tmp_path):
     assert proc.returncode == 0, proc.stderr
     if "SKIP" not in proc.stdout:
         assert (tmp_path / "plot.png").exists()
+
+
+def test_moviepy_write_videofile_forced_quiet(tmp_path):
+    """moviepy isn't installed in this environment, so emulate its module
+    shape: the patch must wrap VideoClip.write_videofile to force
+    verbose=False, logger=None (progress bars otherwise flood the stdout
+    Execute returns)."""
+    fake_pkg = tmp_path / "pkgs"
+    (fake_pkg / "moviepy").mkdir(parents=True)
+    (fake_pkg / "moviepy" / "__init__.py").write_text("")
+    (fake_pkg / "moviepy" / "editor.py").write_text(
+        # moviepy 1.x shape: write_videofile accepts a verbose kwarg
+        "class VideoClip:\n"
+        "    def write_videofile(self, path, verbose=True, logger='bar', **kw):\n"
+        "        return {'verbose': verbose, 'logger': logger, **kw}\n"
+    )
+    proc = run_sandboxed(
+        "import moviepy.editor as e\n"
+        "kwargs = e.VideoClip().write_videofile('out.mp4', verbose=True)\n"
+        "assert kwargs == {'verbose': False, 'logger': None}, kwargs\n"
+        "print('quiet ok')\n",
+        tmp_path,
+        extra_env={
+            "PYTHONPATH": os.pathsep.join(
+                [str(EXECUTOR_DIR), str(REPO_ROOT), str(fake_pkg)]
+            )
+        },
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "quiet ok" in proc.stdout
+
+
+def test_moviepy_2x_flat_layout_forced_quiet(tmp_path):
+    """moviepy 2.x drops moviepy.editor and the verbose kwarg; the patch
+    keys on the top-level module and forces only logger=None."""
+    fake_pkg = tmp_path / "pkgs"
+    (fake_pkg / "moviepy").mkdir(parents=True)
+    (fake_pkg / "moviepy" / "__init__.py").write_text(
+        "class VideoClip:\n"
+        "    def write_videofile(self, path, logger='bar', **kw):\n"
+        "        return {'logger': logger, **kw}\n"
+    )
+    proc = run_sandboxed(
+        "import moviepy\n"
+        "kwargs = moviepy.VideoClip().write_videofile('out.mp4')\n"
+        "assert kwargs == {'logger': None}, kwargs\n"
+        "print('quiet ok')\n",
+        tmp_path,
+        extra_env={
+            "PYTHONPATH": os.pathsep.join(
+                [str(EXECUTOR_DIR), str(REPO_ROOT), str(fake_pkg)]
+            )
+        },
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "quiet ok" in proc.stdout
