@@ -10,7 +10,8 @@
 FROM python:3.12-slim-bookworm
 
 ARG KUBECTL_VERSION=v1.31.0
-ADD https://dl.k8s.io/release/${KUBECTL_VERSION}/bin/linux/amd64/kubectl /usr/local/bin/kubectl
+ARG TARGETARCH=amd64
+ADD https://dl.k8s.io/release/${KUBECTL_VERSION}/bin/linux/${TARGETARCH}/kubectl /usr/local/bin/kubectl
 RUN chmod 0755 /usr/local/bin/kubectl
 
 WORKDIR /app

@@ -1,0 +1,35 @@
+# Dev task runner (parity: the reference's poe tasks — run, health_check,
+# test; pyproject.toml:45-57 — done as make targets since this project is
+# setuptools-based).
+
+.PHONY: all executor run health-check test test-sanitizers bench proto clean
+
+all: executor
+
+executor:
+	$(MAKE) -C executor
+
+run: executor
+	APP_EXECUTOR_BACKEND=local python -m bee_code_interpreter_fs_tpu
+
+health-check:
+	python -m bee_code_interpreter_fs_tpu.health_check
+
+test: executor
+	python -m pytest tests/ -q
+
+test-sanitizers:
+	$(MAKE) -C executor asan tsan
+	ASAN_OPTIONS=detect_leaks=1 TEST_EXECUTOR_BINARY=$(CURDIR)/executor/build/executor-server-asan \
+		python -m pytest tests/unit/test_executor_server.py -q
+	TSAN_OPTIONS=halt_on_error=1 TEST_EXECUTOR_BINARY=$(CURDIR)/executor/build/executor-server-tsan \
+		python -m pytest tests/unit/test_executor_server.py -q
+
+bench: executor
+	python bench.py
+
+proto:
+	scripts/genproto.sh
+
+clean:
+	$(MAKE) -C executor clean

@@ -52,6 +52,22 @@ def _kill_group(proc: asyncio.subprocess.Process) -> None:
         pass
 
 
+async def _terminate_sandbox(proc: asyncio.subprocess.Process, grace: float) -> None:
+    """SIGTERM first: the server's handler reaps the warm runner's whole
+    SESSION (which killpg cannot reach, and which may be wedged in
+    GIL-holding TPU init where its own pipe-EOF watchdog can't run) before
+    exiting. Escalate to a group SIGKILL if the server doesn't die in time."""
+    try:
+        proc.terminate()
+    except ProcessLookupError:
+        pass
+    try:
+        await asyncio.wait_for(asyncio.shield(proc.wait()), timeout=grace)
+    except asyncio.TimeoutError:
+        pass
+    _kill_group(proc)
+
+
 def _free_port() -> int:
     """An OS-assigned free TCP port for the group's jax.distributed
     coordinator. Racy in principle, but the window is the group spawn and
@@ -222,7 +238,7 @@ class LocalSandboxBackend(SandboxBackend):
         if entry is None:
             return
         proc, sandbox_dir = entry
-        _kill_group(proc)
+        await _terminate_sandbox(proc, grace=2.0)
         try:
             # wait() resolves only after the server's pipes fully close; the
             # runner's server-watchdog makes that prompt, but never let a
@@ -234,8 +250,14 @@ class LocalSandboxBackend(SandboxBackend):
         await asyncio.to_thread(shutil.rmtree, sandbox_dir, True)
 
     async def delete(self, sandbox: Sandbox) -> None:
-        for host_id in sandbox.meta.get("hosts", [sandbox.id]):
-            await self._kill_host(host_id)
+        # Concurrent per-host teardown: the TERM grace + reap timeout would
+        # otherwise stack serially across a slice group's hosts.
+        await asyncio.gather(
+            *(
+                self._kill_host(host_id)
+                for host_id in sandbox.meta.get("hosts", [sandbox.id])
+            )
+        )
         logger.info("deleted local sandbox %s", sandbox.id)
 
     async def close(self) -> None:

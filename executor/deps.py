@@ -18,7 +18,9 @@ import re
 import sys
 from pathlib import Path
 
-# import name -> pip distribution name, for the common divergent cases.
+# import name -> pip distribution name, for the common divergent cases
+# (curated equivalent of upm's pypi_map.sqlite import->package DB the
+# reference shipped, executor/Dockerfile:122-124; None = never install).
 IMPORT_TO_PIP = {
     "cv2": "opencv-python-headless",
     "PIL": "pillow",
@@ -27,6 +29,7 @@ IMPORT_TO_PIP = {
     "bs4": "beautifulsoup4",
     "yaml": "pyyaml",
     "Crypto": "pycryptodome",
+    "nacl": "pynacl",
     "fitz": "pymupdf",
     "dateutil": "python-dateutil",
     "docx": "python-docx",
@@ -38,8 +41,32 @@ IMPORT_TO_PIP = {
     "magic": "python-magic",
     "Levenshtein": "python-Levenshtein",
     "moviepy": "moviepy",
+    "attr": "attrs",
+    "cairo": "pycairo",
+    "dotenv": "python-dotenv",
+    "fake_useragent": "fake-useragent",
+    "flask_cors": "flask-cors",
+    "flask_sqlalchemy": "flask-sqlalchemy",
+    "github": "PyGithub",
+    "grpc": "grpcio",
+    "igraph": "python-igraph",
+    "jose": "python-jose",
+    "mpl_toolkits": "matplotlib",
+    "mysql": "mysql-connector-python",
+    "osgeo": "gdal",
+    "psycopg2": "psycopg2-binary",
+    "requests_html": "requests-html",
+    "rest_framework": "djangorestframework",
+    "sentence_transformers": "sentence-transformers",
+    "slugify": "python-slugify",
+    "socks": "pysocks",
+    "telegram": "python-telegram-bot",
+    "typing_extensions": "typing-extensions",
+    "websocket": "websocket-client",
+    "zmq": "pyzmq",
     "gi": None,  # system-only
     "libtpu": None,
+    "_curses": None,
 }
 
 

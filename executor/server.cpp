@@ -43,6 +43,19 @@
 #include "http.hpp"
 #include "json.hpp"
 
+// Runner session id, mirrored for the SIGTERM handler (async-signal-safe
+// cleanup): the runner lives in its own session, so killing the server's
+// group misses it, and the runner's own pipe-EOF watchdog cannot run while
+// its main thread blocks in GIL-holding native code (e.g. TPU init). The
+// server is therefore the one reliable place to reap it on shutdown.
+volatile sig_atomic_t g_runner_sid = 0;
+
+extern "C" void handle_shutdown_signal(int) {
+  pid_t sid = g_runner_sid;
+  if (sid > 0) kill(-sid, SIGKILL);
+  _exit(143);
+}
+
 namespace {
 
 std::string env_or(const char* name, const std::string& dflt) {
@@ -360,6 +373,7 @@ class WarmRunner {
     close(resp_pipe[1]);
     req_fd_ = req_pipe[1];
     resp_fd_ = resp_pipe[0];
+    g_runner_sid = pid_;
     // Wait for the ready line (runner imports jax → can take seconds on TPU;
     // that's the point: it happens at sandbox boot, not at Execute time).
     std::string line;
@@ -419,6 +433,7 @@ class WarmRunner {
   }
 
   void kill_runner() {
+    g_runner_sid = 0;
     if (pid_ > 0) {
       kill(-pid_, SIGKILL);
       waitpid(pid_, nullptr, 0);
@@ -810,16 +825,24 @@ int main() {
   g_state.auto_install = env_flag("APP_AUTO_INSTALL_DEPS", false);
   g_state.num_hosts = static_cast<int>(env_num("APP_NUM_HOSTS", 1));
   // Local-subprocess backend sets this so a SIGKILLed control plane can't
-  // orphan sandboxes. Off in pods, where the server is the container's PID 1
-  // and GC is the ownerReference's job.
+  // orphan sandboxes. SIGTERM (not SIGKILL) so the shutdown handler below
+  // still reaps the runner's session. Off in pods, where the server is the
+  // container's PID 1 and GC is the ownerReference's job.
   if (env_flag("APP_PARENT_DEATH_EXIT", false)) {
-    prctl(PR_SET_PDEATHSIG, SIGKILL);
+    prctl(PR_SET_PDEATHSIG, SIGTERM);
   }
   g_state.default_timeout = env_num("APP_DEFAULT_TIMEOUT", 60.0);
   g_state.max_output = static_cast<size_t>(env_num("APP_MAX_OUTPUT_BYTES", 10485760));
 
   mkdir(g_state.workspace.c_str(), 0777);
   mkdir(g_state.runtime_packages.c_str(), 0777);
+
+  // Graceful shutdown (kubelet pod stop, local backend teardown): reap the
+  // runner's whole session, then exit.
+  struct sigaction sa {};
+  sa.sa_handler = handle_shutdown_signal;
+  sigaction(SIGTERM, &sa, nullptr);
+  sigaction(SIGINT, &sa, nullptr);
 
   WarmRunner runner(g_state.python, g_state.runner_script, g_state.workspace);
   if (g_state.warm_enabled) {

@@ -87,11 +87,19 @@ elif verb == "wait":
             time.sleep(0.3)
     sys.stderr.write("timed out waiting for the condition\n"); sys.exit(1)
 elif verb == "delete":
+    # Emulate kubelet: SIGTERM to the container's PID 1 (the server reaps its
+    # runner session in its handler), escalate to SIGKILL after a grace.
+    import time
     name = args[2]
     if os.path.exists(pod_path(name)):
         manifest = json.load(open(pod_path(name)))
+        pid = manifest["pid"]
         try:
-            os.killpg(manifest["pid"], signal.SIGKILL)
+            os.kill(pid, signal.SIGTERM)
+            for _ in range(40):
+                time.sleep(0.05)
+                os.kill(pid, 0)  # raises once the process is gone
+            os.killpg(pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
         os.unlink(pod_path(name))

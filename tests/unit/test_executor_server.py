@@ -25,6 +25,24 @@ BINARY = Path(
 )
 
 
+def _server_env(ws, rp) -> dict:
+    """Server env based on os.environ so CI's ASAN_OPTIONS/TSAN_OPTIONS
+    (halt_on_error etc.) actually reach the sanitized process — a hand-built
+    env dict would leave the sanitizer jobs blind."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "APP_LISTEN_ADDR": "127.0.0.1:0",
+            "APP_WORKSPACE": str(ws),
+            "APP_RUNTIME_PACKAGES": str(rp),
+            "APP_WARM_IMPORT_JAX": "0",
+        }
+    )
+    return env
+
+
 @pytest.fixture(scope="module")
 def executor(tmp_path_factory):
     if "TEST_EXECUTOR_BINARY" not in os.environ:
@@ -38,15 +56,9 @@ def executor(tmp_path_factory):
     rp.mkdir()
     proc = subprocess.Popen(
         [str(BINARY)],
-        env={
-            "PATH": "/usr/bin:/bin:/usr/local/bin",
-            "APP_LISTEN_ADDR": "127.0.0.1:0",
-            "APP_WORKSPACE": str(ws),
-            "APP_RUNTIME_PACKAGES": str(rp),
-            "APP_WARM_IMPORT_JAX": "0",
-        },
+        env=_server_env(ws, rp),
         stdout=subprocess.PIPE,
-        stderr=subprocess.DEVNULL,
+        stderr=None,  # inherit: sanitizer reports must reach the test log
     )
     line = proc.stdout.readline().decode()
     port = int(re.search(r"port=(\d+)", line).group(1))
@@ -193,3 +205,48 @@ def test_deps_scanner():
     assert "definitely_not_installed_pkg" in missing
     assert "numpy" not in missing  # installed
     assert "os" not in missing  # stdlib
+
+
+def test_sigterm_reaps_runner_session(tmp_path):
+    """SIGTERM to the server must take the warm runner down with it even
+    though the runner sits in its own session (kubelet pod stop and the
+    local backend's graceful teardown both rely on this; a GIL-wedged
+    runner cannot be trusted to notice pipe EOF itself)."""
+    import signal
+
+    ws = tmp_path / "ws"
+    rp = tmp_path / "rp"
+    ws.mkdir()
+    rp.mkdir()
+    proc = subprocess.Popen(
+        [str(BINARY)],
+        env=_server_env(ws, rp),
+        stdout=subprocess.PIPE,
+        stderr=None,
+        start_new_session=True,
+    )
+    try:
+        assert b"port=" in proc.stdout.readline()
+        # the warm runner is the server's only child
+        children = subprocess.run(
+            ["pgrep", "-P", str(proc.pid)], capture_output=True, text=True
+        ).stdout.split()
+        assert len(children) == 1, children
+        runner_pid = int(children[0])
+
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=5)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                os.kill(runner_pid, 0)
+            except ProcessLookupError:
+                break  # runner reaped by the server's handler
+            time.sleep(0.05)
+        else:
+            pytest.fail(f"runner {runner_pid} survived server SIGTERM")
+    finally:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
