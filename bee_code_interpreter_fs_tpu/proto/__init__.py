@@ -5,7 +5,8 @@ unvendored git submodule (SURVEY.md §0.2); here both the .proto files and the
 generated code are in-repo.
 """
 
-from . import code_interpreter_pb2, health_pb2  # noqa: F401
+from . import code_interpreter_pb2, health_pb2, reflection_pb2  # noqa: F401
 
 SERVICE_NAME = "code_interpreter.v1.CodeInterpreterService"
 HEALTH_SERVICE_NAME = "grpc.health.v1.Health"
+REFLECTION_SERVICE_NAME = "grpc.reflection.v1alpha.ServerReflection"

@@ -75,6 +75,13 @@ class SandboxBackend(Protocol):
         """Create a sandbox and wait until its executor server is ready."""
         ...
 
+    def pool_capacity(self, chip_count: int) -> int | None:
+        """Max warm sandboxes a pool lane should hold on this backend, or
+        None for unbounded. A warm TPU sandbox owns its chips for its whole
+        pool residency, so the cap reflects physical chip availability —
+        the pool must never demand more chips than exist (VERDICT r1 #1/#5)."""
+        ...
+
     async def delete(self, sandbox: Sandbox) -> None:
         """Tear the sandbox down (idempotent, must not raise)."""
         ...

@@ -142,6 +142,14 @@ class KubernetesSandboxBackend(SandboxBackend):
                 "name": "APP_WARM_RUNNER",
                 "value": "1" if self.config.executor_warm_runner else "0",
             },
+            # Pods warm eagerly at boot (the default), but the in-server
+            # runner ready budget must match the control plane's warm budget
+            # — its 180s built-in default would give up on a slow TPU init
+            # that /readyz and _ready_wait_seconds() are still waiting on.
+            {
+                "name": "APP_RUNNER_READY_TIMEOUT",
+                "value": str(self.config.executor_warm_ready_timeout),
+            },
             {"name": "APP_CHIP_COUNT", "value": str(chip_count)},
         ]
         if self.config.jax_compilation_cache_dir:
@@ -165,13 +173,20 @@ class KubernetesSandboxBackend(SandboxBackend):
                         "ports": [{"containerPort": EXECUTOR_PORT}],
                         "env": env,
                         "resources": resources,
-                        # The executor only starts listening once its warm
-                        # JAX runner finished libtpu init, so Ready really
-                        # means "hot TPU, ready for user code".
+                        # The server listens immediately; warm-up (libtpu
+                        # init) runs in the background and /readyz turns 200
+                        # only once the runner is hot — so pod Ready still
+                        # means "TPU hot" without the server's existence
+                        # depending on TPU init.
                         "readinessProbe": {
+                            "httpGet": {"path": "/readyz", "port": EXECUTOR_PORT},
+                            "periodSeconds": 2,
+                            "failureThreshold": 300,
+                        },
+                        "livenessProbe": {
                             "httpGet": {"path": "/healthz", "port": EXECUTOR_PORT},
-                            "periodSeconds": 1,
-                            "failureThreshold": 120,
+                            "periodSeconds": 10,
+                            "failureThreshold": 6,
                         },
                     }
                 ],
@@ -220,13 +235,26 @@ class KubernetesSandboxBackend(SandboxBackend):
         except KubectlError as e:
             raise SandboxSpawnError(f"pod {name} create failed: {e}") from e
 
+    def pool_capacity(self, chip_count: int) -> int | None:
+        """TPU lanes hold at most `tpu_warm_pool_capacity` warm pods (each
+        owns its chips while pooled); CPU lanes keep the configured target."""
+        return self.config.tpu_warm_pool_capacity if chip_count > 0 else None
+
+    def _ready_wait_seconds(self) -> int:
+        # Pod Ready gates on /readyz (warm runner hot), so the wait budget
+        # must cover scheduling + image pull + TPU init — not just boot.
+        budget = self.config.executor_pod_ready_timeout
+        if self.config.executor_warm_runner:
+            budget += self.config.executor_warm_ready_timeout
+        return int(budget)
+
     async def _wait_ready_ip(self, name: str) -> str:
         try:
             await self.kubectl.wait(
                 "pod",
                 name,
                 **{"for": "condition=Ready"},
-                timeout=f"{int(self.config.executor_pod_ready_timeout)}s",
+                timeout=f"{self._ready_wait_seconds()}s",
             )
             pod = await self.kubectl.get("pod", name)
             pod_ip = pod["status"].get("podIP")

@@ -25,10 +25,17 @@ import sys
 import uuid
 from pathlib import Path
 
+import httpx
+
 from ...config import Config
 from .base import Sandbox, SandboxBackend, SandboxSpawnError, num_hosts_for
 
 logger = logging.getLogger(__name__)
+
+
+def _httpx_client() -> httpx.AsyncClient:
+    # Control-plane↔sandbox calls are localhost; 10s covers a loaded machine.
+    return httpx.AsyncClient(timeout=httpx.Timeout(10.0))
 
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent.parent
 DEFAULT_BINARY = REPO_ROOT / "executor" / "build" / "executor-server"
@@ -101,6 +108,47 @@ class LocalSandboxBackend(SandboxBackend):
         )
         self.numpy_dispatch = numpy_dispatch
         self._procs: dict[str, tuple[asyncio.subprocess.Process, str]] = {}
+        # libtpu is exclusive-access: only `local_tpu_slots` warm-JAX
+        # sandboxes may hold the local TPU at once. Spawns acquire a slot
+        # BEFORE triggering the runner's jax import (POST /warmup) and
+        # release it only when the sandbox's process group is confirmed
+        # dead — so a pool refill can never race the in-flight execution
+        # for the chip (the round-1 bench wedge).
+        self._tpu_slots = asyncio.Semaphore(max(1, self.config.local_tpu_slots))
+        self._slot_holders: set[str] = set()  # sandbox/host ids holding a slot
+
+    def _tpu_exclusive(self) -> bool:
+        """Would a warm-JAX runner grab a real (exclusive-access) TPU?
+
+        JAX_PLATFORMS=cpu (tests, CI's virtual mesh) means jax init is
+        concurrency-safe and spawns need no serialization."""
+        if not self.warm_import_jax:
+            return False
+        return not os.environ.get("JAX_PLATFORMS", "").strip().lower().startswith(
+            "cpu"
+        )
+
+    def pool_capacity(self, chip_count: int) -> int | None:
+        """Max warm sandboxes a pool lane should hold on this backend
+        (None = unbounded). Every warm-JAX sandbox on this host holds the
+        same local TPU regardless of lane, so the cap is the slot count."""
+        del chip_count
+        return max(1, self.config.local_tpu_slots) if self._tpu_exclusive() else None
+
+    def _stderr_tail(self, host_ids: list[str], limit: int = 1500) -> str:
+        """Tail of the sandbox server's stderr log(s) — the only place a
+        wedged `import jax` leaves its traceback (round-1's bench failure
+        was undiagnosable because this went to DEVNULL)."""
+        parts = []
+        for host_id in host_ids:
+            try:
+                data = (self.root / host_id / "server.log").read_bytes()
+            except OSError:
+                continue
+            if data:
+                tail = data[-limit:].decode("utf-8", "replace").strip()
+                parts.append(f"--- {host_id} stderr tail ---\n{tail}")
+        return "\n".join(parts)
 
     async def spawn(self, chip_count: int = 0) -> Sandbox:
         if not self.binary.exists():
@@ -111,20 +159,24 @@ class LocalSandboxBackend(SandboxBackend):
         num_hosts = num_hosts_for(chip_count, self.config.tpu_chips_per_host)
         if num_hosts == 1:
             port = await self._spawn_host(sandbox_id)
+            urls = [f"http://127.0.0.1:{port}"]
+            await self._warm_sandbox(sandbox_id, [sandbox_id], urls)
             logger.info("spawned local sandbox %s on port %d", sandbox_id, port)
             return Sandbox(
                 id=sandbox_id,
-                url=f"http://127.0.0.1:{port}",
+                url=urls[0],
                 chip_count=chip_count,
                 meta={"dir": str(self.root / sandbox_id)},
             )
 
         # Multi-host slice group: one executor process per "host", all joined
         # into a single jax.distributed cluster via a localhost coordinator.
-        # The host processes block in distributed init until the whole group
-        # is up, so they MUST be spawned concurrently.
+        # Servers come up instantly (warm-up is deferred to /warmup), then
+        # every host's runner starts concurrently — they block in distributed
+        # init until the whole group has joined.
         coord_port = _free_port()
         host_ids = [f"{sandbox_id}-h{i}" for i in range(num_hosts)]
+        chips_per_host = max(1, self.config.tpu_chips_per_host)
         results = await asyncio.gather(
             *(
                 self._spawn_host(
@@ -133,6 +185,19 @@ class LocalSandboxBackend(SandboxBackend):
                         "APP_NUM_HOSTS": str(num_hosts),
                         "APP_HOST_ID": str(i),
                         "APP_COORDINATOR_ADDR": f"127.0.0.1:{coord_port}",
+                        # Local "hosts" share one machine: partition its chips
+                        # so peers don't all grab the whole TPU and wedge each
+                        # other out of libtpu's exclusive access (inert when
+                        # JAX_PLATFORMS=cpu). Real multi-host TPU slices are
+                        # the kubernetes backend's job.
+                        "TPU_VISIBLE_CHIPS": ",".join(
+                            str(c)
+                            for c in range(
+                                i * chips_per_host, (i + 1) * chips_per_host
+                            )
+                        ),
+                        "TPU_PROCESS_BOUNDS": f"1,1,{num_hosts}",
+                        "TPU_CHIPS_PER_PROCESS_BOUNDS": f"1,1,{chips_per_host}",
                     },
                 )
                 for i, host_id in enumerate(host_ids)
@@ -147,6 +212,8 @@ class LocalSandboxBackend(SandboxBackend):
                 raise failure
             raise SandboxSpawnError(f"group {sandbox_id} spawn failed: {failure!r}")
         ports = list(results)
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        await self._warm_sandbox(sandbox_id, host_ids, urls)
         logger.info(
             "spawned local multi-host sandbox %s (%d hosts, ports %s)",
             sandbox_id,
@@ -155,11 +222,91 @@ class LocalSandboxBackend(SandboxBackend):
         )
         return Sandbox(
             id=sandbox_id,
-            url=f"http://127.0.0.1:{ports[0]}",
+            url=urls[0],
             chip_count=chip_count,
-            host_urls=[f"http://127.0.0.1:{p}" for p in ports],
+            host_urls=urls,
             meta={"hosts": host_ids, "dirs": [str(self.root / h) for h in host_ids]},
         )
+
+    async def _warm_sandbox(
+        self, sandbox_id: str, host_ids: list[str], urls: list[str]
+    ) -> None:
+        """Drive the sandbox from reachable to warm: acquire a TPU slot if the
+        runner will grab the chip, POST /warmup to every host, poll /healthz
+        until all report warm. Kills the sandbox (and releases the slot) on
+        failure/cancellation, with the server's stderr tail in the error."""
+        if not self.config.executor_warm_runner:
+            return
+        try:
+            if self._tpu_exclusive():
+                # One slot per sandbox (a local group partitions the same
+                # chips), held until _kill_host confirms the process group is
+                # dead. Bounded wait: an idle warm sandbox of ANOTHER lane
+                # holding the slot must surface as an error the pool can act
+                # on (evict + retry), never an unbounded hang.
+                try:
+                    await asyncio.wait_for(
+                        self._tpu_slots.acquire(),
+                        timeout=self.config.executor_warm_ready_timeout,
+                    )
+                except asyncio.TimeoutError:
+                    raise SandboxSpawnError(
+                        f"sandbox {sandbox_id}: no TPU slot freed within "
+                        f"{self.config.executor_warm_ready_timeout:.0f}s "
+                        "(held by another warm sandbox)"
+                    ) from None
+                self._slot_holders.add(sandbox_id)
+            await self._await_warm(urls, host_ids)
+        except BaseException as e:
+            # Tail BEFORE the kill: _kill_host's rmtree deletes server.log,
+            # and generic failures (server died mid-warm-up) need the tail
+            # just as much as the explicit timeout paths.
+            tail = self._stderr_tail(host_ids)
+            for host_id in host_ids:
+                await self._kill_host(host_id)
+            self._release_slot(sandbox_id)
+            if isinstance(e, (SandboxSpawnError, asyncio.CancelledError)):
+                raise
+            raise SandboxSpawnError(
+                f"sandbox {sandbox_id} warm-up failed: {e!r}"
+                + (f"\n{tail}" if tail else "")
+            ) from e
+
+    async def _await_warm(self, urls: list[str], host_ids: list[str]) -> None:
+        deadline = (
+            asyncio.get_running_loop().time() + self.config.executor_warm_ready_timeout
+        )
+        async with _httpx_client() as client:
+            for url in urls:
+                resp = await client.post(f"{url}/warmup")
+                resp.raise_for_status()
+            pending = dict(zip(host_ids, urls))
+            while pending:
+                for host_id, url in list(pending.items()):
+                    health = (await client.get(f"{url}/healthz")).json()
+                    state = health.get("warm_state")
+                    if health.get("warm"):
+                        del pending[host_id]
+                    elif state == "failed":
+                        tail = self._stderr_tail([host_id])
+                        raise SandboxSpawnError(
+                            f"sandbox {host_id} warm-up failed (jax/TPU init "
+                            f"died)\n{tail}"
+                        )
+                if not pending:
+                    return
+                if asyncio.get_running_loop().time() > deadline:
+                    tail = self._stderr_tail(sorted(pending))
+                    raise SandboxSpawnError(
+                        f"sandbox hosts {sorted(pending)} not warm within "
+                        f"{self.config.executor_warm_ready_timeout:.0f}s\n{tail}"
+                    )
+                await asyncio.sleep(0.25)
+
+    def _release_slot(self, sandbox_id: str) -> None:
+        if sandbox_id in self._slot_holders:
+            self._slot_holders.discard(sandbox_id)
+            self._tpu_slots.release()
 
     async def _spawn_host(
         self, host_id: str, env_extra: dict[str, str] | None = None
@@ -181,7 +328,14 @@ class LocalSandboxBackend(SandboxBackend):
                 "APP_WORKSPACE": str(workspace),
                 "APP_RUNTIME_PACKAGES": str(runtime_packages),
                 "APP_WARM_RUNNER": "1" if self.config.executor_warm_runner else "0",
+                # Warm-up waits for our POST /warmup — issued only after the
+                # per-chip TPU slot is acquired, so concurrent spawns never
+                # fight over libtpu's exclusive access.
+                "APP_WARM_EAGER": "0",
                 "APP_WARM_IMPORT_JAX": "1" if self.warm_import_jax else "0",
+                "APP_RUNNER_READY_TIMEOUT": str(
+                    self.config.executor_warm_ready_timeout
+                ),
                 "APP_PARENT_DEATH_EXIT": "1",  # die with the control plane
                 "APP_PYTHON": sys.executable,
                 "APP_DEFAULT_TIMEOUT": str(self.config.default_execution_timeout),
@@ -204,20 +358,30 @@ class LocalSandboxBackend(SandboxBackend):
         if env_extra:
             env.update(env_extra)
 
-        proc = await asyncio.create_subprocess_exec(
-            str(self.binary),
-            env=env,
-            stdout=asyncio.subprocess.PIPE,
-            stderr=asyncio.subprocess.DEVNULL,
-            start_new_session=True,
-        )
+        # Server stderr (including the warm runner's `import jax` traceback —
+        # the one clue when TPU init wedges) goes to a per-sandbox log file;
+        # its tail is included in every SandboxSpawnError.
+        log_file = open(sandbox_dir / "server.log", "wb")
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                str(self.binary),
+                env=env,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=log_file,
+                start_new_session=True,
+            )
+        finally:
+            log_file.close()
         # Register BEFORE waiting for readiness: a close() racing this spawn
         # (service shutdown mid-prefill) must be able to kill the process.
         self._procs[host_id] = (proc, str(sandbox_dir))
 
         async def abort_spawn(reason: str):
+            tail = self._stderr_tail([host_id])
             await self._kill_host(host_id)
-            raise SandboxSpawnError(f"sandbox {host_id} {reason}")
+            raise SandboxSpawnError(
+                f"sandbox {host_id} {reason}" + (f"\n{tail}" if tail else "")
+            )
 
         try:
             line = await asyncio.wait_for(
@@ -236,6 +400,7 @@ class LocalSandboxBackend(SandboxBackend):
     async def _kill_host(self, host_id: str) -> None:
         entry = self._procs.pop(host_id, None)
         if entry is None:
+            self._release_slot(host_id)
             return
         proc, sandbox_dir = entry
         await _terminate_sandbox(proc, grace=2.0)
@@ -247,6 +412,9 @@ class LocalSandboxBackend(SandboxBackend):
             await asyncio.wait_for(proc.wait(), timeout=10.0)
         except asyncio.TimeoutError:
             logger.warning("sandbox %s did not reap within 10s; abandoning", host_id)
+        # Only now — with the process group dead and its libtpu handle gone —
+        # may the next warm spawn take the chip.
+        self._release_slot(host_id)
         await asyncio.to_thread(shutil.rmtree, sandbox_dir, True)
 
     async def delete(self, sandbox: Sandbox) -> None:
@@ -258,6 +426,8 @@ class LocalSandboxBackend(SandboxBackend):
                 for host_id in sandbox.meta.get("hosts", [sandbox.id])
             )
         )
+        # A slice group's TPU slot is keyed by the group id, not a host id.
+        self._release_slot(sandbox.id)
         logger.info("deleted local sandbox %s", sandbox.id)
 
     async def close(self) -> None:

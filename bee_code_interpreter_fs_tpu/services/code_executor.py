@@ -49,6 +49,13 @@ class ExecutorError(RuntimeError):
     """Infrastructure-level execution failure (retried, then surfaced)."""
 
 
+def _drain(pool: deque) -> list:
+    drained = []
+    while pool:
+        drained.append(pool.popleft())
+    return drained
+
+
 @dataclass
 class Result:
     stdout: str
@@ -83,12 +90,27 @@ class CodeExecutor:
     def _pool(self, chip_count: int) -> deque[Sandbox]:
         return self._pools.setdefault(chip_count, deque())
 
+    def _lane_target(self, chip_count: int) -> int:
+        """Warm-pool target for a lane, capped by the backend's physical
+        capacity: a warm TPU sandbox owns its chips for its whole pool
+        residency, so an uncapped target (the reference's flat 5,
+        config.py:77) would demand N× the chips of one request — wedging
+        spawns behind libtpu's exclusive access locally, or pods Pending on
+        Kubernetes. CPU lanes report no cap and keep the configured target."""
+        target = self.config.executor_pod_queue_target_length
+        capacity_fn = getattr(self.backend, "pool_capacity", None)
+        if capacity_fn is not None:
+            capacity = capacity_fn(chip_count)
+            if capacity is not None:
+                target = min(target, capacity)
+        return target
+
     async def fill_pool(self, chip_count: int = 0) -> None:
         """Top the lane up to the target length, tracking in-flight spawns."""
         if self._closed:
             return
         pool = self._pool(chip_count)
-        target = self.config.executor_pod_queue_target_length
+        target = self._lane_target(chip_count)
         missing = target - len(pool) - self._spawning.get(chip_count, 0)
         if missing <= 0:
             return
@@ -131,11 +153,48 @@ class CodeExecutor:
         )
         return sandbox
 
+    async def _evict_idle_other_lanes(self, chip_count: int) -> None:
+        """On a capacity-constrained backend, idle warm sandboxes pooled in
+        OTHER lanes hold the physical TPU slots this lane's spawn needs —
+        without eviction the spawn would block on the slot until timeout
+        (starvation across lanes). Disposal is awaited so the slots are
+        actually free before the spawn starts; the evicted lanes refill only
+        when next requested."""
+        capacity_fn = getattr(self.backend, "pool_capacity", None)
+        if capacity_fn is None or capacity_fn(chip_count) is None:
+            return
+        evicted = [
+            sandbox
+            for lane, pool in self._pools.items()
+            # Only lanes that actually hold constrained resources: draining
+            # an unconstrained lane (e.g. CPU pods on kubernetes) would wipe
+            # a warm pool without freeing anything.
+            if lane != chip_count and capacity_fn(lane) is not None
+            for sandbox in _drain(pool)
+        ]
+        if evicted:
+            logger.info(
+                "evicting %d idle sandbox(es) from other lanes to free TPU "
+                "slots for lane %d",
+                len(evicted),
+                chip_count,
+            )
+            await asyncio.gather(*(self._dispose(s) for s in evicted))
+
     async def _acquire(self, chip_count: int) -> Sandbox:
         pool = self._pool(chip_count)
+        while not pool and self._spawning.get(chip_count, 0) > 0:
+            # A refill spawn for this lane is already in flight. On a
+            # capacity-constrained backend, starting a competing spawn here
+            # would lose the slot race to the refill and then starve behind
+            # the idle sandbox it parks in the pool — wait for it to land
+            # and pop it instead. If the refill fails (degraded pool),
+            # _spawning drops to zero and we spawn ourselves.
+            await asyncio.sleep(0.05)
         if pool:
             sandbox = pool.popleft()
         else:
+            await self._evict_idle_other_lanes(chip_count)
             sandbox = await self._spawn_with_retry(chip_count)
         self.fill_pool_soon(chip_count)
         return sandbox

@@ -13,9 +13,16 @@ from __future__ import annotations
 import logging
 
 import grpc
+from google.protobuf import descriptor_pool
 
 from ..config import Config
-from ..proto import HEALTH_SERVICE_NAME, SERVICE_NAME, health_pb2
+from ..proto import (
+    HEALTH_SERVICE_NAME,
+    REFLECTION_SERVICE_NAME,
+    SERVICE_NAME,
+    health_pb2,
+    reflection_pb2,
+)
 from .code_executor import CodeExecutor
 from .custom_tool_executor import CustomToolExecutor
 from .grpc_servicers.code_interpreter_servicer import CodeInterpreterServicer
@@ -58,6 +65,104 @@ class HealthServicer:
         }
 
 
+class ReflectionServicer:
+    """grpc.reflection.v1alpha.ServerReflection, served first-party.
+
+    The reference enables reflection via the grpcio add-on package
+    (src/code_interpreter/services/grpc_server.py:67-69) and its README
+    workflow depends on it (grpcurl, README.md:46). That package is not
+    available here, so the protocol is implemented directly over the default
+    descriptor pool the vendored *_pb2 modules register into — same approach
+    as the hand-rolled health service above.
+    """
+
+    def __init__(self, service_names: list[str]) -> None:
+        self.service_names = sorted(service_names)
+        self.pool = descriptor_pool.Default()
+
+    # -- descriptor closure ------------------------------------------------
+
+    def _file_closure(self, fd) -> list[bytes]:
+        """The file plus its transitive imports, each as a serialized
+        FileDescriptorProto (grpcurl needs the full closure to decode)."""
+        seen: dict[str, bytes] = {}
+
+        def visit(file_descriptor) -> None:
+            if file_descriptor.name in seen:
+                return
+            seen[file_descriptor.name] = file_descriptor.serialized_pb
+            for dep in file_descriptor.dependencies:
+                visit(dep)
+
+        visit(fd)
+        return list(seen.values())
+
+    def _respond(
+        self, request: reflection_pb2.ServerReflectionRequest
+    ) -> reflection_pb2.ServerReflectionResponse:
+        response = reflection_pb2.ServerReflectionResponse(
+            valid_host=request.host, original_request=request
+        )
+        kind = request.WhichOneof("message_request")
+        try:
+            if kind == "list_services":
+                response.list_services_response.service.extend(
+                    reflection_pb2.ServiceResponse(name=name)
+                    for name in self.service_names
+                )
+            elif kind == "file_containing_symbol":
+                fd = self.pool.FindFileContainingSymbol(
+                    request.file_containing_symbol
+                )
+                response.file_descriptor_response.file_descriptor_proto.extend(
+                    self._file_closure(fd)
+                )
+            elif kind == "file_by_filename":
+                fd = self.pool.FindFileByName(request.file_by_filename)
+                response.file_descriptor_response.file_descriptor_proto.extend(
+                    self._file_closure(fd)
+                )
+            elif kind == "all_extension_numbers_of_type":
+                # proto3 services here define no extensions; report the type
+                # with an empty number list if it exists at all.
+                self.pool.FindMessageTypeByName(
+                    request.all_extension_numbers_of_type
+                )
+                response.all_extension_numbers_response.base_type_name = (
+                    request.all_extension_numbers_of_type
+                )
+            elif kind == "file_containing_extension":
+                raise KeyError("extensions are not used by this server")
+            else:
+                response.error_response.error_code = int(
+                    grpc.StatusCode.INVALID_ARGUMENT.value[0]
+                )
+                response.error_response.error_message = "empty message_request"
+        except KeyError as e:
+            response.error_response.error_code = int(
+                grpc.StatusCode.NOT_FOUND.value[0]
+            )
+            response.error_response.error_message = str(e)
+        return response
+
+    async def ServerReflectionInfo(self, request_iterator, context):
+        async for request in request_iterator:
+            yield self._respond(request)
+
+    def method_handlers(self) -> dict[str, grpc.RpcMethodHandler]:
+        return {
+            "ServerReflectionInfo": grpc.stream_stream_rpc_method_handler(
+                self.ServerReflectionInfo,
+                request_deserializer=(
+                    reflection_pb2.ServerReflectionRequest.FromString
+                ),
+                response_serializer=(
+                    reflection_pb2.ServerReflectionResponse.SerializeToString
+                ),
+            ),
+        }
+
+
 class GrpcServer:
     def __init__(
         self,
@@ -69,6 +174,9 @@ class GrpcServer:
         self.config = config
         self.servicer = CodeInterpreterServicer(code_executor, custom_tool_executor)
         self.health = HealthServicer()
+        self.reflection = ReflectionServicer(
+            [SERVICE_NAME, HEALTH_SERVICE_NAME, REFLECTION_SERVICE_NAME]
+        )
         self.server = grpc.aio.server()
         self.server.add_generic_rpc_handlers(
             (
@@ -77,6 +185,9 @@ class GrpcServer:
                 ),
                 grpc.method_handlers_generic_handler(
                     HEALTH_SERVICE_NAME, self.health.method_handlers()
+                ),
+                grpc.method_handlers_generic_handler(
+                    REFLECTION_SERVICE_NAME, self.reflection.method_handlers()
                 ),
             )
         )

@@ -34,6 +34,12 @@ BENCH_SOURCE = (REPO_ROOT / "examples" / "benchmark-numpy.py").read_text()
 GFLOPS_RE = re.compile(r"GFLOPS=([0-9.]+)")
 
 
+def log(msg: str) -> None:
+    """Progress to stderr: stdout must stay one clean JSON line, and when the
+    bench dies the driver's captured tail must say which stage died."""
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
 async def run_gflops(dispatch: bool, runs: int, tmp: Path) -> tuple[float, dict]:
     config = Config(
         file_storage_path=str(tmp / f"storage-{dispatch}"),
@@ -47,10 +53,12 @@ async def run_gflops(dispatch: bool, runs: int, tmp: Path) -> tuple[float, dict]
     )
     executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
     try:
+        log(f"filling pool (dispatch={dispatch})...")
         await executor.fill_pool()
         best = 0.0
         info: dict = {}
         for i in range(runs):
+            log(f"run {i} (dispatch={dispatch})...")
             t0 = time.perf_counter()
             result = await executor.execute(BENCH_SOURCE, timeout=600.0)
             elapsed = time.perf_counter() - t0
@@ -70,6 +78,7 @@ async def run_gflops(dispatch: bool, runs: int, tmp: Path) -> tuple[float, dict]
                 "array_type": backend_line.split(":", 1)[1].strip(),
                 "phases": {k: round(v, 4) for k, v in result.phases.items()},
             }
+            log(f"run {i}: {gflops:.3f} GFLOPS ({info['array_type']})")
             best = max(best, gflops)
         return best, info
     finally:
@@ -87,13 +96,15 @@ async def cold_start_p50(tmp: Path, samples: int = 5) -> float:
     backend = LocalSandboxBackend(config, warm_import_jax=True, numpy_dispatch=True)
     executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
     try:
+        log("p50: filling pool...")
         await executor.fill_pool()
         latencies = []
-        for _ in range(samples):
+        for i in range(samples):
             t0 = time.perf_counter()
             result = await executor.execute("print(21 * 2)")
             latencies.append(time.perf_counter() - t0)
             assert result.exit_code == 0
+            log(f"p50 sample {i}: {latencies[-1]:.3f}s")
             # let the refill task restore the pool before the next sample
             await executor.fill_pool()
         return statistics.median(latencies)
@@ -101,9 +112,39 @@ async def cold_start_p50(tmp: Path, samples: int = 5) -> float:
         await executor.close()
 
 
+def prime_accelerator() -> None:
+    """One clean-exiting subprocess that imports jax and touches the devices
+    BEFORE any sandbox spawns. First-ever TPU init on a cold host pages in
+    the whole jax/libtpu stack and establishes the device session — minutes,
+    sometimes longer than any sane per-sandbox budget. Paying it here, in a
+    process that exits cleanly (never killed mid-init — killing a client
+    mid-init can wedge the device for the next one), makes every subsequent
+    sandbox warm-up fast. No timeout on purpose."""
+    import subprocess
+
+    log("priming accelerator (first-init page-in, may take minutes)...")
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import jax, jax.numpy as jnp;"
+            "print(jax.devices());"
+            "jnp.add(jnp.ones(()), 1.0).block_until_ready()",
+        ],
+        capture_output=True,
+        text=True,
+    )
+    log(
+        f"prime done in {time.perf_counter() - t0:.1f}s rc={proc.returncode} "
+        f"{(proc.stdout or proc.stderr).strip().splitlines()[-1:]}"
+    )
+
+
 async def main() -> None:
     import tempfile
 
+    prime_accelerator()
     with tempfile.TemporaryDirectory(prefix="bench-") as tmp_str:
         tmp = Path(tmp_str)
         tpu_gflops, tpu_info = await run_gflops(dispatch=True, runs=2, tmp=tmp)

@@ -5,9 +5,12 @@ TPU-native replacement for the reference's `upm guess` subprocess + sqlite
 import→package DB (executor/server.rs:174-195, executor/Dockerfile:122-124):
 an AST walk over the user script collects imported top-level modules, filters
 the stdlib (sys.stdlib_module_names) and anything already importable, then
-maps import names to pip names via a small alias table. A skip list
+maps import names to pip names via a data-file table (pypi_imports.tsv,
+~400 divergent import→distribution mappings — the equivalent of upm's
+pypi_map.sqlite) with the identity mapping as fallback. A skip list
 (requirements-skip.txt in the runtime-packages dir, reference parity:
-executor/requirements-skip.txt) suppresses OS-packaged aliases.
+executor/requirements-skip.txt) suppresses OS-packaged aliases; entries may
+carry extras/version pins ("pandas[excel]>=2"), which are stripped.
 
 Usage: python deps.py <script.py> [runtime_packages_dir]
 """
@@ -18,10 +21,10 @@ import re
 import sys
 from pathlib import Path
 
-# import name -> pip distribution name, for the common divergent cases
-# (curated equivalent of upm's pypi_map.sqlite import->package DB the
-# reference shipped, executor/Dockerfile:122-124; None = never install).
-IMPORT_TO_PIP = {
+# Mappings that must hold even if the data file is missing/corrupt (the
+# sandbox's most common divergent imports). The data file extends this table;
+# these entries win on conflict. None = never install (system-only).
+IMPORT_TO_PIP: dict[str, str | None] = {
     "cv2": "opencv-python-headless",
     "PIL": "pillow",
     "sklearn": "scikit-learn",
@@ -29,45 +32,38 @@ IMPORT_TO_PIP = {
     "bs4": "beautifulsoup4",
     "yaml": "pyyaml",
     "Crypto": "pycryptodome",
-    "nacl": "pynacl",
     "fitz": "pymupdf",
     "dateutil": "python-dateutil",
     "docx": "python-docx",
     "pptx": "python-pptx",
-    "kubernetes": "kubernetes",
-    "serial": "pyserial",
-    "OpenSSL": "pyopenssl",
-    "jwt": "pyjwt",
-    "magic": "python-magic",
-    "Levenshtein": "python-Levenshtein",
-    "moviepy": "moviepy",
-    "attr": "attrs",
-    "cairo": "pycairo",
-    "dotenv": "python-dotenv",
-    "fake_useragent": "fake-useragent",
-    "flask_cors": "flask-cors",
-    "flask_sqlalchemy": "flask-sqlalchemy",
-    "github": "PyGithub",
-    "grpc": "grpcio",
-    "igraph": "python-igraph",
-    "jose": "python-jose",
-    "mpl_toolkits": "matplotlib",
-    "mysql": "mysql-connector-python",
-    "osgeo": "gdal",
-    "psycopg2": "psycopg2-binary",
-    "requests_html": "requests-html",
-    "rest_framework": "djangorestframework",
-    "sentence_transformers": "sentence-transformers",
-    "slugify": "python-slugify",
-    "socks": "pysocks",
-    "telegram": "python-telegram-bot",
-    "typing_extensions": "typing-extensions",
-    "websocket": "websocket-client",
-    "zmq": "pyzmq",
     "gi": None,  # system-only
     "libtpu": None,
     "_curses": None,
 }
+
+DATA_FILE = Path(__file__).resolve().parent / "pypi_imports.tsv"
+
+
+def load_import_map() -> dict[str, str | None]:
+    """Data-file mappings, overlaid by the built-in table."""
+    table: dict[str, str | None] = {}
+    try:
+        lines = DATA_FILE.read_text().splitlines()
+    except OSError:
+        lines = []
+    for line in lines:
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split("\t")
+        if len(parts) != 2:
+            continue
+        import_name, pip_name = parts[0].strip(), parts[1].strip()
+        if not import_name or not pip_name:
+            continue
+        table[import_name] = None if pip_name == "-" else pip_name
+    table.update(IMPORT_TO_PIP)
+    return table
 
 
 def imported_top_modules(source: str) -> set[str]:
@@ -86,6 +82,11 @@ def imported_top_modules(source: str) -> set[str]:
     return mods
 
 
+def _base_name(requirement: str) -> str:
+    """Strip extras/version specifiers: 'pandas[excel]>=2' -> 'pandas'."""
+    return re.split(r"[\[<>=!~;@\s]", requirement, 1)[0].strip().lower()
+
+
 def load_skip_list(runtime_packages: Path) -> set[str]:
     skip: set[str] = set()
     for name in ("requirements.txt", "requirements-skip.txt"):
@@ -96,31 +97,37 @@ def load_skip_list(runtime_packages: Path) -> set[str]:
             line = line.split("#", 1)[0].strip()
             if not line:
                 continue
-            # strip extras/version specifiers: "pandas[excel]>=2" -> "pandas"
-            pkg = re.split(r"[\[<>=!~;]", line, 1)[0].strip().lower()
+            pkg = _base_name(line)
             if pkg:
                 skip.add(pkg)
     return skip
 
 
-def main() -> None:
-    script = Path(sys.argv[1])
-    runtime_packages = Path(sys.argv[2]) if len(sys.argv) > 2 else None
-    mods = imported_top_modules(script.read_text())
+def missing_packages(
+    source: str, runtime_packages: Path | None = None
+) -> list[str]:
+    mods = imported_top_modules(source)
     skip = load_skip_list(runtime_packages) if runtime_packages else set()
+    import_map = load_import_map()
     missing: list[str] = []
     for mod in sorted(mods):
         if mod in sys.stdlib_module_names:
             continue
         if importlib.util.find_spec(mod) is not None:
             continue
-        pip_name = IMPORT_TO_PIP.get(mod, mod)
+        pip_name = import_map.get(mod, mod)
         if pip_name is None:
             continue
-        if pip_name.lower() in skip or mod.lower() in skip:
+        if _base_name(pip_name) in skip or mod.lower() in skip:
             continue
         missing.append(pip_name)
-    print("\n".join(missing))
+    return missing
+
+
+def main() -> None:
+    script = Path(sys.argv[1])
+    runtime_packages = Path(sys.argv[2]) if len(sys.argv) > 2 else None
+    print("\n".join(missing_packages(script.read_text(), runtime_packages)))
 
 
 if __name__ == "__main__":

@@ -293,6 +293,7 @@ class Conn {
       case 404: return "Not Found";
       case 408: return "Request Timeout";
       case 500: return "Internal Server Error";
+      case 503: return "Service Unavailable";
       default: return "Unknown";
     }
   }

@@ -208,10 +208,14 @@ def _start_server_watchdog() -> None:
 
     def watch() -> None:
         poller = select.poll()
-        poller.register(REQ_FD, 0)  # HUP/ERR are always reported
+        poller.register(REQ_FD, 0)  # HUP/ERR/NVAL are always reported
+        # POLLNVAL: user code closed fd 3 out from under us. The runner can
+        # never receive another request, and without exiting on it poll()
+        # would return NVAL instantly forever — a 100%-CPU busy spin.
+        fatal = select.POLLHUP | select.POLLERR | select.POLLNVAL
         while True:
             for _, event in poller.poll():
-                if event & (select.POLLHUP | select.POLLERR):
+                if event & fatal:
                     os._exit(0)
 
     threading.Thread(target=watch, name="server-watchdog", daemon=True).start()

@@ -18,8 +18,9 @@
 //
 // Env knobs: APP_LISTEN_ADDR (0.0.0.0:8000; port 0 = ephemeral, printed),
 // APP_WORKSPACE (/workspace), APP_RUNTIME_PACKAGES (/runtime-packages),
-// APP_PYTHON (python3), APP_WARM_RUNNER (1), APP_AUTO_INSTALL_DEPS (0),
-// APP_DEFAULT_TIMEOUT (60), APP_MAX_OUTPUT_BYTES (10485760).
+// APP_PYTHON (python3), APP_WARM_RUNNER (1), APP_WARM_EAGER (1; 0 = warm-up
+// waits for POST /warmup), APP_RUNNER_READY_TIMEOUT (180), APP_AUTO_INSTALL_DEPS
+// (0), APP_DEFAULT_TIMEOUT (60), APP_MAX_OUTPUT_BYTES (10485760).
 
 #include <dirent.h>
 #include <fcntl.h>
@@ -31,6 +32,7 @@
 #include <sys/wait.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cstdarg>
 #include <cstdio>
 #include <cstdlib>
@@ -38,6 +40,7 @@
 #include <map>
 #include <mutex>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "http.hpp"
@@ -331,10 +334,12 @@ ExecOutcome run_subprocess(const std::vector<std::string>& argv,
 
 class WarmRunner {
  public:
-  WarmRunner(std::string python, std::string runner_script, std::string workspace)
+  WarmRunner(std::string python, std::string runner_script, std::string workspace,
+             double ready_timeout_s)
       : python_(std::move(python)),
         runner_script_(std::move(runner_script)),
-        workspace_(std::move(workspace)) {}
+        workspace_(std::move(workspace)),
+        ready_timeout_s_(ready_timeout_s) {}
 
   bool start() {
     int req_pipe[2];   // server writes → runner fd 3
@@ -375,9 +380,9 @@ class WarmRunner {
     resp_fd_ = resp_pipe[0];
     g_runner_sid = pid_;
     // Wait for the ready line (runner imports jax → can take seconds on TPU;
-    // that's the point: it happens at sandbox boot, not at Execute time).
+    // that's the point: it happens at sandbox warm-up, not at Execute time).
     std::string line;
-    if (!read_line(line, 120.0)) {
+    if (!read_line(line, ready_timeout_s_)) {
       log_msg("warm runner failed to become ready");
       stop();
       return false;
@@ -478,6 +483,7 @@ class WarmRunner {
   }
 
   std::string python_, runner_script_, workspace_;
+  double ready_timeout_s_ = 180.0;
   pid_t pid_ = -1;
   int req_fd_ = -1, resp_fd_ = -1;
   bool ready_ = false;
@@ -495,6 +501,7 @@ struct ServerState {
   std::string runner_script;
   std::string deps_script;
   bool warm_enabled = true;
+  bool warm_eager = true;  // start warm-up at boot (pods); 0 = wait for /warmup
   bool auto_install = false;
   int num_hosts = 1;  // >1 → this sandbox is one host of a multi-host slice
   double default_timeout = 60.0;
@@ -505,6 +512,60 @@ struct ServerState {
 };
 
 ServerState g_state;
+
+// Warm-up state machine. The server announces its port and serves HTTP from
+// the moment it boots; the warm runner's jax import / TPU init (seconds to
+// minutes) runs on a background thread. Round 1 serialized these — readiness
+// waited on TPU init, so any init slower than the control plane's ready
+// timeout failed every spawn (the r01 bench killer). Now "reachable" and
+// "TPU-hot" are separate facts: /healthz reports warm_state, /readyz gates
+// k8s readiness on it, POST /warmup lets the control plane decide WHEN init
+// runs (it holds the per-chip lease — see backends/local.py).
+enum WarmState { kWarmOff = 0, kWarmPending = 1, kWarmReady = 2, kWarmFailed = 3 };
+std::atomic<int> g_warm_state{kWarmOff};
+std::atomic<bool> g_ever_ready{false};
+std::mutex g_warm_transition_mutex;
+
+const char* warm_state_name(int s) {
+  switch (s) {
+    case kWarmPending: return "pending";
+    case kWarmReady: return "ready";
+    case kWarmFailed: return "failed";
+    default: return "off";
+  }
+}
+
+// Kick off (or retry) warm-up on a background thread. Idempotent: no-op when
+// already pending/ready. Failed → pending retries (used for the
+// off-critical-path runner restart after a timeout kill).
+void start_warm_async() {
+  if (!g_state.warm_enabled || !g_state.runner) return;
+  {
+    std::lock_guard<std::mutex> l(g_warm_transition_mutex);
+    int s = g_warm_state.load();
+    if (s == kWarmPending || s == kWarmReady) return;
+    if (s == kWarmFailed && g_state.num_hosts > 1) return;  // see below
+    g_warm_state = kWarmPending;
+  }
+  std::thread([] {
+    bool ok;
+    {
+      std::lock_guard<std::mutex> l(g_state.runner_mutex);
+      ok = g_state.runner->start();
+    }
+    if (ok) g_ever_ready = true;
+    g_warm_state = ok ? kWarmReady : kWarmFailed;
+    if (!ok) {
+      // On a multi-host slice the runner IS the jax.distributed membership;
+      // a lone restart could never rendezvous (its peers' runners are still
+      // in the old cluster), so failure is terminal and the control plane
+      // must dispose the whole slice group.
+      log_msg("warm-up failed%s", g_state.num_hosts > 1
+                                      ? " on a multi-host slice (terminal)"
+                                      : "");
+    }
+  }).detach();
+}
 
 const std::string* prefix_base(const std::string& prefix) {
   if (prefix == "workspace") return &g_state.workspace;
@@ -680,34 +741,52 @@ void handle_execute(const minihttp::Request& /*req*/, minihttp::Conn& conn) {
   bool timed_out = false;
   bool runner_died = false;
   bool ran_warm = false;
+  bool restart_runner = false;
 
   if (g_state.warm_enabled && g_state.runner) {
-    std::lock_guard<std::mutex> rlock(g_state.runner_mutex);
-    if (!g_state.runner->alive()) {
-      // runner died (previous timeout) — restart for this sandbox
-      g_state.runner->start();
+    // Initial warm-up may still be in flight (the control plane normally
+    // gates on /healthz warm before admitting a sandbox, but direct clients
+    // and eager-mode pods can race it). Racing a cold subprocess against the
+    // runner's TPU init would make both fight over the chip — wait it out.
+    // Bounded: the warm thread resolves within the runner's ready timeout.
+    // A RESTART in flight (g_ever_ready) is different: the previous request
+    // timed out, and the next one must not pay TPU re-init on its critical
+    // path — it falls through to the cold subprocess immediately.
+    while (g_warm_state.load() == kWarmPending && !g_ever_ready.load()) {
+      usleep(50 * 1000);
     }
-    if (g_state.runner->alive()) {
-      minijson::Object reqo;
-      reqo["source_path"] = minijson::Value(script_path);
-      reqo["stdout_path"] = minijson::Value(stdout_path);
-      reqo["stderr_path"] = minijson::Value(stderr_path);
-      if (extra_env.is_object()) reqo["env"] = extra_env;
-      minijson::Value resp;
-      WarmRunner::ExecResult r = g_state.runner->execute(
-          minijson::Value(reqo).dump(), timeout_s > 0 ? timeout_s + 0.5 : 0, resp);
-      ran_warm = true;
-      switch (r) {
-        case WarmRunner::ExecResult::kOk:
-          exit_code = static_cast<int>(resp.get_number("exit_code", -1));
-          break;
-        case WarmRunner::ExecResult::kTimeout:
-          timed_out = true;
-          break;
-        case WarmRunner::ExecResult::kDied:
-          runner_died = true;
-          break;
+    if (g_warm_state.load() == kWarmReady) {
+      std::lock_guard<std::mutex> rlock(g_state.runner_mutex);
+      if (g_state.runner->alive()) {
+        minijson::Object reqo;
+        reqo["source_path"] = minijson::Value(script_path);
+        reqo["stdout_path"] = minijson::Value(stdout_path);
+        reqo["stderr_path"] = minijson::Value(stderr_path);
+        if (extra_env.is_object()) reqo["env"] = extra_env;
+        minijson::Value resp;
+        WarmRunner::ExecResult r = g_state.runner->execute(
+            minijson::Value(reqo).dump(), timeout_s > 0 ? timeout_s + 0.5 : 0, resp);
+        ran_warm = true;
+        switch (r) {
+          case WarmRunner::ExecResult::kOk:
+            exit_code = static_cast<int>(resp.get_number("exit_code", -1));
+            break;
+          case WarmRunner::ExecResult::kTimeout:
+            timed_out = true;
+            restart_runner = true;
+            break;
+          case WarmRunner::ExecResult::kDied:
+            runner_died = true;
+            restart_runner = true;
+            break;
+        }
       }
+    }
+    if (restart_runner) {
+      // Off the critical path: restart in the background; this response (and
+      // any request landing before the restart finishes) is served cold.
+      g_warm_state = kWarmFailed;
+      start_warm_async();
     }
   }
 
@@ -769,23 +848,51 @@ void handle_execute(const minihttp::Request& /*req*/, minihttp::Conn& conn) {
   conn.send_response(200, "application/json", minijson::Value(resp).dump());
 }
 
-void handle_healthz(const minihttp::Request&, minihttp::Conn& conn) {
+minijson::Value warm_status_body() {
   minijson::Object resp;
   resp["status"] = minijson::Value("ok");
-  bool warm = g_state.runner && g_state.runner->alive();
+  int state = g_warm_state.load();
+  bool warm = state == kWarmReady && g_state.runner && g_state.runner->alive();
   resp["warm"] = minijson::Value(warm);
+  resp["warm_state"] = minijson::Value(std::string(warm_state_name(state)));
   if (warm) {
     resp["backend"] = minijson::Value(g_state.runner->backend());
     resp["device_count"] = minijson::Value(g_state.runner->device_count());
   }
-  conn.send_response(200, "application/json", minijson::Value(resp).dump());
+  return minijson::Value(resp);
+}
+
+void handle_healthz(const minihttp::Request&, minihttp::Conn& conn) {
+  // Liveness + warm telemetry: always 200 while the server is up; the body
+  // carries warm_state so the control plane can poll init progress.
+  conn.send_response(200, "application/json", warm_status_body().dump());
+}
+
+void handle_readyz(const minihttp::Request&, minihttp::Conn& conn) {
+  // Readiness: 503 until the sandbox can actually serve its purpose (warm
+  // runner hot, or warm mode off). This is what k8s readinessProbe targets,
+  // so "pod Ready" still means "TPU hot" without the server's *existence*
+  // depending on TPU init (the r01 failure mode).
+  bool ready = !g_state.warm_enabled || g_warm_state.load() == kWarmReady;
+  conn.send_response(ready ? 200 : 503, "application/json",
+                     warm_status_body().dump());
+}
+
+void handle_warmup(const minihttp::Request&, minihttp::Conn& conn) {
+  conn.drain_body();
+  start_warm_async();
+  conn.send_response(200, "application/json", warm_status_body().dump());
 }
 
 void route(const minihttp::Request& req, minihttp::Conn& conn) {
   if (req.method == "POST" && req.target == "/execute") {
     handle_execute(req, conn);
+  } else if (req.method == "POST" && req.target == "/warmup") {
+    handle_warmup(req, conn);
   } else if (req.method == "GET" && req.target == "/healthz") {
     handle_healthz(req, conn);
+  } else if (req.method == "GET" && req.target == "/readyz") {
+    handle_readyz(req, conn);
   } else if (req.method == "PUT") {
     handle_upload(req, conn);
   } else if (req.method == "GET" || req.method == "HEAD") {
@@ -822,6 +929,7 @@ int main() {
   g_state.runner_script = env_or("APP_RUNNER_SCRIPT", sibling("runner.py"));
   g_state.deps_script = env_or("APP_DEPS_SCRIPT", sibling("deps.py"));
   g_state.warm_enabled = env_flag("APP_WARM_RUNNER", true);
+  g_state.warm_eager = env_flag("APP_WARM_EAGER", true);
   g_state.auto_install = env_flag("APP_AUTO_INSTALL_DEPS", false);
   g_state.num_hosts = static_cast<int>(env_num("APP_NUM_HOSTS", 1));
   // Local-subprocess backend sets this so a SIGKILLed control plane can't
@@ -844,30 +952,27 @@ int main() {
   sigaction(SIGTERM, &sa, nullptr);
   sigaction(SIGINT, &sa, nullptr);
 
-  WarmRunner runner(g_state.python, g_state.runner_script, g_state.workspace);
-  if (g_state.warm_enabled) {
-    if (runner.start()) {
-      g_state.runner = &runner;
-    } else if (g_state.num_hosts > 1) {
-      // One host of a multi-host slice: the warm runner IS the slice's
-      // jax.distributed membership. Coming up without it would present a
-      // healthy sandbox whose user code silently sees no mesh — refuse to
-      // start instead (the pod never turns Ready; the spawn fails loudly).
-      log_msg("warm runner failed on a multi-host slice; exiting");
-      return 1;
-    } else {
-      log_msg("warm runner unavailable; falling back to cold subprocess mode");
-    }
-  } else if (g_state.num_hosts > 1) {
+  if (!g_state.warm_enabled && g_state.num_hosts > 1) {
+    // A multi-host slice only exists through the warm runner's
+    // jax.distributed mesh — refusing a misconfigured boot beats presenting
+    // a sandbox whose user code silently sees no mesh.
     log_msg("APP_NUM_HOSTS>1 requires the warm runner; exiting");
     return 1;
   }
+  double ready_timeout = env_num("APP_RUNNER_READY_TIMEOUT", 180.0);
+  WarmRunner runner(g_state.python, g_state.runner_script, g_state.workspace,
+                    ready_timeout);
+  if (g_state.warm_enabled) g_state.runner = &runner;
 
+  // Announce the port BEFORE any TPU init: "reachable" must not wait on
+  // "hot". Warm-up runs on a background thread (eager mode) or when the
+  // control plane POSTs /warmup after acquiring its per-chip lease.
   minihttp::Server server(listen_addr, route);
-  // Port 0 → ephemeral; announce the bound port for the parent process.
   printf("LISTENING port=%d\n", server.port());
   fflush(stdout);
-  log_msg("executor-server listening on port %d (workspace=%s warm=%d)",
-          server.port(), g_state.workspace.c_str(), g_state.runner != nullptr);
+  log_msg("executor-server listening on port %d (workspace=%s warm=%d eager=%d)",
+          server.port(), g_state.workspace.c_str(), (int)g_state.warm_enabled,
+          (int)g_state.warm_eager);
+  if (g_state.warm_enabled && g_state.warm_eager) start_warm_async();
   server.serve_forever();
 }

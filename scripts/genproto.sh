@@ -3,5 +3,5 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 protoc --python_out=bee_code_interpreter_fs_tpu/proto -I proto \
-  proto/code_interpreter.proto proto/health.proto
+  proto/code_interpreter.proto proto/health.proto proto/reflection.proto
 echo "regenerated bee_code_interpreter_fs_tpu/proto/*_pb2.py"

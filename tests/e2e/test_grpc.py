@@ -13,9 +13,11 @@ import pytest
 from bee_code_interpreter_fs_tpu.config import Config
 from bee_code_interpreter_fs_tpu.proto import (
     HEALTH_SERVICE_NAME,
+    REFLECTION_SERVICE_NAME,
     SERVICE_NAME,
     code_interpreter_pb2 as pb2,
     health_pb2,
+    reflection_pb2,
 )
 from bee_code_interpreter_fs_tpu.services.backends.local import LocalSandboxBackend
 from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor
@@ -47,6 +49,15 @@ class Client:
             health_pb2.HealthCheckRequest,
             health_pb2.HealthCheckResponse,
             service=HEALTH_SERVICE_NAME,
+        )
+        self.reflect = channel.stream_stream(
+            f"/{REFLECTION_SERVICE_NAME}/ServerReflectionInfo",
+            request_serializer=(
+                reflection_pb2.ServerReflectionRequest.SerializeToString
+            ),
+            response_deserializer=(
+                reflection_pb2.ServerReflectionResponse.FromString
+            ),
         )
 
 
@@ -151,6 +162,60 @@ async def test_execute_custom_tool_error(client):
     )
     assert resp.WhichOneof("response") == "error"
     assert "division by zero" in resp.error.stderr
+
+
+async def test_reflection_list_services(client):
+    """The grpcurl `list` workflow (reference README.md:46): list_services
+    must name every registered service."""
+    call = client.reflect(
+        iter([reflection_pb2.ServerReflectionRequest(list_services="*")])
+    )
+    responses = [r async for r in call]
+    assert len(responses) == 1
+    names = {s.name for s in responses[0].list_services_response.service}
+    assert SERVICE_NAME in names
+    assert HEALTH_SERVICE_NAME in names
+    assert REFLECTION_SERVICE_NAME in names
+
+
+async def test_reflection_file_containing_symbol(client):
+    """The grpcurl `describe` workflow: fetching the file for a service
+    symbol must return a descriptor closure that actually parses and
+    contains the service definition."""
+    from google.protobuf import descriptor_pb2
+
+    call = client.reflect(
+        iter(
+            [
+                reflection_pb2.ServerReflectionRequest(
+                    file_containing_symbol=SERVICE_NAME
+                ),
+                reflection_pb2.ServerReflectionRequest(
+                    file_containing_symbol="code_interpreter.v1.ExecuteRequest"
+                ),
+                reflection_pb2.ServerReflectionRequest(
+                    file_containing_symbol="no.such.Symbol"
+                ),
+            ]
+        )
+    )
+    responses = [r async for r in call]
+    assert len(responses) == 3
+    for resp in responses[:2]:
+        assert resp.WhichOneof("message_response") == "file_descriptor_response"
+        protos = [
+            descriptor_pb2.FileDescriptorProto.FromString(raw)
+            for raw in resp.file_descriptor_response.file_descriptor_proto
+        ]
+        assert any(
+            svc.name == "CodeInterpreterService"
+            for proto in protos
+            for svc in proto.service
+        )
+    assert responses[2].WhichOneof("message_response") == "error_response"
+    assert responses[2].error_response.error_code == int(
+        grpc.StatusCode.NOT_FOUND.value[0]
+    )
 
 
 async def test_health_service(client):

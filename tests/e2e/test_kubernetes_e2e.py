@@ -161,8 +161,13 @@ async def test_file_roundtrip_through_fake_cluster(k8s_executor):
 
 async def test_pods_are_single_use(k8s_executor):
     executor, state = k8s_executor
+    import asyncio
+
     await executor.execute(source_code="x = 1")
     await executor.execute(source_code="print('second')")
-    # Used pods get deleted; at most the warm-pool replacement remains.
+    # Used pods get deleted off the hot path; drain the in-flight disposals
+    # (and the refill they race) before counting what's actually left.
+    await asyncio.gather(*executor._dispose_tasks, return_exceptions=True)
+    await asyncio.gather(*executor._fill_tasks, return_exceptions=True)
     live = [p for p in state.glob("*.json")]
     assert len(live) <= executor.config.executor_pod_queue_target_length + 1

@@ -63,13 +63,15 @@ def executor(tmp_path_factory):
     line = proc.stdout.readline().decode()
     port = int(re.search(r"port=(\d+)", line).group(1))
     client = httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=30.0)
-    # wait until responsive
-    for _ in range(100):
+    # The port is announced before warm-up (that's the round-2 design);
+    # wait for the background warm thread to finish before tests run.
+    for _ in range(200):
         try:
-            client.get("/healthz")
-            break
+            if client.get("/healthz").json().get("warm"):
+                break
         except httpx.TransportError:
-            time.sleep(0.1)
+            pass
+        time.sleep(0.1)
     yield client, ws
     client.close()
     proc.kill()
@@ -87,6 +89,21 @@ def test_healthz_warm(executor):
     health = client.get("/healthz").json()
     assert health["status"] == "ok"
     assert health["warm"] is True
+    assert health["warm_state"] == "ready"
+
+
+def test_readyz_ready(executor):
+    client, _ = executor
+    resp = client.get("/readyz")
+    assert resp.status_code == 200
+    assert resp.json()["warm"] is True
+
+
+def test_warmup_idempotent(executor):
+    client, _ = executor
+    resp = client.post("/warmup")
+    assert resp.status_code == 200
+    assert resp.json()["warm_state"] == "ready"
 
 
 def test_upload_download_roundtrip(executor):
@@ -147,10 +164,25 @@ def test_execute_timeout_and_recovery(executor):
     result = execute(client, "while True: pass", timeout=1)
     assert result["exit_code"] == -1
     assert "timed out" in result["stderr"]
-    # runner restarts; next request works
+    # The runner restart happens in the BACKGROUND (VERDICT r1 #9): the very
+    # next request must not pay runner re-init on its critical path — it is
+    # served by the cold subprocess immediately.
+    t0 = time.monotonic()
     result = execute(client, "print('recovered')")
+    elapsed = time.monotonic() - t0
     assert result["stdout"] == "recovered\n"
     assert result["exit_code"] == 0
+    assert result["warm"] is False
+    assert elapsed < 10, f"cold fallback took {elapsed:.1f}s"
+    # and the background restart eventually restores warm service
+    for _ in range(100):
+        if client.get("/healthz").json().get("warm"):
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail("runner did not restart in the background")
+    result = execute(client, "print('warm again')")
+    assert result["warm"] is True
 
 
 def test_execute_exception_traceback(executor):
@@ -227,10 +259,15 @@ def test_sigterm_reaps_runner_session(tmp_path):
     )
     try:
         assert b"port=" in proc.stdout.readline()
-        # the warm runner is the server's only child
-        children = subprocess.run(
-            ["pgrep", "-P", str(proc.pid)], capture_output=True, text=True
-        ).stdout.split()
+        # the warm runner is forked by a background warm-up thread now —
+        # poll for the server's only child to appear
+        deadline = time.time() + 10
+        children: list[str] = []
+        while time.time() < deadline and not children:
+            children = subprocess.run(
+                ["pgrep", "-P", str(proc.pid)], capture_output=True, text=True
+            ).stdout.split()
+            time.sleep(0.05)
         assert len(children) == 1, children
         runner_pid = int(children[0])
 
