@@ -53,7 +53,11 @@ async def main(ctx: ApplicationContext | None = None) -> None:
     except Exception:  # noqa: BLE001 — HTTP-only mode still works
         logger.exception("gRPC server failed to start; continuing HTTP-only")
 
-    ctx.code_executor.fill_pool_soon()
+    # Prefill the lane requests actually land on: with e.g.
+    # APP_DEFAULT_CHIP_COUNT=4, prefilling lane 0 would warm CPU-only
+    # sandboxes the default lane never consumes, and the first real Execute
+    # would pay the full cold TPU spawn.
+    ctx.code_executor.fill_pool_soon(ctx.config.default_chip_count)
 
     try:
         stop_task = asyncio.create_task(stop.wait())

@@ -106,6 +106,13 @@ class Config(BaseModel):
     # {"cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
     #  "cloud.google.com/gke-tpu-topology": "2x2"}.
     tpu_node_selector: dict = Field(default_factory=dict)
+    # Per-slice-size selector overrides, keyed by the TOTAL chip count of the
+    # requested slice (as a string, env vars are JSON): a 2-host v5e-8 slice
+    # needs topology "2x4" nodes while a single-host v5e-4 wants "2x2" — a
+    # single static selector cannot serve both (the multi-host pods would
+    # land on unrelated single-host slices where no ICI mesh can form).
+    # Example: {"8": {"cloud.google.com/gke-tpu-topology": "2x4"}}.
+    tpu_node_selector_by_chip_count: dict = Field(default_factory=dict)
     # Default chip count an Execute request gets when it doesn't ask.
     default_chip_count: int = 0  # 0 = whatever the sandbox has
     # Chips attached to one host of a slice. chip_count above this → a

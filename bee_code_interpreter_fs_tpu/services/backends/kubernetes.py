@@ -112,6 +112,18 @@ class KubernetesSandboxBackend(SandboxBackend):
                     self._owner_ref = False
             return self._owner_ref or None
 
+    def _node_selector_for(self, slice_chip_count: int) -> dict:
+        """Selector for the node shape that can host this SLICE: the
+        per-chip-count map wins (a 2-host v5e-8 slice needs different
+        topology nodes than a single-host v5e-4), else the static default."""
+        by_count = self.config.tpu_node_selector_by_chip_count
+        override = by_count.get(str(slice_chip_count)) or by_count.get(
+            slice_chip_count
+        )
+        if override:
+            return dict(override)
+        return dict(self.config.tpu_node_selector)
+
     def pod_manifest(
         self,
         name: str,
@@ -120,9 +132,16 @@ class KubernetesSandboxBackend(SandboxBackend):
         *,
         env_extra: list[dict] | None = None,
         group: str | None = None,
+        slice_chip_count: int | None = None,
+        hostname: str | None = None,
+        subdomain: str | None = None,
     ) -> dict:
         resources = deep_merge({}, self.config.executor_container_resources)
         spec: dict[str, Any] = {}
+        if hostname:
+            spec["hostname"] = hostname
+        if subdomain:
+            spec["subdomain"] = subdomain
         if chip_count > 0:
             tpu = self.config.tpu_resource_requests or {"google.com/tpu": None}
             chip_resources = {
@@ -133,8 +152,9 @@ class KubernetesSandboxBackend(SandboxBackend):
                 resources,
                 {"limits": dict(chip_resources), "requests": dict(chip_resources)},
             )
-            if self.config.tpu_node_selector:
-                spec["nodeSelector"] = dict(self.config.tpu_node_selector)
+            selector = self._node_selector_for(slice_chip_count or chip_count)
+            if selector:
+                spec["nodeSelector"] = selector
 
         env = [
             {"name": "APP_LISTEN_ADDR", "value": f"0.0.0.0:{EXECUTOR_PORT}"},
@@ -207,6 +227,49 @@ class KubernetesSandboxBackend(SandboxBackend):
         if owner:
             metadata["ownerReferences"] = [owner]
         return {"apiVersion": "v1", "kind": "Pod", "metadata": metadata, "spec": spec}
+
+    def _group_service_manifest(self, group: str, owner: dict | None) -> dict:
+        """Headless Service giving a slice group's pods stable DNS names
+        ({pod}.{group}) before they are Ready — required for
+        TPU_WORKER_HOSTNAMES and usable by the jax.distributed bootstrap."""
+        metadata: dict[str, Any] = {
+            "name": group,
+            "labels": {"app": "code-executor", "code-executor/slice-group": group},
+        }
+        if owner:
+            metadata["ownerReferences"] = [owner]
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": metadata,
+            "spec": {
+                "clusterIP": "None",
+                "publishNotReadyAddresses": True,
+                "selector": {"code-executor/slice-group": group},
+                "ports": [
+                    {"name": "executor", "port": EXECUTOR_PORT},
+                    {"name": "coordinator", "port": self.config.coordinator_port},
+                ],
+            },
+        }
+
+    async def _create_service(self, manifest: dict) -> None:
+        name = manifest["metadata"]["name"]
+        try:
+            await self.kubectl.create(manifest)
+        except KubectlError as e:
+            raise SandboxSpawnError(f"service {name} create failed: {e}") from e
+
+    def _delete_service_soon(self, name: str) -> None:
+        async def delete_service() -> None:
+            try:
+                await self.kubectl.delete("service", name, wait=False)
+            except KubectlError as e:
+                logger.warning("service %s delete failed: %s", name, e)
+
+        task = asyncio.get_running_loop().create_task(delete_service())
+        self._cleanup_tasks.add(task)
+        task.add_done_callback(self._cleanup_tasks.discard)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -322,41 +385,47 @@ class KubernetesSandboxBackend(SandboxBackend):
         chips_per_host = max(1, self.config.tpu_chips_per_host)
         owner = await self._owner_reference()
         coord_port = self.config.coordinator_port
+        # Stable DNS names via a per-group headless Service (pods get
+        # hostname/subdomain): libtpu's single-slice multi-host bootstrap
+        # needs every worker to know its peers by stable name BEFORE any pod
+        # is Ready, hence publishNotReadyAddresses.
+        worker_hostnames = ",".join(f"{name}.{group}" for name in names)
 
         def host_env(host_id: int, coordinator: str) -> list[dict]:
             return [
                 {"name": "APP_NUM_HOSTS", "value": str(num_hosts)},
                 {"name": "APP_HOST_ID", "value": str(host_id)},
                 {"name": "APP_COORDINATOR_ADDR", "value": coordinator},
+                # GKE TPU worker identity: libtpu forms the ICI mesh across
+                # hosts from these (single-slice multi-host bootstrap).
+                {"name": "TPU_WORKER_ID", "value": str(host_id)},
+                {"name": "TPU_WORKER_HOSTNAMES", "value": worker_hostnames},
             ]
 
+        def pod(i: int, coordinator: str) -> dict:
+            return self.pod_manifest(
+                names[i],
+                chips_per_host,
+                owner,
+                env_extra=host_env(i, coordinator),
+                group=group,
+                slice_chip_count=chip_count,
+                hostname=names[i],
+                subdomain=group,
+            )
+
         try:
+            await self._create_service(self._group_service_manifest(group, owner))
             # Host 0 binds the coordinator port itself; 0.0.0.0 is valid for
             # the binding side of jax.distributed.initialize.
-            await self._create_pod(
-                self.pod_manifest(
-                    names[0],
-                    chips_per_host,
-                    owner,
-                    env_extra=host_env(0, f"0.0.0.0:{coord_port}"),
-                    group=group,
-                )
-            )
+            await self._create_pod(pod(0, f"0.0.0.0:{coord_port}"))
             coordinator_ip = await self._wait_pod_ip(names[0])
             # return_exceptions on both gathers: every sibling create/wait
             # must settle before cleanup runs, or an in-flight create could
             # land after its delete and leak a pod holding TPU chips.
             created = await asyncio.gather(
                 *(
-                    self._create_pod(
-                        self.pod_manifest(
-                            names[i],
-                            chips_per_host,
-                            owner,
-                            env_extra=host_env(i, f"{coordinator_ip}:{coord_port}"),
-                            group=group,
-                        )
-                    )
+                    self._create_pod(pod(i, f"{coordinator_ip}:{coord_port}"))
                     for i in range(1, num_hosts)
                 ),
                 return_exceptions=True,
@@ -369,6 +438,7 @@ class KubernetesSandboxBackend(SandboxBackend):
         except (SandboxSpawnError, asyncio.CancelledError):
             for name in names:  # no partial slices
                 self._delete_soon(name)
+            self._delete_service_soon(group)
             raise
         urls = [f"http://{ip}:{EXECUTOR_PORT}" for ip in ips]
         sandbox = Sandbox(
@@ -400,6 +470,7 @@ class KubernetesSandboxBackend(SandboxBackend):
         if pods:
             self._live.pop(sandbox.id, None)
             await asyncio.gather(*(self.delete_by_name(name) for name in pods))
+            self._delete_service_soon(sandbox.id)
         else:
             await self.delete_by_name(sandbox.id)
 

@@ -146,6 +146,11 @@ class CodeExecutor:
         reraise=True,
     )
     async def _spawn_with_retry(self, chip_count: int) -> Sandbox:
+        # Evict on EVERY attempt, not once before the retry loop: a
+        # cross-lane refill that was mid-flight during the first eviction can
+        # park an idle slot-holding sandbox right after it, and only a fresh
+        # eviction at the next attempt can free that slot again.
+        await self._evict_idle_other_lanes(chip_count)
         start = time.perf_counter()
         sandbox = await self.backend.spawn(chip_count)
         self.metrics.spawn_seconds.observe(
@@ -194,7 +199,6 @@ class CodeExecutor:
         if pool:
             sandbox = pool.popleft()
         else:
-            await self._evict_idle_other_lanes(chip_count)
             sandbox = await self._spawn_with_retry(chip_count)
         self.fill_pool_soon(chip_count)
         return sandbox

@@ -1,0 +1,31 @@
+"""Cold-path script launcher: shell-fallback preprocessing + runpy.
+
+The warm runner (runner.py) applies the same shellfb.prepare() in-process;
+this launcher gives the cold-subprocess path (warm runner off or restarting)
+identical mixed-Python/shell semantics: `python launch.py <script> [argv...]`.
+"""
+
+import runpy
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+import shellfb  # noqa: E402
+
+del sys.path[0]
+
+
+def main() -> None:
+    source_path = sys.argv[1]
+    run_path = shellfb.prepare(source_path)
+    # argv as the script would see it when run directly
+    sys.argv = [source_path] + sys.argv[2:]
+    try:
+        runpy.run_path(run_path, run_name="__main__")
+    finally:
+        if run_path != source_path:
+            Path(run_path).unlink(missing_ok=True)
+
+
+if __name__ == "__main__":
+    main()

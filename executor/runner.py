@@ -117,13 +117,7 @@ def _profile_requested(env: dict) -> bool:
 
 
 def _import_jax_profile():
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    try:
-        import jax_profile
-
-        return jax_profile
-    finally:
-        sys.path.pop(0)
+    return _import_sibling("jax_profile")
 
 
 def _start_profile() -> str | None:
@@ -144,8 +138,23 @@ def _finish_profile(trace_dir: str) -> None:
         traceback.print_exc()
 
 
+def _import_sibling(name: str):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
 def _run_one(req: dict) -> int:
     source_path = req["source_path"]
+    run_path = source_path
+    try:
+        # Mixed Python/shell snippets run via the shellfb transform — the
+        # xonsh role (reference server.rs:197-207) without its 80 ms tax.
+        run_path = _import_sibling("shellfb").prepare(source_path)
+    except Exception:  # noqa: BLE001 — fallback is best-effort
+        traceback.print_exc()
     env = req.get("env") or {}
     # APP_JAX_PROFILE stays out of os.environ: the warm runner profiles the
     # run itself, and leaking the var would make a sitecustomize on the path
@@ -167,8 +176,8 @@ def _run_one(req: dict) -> int:
     exit_code = 0
     trace_dir = _start_profile() if _profile_requested(env) else None
     try:
-        sys.argv = [source_path]
-        runpy.run_path(source_path, run_name="__main__")
+        sys.argv = [source_path]  # argv[0] stays the user's path
+        runpy.run_path(run_path, run_name="__main__")
     except SystemExit as e:
         code = e.code
         exit_code = code if isinstance(code, int) else (0 if code is None else 1)
@@ -194,6 +203,11 @@ def _run_one(req: dict) -> int:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+        if run_path != source_path:
+            try:
+                os.unlink(run_path)
+            except OSError:
+                pass
     return exit_code
 
 

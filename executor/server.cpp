@@ -500,6 +500,7 @@ struct ServerState {
   std::string python;
   std::string runner_script;
   std::string deps_script;
+  std::string launch_script;
   bool warm_enabled = true;
   bool warm_eager = true;  // start warm-up at boot (pods); 0 = wait for /warmup
   bool auto_install = false;
@@ -792,6 +793,7 @@ void handle_execute(const minihttp::Request& /*req*/, minihttp::Conn& conn) {
 
   if (!ran_warm) {
     if (g_state.num_hosts > 1) {
+      // (cold path below is single-host only)
       // A multi-host slice only exists through the warm runner's
       // jax.distributed mesh; a cold subprocess here would run user code
       // with a silently missing mesh — fail loudly instead.
@@ -802,9 +804,11 @@ void handle_execute(const minihttp::Request& /*req*/, minihttp::Conn& conn) {
                          "slice; cannot execute\"}");
       return;
     }
-    ExecOutcome out =
-        run_subprocess({g_state.python, script_path}, g_state.workspace,
-                       stdout_path, stderr_path, timeout_s, &extra_env);
+    // launch.py wraps runpy with the same shell-syntax fallback the warm
+    // runner applies (mixed Python/shell snippets — the xonsh role).
+    ExecOutcome out = run_subprocess(
+        {g_state.python, g_state.launch_script, script_path}, g_state.workspace,
+        stdout_path, stderr_path, timeout_s, &extra_env);
     exit_code = out.exit_code;
     timed_out = out.timed_out;
   }
@@ -928,6 +932,7 @@ int main() {
   };
   g_state.runner_script = env_or("APP_RUNNER_SCRIPT", sibling("runner.py"));
   g_state.deps_script = env_or("APP_DEPS_SCRIPT", sibling("deps.py"));
+  g_state.launch_script = env_or("APP_LAUNCH_SCRIPT", sibling("launch.py"));
   g_state.warm_enabled = env_flag("APP_WARM_RUNNER", true);
   g_state.warm_eager = env_flag("APP_WARM_EAGER", true);
   g_state.auto_install = env_flag("APP_AUTO_INSTALL_DEPS", false);

@@ -1,0 +1,215 @@
+"""Shell-syntax fallback for user scripts that mix Python and shell lines.
+
+LLM-emitted snippets routinely interleave shell commands with Python — the
+reference runs everything under xonsh for exactly this reason
+(/root/reference/executor/server.rs:197-207). xonsh costs ~80 ms of startup
+per execution (server.rs:204); this module recovers the same mixed-snippet
+tolerance as a zero-cost source transform instead:
+
+1. SyntaxError repair loop: lines that don't parse as Python but look like
+   commands (``pip install requests``, ``echo hi > out.txt``) are rewritten
+   to ``__shell__('<line>')`` and the compile is retried, until the script
+   parses or a non-shell-ish error remains (which is then surfaced
+   untouched).
+2. Undefined-command statements: a bare ``ls`` IS valid Python (a Name
+   expression) that would die with NameError at runtime. An AST pass
+   rewrites top-level expression statements made of names never defined in
+   the script (including ``ls | grep foo`` pipe chains) into shell calls —
+   the same auto-recovery tradeoff xonsh makes.
+
+``__shell__`` is injected via builtins (never prepended to the source), so
+line numbers in user tracebacks stay exact. Scripts that are pure Python
+compile on the first try and pay one ``compile()`` — no interpreter swap,
+no startup tax.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import keyword
+import re
+
+MAX_FIXES = 200
+
+# First token of a line that may be treated as a shell command. Anything
+# starting with a Python keyword stays Python (it is broken Python, and the
+# user deserves the real SyntaxError).
+_CMD_TOKEN = re.compile(r"^[A-Za-z0-9_.~/-]+")
+
+
+def _shellish(stripped: str) -> bool:
+    if stripped.startswith("!"):  # IPython-style explicit shell escape
+        return True
+    match = _CMD_TOKEN.match(stripped)
+    if not match:
+        return False
+    first = match.group(0)
+    if keyword.iskeyword(first):
+        return False
+    return True
+
+
+_CD_LINE = re.compile(r"^cd(?:\s+(?P<path>\S+))?\s*$")
+_EXPORT_LINE = re.compile(r"^export\s+(?P<name>[A-Za-z_][A-Za-z0-9_]*)=(?P<value>.*)$")
+
+
+def run_shell_line(cmd: str) -> int:
+    """Execute one shell line; inherits cwd/env/stdout/stderr. Mirrors shell
+    script semantics (no set -e): a failing command reports via stderr and
+    the next line still runs.
+
+    ``cd <dir>`` and ``export K=V`` as standalone lines mutate the PYTHON
+    process (os.chdir / os.environ) — under xonsh those persist across lines
+    and into the surrounding Python, and each line here is otherwise its own
+    subprocess whose state would vanish. Compound commands (``cd x && make``)
+    stay in one subprocess, where the shell scopes them itself."""
+    import os
+    import subprocess
+    import sys
+
+    cd = _CD_LINE.match(cmd.strip())
+    if cd:
+        target = os.path.expanduser(cd.group("path") or "~")
+        try:
+            os.chdir(target)
+            return 0
+        except OSError as e:
+            print(f"cd: {target}: {e.strerror}", file=sys.stderr)
+            return 1
+    export = _EXPORT_LINE.match(cmd.strip())
+    if export:
+        os.environ[export.group("name")] = export.group("value").strip("'\"")
+        return 0
+    return subprocess.run(cmd, shell=True).returncode
+
+
+def install_shell_builtin() -> None:
+    builtins.__shell__ = run_shell_line
+
+
+def _line_replace(lines: list[str], lineno: int, command: str) -> None:
+    line = lines[lineno - 1]
+    indent = line[: len(line) - len(line.lstrip())]
+    lines[lineno - 1] = f"{indent}__shell__({command!r})"
+
+
+def _fix_syntax_lines(source: str) -> tuple[str, bool]:
+    """Repair loop over SyntaxErrors; returns (source, fully_parses)."""
+    lines = source.split("\n")
+    touched: set[int] = set()
+    for _ in range(MAX_FIXES):
+        candidate = "\n".join(lines)
+        try:
+            compile(candidate, "<fallback-check>", "exec")
+            return candidate, True
+        except SyntaxError as e:
+            lineno = e.lineno
+            if (
+                lineno is None
+                or not 1 <= lineno <= len(lines)
+                or lineno in touched
+            ):
+                return source, False
+            stripped = lines[lineno - 1].strip()
+            # A ';' means Python and shell may share the line ('x = 1; echo
+            # hi') — whole-line replacement would swallow the Python part.
+            # Surface the original error instead of guessing.
+            if not stripped or ";" in stripped or not _shellish(stripped):
+                return source, False
+            touched.add(lineno)
+            _line_replace(lines, lineno, stripped.lstrip("!").strip())
+        except ValueError:
+            return source, False
+    return source, False
+
+
+def _defined_names(tree: ast.Module) -> set[str]:
+    """Every name the script itself binds, anywhere (conservative scope)."""
+    defined: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            defined.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            defined.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                defined.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, ast.arg):
+            defined.add(node.arg)
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            defined.update(node.names)
+    return defined
+
+
+def _is_command_expr(value: ast.expr, defined: set[str]) -> bool:
+    """True for expressions that can only be shell commands: bare undefined
+    names and ``|``-chains of them (``ls``, ``ls | grep foo``)."""
+    if isinstance(value, ast.Name):
+        return value.id not in defined and not hasattr(builtins, value.id)
+    if isinstance(value, ast.BinOp) and isinstance(value.op, ast.BitOr):
+        # Every leaf must be an undefined name (`ls | wc`): a chain with any
+        # defined operand is much more likely real Python with a typo, and
+        # the honest NameError beats a mystifying `sh: not found`.
+        return _is_command_expr(value.left, defined) and _is_command_expr(
+            value.right, defined
+        )
+    return False
+
+
+def _fix_undefined_commands(source: str) -> str:
+    """Rewrite single-line expression statements of undefined names into
+    shell calls (module top level and inside simple blocks)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:  # pragma: no cover — caller ensured it parses
+        return source
+    defined = _defined_names(tree)
+    lines = source.split("\n")
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Expr):
+            continue
+        if node.lineno != node.end_lineno:  # multi-line: leave alone
+            continue
+        if _is_command_expr(node.value, defined):
+            segment = ast.get_source_segment(source, node)
+            # Only when the statement IS the whole line: 'x = 1; ls' must
+            # not lose the assignment to a whole-line rewrite.
+            if segment and segment.strip() == lines[node.lineno - 1].strip():
+                _line_replace(lines, node.lineno, segment.strip())
+    return "\n".join(lines)
+
+
+def transform(source: str) -> tuple[str, bool]:
+    """Returns (runnable_source, changed). Pure-Python sources come back
+    untouched after one compile(); unfixable sources come back untouched so
+    the user sees the original SyntaxError."""
+    fixed, parses = _fix_syntax_lines(source)
+    if not parses:
+        return source, False
+    result = _fix_undefined_commands(fixed)
+    return result, result != source
+
+
+def prepare(source_path: str) -> str:
+    """Transform the script at source_path if it needs shell fallback;
+    returns the path to run (a sibling temp file when transformed). Installs
+    the ``__shell__`` builtin either way — cheap, and keeps behavior
+    identical whether or not a fallback happened."""
+    install_shell_builtin()
+    try:
+        with open(source_path, encoding="utf-8", errors="replace") as f:
+            source = f.read()
+    except OSError:
+        return source_path
+    transformed, changed = transform(source)
+    if not changed:
+        return source_path
+    import tempfile
+
+    fd, path = tempfile.mkstemp(suffix=".py", prefix="shellfb-")
+    with open(fd, "w", encoding="utf-8") as f:
+        f.write(transformed)
+    return path

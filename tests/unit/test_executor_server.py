@@ -185,6 +185,24 @@ def test_execute_timeout_and_recovery(executor):
     assert result["warm"] is True
 
 
+def test_execute_mixed_shell_python(executor):
+    """Mixed Python/shell snippets (the xonsh role, reference server.rs:
+    197-207) execute through the warm runner via the shellfb transform."""
+    result = execute(
+        client_of(executor),
+        "x = 21\necho marker-line > shell_out.txt\n"
+        "print(open('shell_out.txt').read().strip())\nprint(x * 2)",
+    )
+    assert result["exit_code"] == 0
+    assert result["stdout"] == "marker-line\n42\n"
+    assert "shell_out.txt" in result["files"]
+
+
+def client_of(executor):
+    client, _ = executor
+    return client
+
+
 def test_execute_exception_traceback(executor):
     client, _ = executor
     result = execute(client, "1/0")

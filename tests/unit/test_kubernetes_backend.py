@@ -233,27 +233,78 @@ async def test_spawn_multihost_group(fake_kubectl):
         assert env["APP_NUM_HOSTS"] == "2"
         assert env["APP_HOST_ID"] == str(i)
         assert manifest["metadata"]["labels"]["code-executor/slice-group"] == sandbox.id
+        # libtpu single-slice multi-host worker identity + stable DNS names
+        assert env["TPU_WORKER_ID"] == str(i)
+        assert env["TPU_WORKER_HOSTNAMES"] == (
+            f"{sandbox.id}-h0.{sandbox.id},{sandbox.id}-h1.{sandbox.id}"
+        )
+        assert manifest["spec"]["hostname"] == f"{sandbox.id}-h{i}"
+        assert manifest["spec"]["subdomain"] == sandbox.id
     env0 = {e["name"]: e["value"] for e in manifests[0]["spec"]["containers"][0]["env"]}
     env1 = {e["name"]: e["value"] for e in manifests[1]["spec"]["containers"][0]["env"]}
     assert env0["APP_COORDINATOR_ADDR"] == "0.0.0.0:8476"  # host 0 binds
     assert env1["APP_COORDINATOR_ADDR"] == "10.0.0.7:8476"  # peers dial host 0
 
-    # pod 0 created → IP polled → peer created → both waited on
+    # the headless service gives not-yet-Ready pods resolvable names
+    service = json.loads((state / f"{sandbox.id}.json").read_text())
+    assert service["kind"] == "Service"
+    assert service["spec"]["clusterIP"] == "None"
+    assert service["spec"]["publishNotReadyAddresses"] is True
+    assert service["spec"]["selector"] == {
+        "code-executor/slice-group": sandbox.id
+    }
+
+    # service → pod 0 created → IP polled → peer created → both waited on
     verbs = [c["argv"][0] for c in calls()]
-    assert verbs[0] == "create"
-    assert "get" in verbs[1:verbs.index("create", 1)]  # IP poll before peer create
-    assert verbs.count("create") == 2
+    assert verbs[0] == "create"  # the service
+    assert verbs[1] == "create"  # pod 0
+    assert "get" in verbs[2:verbs.index("create", 2)]  # IP poll before peer create
+    assert verbs.count("create") == 3
     assert verbs.count("wait") == 2
 
 
+async def test_multihost_topology_selector_by_slice_size(fake_kubectl):
+    """ADVICE r1 #1: the slice's TOTAL chip count picks the node topology —
+    a static single-host selector would scatter group pods across unrelated
+    slices where the ICI mesh cannot form."""
+    kubectl, state, _ = fake_kubectl
+    backend = _backend(
+        kubectl,
+        tpu_chips_per_host=4,
+        tpu_node_selector_by_chip_count={
+            "8": {
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                "cloud.google.com/gke-tpu-topology": "2x4",
+            }
+        },
+    )
+    sandbox = await backend.spawn(chip_count=8)
+    for i in range(2):
+        manifest = json.loads((state / f"{sandbox.id}-h{i}.json").read_text())
+        assert (
+            manifest["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"]
+            == "2x4"
+        )
+    # single-host spawns keep the static selector
+    single = await backend.spawn(chip_count=4)
+    manifest = json.loads((state / f"{single.id}.json").read_text())
+    assert (
+        manifest["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"]
+        == "2x2"
+    )
+
+
 async def test_multihost_delete_removes_all_pods(fake_kubectl):
+    import asyncio
+
     kubectl, state, calls = fake_kubectl
     backend = _backend(kubectl, tpu_chips_per_host=4)
     sandbox = await backend.spawn(chip_count=16)
     assert sandbox.num_hosts == 4
     await backend.delete(sandbox)
+    await asyncio.sleep(0.2)  # service delete is fire-and-tracked
     deleted = {c["argv"][2] for c in calls() if c["argv"][0] == "delete"}
-    assert deleted == {f"{sandbox.id}-h{i}" for i in range(4)}
+    assert deleted == {f"{sandbox.id}-h{i}" for i in range(4)} | {sandbox.id}
 
 
 async def test_multihost_spawn_failure_cleans_whole_group(fake_kubectl):
@@ -266,7 +317,8 @@ async def test_multihost_spawn_failure_cleans_whole_group(fake_kubectl):
         await backend.spawn(chip_count=8)
     await asyncio.sleep(0.2)  # fire-and-forget deletes
     deleted = {c["argv"][2] for c in calls() if c["argv"][0] == "delete"}
-    assert len(deleted) == 2  # no partial slices left behind
+    # both pods AND the group's headless service: no partial slices left
+    assert len(deleted) == 3
 
 
 def test_num_hosts_for_tiling():

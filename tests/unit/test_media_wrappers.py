@@ -1,0 +1,78 @@
+"""Sandbox media tool wrappers (executor/wrappers/).
+
+Reference parity: its sandbox wraps pandoc to pin the weasyprint PDF engine
+and ffmpeg to silence the startup banner (/root/reference/executor/
+pandoc-wrapper, ffmpeg-wrapper, Dockerfile:111-116). The real tools are not
+installed on the dev machine, so the wrappers are driven against stub
+binaries via their *_REAL override — asserting exactly what argv reaches
+the real tool.
+"""
+
+import os
+import stat
+import subprocess
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+WRAPPERS = REPO_ROOT / "executor" / "wrappers"
+
+STUB = "#!/bin/sh\nprintf '%s\\n' \"$@\"\n"
+
+
+def _stub(tmp_path: Path, name: str) -> Path:
+    path = tmp_path / name
+    path.write_text(STUB)
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return path
+
+
+def _run(wrapper: str, args: list[str], env_var: str, stub: Path) -> list[str]:
+    wrapper_path = WRAPPERS / wrapper
+    proc = subprocess.run(
+        ["sh", str(wrapper_path), *args],
+        capture_output=True,
+        text=True,
+        env={**os.environ, env_var: str(stub)},
+    )
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout.splitlines()
+
+
+def test_pandoc_pdf_output_defaults_to_weasyprint(tmp_path):
+    stub = _stub(tmp_path, "pandoc-real")
+    argv = _run(
+        "pandoc", ["doc.md", "-o", "out.pdf"], "PANDOC_REAL", stub
+    )
+    assert argv == ["--pdf-engine=weasyprint", "doc.md", "-o", "out.pdf"]
+
+
+def test_pandoc_non_pdf_untouched(tmp_path):
+    stub = _stub(tmp_path, "pandoc-real")
+    argv = _run("pandoc", ["doc.md", "-o", "out.html"], "PANDOC_REAL", stub)
+    assert argv == ["doc.md", "-o", "out.html"]
+
+
+def test_pandoc_explicit_engine_wins(tmp_path):
+    stub = _stub(tmp_path, "pandoc-real")
+    argv = _run(
+        "pandoc",
+        ["--pdf-engine=xelatex", "doc.md", "-o", "out.pdf"],
+        "PANDOC_REAL",
+        stub,
+    )
+    assert argv == ["--pdf-engine=xelatex", "doc.md", "-o", "out.pdf"]
+    argv = _run(
+        "pandoc",
+        ["--pdf-engine", "xelatex", "doc.md", "-o", "out.pdf"],
+        "PANDOC_REAL",
+        stub,
+    )
+    assert argv == ["--pdf-engine", "xelatex", "doc.md", "-o", "out.pdf"]
+
+
+def test_ffmpeg_banner_hidden(tmp_path):
+    stub = _stub(tmp_path, "ffmpeg-real")
+    argv = _run(
+        "ffmpeg", ["-i", "in.mp4", "out.gif"], "FFMPEG_REAL", stub
+    )
+    assert argv == ["-hide_banner", "-i", "in.mp4", "out.gif"]

@@ -1,0 +1,175 @@
+"""Shell-syntax fallback (executor/shellfb.py).
+
+The reference ran user code under xonsh precisely because LLM-emitted
+snippets mix Python and shell lines (/root/reference/executor/server.rs:
+197-207, examples/escaping.py exercises quoting through it). The TPU build
+dropped xonsh for its ~80 ms startup tax; these tests pin the replacement:
+a source transform that keeps pure Python untouched and rewrites shell-ish
+lines to subprocess calls.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+EXECUTOR_DIR = REPO_ROOT / "executor"
+sys.path.insert(0, str(EXECUTOR_DIR))
+import shellfb  # noqa: E402
+
+sys.path.pop(0)
+
+
+def test_pure_python_untouched():
+    src = "x = 1\nprint(x + 1)\n"
+    out, changed = shellfb.transform(src)
+    assert not changed
+    assert out == src
+
+
+def test_syntax_shell_line_rewritten():
+    src = "print('before')\npip install requests\nprint('after')\n"
+    out, changed = shellfb.transform(src)
+    assert changed
+    assert "__shell__('pip install requests')" in out
+    assert out.splitlines()[0] == "print('before')"
+
+
+def test_bare_ls_rewritten():
+    # `ls` is VALID Python (a Name) — must still become a shell call.
+    src = "open('f.txt','w').write('x')\nls\n"
+    out, changed = shellfb.transform(src)
+    assert changed
+    assert "__shell__('ls')" in out
+
+
+def test_defined_name_not_rewritten():
+    src = "ls = 5\nls\n"
+    out, changed = shellfb.transform(src)
+    assert not changed
+
+
+def test_pipe_chain_of_undefined_names():
+    src = "ls | wc\n"
+    out, changed = shellfb.transform(src)
+    assert changed
+    assert "__shell__('ls | wc')" in out
+
+
+def test_genuine_python_syntax_error_surfaces():
+    # A broken Python statement (keyword-led) must NOT silently become shell.
+    src = "def broken(:\n    pass\n"
+    out, changed = shellfb.transform(src)
+    assert not changed
+    assert out == src
+
+
+def test_bang_line():
+    src = "!echo hi\n"
+    out, changed = shellfb.transform(src)
+    assert changed
+    assert "__shell__('echo hi')" in out
+
+
+def test_indented_shell_line():
+    src = "for i in range(2):\n    echo hello world\n"
+    out, changed = shellfb.transform(src)
+    assert changed
+    assert "    __shell__('echo hello world')" in out
+
+
+def test_semicolon_mixed_line_not_swallowed():
+    # 'x = 1; ls' — rewriting the whole line would delete the assignment.
+    out, changed = shellfb.transform("x = 1; ls\nprint(x)\n")
+    assert not changed
+    out, changed = shellfb.transform("x = 1; echo hi\nprint(x)\n")
+    assert not changed  # SyntaxError path: surface original error
+
+
+def test_cd_persists_across_lines(tmp_path):
+    script = tmp_path / "cd.py"
+    (tmp_path / "sub").mkdir()
+    script.write_text(
+        "mkdir -p sub\ncd sub\necho here > inner.txt\n"
+        "import os\nprint(os.path.basename(os.getcwd()))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(EXECUTOR_DIR / "launch.py"), str(script)],
+        capture_output=True,
+        text=True,
+        cwd=tmp_path,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip() == "sub"
+    assert (tmp_path / "sub" / "inner.txt").exists()  # cd affected the echo
+
+
+def test_export_persists_to_python(tmp_path):
+    script = tmp_path / "exp.py"
+    script.write_text(
+        "export MY_SETTING=hello\nimport os\nprint(os.environ['MY_SETTING'])\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(EXECUTOR_DIR / "launch.py"), str(script)],
+        capture_output=True,
+        text=True,
+        cwd=tmp_path,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip() == "hello"
+
+
+def test_launcher_cleans_up_transformed_file(tmp_path, monkeypatch):
+    monkeypatch.setenv("TMPDIR", str(tmp_path / "tmp"))
+    (tmp_path / "tmp").mkdir()
+    script = tmp_path / "clean.py"
+    script.write_text("echo cleanup-check\n")
+    proc = subprocess.run(
+        [sys.executable, str(EXECUTOR_DIR / "launch.py"), str(script)],
+        capture_output=True,
+        text=True,
+        cwd=tmp_path,
+        env={**os.environ, "TMPDIR": str(tmp_path / "tmp")},
+    )
+    assert proc.returncode == 0
+    assert list((tmp_path / "tmp").glob("shellfb-*")) == []
+
+
+def test_end_to_end_mixed_script(tmp_path):
+    """Mirror of the reference examples/escaping.py intent: mixed snippet
+    executes, shell lines really run, Python quoting survives."""
+    script = tmp_path / "mixed.py"
+    script.write_text(
+        "msg = \"it's 'quoted'\"\n"
+        "echo shell-ran > marker.txt\n"
+        "print(open('marker.txt').read().strip())\n"
+        "print(msg)\n"
+    )
+    run_path = shellfb.prepare(str(script))
+    proc = subprocess.run(
+        [sys.executable, str(EXECUTOR_DIR / "launch.py"), str(script)],
+        capture_output=True,
+        text=True,
+        cwd=tmp_path,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout == "shell-ran\nit's 'quoted'\n"
+    assert run_path != str(script)  # a transformed sibling was produced
+    Path(run_path).unlink(missing_ok=True)
+
+
+def test_failing_shell_line_does_not_stop_script(tmp_path):
+    script = tmp_path / "failing.py"
+    script.write_text(
+        "definitely-not-a-command --flag\nprint('still here')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(EXECUTOR_DIR / "launch.py"), str(script)],
+        capture_output=True,
+        text=True,
+        cwd=tmp_path,
+    )
+    assert proc.returncode == 0
+    assert "still here" in proc.stdout
+    assert "not found" in proc.stderr or "not-a-command" in proc.stderr
