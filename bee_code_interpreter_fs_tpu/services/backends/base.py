@@ -10,12 +10,50 @@ cluster — the gap called out in SURVEY.md §4.
 
 from __future__ import annotations
 
+import asyncio
+import logging
 from dataclasses import dataclass, field
 from typing import Protocol, runtime_checkable
+
+import httpx
+
+logger = logging.getLogger(__name__)
 
 
 class SandboxSpawnError(RuntimeError):
     pass
+
+
+async def reset_sandbox_over_http(
+    sandbox: "Sandbox", *, timeout: float = 15.0
+) -> "Sandbox | None":
+    """Shared generation-turnover fan-out: POST /reset to every host of the
+    sandbox; all must answer 200 + ok. Returns the sandbox with its
+    generation bumped, or None (caller must dispose). Backend-specific
+    prechecks (process liveness, pod registry) stay in the backends."""
+    try:
+        async with httpx.AsyncClient(timeout=httpx.Timeout(timeout)) as client:
+            resps = await asyncio.gather(
+                *(client.post(f"{url}/reset") for url in sandbox.host_urls),
+                return_exceptions=True,
+            )
+    except Exception:  # noqa: BLE001 — reuse is best-effort
+        return None
+    for resp in resps:
+        if isinstance(resp, BaseException) or resp.status_code != 200:
+            return None
+        try:
+            if not resp.json().get("ok"):
+                return None
+        except ValueError:
+            return None
+    sandbox.meta["generation"] = sandbox.meta.get("generation", 0) + 1
+    logger.info(
+        "recycled sandbox %s (generation %d)",
+        sandbox.id,
+        sandbox.meta["generation"],
+    )
+    return sandbox
 
 
 def num_hosts_for(chip_count: int, chips_per_host: int) -> int:
@@ -85,6 +123,15 @@ class SandboxBackend(Protocol):
     async def delete(self, sandbox: Sandbox) -> None:
         """Tear the sandbox down (idempotent, must not raise)."""
         ...
+
+    async def reset(self, sandbox: Sandbox) -> Sandbox | None:
+        """Scrub the sandbox for a new generation, keeping its warm device
+        process (TPU lease) alive: wiped workspace, reaped stray processes,
+        restored runner state. Returns the recycled Sandbox, or None if it
+        cannot be safely reused (caller must delete() it instead). Backends
+        without generation turnover just return None — every request then
+        pays a full spawn, the reference's behavior."""
+        return None
 
     async def close(self) -> None:
         """Release backend resources (delete all live sandboxes)."""

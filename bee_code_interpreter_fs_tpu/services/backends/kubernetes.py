@@ -29,7 +29,13 @@ from typing import Any
 
 from ...config import Config
 from ..kubectl import Kubectl, KubectlError
-from .base import Sandbox, SandboxBackend, SandboxSpawnError, num_hosts_for
+from .base import (
+    Sandbox,
+    SandboxBackend,
+    SandboxSpawnError,
+    num_hosts_for,
+    reset_sandbox_over_http,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -171,6 +177,10 @@ class KubernetesSandboxBackend(SandboxBackend):
                 "value": str(self.config.executor_warm_ready_timeout),
             },
             {"name": "APP_CHIP_COUNT", "value": str(chip_count)},
+            # Pod reuse (generation turnover) must wipe every container-
+            # private path user code can write outside the workspace:
+            # /tmp (tempfile) and ~/.local (pip --user lands on sys.path).
+            {"name": "APP_RESET_EXTRA_WIPE_DIRS", "value": "/tmp:~/.local"},
         ]
         if self.config.jax_compilation_cache_dir:
             env.append(
@@ -457,6 +467,21 @@ class KubernetesSandboxBackend(SandboxBackend):
             ips,
         )
         return sandbox
+
+    async def reset(self, sandbox: Sandbox) -> Sandbox | None:
+        """Recycle a pod (or a whole slice group) across sandbox generations:
+        POST /reset on every host scrubs the warm runner and wipes workspace +
+        runtime-packages while the pod — and its TPU chips, which would take
+        a full pod respawn + libtpu init to reacquire — stays hot. Any host
+        refusing (runner killed on timeout, mid-rewarm) disqualifies the whole
+        sandbox and the caller deletes it (the reference's per-request pod
+        disposal, kubernetes_code_executor.py:263-279, becomes the fallback
+        path rather than the rule)."""
+        if not self.config.executor_reuse_sandboxes:
+            return None
+        if sandbox.id not in self._live:
+            return None  # already deleted / unknown
+        return await reset_sandbox_over_http(sandbox, timeout=15.0)
 
     async def delete_by_name(self, name: str) -> None:
         self._live.pop(name, None)

@@ -28,7 +28,13 @@ from pathlib import Path
 import httpx
 
 from ...config import Config
-from .base import Sandbox, SandboxBackend, SandboxSpawnError, num_hosts_for
+from .base import (
+    Sandbox,
+    SandboxBackend,
+    SandboxSpawnError,
+    num_hosts_for,
+    reset_sandbox_over_http,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -314,8 +320,13 @@ class LocalSandboxBackend(SandboxBackend):
         sandbox_dir = self.root / host_id
         workspace = sandbox_dir / "workspace"
         runtime_packages = sandbox_dir / "runtime-packages"
+        # Per-sandbox TMPDIR: tempfile writes from user code must not land in
+        # the shared host /tmp (which /reset could never wipe) — they go to a
+        # sandbox-private dir that IS wiped at generation turnover.
+        scratch_tmp = sandbox_dir / "tmp"
         workspace.mkdir(parents=True)
         runtime_packages.mkdir(parents=True)
+        scratch_tmp.mkdir(parents=True)
 
         cache_dir = self.config.jax_compilation_cache_dir
         if cache_dir:
@@ -339,6 +350,8 @@ class LocalSandboxBackend(SandboxBackend):
                 "APP_PARENT_DEATH_EXIT": "1",  # die with the control plane
                 "APP_PYTHON": sys.executable,
                 "APP_DEFAULT_TIMEOUT": str(self.config.default_execution_timeout),
+                "TMPDIR": str(scratch_tmp),
+                "APP_RESET_EXTRA_WIPE_DIRS": str(scratch_tmp),
             }
         )
         if cache_dir:
@@ -416,6 +429,23 @@ class LocalSandboxBackend(SandboxBackend):
         # may the next warm spawn take the chip.
         self._release_slot(host_id)
         await asyncio.to_thread(shutil.rmtree, sandbox_dir, True)
+
+    async def reset(self, sandbox: Sandbox) -> Sandbox | None:
+        """Generation turnover without losing the TPU lease: POST /reset to
+        every host (server scrubs the warm runner and wipes workspace +
+        runtime-packages in place). All hosts must succeed; any refusal
+        (runner cold / mid-rewarm after a timeout kill / wipe failure) makes
+        the whole sandbox non-reusable and the caller disposes it. The TPU
+        slot stays held by the sandbox across generations — it is released
+        only by _kill_host when the process actually dies."""
+        if not self.config.executor_reuse_sandboxes:
+            return None
+        host_ids = sandbox.meta.get("hosts", [sandbox.id])
+        for host_id in host_ids:
+            entry = self._procs.get(host_id)
+            if entry is None or entry[0].returncode is not None:
+                return None  # process gone or already dying
+        return await reset_sandbox_over_http(sandbox, timeout=10.0)
 
     async def delete(self, sandbox: Sandbox) -> None:
         # Concurrent per-host teardown: the TERM grace + reap timeout would

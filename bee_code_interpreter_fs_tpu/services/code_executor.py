@@ -80,6 +80,20 @@ class CodeExecutor:
         self.metrics = metrics or ExecutorMetrics()
         self._pools: dict[int, deque[Sandbox]] = {}
         self._spawning: dict[int, int] = {}
+        # Requests currently holding a sandbox, per lane. With reuse on,
+        # these sandboxes come BACK to the pool at release (generation
+        # turnover keeps the TPU lease), so they count toward the lane
+        # target — a refill spawn for a sandbox that is about to recycle
+        # would fight it for the physical TPU slot and lose (VERDICT r2 #1).
+        self._in_use: dict[int, int] = {}
+        # Requests currently blocked in _acquire, per lane: lets a waiter
+        # decide between waiting for a due-back sandbox (sequential traffic —
+        # a recycle lands in milliseconds) and spawning its own (burst —
+        # more demand than sandboxes due back).
+        self._waiting: dict[int, int] = {}
+        # Per-lane turnover signal: set whenever pool/spawning/in_use change
+        # so waiters re-evaluate instead of polling (VERDICT r2 #6).
+        self._lane_events: dict[int, asyncio.Event] = {}
         self._fill_tasks: set[asyncio.Task] = set()
         self._dispose_tasks: set[asyncio.Task] = set()
         self._closed = False
@@ -90,6 +104,15 @@ class CodeExecutor:
     def _pool(self, chip_count: int) -> deque[Sandbox]:
         return self._pools.setdefault(chip_count, deque())
 
+    def _lane_capacity(self, chip_count: int) -> int | None:
+        capacity_fn = getattr(self.backend, "pool_capacity", None)
+        return capacity_fn(chip_count) if capacity_fn is not None else None
+
+    def _notify_lane(self, chip_count: int) -> None:
+        event = self._lane_events.pop(chip_count, None)
+        if event is not None:
+            event.set()
+
     def _lane_target(self, chip_count: int) -> int:
         """Warm-pool target for a lane, capped by the backend's physical
         capacity: a warm TPU sandbox owns its chips for its whole pool
@@ -98,20 +121,28 @@ class CodeExecutor:
         spawns behind libtpu's exclusive access locally, or pods Pending on
         Kubernetes. CPU lanes report no cap and keep the configured target."""
         target = self.config.executor_pod_queue_target_length
-        capacity_fn = getattr(self.backend, "pool_capacity", None)
-        if capacity_fn is not None:
-            capacity = capacity_fn(chip_count)
-            if capacity is not None:
-                target = min(target, capacity)
+        capacity = self._lane_capacity(chip_count)
+        if capacity is not None:
+            target = min(target, capacity)
         return target
 
     async def fill_pool(self, chip_count: int = 0) -> None:
-        """Top the lane up to the target length, tracking in-flight spawns."""
+        """Top the lane up to the target length, tracking in-flight spawns.
+
+        In-use sandboxes count toward the target when reuse is on: they
+        return to the pool at release (generation turnover), so spawning a
+        replacement would overshoot — and on a capacity-constrained backend,
+        deadlock against the in-flight request for the physical TPU slot."""
         if self._closed:
             return
         pool = self._pool(chip_count)
         target = self._lane_target(chip_count)
-        missing = target - len(pool) - self._spawning.get(chip_count, 0)
+        in_use = (
+            self._in_use.get(chip_count, 0)
+            if self.config.executor_reuse_sandboxes
+            else 0
+        )
+        missing = target - len(pool) - self._spawning.get(chip_count, 0) - in_use
         if missing <= 0:
             return
         self._spawning[chip_count] = self._spawning.get(chip_count, 0) + missing
@@ -129,6 +160,7 @@ class CodeExecutor:
                 logger.exception("pool prefill spawn failed (lane=%d)", chip_count)
             finally:
                 self._spawning[chip_count] -= 1
+                self._notify_lane(chip_count)
 
         await asyncio.gather(*(spawn_one() for _ in range(missing)))
 
@@ -188,18 +220,68 @@ class CodeExecutor:
 
     async def _acquire(self, chip_count: int) -> Sandbox:
         pool = self._pool(chip_count)
-        while not pool and self._spawning.get(chip_count, 0) > 0:
-            # A refill spawn for this lane is already in flight. On a
-            # capacity-constrained backend, starting a competing spawn here
-            # would lose the slot race to the refill and then starve behind
-            # the idle sandbox it parks in the pool — wait for it to land
-            # and pop it instead. If the refill fails (degraded pool),
-            # _spawning drops to zero and we spawn ourselves.
-            await asyncio.sleep(0.05)
-        if pool:
-            sandbox = pool.popleft()
-        else:
-            sandbox = await self._spawn_with_retry(chip_count)
+        # After this long without a sandbox, spawn regardless of what is
+        # "due back" — a long-running in-flight execute must not block a
+        # waiter on an unconstrained lane indefinitely.
+        grace_deadline = asyncio.get_running_loop().time() + 10.0
+        self._waiting[chip_count] = self._waiting.get(chip_count, 0) + 1
+        try:
+            while True:
+                # Grab the event BEFORE checking state: a turnover landing
+                # between the check and the wait sets this same event, so the
+                # wake-up cannot be lost.
+                event = self._lane_events.setdefault(chip_count, asyncio.Event())
+                if pool:
+                    sandbox = pool.popleft()
+                    break
+                spawning = self._spawning.get(chip_count, 0)
+                in_use = self._in_use.get(chip_count, 0)
+                capacity = self._lane_capacity(chip_count)
+                if capacity is not None:
+                    # Constrained lane: a competing spawn would lose the
+                    # physical-slot race to an in-flight refill or an
+                    # about-to-recycle request — spawn only under capacity.
+                    can_spawn = spawning + in_use < capacity
+                else:
+                    # Unconstrained lane: sandboxes "due back" are in-flight
+                    # refills plus (with reuse on) in-use sandboxes that will
+                    # recycle into the pool at release. Wait when supply
+                    # covers the queue — a recycle lands in milliseconds, a
+                    # fresh spawn takes seconds — but spawn when demand
+                    # exceeds it (burst) or the grace deadline passes.
+                    due_back = spawning + (
+                        in_use if self.config.executor_reuse_sandboxes else 0
+                    )
+                    can_spawn = (
+                        due_back == 0
+                        or self._waiting.get(chip_count, 1) > due_back
+                        or asyncio.get_running_loop().time() > grace_deadline
+                    )
+                if can_spawn:
+                    # Count the direct spawn in _spawning: a concurrent
+                    # waiter evaluating the guards mid-spawn must see it, or
+                    # two waiters would race past a capacity-1 check and the
+                    # loser would starve on the backend's physical slot.
+                    self._spawning[chip_count] = (
+                        self._spawning.get(chip_count, 0) + 1
+                    )
+                    try:
+                        sandbox = await self._spawn_with_retry(chip_count)
+                    finally:
+                        self._spawning[chip_count] -= 1
+                        self._notify_lane(chip_count)
+                    break
+                # Wait for turnover (a recycle, a dispose, or a refill
+                # landing). The timeout is a safety net against a lost
+                # release, not a poll — the event fires long before it in
+                # normal operation.
+                try:
+                    await asyncio.wait_for(event.wait(), timeout=30.0)
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            self._waiting[chip_count] -= 1
+        self._in_use[chip_count] = self._in_use.get(chip_count, 0) + 1
         self.fill_pool_soon(chip_count)
         return sandbox
 
@@ -277,6 +359,7 @@ class CodeExecutor:
 
         with timer.phase("queue_wait"):
             sandbox = await self._acquire(lane)
+        reusable = False
         try:
             async with httpx.AsyncClient(timeout=httpx.Timeout(30.0)) as client:
                 # A multi-host slice is one sandbox with an executor per host:
@@ -355,6 +438,12 @@ class CodeExecutor:
                     stderr += ("\n" if stderr else "") + (
                         f"[host {host_index}] {body['stderr']}"
                     )
+            # The request completed (user errors included). Whether the
+            # sandbox is actually safe to recycle is the server's call —
+            # /reset refuses (409) when its runner was killed by a timeout
+            # or died — so only infra failures (exceptions before this
+            # point) hard-disqualify reuse here.
+            reusable = True
             return Result(
                 stdout=primary.get("stdout", ""),
                 stderr=stderr,
@@ -364,8 +453,12 @@ class CodeExecutor:
                 warm=bool(primary.get("warm", False)),
             )
         finally:
-            # single-use sandbox: dispose off the hot path
-            task = asyncio.get_running_loop().create_task(self._dispose(sandbox))
+            # Sandbox release off the hot path: recycle the warm device
+            # process back into the pool (generation turnover via /reset),
+            # or dispose it when it can't be safely reused.
+            task = asyncio.get_running_loop().create_task(
+                self._release(sandbox, lane, reusable)
+            )
             self._dispose_tasks.add(task)
             task.add_done_callback(self._dispose_tasks.discard)
 
@@ -437,6 +530,47 @@ class CodeExecutor:
             raise ExecutorError(f"download of {rel} failed: {e}")
         assert writer.hash is not None
         return rel, writer.hash
+
+    async def _release(self, sandbox: Sandbox, lane: int, recyclable: bool) -> None:
+        """Post-request sandbox turnover (runs off the hot path): recycle the
+        warm device process back into the pool when safe — the TPU lease
+        survives and the next request pops a hot sandbox in milliseconds —
+        else dispose it and refill the lane (VERDICT r2 #1)."""
+        recycled: Sandbox | None = None
+        try:
+            if (
+                recyclable
+                and not self._closed
+                and self.config.executor_reuse_sandboxes
+                # Recycle only while the pool is short: under a concurrency
+                # burst on an unconstrained lane, many in-flight sandboxes
+                # release at once and the surplus must be disposed, or live
+                # processes would grow past the lane target and stay there.
+                and len(self._pool(lane)) < self._lane_target(lane)
+            ):
+                try:
+                    recycled = await self.backend.reset(sandbox)
+                except Exception:  # noqa: BLE001 — recycle is best-effort
+                    logger.exception("sandbox %s reset failed", sandbox.id)
+                # Concurrent releases race the pool-short check above (all
+                # pass it before any appends) — re-check after the await and
+                # dispose the surplus, or a burst would leave the pool
+                # permanently over target.
+                if recycled is not None and not (
+                    len(self._pool(lane)) < self._lane_target(lane)
+                    and not self._closed
+                ):
+                    recycled = None
+            if recycled is not None:
+                self._pool(lane).append(recycled)
+                self.metrics.recycles.inc()
+            else:
+                await self._dispose(sandbox)
+        finally:
+            self._in_use[lane] = max(0, self._in_use.get(lane, 0) - 1)
+            self._notify_lane(lane)
+            if recycled is None:
+                self.fill_pool_soon(lane)
 
     async def _dispose(self, sandbox: Sandbox) -> None:
         try:

@@ -219,6 +219,11 @@ class ExecutorMetrics:
             "code_interpreter_warm_runner_executions_total",
             "Executions served by a pre-initialized (warm) sandbox runner.",
         )
+        self.recycles = self.registry.counter(
+            "code_interpreter_sandbox_recycles_total",
+            "Sandboxes recycled back into the pool after a request "
+            "(generation turnover via /reset — the TPU lease survived).",
+        )
         self.phase_seconds = self.registry.histogram(
             "code_interpreter_phase_seconds",
             "Per-request phase latency (queue_wait/upload/exec/download).",

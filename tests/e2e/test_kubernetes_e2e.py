@@ -50,7 +50,15 @@ if verb == "create":
     env["APP_WORKSPACE"] = os.path.join(state, name, "workspace")
     env["APP_RUNTIME_PACKAGES"] = os.path.join(state, name, "runtime-packages")
     env["APP_PYTHON"] = sys.executable
+    # A real pod's manifest wipes its CONTAINER-private /tmp and ~/.local at
+    # generation reset; this fake pod is a host process, so point those at
+    # per-pod directories — wiping the host's /tmp would destroy the test
+    # harness itself (and anything else running on the machine).
+    env["TMPDIR"] = os.path.join(state, name, "tmp")
+    env["HOME"] = os.path.join(state, name, "home")
+    env["APP_RESET_EXTRA_WIPE_DIRS"] = env["TMPDIR"] + ":~/.local"
     os.makedirs(env["APP_WORKSPACE"]); os.makedirs(env["APP_RUNTIME_PACKAGES"])
+    os.makedirs(env["TMPDIR"]); os.makedirs(env["HOME"])
     proc = subprocess.Popen([os.environ["FAKE_EXECUTOR_BINARY"]], env=env,
                             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
                             start_new_session=True)

@@ -73,12 +73,15 @@ async def test_16_concurrent_llama_executes(llama_executor):
     for r in results:
         assert re.search(r"llama_ok shape=\(1, 64, 512\)", r.stdout), r.stdout
 
-    # The burst must actually run concurrently: 16 sequential runs would
-    # take >= 16x a single run's floor (jax import alone is seconds); allow
-    # a generous bound that still rules out full serialization.
-    single_floor = min(r.phases["exec"] for r in results)
-    assert wall < single_floor * CONCURRENCY, (
-        f"wall {wall:.1f}s vs serialized floor {single_floor * CONCURRENCY:.1f}s"
+    # The burst must actually run concurrently. Full serialization would put
+    # wall at ~the sum of the exec phases; require clear overlap. (Bounding
+    # against min-exec × N broke once reuse landed: a recycled warm sandbox
+    # makes the fastest exec far faster than the burst's cold average, so
+    # the old bound tightened for the wrong reason.)
+    serialized_total = sum(r.phases["exec"] for r in results)
+    assert wall < 0.75 * serialized_total, (
+        f"wall {wall:.1f}s vs serialized total {serialized_total:.1f}s — "
+        "the burst did not overlap"
     )
 
     # Pool hygiene: disposals drain; nothing leaks past close() (checked by

@@ -1,0 +1,106 @@
+"""End-to-end sandbox reuse through the real local backend + C++ executor.
+
+The TPU lease (warm executor process) must survive generation turnover while
+each Execute still sees a pristine sandbox — fresh workspace, clean env, no
+module shadows, no stray processes (VERDICT r2 #1).
+"""
+
+import pytest
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.backends.local import LocalSandboxBackend
+from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+
+@pytest.fixture
+async def stack(tmp_path):
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        local_sandbox_root=str(tmp_path / "sandboxes"),
+        executor_pod_queue_target_length=1,
+        jax_compilation_cache_dir="",
+        default_execution_timeout=30.0,
+    )
+    backend = LocalSandboxBackend(config, warm_import_jax=False)
+    executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
+    yield executor, backend
+    await executor.close()
+
+
+async def _settle(executor):
+    import asyncio
+
+    for _ in range(200):
+        pending = list(executor._dispose_tasks) + list(executor._fill_tasks)
+        if not pending:
+            return
+        await asyncio.gather(*pending, return_exceptions=True)
+
+
+async def test_process_reused_and_workspace_isolated(stack):
+    executor, backend = stack
+    await executor.fill_pool()
+    procs_before = {
+        host_id: proc.pid for host_id, (proc, _) in backend._procs.items()
+    }
+    assert len(procs_before) == 1
+
+    first = await executor.execute(
+        "import os\n"
+        "open('state.txt', 'w').write('gen1')\n"
+        "os.environ['GEN'] = '1'\n"
+        "print(os.getpid())\n"
+    )
+    assert first.exit_code == 0, first.stderr
+    await _settle(executor)
+
+    second = await executor.execute(
+        "import os\n"
+        "print(sorted(os.listdir('.')))\n"
+        "print(os.environ.get('GEN'))\n"
+        "print(os.getpid())\n"
+    )
+    assert second.exit_code == 0, second.stderr
+    await _settle(executor)
+
+    lines = second.stdout.splitlines()
+    assert lines[0] == "[]"  # generation 1's files are gone
+    assert lines[1] == "None"  # generation 1's env is gone
+    # Same warm process served both generations (the lease survived): the
+    # warm runner executes in-process, so the user-visible pid IS the
+    # runner's pid.
+    assert first.stdout.strip() == lines[2]
+    procs_after = {
+        host_id: proc.pid for host_id, (proc, _) in backend._procs.items()
+    }
+    assert procs_after == procs_before
+
+    # Pool-pop latency, not respawn latency (VERDICT r2 #1 done-criterion).
+    assert second.phases["queue_wait"] < max(first.phases["queue_wait"] * 10, 0.05)
+
+
+async def test_timeout_poisons_sandbox_but_service_recovers(stack):
+    executor, backend = stack
+    await executor.fill_pool()
+    result = await executor.execute("while True: pass", timeout=1)
+    assert result.exit_code == -1
+    assert "timed out" in result.stderr
+    await _settle(executor)
+    # The timed-out sandbox's runner was killed — /reset refuses, the
+    # process is disposed, and the pool refills with a fresh spawn.
+    result = await executor.execute("print('recovered')")
+    assert result.exit_code == 0
+    assert result.stdout == "recovered\n"
+
+
+async def test_file_outputs_per_generation(stack):
+    """Changed-file capture works per generation: each request only sees its
+    own writes even though the workspace directory object is shared."""
+    executor, backend = stack
+    await executor.fill_pool()
+    first = await executor.execute("open('a.txt', 'w').write('A')")
+    await _settle(executor)
+    second = await executor.execute("open('b.txt', 'w').write('B')")
+    assert set(first.files) == {"/workspace/a.txt"}
+    assert set(second.files) == {"/workspace/b.txt"}

@@ -240,6 +240,182 @@ def test_unicode_roundtrip(executor):
     assert result["stdout"] == "héllo ✓ 日本語\n"
 
 
+def test_reset_scrubs_generation(executor):
+    """POST /reset is the generation turnover that lets the control plane
+    reuse the warm device process (VERDICT r2 #1): the previous sandbox's
+    files, env mutations, workspace module imports, and stray child
+    processes must all be gone; the warm runner must stay alive."""
+    client, ws = executor
+    result = execute(
+        client,
+        "import os, subprocess, sys\n"
+        "open('leftover.txt', 'w').write('secret')\n"
+        "open('shadow.py', 'w').write('VALUE = 1')\n"
+        "sys.path.insert(0, os.getcwd())\n"
+        "import shadow\n"
+        "print(shadow.VALUE)\n"
+        "os.environ['LEAKED_VAR'] = 'oops'\n"
+        "child = subprocess.Popen(['sleep', '600'])\n"
+        "print(child.pid)\n",
+    )
+    assert result["exit_code"] == 0, result["stderr"]
+    lines = result["stdout"].split()
+    assert lines[0] == "1"
+    child_pid = int(lines[1])
+
+    resp = client.post("/reset")
+    assert resp.status_code == 200, resp.text
+    assert resp.json()["ok"] is True
+    assert resp.json()["warm"] is True  # the device process survived
+
+    assert list(ws.iterdir()) == []  # workspace wiped in place
+    with pytest.raises(ProcessLookupError):
+        os.kill(child_pid, 0)  # stray child reaped
+
+    result = execute(
+        client,
+        "import os, sys\n"
+        "print(sorted(os.listdir('.')))\n"
+        "print(os.environ.get('LEAKED_VAR'))\n"
+        "open('shadow.py', 'w').write('VALUE = 2')\n"
+        "sys.path.insert(0, os.getcwd())\n"
+        "import shadow\n"
+        "print(shadow.VALUE)\n",
+    )
+    assert result["exit_code"] == 0, result["stderr"]
+    out = result["stdout"].splitlines()
+    assert out[0] == "[]"  # fresh workspace
+    assert out[1] == "None"  # env restored
+    assert out[2] == "2"  # no module-cache shadow from the last generation
+    assert result["warm"] is True
+    client.post("/reset")  # leave a clean workspace for the next test
+
+
+def test_reset_refused_when_user_thread_survives(executor):
+    """A thread the previous generation started cannot be killed from
+    outside — the runner must refuse the reset so the control plane
+    disposes the whole process instead of recycling it."""
+    client, _ = executor
+    result = execute(
+        client,
+        "import threading, time\n"
+        "threading.Thread(target=time.sleep, args=(600,), daemon=True).start()\n"
+        "print('spawned')\n",
+    )
+    assert result["exit_code"] == 0, result["stderr"]
+    resp = client.post("/reset")
+    assert resp.status_code == 409
+    assert resp.json()["ok"] is False
+    # The refusal marks the runner failed; restore warm service for the
+    # remaining tests the way the control plane would not (it would dispose)
+    # — this dev server can just rewarm.
+    client.post("/warmup")
+    for _ in range(100):
+        if client.get("/healthz").json().get("warm"):
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail("runner did not rewarm after refused reset")
+
+
+def test_reset_wipes_extra_dirs_and_tmpdir(tmp_path):
+    """APP_RESET_EXTRA_WIPE_DIRS closes the cross-generation channels
+    outside workspace/runtime-packages (sandbox-private tmp, ~/.local)."""
+    ws = tmp_path / "ws"
+    rp = tmp_path / "rp"
+    extra = tmp_path / "scratch-tmp"
+    ws.mkdir()
+    rp.mkdir()
+    extra.mkdir()
+    env = _server_env(ws, rp)
+    env["APP_RESET_EXTRA_WIPE_DIRS"] = str(extra) + ":" + str(
+        tmp_path / "never-created"
+    )
+    env["TMPDIR"] = str(extra)
+    proc = subprocess.Popen(
+        [str(BINARY)], env=env, stdout=subprocess.PIPE, stderr=None
+    )
+    try:
+        line = proc.stdout.readline().decode()
+        port = int(re.search(r"port=(\d+)", line).group(1))
+        with httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=30.0) as c:
+            for _ in range(200):
+                if c.get("/healthz").json().get("warm"):
+                    break
+                time.sleep(0.05)
+            result = c.post(
+                "/execute",
+                json={
+                    "source_code": "import tempfile, os\n"
+                    "fd, path = tempfile.mkstemp()\n"
+                    "os.write(fd, b'stash')\n"
+                    "os.close(fd)\n"
+                    "print(path)\n"
+                },
+            ).json()
+            assert result["exit_code"] == 0, result["stderr"]
+            stash_path = result["stdout"].strip()
+            assert stash_path.startswith(str(extra))  # TMPDIR honored
+            resp = c.post("/reset")
+            assert resp.status_code == 200, resp.text
+        assert list(extra.iterdir()) == []  # scratch tmp wiped
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_reset_refused_when_runner_cold(tmp_path):
+    """A sandbox whose runner never warmed (or was killed) must not be
+    recycled: /reset answers 409 so the control plane disposes it."""
+    ws = tmp_path / "ws"
+    rp = tmp_path / "rp"
+    ws.mkdir()
+    rp.mkdir()
+    env = _server_env(ws, rp)
+    env["APP_WARM_EAGER"] = "0"  # warm-up waits for /warmup that never comes
+    proc = subprocess.Popen(
+        [str(BINARY)], env=env, stdout=subprocess.PIPE, stderr=None
+    )
+    try:
+        line = proc.stdout.readline().decode()
+        port = int(re.search(r"port=(\d+)", line).group(1))
+        with httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=10.0) as c:
+            resp = c.post("/reset")
+            assert resp.status_code == 409
+            assert resp.json()["ok"] is False
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_reset_without_warm_runner_wipes(tmp_path):
+    """Warm mode off (plumbing/dev): /reset still wipes both prefixes."""
+    ws = tmp_path / "ws"
+    rp = tmp_path / "rp"
+    ws.mkdir()
+    rp.mkdir()
+    (ws / "old.txt").write_text("x")
+    (rp / "pkg").mkdir()
+    (rp / "pkg" / "mod.py").write_text("y")
+    env = _server_env(ws, rp)
+    env["APP_WARM_RUNNER"] = "0"
+    proc = subprocess.Popen(
+        [str(BINARY)], env=env, stdout=subprocess.PIPE, stderr=None
+    )
+    try:
+        line = proc.stdout.readline().decode()
+        port = int(re.search(r"port=(\d+)", line).group(1))
+        with httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=10.0) as c:
+            resp = c.post("/reset")
+            assert resp.status_code == 200
+            assert resp.json()["ok"] is True
+        assert list(ws.iterdir()) == []
+        assert list(rp.iterdir()) == []
+    finally:
+        proc.kill()
+        proc.wait()
+
+
 def test_deps_scanner():
     out = subprocess.run(
         [

@@ -139,6 +139,10 @@ async def test_acquire_waits_for_inflight_refill(tmp_path, monkeypatch):
         local_tpu_slots=1,
         executor_pod_queue_target_length=1,
         executor_warm_ready_timeout=60.0,
+        # Single-use mode: with reuse on there is no competing refill at all
+        # (the in-use sandbox counts toward the target and comes back via
+        # recycle — covered by tests/unit/test_sandbox_reuse.py).
+        executor_reuse_sandboxes=False,
     )
     backend = LocalSandboxBackend(config, warm_import_jax=False)
     monkeypatch.setattr(backend, "_tpu_exclusive", lambda: True)
@@ -149,7 +153,9 @@ async def test_acquire_waits_for_inflight_refill(tmp_path, monkeypatch):
         acquire2 = asyncio.create_task(executor._acquire(0))
         await asyncio.sleep(0.5)
         assert not acquire2.done(), "second acquire should wait for the refill"
-        await executor._dispose(first)  # frees the slot -> refill lands
+        # Release (non-recyclable) frees the slot -> the refill lands and
+        # wakes the waiter.
+        await executor._release(first, 0, False)
         second = await asyncio.wait_for(acquire2, timeout=45.0)
         assert second.url
     finally:
