@@ -31,7 +31,11 @@ from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor  # n
 from bee_code_interpreter_fs_tpu.services.storage import Storage  # noqa: E402
 
 BENCH_SOURCE = (REPO_ROOT / "examples" / "benchmark-numpy.py").read_text()
+MATMUL_SOURCE = (REPO_ROOT / "examples" / "benchmark-matmul.py").read_text()
 GFLOPS_RE = re.compile(r"GFLOPS=([0-9.]+)")
+SINGLE_SHOT_RE = re.compile(r"GFLOPS_single_shot=([0-9.]+)")
+TFLOPS_RE = re.compile(r"TFLOPS=([0-9.]+)")
+MFU_RE = re.compile(r"MFU_vs_v5e_peak_pct=([0-9.]+)")
 
 
 def log(msg: str) -> None:
@@ -55,7 +59,8 @@ async def run_gflops(dispatch: bool, runs: int, tmp: Path) -> tuple[float, dict]
     try:
         log(f"filling pool (dispatch={dispatch})...")
         await executor.fill_pool()
-        best = 0.0
+        samples: list[float] = []
+        single_shots: list[float] = []
         info: dict = {}
         for i in range(runs):
             log(f"run {i} (dispatch={dispatch})...")
@@ -68,6 +73,9 @@ async def run_gflops(dispatch: bool, runs: int, tmp: Path) -> tuple[float, dict]
             if not match:
                 raise RuntimeError(f"no GFLOPS line in: {result.stdout[-400:]}")
             gflops = float(match.group(1))
+            single = SINGLE_SHOT_RE.search(result.stdout)
+            if single:
+                single_shots.append(float(single.group(1)))
             backend_line = next(
                 (l for l in result.stdout.splitlines() if l.startswith("backend:")),
                 "backend: ?",
@@ -79,8 +87,54 @@ async def run_gflops(dispatch: bool, runs: int, tmp: Path) -> tuple[float, dict]
                 "phases": {k: round(v, 4) for k, v in result.phases.items()},
             }
             log(f"run {i}: {gflops:.3f} GFLOPS ({info['array_type']})")
-            best = max(best, gflops)
-        return best, info
+            samples.append(gflops)
+        # Run 0 includes first-compile; steady state = the rest (SURVEY §6 /
+        # VERDICT r2 #3: N>=3, report best and median excluding compile).
+        steady = samples[1:] if len(samples) > 1 else samples
+        info["gflops_samples"] = [round(s, 3) for s in samples]
+        info["gflops_median"] = round(statistics.median(steady), 3)
+        if single_shots:
+            info["gflops_single_shot_best"] = round(max(single_shots), 3)
+        return max(steady), info
+    finally:
+        await executor.close()
+
+
+async def run_matmul(tmp: Path) -> dict:
+    """Compute-bound config: chained bf16 matmuls (pure JAX user code via
+    Execute). Reports achieved TFLOPS + MFU vs v5e bf16 peak."""
+    config = Config(
+        file_storage_path=str(tmp / "storage-mm"),
+        local_sandbox_root=str(tmp / "sb-mm"),
+        executor_pod_queue_target_length=1,
+        default_execution_timeout=600.0,
+        jax_compilation_cache_dir=str(tmp / "jax-cache"),
+    )
+    backend = LocalSandboxBackend(config, warm_import_jax=True, numpy_dispatch=False)
+    executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
+    try:
+        log("matmul: filling pool...")
+        await executor.fill_pool()
+        best: dict = {}
+        for i in range(2):
+            log(f"matmul run {i}...")
+            result = await executor.execute(MATMUL_SOURCE, timeout=600.0)
+            if result.exit_code != 0:
+                raise RuntimeError(f"matmul execute failed: {result.stderr[-800:]}")
+            tflops_m = TFLOPS_RE.search(result.stdout)
+            if not tflops_m:
+                raise RuntimeError(f"no TFLOPS line in: {result.stdout[-400:]}")
+            tflops = float(tflops_m.group(1))
+            mfu_m = MFU_RE.search(result.stdout)
+            log(f"matmul run {i}: {tflops:.2f} TFLOPS")
+            if not best or tflops > best["matmul_tflops"]:
+                best = {
+                    "matmul_tflops": tflops,
+                    "matmul_mfu_vs_v5e_peak_pct": (
+                        float(mfu_m.group(1)) if mfu_m else None
+                    ),
+                }
+        return best
     finally:
         await executor.close()
 
@@ -147,7 +201,8 @@ async def main() -> None:
     prime_accelerator()
     with tempfile.TemporaryDirectory(prefix="bench-") as tmp_str:
         tmp = Path(tmp_str)
-        tpu_gflops, tpu_info = await run_gflops(dispatch=True, runs=2, tmp=tmp)
+        tpu_gflops, tpu_info = await run_gflops(dispatch=True, runs=4, tmp=tmp)
+        matmul = await run_matmul(tmp)
         cpu_gflops, _ = await run_gflops(dispatch=False, runs=1, tmp=tmp)
         p50 = await cold_start_p50(tmp)
 
@@ -160,6 +215,7 @@ async def main() -> None:
             "cpu_numpy_gflops": round(cpu_gflops, 3),
             "execute_p50_warm_pool_s": round(p50, 4),
             "tpu_run": tpu_info,
+            **matmul,
         },
     }
     print(json.dumps(line))

@@ -1,0 +1,52 @@
+"""Compute-bound benchmark: chained bf16 matmuls on the MXU.
+
+BASELINE.json describes a matmul config and SURVEY.md §6 orders both shapes
+measured; the sum-of-squares headline is HBM-bandwidth-bound, so this is the
+number that shows whether Execute-submitted user code can reach the systolic
+array's peak. Pure JAX user code (no numpy shim needed): a lax.fori_loop
+chain of DIM×DIM @ DIM×DIM bf16 matmuls — each iteration consumes the
+previous product, so XLA cannot collapse the chain — with one host sync at
+the end. Reports achieved TFLOPS and model-flops-utilization against the
+v5e bf16 peak (197 TFLOPS/chip).
+
+On non-TPU backends (tests, CI) the shape shrinks so the script stays fast.
+"""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+ON_TPU = jax.devices()[0].platform == "tpu"
+DIM = 8192 if ON_TPU else 256
+ITERS = 32 if ON_TPU else 2
+V5E_BF16_PEAK_TFLOPS = 197.0
+
+
+@partial(jax.jit, static_argnums=(1,))
+def matmul_chain(a, iters):
+    def body(_, b):
+        # Rescale each product so bf16 stays in range across the chain.
+        return (a @ b) * jnp.bfloat16(0.0156)
+
+    b = jax.lax.fori_loop(0, iters, body, a)
+    return b[0, 0].astype(jnp.float32)
+
+
+key = jax.random.PRNGKey(0)
+a = jax.random.normal(key, (DIM, DIM), dtype=jnp.bfloat16)
+float(matmul_chain(a, ITERS))  # compile + first run off the clock
+
+best = float("inf")
+for _ in range(3):
+    t0 = time.perf_counter()
+    float(matmul_chain(a, ITERS))
+    best = min(best, time.perf_counter() - t0)
+
+tflops = ITERS * 2 * DIM**3 / best / 1e12
+print(f"backend: {jax.devices()[0].platform} dim={DIM} iters={ITERS}")
+print(f"elapsed_s={best:.4f}")
+print(f"TFLOPS={tflops:.2f}")
+if ON_TPU:
+    print(f"MFU_vs_v5e_peak_pct={tflops / V5E_BF16_PEAK_TFLOPS * 100:.1f}")

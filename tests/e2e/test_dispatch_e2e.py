@@ -52,6 +52,15 @@ async def test_benchmark_fib_unaffected(executor):
     assert "fib(10000) x1000" in result.stdout
 
 
+async def test_benchmark_matmul_example(executor):
+    """The compute-bound bench (chained bf16 matmuls) runs via Execute; on
+    the CPU test platform it self-shrinks and still reports TFLOPS."""
+    source = (EXAMPLES / "benchmark-matmul.py").read_text()
+    result = await executor.execute(source, timeout=120)
+    assert result.exit_code == 0, result.stderr
+    assert "TFLOPS=" in result.stdout
+
+
 async def test_using_imports_with_shim(executor):
     source = (EXAMPLES / "using_imports.py").read_text()
     result = await executor.execute(source, timeout=120)
