@@ -193,7 +193,9 @@ class CustomToolExecutor:
         if args.kwarg:
             errors.append("**kwargs is not supported")
 
-        summary, param_docs, _ = _parse_docstring(ast.get_docstring(fn) or "")
+        summary, param_docs, return_doc = _parse_docstring(
+            ast.get_docstring(fn) or ""
+        )
 
         properties: dict[str, dict] = {}
         required: list[str] = []
@@ -222,13 +224,28 @@ class CustomToolExecutor:
             raise CustomToolParseError(errors)
 
         input_schema = {
+            "$schema": "http://json-schema.org/draft-07/schema#",
             "type": "object",
+            "title": fn.name,
             "properties": properties,
             "required": required,
             "additionalProperties": False,
         }
+        # Tool-card parity (reference custom_tool_executor.py:132-148): the
+        # return contract — "<annotation> -- <:return: doc>", either part
+        # optional — is appended so LLM clients see what comes back.
+        return_type = ast.unparse(fn.returns) if fn.returns else None
+        return_contract = " -- ".join(s for s in (return_type, return_doc) if s)
+        description = "\n\n".join(
+            s
+            for s in (
+                summary,
+                f"Returns: {return_contract}" if return_contract else None,
+            )
+            if s
+        )
         return CustomTool(
-            name=fn.name, description=summary, input_schema=input_schema
+            name=fn.name, description=description, input_schema=input_schema
         )
 
     async def execute(

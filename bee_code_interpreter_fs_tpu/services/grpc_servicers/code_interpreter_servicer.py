@@ -49,6 +49,14 @@ class CodeInterpreterServicer:
                 grpc.StatusCode.INVALID_ARGUMENT,
                 "exactly one of source_code/source_file is required",
             )
+        if request.timeout < 0:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "timeout must be >= 0"
+            )
+        if request.chip_count < 0:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "chip_count must be >= 0"
+            )
         for path, object_id in request.files.items():
             if not OBJECT_ID_RE.match(object_id):
                 await context.abort(

@@ -21,6 +21,26 @@ tolerance as a zero-cost source transform instead:
 line numbers in user tracebacks stay exact. Scripts that are pure Python
 compile on the first try and pay one ``compile()`` — no interpreter swap,
 no startup tax.
+
+Contract vs xonsh (documented divergences — VERDICT r2 #8). Covered:
+bare commands; pipes/redirection/&&/|| within a shell line (delegated to
+``sh``); ``!``-escapes; ``cd`` / ``export`` persisting across lines and into
+the surrounding Python (os.chdir / os.environ); ``$VAR`` expansion inside
+shell lines, including the ``cd``/``export`` fast paths (environment =
+process env + prior ``export``s; single-quoted export values stay literal,
+shell-style). NOT covered — these stay ordinary Python or fail loudly
+rather than half-working:
+  * ``$VAR`` inside *Python* expressions (xonsh: ``print($HOME)``) — here
+    that is a real NameError; use ``os.environ``.
+  * Python-expression substitution inside shell lines (xonsh ``@(expr)``).
+  * Capturing shell output into Python variables (xonsh ``x = $(cmd)``) —
+    a line that parses as Python is never treated as shell; use
+    ``subprocess``.
+  * xonsh globbing/regex paths (`` `re` ``) and its alias system.
+  * Per-line subshells: unlike xonsh's single session, each rewritten line
+    is its own ``sh -c`` (except ``cd``/``export``, persisted explicitly) —
+    ``set -e``-style abort semantics across lines do not exist; a failing
+    line reports and the next line runs (plain shell-script behavior).
 """
 
 from __future__ import annotations
@@ -52,6 +72,19 @@ def _shellish(stripped: str) -> bool:
 
 _CD_LINE = re.compile(r"^cd(?:\s+(?P<path>\S+))?\s*$")
 _EXPORT_LINE = re.compile(r"^export\s+(?P<name>[A-Za-z_][A-Za-z0-9_]*)=(?P<value>.*)$")
+_ENV_REF = re.compile(r"\$(?:\{(?P<braced>[A-Za-z_][A-Za-z0-9_]*)\}|(?P<name>[A-Za-z_][A-Za-z0-9_]*))")
+
+
+def _expand_env(text: str) -> str:
+    """$VAR / ${VAR} expansion with sh semantics: UNDEFINED variables expand
+    to empty (os.path.expandvars would leave the literal '$VAR', making the
+    same reference mean different things on a cd/export line vs any other
+    shell line, which the subshell expands)."""
+    import os
+
+    return _ENV_REF.sub(
+        lambda m: os.environ.get(m.group("braced") or m.group("name"), ""), text
+    )
 
 
 def run_shell_line(cmd: str) -> int:
@@ -70,7 +103,10 @@ def run_shell_line(cmd: str) -> int:
 
     cd = _CD_LINE.match(cmd.strip())
     if cd:
-        target = os.path.expanduser(cd.group("path") or "~")
+        # $VAR expands from the live environment (prior `export`s included),
+        # matching what the subshell does for any other command line —
+        # including empty expansion of undefined names.
+        target = os.path.expanduser(_expand_env(cd.group("path") or "~"))
         try:
             os.chdir(target)
             return 0
@@ -79,7 +115,14 @@ def run_shell_line(cmd: str) -> int:
             return 1
     export = _EXPORT_LINE.match(cmd.strip())
     if export:
-        os.environ[export.group("name")] = export.group("value").strip("'\"")
+        value = export.group("value").strip()
+        if len(value) >= 2 and value[0] == value[-1] == "'":
+            value = value[1:-1]  # single quotes: literal, shell-style
+        else:
+            if len(value) >= 2 and value[0] == value[-1] == '"':
+                value = value[1:-1]
+            value = _expand_env(value)
+        os.environ[export.group("name")] = value
         return 0
     return subprocess.run(cmd, shell=True).returncode
 

@@ -173,7 +173,9 @@ def find_items(query: str, limit: int = 10, tags: typing.Optional[list[str]] = N
     assert resp.status == 200
     body = await resp.json()
     assert body["tool_name"] == "find_items"
-    assert body["tool_description"] == "Search the catalog."
+    assert body["tool_description"] == (
+        "Search the catalog.\n\nReturns: dict -- matching items"
+    )
     schema = json.loads(body["tool_input_schema_json"])
     assert schema["required"] == ["query"]
     assert schema["properties"]["query"] == {

@@ -66,6 +66,26 @@ def test_docstring_extraction():
     assert tool.input_schema["properties"]["x"]["description"] == (
         "the x continued over lines"
     )
+    # Tool-card parity (VERDICT r2 #5): the return contract — annotation and
+    # :return: doc — is part of the description, and the schema identifies
+    # itself ($schema/title) as the reference's does.
+    assert tool.description.endswith("Returns: int -- doubled x")
+    assert tool.input_schema["$schema"] == "http://json-schema.org/draft-07/schema#"
+    assert tool.input_schema["title"] == "f"
+
+
+def test_return_contract_variants():
+    # annotation only
+    tool = parse('def f(x: int) -> str:\n    """Go."""\n    return "s"')
+    assert tool.description == "Go.\n\nReturns: str"
+    # :return: doc only
+    tool = parse(
+        'def f(x: int):\n    """Go.\n\n    :return: a greeting\n    """\n    return 1'
+    )
+    assert tool.description == "Go.\n\nReturns: a greeting"
+    # neither -> no Returns section
+    tool = parse('def f(x: int):\n    """Go."""\n    return 1')
+    assert tool.description == "Go."
 
 
 @pytest.mark.parametrize(

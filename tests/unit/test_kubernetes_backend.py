@@ -43,6 +43,9 @@ elif verb == "get":
     if name and os.path.exists(path):
         manifest = json.load(open(path))
         manifest.setdefault("status", {})["podIP"] = "10.0.0.7"
+        status_path = os.path.join(state, "status.json")
+        if os.path.exists(status_path):
+            manifest["status"].update(json.load(open(status_path)))
         manifest["metadata"]["uid"] = "uid-" + name
         print(json.dumps(manifest))
     else:
@@ -52,6 +55,13 @@ elif verb == "wait":
     print("pod condition met")
 elif verb == "delete":
     print("pod deleted")
+elif verb == "logs":
+    logs_path = os.path.join(state, "logs.txt")
+    if os.path.exists(logs_path):
+        print(open(logs_path).read())
+    else:
+        sys.stderr.write("no logs\n")
+        sys.exit(1)
 else:
     sys.exit(2)
 """
@@ -144,6 +154,67 @@ async def test_spawn_failure_deletes_pod(fake_kubectl):
 
     await asyncio.sleep(0.2)  # fire-and-forget delete
     assert "delete" in [c["argv"][0] for c in calls()]
+
+
+async def test_spawn_failure_includes_pod_diagnostics(fake_kubectl):
+    """A failed spawn must carry WHY: pod phase/conditions/container state
+    plus the kubectl-logs tail — the k8s analogue of the local backend's
+    stderr tail (VERDICT r2 #7)."""
+    kubectl, state, calls = fake_kubectl
+    (state / "fail_wait").touch()
+    (state / "status.json").write_text(
+        json.dumps(
+            {
+                "phase": "Pending",
+                "conditions": [
+                    {
+                        "type": "Ready",
+                        "status": "False",
+                        "reason": "ContainersNotReady",
+                        "message": "containers with unready status: [executor]",
+                    }
+                ],
+                "containerStatuses": [
+                    {
+                        "name": "executor",
+                        "state": {
+                            "waiting": {
+                                "reason": "CrashLoopBackOff",
+                                "message": "back-off 40s restarting failed container",
+                            }
+                        },
+                    }
+                ],
+            }
+        )
+    )
+    (state / "logs.txt").write_text(
+        "RuntimeError: TPU initialization failed: device busy\n"
+    )
+    backend = _backend(kubectl)
+    with pytest.raises(SandboxSpawnError) as exc_info:
+        await backend.spawn(chip_count=0)
+    message = str(exc_info.value)
+    assert "did not become ready" in message
+    assert "phase=Pending" in message
+    assert "CrashLoopBackOff" in message
+    assert "TPU initialization failed: device busy" in message
+    await backend.close()  # drain the fire-and-tracked failure-path delete
+
+
+async def test_spawn_failure_diagnostics_degrade_gracefully(fake_kubectl):
+    """Logs/status fetch failures must not mask the original error."""
+    kubectl, state, calls = fake_kubectl
+    (state / "fail_wait").touch()
+    (state / "fail_get").touch()  # no logs.txt either -> logs verb fails
+    backend = _backend(kubectl)
+    with pytest.raises(SandboxSpawnError) as exc_info:
+        await backend.spawn(chip_count=0)
+    message = str(exc_info.value)
+    assert "did not become ready" in message
+    assert "pod status unavailable" in message
+    assert "pod logs unavailable" in message
+    await backend.close()  # drain the fire-and-tracked failure-path delete
 
 
 async def test_delete_and_close(fake_kubectl):

@@ -175,6 +175,106 @@ def test_float64_requests_are_explicitly_float32(np_shim):
     assert not truncations, "policy must canonicalize, not rely on jax warnings"
 
 
+def test_integer_policy_arange_default_stays_host(np_shim):
+    """numpy's default arange dtype is int64 — the device would wrap it to
+    int32, so integer arange stays on host and sums exactly (VERDICT r2 #4,
+    the np.arange(3e9).sum() class of case at test-friendly size)."""
+    n = THRESHOLD * 50
+    a = np_shim.arange(n)
+    assert type(a).__name__ == "ndarray"
+    assert a.dtype.name == "int64"
+    assert int(a.sum()) == n * (n - 1) // 2
+    # and a genuinely wide-valued sum is exact (would wrap in int32)
+    big = np_shim.arange(2_000_000_000, 2_000_000_000 + n)
+    assert type(big).__name__ == "ndarray"
+    assert int(big.sum()) == sum(range(2_000_000_000, 2_000_000_000 + n))
+
+
+def test_integer_policy_wide_dtype_requests_stay_host(np_shim):
+    a = np_shim.zeros(THRESHOLD * 2, dtype=np_shim.int64)
+    assert type(a).__name__ == "ndarray" and a.dtype.name == "int64"
+    b = np_shim.full(THRESHOLD * 2, 7, dtype="uint64")
+    assert type(b).__name__ == "ndarray" and b.dtype.name == "uint64"
+    # conversions of 64-bit-int ndarrays stay host too
+    import bee_code_interpreter_fs_tpu.ops.npdispatch.shim as shim_mod
+
+    raw = shim_mod.real_np.arange(THRESHOLD * 3, dtype=shim_mod.real_np.int64)
+    converted = np_shim.asarray(raw)
+    assert type(converted).__name__ == "ndarray"
+
+
+def test_integer_policy_device_reductions_promote_on_host(np_shim):
+    """int32 arrays DO dispatch to device, but sum/prod promote their
+    accumulator in numpy (int32 -> int64) — the shim computes those on host,
+    exactly, instead of wrapping in int32 on device."""
+    import bee_code_interpreter_fs_tpu.ops.npdispatch.shim as shim_mod
+
+    n = THRESHOLD * 2
+    a = np_shim.full(n, 2**30, dtype=np_shim.int32)
+    assert isinstance(a, TpuArray)  # int32 itself is device-legal
+    total = a.sum()
+    assert not isinstance(total, TpuArray)
+    expected = shim_mod.real_np.full(n, 2**30, dtype="int32").sum()
+    assert int(total) == int(expected)  # exact, far beyond int32 range
+    assert int(total) == n * 2**30
+    # module-level np.sum routes identically
+    assert int(np_shim.sum(a)) == n * 2**30
+    # explicit accumulator dtype follows numpy (int32 wraps in BOTH)
+    wrapped_host = shim_mod.real_np.full(n, 2**30, dtype="int32").sum(
+        dtype=shim_mod.real_np.int32
+    )
+    wrapped_shim = a.sum(dtype=np_shim.int32)
+    assert int(wrapped_shim) == int(wrapped_host)
+
+
+def test_integer_policy_astype_wide_goes_host(np_shim):
+    a = np_shim.zeros(THRESHOLD * 2, dtype=np_shim.float32)
+    assert isinstance(a, TpuArray)
+    widened = a.astype(np_shim.int64)
+    assert type(widened).__name__ == "ndarray"
+    assert widened.dtype.name == "int64"
+
+
+def test_integer_policy_binop_with_wide_ndarray_goes_host(np_shim):
+    """`a + wide_int64_ndarray` must match np.add(a, ...)'s host routing —
+    the device would cast the int64 operand to int32 and wrap."""
+    import bee_code_interpreter_fs_tpu.ops.npdispatch.shim as shim_mod
+
+    n = THRESHOLD * 2
+    a = np_shim.full(n, 2**30, dtype=np_shim.int32)
+    assert isinstance(a, TpuArray)
+    wide = shim_mod.real_np.full(n, 2**31 + 5, dtype=shim_mod.real_np.int64)
+    out = a + wide
+    assert type(out).__name__ == "ndarray"
+    assert int(out[0]) == 2**30 + 2**31 + 5  # exact, not wrapped
+    out_r = wide + a  # reflected path
+    assert int(out_r[0]) == 2**30 + 2**31 + 5
+
+
+def test_integer_policy_method_explicit_wide_dtype_goes_host(np_shim):
+    """a.sum(dtype=np.int64) explicitly requests a 64-bit accumulator; jax
+    would silently truncate it to int32 — must compute on host."""
+    n = THRESHOLD * 2
+    a = np_shim.full(n, 2**30, dtype=np_shim.int32)
+    total = a.sum(dtype=np_shim.int64)
+    assert int(total) == n * 2**30
+
+
+def test_integer_policy_nansum_exact(np_shim):
+    n = THRESHOLD * 2
+    a = np_shim.full(n, 2**30, dtype=np_shim.int32)
+    assert int(np_shim.nansum(a)) == n * 2**30
+
+
+def test_integer_policy_elementwise_int32_stays_device(np_shim):
+    """Fixed-width elementwise int arithmetic wraps identically in numpy
+    and on device — no reason to leave the accelerator."""
+    a = np_shim.zeros(THRESHOLD * 2, dtype=np_shim.int32)
+    b = (a + 7) * 3
+    assert isinstance(b, TpuArray)
+    assert int(b[0]) == 21
+
+
 def test_headline_sum_of_squares_divergence_bounded(np_shim):
     """The BASELINE.json headline workload shape (sum of squares over random
     doubles) computed by the shim in float32 must stay within rtol=1e-5 of

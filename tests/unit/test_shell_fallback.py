@@ -120,6 +120,84 @@ def test_export_persists_to_python(tmp_path):
     assert proc.stdout.strip() == "hello"
 
 
+def test_dollar_var_expands_in_shell_lines(tmp_path):
+    """$VAR inside a shell-ish line resolves against the persisted exports
+    (VERDICT r2 #8): the subshell sees os.environ, which `export` mutates."""
+    script = tmp_path / "dollar.py"
+    script.write_text(
+        "export GREETING=bonjour\n"
+        "echo $GREETING-monde > out.txt\n"
+        "print(open('out.txt').read().strip())\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(EXECUTOR_DIR / "launch.py"), str(script)],
+        capture_output=True,
+        text=True,
+        cwd=tmp_path,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip() == "bonjour-monde"
+
+
+def test_cd_expands_env_vars(tmp_path):
+    script = tmp_path / "cdvar.py"
+    (tmp_path / "deep").mkdir()
+    script.write_text(
+        "export TARGET=deep\n"
+        "cd $TARGET\n"
+        "import os\nprint(os.path.basename(os.getcwd()))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(EXECUTOR_DIR / "launch.py"), str(script)],
+        capture_output=True,
+        text=True,
+        cwd=tmp_path,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip() == "deep"
+
+
+def test_undefined_var_expands_empty_like_sh(tmp_path):
+    """sh expands undefined $VARs to empty; the cd/export fast paths must
+    agree (a literal '$UNSET' leaking into os.environ would mean the same
+    reference behaves differently on an export line vs an echo line)."""
+    script = tmp_path / "unset.py"
+    script.write_text(
+        "export FLAGS=$TOTALLY_UNSET_VAR-x\n"
+        "import os\nprint(repr(os.environ['FLAGS']))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(EXECUTOR_DIR / "launch.py"), str(script)],
+        capture_output=True,
+        text=True,
+        cwd=tmp_path,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip() == "'-x'"
+
+
+def test_export_expansion_and_single_quote_literal(tmp_path):
+    """Shell-style quoting in export values: double quotes / bare expand
+    $VAR, single quotes stay literal."""
+    script = tmp_path / "expq.py"
+    script.write_text(
+        "export BASE=/opt/data\n"
+        'export FULL="$BASE/run1"\n'
+        "export RAW='$BASE/run1'\n"
+        "import os\n"
+        "print(os.environ['FULL'])\n"
+        "print(os.environ['RAW'])\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(EXECUTOR_DIR / "launch.py"), str(script)],
+        capture_output=True,
+        text=True,
+        cwd=tmp_path,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.splitlines() == ["/opt/data/run1", "$BASE/run1"]
+
+
 def test_launcher_cleans_up_transformed_file(tmp_path, monkeypatch):
     monkeypatch.setenv("TMPDIR", str(tmp_path / "tmp"))
     (tmp_path / "tmp").mkdir()
