@@ -66,7 +66,11 @@ def load_import_map() -> dict[str, str | None]:
     return table
 
 
-def imported_top_modules(source: str) -> set[str]:
+def imported_modules(source: str) -> set[str]:
+    """Full dotted module paths the script imports. `from google.cloud
+    import bigquery` yields both "google.cloud" and "google.cloud.bigquery"
+    — namespace packages (google.*, azure.*) distribute per SUBpackage, so
+    the top-level name alone cannot identify the distribution."""
     try:
         tree = ast.parse(source)
     except SyntaxError:
@@ -75,11 +79,18 @@ def imported_top_modules(source: str) -> set[str]:
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for alias in node.names:
-                mods.add(alias.name.split(".")[0])
+                mods.add(alias.name)
         elif isinstance(node, ast.ImportFrom):
             if node.module and node.level == 0:
-                mods.add(node.module.split(".")[0])
+                mods.add(node.module)
+                for alias in node.names:
+                    if alias.name != "*":
+                        mods.add(f"{node.module}.{alias.name}")
     return mods
+
+
+def imported_top_modules(source: str) -> set[str]:
+    return {path.split(".")[0] for path in imported_modules(source)}
 
 
 def _base_name(requirement: str) -> str:
@@ -103,24 +114,48 @@ def load_skip_list(runtime_packages: Path) -> set[str]:
     return skip
 
 
+def _find_spec_safe(name: str):
+    """find_spec on a dotted path imports parent packages, which can raise
+    arbitrarily for half-present namespaces — treat any failure as absent."""
+    try:
+        return importlib.util.find_spec(name)
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def missing_packages(
     source: str, runtime_packages: Path | None = None
 ) -> list[str]:
-    mods = imported_top_modules(source)
+    mods = imported_modules(source)
     skip = load_skip_list(runtime_packages) if runtime_packages else set()
     import_map = load_import_map()
     missing: list[str] = []
-    for mod in sorted(mods):
-        if mod in sys.stdlib_module_names:
+    seen: set[str] = set()
+    for mod_path in sorted(mods):
+        top = mod_path.split(".")[0]
+        if top in sys.stdlib_module_names:
             continue
-        if importlib.util.find_spec(mod) is not None:
-            continue
-        pip_name = import_map.get(mod, mod)
+        # Longest-prefix lookup: "google.cloud.bigquery" matches its own map
+        # row even though the top-level "google" namespace is importable.
+        parts = mod_path.split(".")
+        key = None
+        for i in range(len(parts), 0, -1):
+            candidate = ".".join(parts[:i])
+            if candidate in import_map:
+                key = candidate
+                break
+        if key is None:
+            key = top  # identity mapping on the top-level name
+        pip_name = import_map.get(key, key)
         if pip_name is None:
             continue
-        if _base_name(pip_name) in skip or mod.lower() in skip:
+        if _find_spec_safe(key) is not None:
             continue
-        missing.append(pip_name)
+        if _base_name(pip_name) in skip or key.lower() in skip:
+            continue
+        if pip_name not in seen:
+            seen.add(pip_name)
+            missing.append(pip_name)
     return missing
 
 

@@ -257,6 +257,12 @@ def _reset(snapshot: dict) -> bool:
     a live thread behind (it would keep running beside the next
     generation's code; threads cannot be killed from outside in CPython).
 
+    The full gc (which releases the previous user's host+device buffers) is
+    the caller's job AFTER acking the reset: in a jax-laden interpreter a
+    full collection costs tens of ms, and running it post-ack lets it
+    overlap the control plane's workspace wipe and pool bookkeeping instead
+    of sitting on the next request's queue-wait.
+
     Residual-risk contract (documented, not silently assumed): in-place
     mutations of SHARED module state (e.g. ``json.loads = evil``) by hostile
     code are not detectable and not scrubbed — process reuse trades that
@@ -264,7 +270,6 @@ def _reset(snapshot: dict) -> bool:
     executing mutually-hostile tenants should set
     APP_EXECUTOR_REUSE_SANDBOXES=0 and pay the respawn (the reference's
     single-use-pod model)."""
-    import gc
     import signal
     import threading
     import time
@@ -331,7 +336,6 @@ def _reset(snapshot: dict) -> bool:
     # _run_one restores fds, not Python-level bindings).
     sys.stdout, sys.stderr = sys.__stdout__, sys.__stderr__
     sys.path[:] = snapshot["path"]
-    gc.collect()  # drop the previous user's host+device buffers
     return True
 
 
@@ -396,7 +400,15 @@ def main() -> None:
             try:
                 req = json.loads(line)
                 if req.get("op") == "reset":
-                    _send({"ok": _reset(snapshot)})
+                    ok = _reset(snapshot)
+                    _send({"ok": ok})
+                    if ok:
+                        import gc
+
+                        # Post-ack: drop the previous generation's host and
+                        # device buffers while the server wipes the
+                        # workspace — off the next request's critical path.
+                        gc.collect()
                 else:
                     exit_code = _run_one(req)
                     _send({"exit_code": exit_code})
