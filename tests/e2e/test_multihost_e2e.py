@@ -21,14 +21,15 @@ from bee_code_interpreter_fs_tpu.services.storage import Storage
 
 
 def _config(tmp_path, **kwargs) -> Config:
-    return Config(
+    defaults = dict(
         file_storage_path=str(tmp_path / "storage"),
         local_sandbox_root=str(tmp_path / "sandboxes"),
         executor_pod_queue_target_length=0,
         tpu_chips_per_host=1,  # every "chip" is its own local host process
         jax_compilation_cache_dir="",
-        **kwargs,
     )
+    defaults.update(kwargs)
+    return Config(**defaults)
 
 
 @pytest.fixture
@@ -60,6 +61,44 @@ async def test_fanout_mechanics(mechanics_executor):
     assert set(result.files) >= {"/workspace/host0.txt", "/workspace/host1.txt"}
     data = await executor.storage.read(result.files["/workspace/host1.txt"])
     assert data == b"from host 1"
+
+
+async def test_group_recycled_across_generations(tmp_path):
+    """A multi-host slice group is reused whole across sandbox generations:
+    both hosts reset, both keep the same processes (the jax.distributed
+    membership — re-forming it would cost a full group respawn), and the
+    second request sees pristine workspaces on every host."""
+    import asyncio
+
+    config = _config(tmp_path, executor_pod_queue_target_length=1)
+    backend = LocalSandboxBackend(config, warm_import_jax=False)
+    executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
+    try:
+        await executor.fill_pool(2)
+        procs_before = {h: p.pid for h, (p, _) in backend._procs.items()}
+        assert len(procs_before) == 2
+
+        first = await executor.execute(
+            "import os\nopen(f\"left{os.environ['APP_HOST_ID']}.txt\", 'w')"
+            ".write('x')\nprint('gen1')\n",
+            chip_count=2,
+        )
+        assert first.exit_code == 0, first.stderr
+        for _ in range(200):
+            pending = list(executor._dispose_tasks) + list(executor._fill_tasks)
+            if not pending:
+                break
+            await asyncio.gather(*pending, return_exceptions=True)
+
+        second = await executor.execute(
+            "import os\nprint(sorted(os.listdir('.')))\n", chip_count=2
+        )
+        assert second.exit_code == 0, second.stderr
+        assert second.stdout.strip() == "[]"  # every host's workspace wiped
+        procs_after = {h: p.pid for h, (p, _) in backend._procs.items()}
+        assert procs_after == procs_before  # same group, no respawn
+    finally:
+        await executor.close()
 
 
 async def test_fanout_peer_failure_fails_execute(mechanics_executor):
