@@ -368,20 +368,21 @@ class CodeExecutor:
                 # mesh), and outputs merge with host-0 precedence.
                 hosts = sandbox.host_urls
                 with timer.phase("upload"):
-                    # One storage read per object, shared across hosts.
-                    contents = dict(
-                        zip(
-                            files,
-                            await asyncio.gather(
-                                *(self._read_object(oid) for oid in files.values())
-                            ),
-                        )
-                    )
+                    # Validate ids up front (unknown id = client error, not
+                    # an upload failure), then stream each object from
+                    # storage per host — input files never fully buffer in
+                    # control-plane memory (a multi-GB session file times N
+                    # hosts would otherwise blow the heap).
+                    for object_id in files.values():
+                        if not await self.storage.exists(object_id):
+                            raise ValueError(
+                                f"unknown file object id: {object_id}"
+                            )
                     await asyncio.gather(
                         *(
-                            self._upload_file(client, base, path, contents[path])
+                            self._upload_file(client, base, path, object_id)
                             for base in hosts
-                            for path in files
+                            for path, object_id in files.items()
                         )
                     )
                 with timer.phase("exec"):
@@ -492,21 +493,23 @@ class CodeExecutor:
                 f"sandbox {sandbox.id} ({base}) returned malformed JSON: {e}"
             )
 
-    async def _read_object(self, object_id: str) -> bytes:
-        try:
-            async with self.storage.reader(object_id) as reader:
-                return await reader.read()
-        except KeyError:
-            raise ValueError(f"unknown file object id: {object_id}")
-
     async def _upload_file(
-        self, client: httpx.AsyncClient, base: str, path: str, data: bytes
+        self, client: httpx.AsyncClient, base: str, path: str, object_id: str
     ) -> None:
         rel = normalize_workspace_path(path)
         if rel.startswith("workspace/"):
             rel = rel[len("workspace/") :]
+
+        async def stream():
+            async with self.storage.reader(object_id) as reader:
+                while True:
+                    data = await reader.read(1 << 20)
+                    if not data:
+                        return
+                    yield data
+
         try:
-            resp = await client.put(f"{base}/workspace/{rel}", content=data)
+            resp = await client.put(f"{base}/workspace/{rel}", content=stream())
         except httpx.HTTPError as e:
             raise ExecutorError(f"upload of {path} failed: {e}")
         if resp.status_code != 200:

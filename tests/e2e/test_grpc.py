@@ -101,6 +101,15 @@ async def test_execute_validation_abort(client):
         )
     assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
 
+    # Documented numeric constraints (proto/code_interpreter.proto)
+    with pytest.raises(grpc.aio.AioRpcError) as e:
+        await client.execute(pb2.ExecuteRequest(source_code="x", timeout=-5))
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    with pytest.raises(grpc.aio.AioRpcError) as e:
+        await client.execute(pb2.ExecuteRequest(source_code="x", chip_count=-4))
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
 
 async def test_file_roundtrip(client):
     resp = await client.execute(
