@@ -97,7 +97,15 @@ class CodeExecutor:
         self._fill_tasks: set[asyncio.Task] = set()
         self._dispose_tasks: set[asyncio.Task] = set()
         self._closed = False
+        # One persistent client for all sandbox HTTP: connection pooling
+        # keeps per-request TCP setup off the Execute path.
+        self._client: httpx.AsyncClient | None = None
         self.metrics.bind_pool(self._pools)
+
+    def _http_client(self) -> httpx.AsyncClient:
+        if self._client is None or self._client.is_closed:
+            self._client = httpx.AsyncClient(timeout=httpx.Timeout(30.0))
+        return self._client
 
     # ------------------------------------------------------------------ pool
 
@@ -361,70 +369,68 @@ class CodeExecutor:
             sandbox = await self._acquire(lane)
         reusable = False
         try:
-            async with httpx.AsyncClient(timeout=httpx.Timeout(30.0)) as client:
-                # A multi-host slice is one sandbox with an executor per host:
-                # inputs go to every host, /execute fires on every host (the
-                # hosts rendezvous via their pre-established jax.distributed
-                # mesh), and outputs merge with host-0 precedence.
-                hosts = sandbox.host_urls
-                with timer.phase("upload"):
-                    # Validate ids up front (unknown id = client error, not
-                    # an upload failure), then stream each object from
-                    # storage per host — input files never fully buffer in
-                    # control-plane memory (a multi-GB session file times N
-                    # hosts would otherwise blow the heap).
-                    for object_id in files.values():
-                        if not await self.storage.exists(object_id):
-                            raise ValueError(
-                                f"unknown file object id: {object_id}"
-                            )
-                    await asyncio.gather(
-                        *(
-                            self._upload_file(client, base, path, object_id)
-                            for base in hosts
-                            for path, object_id in files.items()
-                        )
+            client = self._http_client()
+            # A multi-host slice is one sandbox with an executor per host:
+            # inputs go to every host, /execute fires on every host (the
+            # hosts rendezvous via their pre-established jax.distributed
+            # mesh), and outputs merge with host-0 precedence.
+            hosts = sandbox.host_urls
+            with timer.phase("upload"):
+                # Validate ids up front (unknown id = client error, not an
+                # upload failure), then stream each object from storage per
+                # host — input files never fully buffer in control-plane
+                # memory (a multi-GB session file times N hosts would
+                # otherwise blow the heap).
+                for object_id in files.values():
+                    if not await self.storage.exists(object_id):
+                        raise ValueError(f"unknown file object id: {object_id}")
+                await asyncio.gather(
+                    *(
+                        self._upload_file(client, base, path, object_id)
+                        for base in hosts
+                        for path, object_id in files.items()
                     )
-                with timer.phase("exec"):
-                    payload: dict = {"timeout": timeout}
-                    if env:
-                        payload["env"] = env
-                    if source_code is not None:
-                        payload["source_code"] = source_code
-                    else:
-                        payload["source_file"] = source_file
-                    bodies = await asyncio.gather(
-                        *(
-                            self._post_execute(client, base, payload, timeout, sandbox)
-                            for base in hosts
-                        ),
-                        # Let every host finish before surfacing a failure —
-                        # a half-cancelled slice group would leak in-flight
-                        # requests into the dispose path.
-                        return_exceptions=True,
+                )
+            with timer.phase("exec"):
+                payload: dict = {"timeout": timeout}
+                if env:
+                    payload["env"] = env
+                if source_code is not None:
+                    payload["source_code"] = source_code
+                else:
+                    payload["source_file"] = source_file
+                bodies = await asyncio.gather(
+                    *(
+                        self._post_execute(client, base, payload, timeout, sandbox)
+                        for base in hosts
+                    ),
+                    # Let every host finish before surfacing a failure — a
+                    # half-cancelled slice group would leak in-flight
+                    # requests into the dispose path.
+                    return_exceptions=True,
+                )
+                failure = next(
+                    (b for b in bodies if isinstance(b, BaseException)), None
+                )
+                if failure is not None:
+                    raise failure
+            with timer.phase("download"):
+                # Host 0 wins path conflicts (it is the coordinator and, per
+                # JAX convention, the process that does singular side
+                # effects); per-shard files unique to other hosts are still
+                # captured. Resolving the winner BEFORE downloading fetches
+                # each path exactly once — no N-way duplicate downloads, no
+                # orphaned storage objects.
+                winner: dict[str, str] = {}
+                for base, body in zip(hosts, bodies):
+                    for rel in body.get("files", []):
+                        winner.setdefault(rel, base)
+                changed = await asyncio.gather(
+                    *(
+                        self._download_file(client, base, rel)
+                        for rel, base in winner.items()
                     )
-                    failure = next(
-                        (b for b in bodies if isinstance(b, BaseException)), None
-                    )
-                    if failure is not None:
-                        raise failure
-                with timer.phase("download"):
-                    # Host 0 wins path conflicts (it is the coordinator and,
-                    # per JAX convention, the process that does singular side
-                    # effects); per-shard files unique to other hosts are
-                    # still captured. Resolving the winner BEFORE downloading
-                    # fetches each path exactly once — no N-way duplicate
-                    # downloads, no orphaned storage objects.
-                    winner: dict[str, str] = {}
-                    for base, body in zip(hosts, bodies):
-                        for rel in body.get("files", []):
-                            winner.setdefault(rel, base)
-                    changed = await asyncio.gather(
-                        *(
-                            self._download_file(client, base, rel)
-                            for rel, base in winner.items()
-                        )
-                    )
+                )
             merged_files = {
                 f"/workspace/{rel}": object_id for rel, object_id in changed
             }
@@ -598,4 +604,6 @@ class CodeExecutor:
         sandboxes = [s for pool in self._pools.values() for s in pool]
         self._pools.clear()
         await asyncio.gather(*(self._dispose(s) for s in sandboxes))
+        if self._client is not None and not self._client.is_closed:
+            await self._client.aclose()
         await self.backend.close()

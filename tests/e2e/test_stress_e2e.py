@@ -1,0 +1,111 @@
+"""Concurrency stress over the full local stack: mixed success / user-error /
+timeout / file-writing requests racing through the pool's recycle machinery.
+
+The reference had nothing like this (SURVEY.md §5: no race detection); the
+asyncio pool bookkeeping (in-use accounting, event wakeups, recycle-vs-
+dispose races, slot lifecycle) is exactly the code a sequential test cannot
+falsify, so this drives it with a burst of interleaved outcomes and then
+audits the end state: correct per-request results, isolated workspaces,
+bounded live processes, empty in-use/spawning counters.
+"""
+
+import asyncio
+
+import pytest
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.backends.local import LocalSandboxBackend
+from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+REQUESTS = 32
+
+
+@pytest.fixture
+async def stack(tmp_path):
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        local_sandbox_root=str(tmp_path / "sandboxes"),
+        executor_pod_queue_target_length=3,
+        jax_compilation_cache_dir="",
+        default_execution_timeout=30.0,
+    )
+    backend = LocalSandboxBackend(config, warm_import_jax=False)
+    executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
+    yield executor, backend
+    await executor.close()
+
+
+async def _settle(executor):
+    for _ in range(400):
+        pending = list(executor._dispose_tasks) + list(executor._fill_tasks)
+        if not pending:
+            return
+        await asyncio.gather(*pending, return_exceptions=True)
+
+
+async def test_mixed_burst_races_pool_machinery(stack):
+    executor, backend = stack
+    await executor.fill_pool()
+
+    def source_for(i: int) -> tuple[str, int]:
+        """(source, expected_exit_code) per request flavor."""
+        flavor = i % 4
+        if flavor == 0:  # plain success
+            return f"print('req-{i}')", 0
+        if flavor == 1:  # writes a uniquely-named file
+            return (
+                f"import os\nopen('out-{i}.txt', 'w').write('{i}')\n"
+                f"print(len(os.listdir('.')))",
+                0,
+            )
+        if flavor == 2:  # user error (sandbox stays healthy)
+            return f"raise RuntimeError('req-{i} boom')", 1
+        return f"import sys\nprint('req-{i}')\nsys.exit({i % 7})", i % 7
+
+    expected = [source_for(i) for i in range(REQUESTS)]
+    results = await asyncio.gather(
+        *(executor.execute(src) for src, _ in expected)
+    )
+
+    for i, (result, (_, want_exit)) in enumerate(zip(results, expected)):
+        assert result.exit_code == want_exit, (
+            f"req {i}: exit {result.exit_code} != {want_exit}: "
+            f"{result.stderr[-200:]}"
+        )
+        flavor = i % 4
+        if flavor == 0:
+            assert result.stdout == f"req-{i}\n"
+        elif flavor == 1:
+            # Workspace isolation under recycling: this request saw exactly
+            # its own file, nothing from any other generation.
+            assert result.stdout == "1\n", result.stdout
+            assert set(result.files) == {f"/workspace/out-{i}.txt"}
+        elif flavor == 2:
+            assert f"req-{i} boom" in result.stderr
+
+    await _settle(executor)
+    # End-state audit: no runaway processes, consistent accounting.
+    target = executor.config.executor_pod_queue_target_length
+    assert len(backend._procs) <= target
+    assert sum(len(pool) for pool in executor._pools.values()) <= target
+    assert all(v == 0 for v in executor._in_use.values())
+    assert all(v == 0 for v in executor._spawning.values())
+    assert all(v == 0 for v in executor._waiting.values())
+
+
+async def test_timeout_storm_recovers(stack):
+    """A wave of timeouts poisons every runner at once; the service must
+    dispose them all and still serve fresh requests afterwards."""
+    executor, backend = stack
+    await executor.fill_pool()
+    storm = await asyncio.gather(
+        *(executor.execute("while True: pass", timeout=1) for _ in range(4))
+    )
+    assert all(r.exit_code == -1 for r in storm)
+    assert all("timed out" in r.stderr for r in storm)
+    await _settle(executor)
+    after = await asyncio.gather(
+        *(executor.execute(f"print({i})") for i in range(4))
+    )
+    assert [r.stdout for r in after] == ["0\n", "1\n", "2\n", "3\n"]
