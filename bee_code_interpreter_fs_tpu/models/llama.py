@@ -47,6 +47,11 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
+    # Mixture-of-experts (Mixtral-class): 0 = dense MLP. With n_experts > 0
+    # every layer's MLP becomes n_experts expert MLPs with top-k routing;
+    # experts shard over the "ep" mesh axis (param_specs).
+    n_experts: int = 0
+    n_experts_per_token: int = 2
 
     @property
     def head_dim(self) -> int:
@@ -81,7 +86,23 @@ def init_params(key, cfg: LlamaConfig):
 
     L = cfg.n_layers
     ka = jax.random.split(k_attn, 4)
-    km = jax.random.split(k_mlp, 3)
+    km = jax.random.split(k_mlp, 4)
+    if cfg.n_experts > 0:
+        E = cfg.n_experts
+        mlp = {
+            "router": dense(km[3], (L, cfg.dim, E), cfg.dim),
+            "w_gate": dense(km[0], (L, E, cfg.dim, cfg.hidden_dim), cfg.dim),
+            "w_up": dense(km[1], (L, E, cfg.dim, cfg.hidden_dim), cfg.dim),
+            "w_down": dense(
+                km[2], (L, E, cfg.hidden_dim, cfg.dim), cfg.hidden_dim
+            ),
+        }
+    else:
+        mlp = {
+            "w_gate": dense(km[0], (L, cfg.dim, cfg.hidden_dim), cfg.dim),
+            "w_up": dense(km[1], (L, cfg.dim, cfg.hidden_dim), cfg.dim),
+            "w_down": dense(km[2], (L, cfg.hidden_dim, cfg.dim), cfg.hidden_dim),
+        }
     return {
         "embed": dense(k_emb, (cfg.vocab_size, cfg.dim), 1.0),
         "layers": {
@@ -91,9 +112,7 @@ def init_params(key, cfg: LlamaConfig):
             "wv": dense(ka[2], (L, cfg.dim, nkv * hd), cfg.dim),
             "wo": dense(ka[3], (L, nh * hd, cfg.dim), nh * hd),
             "mlp_norm": jnp.ones((L, cfg.dim), jnp.float32),
-            "w_gate": dense(km[0], (L, cfg.dim, cfg.hidden_dim), cfg.dim),
-            "w_up": dense(km[1], (L, cfg.dim, cfg.hidden_dim), cfg.dim),
-            "w_down": dense(km[2], (L, cfg.hidden_dim, cfg.dim), cfg.hidden_dim),
+            **mlp,
         },
         "final_norm": jnp.ones((cfg.dim,), jnp.float32),
         "lm_head": dense(k_out, (cfg.dim, cfg.vocab_size), cfg.dim),
@@ -105,8 +124,23 @@ def param_specs(cfg: LlamaConfig):
 
     Projections shard their head/hidden dimension; wo/w_down shard the
     contracting dimension so each block needs exactly one psum (XLA inserts
-    it). Embedding shards the vocab dim; norms replicate.
+    it). Embedding shards the vocab dim; norms replicate. MoE experts shard
+    their expert dimension over "ep" AND their hidden dimension over "tp" —
+    the weighted combine over experts becomes the per-layer ep psum.
     """
+    if cfg.n_experts > 0:
+        mlp = {
+            "router": P(None, None, None),
+            "w_gate": P(None, "ep", None, "tp"),
+            "w_up": P(None, "ep", None, "tp"),
+            "w_down": P(None, "ep", "tp", None),
+        }
+    else:
+        mlp = {
+            "w_gate": P(None, None, "tp"),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+        }
     return {
         "embed": P("tp", None),
         "layers": {
@@ -116,9 +150,7 @@ def param_specs(cfg: LlamaConfig):
             "wv": P(None, None, "tp"),
             "wo": P(None, "tp", None),
             "mlp_norm": P(None, None),
-            "w_gate": P(None, None, "tp"),
-            "w_up": P(None, None, "tp"),
-            "w_down": P(None, "tp", None),
+            **mlp,
         },
         "final_norm": P(None),
         "lm_head": P(None, "tp"),
@@ -144,6 +176,35 @@ def _rope(x, theta):
     sin = sin[None, :, None, :].astype(x.dtype)
     out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.reshape(b, t, h, d)
+
+
+def _moe_mlp(h, lp, cfg: LlamaConfig):
+    """Mixtral-class top-k MoE MLP, SPMD-first dense dispatch.
+
+    Router picks k of E experts per token (softmax over the top-k logits
+    renormalized); the expert computation is written as einsums over a
+    stacked [E, dim, hidden] weight tensor, so GSPMD partitions the E
+    dimension across the "ep" mesh axis from the param shardings alone —
+    each device runs its local experts over the full token set and the
+    weighted combine over E lowers to one psum on ep per layer. Dense
+    dispatch trades FLOPs (every expert sees every token, inflation E/k)
+    for zero dynamic shapes and no all-to-all — the right trade below the
+    scale where ragged dispatch kernels pay for themselves; swap in a
+    Pallas ragged dispatch at Mixtral-8x7B scale.
+    """
+    router_logits = (h @ lp["router"].astype(h.dtype)).astype(jnp.float32)
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [b, t, E]
+    top_w, top_i = lax.top_k(probs, cfg.n_experts_per_token)  # [b, t, k]
+    top_w = top_w / top_w.sum(axis=-1, keepdims=True)
+    # Dense per-token expert weights: zero outside the top-k.
+    weights = (
+        jax.nn.one_hot(top_i, cfg.n_experts, dtype=jnp.float32)
+        * top_w[..., None]
+    ).sum(axis=-2)  # [b, t, E]
+    gate = jax.nn.silu(jnp.einsum("btd,edh->bteh", h, lp["w_gate"]))
+    up = jnp.einsum("btd,edh->bteh", h, lp["w_up"])
+    y = jnp.einsum("bteh,ehd->bted", gate * up, lp["w_down"])
+    return jnp.einsum("bted,bte->btd", y, weights.astype(y.dtype))
 
 
 def _plain_causal_attention(q, k, v, scale):
@@ -192,8 +253,11 @@ def forward(params, tokens, cfg: LlamaConfig, *, mesh: Mesh | None = None):
         x = x + attn.reshape(b, t, nh * hd) @ lp["wo"]
 
         h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ lp["w_gate"])
-        x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+        if cfg.n_experts > 0:
+            x = x + _moe_mlp(h, lp, cfg)
+        else:
+            gate = jax.nn.silu(h @ lp["w_gate"])
+            x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
         return x, None
 
     x, _ = lax.scan(layer, x, params["layers"])

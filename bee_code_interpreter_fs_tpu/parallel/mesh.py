@@ -10,8 +10,10 @@ device count, and naming the axes consistently across the framework.
 Axis conventions (used by models/ and __graft_entry__):
   dp — data parallel: batch is split, gradients all-reduced.
   sp — sequence/context parallel: sequence dimension split (ring attention).
+  ep — expert parallel: MoE experts split; per-layer partial sums psum'd.
   tp — tensor parallel: attention heads / MLP hidden split, activations
-       all-reduced per block.
+       all-reduced per block. Last = ICI-nearest (its collectives fire the
+       most often per layer).
 """
 
 from __future__ import annotations
@@ -23,7 +25,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-AXES = ("dp", "sp", "tp")
+AXES = ("dp", "sp", "ep", "tp")
 
 
 @dataclass(frozen=True)
@@ -47,13 +49,14 @@ def best_mesh_shape(
     *,
     tp: int | None = None,
     sp: int | None = None,
+    ep: int | None = None,
 ) -> MeshSpec:
-    """Pick a (dp, sp, tp) factorization of n_devices.
+    """Pick a (dp, sp, ep, tp) factorization of n_devices.
 
     Heuristic: tp wants the ICI-nearest (fastest, last) axis and benefits most
     up to the MXU-efficient head count, so give tp the largest power-of-two
-    factor <= 4 unless pinned; sp defaults to 1 unless pinned; dp absorbs the
-    rest. All axes must divide n_devices.
+    factor <= 4 unless pinned; sp and ep default to 1 unless pinned; dp
+    absorbs the rest. All axes must divide n_devices.
     """
     if n_devices < 1:
         raise ValueError("n_devices must be >= 1")
@@ -70,8 +73,13 @@ def best_mesh_shape(
         sp = 1
     if rest % sp != 0:
         raise ValueError(f"sp={sp} does not divide n_devices/tp={rest}")
-    dp = rest // sp
-    return MeshSpec(shape=(dp, sp, tp))
+    rest //= sp
+    if ep is None:
+        ep = 1
+    if rest % ep != 0:
+        raise ValueError(f"ep={ep} does not divide n_devices/(tp*sp)={rest}")
+    dp = rest // ep
+    return MeshSpec(shape=(dp, sp, ep, tp))
 
 
 def make_mesh(

@@ -19,17 +19,20 @@ from bee_code_interpreter_fs_tpu.models.llama import _plain_causal_attention
 
 
 def test_best_mesh_shape_factors():
-    assert best_mesh_shape(8).shape == (2, 1, 4)
-    assert best_mesh_shape(8, tp=2, sp=2).shape == (2, 2, 2)
-    assert best_mesh_shape(1).shape == (1, 1, 1)
-    assert best_mesh_shape(6, tp=2).shape == (3, 1, 2)
+    assert best_mesh_shape(8).shape == (2, 1, 1, 4)
+    assert best_mesh_shape(8, tp=2, sp=2).shape == (2, 2, 1, 2)
+    assert best_mesh_shape(8, tp=2, sp=2, ep=2).shape == (1, 2, 2, 2)
+    assert best_mesh_shape(1).shape == (1, 1, 1, 1)
+    assert best_mesh_shape(6, tp=2).shape == (3, 1, 1, 2)
     with pytest.raises(ValueError):
         best_mesh_shape(8, tp=3)
+    with pytest.raises(ValueError):
+        best_mesh_shape(8, tp=2, sp=2, ep=3)
 
 
 def test_make_mesh_axes():
     mesh = make_mesh(best_mesh_shape(8, tp=2, sp=2))
-    assert mesh.shape == {"dp": 2, "sp": 2, "tp": 2}
+    assert mesh.shape == {"dp": 2, "sp": 2, "ep": 1, "tp": 2}
     assert len(mesh.devices.flatten()) == 8
 
 
