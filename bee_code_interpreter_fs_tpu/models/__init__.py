@@ -10,8 +10,11 @@ the matmul path, static shapes.
 
 from bee_code_interpreter_fs_tpu.models.llama import (
     LlamaConfig,
-    init_params,
+    decode_step,
     forward,
+    generate,
+    init_cache,
+    init_params,
     loss_fn,
     make_train_step,
     param_specs,
@@ -19,8 +22,11 @@ from bee_code_interpreter_fs_tpu.models.llama import (
 
 __all__ = [
     "LlamaConfig",
-    "init_params",
+    "decode_step",
     "forward",
+    "generate",
+    "init_cache",
+    "init_params",
     "loss_fn",
     "make_train_step",
     "param_specs",

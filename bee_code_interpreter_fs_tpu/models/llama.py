@@ -165,11 +165,13 @@ def _rms_norm(x, w, eps):
     return (x32 * rms).astype(x.dtype) * w.astype(x.dtype)
 
 
-def _rope(x, theta):
-    """Rotary embedding over [b, t, h, d]."""
+def _rope(x, theta, offset=0):
+    """Rotary embedding over [b, t, h, d]; `offset` shifts the position
+    index (incremental decoding: the single new token sits at `pos`)."""
     b, t, h, d = x.shape
     freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    angles = jnp.arange(t, dtype=jnp.float32)[:, None] * freqs[None, :]  # [t, d/2]
+    positions = jnp.arange(t, dtype=jnp.float32) + offset
+    angles = positions[:, None] * freqs[None, :]  # [t, d/2]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
     x1, x2 = x[..., 0::2], x[..., 1::2]
     cos = cos[None, :, None, :].astype(x.dtype)
@@ -263,6 +265,101 @@ def forward(params, tokens, cfg: LlamaConfig, *, mesh: Mesh | None = None):
     x, _ = lax.scan(layer, x, params["layers"])
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------- decoding
+
+def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int | None = None):
+    """Stacked per-layer KV cache (unexpanded GQA heads — memory scales with
+    n_kv_heads, not n_heads): {"k"|"v": [L, b, max_len, n_kv, head_dim]}."""
+    max_len = max_len or cfg.max_seq_len
+    shape = (
+        cfg.n_layers,
+        batch_size,
+        max_len,
+        cfg.n_kv_heads,
+        cfg.head_dim,
+    )
+    dt = jnp.dtype(cfg.dtype)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def decode_step(params, tokens, cache, pos, cfg: LlamaConfig):
+    """One incremental decoding step.
+
+    tokens: [b, 1] int32 — the token at position `pos` (a traced scalar, so
+    one compile serves every step). Returns (logits [b, vocab] float32,
+    updated cache). Attention reads the cache up to and including `pos`
+    (static cache length + a position mask — no dynamic shapes under jit).
+    """
+    dt = jnp.dtype(cfg.dtype)
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    scale = hd ** -0.5
+    max_len = cache["k"].shape[2]
+    valid = (jnp.arange(max_len) <= pos)[None, None, None, :]  # [1,1,1,max]
+
+    x = params["embed"].astype(dt)[tokens]  # [b, 1, dim]
+
+    def layer(x, inputs):
+        lp, ck, cv = inputs  # ck/cv: [b, max, nkv, hd]
+        b = x.shape[0]
+        h = _rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(b, 1, nh, hd)
+        k = (h @ lp["wk"]).reshape(b, 1, nkv, hd)
+        v = (h @ lp["wv"]).reshape(b, 1, nkv, hd)
+        q = _rope(q, cfg.rope_theta, offset=pos)
+        k = _rope(k, cfg.rope_theta, offset=pos)
+        ck = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+        keys, values = ck, cv
+        if nkv != nh:  # GQA: expand kv heads at read time
+            rep = nh // nkv
+            keys = jnp.repeat(keys, rep, axis=2)
+            values = jnp.repeat(values, rep, axis=2)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, keys).astype(jnp.float32) * scale
+        s = jnp.where(valid, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p, values)
+        x = x + attn.reshape(b, 1, nh * hd) @ lp["wo"]
+
+        h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        if cfg.n_experts > 0:
+            x = x + _moe_mlp(h, lp, cfg)
+        else:
+            gate = jax.nn.silu(h @ lp["w_gate"])
+            x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(layer, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits[:, 0], {"k": new_k, "v": new_v}
+
+
+def generate(params, prompt_tokens, cfg: LlamaConfig, *, max_new_tokens: int,
+             max_len: int | None = None):
+    """Greedy autoregressive generation: prefill the cache token-by-token
+    through the jitted decode_step (one compile serves the whole sequence —
+    `pos` is a traced scalar), then sample argmax continuations.
+    Returns [b, prompt + max_new_tokens] int32.
+    """
+    b, prompt_len = prompt_tokens.shape
+    max_len = max_len or (prompt_len + max_new_tokens)
+    cache = init_cache(cfg, b, max_len)
+    step = jax.jit(partial(decode_step, cfg=cfg))
+
+    tokens = prompt_tokens
+    logits = None
+    for i in range(prompt_len):
+        logits, cache = step(params, tokens[:, i : i + 1], cache, jnp.int32(i))
+    for i in range(max_new_tokens):
+        next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+        tokens = jnp.concatenate([tokens, next_token], axis=1)
+        if i + 1 < max_new_tokens:
+            logits, cache = step(
+                params, next_token, cache, jnp.int32(prompt_len + i)
+            )
+    return tokens
 
 
 # ---------------------------------------------------------------- training
