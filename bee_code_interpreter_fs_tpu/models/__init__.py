@@ -18,6 +18,7 @@ from bee_code_interpreter_fs_tpu.models.llama import (
     loss_fn,
     make_train_step,
     param_specs,
+    prefill,
 )
 
 __all__ = [
@@ -30,4 +31,5 @@ __all__ = [
     "loss_fn",
     "make_train_step",
     "param_specs",
+    "prefill",
 ]

@@ -218,6 +218,41 @@ def _plain_causal_attention(q, k, v, scale):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def _expand_gqa(k, v, n_heads):
+    """Repeat kv heads up to n_heads (full-sequence attention paths; the
+    decode path contracts against unexpanded kv instead — no cache copy)."""
+    rep = n_heads // k.shape[2]
+    if rep == 1:
+        return k, v
+    return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+
+
+def transformer_block(x, lp, cfg: LlamaConfig, attn_fn, *, rope_offset=0):
+    """One pre-norm decoder block: attention + (dense | MoE) MLP, residual
+    around each. `attn_fn(q, k, v) -> attn` receives UNexpanded kv heads
+    ([b, t, n_kv_heads, hd]) so callers can swap plain causal attention,
+    ring attention (sp), or a KV-cached variant without duplicating the
+    block arithmetic; `rope_offset` positions incremental-decode tokens."""
+    b, t, _ = x.shape
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    h = _rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(b, t, nh, hd)
+    k = (h @ lp["wk"]).reshape(b, t, nkv, hd)
+    v = (h @ lp["wv"]).reshape(b, t, nkv, hd)
+    q = _rope(q, cfg.rope_theta, offset=rope_offset)
+    k = _rope(k, cfg.rope_theta, offset=rope_offset)
+    attn = attn_fn(q, k, v)
+    x = x + attn.reshape(b, t, nh * hd) @ lp["wo"]
+
+    h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    if cfg.n_experts > 0:
+        x = x + _moe_mlp(h, lp, cfg)
+    else:
+        gate = jax.nn.silu(h @ lp["w_gate"])
+        x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    return x
+
+
 def forward(params, tokens, cfg: LlamaConfig, *, mesh: Mesh | None = None):
     """Token ids [b, t] -> logits [b, t, vocab] (float32).
 
@@ -239,28 +274,15 @@ def forward(params, tokens, cfg: LlamaConfig, *, mesh: Mesh | None = None):
         )
 
     x = params["embed"].astype(dt)[tokens]  # [b, t, dim]
+    if use_ring:
+        attn_fn = lambda q, k, v: ring(q, *_expand_gqa(k, v, nh))  # noqa: E731
+    else:
+        attn_fn = lambda q, k, v: _plain_causal_attention(  # noqa: E731
+            q, *_expand_gqa(k, v, nh), scale
+        )
 
     def layer(x, lp):
-        b, t, _ = x.shape
-        h = _rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(b, t, nh, hd)
-        k = (h @ lp["wk"]).reshape(b, t, nkv, hd)
-        v = (h @ lp["wv"]).reshape(b, t, nkv, hd)
-        q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
-        if nkv != nh:  # GQA: expand kv heads
-            rep = nh // nkv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        attn = ring(q, k, v) if use_ring else _plain_causal_attention(q, k, v, scale)
-        x = x + attn.reshape(b, t, nh * hd) @ lp["wo"]
-
-        h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        if cfg.n_experts > 0:
-            x = x + _moe_mlp(h, lp, cfg)
-        else:
-            gate = jax.nn.silu(h @ lp["w_gate"])
-            x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
-        return x, None
+        return transformer_block(x, lp, cfg, attn_fn), None
 
     x, _ = lax.scan(layer, x, params["layers"])
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
@@ -284,6 +306,22 @@ def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int | None = None):
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
+def _cached_gqa_attention(q, keys, values, valid, scale):
+    """Attention of `q` [b, t, nh, hd] against an UNexpanded cache
+    ([b, max, nkv, hd]) via a grouped contraction — no jnp.repeat copy of
+    the whole cache on the per-token hot path (the n_kv_heads memory saving
+    init_cache advertises must hold at read time too)."""
+    b, t, nh, hd = q.shape
+    nkv = keys.shape[2]
+    rep = nh // nkv
+    qg = q.reshape(b, t, nkv, rep, hd)
+    s = jnp.einsum("btgrd,bkgd->bgrtk", qg, keys).astype(jnp.float32) * scale
+    s = jnp.where(valid, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    attn = jnp.einsum("bgrtk,bkgd->btgrd", p, values)
+    return attn.reshape(b, t, nh, hd)
+
+
 def decode_step(params, tokens, cache, pos, cfg: LlamaConfig):
     """One incremental decoding step.
 
@@ -291,44 +329,28 @@ def decode_step(params, tokens, cache, pos, cfg: LlamaConfig):
     one compile serves every step). Returns (logits [b, vocab] float32,
     updated cache). Attention reads the cache up to and including `pos`
     (static cache length + a position mask — no dynamic shapes under jit).
+    Jit with ``donate_argnums=(2,)`` so the cache updates in place instead
+    of copying [L, b, max, nkv, hd] twice per token (generate() does).
     """
     dt = jnp.dtype(cfg.dtype)
-    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
-    scale = hd ** -0.5
+    scale = cfg.head_dim ** -0.5
     max_len = cache["k"].shape[2]
-    valid = (jnp.arange(max_len) <= pos)[None, None, None, :]  # [1,1,1,max]
+    valid = (jnp.arange(max_len) <= pos)[None, None, None, None, :]
 
     x = params["embed"].astype(dt)[tokens]  # [b, 1, dim]
 
     def layer(x, inputs):
         lp, ck, cv = inputs  # ck/cv: [b, max, nkv, hd]
-        b = x.shape[0]
-        h = _rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(b, 1, nh, hd)
-        k = (h @ lp["wk"]).reshape(b, 1, nkv, hd)
-        v = (h @ lp["wv"]).reshape(b, 1, nkv, hd)
-        q = _rope(q, cfg.rope_theta, offset=pos)
-        k = _rope(k, cfg.rope_theta, offset=pos)
-        ck = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
-        keys, values = ck, cv
-        if nkv != nh:  # GQA: expand kv heads at read time
-            rep = nh // nkv
-            keys = jnp.repeat(keys, rep, axis=2)
-            values = jnp.repeat(values, rep, axis=2)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, keys).astype(jnp.float32) * scale
-        s = jnp.where(valid, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", p, values)
-        x = x + attn.reshape(b, 1, nh * hd) @ lp["wo"]
+        cell = {}
 
-        h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        if cfg.n_experts > 0:
-            x = x + _moe_mlp(h, lp, cfg)
-        else:
-            gate = jax.nn.silu(h @ lp["w_gate"])
-            x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
-        return x, (ck, cv)
+        def attn_fn(q, k, v):
+            new_k = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+            new_v = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+            cell["kv"] = (new_k, new_v)
+            return _cached_gqa_attention(q, new_k, new_v, valid, scale)
+
+        x = transformer_block(x, lp, cfg, attn_fn, rope_offset=pos)
+        return x, cell["kv"]
 
     x, (new_k, new_v) = lax.scan(layer, x, (params["layers"], cache["k"], cache["v"]))
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
@@ -336,22 +358,66 @@ def decode_step(params, tokens, cache, pos, cfg: LlamaConfig):
     return logits[:, 0], {"k": new_k, "v": new_v}
 
 
+def prefill(params, tokens, cache, cfg: LlamaConfig):
+    """Process the whole prompt in ONE forward pass, writing every K/V
+    position into the cache (one device dispatch and one cache write per
+    layer — not prompt_len sequential decode steps). Returns (last-position
+    logits [b, vocab] float32, updated cache)."""
+    dt = jnp.dtype(cfg.dtype)
+    scale = cfg.head_dim ** -0.5
+    t = tokens.shape[1]
+    if t > cache["k"].shape[2]:
+        raise ValueError(
+            f"prompt length {t} exceeds cache max_len {cache['k'].shape[2]}"
+        )
+    x = params["embed"].astype(dt)[tokens]
+
+    def layer(x, inputs):
+        lp, ck, cv = inputs
+        cell = {}
+
+        def attn_fn(q, k, v):
+            cell["kv"] = (
+                lax.dynamic_update_slice(ck, k, (0, 0, 0, 0)),
+                lax.dynamic_update_slice(cv, v, (0, 0, 0, 0)),
+            )
+            return _plain_causal_attention(
+                q, *_expand_gqa(k, v, cfg.n_heads), scale
+            )
+
+        x = transformer_block(x, lp, cfg, attn_fn)
+        return x, cell["kv"]
+
+    x, (new_k, new_v) = lax.scan(layer, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, t - 1] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
 def generate(params, prompt_tokens, cfg: LlamaConfig, *, max_new_tokens: int,
              max_len: int | None = None):
-    """Greedy autoregressive generation: prefill the cache token-by-token
-    through the jitted decode_step (one compile serves the whole sequence —
-    `pos` is a traced scalar), then sample argmax continuations.
+    """Greedy autoregressive generation: one batched prefill pass over the
+    prompt, then jitted single-token decode steps with the cache donated
+    (updated in place) and the position carried as a traced scalar — one
+    compile each for prefill and decode serves any lengths.
     Returns [b, prompt + max_new_tokens] int32.
     """
     b, prompt_len = prompt_tokens.shape
-    max_len = max_len or (prompt_len + max_new_tokens)
+    needed = prompt_len + max_new_tokens
+    max_len = max_len or needed
+    if max_len < needed:
+        # dynamic_update_slice would silently clamp writes past the end of
+        # the cache — wrong generations with no error. Fail loudly instead.
+        raise ValueError(
+            f"max_len={max_len} < prompt+new={needed}: cache too small"
+        )
     cache = init_cache(cfg, b, max_len)
-    step = jax.jit(partial(decode_step, cfg=cfg))
+    step = jax.jit(partial(decode_step, cfg=cfg), donate_argnums=(2,))
 
+    logits, cache = jax.jit(partial(prefill, cfg=cfg), donate_argnums=(2,))(
+        params, prompt_tokens, cache
+    )
     tokens = prompt_tokens
-    logits = None
-    for i in range(prompt_len):
-        logits, cache = step(params, tokens[:, i : i + 1], cache, jnp.int32(i))
     for i in range(max_new_tokens):
         next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
         tokens = jnp.concatenate([tokens, next_token], axis=1)

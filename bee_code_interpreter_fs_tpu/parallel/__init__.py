@@ -25,7 +25,14 @@ from bee_code_interpreter_fs_tpu.parallel.collectives import (
     all_gather,
     all_reduce_mean,
     all_reduce_sum,
+    reduce_scatter_sum,
+    ring_all_reduce,
     ring_permute,
+)
+from bee_code_interpreter_fs_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_stages,
+    pipelined_transformer,
 )
 from bee_code_interpreter_fs_tpu.parallel.ring_attention import ring_attention
 
@@ -38,6 +45,11 @@ __all__ = [
     "all_gather",
     "all_reduce_mean",
     "all_reduce_sum",
+    "reduce_scatter_sum",
+    "ring_all_reduce",
     "ring_permute",
     "ring_attention",
+    "pipeline_apply",
+    "pipeline_stages",
+    "pipelined_transformer",
 ]

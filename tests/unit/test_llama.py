@@ -182,6 +182,47 @@ def test_incremental_decode_gqa_and_moe():
         )
 
 
+def test_prefill_matches_stepwise_decode():
+    """One batched prefill pass must leave the cache and last-position
+    logits exactly as prompt_len sequential decode steps would."""
+    from bee_code_interpreter_fs_tpu.models import decode_step, init_cache, prefill
+
+    cfg = LlamaConfig.tiny(dtype="float32", n_heads=4, n_kv_heads=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(13), (2, 10), 0, cfg.vocab_size)
+
+    stepwise_cache = init_cache(cfg, 2, max_len=12)
+    for t in range(10):
+        step_logits, stepwise_cache = decode_step(
+            params, tokens[:, t : t + 1], stepwise_cache, jnp.int32(t), cfg
+        )
+
+    batched_logits, batched_cache = prefill(
+        params, tokens, init_cache(cfg, 2, max_len=12), cfg
+    )
+    np.testing.assert_allclose(
+        np.asarray(batched_logits), np.asarray(step_logits), rtol=2e-4, atol=2e-4
+    )
+    for key in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(batched_cache[key])[:, :, :10],
+            np.asarray(stepwise_cache[key])[:, :, :10],
+            rtol=2e-4,
+            atol=2e-4,
+        )
+
+
+def test_generate_rejects_too_small_cache():
+    from bee_code_interpreter_fs_tpu.models import generate
+    import pytest
+
+    cfg = LlamaConfig.tiny(dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.zeros((1, 6), jnp.int32)
+    with pytest.raises(ValueError, match="cache too small"):
+        generate(params, prompt, cfg, max_new_tokens=4, max_len=8)
+
+
 def test_generate_greedy_is_self_consistent():
     """generate()'s greedy continuations must equal argmax of the full
     forward over the generated prefix (cache path == full path)."""
