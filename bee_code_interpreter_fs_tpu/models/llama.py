@@ -52,6 +52,11 @@ class LlamaConfig:
     # experts shard over the "ep" mesh axis (param_specs).
     n_experts: int = 0
     n_experts_per_token: int = 2
+    # Single-shard attention implementation: "plain" (XLA fused dense) or
+    # "flash" (the Pallas kernel, ops/flash_attention.py — O(t·d) HBM
+    # instead of O(t²), the long-context choice). Ring attention (mesh with
+    # sp > 1) takes precedence over either.
+    attn_impl: str = "plain"
 
     @property
     def head_dim(self) -> int:
@@ -276,6 +281,17 @@ def forward(params, tokens, cfg: LlamaConfig, *, mesh: Mesh | None = None):
     x = params["embed"].astype(dt)[tokens]  # [b, t, dim]
     if use_ring:
         attn_fn = lambda q, k, v: ring(q, *_expand_gqa(k, v, nh))  # noqa: E731
+    elif cfg.attn_impl == "flash":
+        from bee_code_interpreter_fs_tpu.ops.flash_attention import (
+            flash_attention,
+        )
+
+        # Pallas lowers via Mosaic on TPU; elsewhere (tests, CPU dev) the
+        # same kernel runs interpreted.
+        interpret = jax.default_backend() != "tpu"
+        attn_fn = lambda q, k, v: flash_attention(  # noqa: E731
+            q, *_expand_gqa(k, v, nh), scale=scale, interpret=interpret
+        )
     else:
         attn_fn = lambda q, k, v: _plain_causal_attention(  # noqa: E731
             q, *_expand_gqa(k, v, nh), scale

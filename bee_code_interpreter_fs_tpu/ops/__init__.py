@@ -1,1 +1,5 @@
 """TPU compute-path ops: the numpy dispatch shim and Pallas kernels."""
+
+from bee_code_interpreter_fs_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,128 @@
+"""Fused causal attention as a Pallas TPU kernel (flash attention).
+
+The hot op of the Llama family, written for the hardware: one kernel
+computes softmax(QKᵀ·scale)·V tile by tile with the online-softmax
+recurrence, so the [t, t] score matrix never materializes in HBM — scores
+live in VMEM one [block_q, block_k] tile at a time, the MXU sees back-to-back
+dot_generals, and HBM traffic drops from O(t²) to O(t·d). Causal blocks
+beyond the diagonal are skipped entirely (the fori_loop upper bound is the
+query block's diagonal), halving the work of the masked-dense formulation.
+
+Grid: (batch·heads, t/block_q); each program owns one query tile and loops
+over its key tiles with the running (max, denom, accumulator) carry. Scores
+accumulate in float32 regardless of input dtype (bf16 inputs hit the MXU as
+bf16, the softmax statistics stay exact enough — same recipe as
+parallel/ring_attention.py, which is this kernel's cross-CHIP counterpart:
+ring attention shards the sequence over the "sp" mesh axis while this
+fuses the per-shard compute).
+
+`interpret=True` runs the same kernel on CPU for tests/CI (no TPU needed);
+on TPU it compiles via Mosaic.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_q, block_k,
+                  seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0]  # [block_q, d]
+    d = q.shape[-1]
+
+    q_positions = qi * block_q + jax.lax.iota(jnp.int32, block_q)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k_tile = k_ref[0, pl.ds(j * block_k, block_k), :]  # [block_k, d]
+        v_tile = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_tile,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+        k_positions = j * block_k + jax.lax.iota(jnp.int32, block_k)
+        causal = q_positions[:, None] >= k_positions[None, :]
+        in_range = k_positions[None, :] < seq_len  # padding tail masked
+        s = jnp.where(causal & in_range, s, NEG_INF)
+
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + p.sum(axis=-1)
+        acc_new = acc * correction[:, None] + jax.lax.dot_general(
+            p.astype(v_tile.dtype), v_tile,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    # Only key tiles up to (and including) the query tile's diagonal exist
+    # under causality — skip the rest outright.
+    num_k_tiles = (qi * block_q + block_q + block_k - 1) // block_k
+    acc = jnp.zeros((block_q, d), jnp.float32)
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_k_tiles, body, (acc, m, l))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, *, scale: float | None = None, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Causal flash attention over [b, t, h, d] (kv heads must equal q
+    heads — expand GQA first, models.llama._expand_gqa). Returns [b, t, h,
+    d] in q's dtype. Sequence lengths that don't divide the block sizes are
+    padded internally and sliced back out.
+    """
+    b, t, h, d = q.shape
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
+    scale = d ** -0.5 if scale is None else scale
+    block_q = min(block_q, max(t, 1))
+    block_k = min(block_k, max(t, 1))
+
+    pad_q = (-t) % block_q
+    pad_k = (-t) % block_k
+    pad = max(pad_q, pad_k)
+    if pad:
+        widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+        q = jnp.pad(q, widths)
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
+    t_padded = t + pad
+
+    # [b, t, h, d] -> [b*h, t, d]: the kernel grid is (batch*heads, q tiles).
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t_padded, d)
+
+    qh, kh, vh = to_bh(q), to_bh(k), to_bh(v)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        seq_len=t,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t_padded // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, t_padded, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, t_padded, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t_padded, d), q.dtype),
+        interpret=interpret,
+    )(qh, kh, vh)
+
+    out = out.reshape(b, h, t_padded, d).transpose(0, 2, 1, 3)
+    return out[:, :t]
