@@ -13,6 +13,7 @@ from bee_code_interpreter_fs_tpu.models.llama import (
     decode_step,
     forward,
     generate,
+    greedy_generate,
     init_cache,
     init_params,
     loss_fn,
@@ -26,6 +27,7 @@ __all__ = [
     "decode_step",
     "forward",
     "generate",
+    "greedy_generate",
     "init_cache",
     "init_params",
     "loss_fn",

@@ -410,6 +410,37 @@ def prefill(params, tokens, cache, cfg: LlamaConfig):
     return logits, {"k": new_k, "v": new_v}
 
 
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "max_len"))
+def greedy_generate(params, prompt_tokens, cfg: LlamaConfig, *,
+                    max_new_tokens: int, max_len: int | None = None):
+    """Whole-generation greedy decode as ONE jitted program: batched prefill
+    then a lax.scan over decode steps, token selection included. One device
+    dispatch serves the entire generation — the per-step host round-trip
+    that dominates a Python decode loop (milliseconds per token on a
+    networked device) disappears. Returns [b, prompt + max_new_tokens].
+    `generate()` below is the step-by-step reference implementation."""
+    b, prompt_len = prompt_tokens.shape
+    needed = prompt_len + max_new_tokens
+    max_len = max_len or needed
+    if max_len < needed:
+        raise ValueError(
+            f"max_len={max_len} < prompt+new={needed}: cache too small"
+        )
+    cache = init_cache(cfg, b, max_len)
+    logits, cache = prefill(params, prompt_tokens, cache, cfg)
+
+    def body(carry, i):
+        logits, cache = carry
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+        logits, cache = decode_step(params, token, cache, prompt_len + i, cfg)
+        return (logits, cache), token[:, 0]
+
+    _, new_tokens = lax.scan(
+        body, (logits, cache), jnp.arange(max_new_tokens)
+    )
+    return jnp.concatenate([prompt_tokens, new_tokens.T], axis=1)
+
+
 def generate(params, prompt_tokens, cfg: LlamaConfig, *, max_new_tokens: int,
              max_len: int | None = None):
     """Greedy autoregressive generation: one batched prefill pass over the

@@ -71,6 +71,30 @@ print(f"final_loss={float(loss):.4f}")
 print(f"steps_per_s={STEPS/dt:.1f}")
 """
 
+LLAMA_DECODE = """
+import time
+import jax, jax.numpy as jnp
+from bee_code_interpreter_fs_tpu.models.llama import (
+    LlamaConfig, greedy_generate, init_params,
+)
+
+cfg = LlamaConfig.tiny(n_layers=4, dim=512, n_heads=8, n_kv_heads=8,
+                       hidden_dim=1376, vocab_size=32000, max_seq_len=512)
+B, PROMPT, NEW = 8, 64, 64
+params = init_params(jax.random.PRNGKey(0), cfg)
+prompt = jax.random.randint(jax.random.PRNGKey(1), (B, PROMPT), 0, cfg.vocab_size)
+# The whole generation (prefill + KV-cache decode scan + token selection)
+# is ONE jitted program -> one device dispatch, no per-token host trips.
+out = greedy_generate(params, prompt, cfg, max_new_tokens=NEW)
+_ = int(out[0, -1])  # compile + first run off the clock
+t0 = time.perf_counter()
+out = greedy_generate(params, prompt, cfg, max_new_tokens=NEW)
+_ = int(out[0, -1])  # sync
+dt = time.perf_counter() - t0
+print(f"platform={jax.devices()[0].platform}")
+print(f"decode_tokens_per_s={B * NEW / dt:.0f}")
+"""
+
 LLAMA_INFER = """
 import time
 import jax, jax.numpy as jnp
@@ -173,6 +197,11 @@ async def main() -> None:
             # -- config 5a: Llama-class inference throughput, 1 chip ----------
             out.append(
                 await run_config("5a:llama-infer-tpu-x1", LLAMA_INFER, executor=executor)
+            )
+
+            # -- config 5c: KV-cache incremental decode throughput ------------
+            out.append(
+                await run_config("5c:llama-decode-tpu-x1", LLAMA_DECODE, executor=executor)
             )
         finally:
             await executor.close()

@@ -223,6 +223,19 @@ def test_generate_rejects_too_small_cache():
         generate(params, prompt, cfg, max_new_tokens=4, max_len=8)
 
 
+def test_greedy_generate_matches_stepwise_generate():
+    """The fully-jitted scan decode loop must produce the same tokens as
+    the step-by-step reference generate()."""
+    from bee_code_interpreter_fs_tpu.models import generate, greedy_generate
+
+    cfg = LlamaConfig.tiny(dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(15), (2, 5), 0, cfg.vocab_size)
+    want = generate(params, prompt, cfg, max_new_tokens=5)
+    got = greedy_generate(params, prompt, cfg, max_new_tokens=5)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_generate_greedy_is_self_consistent():
     """generate()'s greedy continuations must equal argmax of the full
     forward over the generated prefix (cache path == full path)."""
