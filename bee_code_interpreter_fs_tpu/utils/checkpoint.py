@@ -1,0 +1,49 @@
+"""Model checkpoint save/restore (orbax-backed).
+
+The reference's only "checkpointing" is the session files map (workspace
+snapshots round-tripped through Storage — SURVEY.md §5); that remains the
+Execute-API story. This module covers the other half a compute framework
+needs: durable parameter/optimizer pytrees for the model payloads in
+models/ — async-friendly orbax checkpoints that restore with the SAME
+shardings they were saved under (restore takes an abstract pytree built
+from the live mesh, so a checkpoint saved on one topology reloads onto
+another without host-side gathering).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def save_checkpoint(path: str | Path, tree, *, force: bool = True) -> None:
+    """Write a pytree checkpoint (params / opt state / anything jax-array)."""
+    path = Path(path).resolve()
+    with ocp.PyTreeCheckpointer() as checkpointer:
+        checkpointer.save(path, tree, force=force)
+
+
+def restore_checkpoint(path: str | Path, like=None):
+    """Restore a pytree checkpoint.
+
+    `like` (optional) is a pytree of arrays OR jax.ShapeDtypeStruct with
+    shardings attached: restoration places every leaf directly onto its
+    target devices — the multi-host/multi-chip path where no single host
+    could materialize the full tree.
+    """
+    path = Path(path).resolve()
+    with ocp.PyTreeCheckpointer() as checkpointer:
+        if like is None:
+            return checkpointer.restore(path)
+        abstract = jax.tree.map(
+            lambda leaf: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=getattr(leaf, "sharding", None)
+            ),
+            like,
+        )
+        restore_args = ocp.checkpoint_utils.construct_restore_args(abstract)
+        return checkpointer.restore(
+            path, item=abstract, restore_args=restore_args
+        )
