@@ -20,6 +20,7 @@ from bee_code_interpreter_fs_tpu.models.llama import (
     make_train_step,
     param_specs,
     prefill,
+    sample_generate,
 )
 
 __all__ = [
@@ -34,4 +35,5 @@ __all__ = [
     "make_train_step",
     "param_specs",
     "prefill",
+    "sample_generate",
 ]

@@ -34,6 +34,8 @@ from jax.experimental.shard_map import shard_map
 
 from bee_code_interpreter_fs_tpu.parallel.ring_attention import ring_attention
 
+NEG_INF_LOGIT = -1e30  # finite mask value for truncated-sampling logits
+
 
 @dataclass(frozen=True)
 class LlamaConfig:
@@ -437,6 +439,47 @@ def greedy_generate(params, prompt_tokens, cfg: LlamaConfig, *,
 
     _, new_tokens = lax.scan(
         body, (logits, cache), jnp.arange(max_new_tokens)
+    )
+    return jnp.concatenate([prompt_tokens, new_tokens.T], axis=1)
+
+
+@partial(
+    jax.jit, static_argnames=("cfg", "max_new_tokens", "top_k", "max_len")
+)
+def sample_generate(params, prompt_tokens, key, cfg: LlamaConfig, *,
+                    max_new_tokens: int, temperature=1.0, top_k: int = 0,
+                    max_len: int | None = None):
+    """Stochastic generation, fully jitted like greedy_generate: temperature
+    scaling plus optional top-k truncation, sampled with jax.random
+    (counter-based PRNG — same key, same output, any device). `temperature`
+    is a traced scalar (no recompile per setting); `top_k` 0 disables
+    truncation. Returns [b, prompt + max_new_tokens]."""
+    b, prompt_len = prompt_tokens.shape
+    needed = prompt_len + max_new_tokens
+    max_len = max_len or needed
+    if max_len < needed:
+        raise ValueError(
+            f"max_len={max_len} < prompt+new={needed}: cache too small"
+        )
+    cache = init_cache(cfg, b, max_len)
+    logits, cache = prefill(params, prompt_tokens, cache, cfg)
+
+    def pick(step_key, logits):
+        scaled = logits / jnp.maximum(temperature, 1e-6)
+        if top_k > 0:
+            kth = lax.top_k(scaled, top_k)[0][..., -1:]
+            scaled = jnp.where(scaled < kth, NEG_INF_LOGIT, scaled)
+        return jax.random.categorical(step_key, scaled).astype(jnp.int32)
+
+    def body(carry, step_key):
+        logits, cache, pos = carry
+        token = pick(step_key, logits)[:, None]
+        logits, cache = decode_step(params, token, cache, pos, cfg)
+        return (logits, cache, pos + 1), token[:, 0]
+
+    step_keys = jax.random.split(key, max_new_tokens)
+    _, new_tokens = lax.scan(
+        body, (logits, cache, jnp.int32(prompt_len)), step_keys
     )
     return jnp.concatenate([prompt_tokens, new_tokens.T], axis=1)
 

@@ -1,0 +1,35 @@
+"""Llama-family autoregressive generation inside the sandbox (BASELINE
+config 5 flavor): prefill + KV-cache decode + token selection fused into one
+jitted program (models.llama.greedy_generate), running on whatever
+accelerator the sandbox exposes. Submitted through Execute like any user
+payload — demonstrates that serving-style inference code needs nothing
+special from the framework."""
+
+import time
+
+import jax
+
+from bee_code_interpreter_fs_tpu.models import (
+    LlamaConfig,
+    greedy_generate,
+    init_params,
+)
+
+cfg = LlamaConfig.tiny(
+    n_layers=4, dim=512, n_heads=8, n_kv_heads=8, hidden_dim=1376,
+    vocab_size=32000, max_seq_len=512,
+)
+B, PROMPT, NEW = 4, 32, 32
+params = init_params(jax.random.PRNGKey(0), cfg)
+prompt = jax.random.randint(jax.random.PRNGKey(1), (B, PROMPT), 0, cfg.vocab_size)
+
+out = greedy_generate(params, prompt, cfg, max_new_tokens=NEW)
+_ = int(out[0, -1])  # compile + run off the clock
+t0 = time.perf_counter()
+out = greedy_generate(params, prompt, cfg, max_new_tokens=NEW)
+_ = int(out[0, -1])
+dt = time.perf_counter() - t0
+
+print(f"platform={jax.devices()[0].platform}")
+print(f"generated shape={tuple(out.shape)}")
+print(f"tokens_per_s={B * NEW / dt:.0f}")

@@ -236,6 +236,43 @@ def test_greedy_generate_matches_stepwise_generate():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_sample_generate_topk1_equals_greedy():
+    """top_k=1 collapses sampling to argmax — must match greedy_generate
+    for any key."""
+    from bee_code_interpreter_fs_tpu.models import greedy_generate, sample_generate
+
+    cfg = LlamaConfig.tiny(dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(16), (2, 5), 0, cfg.vocab_size)
+    greedy = greedy_generate(params, prompt, cfg, max_new_tokens=5)
+    sampled = sample_generate(
+        params, prompt, jax.random.PRNGKey(99), cfg, max_new_tokens=5, top_k=1
+    )
+    np.testing.assert_array_equal(np.asarray(sampled), np.asarray(greedy))
+
+
+def test_sample_generate_is_seeded_and_varied():
+    from bee_code_interpreter_fs_tpu.models import sample_generate
+
+    cfg = LlamaConfig.tiny(dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(17), (1, 4), 0, cfg.vocab_size)
+    a = sample_generate(
+        params, prompt, jax.random.PRNGKey(1), cfg, max_new_tokens=8,
+        temperature=5.0,
+    )
+    b = sample_generate(
+        params, prompt, jax.random.PRNGKey(1), cfg, max_new_tokens=8,
+        temperature=5.0,
+    )
+    c = sample_generate(
+        params, prompt, jax.random.PRNGKey(2), cfg, max_new_tokens=8,
+        temperature=5.0,
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # same key
+    assert not np.array_equal(np.asarray(a), np.asarray(c))  # different key
+
+
 def test_generate_greedy_is_self_consistent():
     """generate()'s greedy continuations must equal argmax of the full
     forward over the generated prefix (cache path == full path)."""
