@@ -272,8 +272,16 @@ def forward(params, tokens, cfg: LlamaConfig, *, mesh: Mesh | None = None):
     scale = hd ** -0.5
     use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
     if use_ring:
+        # attn_impl="flash" composes: the Pallas partial kernel computes each
+        # ring step's local contribution (no per-chunk-pair score tensor).
         ring = shard_map(
-            partial(ring_attention, axis_name="sp", scale=scale),
+            partial(
+                ring_attention,
+                axis_name="sp",
+                scale=scale,
+                use_flash=cfg.attn_impl == "flash",
+                flash_interpret=jax.default_backend() != "tpu",
+            ),
             mesh=mesh,
             in_specs=(P("dp", "sp", "tp", None),) * 3,
             out_specs=P("dp", "sp", "tp", None),

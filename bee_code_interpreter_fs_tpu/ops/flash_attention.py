@@ -74,6 +74,132 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_q, block_k,
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
 
 
+def _flash_partial_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
+                          acc_in_ref, m_in_ref, l_in_ref,
+                          acc_ref, m_ref, l_ref, *, scale, block_q, block_k,
+                          chunk_len, causal):
+    """One ring step's contribution: fold a K/V chunk into the running
+    (acc, m, l) online-softmax carry for this query tile. Positions are
+    GLOBAL (offsets arrive via scalar refs — they are traced axis indices
+    at the call site), so causal masking works across sequence shards."""
+    qi = pl.program_id(1)
+    q = q_ref[0]
+    q_positions = qoff_ref[0] + qi * block_q + jax.lax.iota(jnp.int32, block_q)
+
+    acc = acc_in_ref[0].astype(jnp.float32)
+    # m/l ride as [bh, tq, 1]: Mosaic requires the last two block dims to be
+    # (divisible by 8, divisible by 128) or equal to the array dims — a
+    # trailing singleton satisfies "equal" where a 2D [bh, tq] layout can't.
+    m = m_in_ref[0, :, 0].astype(jnp.float32)
+    l = l_in_ref[0, :, 0].astype(jnp.float32)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k_tile = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_tile = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_tile,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            k_positions = koff_ref[0] + j * block_k + jax.lax.iota(
+                jnp.int32, block_k
+            )
+            s = jnp.where(
+                q_positions[:, None] >= k_positions[None, :], s, NEG_INF
+            )
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + p.sum(axis=-1)
+        acc_new = acc * correction[:, None] + jax.lax.dot_general(
+            p.astype(v_tile.dtype), v_tile,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    acc, m, l = jax.lax.fori_loop(0, chunk_len // block_k, body, (acc, m, l))
+    acc_ref[0] = acc
+    m_ref[0] = m[:, None]
+    l_ref[0] = l[:, None]
+
+
+def flash_attention_partial(q, k, v, acc, m, l, *, q_offset, k_offset,
+                            scale: float | None = None, causal: bool = True,
+                            block_q: int = 128, block_k: int = 128,
+                            interpret: bool = False):
+    """Fold one K/V chunk into a running online-softmax carry — the
+    per-ring-step building block that lets ring attention (sequence sharded
+    over "sp") use the fused kernel for its local compute instead of
+    materializing per-chunk [tq, tk] scores.
+
+    q: [b, tq, h, d]; k/v: [b, tk, h, d]; acc: [b, h, tq, d] float32;
+    m/l: [b, h, tq] float32. q_offset/k_offset are GLOBAL sequence offsets
+    of the chunks (traced values are fine). Returns updated (acc, m, l);
+    finalize with out = acc / l[..., None].
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = d ** -0.5 if scale is None else scale
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        raise ValueError(
+            f"chunk lengths ({tq}, {tk}) must divide blocks ({block_q}, {block_k})"
+        )
+
+    qh = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    acc_h = acc.reshape(b * h, tq, d)
+    m_h = m.reshape(b * h, tq, 1)
+    l_h = l.reshape(b * h, tq, 1)
+    q_off = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    k_off = jnp.asarray(k_offset, jnp.int32).reshape(1)
+
+    kernel = functools.partial(
+        _flash_partial_kernel,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        chunk_len=tk,
+        causal=causal,
+    )
+    grid = (b * h, tq // block_q)
+    acc_h, m_h, l_h = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1,), lambda bh, qi: (0,)),
+            pl.BlockSpec((1,), lambda bh, qi: (0,)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, tk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q_off, k_off, qh, kh, vh, acc_h, m_h, l_h)
+    return (
+        acc_h.reshape(b, h, tq, d),
+        m_h.reshape(b, h, tq),
+        l_h.reshape(b, h, tq),
+    )
+
+
 def flash_attention(q, k, v, *, scale: float | None = None, block_q: int = 128,
                     block_k: int = 128, interpret: bool = False):
     """Causal flash attention over [b, t, h, d] (kv heads must equal q

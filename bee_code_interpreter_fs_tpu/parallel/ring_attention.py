@@ -23,7 +23,9 @@ from jax import lax
 _NEG = -1e30  # finite mask value: keeps online-softmax max finite everywhere
 
 
-def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, scale=None):
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, scale=None,
+                   use_flash: bool = False, flash_interpret: bool = False,
+                   flash_block: int = 128):
     """Exact attention where q, k, v are per-device sequence chunks.
 
     Args:
@@ -32,6 +34,12 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, scale=None):
       causal: apply a global causal mask (positions are global, computed from
         the device's ring index).
       scale: softmax scale; defaults to head_dim**-0.5.
+      use_flash: compute each ring step's local contribution with the Pallas
+        partial flash kernel (ops/flash_attention.py) instead of the einsum
+        path — the per-chunk-pair [tq, tk] score tensor never materializes,
+        which is what makes very long per-device chunks viable. Same online-
+        softmax carry either way. `flash_interpret` runs the kernel
+        interpreted (CPU tests); `flash_block` is its tile size.
 
     Returns local output chunk [batch, chunk_len, heads, head_dim].
     """
@@ -48,29 +56,46 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, scale=None):
         kc, vc, acc, m, l = carry
         # K/V chunk currently held was originated by device (my - i) mod n.
         src = (my - i) % n
-        k_pos = src * t + jnp.arange(t)
 
-        # [b, h, tq, tk]; statistics in float32 regardless of input dtype
-        # (bf16 maxes/exps drift over the ring steps otherwise). The MXU
-        # takes bf16 inputs with f32 accumulation via preferred_element_type,
-        # so this costs no extra HBM copies or f32 matmuls.
-        s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, kc, preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
-            mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask[None, None, :, :], s, _NEG)
+        if use_flash:
+            from bee_code_interpreter_fs_tpu.ops.flash_attention import (
+                flash_attention_partial,
+            )
 
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[..., None])
-        acc = acc * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd",
-            p.astype(v.dtype),
-            vc,
-            preferred_element_type=jnp.float32,
-        )
-        l = l * corr + p.sum(axis=-1)
+            acc, m_new, l = flash_attention_partial(
+                q, kc, vc, acc, m, l,
+                q_offset=my * t,
+                k_offset=src * t,
+                scale=scale,
+                causal=causal,
+                block_q=min(flash_block, t),
+                block_k=min(flash_block, t),
+                interpret=flash_interpret,
+            )
+        else:
+            k_pos = src * t + jnp.arange(t)
+            # [b, h, tq, tk]; statistics in float32 regardless of input
+            # dtype (bf16 maxes/exps drift over the ring steps otherwise).
+            # The MXU takes bf16 inputs with f32 accumulation via
+            # preferred_element_type, so this costs no extra HBM copies or
+            # f32 matmuls.
+            s = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, kc, preferred_element_type=jnp.float32
+            ) * scale
+            if causal:
+                mask = q_pos[:, None] >= k_pos[None, :]
+                s = jnp.where(mask[None, None, :, :], s, _NEG)
+
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            corr = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[..., None])
+            acc = acc * corr[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd",
+                p.astype(v.dtype),
+                vc,
+                preferred_element_type=jnp.float32,
+            )
+            l = l * corr + p.sum(axis=-1)
 
         # Rotate K/V to the next device; shift every step including the last
         # so chunks end where they started (keeps the loop-carried shape story

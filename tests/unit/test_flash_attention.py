@@ -65,6 +65,91 @@ def test_forward_with_flash_impl_matches_plain():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_partial_kernel_single_chunk_equals_full():
+    """Folding one chunk from a zero carry must equal full flash/dense
+    attention (the ring step's base case)."""
+    from bee_code_interpreter_fs_tpu.ops.flash_attention import (
+        flash_attention_partial,
+    )
+
+    b, t, h, d = 1, 64, 2, 16
+    key = jax.random.PRNGKey(3)
+    q, k, v = (
+        jax.random.normal(kk, (b, t, h, d), jnp.float32)
+        for kk in jax.random.split(key, 3)
+    )
+    acc = jnp.zeros((b, h, t, d), jnp.float32)
+    m = jnp.full((b, h, t), -1e30, jnp.float32)
+    l = jnp.zeros((b, h, t), jnp.float32)
+    acc, m, l = flash_attention_partial(
+        q, k, v, acc, m, l, q_offset=0, k_offset=0, block_q=16, block_k=16,
+        interpret=True,
+    )
+    got = (acc / l[..., None]).transpose(0, 2, 1, 3)
+    want = _plain_causal_attention(q, k, v, d ** -0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_with_flash_kernel():
+    """ring_attention(use_flash=True) on the sp mesh — the Pallas kernel
+    inside the ring schedule — must match plain causal attention, including
+    the fully-masked future chunks the ring streams past each device."""
+    from functools import partial as fpartial
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from bee_code_interpreter_fs_tpu.parallel import (
+        best_mesh_shape,
+        make_mesh,
+        ring_attention,
+    )
+
+    mesh = make_mesh(best_mesh_shape(8, tp=2, sp=2))
+    b, t, h, d = 2, 64, 4, 16
+    key = jax.random.PRNGKey(4)
+    q, k, v = (
+        jax.random.normal(kk, (b, t, h, d), jnp.float32)
+        for kk in jax.random.split(key, 3)
+    )
+    want = _plain_causal_attention(q, k, v, d ** -0.5)
+    got = shard_map(
+        fpartial(
+            ring_attention, axis_name="sp", use_flash=True,
+            flash_interpret=True, flash_block=16,
+        ),
+        mesh=mesh,
+        in_specs=(P("dp", "sp", "tp", None),) * 3,
+        out_specs=P("dp", "sp", "tp", None),
+        check_rep=False,
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_forward_ring_flash_composition():
+    """Full model: sp mesh + attn_impl='flash' routes attention through the
+    ring schedule with the Pallas partial kernel inside."""
+    from bee_code_interpreter_fs_tpu.parallel import (
+        best_mesh_shape,
+        make_mesh,
+        shard_pytree,
+    )
+    from bee_code_interpreter_fs_tpu.models import param_specs
+
+    cfg = LlamaConfig.tiny(dtype="float32", attn_impl="flash")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(18), (2, 32), 0, cfg.vocab_size)
+    want = forward(params, tokens, LlamaConfig.tiny(dtype="float32"))
+
+    mesh = make_mesh(best_mesh_shape(8, tp=2, sp=2))
+    sharded = shard_pytree(mesh, params, param_specs(cfg))
+    got = jax.jit(lambda p, t: forward(p, t, cfg, mesh=mesh))(sharded, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=5e-3, atol=5e-3)
+
+
 def test_shape_mismatch_rejected():
     q = jnp.zeros((1, 8, 2, 4))
     k = jnp.zeros((1, 8, 1, 4))
