@@ -31,6 +31,28 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
+def _tile_update(q, k_tile, v_tile, acc, m, l, *, scale, mask):
+    """One online-softmax tile fold — the numerically delicate recurrence,
+    shared by the full kernel and the ring-step partial kernel so the two
+    can never drift apart. `mask` is the [block_q, block_k] validity."""
+    s = jax.lax.dot_general(
+        q, k_tile,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [block_q, block_k]
+    s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[:, None])
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + p.sum(axis=-1)
+    acc_new = acc * correction[:, None] + jax.lax.dot_general(
+        p.astype(v_tile.dtype), v_tile,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return acc_new, m_new, l_new
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_q, block_k,
                   seq_len):
     qi = pl.program_id(1)
@@ -40,29 +62,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_q, block_k,
     q_positions = qi * block_q + jax.lax.iota(jnp.int32, block_q)
 
     def body(j, carry):
-        acc, m, l = carry
         k_tile = k_ref[0, pl.ds(j * block_k, block_k), :]  # [block_k, d]
         v_tile = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k_tile,
-            (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [block_q, block_k]
         k_positions = j * block_k + jax.lax.iota(jnp.int32, block_k)
-        causal = q_positions[:, None] >= k_positions[None, :]
-        in_range = k_positions[None, :] < seq_len  # padding tail masked
-        s = jnp.where(causal & in_range, s, NEG_INF)
-
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        correction = jnp.exp(m - m_new)
-        l_new = l * correction + p.sum(axis=-1)
-        acc_new = acc * correction[:, None] + jax.lax.dot_general(
-            p.astype(v_tile.dtype), v_tile,
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        mask = (q_positions[:, None] >= k_positions[None, :]) & (
+            k_positions[None, :] < seq_len  # padding tail masked
         )
-        return acc_new, m_new, l_new
+        return _tile_update(q, k_tile, v_tile, *carry, scale=scale, mask=mask)
 
     # Only key tiles up to (and including) the query tile's diagonal exist
     # under causality — skip the rest outright.
@@ -74,14 +80,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_q, block_k,
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
 
 
-def _flash_partial_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
+def _flash_partial_kernel(qoff_ref, koff_ref, klen_ref, q_ref, k_ref, v_ref,
                           acc_in_ref, m_in_ref, l_in_ref,
                           acc_ref, m_ref, l_ref, *, scale, block_q, block_k,
                           chunk_len, causal):
     """One ring step's contribution: fold a K/V chunk into the running
     (acc, m, l) online-softmax carry for this query tile. Positions are
     GLOBAL (offsets arrive via scalar refs — they are traced axis indices
-    at the call site), so causal masking works across sequence shards."""
+    at the call site), so causal masking works across sequence shards;
+    klen masks the chunk's padding tail."""
     qi = pl.program_id(1)
     q = q_ref[0]
     q_positions = qoff_ref[0] + qi * block_q + jax.lax.iota(jnp.int32, block_q)
@@ -94,33 +101,28 @@ def _flash_partial_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
     l = l_in_ref[0, :, 0].astype(jnp.float32)
 
     def body(j, carry):
-        acc, m, l = carry
         k_tile = k_ref[0, pl.ds(j * block_k, block_k), :]
         v_tile = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k_tile,
-            (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        if causal:
-            k_positions = koff_ref[0] + j * block_k + jax.lax.iota(
-                jnp.int32, block_k
-            )
-            s = jnp.where(
-                q_positions[:, None] >= k_positions[None, :], s, NEG_INF
-            )
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        correction = jnp.exp(m - m_new)
-        l_new = l * correction + p.sum(axis=-1)
-        acc_new = acc * correction[:, None] + jax.lax.dot_general(
-            p.astype(v_tile.dtype), v_tile,
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        k_positions = koff_ref[0] + j * block_k + jax.lax.iota(
+            jnp.int32, block_k
         )
-        return acc_new, m_new, l_new
+        mask = k_positions[None, :] < koff_ref[0] + klen_ref[0]
+        if causal:
+            mask &= q_positions[:, None] >= k_positions[None, :]
+        else:
+            mask = jnp.broadcast_to(mask, (block_q, block_k))
+        return _tile_update(q, k_tile, v_tile, *carry, scale=scale, mask=mask)
 
-    acc, m, l = jax.lax.fori_loop(0, chunk_len // block_k, body, (acc, m, l))
+    num_k_tiles = chunk_len // block_k
+    if causal:
+        # Key tiles entirely past this query tile's last position contribute
+        # nothing — bound the loop at the (traced) causal frontier. A chunk
+        # fully in the future folds zero tiles.
+        last_q = qoff_ref[0] + qi * block_q + block_q - 1
+        num_k_tiles = jnp.clip(
+            (last_q - koff_ref[0]) // block_k + 1, 0, num_k_tiles
+        )
+    acc, m, l = jax.lax.fori_loop(0, num_k_tiles, body, (acc, m, l))
     acc_ref[0] = acc
     m_ref[0] = m[:, None]
     l_ref[0] = l[:, None]
@@ -137,46 +139,62 @@ def flash_attention_partial(q, k, v, acc, m, l, *, q_offset, k_offset,
 
     q: [b, tq, h, d]; k/v: [b, tk, h, d]; acc: [b, h, tq, d] float32;
     m/l: [b, h, tq] float32. q_offset/k_offset are GLOBAL sequence offsets
-    of the chunks (traced values are fine). Returns updated (acc, m, l);
-    finalize with out = acc / l[..., None].
+    of the chunks (traced values are fine). Chunk lengths that don't divide
+    the blocks are padded internally (padded keys masked, padded query rows
+    sliced off). Returns updated (acc, m, l); finalize with
+    out = acc / l[..., None].
+
+    VMEM note: the K/V chunk resides fully in VMEM per program, so the
+    practical per-device chunk bound is ~8k positions at d=128 float32
+    (~16k bf16); beyond that, shard the sequence further (larger sp) or
+    tile K/V through the grid.
     """
     b, tq, h, d = q.shape
     tk = k.shape[1]
     scale = d ** -0.5 if scale is None else scale
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
-    if tq % block_q or tk % block_k:
-        raise ValueError(
-            f"chunk lengths ({tq}, {tk}) must divide blocks ({block_q}, {block_k})"
-        )
+    pad_q = (-tq) % block_q
+    pad_k = (-tk) % block_k
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        acc = jnp.pad(acc, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+        m = jnp.pad(m, ((0, 0), (0, 0), (0, pad_q)), constant_values=NEG_INF)
+        l = jnp.pad(l, ((0, 0), (0, 0), (0, pad_q)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+    tq_p, tk_p = tq + pad_q, tk + pad_k
 
-    qh = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
-    kh = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
-    vh = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
-    acc_h = acc.reshape(b * h, tq, d)
-    m_h = m.reshape(b * h, tq, 1)
-    l_h = l.reshape(b * h, tq, 1)
+    qh = q.transpose(0, 2, 1, 3).reshape(b * h, tq_p, d)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * h, tk_p, d)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * h, tk_p, d)
+    acc_h = acc.reshape(b * h, tq_p, d)
+    m_h = m.reshape(b * h, tq_p, 1)
+    l_h = l.reshape(b * h, tq_p, 1)
     q_off = jnp.asarray(q_offset, jnp.int32).reshape(1)
     k_off = jnp.asarray(k_offset, jnp.int32).reshape(1)
+    k_len = jnp.asarray(tk, jnp.int32).reshape(1)
 
     kernel = functools.partial(
         _flash_partial_kernel,
         scale=scale,
         block_q=block_q,
         block_k=block_k,
-        chunk_len=tk,
+        chunk_len=tk_p,
         causal=causal,
     )
-    grid = (b * h, tq // block_q)
+    grid = (b * h, tq_p // block_q)
     acc_h, m_h, l_h = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1,), lambda bh, qi: (0,)),
             pl.BlockSpec((1,), lambda bh, qi: (0,)),
+            pl.BlockSpec((1,), lambda bh, qi: (0,)),
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, tk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, tk_p, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, tk_p, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
@@ -187,17 +205,21 @@ def flash_attention_partial(q, k, v, acc, m, l, *, q_offset, k_offset,
             pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, tq, d), jnp.float32),
-            jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32),
-            jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, tq_p, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, tq_p, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, tq_p, 1), jnp.float32),
         ],
+        # The carry updates in place: without aliasing every ring step would
+        # copy the full acc/m/l through fresh HBM buffers.
+        input_output_aliases={6: 0, 7: 1, 8: 2},
         interpret=interpret,
-    )(q_off, k_off, qh, kh, vh, acc_h, m_h, l_h)
-    return (
-        acc_h.reshape(b, h, tq, d),
-        m_h.reshape(b, h, tq),
-        l_h.reshape(b, h, tq),
-    )
+    )(q_off, k_off, k_len, qh, kh, vh, acc_h, m_h, l_h)
+    acc = acc_h.reshape(b, h, tq_p, d)
+    m = m_h.reshape(b, h, tq_p)
+    l = l_h.reshape(b, h, tq_p)
+    if pad_q:
+        acc, m, l = acc[:, :, :tq], m[:, :, :tq], l[:, :, :tq]
+    return acc, m, l
 
 
 def flash_attention(q, k, v, *, scale: float | None = None, block_q: int = 128,

@@ -20,6 +20,10 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from bee_code_interpreter_fs_tpu.ops.flash_attention import (
+    flash_attention_partial,
+)
+
 _NEG = -1e30  # finite mask value: keeps online-softmax max finite everywhere
 
 
@@ -58,20 +62,27 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, scale=None,
         src = (my - i) % n
 
         if use_flash:
-            from bee_code_interpreter_fs_tpu.ops.flash_attention import (
-                flash_attention_partial,
-            )
+            def fold(args):
+                acc, m, l = args
+                return flash_attention_partial(
+                    q, kc, vc, acc, m, l,
+                    q_offset=my * t,
+                    k_offset=src * t,
+                    scale=scale,
+                    causal=causal,
+                    block_q=flash_block,
+                    block_k=flash_block,
+                    interpret=flash_interpret,
+                )
 
-            acc, m_new, l = flash_attention_partial(
-                q, kc, vc, acc, m, l,
-                q_offset=my * t,
-                k_offset=src * t,
-                scale=scale,
-                causal=causal,
-                block_q=min(flash_block, t),
-                block_k=min(flash_block, t),
-                interpret=flash_interpret,
-            )
+            if causal:
+                # A chunk entirely in this device's future contributes
+                # nothing — skip the kernel launch, not just its tiles.
+                acc, m_new, l = lax.cond(
+                    src <= my, fold, lambda args: args, (acc, m, l)
+                )
+            else:
+                acc, m_new, l = fold((acc, m, l))
         else:
             k_pos = src * t + jnp.arange(t)
             # [b, h, tq, tk]; statistics in float32 regardless of input

@@ -128,6 +128,42 @@ def test_ring_attention_with_flash_kernel():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_ring_flash_non_divisible_chunks():
+    """Per-device chunks that don't divide the kernel blocks must pad
+    internally (a config the einsum ring path always handled)."""
+    from functools import partial as fpartial
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from bee_code_interpreter_fs_tpu.parallel import (
+        best_mesh_shape,
+        make_mesh,
+        ring_attention,
+    )
+
+    mesh = make_mesh(best_mesh_shape(8, tp=2, sp=2))
+    b, t, h, d = 2, 48, 4, 16  # per-device chunk 24, blocks 16 -> padding
+    key = jax.random.PRNGKey(5)
+    q, k, v = (
+        jax.random.normal(kk, (b, t, h, d), jnp.float32)
+        for kk in jax.random.split(key, 3)
+    )
+    want = _plain_causal_attention(q, k, v, d ** -0.5)
+    got = shard_map(
+        fpartial(
+            ring_attention, axis_name="sp", use_flash=True,
+            flash_interpret=True, flash_block=16,
+        ),
+        mesh=mesh,
+        in_specs=(P("dp", "sp", "tp", None),) * 3,
+        out_specs=P("dp", "sp", "tp", None),
+        check_rep=False,
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_forward_ring_flash_composition():
     """Full model: sp mesh + attn_impl='flash' routes attention through the
     ring schedule with the Pallas partial kernel inside."""
