@@ -49,7 +49,7 @@ def pipeline_apply(stage_fn, stage_params, microbatches, *, axis_name: str):
     out = jnp.zeros_like(microbatches)
     state = jnp.zeros_like(microbatches[0])
 
-    def tick(t, carry):
+    def tick(carry, t):
         state, out = carry
         # Stage 0 injects microbatch t (clamped: late ticks re-inject the
         # last microbatch; its results never land in `out`, see below).
@@ -68,9 +68,15 @@ def pipeline_apply(stage_fn, stage_params, microbatches, *, axis_name: str):
         # Hand to the next stage; the ring edge S-1 -> 0 is harmless (stage
         # 0 overwrites with its injection).
         state = lax.ppermute(state, axis_name, perm)
-        return state, out
+        return (state, out), None
 
-    _, out = lax.fori_loop(0, n_micro + n_stages - 1, tick, (state, out))
+    # scan (not fori_loop): the tick count is static, and scan is reverse-
+    # differentiable — jax.grad flows through the whole schedule, so the
+    # pipeline trains, not just infers (the backward pass is the mirrored
+    # pipeline: ppermute's transpose is the reverse-direction ring).
+    (_, out), _ = lax.scan(
+        tick, (state, out), jnp.arange(n_micro + n_stages - 1)
+    )
     return out
 
 
