@@ -108,6 +108,10 @@ class Config(BaseModel):
     # whose runner died or timed out are never recycled. Disable to restore
     # strict one-process-per-Execute disposal (the reference's model).
     executor_reuse_sandboxes: bool = True
+    # Every N seconds, probe pooled sandboxes' /healthz and dispose the
+    # unresponsive ones (a silently-dead pooled process would otherwise cost
+    # the next request a failed attempt first). 0 disables the sweeper.
+    pool_health_sweep_interval: float = 30.0
     # Default accelerator request for kubernetes backend pods, merged into the
     # container resources (e.g. {"google.com/tpu": "4"}). Empty → CPU pods.
     tpu_resource_requests: dict = Field(default_factory=dict)

@@ -589,6 +589,57 @@ class CodeExecutor:
 
     # ----------------------------------------------------------------- admin
 
+    async def sweep_pool_health(self) -> int:
+        """Probe every pooled sandbox's /healthz and dispose the
+        unresponsive ones (refilling their lanes). Proactive failure
+        detection: a pooled sandbox whose process died silently (OOM kill,
+        node trouble) would otherwise cost the next request a failed
+        attempt before the retry path replaced it. Returns disposed count."""
+        client = self._http_client()
+        removed = 0
+        for lane, pool in list(self._pools.items()):
+            for sandbox in list(pool):
+                healthy = True
+                for url in sandbox.host_urls:
+                    try:
+                        resp = await client.get(f"{url}/healthz", timeout=3.0)
+                        if resp.status_code != 200:
+                            healthy = False
+                    except Exception:  # noqa: BLE001 — unreachable = dead
+                        healthy = False
+                if healthy:
+                    continue
+                try:
+                    pool.remove(sandbox)
+                except ValueError:
+                    continue  # popped by a request while we probed
+                logger.warning(
+                    "pooled sandbox %s failed its health probe; disposing",
+                    sandbox.id,
+                )
+                removed += 1
+                await self._dispose(sandbox)
+                self.fill_pool_soon(lane)
+        return removed
+
+    def start_health_sweeper(self, interval: float) -> asyncio.Task | None:
+        """Run sweep_pool_health every `interval` seconds until close()."""
+        if interval <= 0:
+            return None
+
+        async def sweeper() -> None:
+            while not self._closed:
+                await asyncio.sleep(interval)
+                try:
+                    await self.sweep_pool_health()
+                except Exception:  # noqa: BLE001 — keep sweeping
+                    logger.exception("pool health sweep failed")
+
+        task = asyncio.get_running_loop().create_task(sweeper())
+        self._fill_tasks.add(task)  # cancelled/awaited by close()
+        task.add_done_callback(self._fill_tasks.discard)
+        return task
+
     async def close(self) -> None:
         self._closed = True
         # Cancel in-flight pool refills — a spawn can take tens of seconds

@@ -94,6 +94,31 @@ async def test_timeout_poisons_sandbox_but_service_recovers(stack):
     assert result.stdout == "recovered\n"
 
 
+async def test_health_sweep_replaces_dead_pooled_sandbox(stack):
+    """A pooled sandbox whose process dies silently is detected by the
+    health sweep, disposed, and its lane refilled — the next request never
+    sees it."""
+    import os
+    import signal
+
+    executor, backend = stack
+    await executor.fill_pool()
+    (host_id, (proc, _)), = backend._procs.items()
+    # Kill the sandbox's process group behind the backend's back (an
+    # OOM-kill stand-in) — the pool still holds the dead sandbox.
+    os.killpg(proc.pid, signal.SIGKILL)
+    await proc.wait()
+    assert len(executor._pool(0)) == 1
+
+    removed = await executor.sweep_pool_health()
+    assert removed == 1
+    await _settle(executor)
+    assert len(executor._pool(0)) == 1  # lane refilled with a live sandbox
+    result = await executor.execute("print('alive')")
+    assert result.exit_code == 0
+    assert result.stdout == "alive\n"
+
+
 async def test_file_outputs_per_generation(stack):
     """Changed-file capture works per generation: each request only sees its
     own writes even though the workspace directory object is shared."""
