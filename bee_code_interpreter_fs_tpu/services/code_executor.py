@@ -597,17 +597,24 @@ class CodeExecutor:
         attempt before the retry path replaced it. Returns disposed count."""
         client = self._http_client()
         removed = 0
+
+        async def probe(url: str) -> bool:
+            try:
+                resp = await client.get(f"{url}/healthz", timeout=3.0)
+                return resp.status_code == 200
+            except Exception:  # noqa: BLE001 — unreachable = dead
+                return False
+
         for lane, pool in list(self._pools.items()):
             for sandbox in list(pool):
-                healthy = True
-                for url in sandbox.host_urls:
-                    try:
-                        resp = await client.get(f"{url}/healthz", timeout=3.0)
-                        if resp.status_code != 200:
-                            healthy = False
-                    except Exception:  # noqa: BLE001 — unreachable = dead
-                        healthy = False
-                if healthy:
+                # Probe a sandbox's hosts concurrently: serialized 3s
+                # timeouts across a multi-host slice would make one sweep
+                # take minutes on a hung node.
+                if all(
+                    await asyncio.gather(
+                        *(probe(url) for url in sandbox.host_urls)
+                    )
+                ):
                     continue
                 try:
                     pool.remove(sandbox)
@@ -618,7 +625,15 @@ class CodeExecutor:
                     sandbox.id,
                 )
                 removed += 1
-                await self._dispose(sandbox)
+                # Dispose off the sweep path via the tracked-task pattern:
+                # close() AWAITS _dispose_tasks (it CANCELS the sweeper
+                # itself, and a cancel landing mid-teardown would leak the
+                # sandbox's process past the loop).
+                task = asyncio.get_running_loop().create_task(
+                    self._dispose(sandbox)
+                )
+                self._dispose_tasks.add(task)
+                task.add_done_callback(self._dispose_tasks.discard)
                 self.fill_pool_soon(lane)
         return removed
 
