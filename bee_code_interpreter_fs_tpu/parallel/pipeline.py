@@ -119,6 +119,13 @@ def pipelined_transformer(params, tokens, cfg, *, mesh: Mesh,
     micro = x.reshape(n_microbatches, batch // n_microbatches, seq, -1)
 
     def stage_fn(stage_layers, x):
+        # shard_map delivers this stage's block with the pp dimension still
+        # leading ([1, layers_per_stage, ...]) — strip it so the scan
+        # iterates LAYERS. (Without this, a single-layer stage silently
+        # "works" by matmul broadcasting and a multi-layer stage scans the
+        # wrong axis.)
+        stage_layers = jax.tree.map(lambda w: w[0], stage_layers)
+
         def attn_fn(q, k, v):
             return _plain_causal_attention(
                 q, *_expand_gqa(k, v, cfg.n_heads), scale

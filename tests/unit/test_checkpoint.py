@@ -34,6 +34,25 @@ def test_roundtrip_params(tmp_path):
     )
 
 
+def test_moe_checkpoint_restores_onto_ep_mesh(tmp_path):
+    """Composition: a MoE checkpoint restores with experts sharded over ep
+    and computes identical logits."""
+    cfg = LlamaConfig.tiny(dtype="float32", n_experts=4, n_experts_per_token=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, cfg.vocab_size)
+    expected = forward(params, tokens, cfg)
+
+    save_checkpoint(tmp_path / "moe", params)
+    mesh = make_mesh(best_mesh_shape(8, tp=2, sp=1, ep=2))
+    like = shard_pytree(mesh, jax.tree.map(jnp.zeros_like, params), param_specs(cfg))
+    restored = restore_checkpoint(tmp_path / "moe", like=like)
+    assert restored["layers"]["w_gate"].sharding.spec == P(None, "ep", None, "tp")
+    got = jax.jit(lambda p, t: forward(p, t, cfg))(restored, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expected), rtol=5e-3, atol=5e-3
+    )
+
+
 def test_restore_with_shardings_produces_identical_model(tmp_path):
     """A checkpoint saved unsharded restores directly onto a tp/sp mesh with
     the model's shardings — and the sharded model computes the same logits."""

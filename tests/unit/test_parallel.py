@@ -132,6 +132,64 @@ def test_pipelined_transformer_matches_forward():
     )
 
 
+def test_pipelined_transformer_multiple_layers_per_stage():
+    """n_layers=8 over pp=4: each stage scans TWO layers — pins the
+    stage-block axis handling (a single-layer stage can pass by matmul
+    broadcasting even when the scan axis is wrong)."""
+    from bee_code_interpreter_fs_tpu.models import (
+        LlamaConfig,
+        forward,
+        init_params,
+    )
+    from bee_code_interpreter_fs_tpu.parallel import (
+        MeshSpec,
+        pipelined_transformer,
+    )
+
+    cfg = LlamaConfig.tiny(dtype="float32", n_layers=8)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(21), (4, 16), 0, cfg.vocab_size)
+    expected = forward(params, tokens, cfg)
+
+    mesh = make_mesh(MeshSpec(shape=(4,), axes=("pp",)))
+    got = jax.jit(
+        lambda p, t: pipelined_transformer(p, t, cfg, mesh=mesh, n_microbatches=2)
+    )(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expected), rtol=5e-3, atol=5e-3
+    )
+
+
+def test_pipelined_moe_transformer_matches_forward():
+    """Composition: MoE decoder blocks staged over pp — expert weights
+    reshape into stages like any stacked layer weight."""
+    from bee_code_interpreter_fs_tpu.models import (
+        LlamaConfig,
+        forward,
+        init_params,
+    )
+    from bee_code_interpreter_fs_tpu.parallel import (
+        MeshSpec,
+        pipelined_transformer,
+    )
+
+    cfg = LlamaConfig.tiny(
+        dtype="float32", n_layers=4, n_experts=4, n_experts_per_token=2,
+        n_heads=4, n_kv_heads=2,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(20), (4, 16), 0, cfg.vocab_size)
+    expected = forward(params, tokens, cfg)
+
+    mesh = make_mesh(MeshSpec(shape=(4,), axes=("pp",)))
+    got = jax.jit(
+        lambda p, t: pipelined_transformer(p, t, cfg, mesh=mesh, n_microbatches=2)
+    )(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expected), rtol=5e-3, atol=5e-3
+    )
+
+
 def test_pipelined_transformer_gradients_match():
     """The pipeline must TRAIN, not just infer: gradients through the full
     pp=4 schedule (reverse pipeline via ppermute transpose) must match
