@@ -106,50 +106,65 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
 def _flash_partial_kernel(qoff_ref, koff_ref, klen_ref, q_ref, k_ref, v_ref,
                           acc_in_ref, m_in_ref, l_in_ref,
-                          acc_ref, m_ref, l_ref, *, scale, block_q, block_k,
-                          chunk_len, causal):
+                          acc_ref, m_ref, l_ref,
+                          acc_s, m_s, l_s, *, scale, block_q, block_k,
+                          causal):
     """One ring step's contribution: fold a K/V chunk into the running
     (acc, m, l) online-softmax carry for this query tile. Positions are
     GLOBAL (offsets arrive via scalar refs — they are traced axis indices
-    at the call site), so causal masking works across sequence shards;
-    klen masks the chunk's padding tail."""
+    at the call site), so causal masking works across sequence shards; klen
+    masks the chunk's padding tail. Like the full kernel, the key dimension
+    is the innermost grid axis — one K/V tile VMEM-resident at a time,
+    chunk length bounded by HBM — and the working carry lives in VMEM
+    scratch: loaded from the carry inputs at the first key tile, stored to
+    the carry outputs at the last (in/out refs are pipelined block copies,
+    not loop-carried state, so scratch is the only correct home between
+    grid steps)."""
     qi = pl.program_id(1)
-    q = q_ref[0]
+    kj = pl.program_id(2)
+    n_k = pl.num_programs(2)
     q_positions = qoff_ref[0] + qi * block_q + jax.lax.iota(jnp.int32, block_q)
+    k_start = koff_ref[0] + kj * block_k
 
-    acc = acc_in_ref[0].astype(jnp.float32)
-    # m/l ride as [bh, tq, 1]: Mosaic requires the last two block dims to be
-    # (divisible by 8, divisible by 128) or equal to the array dims — a
-    # trailing singleton satisfies "equal" where a 2D [bh, tq] layout can't.
-    m = m_in_ref[0, :, 0].astype(jnp.float32)
-    l = l_in_ref[0, :, 0].astype(jnp.float32)
+    @pl.when(kj == 0)
+    def _load():
+        acc_s[:] = acc_in_ref[0].astype(jnp.float32)
+        m_s[:] = m_in_ref[0].astype(jnp.float32)
+        l_s[:] = l_in_ref[0].astype(jnp.float32)
 
-    def body(j, carry):
-        k_tile = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v_tile = v_ref[0, pl.ds(j * block_k, block_k), :]
-        k_positions = koff_ref[0] + j * block_k + jax.lax.iota(
-            jnp.int32, block_k
-        )
+    # Causal frontier: a key tile entirely past this query tile's last
+    # position (including every tile of a fully-future chunk) is a no-op.
+    live = (
+        k_start <= q_positions[block_q - 1] if causal else jnp.bool_(True)
+    )
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0]
+        k_tile = k_ref[0]
+        v_tile = v_ref[0]
+        k_positions = k_start + jax.lax.iota(jnp.int32, block_k)
         mask = k_positions[None, :] < koff_ref[0] + klen_ref[0]
         if causal:
             mask &= q_positions[:, None] >= k_positions[None, :]
         else:
             mask = jnp.broadcast_to(mask, (block_q, block_k))
-        return _tile_update(q, k_tile, v_tile, *carry, scale=scale, mask=mask)
-
-    num_k_tiles = chunk_len // block_k
-    if causal:
-        # Key tiles entirely past this query tile's last position contribute
-        # nothing — bound the loop at the (traced) causal frontier. A chunk
-        # fully in the future folds zero tiles.
-        last_q = qoff_ref[0] + qi * block_q + block_q - 1
-        num_k_tiles = jnp.clip(
-            (last_q - koff_ref[0]) // block_k + 1, 0, num_k_tiles
+        acc, m, l = _tile_update(
+            q, k_tile, v_tile, acc_s[:], m_s[:, 0], l_s[:, 0],
+            scale=scale, mask=mask,
         )
-    acc, m, l = jax.lax.fori_loop(0, num_k_tiles, body, (acc, m, l))
-    acc_ref[0] = acc
-    m_ref[0] = m[:, None]
-    l_ref[0] = l[:, None]
+        acc_s[:] = acc
+        m_s[:] = m[:, None]
+        l_s[:] = l[:, None]
+
+    @pl.when(kj == n_k - 1)
+    def _store():
+        # m/l ride as [.., 1]: Mosaic requires the last two block dims to
+        # be (divisible by 8, divisible by 128) or equal to the array dims —
+        # a trailing singleton satisfies "equal" where 2D [bh, tq] can't.
+        acc_ref[0] = acc_s[:]
+        m_ref[0] = m_s[:]
+        l_ref[0] = l_s[:]
 
 
 def flash_attention_partial(q, k, v, acc, m, l, *, q_offset, k_offset,
@@ -168,10 +183,9 @@ def flash_attention_partial(q, k, v, acc, m, l, *, q_offset, k_offset,
     sliced off). Returns updated (acc, m, l); finalize with
     out = acc / l[..., None].
 
-    VMEM note: the K/V chunk resides fully in VMEM per program, so the
-    practical per-device chunk bound is ~8k positions at d=128 float32
-    (~16k bf16); beyond that, shard the sequence further (larger sp) or
-    tile K/V through the grid.
+    K/V tiles stream through VMEM one [block_k, d] at a time (innermost grid
+    dimension), so per-device chunk length is bounded by HBM, not VMEM —
+    same layout as the full kernel.
     """
     b, tq, h, d = q.shape
     tk = k.shape[1]
@@ -205,36 +219,40 @@ def flash_attention_partial(q, k, v, acc, m, l, *, q_offset, k_offset,
         scale=scale,
         block_q=block_q,
         block_k=block_k,
-        chunk_len=tk_p,
         causal=causal,
     )
-    grid = (b * h, tq_p // block_q)
+    grid = (b * h, tq_p // block_q, tk_p // block_k)
     acc_h, m_h, l_h = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1,), lambda bh, qi: (0,)),
-            pl.BlockSpec((1,), lambda bh, qi: (0,)),
-            pl.BlockSpec((1,), lambda bh, qi: (0,)),
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, tk_p, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, tk_p, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1,), lambda bh, qi, kj: (0,)),
+            pl.BlockSpec((1,), lambda bh, qi, kj: (0,)),
+            pl.BlockSpec((1,), lambda bh, qi, kj: (0,)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, tq_p, d), jnp.float32),
             jax.ShapeDtypeStruct((b * h, tq_p, 1), jnp.float32),
             jax.ShapeDtypeStruct((b * h, tq_p, 1), jnp.float32),
         ],
-        # The carry updates in place: without aliasing every ring step would
-        # copy the full acc/m/l through fresh HBM buffers.
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        # The carry buffers reuse in place (the blocks are read at the first
+        # key tile and rewritten at the last).
         input_output_aliases={6: 0, 7: 1, 8: 2},
         interpret=interpret,
     )(q_off, k_off, k_len, qh, kh, vh, acc_h, m_h, l_h)
