@@ -4,9 +4,10 @@ The hot op of the Llama family, written for the hardware: one kernel
 computes softmax(QKᵀ·scale)·V tile by tile with the online-softmax
 recurrence, so the [t, t] score matrix never materializes in HBM — scores
 live in VMEM one [block_q, block_k] tile at a time, the MXU sees back-to-back
-dot_generals, and HBM traffic drops from O(t²) to O(t·d). Causal blocks
-beyond the diagonal are skipped entirely (the fori_loop upper bound is the
-query block's diagonal), halving the work of the masked-dense formulation.
+dot_generals, and HBM traffic drops from O(t²) to O(t·d). Key tiles beyond a
+query tile's causal diagonal skip their MXU work under a pl.when guard
+(the grid still visits them — their DMAs are pipelined and cheap relative
+to the saved matmuls), halving the compute of the masked-dense formulation.
 
 Grid: (batch·heads, t/block_q, t/block_k) with the key dimension innermost —
 only ONE [block_k, d] K and V tile is VMEM-resident at a time (Pallas
@@ -39,11 +40,19 @@ NEG_INF = -1e30
 def _tile_update(q, k_tile, v_tile, acc, m, l, *, scale, mask):
     """One online-softmax tile fold — the numerically delicate recurrence,
     shared by the full kernel and the ring-step partial kernel so the two
-    can never drift apart. `mask` is the [block_q, block_k] validity."""
+    can never drift apart. `mask` is the [block_q, block_k] validity.
+
+    The dots pin precision=DEFAULT explicitly: this kernel manages its own
+    numerics (bf16 MXU inputs, float32 accumulation via
+    preferred_element_type), and a global jax_default_matmul_precision of
+    "highest" — which the numpy dispatch shim sets for numpy parity — would
+    otherwise lower bf16 operands with an fp32 contract precision that
+    Mosaic rejects ("Bad lhs type")."""
     s = jax.lax.dot_general(
         q, k_tile,
         (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT,
     ) * scale  # [block_q, block_k]
     s = jnp.where(mask, s, NEG_INF)
     m_new = jnp.maximum(m, s.max(axis=-1))
@@ -54,6 +63,7 @@ def _tile_update(q, k_tile, v_tile, acc, m, l, *, scale, mask):
         p.astype(v_tile.dtype), v_tile,
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT,
     )
     return acc_new, m_new, l_new
 

@@ -32,6 +32,8 @@ from bee_code_interpreter_fs_tpu.services.storage import Storage  # noqa: E402
 
 BENCH_SOURCE = (REPO_ROOT / "examples" / "benchmark-numpy.py").read_text()
 MATMUL_SOURCE = (REPO_ROOT / "examples" / "benchmark-matmul.py").read_text()
+ATTENTION_SOURCE = (REPO_ROOT / "examples" / "benchmark-attention.py").read_text()
+ATTN_RE = re.compile(r"ATTN_TFLOPS=([0-9.]+)")
 GFLOPS_RE = re.compile(r"GFLOPS=([0-9.]+)")
 SINGLE_SHOT_RE = re.compile(r"GFLOPS_single_shot=([0-9.]+)")
 TFLOPS_RE = re.compile(r"TFLOPS=([0-9.]+)")
@@ -110,7 +112,9 @@ async def run_matmul(tmp: Path) -> dict:
         default_execution_timeout=600.0,
         jax_compilation_cache_dir=str(tmp / "jax-cache"),
     )
-    backend = LocalSandboxBackend(config, warm_import_jax=True, numpy_dispatch=False)
+    # numpy_dispatch puts the repo on the sandbox path — the attention bench
+    # imports the framework's Pallas kernel; matmul is pure jax either way.
+    backend = LocalSandboxBackend(config, warm_import_jax=True, numpy_dispatch=True)
     executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
     try:
         log("matmul: filling pool...")
@@ -134,6 +138,16 @@ async def run_matmul(tmp: Path) -> dict:
                         float(mfu_m.group(1)) if mfu_m else None
                     ),
                 }
+        # Long-context fused attention (Pallas flash kernel) through Execute.
+        log("flash attention (t=16384)...")
+        result = await executor.execute(ATTENTION_SOURCE, timeout=600.0)
+        if result.exit_code == 0:
+            attn = ATTN_RE.search(result.stdout)
+            if attn:
+                best["flash_attention_16k_tflops"] = float(attn.group(1))
+                log(f"flash attention: {attn.group(1)} TFLOPS causal")
+        else:
+            log(f"flash attention failed (non-fatal): {result.stderr[-300:]}")
         return best
     finally:
         await executor.close()

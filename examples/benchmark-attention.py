@@ -1,0 +1,46 @@
+"""Long-context fused attention benchmark: the Pallas flash kernel as an
+ordinary Execute payload. Causal attention at t=16384 — a sequence length
+whose dense score matrix (t² floats per head) would be gigabytes — runs in
+one kernel with K/V tiles streaming through VMEM. Steady state over chained
+iterations (each consumes the previous output as queries) with one final
+sync, per the rig's benchmarking methodology."""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from bee_code_interpreter_fs_tpu.ops.flash_attention import flash_attention
+
+ON_TPU = jax.devices()[0].platform == "tpu"
+B, T, H, D = (1, 16384, 4, 128) if ON_TPU else (1, 128, 2, 16)
+ITERS = 4 if ON_TPU else 2
+
+key = jax.random.PRNGKey(0)
+q, k, v = (
+    jax.random.normal(kk, (B, T, H, D), jnp.bfloat16)
+    for kk in jax.random.split(key, 3)
+)
+
+
+@jax.jit
+def chain(q, k, v):
+    def body(_, q):
+        return flash_attention(q, k, v, interpret=not ON_TPU).astype(q.dtype)
+
+    out = jax.lax.fori_loop(0, ITERS, body, q)
+    return out[0, 0, 0, 0].astype(jnp.float32)
+
+
+float(chain(q, k, v))  # compile + first run off the clock
+best = float("inf")
+for _ in range(2):
+    t0 = time.perf_counter()
+    float(chain(q, k, v))
+    best = min(best, time.perf_counter() - t0)
+
+# Causal attention flops: QK^T + PV, each 2*b*h*(t^2/2)*d.
+flops = ITERS * 4 * B * H * (T * T / 2) * D
+print(f"backend: {jax.devices()[0].platform} t={T} iters={ITERS}")
+print(f"ATTN_TFLOPS={flops / best / 1e12:.2f}")

@@ -52,6 +52,15 @@ async def test_benchmark_fib_unaffected(executor):
     assert "fib(10000) x1000" in result.stdout
 
 
+async def test_benchmark_attention_example(executor):
+    """The long-context flash-attention bench runs via Execute; on the CPU
+    test platform it self-shrinks and runs the kernel interpreted."""
+    source = (EXAMPLES / "benchmark-attention.py").read_text()
+    result = await executor.execute(source, timeout=120)
+    assert result.exit_code == 0, result.stderr
+    assert "ATTN_TFLOPS=" in result.stdout
+
+
 async def test_benchmark_matmul_example(executor):
     """The compute-bound bench (chained bf16 matmuls) runs via Execute; on
     the CPU test platform it self-shrinks and still reports TFLOPS."""
