@@ -46,13 +46,20 @@ def install(threshold: int | None = None) -> None:
 
     if os.environ.get("APP_NUMPY_DISPATCH_X64", "0") not in ("0", "false", ""):
         jax.config.update("jax_enable_x64", True)
+
+    from . import lazy, shim
+
     # numpy users expect float32 matmuls to be float32: on TPU the MXU would
     # otherwise run bf16 passes and round (e.g. 257.0 -> 256.0). "highest"
-    # keeps numpy-compatible accuracy; ops that want speed can opt down.
-    precision = os.environ.get("APP_NUMPY_DISPATCH_MATMUL_PRECISION", "highest")
-    jax.config.update("jax_default_matmul_precision", precision)
-
-    from . import shim
+    # keeps numpy-compatible accuracy — but SCOPED to shim-dispatched
+    # computations (lazy.precision_scope), never as a global
+    # jax_default_matmul_precision: user jax code in the same sandbox must
+    # keep its own numerics, and Pallas kernels break under a global
+    # "highest" (bf16 dots lower with an fp32 contract precision Mosaic
+    # rejects).
+    lazy.MATMUL_PRECISION = os.environ.get(
+        "APP_NUMPY_DISPATCH_MATMUL_PRECISION", "highest"
+    )
 
     if threshold is None:
         threshold = int(os.environ.get("APP_NUMPY_DISPATCH_THRESHOLD", str(2**17)))

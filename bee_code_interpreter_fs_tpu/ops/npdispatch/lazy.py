@@ -182,6 +182,21 @@ def build_node(op_name: str, fn: Callable, args, kwargs) -> Node | None:
 
 
 # --------------------------------------------------------------------------
+# Matmul precision for SHIM-DISPATCHED computations only (set by
+# npdispatch.install from APP_NUMPY_DISPATCH_MATMUL_PRECISION). numpy users
+# expect float32 matmuls to be float32 — the MXU would otherwise run bf16
+# passes and round (257.0 -> 256.0) — but this must NOT be a global
+# jax_default_matmul_precision: user jax code sharing the process would
+# silently change numerics/speed, and Pallas kernels break outright (bf16
+# dots lower with an fp32 contract precision Mosaic rejects). Every shim
+# execution path enters this scope instead.
+MATMUL_PRECISION = "highest"
+
+
+def precision_scope():
+    return jax.default_matmul_precision(MATMUL_PRECISION)
+
+
 # Materialization: linearize DAG -> structure key -> cached jitted runner.
 
 _exec_cache: dict[tuple, Callable] = {}
@@ -283,7 +298,8 @@ def materialize(root: Node) -> jax.Array:
         leaf if isinstance(leaf, jax.Array) else jnp.asarray(leaf)
         for leaf in leaves
     ]
-    outs = runner(device_leaves)
+    with precision_scope():
+        outs = runner(device_leaves)
     for (_, owners), value in zip(writebacks, outs[1:]):
         for owner in owners:
             owner._concrete = value

@@ -250,10 +250,11 @@ def eager_device(fn, args, kwargs):
     """Run the jnp op eagerly on device; NotImplemented on fallback errors
     (object dtype, unsupported kwarg, ...) so callers can try host numpy."""
     try:
-        result = fn(
-            *_unwrap_jnp(list(args)),
-            **{k: _unwrap_jnp(v) for k, v in kwargs.items()},
-        )
+        with lazy.precision_scope():
+            result = fn(
+                *_unwrap_jnp(list(args)),
+                **{k: _unwrap_jnp(v) for k, v in kwargs.items()},
+            )
     except _FALLBACK_ERRORS:
         return NotImplemented
     return _result_wrap(result)
@@ -535,11 +536,12 @@ class TpuArray:
         if callable(attr):
 
             def method(*args, **kwargs):
-                return _result_wrap(
-                    attr(*_unwrap_jnp(list(args)), **{
-                        k: _unwrap_jnp(v) for k, v in kwargs.items()
-                    })
-                )
+                with lazy.precision_scope():
+                    return _result_wrap(
+                        attr(*_unwrap_jnp(list(args)), **{
+                            k: _unwrap_jnp(v) for k, v in kwargs.items()
+                        })
+                    )
 
             return method
         return _result_wrap(attr)

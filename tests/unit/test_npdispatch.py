@@ -275,6 +275,26 @@ def test_integer_policy_elementwise_int32_stays_device(np_shim):
     assert int(b[0]) == 21
 
 
+def test_matmul_precision_scoped_not_global(np_shim):
+    """The shim's float32-parity matmul precision must apply to SHIM ops
+    only: (a) a float32 matmul through the shim keeps values a bf16 MXU
+    pass would round (257 -> 256), and (b) the process-global
+    jax_default_matmul_precision stays untouched — a global "highest" broke
+    Pallas kernels sharing the sandbox (bf16 dots lower with an fp32
+    contract precision Mosaic rejects)."""
+    import jax
+
+    assert jax.config.jax_default_matmul_precision is None  # (b)
+
+    n = 64
+    a = np_shim.full((THRESHOLD, n), 1.0, dtype=np_shim.float32)
+    a[0, :] = 257.0  # representable in f32, rounds to 256 in bf16
+    b = np_shim.eye(n, dtype=np_shim.float32)
+    assert isinstance(a, TpuArray)
+    out = a @ b
+    assert float(out[0, 0]) == 257.0  # (a) exact under f32 contraction
+
+
 def test_headline_sum_of_squares_divergence_bounded(np_shim):
     """The BASELINE.json headline workload shape (sum of squares over random
     doubles) computed by the shim in float32 must stay within rtol=1e-5 of
