@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from bee_code_interpreter_fs_tpu.parallel.mesh import shard_map
 
 from bee_code_interpreter_fs_tpu.parallel.ring_attention import ring_attention
 

@@ -60,6 +60,10 @@ def install(threshold: int | None = None) -> None:
     lazy.MATMUL_PRECISION = os.environ.get(
         "APP_NUMPY_DISPATCH_MATMUL_PRECISION", "highest"
     )
+    # Fail at install time, not from inside the user's first dispatched op:
+    # entering the scope once validates the string against jax's enum.
+    with lazy.precision_scope():
+        pass
 
     if threshold is None:
         threshold = int(os.environ.get("APP_NUMPY_DISPATCH_THRESHOLD", str(2**17)))

@@ -28,7 +28,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental.shard_map import shard_map
+from bee_code_interpreter_fs_tpu.parallel.mesh import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

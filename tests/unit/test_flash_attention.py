@@ -97,7 +97,7 @@ def test_ring_attention_with_flash_kernel():
     the fully-masked future chunks the ring streams past each device."""
     from functools import partial as fpartial
 
-    from jax.experimental.shard_map import shard_map
+    from bee_code_interpreter_fs_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
 
     from bee_code_interpreter_fs_tpu.parallel import (
@@ -133,7 +133,7 @@ def test_ring_flash_non_divisible_chunks():
     internally (a config the einsum ring path always handled)."""
     from functools import partial as fpartial
 
-    from jax.experimental.shard_map import shard_map
+    from bee_code_interpreter_fs_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
 
     from bee_code_interpreter_fs_tpu.parallel import (

@@ -281,7 +281,11 @@ def test_matmul_precision_scoped_not_global(np_shim):
     pass would round (257 -> 256), and (b) the process-global
     jax_default_matmul_precision stays untouched — a global "highest" broke
     Pallas kernels sharing the sandbox (bf16 dots lower with an fp32
-    contract precision Mosaic rejects)."""
+    contract precision Mosaic rejects).
+
+    Assertion (a) only bites on a real TPU MXU — CPU/GPU matmuls are f32
+    regardless of jax_default_matmul_precision, so on CI it is (b) plus the
+    install-time precision_scope validation that guard this behavior."""
     import jax
 
     assert jax.config.jax_default_matmul_precision is None  # (b)

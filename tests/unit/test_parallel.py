@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 from functools import partial
 from jax.sharding import PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from bee_code_interpreter_fs_tpu.parallel.mesh import shard_map
 
 from bee_code_interpreter_fs_tpu.parallel import (
     best_mesh_shape,
