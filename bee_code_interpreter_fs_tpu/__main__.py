@@ -59,6 +59,7 @@ async def main(ctx: ApplicationContext | None = None) -> None:
     # would pay the full cold TPU spawn.
     ctx.code_executor.fill_pool_soon(ctx.config.default_chip_count)
     ctx.code_executor.start_health_sweeper(ctx.config.pool_health_sweep_interval)
+    ctx.code_executor.start_session_sweeper()
 
     try:
         stop_task = asyncio.create_task(stop.wait())

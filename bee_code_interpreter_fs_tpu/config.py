@@ -112,6 +112,22 @@ class Config(BaseModel):
     # unresponsive ones (a silently-dead pooled process would otherwise cost
     # the next request a failed attempt first). 0 disables the sweeper.
     pool_health_sweep_interval: float = 30.0
+    # -- sessions (executor_id affinity) ------------------------------------
+    # Execute requests carrying an executor_id share one live sandbox: its
+    # workspace and warm process persist across the session's requests (the
+    # upstream bee-code-interpreter's persistent-executor semantics; the -fs
+    # fork carried the field but single-use pods made it a no-op). Max
+    # concurrent sessions; at the cap new ids get HTTP 429 /
+    # RESOURCE_EXHAUSTED. 0 = reference-parity mode: executor_id is accepted
+    # and IGNORED (stateless) — set this for legacy clients that thread
+    # opaque per-request ids under the old "field is unused" contract, which
+    # would otherwise open one throwaway session per request.
+    executor_session_max: int = 16
+    # A session idle longer than this is closed and its sandbox returned to
+    # the pool (or disposed). Kept deliberately short: on a capacity-
+    # constrained TPU lane an idle session is parking a chip that stateless
+    # requests are queueing for.
+    executor_session_idle_timeout: float = 120.0
     # Default accelerator request for kubernetes backend pods, merged into the
     # container resources (e.g. {"google.com/tpu": "4"}). Empty → CPU pods.
     tpu_resource_requests: dict = Field(default_factory=dict)

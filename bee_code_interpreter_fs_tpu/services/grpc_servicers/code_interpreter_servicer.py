@@ -19,7 +19,7 @@ import grpc
 from ...proto import code_interpreter_pb2 as pb2
 from ...utils.logs import new_request_id
 from ...utils.validation import OBJECT_ID_RE
-from ..code_executor import CodeExecutor, ExecutorError
+from ..code_executor import CodeExecutor, ExecutorError, SessionLimitError
 from ..custom_tool_executor import (
     CustomToolExecuteError,
     CustomToolExecutor,
@@ -63,6 +63,8 @@ class CodeInterpreterServicer:
                     grpc.StatusCode.INVALID_ARGUMENT,
                     f"invalid file object id for {path}",
                 )
+        # executor_id pattern validation lives in the executor (its
+        # ValueError maps to INVALID_ARGUMENT below, same as the HTTP path).
         try:
             result = await self.code_executor.execute(
                 request.source_code if has_code else None,
@@ -72,18 +74,38 @@ class CodeInterpreterServicer:
                 env=dict(request.env) or None,
                 chip_count=request.chip_count or None,
                 profile=request.profile,
+                executor_id=request.executor_id or None,
             )
         except ValueError as e:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except SessionLimitError as e:
+            # Retryable resource exhaustion, not a defect in the request.
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except (ExecutorError, SandboxSpawnError) as e:
             logger.exception("Execute failed [%s]", request_id)
             await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         response = pb2.ExecuteResponse(
-            stdout=result.stdout, stderr=result.stderr, exit_code=result.exit_code
+            stdout=result.stdout,
+            stderr=result.stderr,
+            exit_code=result.exit_code,
+            session_seq=result.session_seq,
+            session_ended=result.session_ended,
         )
         for path, object_id in result.files.items():
             response.files[path] = object_id
         return response
+
+    async def CloseExecutor(
+        self, request: pb2.CloseExecutorRequest, context: grpc.aio.ServicerContext
+    ) -> pb2.CloseExecutorResponse:
+        new_request_id()
+        if not OBJECT_ID_RE.match(request.executor_id):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "invalid executor_id (want ^[0-9a-zA-Z_-]{1,255}$)",
+            )
+        closed = await self.code_executor.close_session(request.executor_id)
+        return pb2.CloseExecutorResponse(closed=closed)
 
     async def ParseCustomTool(
         self, request: pb2.ParseCustomToolRequest, context: grpc.aio.ServicerContext
@@ -150,5 +172,10 @@ class CodeInterpreterServicer:
                 self.ExecuteCustomTool,
                 request_deserializer=pb2.ExecuteCustomToolRequest.FromString,
                 response_serializer=pb2.ExecuteCustomToolResponse.SerializeToString,
+            ),
+            "CloseExecutor": grpc.unary_unary_rpc_method_handler(
+                self.CloseExecutor,
+                request_deserializer=pb2.CloseExecutorRequest.FromString,
+                response_serializer=pb2.CloseExecutorResponse.SerializeToString,
             ),
         }

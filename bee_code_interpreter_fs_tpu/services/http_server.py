@@ -25,7 +25,7 @@ from pydantic import BaseModel, Field, ValidationError
 from ..utils.logs import new_request_id
 from ..utils.validation import OBJECT_ID_RE
 from .backends.base import SandboxSpawnError
-from .code_executor import CodeExecutor, ExecutorError
+from .code_executor import CodeExecutor, ExecutorError, SessionLimitError
 from .custom_tool_executor import (
     CustomToolExecuteError,
     CustomToolExecutor,
@@ -44,6 +44,9 @@ class ExecuteRequest(BaseModel):
     env: dict[str, str] | None = None
     chip_count: int | None = Field(default=None, ge=0)
     profile: bool = False
+    # Session affinity: requests sharing an executor_id run in one live
+    # sandbox whose workspace persists across them. Empty/absent = stateless.
+    executor_id: str | None = None
 
 
 class ParseCustomToolRequest(BaseModel):
@@ -115,22 +118,43 @@ def create_http_app(
                 env=req.env,
                 chip_count=req.chip_count,
                 profile=req.profile,
+                executor_id=req.executor_id,
             )
         except ValueError as e:
             return bad_request(str(e))
+        except SessionLimitError as e:
+            # Resource exhaustion, not a request defect: retryable.
+            return web.json_response({"error": str(e)}, status=429)
         except (ExecutorError, SandboxSpawnError) as e:
             logger.exception("execute failed")
             return web.json_response({"error": str(e)}, status=502)
-        return web.json_response(
-            {
-                "stdout": result.stdout,
-                "stderr": result.stderr,
-                "exit_code": result.exit_code,
-                "files": result.files,
-                "phases": result.phases,
-                "warm": result.warm,
-            }
-        )
+        body = {
+            "stdout": result.stdout,
+            "stderr": result.stderr,
+            "exit_code": result.exit_code,
+            "files": result.files,
+            "phases": result.phases,
+            "warm": result.warm,
+        }
+        if req.executor_id:
+            # Session continuity: seq==1 on a request the client expected to
+            # land in an existing session means prior state was lost (idle
+            # expiry); session_ended means THIS request killed the session.
+            body["session_seq"] = result.session_seq
+            body["session_ended"] = result.session_ended
+        return web.json_response(body)
+
+    @routes.delete("/v1/executors/{executor_id}")
+    async def close_executor_session(request: web.Request) -> web.Response:
+        """End an executor_id session: waits out an in-flight request, then
+        releases the sandbox (its workspace is discarded; files already
+        round-tripped through /v1/files or Execute responses survive)."""
+        executor_id = request.match_info["executor_id"]
+        if not OBJECT_ID_RE.match(executor_id):
+            return bad_request("invalid executor_id")
+        if await code_executor.close_session(executor_id):
+            return web.json_response({"closed": executor_id})
+        return web.json_response({"error": "no such session"}, status=404)
 
     @routes.post("/v1/parse-custom-tool")
     async def parse_custom_tool(request: web.Request) -> web.Response:

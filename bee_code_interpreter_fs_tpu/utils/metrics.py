@@ -224,6 +224,10 @@ class ExecutorMetrics:
             "Sandboxes recycled back into the pool after a request "
             "(generation turnover via /reset — the TPU lease survived).",
         )
+        self.session_executions = self.registry.counter(
+            "code_interpreter_session_executions_total",
+            "Executions routed to an executor_id session sandbox.",
+        )
         self.phase_seconds = self.registry.histogram(
             "code_interpreter_phase_seconds",
             "Per-request phase latency (queue_wait/upload/exec/download).",
@@ -235,6 +239,7 @@ class ExecutorMetrics:
             ("chip_count",),
         )
         self.pool_depth: Gauge | None = None
+        self.active_sessions: Gauge | None = None
 
     def bind_pool(self, pools) -> None:
         """Expose warm-pool depth per chip-count lane, read at scrape time."""
@@ -246,5 +251,20 @@ class ExecutorMetrics:
             "code_interpreter_pool_depth",
             "Warm sandboxes currently pooled, by chip-count lane.",
             ("chip_count",),
+            callback=sample,
+        )
+
+    def bind_sessions(self, sessions) -> None:
+        """Expose the live executor_id session count, read at scrape time."""
+
+        def sample() -> dict[tuple[str, ...], float]:
+            return {
+                (): float(sum(1 for s in sessions.values() if not s.closed))
+            }
+
+        self.active_sessions = self.registry.gauge(
+            "code_interpreter_active_sessions",
+            "Live executor_id sessions (sandboxes parked out of the pool).",
+            (),
             callback=sample,
         )

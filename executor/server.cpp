@@ -870,6 +870,15 @@ void handle_execute(const minihttp::Request& /*req*/, minihttp::Conn& conn) {
             restart_runner = true;
             break;
         }
+      } else {
+        // Runner found already dead at request time (e.g. OOM-killed
+        // between requests): without flagging a restart here, the sandbox
+        // would serve every subsequent request cold forever (sessions
+        // never hit /reset, where dead-runner recovery otherwise lives)
+        // and runner_restarted=false would hide the in-process state loss
+        // from the control plane's session tracking. The request itself
+        // still runs via the cold path below — no stderr pollution.
+        restart_runner = true;
       }
     }
     if (restart_runner) {
@@ -938,6 +947,11 @@ void handle_execute(const minihttp::Request& /*req*/, minihttp::Conn& conn) {
   resp["files"] = minijson::Value(files);
   resp["duration_s"] = minijson::Value(duration);
   resp["warm"] = minijson::Value(ran_warm);
+  // True when the warm runner was killed (timeout) or died during this
+  // request: its in-process state is gone and a rewarm is in flight. The
+  // control plane uses this to end executor_id sessions, whose contract is
+  // that the process persists across requests.
+  resp["runner_restarted"] = minijson::Value(restart_runner);
   conn.send_response(200, "application/json", minijson::Value(resp).dump());
 }
 

@@ -44,6 +44,9 @@ class Client:
             pb2.ExecuteCustomToolRequest,
             pb2.ExecuteCustomToolResponse,
         )
+        self.close_executor = u(
+            "CloseExecutor", pb2.CloseExecutorRequest, pb2.CloseExecutorResponse
+        )
         self.health_check = u(
             "Check",
             health_pb2.HealthCheckRequest,
@@ -108,6 +111,46 @@ async def test_execute_validation_abort(client):
 
     with pytest.raises(grpc.aio.AioRpcError) as e:
         await client.execute(pb2.ExecuteRequest(source_code="x", chip_count=-4))
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+async def test_execute_session_affinity(client):
+    """executor_id pins requests to one live sandbox: the workspace persists
+    across Executes (no files map round-trip needed). The reference carried
+    this field but its single-use pods ignored it (only health_check.py:48
+    ever set it); here it has the upstream persistent-executor semantics."""
+    resp = await client.execute(
+        pb2.ExecuteRequest(
+            source_code="open('kept.txt','w').write('42')",
+            executor_id="grpc-sess",
+        )
+    )
+    assert resp.exit_code == 0
+    resp = await client.execute(
+        pb2.ExecuteRequest(
+            source_code="print(open('kept.txt').read())",
+            executor_id="grpc-sess",
+        )
+    )
+    assert resp.exit_code == 0, resp.stderr
+    assert resp.stdout == "42\n"
+    assert resp.session_seq == 2
+    assert resp.session_ended is False
+
+    # gRPC clients can close their sessions without the HTTP surface.
+    closed = await client.close_executor(
+        pb2.CloseExecutorRequest(executor_id="grpc-sess")
+    )
+    assert closed.closed is True
+    closed = await client.close_executor(
+        pb2.CloseExecutorRequest(executor_id="grpc-sess")
+    )
+    assert closed.closed is False
+
+    with pytest.raises(grpc.aio.AioRpcError) as e:
+        await client.execute(
+            pb2.ExecuteRequest(source_code="x", executor_id="bad id")
+        )
     assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
 
 

@@ -246,6 +246,45 @@ async def test_concurrent_executes(client):
     assert results == [f"{i * 10}\n" for i in range(4)]
 
 
+async def test_session_over_http(client):
+    """executor_id session: workspace persists across Executes with no file
+    round-trip; DELETE /v1/executors/{id} ends it."""
+    resp = await client.post(
+        "/v1/execute",
+        json={
+            "source_code": "open('s.txt','w').write('kept')",
+            "executor_id": "http-sess",
+        },
+    )
+    assert resp.status == 200
+    body = await resp.json()
+    assert body["exit_code"] == 0
+    assert "/workspace/s.txt" in body["files"]
+
+    resp = await client.post(
+        "/v1/execute",
+        json={
+            "source_code": "print(open('s.txt').read())",
+            "executor_id": "http-sess",
+        },
+    )
+    body = await resp.json()
+    assert body["exit_code"] == 0, body["stderr"]
+    assert body["stdout"] == "kept\n"
+    assert body["session_seq"] == 2
+    assert body["session_ended"] is False
+
+    resp = await client.delete("/v1/executors/http-sess")
+    assert resp.status == 200
+    assert (await resp.json())["closed"] == "http-sess"
+    # Idempotence: the session is gone now.
+    resp = await client.delete("/v1/executors/http-sess")
+    assert resp.status == 404
+    # Bad ids are client errors.
+    resp = await client.delete("/v1/executors/bad%20id")
+    assert resp.status == 400
+
+
 async def test_healthz(client):
     resp = await client.get("/healthz")
     assert resp.status == 200

@@ -1,0 +1,105 @@
+"""End-to-end executor_id sessions through the real local backend + C++
+executor: workspace and process state persist across a session's Executes,
+and closing the session scrubs everything for the next tenant.
+"""
+
+import asyncio
+
+import pytest
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.backends.local import LocalSandboxBackend
+from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+
+@pytest.fixture
+async def stack(tmp_path):
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        local_sandbox_root=str(tmp_path / "sandboxes"),
+        executor_pod_queue_target_length=1,
+        jax_compilation_cache_dir="",
+        default_execution_timeout=30.0,
+    )
+    backend = LocalSandboxBackend(config, warm_import_jax=False)
+    executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
+    yield executor, backend
+    await executor.close()
+
+
+async def _settle(executor):
+    for _ in range(200):
+        pending = list(executor._dispose_tasks) + list(executor._fill_tasks)
+        if not pending:
+            return
+        await asyncio.gather(*pending, return_exceptions=True)
+
+
+async def test_session_workspace_persists_across_executes(stack):
+    executor, backend = stack
+
+    first = await executor.execute(
+        "open('notes.txt', 'w').write('hello from request 1')\n"
+        "import os; print(os.getpid())\n",
+        executor_id="sess-e2e",
+    )
+    assert first.exit_code == 0, first.stderr
+    # The changed file is still captured per-request (stateless-files parity).
+    assert "/workspace/notes.txt" in first.files
+
+    # No upload round-trip: the session workspace still has the file.
+    second = await executor.execute(
+        "import os\n"
+        "print(open('notes.txt').read())\n"
+        "print(os.getpid())\n",
+        executor_id="sess-e2e",
+    )
+    assert second.exit_code == 0, second.stderr
+    lines = second.stdout.splitlines()
+    assert lines[0] == "hello from request 1"
+    # Same warm process served both (in-process execution: user pid = runner
+    # pid), so imported modules stay hot within the session too.
+    assert first.stdout.strip() == lines[1]
+
+    # A STATELESS request meanwhile sees a pristine workspace.
+    stateless = await executor.execute("import os; print(os.listdir('.'))")
+    assert stateless.exit_code == 0, stateless.stderr
+    assert "notes.txt" not in stateless.stdout
+
+    # Close the session; the same id then starts from scratch.
+    assert await executor.close_session("sess-e2e") is True
+    await _settle(executor)
+    fresh = await executor.execute(
+        "import os; print(os.path.exists('notes.txt'))",
+        executor_id="sess-e2e",
+    )
+    assert fresh.exit_code == 0, fresh.stderr
+    assert fresh.stdout.strip() == "False"
+
+
+async def test_session_timeout_kill_ends_session(stack):
+    executor, backend = stack
+
+    first = await executor.execute(
+        "open('state.txt', 'w').write('x')", executor_id="sess-kill"
+    )
+    assert first.exit_code == 0, first.stderr
+
+    # The warm runner is killed by the timeout -> runner_restarted -> the
+    # session ends (its in-process state is gone, the contract is broken).
+    hung = await executor.execute(
+        "import time\ntime.sleep(30)", executor_id="sess-kill", timeout=1.0
+    )
+    assert hung.exit_code == -1
+    assert "timed out" in hung.stderr.lower()
+    assert "sess-kill" not in executor._sessions
+    await _settle(executor)
+
+    # Same id afterwards = a fresh session with a clean workspace.
+    fresh = await executor.execute(
+        "import os; print(os.path.exists('state.txt'))",
+        executor_id="sess-kill",
+    )
+    assert fresh.exit_code == 0, fresh.stderr
+    assert fresh.stdout.strip() == "False"

@@ -9,6 +9,7 @@ recursive changed-file detection.
 import json
 import os
 import re
+import signal
 import subprocess
 import time
 from pathlib import Path
@@ -362,6 +363,79 @@ def test_reset_wipes_extra_dirs_and_tmpdir(tmp_path):
     finally:
         proc.kill()
         proc.wait()
+
+
+def test_runner_dead_at_request_flags_restart(tmp_path):
+    """A warm runner that died BETWEEN requests (OOM-kill etc.) must be
+    detected at the next /execute: the response reports
+    runner_restarted=true (sessions key their state-loss signal off it) and
+    a background rewarm starts — without this, the sandbox would serve
+    every subsequent request cold forever and sessions would silently lose
+    their in-process state. (Detection happens inside the runner protocol —
+    the dead/zombie runner's pipe EOFs -> kDied; alive() alone cannot see a
+    zombie.)"""
+    ws = tmp_path / "ws"
+    rp = tmp_path / "rp"
+    ws.mkdir()
+    rp.mkdir()
+    proc = subprocess.Popen(
+        [str(BINARY)], env=_server_env(ws, rp), stdout=subprocess.PIPE, stderr=None
+    )
+    try:
+        line = proc.stdout.readline().decode()
+        port = int(re.search(r"port=(\d+)", line).group(1))
+        with httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=30.0) as c:
+            for _ in range(200):
+                if c.get("/healthz").json().get("warm"):
+                    break
+                time.sleep(0.1)
+            else:
+                pytest.fail("runner never warmed")
+            # Kill the runner out-of-band: it is the server's only child.
+            children = [
+                int(p)
+                for p in os.listdir("/proc")
+                if p.isdigit() and _ppid_of(int(p)) == proc.pid
+            ]
+            assert children, "no runner child found"
+            for pid in children:
+                os.kill(pid, signal.SIGKILL)
+            time.sleep(0.3)
+
+            resp = c.post("/execute", json={"source_code": "print('x')"})
+            body = resp.json()
+            # The request hits the dead runner: reported honestly (the code
+            # never ran) and flagged so the control plane ends any session.
+            assert body["exit_code"] == -1
+            assert "runner crashed" in body["stderr"].lower()
+            assert body["runner_restarted"] is True
+            # The background rewarm restores warm service.
+            for _ in range(200):
+                if c.get("/healthz").json().get("warm"):
+                    break
+                time.sleep(0.1)
+            else:
+                pytest.fail("runner did not restart in the background")
+            body = c.post(
+                "/execute", json={"source_code": "print('warm')"}
+            ).json()
+            assert body["warm"] is True
+            assert body["runner_restarted"] is False
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def _ppid_of(pid: int) -> int:
+    """Exact ppid (field 2 after the parenthesized comm) — matching the pid
+    loosely against all stat fields could hit unrelated processes' counters
+    and SIGKILL them."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read()
+        return int(stat.rsplit(b") ", 1)[1].split()[1])
+    except (OSError, IndexError, ValueError):
+        return -1
 
 
 def test_reset_refused_when_runner_cold(tmp_path):

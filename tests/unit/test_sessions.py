@@ -1,0 +1,379 @@
+"""executor_id session affinity tests (orchestrator level).
+
+The reference carried `executor_id` in ExecuteRequest but its single-use pods
+ignored it (only the health check ever set it); upstream bee-code-interpreter
+used it to pin requests to a persistent executor pod. Here sessions park one
+live sandbox out of the pool: no /reset between a session's requests, so the
+workspace and the warm process persist until the session closes (explicitly,
+on idle timeout, or when its runner dies).
+"""
+
+import asyncio
+
+import pytest
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.backends.base import Sandbox
+from bee_code_interpreter_fs_tpu.services.code_executor import (
+    CodeExecutor,
+    ExecutorError,
+    SessionLimitError,
+)
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+
+class FakeBackend:
+    def __init__(self, capacity=None, resettable=True):
+        self.capacity = capacity
+        self.resettable = resettable
+        self.spawns = 0
+        self.resets = 0
+        self.deletes = 0
+        self.live = set()
+
+    async def spawn(self, chip_count: int = 0) -> Sandbox:
+        self.spawns += 1
+        sandbox = Sandbox(
+            id=f"sb-{self.spawns}", url="http://fake", chip_count=chip_count
+        )
+        self.live.add(sandbox.id)
+        return sandbox
+
+    def pool_capacity(self, chip_count: int):
+        return self.capacity
+
+    async def reset(self, sandbox: Sandbox):
+        self.resets += 1
+        if not self.resettable or sandbox.id not in self.live:
+            return None
+        sandbox.meta["generation"] = sandbox.meta.get("generation", 0) + 1
+        return sandbox
+
+    async def delete(self, sandbox: Sandbox) -> None:
+        self.deletes += 1
+        self.live.discard(sandbox.id)
+
+    async def close(self) -> None:
+        self.live.clear()
+
+
+class FakeSandboxServer:
+    """Replaces the HTTP hop to the sandbox. Records which sandbox served
+    each request; response fields are overridable per-request via
+    `next_response` (e.g. runner_restarted) and a raisable `fail_next`."""
+
+    def __init__(self, executor: CodeExecutor):
+        self.served_by: list[str] = []
+        self.next_response: dict = {}
+        self.fail_next: Exception | None = None
+
+        async def fake_post_execute(client, base, payload, timeout, sandbox):
+            if self.fail_next is not None:
+                err, self.fail_next = self.fail_next, None
+                raise err
+            self.served_by.append(sandbox.id)
+            body = {
+                "stdout": "ok\n",
+                "stderr": "",
+                "exit_code": 0,
+                "files": [],
+                "warm": True,
+            }
+            body.update(self.next_response)
+            self.next_response = {}
+            return body
+
+        executor._post_execute = fake_post_execute
+
+
+def make_executor(backend, tmp_path, **config_kwargs):
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        executor_pod_queue_target_length=1,
+        **config_kwargs,
+    )
+    executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
+    server = FakeSandboxServer(executor)
+    return executor, server
+
+
+async def settle(executor):
+    """Let release/refill tasks scheduled by execute() run to completion."""
+    for _ in range(3):
+        await asyncio.sleep(0)
+    tasks = list(executor._dispose_tasks) + list(executor._fill_tasks)
+    if tasks:
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+
+async def test_session_requests_share_one_sandbox(tmp_path):
+    backend = FakeBackend()
+    executor, server = make_executor(backend, tmp_path)
+    try:
+        for seq in (1, 2, 3):
+            result = await executor.execute("x", executor_id="sess-a")
+            assert result.exit_code == 0
+            assert result.session_seq == seq
+            assert result.session_ended is False
+        assert len(set(server.served_by)) == 1
+        # No generation turnover between session requests: state persists.
+        assert backend.resets == 0
+        assert executor._session_held.get(0) == 1
+    finally:
+        await executor.close()
+
+
+async def test_session_close_returns_sandbox_via_reset(tmp_path):
+    # capacity=1 keeps the background refill out of the picture (the session
+    # holds THE slot, so the lane target is 0 while it lives): on close, the
+    # sandbox must be scrubbed via reset and become the pool's warm sandbox.
+    backend = FakeBackend(capacity=1)
+    executor, server = make_executor(backend, tmp_path)
+    try:
+        await executor.execute("x", executor_id="sess-a")
+        assert await executor.close_session("sess-a") is True
+        await settle(executor)
+        assert backend.resets == 1  # turnover scrubbed it back to the pool
+        assert executor._session_held.get(0) == 0
+        assert sum(len(p) for p in executor._pools.values()) == 1
+        assert len(backend.live) == 1  # recycled, not leaked or disposed
+        # Closing again: no such session.
+        assert await executor.close_session("sess-a") is False
+    finally:
+        await executor.close()
+
+
+async def test_session_independent_ids_get_distinct_sandboxes(tmp_path):
+    backend = FakeBackend()
+    executor, server = make_executor(backend, tmp_path)
+    try:
+        await executor.execute("x", executor_id="sess-a")
+        await executor.execute("x", executor_id="sess-b")
+        await executor.execute("x", executor_id="sess-a")
+        assert len(set(server.served_by)) == 2
+        assert server.served_by[0] == server.served_by[2]
+        assert executor._session_held.get(0) == 2
+    finally:
+        await executor.close()
+
+
+async def test_session_max_enforced(tmp_path):
+    backend = FakeBackend()
+    executor, server = make_executor(backend, tmp_path, executor_session_max=1)
+    try:
+        await executor.execute("x", executor_id="sess-a")
+        with pytest.raises(SessionLimitError, match="too many active sessions"):
+            await executor.execute("x", executor_id="sess-b")
+        # Closing frees the slot.
+        await executor.close_session("sess-a")
+        await executor.execute("x", executor_id="sess-b")
+    finally:
+        await executor.close()
+
+
+async def test_sessions_disabled_restores_reference_parity(tmp_path):
+    """With executor_session_max=0 the field is accepted and IGNORED — the
+    -fs reference's behavior. A client threading opaque per-request ids
+    under the old contract must not open one throwaway session per request
+    (or hit the cap) when the operator turns sessions off."""
+    backend = FakeBackend()
+    executor, server = make_executor(backend, tmp_path, executor_session_max=0)
+    try:
+        a = await executor.execute("x", executor_id="req-1")
+        b = await executor.execute("x", executor_id="req-2")
+        assert a.exit_code == b.exit_code == 0
+        assert a.session_seq == 0 and b.session_seq == 0  # stateless
+        assert not executor._sessions
+    finally:
+        await executor.close()
+
+
+async def test_invalid_executor_id_rejected(tmp_path):
+    backend = FakeBackend()
+    executor, server = make_executor(backend, tmp_path)
+    try:
+        with pytest.raises(ValueError, match="invalid executor_id"):
+            await executor.execute("x", executor_id="bad id with spaces")
+    finally:
+        await executor.close()
+
+
+async def test_session_chip_count_mismatch_rejected(tmp_path):
+    backend = FakeBackend()
+    executor, server = make_executor(backend, tmp_path)
+    try:
+        await executor.execute("x", executor_id="sess-a", chip_count=0)
+        with pytest.raises(ValueError, match="chip_count"):
+            await executor.execute("x", executor_id="sess-a", chip_count=4)
+        # Unspecified chip_count keeps using the session's lane.
+        await executor.execute("x", executor_id="sess-a")
+    finally:
+        await executor.close()
+
+
+async def test_session_infra_failure_closes_session(tmp_path):
+    backend = FakeBackend()
+    executor, server = make_executor(backend, tmp_path)
+    try:
+        await executor.execute("x", executor_id="sess-a")
+        first = server.served_by[-1]
+        server.fail_next = ExecutorError("sandbox gone")
+        with pytest.raises(ExecutorError):
+            await executor.execute("x", executor_id="sess-a")
+        await settle(executor)
+        assert "sess-a" not in executor._sessions
+        assert first not in backend.live  # disposed, not recycled
+        # A new request under the same id opens a fresh session.
+        await executor.execute("x", executor_id="sess-a")
+        assert server.served_by[-1] != first
+    finally:
+        await executor.close()
+
+
+async def test_session_runner_restart_closes_session(tmp_path):
+    backend = FakeBackend()
+    executor, server = make_executor(backend, tmp_path)
+    try:
+        await executor.execute("x", executor_id="sess-a")
+        first = server.served_by[-1]
+        # Timeout kill: the server reports the warm runner restarted — the
+        # session's in-process state is gone, so the session must end even
+        # though the request itself completed (exit -1, timeout semantics).
+        server.next_response = {"exit_code": -1, "runner_restarted": True}
+        result = await executor.execute("x", executor_id="sess-a")
+        assert result.exit_code == -1
+        assert result.session_ended is True  # client is told the state died
+        assert "sess-a" not in executor._sessions
+        await settle(executor)
+        await executor.execute("x", executor_id="sess-a")
+        assert server.served_by[-1] != first
+    finally:
+        await executor.close()
+
+
+async def test_stale_close_does_not_kill_successor_session(tmp_path):
+    """DELETE racing a runner-restart self-close: the DELETE parked on the
+    OLD session's lock must not tear down a successor session that was
+    created under the same id while it waited."""
+    backend = FakeBackend()
+    executor, server = make_executor(backend, tmp_path)
+    try:
+        await executor.execute("x", executor_id="sess-a")
+        old = executor._sessions["sess-a"]
+
+        async with old.lock:
+            # DELETE arrives and parks on old.lock.
+            closer = asyncio.create_task(executor.close_session("sess-a"))
+            await asyncio.sleep(0.01)
+            assert not closer.done()
+            # The in-flight request ends the session itself (the
+            # runner_restarted path runs under this same lock).
+            await executor._end_session("sess-a", old, recycle=False)
+        await settle(executor)
+
+        # A new request recreates the id before/while the DELETE resumes.
+        await executor.execute("x", executor_id="sess-a")
+        successor = executor._sessions["sess-a"]
+        assert successor is not old
+
+        assert await asyncio.wait_for(closer, timeout=5) is False
+        # The successor survived the stale DELETE.
+        assert executor._sessions.get("sess-a") is successor
+        assert not successor.closed
+        assert successor.sandbox.id in backend.live
+    finally:
+        await executor.close()
+
+
+async def test_session_idle_expiry(tmp_path):
+    backend = FakeBackend()
+    executor, server = make_executor(
+        backend, tmp_path, executor_session_idle_timeout=0.05
+    )
+    try:
+        await executor.execute("x", executor_id="sess-a")
+        assert await executor.sweep_sessions() == 0  # not idle yet... maybe
+        await asyncio.sleep(0.08)
+        assert await executor.sweep_sessions() == 1
+        assert "sess-a" not in executor._sessions
+        await settle(executor)
+        assert executor._session_held.get(0) == 0
+    finally:
+        await executor.close()
+
+
+async def test_concurrent_same_session_serializes_on_one_sandbox(tmp_path):
+    backend = FakeBackend()
+    executor, server = make_executor(backend, tmp_path)
+    try:
+        results = await asyncio.gather(
+            *(executor.execute("x", executor_id="sess-a") for _ in range(5))
+        )
+        assert all(r.exit_code == 0 for r in results)
+        # One session sandbox serves all five (one creation, no racing
+        # session spawns; the unconstrained lane may refill its stateless
+        # pool in the background, which is fine).
+        assert len(set(server.served_by)) == 1
+        assert len(executor._sessions) == 1
+    finally:
+        await executor.close()
+
+
+async def test_session_holds_capacity_slot(tmp_path):
+    """On a capacity-1 lane a session owns THE slot: the pool target drops
+    to zero (no refill fighting the session for the chip) and a stateless
+    spawn is gated until the session closes."""
+    backend = FakeBackend(capacity=1)
+    executor, server = make_executor(backend, tmp_path)
+    try:
+        await executor.execute("x", executor_id="sess-a")
+        await settle(executor)
+        assert executor._lane_target(0) == 0
+        assert sum(len(p) for p in executor._pools.values()) == 0
+        # A stateless request is blocked on the slot; closing the session
+        # releases it and the waiter proceeds.
+        stateless = asyncio.create_task(executor.execute("y"))
+        await asyncio.sleep(0.05)
+        assert not stateless.done()
+        await executor.close_session("sess-a")
+        result = await asyncio.wait_for(stateless, timeout=5)
+        assert result.exit_code == 0
+    finally:
+        await executor.close()
+
+
+async def test_session_gates_spawns_across_constrained_lanes(tmp_path):
+    """Constrained lanes share one physical substrate (the local backend's
+    exclusive TPU): a session parked in lane 0 must gate lane 4's spawns
+    too — per-lane counting would start a spawn that wedges behind the
+    chip for the session's whole lifetime."""
+    backend = FakeBackend(capacity=1)
+    executor, server = make_executor(backend, tmp_path)
+    try:
+        await executor.execute("x", executor_id="sess-a", chip_count=0)
+        await settle(executor)
+        assert executor._session_held_constrained() == 1
+        # The other lane sees no free capacity while the session lives...
+        assert executor._lane_target(4) == 0
+        other = asyncio.create_task(executor.execute("y", chip_count=4))
+        await asyncio.sleep(0.05)
+        assert not other.done()
+        # ...and proceeds once it closes.
+        await executor.close_session("sess-a")
+        result = await asyncio.wait_for(other, timeout=5)
+        assert result.exit_code == 0
+    finally:
+        await executor.close()
+
+
+async def test_stateless_requests_untouched_by_sessions(tmp_path):
+    backend = FakeBackend()
+    executor, server = make_executor(backend, tmp_path)
+    try:
+        await executor.execute("x", executor_id="sess-a")
+        session_sandbox = server.served_by[-1]
+        result = await executor.execute("y")
+        assert result.exit_code == 0
+        assert server.served_by[-1] != session_sandbox
+    finally:
+        await executor.close()
