@@ -22,6 +22,7 @@ backend-agnostic and TPU-aware:
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import time
 from collections import deque
@@ -401,16 +402,7 @@ class CodeExecutor:
         requests are never retried on infrastructure failure: a retry would
         land on a fresh sandbox and silently drop the session's state.
         """
-        if profile:
-            env = {**(env or {}), "APP_JAX_PROFILE": "1"}
-        if executor_id == "":
-            executor_id = None  # proto3 default / explicit "no session"
-        if executor_id is not None and self.config.executor_session_max <= 0:
-            # Reference-parity mode: the -fs reference carried executor_id
-            # but ignored it; clients threading opaque per-request ids under
-            # that contract keep working when the operator turns sessions
-            # off, instead of opening one throwaway session per request.
-            executor_id = None
+        env, executor_id = self._normalize_request(env, profile, executor_id)
         try:
             if executor_id is not None:
                 result = await self._execute_in_session(
@@ -439,15 +431,7 @@ class CodeExecutor:
         except (ExecutorError, SandboxSpawnError):
             self.metrics.executions.inc(outcome="infra_error")
             raise
-        self.metrics.executions.inc(
-            outcome="ok" if result.exit_code == 0 else "user_error"
-        )
-        if result.warm:
-            self.metrics.warm_hits.inc()
-        if executor_id is not None:
-            self.metrics.session_executions.inc()
-        for phase, seconds in result.phases.items():
-            self.metrics.phase_seconds.observe(seconds, phase=phase)
+        self._count_execution(result, session=executor_id is not None)
         return result
 
     @retry(
@@ -466,6 +450,26 @@ class CodeExecutor:
         env: dict[str, str] | None = None,
         chip_count: int | None = None,
     ) -> Result:
+        return await self._execute_once(
+            source_code,
+            source_file=source_file,
+            files=files,
+            timeout=timeout,
+            env=env,
+            chip_count=chip_count,
+        )
+
+    async def _execute_once(
+        self,
+        source_code: str | None = None,
+        *,
+        source_file: str | None = None,
+        files: dict[str, str] | None = None,
+        timeout: float | None = None,
+        env: dict[str, str] | None = None,
+        chip_count: int | None = None,
+        emit=None,
+    ) -> Result:
         lane, files, timeout = self._validate_request(
             source_code, source_file, files, timeout, chip_count
         )
@@ -476,7 +480,8 @@ class CodeExecutor:
         reusable = False
         try:
             result, _continuable = await self._run_on_sandbox(
-                sandbox, source_code, source_file, files, timeout, env, timer
+                sandbox, source_code, source_file, files, timeout, env, timer,
+                emit=emit,
             )
             # The request completed (user errors included). Whether the
             # sandbox is actually safe to recycle is the server's call —
@@ -525,12 +530,20 @@ class CodeExecutor:
         timeout: float,
         env: dict[str, str] | None,
         timer: PhaseTimer,
+        emit=None,
     ) -> tuple[Result, bool]:
         """The sandbox round-trip: upload inputs, fan /execute out to every
         host, download changed files. Returns (result, continuable) —
         continuable is False when a host's warm runner was killed (timeout)
         or crashed, i.e. any in-process state is gone and a session must not
-        keep using the sandbox."""
+        keep using the sandbox.
+
+        With `emit` (an async callback), host 0 runs via /execute/stream and
+        stdout/stderr chunks are emitted as the code produces them; the final
+        Result is identical either way (the stream's last event carries the
+        full response body). Peers of a multi-host slice never stream — host
+        0 is the coordinator and, per JAX convention, does the singular side
+        effects worth watching live."""
         client = self._http_client()
         # A multi-host slice is one sandbox with an executor per host:
         # inputs go to every host, /execute fires on every host (the
@@ -563,8 +576,12 @@ class CodeExecutor:
                 payload["source_file"] = source_file
             bodies = await asyncio.gather(
                 *(
-                    self._post_execute(client, base, payload, timeout, sandbox)
-                    for base in hosts
+                    self._post_execute_stream(
+                        client, base, payload, timeout, sandbox, emit
+                    )
+                    if emit is not None and index == 0
+                    else self._post_execute(client, base, payload, timeout, sandbox)
+                    for index, base in enumerate(hosts)
                 ),
                 # Let every host finish before surfacing a failure — a
                 # half-cancelled slice group would leak in-flight
@@ -618,6 +635,112 @@ class CodeExecutor:
         )
         return result, continuable
 
+    async def execute_stream(
+        self,
+        source_code: str | None = None,
+        *,
+        source_file: str | None = None,
+        files: dict[str, str] | None = None,
+        timeout: float | None = None,
+        env: dict[str, str] | None = None,
+        chip_count: int | None = None,
+        profile: bool = False,
+        executor_id: str | None = None,
+    ):
+        """Streaming variant of execute(): an async generator yielding
+        ``{"stream": "stdout"|"stderr", "data": str}`` events while the code
+        runs (host 0 of the sandbox), then one ``{"result": Result}`` event.
+
+        Infra failures are NOT retried — output already streamed to the
+        client cannot be un-streamed, so a silent retry would duplicate it;
+        the error surfaces and the client decides (same policy as sessions).
+        """
+        env, executor_id = self._normalize_request(env, profile, executor_id)
+        queue: asyncio.Queue = asyncio.Queue()
+        done = object()
+
+        async def emit(event: dict) -> None:
+            queue.put_nowait(event)
+
+        async def run() -> Result:
+            try:
+                if executor_id is not None:
+                    return await self._execute_in_session(
+                        executor_id,
+                        source_code,
+                        source_file=source_file,
+                        files=files,
+                        timeout=timeout,
+                        env=env,
+                        chip_count=chip_count,
+                        emit=emit,
+                    )
+                return await self._execute_once(
+                    source_code,
+                    source_file=source_file,
+                    files=files,
+                    timeout=timeout,
+                    env=env,
+                    chip_count=chip_count,
+                    emit=emit,
+                )
+            finally:
+                queue.put_nowait(done)
+
+        task = asyncio.create_task(run())
+        try:
+            while True:
+                event = await queue.get()
+                if event is done:
+                    break
+                yield event
+            try:
+                result = await task
+            except SessionLimitError:
+                self.metrics.executions.inc(outcome="rejected")
+                raise
+            except (ExecutorError, SandboxSpawnError):
+                self.metrics.executions.inc(outcome="infra_error")
+                raise
+        except BaseException:
+            task.cancel()
+            # The run task owns sandbox/session cleanup; let it finish it.
+            await asyncio.gather(task, return_exceptions=True)
+            raise
+        self._count_execution(result, session=executor_id is not None)
+        yield {"result": result}
+
+    def _normalize_request(
+        self,
+        env: dict[str, str] | None,
+        profile: bool,
+        executor_id: str | None,
+    ) -> tuple[dict[str, str] | None, str | None]:
+        """Request normalization shared by execute() and execute_stream():
+        profile flag → sandbox env; "" executor_id → stateless (proto3
+        default); sessions disabled → executor_id accepted and IGNORED
+        (reference-parity mode: the -fs reference carried the field but
+        ignored it, and clients threading opaque per-request ids under that
+        contract must not open one throwaway session per request)."""
+        if profile:
+            env = {**(env or {}), "APP_JAX_PROFILE": "1"}
+        if executor_id == "":
+            executor_id = None
+        if executor_id is not None and self.config.executor_session_max <= 0:
+            executor_id = None
+        return env, executor_id
+
+    def _count_execution(self, result: Result, *, session: bool) -> None:
+        self.metrics.executions.inc(
+            outcome="ok" if result.exit_code == 0 else "user_error"
+        )
+        if result.warm:
+            self.metrics.warm_hits.inc()
+        if session:
+            self.metrics.session_executions.inc()
+        for phase, seconds in result.phases.items():
+            self.metrics.phase_seconds.observe(seconds, phase=phase)
+
     # --------------------------------------------------------------- sessions
 
     async def _execute_in_session(
@@ -630,6 +753,7 @@ class CodeExecutor:
         timeout: float | None = None,
         env: dict[str, str] | None = None,
         chip_count: int | None = None,
+        emit=None,
     ) -> Result:
         """Run one request inside the executor_id's session sandbox.
 
@@ -670,6 +794,7 @@ class CodeExecutor:
                         timeout,
                         env,
                         timer,
+                        emit=emit,
                     )
                 except (ExecutorError, SandboxSpawnError):
                     # The sandbox is unreachable/broken: session state is
@@ -898,6 +1023,77 @@ class CodeExecutor:
         self._fill_tasks.add(task)  # cancelled/awaited by close()
         task.add_done_callback(self._fill_tasks.discard)
         return task
+
+    async def _post_execute_stream(
+        self,
+        client: httpx.AsyncClient,
+        base: str,
+        payload: dict,
+        timeout: float,
+        sandbox: Sandbox,
+        emit,
+    ) -> dict:
+        """POST /execute/stream: NDJSON events — {"stream","data"} chunks
+        passed to `emit` as they arrive, then a final object that is the
+        complete /execute response body (returned)."""
+        final: dict | None = None
+        try:
+            async with client.stream(
+                "POST",
+                f"{base}/execute/stream",
+                json=payload,
+                timeout=httpx.Timeout(timeout + 30.0, read=timeout + 30.0),
+            ) as resp:
+                if resp.status_code == 403:
+                    # Client path error (e.g. source_file escapes the
+                    # workspace) — same mapping as _post_execute, so the
+                    # streamed surface returns 400, not a 502 infra error.
+                    text = (await resp.aread()).decode(errors="replace")
+                    try:
+                        message = json.loads(text).get("error", "forbidden path")
+                    except ValueError:
+                        message = "forbidden path"
+                    raise ValueError(message)
+                if resp.status_code != 200:
+                    text = (await resp.aread()).decode(errors="replace")
+                    raise ExecutorError(
+                        f"sandbox {sandbox.id} ({base}) /execute/stream -> "
+                        f"{resp.status_code}: {text[:500]}"
+                    )
+                buffer = ""
+                async for text in resp.aiter_text():
+                    buffer += text
+                    while "\n" in buffer:
+                        line, buffer = buffer.split("\n", 1)
+                        if not line.strip():
+                            continue
+                        try:
+                            event = json.loads(line)
+                        except ValueError as e:
+                            raise ExecutorError(
+                                f"sandbox {sandbox.id} ({base}) sent a "
+                                f"malformed stream event: {e}"
+                            )
+                        if "stream" in event:
+                            await emit(
+                                {
+                                    "stream": event.get("stream", ""),
+                                    "data": event.get("data", ""),
+                                }
+                            )
+                        else:
+                            final = event
+        except httpx.HTTPError as e:
+            raise ExecutorError(f"sandbox {sandbox.id} ({base}) unreachable: {e}")
+        if final is None:
+            raise ExecutorError(
+                f"sandbox {sandbox.id} ({base}) stream ended without a result"
+            )
+        if "error" in final and "exit_code" not in final:
+            raise ExecutorError(
+                f"sandbox {sandbox.id} ({base}): {final['error']}"
+            )
+        return final
 
     async def _post_execute(
         self,

@@ -37,11 +37,12 @@ class CodeInterpreterServicer:
         self.code_executor = code_executor
         self.custom_tool_executor = custom_tool_executor
 
-    async def Execute(
-        self, request: pb2.ExecuteRequest, context: grpc.aio.ServicerContext
-    ) -> pb2.ExecuteResponse:
-        request_id = new_request_id()
-        logger.info("Execute [%s] chip_count=%d", request_id, request.chip_count)
+    @staticmethod
+    async def _validate_execute_request(
+        request: pb2.ExecuteRequest, context: grpc.aio.ServicerContext
+    ) -> tuple[bool, bool]:
+        """Shared Execute/ExecuteStream request validation; returns
+        (has_code, has_file) or aborts with INVALID_ARGUMENT."""
         has_code = bool(request.source_code)
         has_file = bool(request.source_file)
         if has_code == has_file:
@@ -63,6 +64,27 @@ class CodeInterpreterServicer:
                     grpc.StatusCode.INVALID_ARGUMENT,
                     f"invalid file object id for {path}",
                 )
+        return has_code, has_file
+
+    @staticmethod
+    def _result_to_response(result) -> pb2.ExecuteResponse:
+        response = pb2.ExecuteResponse(
+            stdout=result.stdout,
+            stderr=result.stderr,
+            exit_code=result.exit_code,
+            session_seq=result.session_seq,
+            session_ended=result.session_ended,
+        )
+        for path, object_id in result.files.items():
+            response.files[path] = object_id
+        return response
+
+    async def Execute(
+        self, request: pb2.ExecuteRequest, context: grpc.aio.ServicerContext
+    ) -> pb2.ExecuteResponse:
+        request_id = new_request_id()
+        logger.info("Execute [%s] chip_count=%d", request_id, request.chip_count)
+        has_code, has_file = await self._validate_execute_request(request, context)
         # executor_id pattern validation lives in the executor (its
         # ValueError maps to INVALID_ARGUMENT below, same as the HTTP path).
         try:
@@ -84,16 +106,48 @@ class CodeInterpreterServicer:
         except (ExecutorError, SandboxSpawnError) as e:
             logger.exception("Execute failed [%s]", request_id)
             await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
-        response = pb2.ExecuteResponse(
-            stdout=result.stdout,
-            stderr=result.stderr,
-            exit_code=result.exit_code,
-            session_seq=result.session_seq,
-            session_ended=result.session_ended,
+        return self._result_to_response(result)
+
+    async def ExecuteStream(
+        self, request: pb2.ExecuteRequest, context: grpc.aio.ServicerContext
+    ):
+        """Server-streaming Execute: OutputChunk events while the code runs,
+        then one `result` event (identical to Execute's response)."""
+        request_id = new_request_id()
+        logger.info(
+            "ExecuteStream [%s] chip_count=%d", request_id, request.chip_count
         )
-        for path, object_id in result.files.items():
-            response.files[path] = object_id
-        return response
+        has_code, has_file = await self._validate_execute_request(request, context)
+        events = self.code_executor.execute_stream(
+            request.source_code if has_code else None,
+            source_file=request.source_file if has_file else None,
+            files=dict(request.files),
+            timeout=request.timeout or None,
+            env=dict(request.env) or None,
+            chip_count=request.chip_count or None,
+            profile=request.profile,
+            executor_id=request.executor_id or None,
+        )
+        try:
+            async for event in events:
+                if "result" in event:
+                    yield pb2.ExecuteStreamEvent(
+                        result=self._result_to_response(event["result"])
+                    )
+                else:
+                    yield pb2.ExecuteStreamEvent(
+                        chunk=pb2.ExecuteStreamEvent.OutputChunk(
+                            stream=event.get("stream", ""),
+                            data=event.get("data", ""),
+                        )
+                    )
+        except ValueError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except SessionLimitError as e:
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        except (ExecutorError, SandboxSpawnError) as e:
+            logger.exception("ExecuteStream failed [%s]", request_id)
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
 
     async def CloseExecutor(
         self, request: pb2.CloseExecutorRequest, context: grpc.aio.ServicerContext
@@ -172,6 +226,11 @@ class CodeInterpreterServicer:
                 self.ExecuteCustomTool,
                 request_deserializer=pb2.ExecuteCustomToolRequest.FromString,
                 response_serializer=pb2.ExecuteCustomToolResponse.SerializeToString,
+            ),
+            "ExecuteStream": grpc.unary_stream_rpc_method_handler(
+                self.ExecuteStream,
+                request_deserializer=pb2.ExecuteRequest.FromString,
+                response_serializer=pb2.ExecuteStreamEvent.SerializeToString,
             ),
             "CloseExecutor": grpc.unary_unary_rpc_method_handler(
                 self.CloseExecutor,

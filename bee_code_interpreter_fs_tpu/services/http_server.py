@@ -101,14 +101,39 @@ def create_http_app(
             charset="utf-8",
         )
 
-    @routes.post("/v1/execute")
-    async def execute(request: web.Request) -> web.Response:
-        req = await parse_model(request, ExecuteRequest)
+    def validate_execute(req: ExecuteRequest) -> web.Response | None:
+        """Shared /v1/execute + /v1/execute/stream pre-flight checks."""
         if (req.source_code is None) == (req.source_file is None):
             return bad_request("exactly one of source_code/source_file is required")
         for path, object_id in req.files.items():
             if not OBJECT_ID_RE.match(object_id):
                 return bad_request(f"invalid file object id for {path}")
+        return None
+
+    def result_body(result, req: ExecuteRequest) -> dict:
+        """Execute response body, identical for both surfaces (the stream's
+        final event must never diverge from the non-streaming body)."""
+        body = {
+            "stdout": result.stdout,
+            "stderr": result.stderr,
+            "exit_code": result.exit_code,
+            "files": result.files,
+            "phases": result.phases,
+            "warm": result.warm,
+        }
+        if req.executor_id:
+            # Session continuity: seq==1 on a request the client expected to
+            # land in an existing session means prior state was lost (idle
+            # expiry); session_ended means THIS request killed the session.
+            body["session_seq"] = result.session_seq
+            body["session_ended"] = result.session_ended
+        return body
+
+    @routes.post("/v1/execute")
+    async def execute(request: web.Request) -> web.Response:
+        req = await parse_model(request, ExecuteRequest)
+        if (error := validate_execute(req)) is not None:
+            return error
         try:
             result = await code_executor.execute(
                 req.source_code,
@@ -128,21 +153,67 @@ def create_http_app(
         except (ExecutorError, SandboxSpawnError) as e:
             logger.exception("execute failed")
             return web.json_response({"error": str(e)}, status=502)
-        body = {
-            "stdout": result.stdout,
-            "stderr": result.stderr,
-            "exit_code": result.exit_code,
-            "files": result.files,
-            "phases": result.phases,
-            "warm": result.warm,
-        }
-        if req.executor_id:
-            # Session continuity: seq==1 on a request the client expected to
-            # land in an existing session means prior state was lost (idle
-            # expiry); session_ended means THIS request killed the session.
-            body["session_seq"] = result.session_seq
-            body["session_ended"] = result.session_ended
-        return web.json_response(body)
+        return web.json_response(result_body(result, req))
+
+    @routes.post("/v1/execute/stream")
+    async def execute_stream(request: web.Request) -> web.StreamResponse:
+        """Streaming Execute: chunked NDJSON — {"stream","data"} events while
+        the code runs, then a final object with the full execute response
+        body. Pre-flight errors use plain JSON statuses; a mid-stream
+        failure emits a final {"error": ...} line (headers are already
+        gone)."""
+        req = await parse_model(request, ExecuteRequest)
+        if (error := validate_execute(req)) is not None:
+            return error
+        events = code_executor.execute_stream(
+            req.source_code,
+            source_file=req.source_file,
+            files=req.files,
+            timeout=req.timeout,
+            env=req.env,
+            chip_count=req.chip_count,
+            profile=req.profile,
+            executor_id=req.executor_id,
+        )
+        response = web.StreamResponse(
+            status=200, headers={"Content-Type": "application/x-ndjson"}
+        )
+        # Chunked implicitly (no Content-Length); flush per event so clients
+        # see output with the code's own cadence.
+        started = False
+        try:
+            async for event in events:
+                if "result" in event:
+                    payload = result_body(event["result"], req)
+                else:
+                    payload = event
+                if not started:
+                    await response.prepare(request)
+                    started = True
+                await response.write(
+                    (json.dumps(payload) + "\n").encode("utf-8")
+                )
+        except ValueError as e:
+            if not started:
+                return bad_request(str(e))
+            await response.write(
+                (json.dumps({"error": str(e)}) + "\n").encode("utf-8")
+            )
+        except SessionLimitError as e:
+            if not started:
+                return web.json_response({"error": str(e)}, status=429)
+            await response.write(
+                (json.dumps({"error": str(e)}) + "\n").encode("utf-8")
+            )
+        except (ExecutorError, SandboxSpawnError) as e:
+            logger.exception("execute stream failed")
+            if not started:
+                return web.json_response({"error": str(e)}, status=502)
+            await response.write(
+                (json.dumps({"error": str(e)}) + "\n").encode("utf-8")
+            )
+        await response.write_eof()
+        return response
 
     @routes.delete("/v1/executors/{executor_id}")
     async def close_executor_session(request: web.Request) -> web.Response:

@@ -152,6 +152,28 @@ class Conn {
     write_all(body);
   }
 
+  // ---- chunked (streaming) responses ----
+  // begin_chunked + N× send_chunk + end_chunked emit one valid HTTP/1.1
+  // chunked response; used by /execute/stream to push stdout/stderr while
+  // user code is still running.
+  void begin_chunked(int status, const std::string& content_type) {
+    std::string head = "HTTP/1.1 " + std::to_string(status) + " " +
+                       reason(status) + "\r\nContent-Type: " + content_type +
+                       "\r\nTransfer-Encoding: chunked\r\n\r\n";
+    write_all(head);
+  }
+
+  void send_chunk(const std::string& data) {
+    if (data.empty()) return;  // an empty chunk would terminate the body
+    char size_hex[32];
+    snprintf(size_hex, sizeof(size_hex), "%zx\r\n", data.size());
+    write_all(size_hex);
+    write_all(data);
+    write_all("\r\n");
+  }
+
+  void end_chunked() { write_all("0\r\n\r\n"); }
+
   // Sends a file with sendfile(2); returns false if open/stat fails.
   bool send_file(const std::string& path) {
     int f = ::open(path.c_str(), O_RDONLY | O_NOFOLLOW);

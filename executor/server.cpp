@@ -755,7 +755,188 @@ void maybe_install_deps(const std::string& script_path) {
   run_subprocess(argv, "", "/dev/null", "/dev/null", 240.0, nullptr);
 }
 
-void handle_execute(const minihttp::Request& /*req*/, minihttp::Conn& conn) {
+// Follows one capture file during a streaming execute, emitting
+// {"stream":...,"data":...} NDJSON events for bytes appended since the last
+// pump. Capped at `limit` bytes per stream (the final result object carries
+// the truncation marker); the file may not exist yet on the first pumps.
+class StreamTail {
+ public:
+  StreamTail(std::string path, std::string name, size_t limit)
+      : path_(std::move(path)), name_(std::move(name)), limit_(limit) {}
+
+  void pump(minihttp::Conn& conn) {
+    if (sent_ >= limit_) return;
+    int fd = ::open(path_.c_str(), O_RDONLY);
+    if (fd < 0) return;  // not created yet
+    if (lseek(fd, static_cast<off_t>(offset_), SEEK_SET) < 0) {
+      ::close(fd);
+      return;
+    }
+    char buf[1 << 16];
+    std::string fresh;
+    ssize_t n;
+    while ((n = ::read(fd, buf, sizeof(buf))) > 0) {
+      fresh.append(buf, static_cast<size_t>(n));
+      if (offset_ + fresh.size() - sent_ > (1 << 20)) break;  // bounded batch
+    }
+    ::close(fd);
+    if (fresh.empty()) return;
+    // Never split a multi-byte UTF-8 character across two JSON events: the
+    // client decodes each event's string independently, and a split
+    // codepoint becomes U+FFFD on both sides. Hold incomplete trailing
+    // bytes for the next pump (the final result body reads the raw file,
+    // so nothing is ever lost to the hold-back).
+    size_t emit_len = utf8_complete_prefix(fresh);
+    if (emit_len == 0) return;
+    fresh.resize(emit_len);
+    offset_ += fresh.size();
+    if (sent_ + fresh.size() > limit_) {
+      fresh.resize(limit_ - sent_);
+      fresh.resize(utf8_complete_prefix(fresh));  // cap edge, same rule
+    }
+    sent_ += fresh.size();
+    if (fresh.empty()) return;
+    minijson::Object event;
+    event["stream"] = minijson::Value(name_);
+    event["data"] = minijson::Value(fresh);
+    conn.send_chunk(minijson::Value(event).dump() + "\n");
+  }
+
+  // Length of the longest prefix ending on a UTF-8 character boundary.
+  // Invalid sequences (binary output) are passed through whole rather than
+  // held forever: only a genuine incomplete multi-byte tail is trimmed.
+  static size_t utf8_complete_prefix(const std::string& s) {
+    if (s.empty()) return 0;
+    size_t i = s.size();
+    size_t back = 0;
+    while (i > 0 && back < 4) {
+      unsigned char c = static_cast<unsigned char>(s[i - 1]);
+      if (c < 0x80) return s.size();  // ASCII tail: everything complete
+      if ((c & 0xC0) == 0xC0) {
+        // Lead byte at i-1 with `back` continuation bytes after it.
+        size_t need = (c & 0xE0) == 0xC0   ? 1
+                      : (c & 0xF0) == 0xE0 ? 2
+                      : (c & 0xF8) == 0xF0 ? 3
+                                           : 0;  // invalid lead: pass through
+        if (need == 0 || need == back) return s.size();
+        return need > back ? i - 1 : s.size();
+      }
+      --i;  // continuation byte, keep scanning back
+      ++back;
+    }
+    return s.size();  // >=4 trailing continuation bytes: invalid, pass through
+  }
+
+ private:
+  std::string path_;
+  std::string name_;
+  size_t limit_;
+  size_t offset_ = 0;  // bytes consumed from the file
+  size_t sent_ = 0;    // bytes emitted to the client (<= limit_)
+};
+
+// Outcome of one user-code run (warm runner or cold subprocess).
+struct RunOutcome {
+  int exit_code = -1;
+  bool timed_out = false;
+  bool runner_died = false;
+  bool ran_warm = false;
+  bool restarted = false;  // warm runner kill/crash -> background rewarm
+  bool multi_host_refused = false;
+};
+
+// The execution core shared by /execute and /execute/stream: run the script
+// through the warm runner when available, else a cold subprocess; stdout/
+// stderr land in the given capture files (which is what makes streaming
+// possible — a tailer can follow them while this blocks).
+RunOutcome run_user_code(const std::string& script_path,
+                         const std::string& stdout_path,
+                         const std::string& stderr_path, double timeout_s,
+                         const minijson::Value& extra_env) {
+  RunOutcome out;
+  bool restart_runner = false;
+
+  if (g_state.warm_enabled && g_state.runner) {
+    // Initial warm-up may still be in flight (the control plane normally
+    // gates on /healthz warm before admitting a sandbox, but direct clients
+    // and eager-mode pods can race it). Racing a cold subprocess against the
+    // runner's TPU init would make both fight over the chip — wait it out.
+    // Bounded: the warm thread resolves within the runner's ready timeout.
+    // A RESTART in flight (g_ever_ready) is different: the previous request
+    // timed out, and the next one must not pay TPU re-init on its critical
+    // path — it falls through to the cold subprocess immediately.
+    {
+      std::unique_lock<std::mutex> wl(g_warm_transition_mutex);
+      g_warm_cv.wait(wl, [] {
+        return g_warm_state.load() != kWarmPending || g_ever_ready.load();
+      });
+    }
+    if (g_warm_state.load() == kWarmReady) {
+      std::lock_guard<std::mutex> rlock(g_state.runner_mutex);
+      if (g_state.runner->alive()) {
+        minijson::Object reqo;
+        reqo["source_path"] = minijson::Value(script_path);
+        reqo["stdout_path"] = minijson::Value(stdout_path);
+        reqo["stderr_path"] = minijson::Value(stderr_path);
+        if (extra_env.is_object()) reqo["env"] = extra_env;
+        minijson::Value resp;
+        WarmRunner::ExecResult r = g_state.runner->execute(
+            minijson::Value(reqo).dump(), timeout_s > 0 ? timeout_s + 0.5 : 0,
+            resp);
+        out.ran_warm = true;
+        switch (r) {
+          case WarmRunner::ExecResult::kOk:
+            out.exit_code = static_cast<int>(resp.get_number("exit_code", -1));
+            break;
+          case WarmRunner::ExecResult::kTimeout:
+            out.timed_out = true;
+            restart_runner = true;
+            break;
+          case WarmRunner::ExecResult::kDied:
+            out.runner_died = true;
+            restart_runner = true;
+            break;
+        }
+      } else {
+        // Runner found already dead at request time (e.g. OOM-killed
+        // between requests): without flagging a restart here, the sandbox
+        // would serve every subsequent request cold forever (sessions
+        // never hit /reset, where dead-runner recovery otherwise lives)
+        // and runner_restarted=false would hide the in-process state loss
+        // from the control plane's session tracking. The request itself
+        // still runs via the cold path below — no stderr pollution.
+        restart_runner = true;
+      }
+    }
+    if (restart_runner) {
+      // Off the critical path: restart in the background; this response (and
+      // any request landing before the restart finishes) is served cold.
+      g_warm_state = kWarmFailed;
+      start_warm_async();
+    }
+  }
+  out.restarted = restart_runner;
+
+  if (!out.ran_warm) {
+    if (g_state.num_hosts > 1) {
+      // A multi-host slice only exists through the warm runner's
+      // jax.distributed mesh; a cold subprocess here would run user code
+      // with a silently missing mesh — fail loudly instead.
+      out.multi_host_refused = true;
+      return out;
+    }
+    // launch.py wraps runpy with the same shell-syntax fallback the warm
+    // runner applies (mixed Python/shell snippets — the xonsh role).
+    ExecOutcome cold = run_subprocess(
+        {g_state.python, g_state.launch_script, script_path}, g_state.workspace,
+        stdout_path, stderr_path, timeout_s, &extra_env);
+    out.exit_code = cold.exit_code;
+    out.timed_out = cold.timed_out;
+  }
+  return out;
+}
+
+void handle_execute_impl(minihttp::Conn& conn, bool streaming) {
   std::string body = conn.read_body();
   minijson::Value parsed;
   try {
@@ -824,92 +1005,95 @@ void handle_execute(const minihttp::Request& /*req*/, minihttp::Conn& conn) {
   struct timespec t0, t1;
   clock_gettime(CLOCK_MONOTONIC, &t0);
 
-  int exit_code = -1;
-  bool timed_out = false;
-  bool runner_died = false;
-  bool ran_warm = false;
-  bool restart_runner = false;
-
-  if (g_state.warm_enabled && g_state.runner) {
-    // Initial warm-up may still be in flight (the control plane normally
-    // gates on /healthz warm before admitting a sandbox, but direct clients
-    // and eager-mode pods can race it). Racing a cold subprocess against the
-    // runner's TPU init would make both fight over the chip — wait it out.
-    // Bounded: the warm thread resolves within the runner's ready timeout.
-    // A RESTART in flight (g_ever_ready) is different: the previous request
-    // timed out, and the next one must not pay TPU re-init on its critical
-    // path — it falls through to the cold subprocess immediately.
-    {
-      std::unique_lock<std::mutex> wl(g_warm_transition_mutex);
-      g_warm_cv.wait(wl, [] {
-        return g_warm_state.load() != kWarmPending || g_ever_ready.load();
-      });
+  RunOutcome run;
+  if (!streaming) {
+    run = run_user_code(script_path, stdout_path, stderr_path, timeout_s,
+                        extra_env);
+  } else {
+    // Streaming mode: the run blocks in a worker thread while this thread
+    // tails the capture files and pushes NDJSON events over a chunked
+    // response. Events: {"stream":"stdout"|"stderr","data":...} chunks,
+    // then one final result object (same fields as /execute's body).
+    try {
+      conn.begin_chunked(200, "application/x-ndjson");
+    } catch (const std::exception&) {
+      // Client vanished before headers: nothing to stream to. Clean the
+      // scratch (submitted source may contain secrets) instead of letting
+      // the throw unwind past it, then drop the connection.
+      if (source_code.empty()) script_path.clear();  // workspace file: keep
+      drop_scratch();
+      throw;
     }
-    if (g_warm_state.load() == kWarmReady) {
-      std::lock_guard<std::mutex> rlock(g_state.runner_mutex);
-      if (g_state.runner->alive()) {
-        minijson::Object reqo;
-        reqo["source_path"] = minijson::Value(script_path);
-        reqo["stdout_path"] = minijson::Value(stdout_path);
-        reqo["stderr_path"] = minijson::Value(stderr_path);
-        if (extra_env.is_object()) reqo["env"] = extra_env;
-        minijson::Value resp;
-        WarmRunner::ExecResult r = g_state.runner->execute(
-            minijson::Value(reqo).dump(), timeout_s > 0 ? timeout_s + 0.5 : 0, resp);
-        ran_warm = true;
-        switch (r) {
-          case WarmRunner::ExecResult::kOk:
-            exit_code = static_cast<int>(resp.get_number("exit_code", -1));
-            break;
-          case WarmRunner::ExecResult::kTimeout:
-            timed_out = true;
-            restart_runner = true;
-            break;
-          case WarmRunner::ExecResult::kDied:
-            runner_died = true;
-            restart_runner = true;
-            break;
-        }
-      } else {
-        // Runner found already dead at request time (e.g. OOM-killed
-        // between requests): without flagging a restart here, the sandbox
-        // would serve every subsequent request cold forever (sessions
-        // never hit /reset, where dead-runner recovery otherwise lives)
-        // and runner_restarted=false would hide the in-process state loss
-        // from the control plane's session tracking. The request itself
-        // still runs via the cold path below — no stderr pollution.
-        restart_runner = true;
+    std::atomic<bool> run_done{false};
+    std::thread worker([&] {
+      // A throw escaping a std::thread calls std::terminate — which would
+      // take down the whole sandbox server (warm runner, sessions) for one
+      // failed request. Degrade to a failed-run outcome instead, matching
+      // the one-connection blast radius of the non-streaming path.
+      try {
+        run = run_user_code(script_path, stdout_path, stderr_path, timeout_s,
+                            extra_env);
+      } catch (const std::exception& e) {
+        log_msg("streamed run_user_code threw: %s", e.what());
+        run = RunOutcome{};  // exit_code -1, nothing ran warm
+      }
+      run_done.store(true);
+    });
+    StreamTail tail_out(stdout_path, "stdout", g_state.max_output);
+    StreamTail tail_err(stderr_path, "stderr", g_state.max_output);
+    bool client_gone = false;
+    while (!run_done.load()) {
+      struct timespec ts = {0, 75 * 1000 * 1000};  // 75 ms poll
+      nanosleep(&ts, nullptr);
+      if (client_gone) continue;  // keep draining the run; stop sending
+      try {
+        tail_out.pump(conn);
+        tail_err.pump(conn);
+      } catch (const std::exception&) {
+        // Client went away mid-stream: the run must still complete (the
+        // runner protocol would desync if we abandoned it mid-request).
+        client_gone = true;
       }
     }
-    if (restart_runner) {
-      // Off the critical path: restart in the background; this response (and
-      // any request landing before the restart finishes) is served cold.
-      g_warm_state = kWarmFailed;
-      start_warm_async();
+    worker.join();
+    if (!client_gone) {
+      try {
+        tail_out.pump(conn);
+        tail_err.pump(conn);
+      } catch (const std::exception&) {
+        client_gone = true;
+      }
     }
+    // client_gone: the epilogue still runs (scratch cleanup, runner state);
+    // sending the final event will just fail silently in its try/catch.
   }
 
-  if (!ran_warm) {
-    if (g_state.num_hosts > 1) {
-      // (cold path below is single-host only)
-      // A multi-host slice only exists through the warm runner's
-      // jax.distributed mesh; a cold subprocess here would run user code
-      // with a silently missing mesh — fail loudly instead.
-      if (source_code.empty()) script_path.clear();  // workspace file: keep it
-      drop_scratch();
+  if (run.multi_host_refused) {
+    // A multi-host slice only exists through the warm runner's
+    // jax.distributed mesh; a cold subprocess here would run user code
+    // with a silently missing mesh — fail loudly instead.
+    if (source_code.empty()) script_path.clear();  // workspace file: keep it
+    drop_scratch();
+    if (!streaming) {
       conn.send_response(500, "application/json",
                          "{\"error\":\"warm runner unavailable on a multi-host "
                          "slice; cannot execute\"}");
-      return;
+    } else {
+      try {
+        conn.send_chunk(
+            "{\"error\":\"warm runner unavailable on a multi-host slice; "
+            "cannot execute\"}\n");
+        conn.end_chunked();
+      } catch (const std::exception&) {
+      }
     }
-    // launch.py wraps runpy with the same shell-syntax fallback the warm
-    // runner applies (mixed Python/shell snippets — the xonsh role).
-    ExecOutcome out = run_subprocess(
-        {g_state.python, g_state.launch_script, script_path}, g_state.workspace,
-        stdout_path, stderr_path, timeout_s, &extra_env);
-    exit_code = out.exit_code;
-    timed_out = out.timed_out;
+    return;
   }
+  int exit_code = run.exit_code;
+  bool timed_out = run.timed_out;
+  bool runner_died = run.runner_died;
+  bool ran_warm = run.ran_warm;
+  bool restart_runner = run.restarted;
 
   clock_gettime(CLOCK_MONOTONIC, &t1);
   double duration =
@@ -952,7 +1136,27 @@ void handle_execute(const minihttp::Request& /*req*/, minihttp::Conn& conn) {
   // control plane uses this to end executor_id sessions, whose contract is
   // that the process persists across requests.
   resp["runner_restarted"] = minijson::Value(restart_runner);
-  conn.send_response(200, "application/json", minijson::Value(resp).dump());
+  if (!streaming) {
+    conn.send_response(200, "application/json", minijson::Value(resp).dump());
+  } else {
+    // Final event: the complete /execute response body (chunks were purely
+    // additive), so a streaming client needs no second code path to build
+    // the result. A vanished client just misses it.
+    try {
+      conn.send_chunk(minijson::Value(resp).dump() + "\n");
+      conn.end_chunked();
+    } catch (const std::exception&) {
+    }
+  }
+}
+
+void handle_execute(const minihttp::Request& /*req*/, minihttp::Conn& conn) {
+  handle_execute_impl(conn, /*streaming=*/false);
+}
+
+void handle_execute_stream(const minihttp::Request& /*req*/,
+                           minihttp::Conn& conn) {
+  handle_execute_impl(conn, /*streaming=*/true);
 }
 
 minijson::Value warm_status_body() {
@@ -1048,6 +1252,8 @@ void handle_reset(const minihttp::Request&, minihttp::Conn& conn) {
 void route(const minihttp::Request& req, minihttp::Conn& conn) {
   if (req.method == "POST" && req.target == "/execute") {
     handle_execute(req, conn);
+  } else if (req.method == "POST" && req.target == "/execute/stream") {
+    handle_execute_stream(req, conn);
   } else if (req.method == "POST" && req.target == "/warmup") {
     handle_warmup(req, conn);
   } else if (req.method == "POST" && req.target == "/reset") {

@@ -47,6 +47,11 @@ class Client:
         self.close_executor = u(
             "CloseExecutor", pb2.CloseExecutorRequest, pb2.CloseExecutorResponse
         )
+        self.execute_stream = channel.unary_stream(
+            f"/{SERVICE_NAME}/ExecuteStream",
+            request_serializer=pb2.ExecuteRequest.SerializeToString,
+            response_deserializer=pb2.ExecuteStreamEvent.FromString,
+        )
         self.health_check = u(
             "Check",
             health_pb2.HealthCheckRequest,
@@ -151,6 +156,40 @@ async def test_execute_session_affinity(client):
         await client.execute(
             pb2.ExecuteRequest(source_code="x", executor_id="bad id")
         )
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+async def test_execute_stream(client):
+    """Server-streaming Execute: chunk events while the code runs, then one
+    result event identical to Execute's response shape."""
+    src = (
+        "import time\n"
+        "for i in range(3):\n"
+        "    print('s', i, flush=True)\n"
+        "    time.sleep(0.3)\n"
+    )
+    chunks, results = [], []
+    async for event in client.execute_stream(
+        pb2.ExecuteRequest(source_code=src)
+    ):
+        kind = event.WhichOneof("event")
+        if kind == "chunk":
+            chunks.append(event.chunk)
+        else:
+            results.append(event.result)
+    assert len(results) == 1
+    result = results[0]
+    assert result.exit_code == 0
+    assert result.stdout == "s 0\ns 1\ns 2\n"
+    assert chunks, "no chunk events"
+    assert "".join(
+        c.data for c in chunks if c.stream == "stdout"
+    ) == result.stdout
+
+    # Validation aborts before the stream starts.
+    with pytest.raises(grpc.aio.AioRpcError) as e:
+        async for _ in client.execute_stream(pb2.ExecuteRequest()):
+            pass
     assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
 
 

@@ -246,6 +246,84 @@ async def test_concurrent_executes(client):
     assert results == [f"{i * 10}\n" for i in range(4)]
 
 
+async def test_execute_stream_over_http(client):
+    """POST /v1/execute/stream through the whole stack: NDJSON chunks while
+    the code runs, then the full execute response as the final line."""
+    import time as _time
+
+    src = (
+        "import time\n"
+        "for i in range(3):\n"
+        "    print('beat', i, flush=True)\n"
+        "    time.sleep(0.4)\n"
+    )
+    t0 = _time.monotonic()
+    events = []
+    resp = await client.post("/v1/execute/stream", json={"source_code": src})
+    assert resp.status == 200
+    assert resp.headers["Content-Type"].startswith("application/x-ndjson")
+    buf = ""
+    async for chunk, _ in resp.content.iter_chunks():
+        buf += chunk.decode()
+        while "\n" in buf:
+            line, buf = buf.split("\n", 1)
+            if line.strip():
+                events.append((_time.monotonic() - t0, json.loads(line)))
+    chunks = [e for _, e in events if "stream" in e]
+    assert chunks, "no chunks streamed"
+    assert events[0][0] < 1.0, f"first chunk too late: {events[0][0]:.2f}s"
+    final = events[-1][1]
+    assert final["exit_code"] == 0
+    assert final["stdout"] == "beat 0\nbeat 1\nbeat 2\n"
+    assert "".join(
+        c["data"] for c in chunks if c["stream"] == "stdout"
+    ) == final["stdout"]
+
+    # Pre-flight validation still uses plain statuses.
+    resp = await client.post("/v1/execute/stream", json={})
+    assert resp.status == 400
+    # A workspace-escaping source_file is a client error (the sandbox's 403
+    # maps to 400 on the streamed surface too, not a 502 infra error).
+    resp = await client.post(
+        "/v1/execute/stream",
+        json={"source_file": "/workspace/../../etc/passwd"},
+    )
+    assert resp.status == 400
+
+
+async def test_execute_stream_in_session(client):
+    """Streaming composes with executor_id sessions: chunks stream AND the
+    workspace persists to the next (non-streamed) request."""
+    resp = await client.post(
+        "/v1/execute/stream",
+        json={
+            "source_code": "print('streamed'); open('s2.txt','w').write('x')",
+            "executor_id": "stream-sess",
+        },
+    )
+    assert resp.status == 200
+    lines = [
+        json.loads(l)
+        for l in (await resp.text()).splitlines()
+        if l.strip()
+    ]
+    final = lines[-1]
+    assert final["exit_code"] == 0
+    assert final["session_seq"] == 1
+    resp = await client.post(
+        "/v1/execute",
+        json={
+            "source_code": "print(open('s2.txt').read())",
+            "executor_id": "stream-sess",
+        },
+    )
+    body = await resp.json()
+    assert body["exit_code"] == 0, body["stderr"]
+    assert body["stdout"] == "x\n"
+    assert body["session_seq"] == 2
+    await client.delete("/v1/executors/stream-sess")
+
+
 async def test_session_over_http(client):
     """executor_id session: workspace persists across Executes with no file
     round-trip; DELETE /v1/executors/{id} ends it."""

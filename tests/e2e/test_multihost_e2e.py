@@ -101,6 +101,32 @@ async def test_group_recycled_across_generations(tmp_path):
         await executor.close()
 
 
+async def test_fanout_streaming_host0(mechanics_executor):
+    """Streaming on a multi-host sandbox: host 0 streams its chunks live,
+    peers run normally, and the merged final result (host-0 stdout, all
+    hosts' files) matches the non-streamed fan-out semantics."""
+    executor = mechanics_executor
+    chunks = []
+    final = None
+    async for event in executor.execute_stream(
+        "import os\n"
+        "print('from host', os.environ.get('APP_HOST_ID'), flush=True)\n"
+        "open(f\"peer{os.environ.get('APP_HOST_ID')}.txt\", 'w').write('x')\n",
+        chip_count=2,
+    ):
+        if "result" in event:
+            final = event["result"]
+        else:
+            chunks.append(event)
+    assert final is not None
+    assert final.exit_code == 0, final.stderr
+    assert final.stdout == "from host 0\n"  # host 0 is the streamed host
+    joined = "".join(c["data"] for c in chunks if c["stream"] == "stdout")
+    assert joined == "from host 0\n"
+    # Peers' side effects still captured even though only host 0 streamed.
+    assert set(final.files) >= {"/workspace/peer0.txt", "/workspace/peer1.txt"}
+
+
 async def test_fanout_peer_failure_fails_execute(mechanics_executor):
     result = await mechanics_executor.execute(
         "import os, sys\n"

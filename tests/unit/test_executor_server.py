@@ -186,6 +186,105 @@ def test_execute_timeout_and_recovery(executor):
     assert result["warm"] is True
 
 
+def test_execute_stream_chunks_arrive_live(executor):
+    """POST /execute/stream: NDJSON chunks must arrive while the code is
+    still running (not buffered until completion), and the final event must
+    be the complete /execute response body."""
+    client, _ = executor
+    src = (
+        "import time\n"
+        "for i in range(4):\n"
+        "    print('tick', i, flush=True)\n"
+        "    time.sleep(0.3)\n"
+        "open('streamed.txt', 'w').write('done')\n"
+    )
+    events = []
+    t0 = time.monotonic()
+    with client.stream(
+        "POST", "/execute/stream", json={"source_code": src}
+    ) as resp:
+        assert resp.status_code == 200
+        buf = ""
+        for text in resp.iter_text():
+            buf += text
+            while "\n" in buf:
+                line, buf = buf.split("\n", 1)
+                if line.strip():
+                    events.append((time.monotonic() - t0, json.loads(line)))
+    chunks = [e for _, e in events if "stream" in e]
+    assert chunks, "no stream chunks arrived"
+    # First chunk must beat the full runtime (~1.2 s) by a wide margin.
+    assert events[0][0] < 0.9, f"first chunk too late: {events[0][0]:.2f}s"
+    final = events[-1][1]
+    assert final["exit_code"] == 0
+    assert final["stdout"] == "tick 0\ntick 1\ntick 2\ntick 3\n"
+    assert "streamed.txt" in final["files"]
+    assert final["runner_restarted"] is False
+    joined = "".join(c["data"] for c in chunks if c["stream"] == "stdout")
+    assert joined == final["stdout"]
+
+
+def test_execute_stream_utf8_never_split(executor):
+    """Multi-byte UTF-8 output streamed in many flushes must decode cleanly
+    per event: a chunk boundary through a codepoint would turn both halves
+    into U+FFFD. Joined chunks must equal the final stdout exactly."""
+    client, _ = executor
+    src = (
+        "import sys, time\n"
+        "for i in range(40):\n"
+        "    sys.stdout.write('\\u6f22\\u5b57\\U0001f600' * 50)\n"
+        "    sys.stdout.flush()\n"
+        "    time.sleep(0.02)\n"
+    )
+    events = []
+    with client.stream(
+        "POST", "/execute/stream", json={"source_code": src}
+    ) as resp:
+        buf = ""
+        for text in resp.iter_text():
+            buf += text
+            while "\n" in buf:
+                line, buf = buf.split("\n", 1)
+                if line.strip():
+                    events.append(json.loads(line))
+    chunks = [e for e in events if e.get("stream") == "stdout"]
+    final = events[-1]
+    assert final["exit_code"] == 0
+    joined = "".join(c["data"] for c in chunks)
+    assert "�" not in joined
+    assert joined == final["stdout"]
+
+
+def test_execute_stream_timeout(executor):
+    """Timeout during a streamed execute: the final event carries the same
+    timeout semantics as /execute (exit -1, marker in stderr)."""
+    client, _ = executor
+    events = []
+    with client.stream(
+        "POST",
+        "/execute/stream",
+        json={"source_code": "import time\ntime.sleep(30)", "timeout": 1},
+    ) as resp:
+        buf = ""
+        for text in resp.iter_text():
+            buf += text
+            while "\n" in buf:
+                line, buf = buf.split("\n", 1)
+                if line.strip():
+                    events.append(json.loads(line))
+    final = events[-1]
+    assert final["exit_code"] == -1
+    assert "timed out" in final["stderr"]
+    assert final["runner_restarted"] is True
+    # Warm service recovers in the background (same as /execute).
+    for _ in range(100):
+        if client.get("/healthz").json().get("warm"):
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail("runner did not restart after streamed timeout")
+
+
 def test_execute_mixed_shell_python(executor):
     """Mixed Python/shell snippets (the xonsh role, reference server.rs:
     197-207) execute through the warm runner via the shellfb transform."""
