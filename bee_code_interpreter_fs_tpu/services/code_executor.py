@@ -950,6 +950,26 @@ class CodeExecutor:
         self._dispose_tasks.add(task)
         task.add_done_callback(self._dispose_tasks.discard)
 
+    def list_sessions(self) -> list[dict]:
+        """Live sessions for GET /v1/executors: id, lane, idle seconds,
+        whether a request is in flight, and requests served. Sessions still
+        spawning their sandbox are included (status "spawning") — they count
+        toward executor_session_max, so hiding them would make the list
+        contradict the cap's own error message."""
+        now = asyncio.get_running_loop().time()
+        return [
+            {
+                "executor_id": executor_id,
+                "chip_count": session.lane,
+                "idle_s": round(max(0.0, now - session.last_used), 3),
+                "busy": session.lock.locked(),
+                "requests": session.seq,
+                "status": "ready" if session.sandbox is not None else "spawning",
+            }
+            for executor_id, session in self._sessions.items()
+            if not session.closed
+        ]
+
     async def close_session(self, executor_id: str) -> bool:
         """Explicitly end a session (DELETE /v1/executors/{id}). Waits for an
         in-flight request on the session to finish first. Returns False if no

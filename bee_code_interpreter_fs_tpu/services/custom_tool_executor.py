@@ -32,9 +32,14 @@ class CustomToolParseError(ValueError):
 
 
 class CustomToolExecuteError(RuntimeError):
-    def __init__(self, stderr: str) -> None:
+    def __init__(self, stderr: str, result=None) -> None:
         super().__init__(stderr)
         self.stderr = stderr
+        # The underlying execution Result (when one exists): session callers
+        # need its session_seq/session_ended even on failure — a timeout
+        # that killed the session must not be invisible just because the
+        # tool also failed.
+        self.result = result
 
 
 @dataclass
@@ -248,20 +253,29 @@ class CustomToolExecutor:
             name=fn.name, description=description, input_schema=input_schema
         )
 
-    async def execute(
+    async def execute_with_result(
         self, tool_source_code: str, tool_input: dict, **execute_kwargs
-    ) -> object:
+    ) -> tuple[object, object]:
+        """Run the tool; returns (parsed JSON output, execution Result).
+
+        The Result travels with the output (and rides CustomToolExecuteError
+        on failure) because session callers need its session_seq/
+        session_ended continuity fields — a silently-reset session must be
+        detectable on the tool surface too, not just on /v1/execute. There
+        is deliberately no output-only variant: discarding the Result is the
+        exact bug class those fields exist to prevent."""
         imports, fn = _split_tool_source(tool_source_code)
         script = self._build_wrapper(tool_source_code, imports, fn.name, tool_input)
         result = await self.code_executor.execute(source_code=script, **execute_kwargs)
         if result.exit_code != 0:
-            raise CustomToolExecuteError(result.stderr)
+            raise CustomToolExecuteError(result.stderr, result=result)
         last_line = result.stdout.strip().splitlines()[-1] if result.stdout.strip() else "null"
         try:
-            return json.loads(last_line)
+            return json.loads(last_line), result
         except json.JSONDecodeError:
             raise CustomToolExecuteError(
-                f"tool did not produce JSON output: {result.stdout[-500:]!r}"
+                f"tool did not produce JSON output: {result.stdout[-500:]!r}",
+                result=result,
             )
 
     @staticmethod

@@ -183,6 +183,10 @@ class CodeInterpreterServicer:
         self, request: pb2.ExecuteCustomToolRequest, context: grpc.aio.ServicerContext
     ) -> pb2.ExecuteCustomToolResponse:
         request_id = new_request_id()
+        if request.timeout < 0:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "timeout must be >= 0"
+            )
         try:
             tool_input = json.loads(request.tool_input_json)
         except json.JSONDecodeError:
@@ -190,23 +194,37 @@ class CodeInterpreterServicer:
                 grpc.StatusCode.INVALID_ARGUMENT, "tool_input_json is not valid JSON"
             )
         try:
-            output = await self.custom_tool_executor.execute(
-                request.tool_source_code, tool_input
+            output, exec_result = await self.custom_tool_executor.execute_with_result(
+                request.tool_source_code,
+                tool_input,
+                executor_id=request.executor_id or None,
+                timeout=request.timeout or None,
             )
         except CustomToolParseError as e:
             return pb2.ExecuteCustomToolResponse(
                 error=pb2.ExecuteCustomToolResponse.Error(stderr="\n".join(e.errors))
             )
         except CustomToolExecuteError as e:
+            # Continuity on failure too (see proto Error comment).
             return pb2.ExecuteCustomToolResponse(
-                error=pb2.ExecuteCustomToolResponse.Error(stderr=e.stderr)
+                error=pb2.ExecuteCustomToolResponse.Error(
+                    stderr=e.stderr,
+                    session_seq=e.result.session_seq if e.result else 0,
+                    session_ended=e.result.session_ended if e.result else False,
+                )
             )
+        except ValueError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except SessionLimitError as e:
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except (ExecutorError, SandboxSpawnError) as e:
             logger.exception("ExecuteCustomTool failed [%s]", request_id)
             await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         return pb2.ExecuteCustomToolResponse(
             success=pb2.ExecuteCustomToolResponse.Success(
-                tool_output_json=json.dumps(output)
+                tool_output_json=json.dumps(output),
+                session_seq=exec_result.session_seq,
+                session_ended=exec_result.session_ended,
             )
         )
 

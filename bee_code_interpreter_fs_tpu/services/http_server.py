@@ -56,6 +56,10 @@ class ParseCustomToolRequest(BaseModel):
 class ExecuteCustomToolRequest(BaseModel):
     tool_source_code: str
     tool_input_json: str
+    # Same session semantics as ExecuteRequest.executor_id: tool calls
+    # sharing an id see each other's workspace files.
+    executor_id: str | None = None
+    timeout: float | None = Field(default=None, gt=0)
 
 
 @web.middleware
@@ -110,6 +114,16 @@ def create_http_app(
                 return bad_request(f"invalid file object id for {path}")
         return None
 
+    def add_session_fields(body: dict, result, executor_id: str | None) -> dict:
+        """Session continuity, one rule for every surface: seq==1 on a
+        request the client expected to land in an existing session means
+        prior state was lost (idle expiry); session_ended means THIS request
+        killed the session."""
+        if executor_id and result is not None:
+            body["session_seq"] = result.session_seq
+            body["session_ended"] = result.session_ended
+        return body
+
     def result_body(result, req: ExecuteRequest) -> dict:
         """Execute response body, identical for both surfaces (the stream's
         final event must never diverge from the non-streaming body)."""
@@ -121,13 +135,7 @@ def create_http_app(
             "phases": result.phases,
             "warm": result.warm,
         }
-        if req.executor_id:
-            # Session continuity: seq==1 on a request the client expected to
-            # land in an existing session means prior state was lost (idle
-            # expiry); session_ended means THIS request killed the session.
-            body["session_seq"] = result.session_seq
-            body["session_ended"] = result.session_ended
-        return body
+        return add_session_fields(body, result, req.executor_id)
 
     @routes.post("/v1/execute")
     async def execute(request: web.Request) -> web.Response:
@@ -215,6 +223,12 @@ def create_http_app(
         await response.write_eof()
         return response
 
+    @routes.get("/v1/executors")
+    async def list_executor_sessions(request: web.Request) -> web.Response:
+        """Live executor_id sessions: id, chip lane, idle seconds, busy flag,
+        requests served — the operator's view of what is parking sandboxes."""
+        return web.json_response({"sessions": code_executor.list_sessions()})
+
     @routes.delete("/v1/executors/{executor_id}")
     async def close_executor_session(request: web.Request) -> web.Response:
         """End an executor_id session: waits out an in-flight request, then
@@ -250,15 +264,33 @@ def create_http_app(
         except json.JSONDecodeError:
             return bad_request("tool_input_json is not valid JSON")
         try:
-            output = await custom_tool_executor.execute(req.tool_source_code, tool_input)
+            output, exec_result = await custom_tool_executor.execute_with_result(
+                req.tool_source_code,
+                tool_input,
+                executor_id=req.executor_id,
+                timeout=req.timeout,
+            )
         except CustomToolParseError as e:
             return web.json_response({"error_messages": e.errors}, status=400)
         except CustomToolExecuteError as e:
-            return web.json_response({"stderr": e.stderr}, status=400)
+            # Continuity on failure too: a timeout that killed the session
+            # must be visible even though the tool call itself failed.
+            return web.json_response(
+                add_session_fields({"stderr": e.stderr}, e.result, req.executor_id),
+                status=400,
+            )
+        except ValueError as e:
+            return bad_request(str(e))
+        except SessionLimitError as e:
+            return web.json_response({"error": str(e)}, status=429)
         except (ExecutorError, SandboxSpawnError) as e:
             logger.exception("custom tool execute failed")
             return web.json_response({"error": str(e)}, status=502)
-        return web.json_response({"tool_output_json": json.dumps(output)})
+        return web.json_response(
+            add_session_fields(
+                {"tool_output_json": json.dumps(output)}, exec_result, req.executor_id
+            )
+        )
 
     @routes.put("/v1/files")
     async def upload_file(request: web.Request) -> web.Response:

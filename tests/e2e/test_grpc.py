@@ -244,6 +244,35 @@ async def test_execute_custom_tool(client):
     assert json.loads(resp.success.tool_output_json) == 42
 
 
+async def test_execute_custom_tool_session(client):
+    """Tool calls sharing an executor_id see each other's workspace files."""
+    tool = (
+        "import os\n"
+        "def bump() -> int:\n"
+        "    n = int(open('n.txt').read()) if os.path.exists('n.txt') else 0\n"
+        "    open('n.txt', 'w').write(str(n + 1))\n"
+        "    return n + 1\n"
+    )
+    try:
+        for want in (1, 2):
+            resp = await client.execute_tool(
+                pb2.ExecuteCustomToolRequest(
+                    tool_source_code=tool,
+                    tool_input_json="{}",
+                    executor_id="grpc-tool-sess",
+                )
+            )
+            assert resp.WhichOneof("response") == "success", resp
+            assert json.loads(resp.success.tool_output_json) == want
+            assert resp.success.session_seq == want
+            assert resp.success.session_ended is False
+    finally:
+        closed = await client.close_executor(
+            pb2.CloseExecutorRequest(executor_id="grpc-tool-sess")
+        )
+    assert closed.closed is True
+
+
 async def test_execute_custom_tool_error(client):
     resp = await client.execute_tool(
         pb2.ExecuteCustomToolRequest(

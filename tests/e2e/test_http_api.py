@@ -363,6 +363,78 @@ async def test_session_over_http(client):
     assert resp.status == 400
 
 
+async def test_custom_tool_in_session(client):
+    """Custom tools compose with sessions: a tool's workspace files persist
+    across an agent's calls sharing an executor_id."""
+    tool = (
+        "import os\n"
+        "def count_calls() -> int:\n"
+        '    """Counts invocations within this session.\n'
+        "    :return: times called so far\n"
+        '    """\n'
+        "    n = int(open('calls.txt').read()) if os.path.exists('calls.txt') else 0\n"
+        "    n += 1\n"
+        "    open('calls.txt', 'w').write(str(n))\n"
+        "    return n\n"
+    )
+    try:
+        for want in (1, 2, 3):
+            resp = await client.post(
+                "/v1/execute-custom-tool",
+                json={
+                    "tool_source_code": tool,
+                    "tool_input_json": "{}",
+                    "executor_id": "tool-sess",
+                },
+            )
+            assert resp.status == 200, await resp.text()
+            body = await resp.json()
+            assert json.loads(body["tool_output_json"]) == want
+            # Continuity contract on the tool surface too.
+            assert body["session_seq"] == want
+            assert body["session_ended"] is False
+
+        # The session is visible to the operator and closable.
+        resp = await client.get("/v1/executors")
+        sessions = (await resp.json())["sessions"]
+        entry = next(s for s in sessions if s["executor_id"] == "tool-sess")
+        assert entry["requests"] == 3 and entry["busy"] is False
+        assert entry["status"] == "ready"
+    finally:
+        await client.delete("/v1/executors/tool-sess")
+    resp = await client.get("/v1/executors")
+    sessions = (await resp.json())["sessions"]
+    assert not any(s["executor_id"] == "tool-sess" for s in sessions)
+
+
+async def test_custom_tool_session_death_visible_on_error(client):
+    """A tool call that times out (killing the session's runner) fails —
+    AND tells the agent its session died, via the error body's continuity
+    fields. A silent session reset behind a 400 would strand the agent."""
+    tool = (
+        "import time\n"
+        "def hang() -> int:\n"
+        "    time.sleep(30)\n"
+        "    return 1\n"
+    )
+    try:
+        resp = await client.post(
+            "/v1/execute-custom-tool",
+            json={
+                "tool_source_code": tool,
+                "tool_input_json": "{}",
+                "executor_id": "tool-kill-sess",
+                "timeout": 1,
+            },
+        )
+        assert resp.status == 400
+        body = await resp.json()
+        assert "timed out" in body["stderr"].lower()
+        assert body["session_ended"] is True
+    finally:
+        await client.delete("/v1/executors/tool-kill-sess")
+
+
 async def test_healthz(client):
     resp = await client.get("/healthz")
     assert resp.status == 200

@@ -386,7 +386,18 @@ async def test_multihost_spawn_failure_cleans_whole_group(fake_kubectl):
     backend = _backend(kubectl, tpu_chips_per_host=4)
     with pytest.raises(SandboxSpawnError):
         await backend.spawn(chip_count=8)
-    await asyncio.sleep(0.2)  # fire-and-forget deletes
+    # Fire-and-forget deletes: poll with a deadline (a fixed sleep flakes
+    # when the host is loaded and the fake-kubectl subprocesses run slowly),
+    # then hold one extra grace interval so a spurious LATE extra delete
+    # (e.g. a double-delete regression) still fails the exact-count assert.
+    deadline = asyncio.get_running_loop().time() + 10.0
+    deleted: set = set()
+    while asyncio.get_running_loop().time() < deadline:
+        deleted = {c["argv"][2] for c in calls() if c["argv"][0] == "delete"}
+        if len(deleted) >= 3:
+            break
+        await asyncio.sleep(0.05)
+    await asyncio.sleep(0.2)
     deleted = {c["argv"][2] for c in calls() if c["argv"][0] == "delete"}
     # both pods AND the group's headless service: no partial slices left
     assert len(deleted) == 3
