@@ -273,6 +273,34 @@ async def test_execute_custom_tool_session(client):
     assert closed.closed is True
 
 
+async def test_execute_custom_tool_session_death_visible_on_error(client):
+    """gRPC mirror of the HTTP error-continuity test: a tool call that
+    times out (killing the session's runner) returns the Error variant WITH
+    session_ended=true — the agent must see its session died."""
+    tool = (
+        "import time\n"
+        "def hang() -> int:\n"
+        "    time.sleep(30)\n"
+        "    return 1\n"
+    )
+    try:
+        resp = await client.execute_tool(
+            pb2.ExecuteCustomToolRequest(
+                tool_source_code=tool,
+                tool_input_json="{}",
+                executor_id="grpc-tool-kill",
+                timeout=1.0,
+            )
+        )
+        assert resp.WhichOneof("response") == "error", resp
+        assert "timed out" in resp.error.stderr.lower()
+        assert resp.error.session_ended is True
+    finally:
+        await client.close_executor(
+            pb2.CloseExecutorRequest(executor_id="grpc-tool-kill")
+        )
+
+
 async def test_execute_custom_tool_error(client):
     resp = await client.execute_tool(
         pb2.ExecuteCustomToolRequest(

@@ -24,6 +24,12 @@ from bee_code_interpreter_fs_tpu.ops.flash_attention import flash_attention
         (2, 64, 4, 16, 16, 16),
         (1, 100, 2, 32, 32, 16),  # t not divisible by blocks: padding path
         (1, 16, 1, 8, 64, 64),  # blocks larger than the sequence
+        # Unequal defaults with t between them and not a tile multiple: the
+        # clamped block must round back to a power of two dividing the
+        # shared padded length (regression: block_k clamped to 900 over an
+        # array padded to 1024 for block_q=512 satisfied neither of
+        # Mosaic's rules).
+        (1, 900, 1, 16, 512, 1024),
     ],
 )
 def test_matches_dense_oracle(b, t, h, d, bq, bk):
