@@ -20,15 +20,22 @@ import jax.numpy as jnp
 
 ON_TPU = jax.devices()[0].platform == "tpu"
 DIM = 8192 if ON_TPU else 256
-ITERS = 32 if ON_TPU else 2
+# Long enough that the rig's ~65 ms host<->device sync amortizes into noise:
+# at 32 iters the sync was ~25% of the measurement and MFU read 65%; at 256
+# the same chip reads 85% (measured sweep 32/128/256 -> 65/81.5/85.0%).
+ITERS = 256 if ON_TPU else 2
 V5E_BF16_PEAK_TFLOPS = 197.0
 
 
 @partial(jax.jit, static_argnums=(1,))
 def matmul_chain(a, iters):
     def body(_, b):
-        # Rescale each product so bf16 stays in range across the chain.
-        return (a @ b) * jnp.bfloat16(0.0156)
+        # Rescale each product so bf16 stays in range across the chain:
+        # per-iteration std grows by ~sqrt(DIM)*scale, so scale must sit at
+        # or below 1/sqrt(DIM) ≈ 0.011 — 0.0100 decays gently (~1e-6 after
+        # 256 iters, nowhere near bf16's underflow), where the old 0.0156
+        # grew ~1.4x/iter and overflowed to inf/NaN past ~250 iterations.
+        return (a @ b) * jnp.bfloat16(0.0100)
 
     b = jax.lax.fori_loop(0, iters, body, a)
     return b[0, 0].astype(jnp.float32)
@@ -36,7 +43,8 @@ def matmul_chain(a, iters):
 
 key = jax.random.PRNGKey(0)
 a = jax.random.normal(key, (DIM, DIM), dtype=jnp.bfloat16)
-float(matmul_chain(a, ITERS))  # compile + first run off the clock
+probe = float(matmul_chain(a, ITERS))  # compile + first run off the clock
+assert probe == probe, "matmul chain produced NaN — rescale is wrong"
 
 best = float("inf")
 for _ in range(3):
