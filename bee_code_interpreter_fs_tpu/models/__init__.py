@@ -10,6 +10,7 @@ the matmul path, static shapes.
 
 from bee_code_interpreter_fs_tpu.models.llama import (
     LlamaConfig,
+    decode_chunk,
     decode_step,
     forward,
     generate,
@@ -21,10 +22,12 @@ from bee_code_interpreter_fs_tpu.models.llama import (
     param_specs,
     prefill,
     sample_generate,
+    speculative_generate,
 )
 
 __all__ = [
     "LlamaConfig",
+    "decode_chunk",
     "decode_step",
     "forward",
     "generate",
@@ -36,4 +39,5 @@ __all__ = [
     "param_specs",
     "prefill",
     "sample_generate",
+    "speculative_generate",
 ]

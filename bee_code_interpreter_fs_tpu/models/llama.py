@@ -358,30 +358,11 @@ def decode_step(params, tokens, cache, pos, cfg: LlamaConfig):
     Jit with ``donate_argnums=(2,)`` so the cache updates in place instead
     of copying [L, b, max, nkv, hd] twice per token (generate() does).
     """
-    dt = jnp.dtype(cfg.dtype)
-    scale = cfg.head_dim ** -0.5
-    max_len = cache["k"].shape[2]
-    valid = (jnp.arange(max_len) <= pos)[None, None, None, None, :]
-
-    x = params["embed"].astype(dt)[tokens]  # [b, 1, dim]
-
-    def layer(x, inputs):
-        lp, ck, cv = inputs  # ck/cv: [b, max, nkv, hd]
-        cell = {}
-
-        def attn_fn(q, k, v):
-            new_k = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
-            new_v = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
-            cell["kv"] = (new_k, new_v)
-            return _cached_gqa_attention(q, new_k, new_v, valid, scale)
-
-        x = transformer_block(x, lp, cfg, attn_fn, rope_offset=pos)
-        return x, cell["kv"]
-
-    x, (new_k, new_v) = lax.scan(layer, x, (params["layers"], cache["k"], cache["v"]))
-    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
-    return logits[:, 0], {"k": new_k, "v": new_v}
+    # The s=1 case of decode_chunk (the valid mask degenerates to
+    # arange(max_len) <= pos) — delegated so the cache-write and
+    # masked-attention plumbing exists exactly once.
+    logits, cache = decode_chunk(params, tokens, cache, pos, cfg)
+    return logits[:, 0], cache
 
 
 def prefill(params, tokens, cache, cfg: LlamaConfig):
@@ -418,6 +399,148 @@ def prefill(params, tokens, cache, cfg: LlamaConfig):
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, t - 1] @ params["lm_head"].astype(dt)).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v}
+
+
+def decode_chunk(params, tokens, cache, pos, cfg: LlamaConfig):
+    """Process `s` tokens at positions pos..pos+s-1 against the cache — the
+    chunked middle ground between prefill() (pos=0, empty cache) and
+    decode_step() (s=1): each chunk token attends to every cache position
+    up to itself (cache prefix + the chunk's own causal prefix). Returns
+    (logits [b, s, vocab] float32 for ALL s positions, updated cache).
+
+    This is speculative decoding's verify pass (score γ draft tokens in one
+    target forward) and doubles as chunked prefill for prompts longer than
+    one pass should materialize.
+    """
+    dt = jnp.dtype(cfg.dtype)
+    scale = cfg.head_dim ** -0.5
+    s = tokens.shape[1]
+    max_len = cache["k"].shape[2]
+    # Chunk-local query i (global pos+i) sees cache positions <= pos+i.
+    q_pos = pos + jnp.arange(s)
+    valid = (jnp.arange(max_len)[None, :] <= q_pos[:, None])[None, None, None]
+    x = params["embed"].astype(dt)[tokens]
+
+    def layer(x, inputs):
+        lp, ck, cv = inputs
+        cell = {}
+
+        def attn_fn(q, k, v):
+            new_k = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+            new_v = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+            cell["kv"] = (new_k, new_v)
+            return _cached_gqa_attention(q, new_k, new_v, valid, scale)
+
+        x = transformer_block(x, lp, cfg, attn_fn, rope_offset=pos)
+        return x, cell["kv"]
+
+    x, (new_k, new_v) = lax.scan(layer, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg_draft", "cfg_target", "max_new_tokens", "gamma", "max_len"),
+)
+def speculative_generate(draft_params, target_params, prompt_tokens,
+                         cfg_draft: LlamaConfig, cfg_target: LlamaConfig, *,
+                         max_new_tokens: int, gamma: int = 4,
+                         max_len: int | None = None):
+    """Greedy speculative decoding, fully jitted: a cheap DRAFT model
+    proposes γ tokens autoregressively, the TARGET scores all of them in
+    ONE decode_chunk forward, and the longest agreeing prefix plus the
+    target's own next token are emitted — up to γ+1 tokens per target
+    pass instead of 1. The output is EXACTLY greedy_generate(target): the
+    draft only decides how many target tokens each pass yields, never what
+    they are (greedy acceptance = token equality, so every emitted token is
+    the target's argmax given its prefix).
+
+    Batch rows advance in lockstep by the BATCH-MINIMUM acceptance (per-row
+    positions would need ragged caches); rows that agreed longer simply
+    re-derive the same tokens next pass — wasteful, never wrong, and the
+    classic single-sequence latency case (b=1) loses nothing. Throughput
+    gain ≈ (mean acceptance + 1) / (1 + γ·cost_draft/cost_target); a draft
+    that rarely agrees makes this SLOWER than greedy_generate — measure
+    acceptance before deploying a draft.
+    """
+    if cfg_draft.vocab_size != cfg_target.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    if gamma < 1:
+        raise ValueError(
+            "gamma must be >= 1 (0 proposals leaves nothing to verify; "
+            "use greedy_generate for plain decoding)"
+        )
+    b, p = prompt_tokens.shape
+    # Slack: the last pass may overshoot max_new_tokens by up to γ.
+    total = p + max_new_tokens + gamma + 1
+    max_len = max(max_len or 0, total)
+
+    d_cache = init_cache(cfg_draft, b, max_len)
+    t_cache = init_cache(cfg_target, b, max_len)
+    t_logits, t_cache = prefill(target_params, prompt_tokens, t_cache, cfg_target)
+    _, d_cache = prefill(draft_params, prompt_tokens, d_cache, cfg_draft)
+
+    buf = jnp.zeros((b, total), jnp.int32)
+    buf = lax.dynamic_update_slice(buf, prompt_tokens, (0, 0))
+    buf = buf.at[:, p].set(jnp.argmax(t_logits, axis=-1).astype(jnp.int32))
+    # Invariant at the top of each pass: n_done tokens emitted; both caches
+    # hold positions 0..L-1 where L = p + n_done - 1; the newest emitted
+    # token sits at buf[:, L] and has not been fed to either model yet.
+
+    def cond(state):
+        _, n_done, _, _ = state
+        return n_done < max_new_tokens
+
+    def body(state):
+        buf, n_done, d_cache, t_cache = state
+        L = p + n_done - 1
+        pending = lax.dynamic_slice(buf, (0, L), (b, 1))[:, 0]
+
+        # Draft rollout: γ+1 steps. Step j feeds the token at position L+j;
+        # steps 0..γ-1 produce the proposals d_1..d_γ, and the extra step
+        # feeds d_γ so the draft cache covers position L+γ — required when
+        # every proposal is accepted (next pass starts at L+γ+1).
+        def droll(carry, j):
+            tok, cache = carry
+            logits, cache = decode_step(
+                draft_params, tok[:, None], cache, L + j, cfg_draft
+            )
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (nxt, cache), nxt
+
+        (_, d_cache), props = lax.scan(
+            droll, (pending, d_cache), jnp.arange(gamma + 1)
+        )
+        drafts = props[:gamma].T  # [b, γ]; d_j = drafts[:, j-1]
+
+        # Verify: target scores [pending, d_1..d_γ] at positions L..L+γ in
+        # one chunk; t_preds[:, j-1] is the target's choice for buf[L+j].
+        chunk = jnp.concatenate([pending[:, None], drafts], axis=1)
+        v_logits, t_cache = decode_chunk(
+            target_params, chunk, t_cache, L, cfg_target
+        )
+        t_preds = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)  # [b, γ+1]
+
+        # Longest agreeing prefix per row, then batch-min (lockstep).
+        agree = drafts == t_preds[:, :gamma]
+        row_accept = jnp.where(
+            agree.all(axis=1), gamma, jnp.argmin(agree, axis=1)
+        )
+        accept = jnp.min(row_accept)
+
+        # Emit t_1..t_{accept+1}. Writing the whole γ+1 prediction row is
+        # safe: positions past the acceptance point are exactly the ones the
+        # next pass rewrites (L' + 1 = L + accept + 2), and the final slice
+        # never reaches past the last genuinely emitted token.
+        buf = lax.dynamic_update_slice(buf, t_preds, (0, L + 1))
+        return buf, n_done + accept + 1, d_cache, t_cache
+
+    buf, _, _, _ = lax.while_loop(
+        cond, body, (buf, jnp.int32(1), d_cache, t_cache)
+    )
+    return buf[:, : p + max_new_tokens]
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "max_len"))

@@ -2,6 +2,7 @@
 path equivalence — all on the virtual 8-device CPU mesh."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -234,6 +235,100 @@ def test_greedy_generate_matches_stepwise_generate():
     want = generate(params, prompt, cfg, max_new_tokens=5)
     got = greedy_generate(params, prompt, cfg, max_new_tokens=5)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_decode_chunk_matches_stepwise_decode():
+    """Scoring s tokens in one decode_chunk must produce the same logits
+    (and cache) as s sequential decode_steps."""
+    from bee_code_interpreter_fs_tpu.models import (
+        decode_chunk,
+        decode_step,
+        init_cache,
+        prefill,
+    )
+
+    cfg = LlamaConfig.tiny(dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(21), (2, 6), 0, cfg.vocab_size)
+    extra = jax.random.randint(jax.random.PRNGKey(22), (2, 4), 0, cfg.vocab_size)
+
+    cache_a = init_cache(cfg, 2, 32)
+    _, cache_a = prefill(params, prompt, cache_a, cfg)
+    chunk_logits, cache_a = decode_chunk(params, extra, cache_a, 6, cfg)
+
+    cache_b = init_cache(cfg, 2, 32)
+    _, cache_b = prefill(params, prompt, cache_b, cfg)
+    step_logits = []
+    for i in range(extra.shape[1]):
+        logits, cache_b = decode_step(params, extra[:, i : i + 1], cache_b, 6 + i, cfg)
+        step_logits.append(logits)
+    np.testing.assert_allclose(
+        np.asarray(chunk_logits),
+        np.stack([np.asarray(l) for l in step_logits], axis=1),
+        rtol=2e-4, atol=2e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(cache_a["k"]), np.asarray(cache_b["k"]), rtol=1e-5, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 5])
+def test_speculative_equals_target_greedy_same_draft(gamma):
+    """Draft == target (every proposal accepted, the upper-bound case):
+    speculative output must EXACTLY equal greedy_generate(target)."""
+    from bee_code_interpreter_fs_tpu.models import (
+        greedy_generate,
+        speculative_generate,
+    )
+
+    cfg = LlamaConfig.tiny(dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(23), (2, 5), 0, cfg.vocab_size)
+    want = greedy_generate(params, prompt, cfg, max_new_tokens=9)
+    got = speculative_generate(
+        params, params, prompt, cfg, cfg, max_new_tokens=9, gamma=gamma
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_speculative_equals_target_greedy_disagreeing_draft():
+    """A DIFFERENT (randomly initialized) draft mostly disagrees with the
+    target — acceptance hits the rejection path constantly — yet the output
+    must still EXACTLY equal the target's own greedy decode: the draft
+    decides speed, never content."""
+    from bee_code_interpreter_fs_tpu.models import (
+        greedy_generate,
+        speculative_generate,
+    )
+
+    cfg_t = LlamaConfig.tiny(dtype="float32")
+    cfg_d = LlamaConfig.tiny(dtype="float32", n_layers=1)
+    target = init_params(jax.random.PRNGKey(0), cfg_t)
+    draft = init_params(jax.random.PRNGKey(77), cfg_d)
+    prompt = jax.random.randint(jax.random.PRNGKey(24), (3, 4), 0, cfg_t.vocab_size)
+    want = greedy_generate(target, prompt, cfg_t, max_new_tokens=8)
+    got = speculative_generate(
+        draft, target, prompt, cfg_d, cfg_t, max_new_tokens=8, gamma=3
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_speculative_rejects_vocab_mismatch_and_zero_gamma():
+    from bee_code_interpreter_fs_tpu.models import speculative_generate
+
+    cfg_t = LlamaConfig.tiny(dtype="float32")
+    cfg_d = LlamaConfig.tiny(dtype="float32", vocab_size=128)
+    target = init_params(jax.random.PRNGKey(0), cfg_t)
+    draft = init_params(jax.random.PRNGKey(1), cfg_d)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="vocabulary"):
+        speculative_generate(
+            draft, target, prompt, cfg_d, cfg_t, max_new_tokens=4
+        )
+    with pytest.raises(ValueError, match="gamma"):
+        speculative_generate(
+            target, target, prompt, cfg_t, cfg_t, max_new_tokens=4, gamma=0
+        )
 
 
 def test_sample_generate_topk1_equals_greedy():
