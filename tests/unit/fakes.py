@@ -1,0 +1,47 @@
+"""Shared in-memory sandbox-backend fake for orchestrator-level unit tests
+(pool, sessions, streaming). One implementation with counters and knobs so
+the Sandbox/SandboxBackend contract has a single test double to keep in sync.
+"""
+
+from bee_code_interpreter_fs_tpu.services.backends.base import Sandbox
+
+
+class FakeBackend:
+    """In-memory backend: spawn/reset/delete counters, no processes.
+
+    `capacity` mimics a TPU host's slot limit (None = unconstrained CPU);
+    `resettable=False` makes every recycle attempt fail (single-use pods,
+    the reference's model)."""
+
+    def __init__(self, capacity=None, resettable=True):
+        self.capacity = capacity
+        self.resettable = resettable
+        self.spawns = 0
+        self.resets = 0
+        self.deletes = 0
+        self.live = set()
+
+    async def spawn(self, chip_count: int = 0) -> Sandbox:
+        self.spawns += 1
+        sandbox = Sandbox(
+            id=f"sb-{self.spawns}", url="http://fake", chip_count=chip_count
+        )
+        self.live.add(sandbox.id)
+        return sandbox
+
+    def pool_capacity(self, chip_count: int):
+        return self.capacity
+
+    async def reset(self, sandbox: Sandbox):
+        self.resets += 1
+        if not self.resettable or sandbox.id not in self.live:
+            return None
+        sandbox.meta["generation"] = sandbox.meta.get("generation", 0) + 1
+        return sandbox
+
+    async def delete(self, sandbox: Sandbox) -> None:
+        self.deletes += 1
+        self.live.discard(sandbox.id)
+
+    async def close(self) -> None:
+        self.live.clear()

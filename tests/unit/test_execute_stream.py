@@ -1,0 +1,150 @@
+"""execute_stream() orchestrator-level tests: event ordering, retry policy,
+session interplay, and failure paths — with the sandbox HTTP hop faked, so
+they pin the queue/cancellation machinery rather than the network."""
+
+import asyncio
+
+import pytest
+from fakes import FakeBackend
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.code_executor import (
+    CodeExecutor,
+    ExecutorError,
+    SessionLimitError,
+)
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+
+def make_executor(tmp_path, **config_kwargs):
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        executor_pod_queue_target_length=1,
+        **config_kwargs,
+    )
+    executor = CodeExecutor(FakeBackend(), Storage(config.file_storage_path), config)
+
+    async def fake_stream(client, base, payload, timeout, sandbox, emit):
+        await emit({"stream": "stdout", "data": "a"})
+        await emit({"stream": "stderr", "data": "w"})
+        await emit({"stream": "stdout", "data": "b"})
+        return {"stdout": "ab", "stderr": "w", "exit_code": 0, "files": [],
+                "warm": True}
+
+    async def fake_post(client, base, payload, timeout, sandbox):
+        return {"stdout": "ab", "stderr": "", "exit_code": 0, "files": [],
+                "warm": True}
+
+    executor._post_execute_stream = fake_stream
+    executor._post_execute = fake_post
+    return executor
+
+
+async def collect(events):
+    chunks, result = [], None
+    async for event in events:
+        if "result" in event:
+            result = event["result"]
+        else:
+            chunks.append(event)
+    return chunks, result
+
+
+async def test_stream_event_order_then_result(tmp_path):
+    executor = make_executor(tmp_path)
+    try:
+        chunks, result = await collect(executor.execute_stream("x"))
+        assert [c["data"] for c in chunks] == ["a", "w", "b"]
+        assert [c["stream"] for c in chunks] == ["stdout", "stderr", "stdout"]
+        assert result is not None and result.exit_code == 0
+        # Streaming counts in the executions metric exactly once.
+        assert executor.metrics.executions._values[("ok",)] == 1
+    finally:
+        await executor.close()
+
+
+async def test_stream_infra_error_not_retried(tmp_path):
+    """Streamed output cannot be un-streamed: infra failures surface
+    immediately instead of the stateless path's tenacity retry."""
+    executor = make_executor(tmp_path)
+    calls = 0
+
+    async def failing_stream(client, base, payload, timeout, sandbox, emit):
+        nonlocal calls
+        calls += 1
+        await emit({"stream": "stdout", "data": "partial"})
+        raise ExecutorError("sandbox died mid-stream")
+
+    executor._post_execute_stream = failing_stream
+    try:
+        chunks = []
+        with pytest.raises(ExecutorError):
+            async for event in executor.execute_stream("x"):
+                if "result" not in event:
+                    chunks.append(event)
+        assert calls == 1  # no retry
+        assert [c["data"] for c in chunks] == ["partial"]
+        assert executor.metrics.executions._values[("infra_error",)] == 1
+    finally:
+        await executor.close()
+
+
+async def test_stream_in_session_updates_seq(tmp_path):
+    executor = make_executor(tmp_path)
+    try:
+        _, first = await collect(executor.execute_stream("x", executor_id="s"))
+        assert first.session_seq == 1
+        _, second = await collect(executor.execute_stream("x", executor_id="s"))
+        assert second.session_seq == 2
+        assert len(executor._sessions) == 1
+    finally:
+        await executor.close()
+
+
+async def test_stream_session_limit_is_session_limit_error(tmp_path):
+    executor = make_executor(tmp_path, executor_session_max=1)
+    try:
+        await collect(executor.execute_stream("x", executor_id="s1"))
+        with pytest.raises(SessionLimitError):
+            await collect(executor.execute_stream("x", executor_id="s2"))
+        assert executor.metrics.executions._values[("rejected",)] == 1
+    finally:
+        await executor.close()
+
+
+async def test_stream_consumer_abandons_mid_stream(tmp_path):
+    """A consumer that stops iterating (client disconnect) must not leak the
+    run task or the sandbox: the generator's cleanup cancels the run and the
+    release path still fires."""
+    executor = make_executor(tmp_path)
+    started = asyncio.Event()
+    proceed = asyncio.Event()
+
+    async def slow_stream(client, base, payload, timeout, sandbox, emit):
+        await emit({"stream": "stdout", "data": "first"})
+        started.set()
+        await proceed.wait()  # blocks until cancelled
+        return {"stdout": "", "stderr": "", "exit_code": 0, "files": [],
+                "warm": True}
+
+    executor._post_execute_stream = slow_stream
+    try:
+        events = executor.execute_stream("x")
+        first = await events.__anext__()
+        assert first["data"] == "first"
+        await started.wait()
+        await events.aclose()  # consumer walks away
+        # Release/dispose tasks must settle without hanging, AND the sandbox
+        # must actually be released — the abandoned run's sandbox is disposed
+        # (infra-cancelled mid-request, never recycled), so the backend's
+        # live set must not retain it. Asserting only on the task set would
+        # pass vacuously if the release task were never created.
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if not executor._dispose_tasks and executor.backend.deletes > 0:
+                break
+        assert not executor._dispose_tasks
+        assert executor.backend.deletes >= 1
+        assert executor._in_use.get(0, 0) == 0
+    finally:
+        await executor.close()

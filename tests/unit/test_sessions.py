@@ -11,6 +11,7 @@ on idle timeout, or when its runner dies).
 import asyncio
 
 import pytest
+from fakes import FakeBackend
 
 from bee_code_interpreter_fs_tpu.config import Config
 from bee_code_interpreter_fs_tpu.services.backends.base import Sandbox
@@ -21,40 +22,6 @@ from bee_code_interpreter_fs_tpu.services.code_executor import (
 )
 from bee_code_interpreter_fs_tpu.services.storage import Storage
 
-
-class FakeBackend:
-    def __init__(self, capacity=None, resettable=True):
-        self.capacity = capacity
-        self.resettable = resettable
-        self.spawns = 0
-        self.resets = 0
-        self.deletes = 0
-        self.live = set()
-
-    async def spawn(self, chip_count: int = 0) -> Sandbox:
-        self.spawns += 1
-        sandbox = Sandbox(
-            id=f"sb-{self.spawns}", url="http://fake", chip_count=chip_count
-        )
-        self.live.add(sandbox.id)
-        return sandbox
-
-    def pool_capacity(self, chip_count: int):
-        return self.capacity
-
-    async def reset(self, sandbox: Sandbox):
-        self.resets += 1
-        if not self.resettable or sandbox.id not in self.live:
-            return None
-        sandbox.meta["generation"] = sandbox.meta.get("generation", 0) + 1
-        return sandbox
-
-    async def delete(self, sandbox: Sandbox) -> None:
-        self.deletes += 1
-        self.live.discard(sandbox.id)
-
-    async def close(self) -> None:
-        self.live.clear()
 
 
 class FakeSandboxServer:
