@@ -209,8 +209,12 @@ def flash_attention_partial(q, k, v, acc, m, l, *, q_offset, k_offset,
     b, tq, h, d = q.shape
     tk = k.shape[1]
     scale = d ** -0.5 if scale is None else scale
-    block_q = min(block_q, tq)
-    block_k = min(block_k, tk)
+    # Same clamp-then-pow2 rule as flash_attention: a block clamped to an
+    # odd chunk length would rely on Mosaic's "block == array dim" escape
+    # hatch; rounding up to a power of two (and padding to it) keeps every
+    # block dividing its padded dim outright.
+    block_q = _pow2_at_least(min(block_q, max(tq, 1)))
+    block_k = _pow2_at_least(min(block_k, max(tk, 1)))
     pad_q = (-tq) % block_q
     pad_k = (-tk) % block_k
     if pad_q:

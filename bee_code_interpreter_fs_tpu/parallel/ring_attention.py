@@ -29,7 +29,7 @@ _NEG = -1e30  # finite mask value: keeps online-softmax max finite everywhere
 
 def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, scale=None,
                    use_flash: bool = False, flash_interpret: bool = False,
-                   flash_block: int = 128):
+                   flash_block: int | None = None):
     """Exact attention where q, k, v are per-device sequence chunks.
 
     Args:
@@ -43,7 +43,10 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, scale=None,
         path — the per-chunk-pair [tq, tk] score tensor never materializes,
         which is what makes very long per-device chunks viable. Same online-
         softmax carry either way. `flash_interpret` runs the kernel
-        interpreted (CPU tests); `flash_block` is its tile size.
+        interpreted (CPU tests); `flash_block` overrides BOTH kernel tile
+        sizes (tests use small tiles on tiny chunks) — None keeps the
+        kernel's measured defaults (512x1024, clamped per chunk), which run
+        ~4x faster than 128x128 tiles on long chunks.
 
     Returns local output chunk [batch, chunk_len, heads, head_dim].
     """
@@ -64,15 +67,19 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, scale=None,
         if use_flash:
             def fold(args):
                 acc, m, l = args
+                block_kwargs = (
+                    {"block_q": flash_block, "block_k": flash_block}
+                    if flash_block is not None
+                    else {}
+                )
                 return flash_attention_partial(
                     q, kc, vc, acc, m, l,
                     q_offset=my * t,
                     k_offset=src * t,
                     scale=scale,
                     causal=causal,
-                    block_q=flash_block,
-                    block_k=flash_block,
                     interpret=flash_interpret,
+                    **block_kwargs,
                 )
 
             if causal:
