@@ -313,6 +313,29 @@ def test_speculative_equals_target_greedy_disagreeing_draft():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_speculative_composes_with_gqa_and_moe():
+    """Speculation is pure decode_step/decode_chunk composition, so it must
+    hold token-exact target parity for GQA and MoE targets too."""
+    from bee_code_interpreter_fs_tpu.models import (
+        greedy_generate,
+        speculative_generate,
+    )
+
+    cfg_t = LlamaConfig.tiny(
+        dtype="float32", n_heads=4, n_kv_heads=2, n_experts=4,
+        n_experts_per_token=2,
+    )
+    cfg_d = LlamaConfig.tiny(dtype="float32", n_layers=1, n_heads=4, n_kv_heads=2)
+    target = init_params(jax.random.PRNGKey(0), cfg_t)
+    draft = init_params(jax.random.PRNGKey(5), cfg_d)
+    prompt = jax.random.randint(jax.random.PRNGKey(25), (2, 4), 0, cfg_t.vocab_size)
+    want = greedy_generate(target, prompt, cfg_t, max_new_tokens=6)
+    got = speculative_generate(
+        draft, target, prompt, cfg_d, cfg_t, max_new_tokens=6, gamma=2
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_speculative_rejects_vocab_mismatch_and_zero_gamma():
     from bee_code_interpreter_fs_tpu.models import speculative_generate
 
