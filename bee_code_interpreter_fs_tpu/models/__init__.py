@@ -24,6 +24,10 @@ from bee_code_interpreter_fs_tpu.models.llama import (
     sample_generate,
     speculative_generate,
 )
+from bee_code_interpreter_fs_tpu.models.quant import (
+    quantize_params,
+    quantized_nbytes,
+)
 
 __all__ = [
     "LlamaConfig",
@@ -40,4 +44,6 @@ __all__ = [
     "prefill",
     "sample_generate",
     "speculative_generate",
+    "quantize_params",
+    "quantized_nbytes",
 ]

@@ -210,9 +210,9 @@ def _moe_mlp(h, lp, cfg: LlamaConfig):
         jax.nn.one_hot(top_i, cfg.n_experts, dtype=jnp.float32)
         * top_w[..., None]
     ).sum(axis=-2)  # [b, t, E]
-    gate = jax.nn.silu(jnp.einsum("btd,edh->bteh", h, lp["w_gate"]))
-    up = jnp.einsum("btd,edh->bteh", h, lp["w_up"])
-    y = jnp.einsum("bteh,ehd->bted", gate * up, lp["w_down"])
+    gate = jax.nn.silu(jnp.einsum("btd,edh->bteh", h, _w(lp["w_gate"], h.dtype)))
+    up = jnp.einsum("btd,edh->bteh", h, _w(lp["w_up"], h.dtype))
+    y = jnp.einsum("bteh,ehd->bted", gate * up, _w(lp["w_down"], h.dtype))
     return jnp.einsum("bted,bte->btd", y, weights.astype(y.dtype))
 
 
@@ -234,6 +234,20 @@ def _expand_gqa(k, v, n_heads):
     return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
 
 
+def _w(leaf, dt):
+    """Matmul-weight accessor: dense arrays pass through (cast is a no-op at
+    the model dtype); int8-quantized {"q","s"} leaves (models/quant.py)
+    dequantize HERE, at the use site inside the layer scan — XLA then reads
+    1 byte/param from HBM and fuses convert*scale into the matmul operand,
+    which is the whole point of weight-only quantization on a decode path
+    that is weight-bandwidth-bound."""
+    from bee_code_interpreter_fs_tpu.models.quant import dequantize, is_quantized
+
+    if is_quantized(leaf):
+        return dequantize(leaf, dt)
+    return leaf.astype(dt)
+
+
 def transformer_block(x, lp, cfg: LlamaConfig, attn_fn, *, rope_offset=0):
     """One pre-norm decoder block: attention + (dense | MoE) MLP, residual
     around each. `attn_fn(q, k, v) -> attn` receives UNexpanded kv heads
@@ -242,21 +256,22 @@ def transformer_block(x, lp, cfg: LlamaConfig, attn_fn, *, rope_offset=0):
     block arithmetic; `rope_offset` positions incremental-decode tokens."""
     b, t, _ = x.shape
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    dt = x.dtype
     h = _rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"]).reshape(b, t, nh, hd)
-    k = (h @ lp["wk"]).reshape(b, t, nkv, hd)
-    v = (h @ lp["wv"]).reshape(b, t, nkv, hd)
+    q = (h @ _w(lp["wq"], dt)).reshape(b, t, nh, hd)
+    k = (h @ _w(lp["wk"], dt)).reshape(b, t, nkv, hd)
+    v = (h @ _w(lp["wv"], dt)).reshape(b, t, nkv, hd)
     q = _rope(q, cfg.rope_theta, offset=rope_offset)
     k = _rope(k, cfg.rope_theta, offset=rope_offset)
     attn = attn_fn(q, k, v)
-    x = x + attn.reshape(b, t, nh * hd) @ lp["wo"]
+    x = x + attn.reshape(b, t, nh * hd) @ _w(lp["wo"], dt)
 
     h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts > 0:
         x = x + _moe_mlp(h, lp, cfg)
     else:
-        gate = jax.nn.silu(h @ lp["w_gate"])
-        x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+        gate = jax.nn.silu(h @ _w(lp["w_gate"], dt))
+        x = x + (gate * (h @ _w(lp["w_up"], dt))) @ _w(lp["w_down"], dt)
     return x
 
 
@@ -312,7 +327,7 @@ def forward(params, tokens, cfg: LlamaConfig, *, mesh: Mesh | None = None):
 
     x, _ = lax.scan(layer, x, params["layers"])
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return (x @ _w(params["lm_head"], dt)).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------- decoding
@@ -397,7 +412,7 @@ def prefill(params, tokens, cache, cfg: LlamaConfig):
 
     x, (new_k, new_v) = lax.scan(layer, x, (params["layers"], cache["k"], cache["v"]))
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, t - 1] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    logits = (x[:, t - 1] @ _w(params["lm_head"], dt)).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -436,7 +451,7 @@ def decode_chunk(params, tokens, cache, pos, cfg: LlamaConfig):
 
     x, (new_k, new_v) = lax.scan(layer, x, (params["layers"], cache["k"], cache["v"]))
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    logits = (x @ _w(params["lm_head"], dt)).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v}
 
 

@@ -1,0 +1,121 @@
+"""Weight-only int8 quantization for the Llama family (serving memory/
+bandwidth optimization).
+
+Autoregressive decoding is weight-HBM-bound: every step streams every matmul
+weight once for a sliver of compute. Storing those weights as int8 with
+per-output-channel scales halves the bytes per step versus bf16 — the
+dequantize (`q.astype(dt) * s`) happens at the use site inside the layer
+scan, so XLA reads 1 byte/param from HBM and fuses the convert+scale into
+the matmul's operand path; the MXU still runs its native bf16 pipeline.
+
+Scheme: symmetric per-output-channel. For a weight `w[*, in, out]` (the
+contraction always runs over the second-to-last axis in this model family —
+dense [in, out], stacked layers [L, in, out], stacked experts [L, E, in,
+out]):
+
+    s = max(|w|, axis=-2, keepdims) / 127        # one scale per out column
+    q = clip(round(w / s), -127, 127).astype(int8)
+    w ≈ q * s    (|error| <= s/2 per element)
+
+Quantized: the seven per-layer matmul weights + lm_head. Left full
+precision: embeddings (a gather, not a matmul — and tied-scale semantics
+differ), norms (tiny, precision-critical), the MoE router (tiny, feeds a
+softmax whose top-k is decision-critical).
+
+The quantized tree is an ordinary pytree (each weight becomes
+{"q": int8, "s": float32}), so it checkpoints through utils/checkpoint.py
+and scans through lax.scan unchanged. `forward`/`prefill`/`decode_chunk`
+accept it transparently via the `_w` accessor in models/llama.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Per-layer matmul weights that quantize (models/llama.py param tree).
+QUANTIZED_LAYER_WEIGHTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_int8(w) -> dict:
+    """Symmetric per-output-channel int8: w ≈ q * s (see module docstring).
+
+    The scale/divide/round math runs in float32 regardless of the weight's
+    dtype: in bf16 (the model default) the division near q=±127 can land a
+    full level off and the scale itself carries ~0.4% rounding, breaking
+    the |error| <= s/2 bound the scheme promises."""
+    w32 = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(w32), axis=-2, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-12)  # all-zero channels must not divide by zero
+    q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+def is_quantized(leaf) -> bool:
+    return isinstance(leaf, dict) and set(leaf) == {"q", "s"}
+
+
+def dequantize(leaf, dtype):
+    return leaf["q"].astype(dtype) * leaf["s"].astype(dtype)
+
+
+def quantize_params(params) -> dict:
+    """int8-quantize a Llama param tree's matmul weights (weight-only).
+
+    Returns a new tree of the same structure with each quantized weight
+    replaced by {"q": int8, "s": float32}; everything else is shared by
+    reference. Works for dense and MoE trees (stacked expert weights
+    quantize per (expert, out-channel) — axis=-2 is the contraction dim in
+    every case).
+    """
+    layers = dict(params["layers"])
+    for name in QUANTIZED_LAYER_WEIGHTS:
+        if name in layers:
+            layers[name] = quantize_int8(layers[name])
+    out = dict(params)
+    out["layers"] = layers
+    out["lm_head"] = quantize_int8(params["lm_head"])
+    return out
+
+
+def quantized_param_specs(cfg) -> dict:
+    """PartitionSpec tree matching quantize_params' structure, derived from
+    the model's param_specs: each quantized weight's spec becomes
+    {"q": <same spec>, "s": <spec with the contraction (-2) axis
+    unsharded>} — the scale's -2 dim is size 1, so a mesh axis there would
+    be meaningless. This is what keeps int8 serving compatible with the
+    tp/ep distribution story (shard_pytree / sharded checkpoint restore)."""
+    from jax.sharding import PartitionSpec as P
+
+    from bee_code_interpreter_fs_tpu.models.llama import param_specs
+
+    def qspec(spec):
+        parts = list(spec)
+        scale_parts = list(spec)
+        scale_parts[-2] = None
+        return {"q": P(*parts), "s": P(*scale_parts)}
+
+    specs = param_specs(cfg)
+    layers = dict(specs["layers"])
+    for name in QUANTIZED_LAYER_WEIGHTS:
+        if name in layers:
+            layers[name] = qspec(layers[name])
+    out = dict(specs)
+    out["layers"] = layers
+    out["lm_head"] = qspec(specs["lm_head"])
+    return out
+
+
+def quantized_nbytes(params) -> int:
+    """Total bytes of the weight leaves (quantized dicts count q + s) —
+    the HBM-residency number the scheme exists to halve."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(
+        params, is_leaf=lambda x: is_quantized(x)
+    ):
+        if is_quantized(leaf):
+            total += leaf["q"].nbytes + leaf["s"].nbytes
+        else:
+            total += leaf.nbytes
+    return total

@@ -150,4 +150,8 @@ def pipelined_transformer(params, tokens, cfg, *, mesh: Mesh,
     # last stage's slab holds the processed microbatches.
     x = piped[-n_microbatches:].reshape(batch, seq, -1)
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    # Function-level import: models.llama imports parallel.* at module scope,
+    # so a top-level import here would cycle through the package __init__s.
+    from bee_code_interpreter_fs_tpu.models.llama import _w
+
+    return (x @ _w(params["lm_head"], dt)).astype(jnp.float32)
