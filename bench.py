@@ -35,6 +35,15 @@ BENCH_SOURCE = (REPO_ROOT / "examples" / "benchmark-numpy.py").read_text()
 MATMUL_SOURCE = (REPO_ROOT / "examples" / "benchmark-matmul.py").read_text()
 ATTENTION_SOURCE = (REPO_ROOT / "examples" / "benchmark-attention.py").read_text()
 METRIC = "benchmark-numpy.py GFLOPS/chip via Execute (1e8 sum-of-squares)"
+
+# Results accumulate here as each leg completes, so a deadline or mid-run
+# failure still reports everything measured up to that point (round 3's
+# artifact was empty because nothing partial ever reached stdout).
+PARTIAL: dict = {}
+
+# Absolute perf_counter() timestamp of the overall deadline, set by
+# _run_with_deadline; inner legs clamp their timeouts against it.
+_DEADLINE_AT: float | None = None
 ATTN_RE = re.compile(r"ATTN_TFLOPS=([0-9.]+)")
 GFLOPS_RE = re.compile(r"GFLOPS=([0-9.]+)")
 SINGLE_SHOT_RE = re.compile(r"GFLOPS_single_shot=([0-9.]+)")
@@ -155,15 +164,18 @@ async def run_matmul(tmp: Path) -> dict:
         await executor.close()
 
 
-async def cold_start_p50(tmp: Path, samples: int = 5) -> float:
-    """Execute RPC latency with a warm pool (the p50 the user sees)."""
+async def cold_start_p50(tmp: Path, samples: int = 5, warm_jax: bool = True) -> float:
+    """Execute RPC latency with a warm pool (the p50 the user sees).
+
+    warm_jax=False keeps the sandboxes off the accelerator entirely — the
+    degraded (wedged-chip) path still measures orchestration latency."""
     config = Config(
         file_storage_path=str(tmp / "storage-lat"),
         local_sandbox_root=str(tmp / "sb-lat"),
         executor_pod_queue_target_length=2,
         jax_compilation_cache_dir=str(tmp / "jax-cache"),
     )
-    backend = LocalSandboxBackend(config, warm_import_jax=True, numpy_dispatch=True)
+    backend = LocalSandboxBackend(config, warm_import_jax=warm_jax, numpy_dispatch=warm_jax)
     executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
     try:
         log("p50: filling pool...")
@@ -182,19 +194,36 @@ async def cold_start_p50(tmp: Path, samples: int = 5) -> float:
         await executor.close()
 
 
-def prime_accelerator() -> None:
+def prime_accelerator(budget_s: float) -> tuple[bool, str]:
     """One clean-exiting subprocess that imports jax and touches the devices
     BEFORE any sandbox spawns. First-ever TPU init on a cold host pages in
-    the whole jax/libtpu stack and establishes the device session — minutes,
-    sometimes longer than any sane per-sandbox budget. Paying it here, in a
-    process that exits cleanly (never killed mid-init — killing a client
-    mid-init can wedge the device for the next one), makes every subsequent
-    sandbox warm-up fast. No timeout on purpose."""
-    import subprocess
+    the whole jax/libtpu stack and establishes the device session — so it
+    gets its own budget here, in a process that is NEVER killed (killing a
+    client mid-init is exactly what wedges the shared device for the next
+    30+ minutes). Two terminal outcomes short of success:
 
-    log("priming accelerator (first-init page-in, may take minutes)...")
+    - the child exits rc!=0 (e.g. UNAVAILABLE: an earlier client's stale
+      claim still holds the chip) → terminal, degrade immediately;
+    - the child outlives ``budget_s`` (attach is hanging on a wedged chip)
+      → leave it running as an orphan to finish attaching on its own —
+      its eventual clean exit is what lets the device recover — and
+      degrade without it.
+
+    Round 3's driver artifact came back empty because this stage only
+    *logged* rc=1 and the bench walked on into pool fills that blocked on
+    the same dead chip. Now a failed prime is terminal."""
+    import subprocess
+    import tempfile
+
+    log(f"priming accelerator (budget {budget_s:.0f}s, child never killed)...")
     t0 = time.perf_counter()
-    proc = subprocess.run(
+    # Child output goes to a real file, not a pipe: a wedged-chip child can
+    # emit retry warnings past a pipe buffer and block in write(), and an
+    # orphaned child must never die of BrokenPipeError mid-attach.
+    outf = tempfile.NamedTemporaryFile(
+        mode="w+", prefix="bench-prime-", suffix=".log", delete=False
+    )
+    proc = subprocess.Popen(
         [
             sys.executable,
             "-c",
@@ -202,45 +231,128 @@ def prime_accelerator() -> None:
             "print(jax.devices());"
             "jnp.add(jnp.ones(()), 1.0).block_until_ready()",
         ],
-        capture_output=True,
-        text=True,
+        stdout=outf,
+        stderr=subprocess.STDOUT,
     )
-    log(
-        f"prime done in {time.perf_counter() - t0:.1f}s rc={proc.returncode} "
-        f"{(proc.stdout or proc.stderr).strip().splitlines()[-1:]}"
-    )
+    try:
+        rc = proc.wait(timeout=budget_s)
+    except subprocess.TimeoutExpired:
+        # Do NOT kill it: orphan the child so its attach can complete
+        # (and release the device cleanly) long after we've moved on. It
+        # keeps its inherited file descriptor; we just stop watching.
+        outf.close()
+        log(
+            f"prime exceeded {budget_s:.0f}s budget; leaving child "
+            f"pid={proc.pid} to finish on its own (log: {outf.name}), "
+            f"declaring the accelerator unavailable for this run"
+        )
+        return False, (
+            f"accelerator attach exceeded {budget_s:.0f}s budget "
+            f"(device wedged by a stale claim?); primer orphaned, not killed"
+        )
+    outf.seek(0)
+    out = outf.read().strip()
+    outf.close()
+    tail = out.splitlines()[-1:] if out else []
+    dt = time.perf_counter() - t0
+    log(f"prime done in {dt:.1f}s rc={rc} {tail}")
+    if rc != 0:
+        return False, f"accelerator init failed rc={rc}: {tail}"
+    PARTIAL["prime_s"] = round(dt, 1)
+    return True, f"prime ok in {dt:.1f}s"
 
 
-async def main() -> None:
+def _last_self_artifact() -> dict:
+    """Pointer to the newest self-measured artifact so a degraded driver
+    line still references the last healthy-chip numbers."""
+    cands = sorted(REPO_ROOT.glob("BENCH_r[0-9]*_self.json"))
+    if not cands:
+        return {}
+    out: dict = {"last_self_measured_artifact": cands[-1].name}
+    try:
+        data = json.loads(cands[-1].read_text())
+        headline = data.get("headline", {})
+        if "value" in headline:
+            out["last_self_measured_headline_gflops"] = headline["value"]
+    except (OSError, ValueError):
+        pass
+    return out
+
+
+def _remaining_s(default: float = 600.0) -> float:
+    """Seconds left before the overall deadline (with a safety margin), so
+    inner leg timeouts never outlive the backstop that would clobber the
+    specific error message with a generic deadline one."""
+    if _DEADLINE_AT is None:
+        return default
+    return max(_DEADLINE_AT - time.perf_counter() - 45.0, 30.0)
+
+
+async def degraded_cpu_bench(tmp: Path) -> None:
+    """The accelerator is unusable: measure everything that doesn't need it
+    (CPU-sandbox numpy baseline + warm-pool Execute p50 with jax kept out of
+    the sandboxes) so the driver's artifact still lands real numbers."""
+    log("degraded mode: CPU-sandbox legs only")
+    try:
+        cpu_gflops, cpu_info = await asyncio.wait_for(
+            run_gflops(dispatch=False, runs=2, tmp=tmp),
+            timeout=min(420.0, _remaining_s() * 0.6),
+        )
+        PARTIAL["cpu_numpy_gflops"] = round(cpu_gflops, 3)
+        PARTIAL["cpu_run"] = cpu_info
+    except Exception as e:  # noqa: BLE001 — degraded mode reports what it can
+        log(f"degraded cpu gflops leg failed: {e}")
+    try:
+        p50 = await asyncio.wait_for(
+            cold_start_p50(tmp, warm_jax=False),
+            timeout=min(240.0, _remaining_s()),
+        )
+        PARTIAL["execute_p50_warm_pool_s_cpu_sandbox"] = round(p50, 4)
+    except Exception as e:  # noqa: BLE001
+        log(f"degraded p50 leg failed: {e}")
+
+
+async def main(prime_ok: bool, prime_detail: str) -> None:
     import tempfile
 
-    prime_accelerator()
     with tempfile.TemporaryDirectory(prefix="bench-") as tmp_str:
         tmp = Path(tmp_str)
+        if not prime_ok:
+            await degraded_cpu_bench(tmp)
+            _emit_error(f"accelerator unavailable: {prime_detail}")
+            sys.exit(1)
         tpu_gflops, tpu_info = await run_gflops(dispatch=True, runs=4, tmp=tmp)
+        PARTIAL["tpu_gflops"] = round(tpu_gflops, 3)
+        PARTIAL["tpu_run"] = tpu_info
         matmul = await run_matmul(tmp)
+        PARTIAL.update(matmul)
         cpu_gflops, _ = await run_gflops(dispatch=False, runs=1, tmp=tmp)
+        PARTIAL["cpu_numpy_gflops"] = round(cpu_gflops, 3)
         p50 = await cold_start_p50(tmp)
+        PARTIAL["execute_p50_warm_pool_s"] = round(p50, 4)
 
     line = {
         "metric": METRIC,
         "value": round(tpu_gflops, 3),
         "unit": "GFLOPS",
         "vs_baseline": round(tpu_gflops / cpu_gflops, 2) if cpu_gflops else None,
-        "extra": {
-            "cpu_numpy_gflops": round(cpu_gflops, 3),
-            "execute_p50_warm_pool_s": round(p50, 4),
-            "tpu_run": tpu_info,
-            **matmul,
-        },
+        "extra": dict(PARTIAL),
     }
     print(json.dumps(line))
 
 
 def _emit_error(kind: str) -> None:
     """The degraded stdout contract: still exactly one parseable JSON line,
-    with an `error` field instead of a measurement."""
+    with an `error` field instead of a headline measurement — but carrying
+    every leg measured before the failure (PARTIAL) plus a pointer to the
+    last healthy-chip self-measured artifact."""
     log(f"bench failed: {kind}")
+    # Snapshot defensively: the backstop timer thread calls this while the
+    # event-loop thread may be mutating PARTIAL.
+    try:
+        extra = {**dict(PARTIAL), **_last_self_artifact()}
+    except RuntimeError:
+        extra = _last_self_artifact()
     print(
         json.dumps(
             {
@@ -249,6 +361,7 @@ def _emit_error(kind: str) -> None:
                 "unit": "GFLOPS",
                 "vs_baseline": None,
                 "error": kind[:500],
+                "extra": extra,
             }
         ),
         flush=True,
@@ -260,34 +373,58 @@ def _run_with_deadline() -> None:
     JSON error line instead of hanging or crashing with a bare traceback.
 
     The failure this guards: a test-rig device wedged by some earlier
-    client killed mid-init makes every TPU attach hang; without a deadline
-    the bench would sit in spawn-retry loops for hours (3 spawn attempts x
-    a deliberately generous 600 s warm budget x several configs) and the
-    harness would record nothing at all. One JSON line with an `error`
-    field keeps the run auditable either way."""
+    client killed mid-init makes every TPU attach hang. Round 3 showed the
+    original guard was not enough — the primer alone burned 1508 s of a
+    2700 s deadline and the DRIVER's window expired before the backstop
+    fired, so the round's official artifact recorded nothing. Hence:
+
+    - default deadline 1200 s, well under any sane driver window;
+    - the primer gets its own sub-budget (BENCH_PRIME_BUDGET_S, 420 s) and
+      a failed/overrun prime is TERMINAL → degraded CPU-only legs + one
+      structured error line, never a march into wedged pool fills;
+    - the backstop thread emits whatever PARTIAL results exist and
+      os._exit()s, which works even while the event loop is blocked."""
     try:
-        deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "") or 2700)
+        deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "") or 1200)
     except ValueError:
-        deadline_s = 2700.0
+        deadline_s = 1200.0
+    try:
+        prime_budget_s = float(os.environ.get("BENCH_PRIME_BUDGET_S", "") or 420)
+    except ValueError:
+        prime_budget_s = 420.0
+    prime_budget_s = min(prime_budget_s, deadline_s * 0.5)
     deadline_msg = f"deadline of {deadline_s:.0f}s exceeded (accelerator hung?)"
 
-    # Thread backstop: the primer is a BLOCKING subprocess.run (deliberately
-    # never killed — killing a client mid-TPU-init is what wedges devices),
-    # and asyncio.wait_for cannot preempt a blocked event loop. The timer
-    # emits the error line and exits the bench; the primer child is left to
-    # finish or wait on its own (orphaned, still never killed mid-init).
+    # Thread backstop: pool fills / executes can block the event loop on a
+    # wedged chip in ways asyncio.wait_for cannot preempt. The timer emits
+    # the error line (with any PARTIAL results) and exits the bench; any
+    # orphaned primer child is left to finish on its own (never killed
+    # mid-init — killing a client mid-TPU-init is what wedges devices).
     import threading
 
-    def _hard_deadline() -> None:
-        _emit_error(deadline_msg)
-        os._exit(1)
+    start = time.perf_counter()
+    global _DEADLINE_AT
+    _DEADLINE_AT = start + deadline_s
 
-    timer = threading.Timer(deadline_s + 30.0, _hard_deadline)
+    def _hard_deadline() -> None:
+        # Whatever happens while formatting, the process MUST exit here —
+        # a dead backstop is how an artifact comes back empty.
+        try:
+            _emit_error(deadline_msg)
+        finally:
+            os._exit(1)
+
+    timer = threading.Timer(deadline_s, _hard_deadline)
     timer.daemon = True
     timer.start()
+    prime_ok, prime_detail = prime_accelerator(prime_budget_s)
+    remaining = max(deadline_s - (time.perf_counter() - start) - 30.0, 60.0)
     try:
-        asyncio.run(asyncio.wait_for(main(), timeout=deadline_s))
+        asyncio.run(asyncio.wait_for(main(prime_ok, prime_detail), timeout=remaining))
         timer.cancel()
+    except SystemExit:
+        timer.cancel()
+        raise
     except Exception as e:  # noqa: BLE001 — the output contract is one JSON line
         # Cancel BEFORE emitting: teardown of wedged sandboxes can take long
         # enough that the backstop would otherwise fire concurrently and put
