@@ -128,6 +128,22 @@ class Config(BaseModel):
     # constrained TPU lane an idle session is parking a chip that stateless
     # requests are queueing for.
     executor_session_idle_timeout: float = 120.0
+    # Max seconds a request may queue for a sandbox slot before getting a
+    # retryable 429/RESOURCE_EXHAUSTED. The hang this bounds: every slot of
+    # a capacity-constrained lane held by ACTIVELY USED sessions, which the
+    # idle sweeper (by design) never touches. 0 = wait forever.
+    executor_acquire_timeout: float = 300.0
+    # -- sandbox resource limits (local backend) ----------------------------
+    # Extra address-space bytes user code may allocate beyond the warm
+    # runner's baseline (soft RLIMIT_AS window in executor/runner.py): an
+    # allocation bomb gets an in-process MemoryError instead of inviting
+    # the host OOM killer. "auto" = 80% of the sandbox host's physical RAM;
+    # "0" disables; any integer = explicit bytes. The kubernetes backend
+    # ignores this — container resources own the bound there (the reference
+    # delegates isolation wholesale to the cluster runtime, README.md:56-57).
+    sandbox_max_user_memory_bytes: int | str = "auto"
+    # Soft RLIMIT_NOFILE applied around user code; 0 = inherit the host's.
+    sandbox_max_open_files: int = 0
     # Default accelerator request for kubernetes backend pods, merged into the
     # container resources (e.g. {"google.com/tpu": "4"}). Empty → CPU pods.
     tpu_resource_requests: dict = Field(default_factory=dict)

@@ -490,7 +490,15 @@ def speculative_generate(draft_params, target_params, prompt_tokens,
     b, p = prompt_tokens.shape
     # Slack: the last pass may overshoot max_new_tokens by up to γ.
     total = p + max_new_tokens + gamma + 1
-    max_len = max(max_len or 0, total)
+    # Mirror greedy/sample_generate: an explicit max_len that can't hold the
+    # generation is a caller error, not something to silently enlarge — a
+    # caller sizing sharded caches by max_len must get what it asked for.
+    if max_len is None:
+        max_len = total
+    elif max_len < total:
+        raise ValueError(
+            f"max_len={max_len} < prompt+new+gamma+1={total}: cache too small"
+        )
 
     d_cache = init_cache(cfg_draft, b, max_len)
     t_cache = init_cache(cfg_target, b, max_len)

@@ -55,6 +55,15 @@ class SessionLimitError(RuntimeError):
     gRPC RESOURCE_EXHAUSTED — not a defect in the request itself)."""
 
 
+class CapacityTimeoutError(SessionLimitError):
+    """A request waited ``executor_acquire_timeout`` seconds for a sandbox
+    slot without one turning over — e.g. a capacity-constrained TPU lane
+    whose every chip is held by actively-used sessions. Subclasses
+    SessionLimitError so both API layers already map it to a retryable
+    HTTP 429 / gRPC RESOURCE_EXHAUSTED instead of the caller hanging
+    indefinitely (ADVICE r3 #1)."""
+
+
 def _drain(pool: deque) -> list:
     drained = []
     while pool:
@@ -307,6 +316,14 @@ class CodeExecutor:
         # "due back" — a long-running in-flight execute must not block a
         # waiter on an unconstrained lane indefinitely.
         grace_deadline = asyncio.get_running_loop().time() + 10.0
+        # On a constrained lane no amount of waiting helps while active
+        # sessions hold every slot — bound the wait and surface a
+        # retryable error instead of an open-ended hang.
+        acquire_deadline = (
+            asyncio.get_running_loop().time() + self.config.executor_acquire_timeout
+            if self.config.executor_acquire_timeout > 0
+            else None
+        )
         self._waiting[chip_count] = self._waiting.get(chip_count, 0) + 1
         try:
             while True:
@@ -363,8 +380,19 @@ class CodeExecutor:
                 # landing). The timeout is a safety net against a lost
                 # release, not a poll — the event fires long before it in
                 # normal operation.
+                now = asyncio.get_running_loop().time()
+                if acquire_deadline is not None and now >= acquire_deadline:
+                    raise CapacityTimeoutError(
+                        f"no lane-{chip_count} sandbox slot freed within "
+                        f"{self.config.executor_acquire_timeout:.0f}s "
+                        f"(in_use={in_use}, session_held={session_held}, "
+                        f"capacity={capacity}); retry later"
+                    )
+                wait_s = 30.0
+                if acquire_deadline is not None:
+                    wait_s = min(wait_s, max(acquire_deadline - now, 0.1))
                 try:
-                    await asyncio.wait_for(event.wait(), timeout=30.0)
+                    await asyncio.wait_for(event.wait(), timeout=wait_s)
                 except asyncio.TimeoutError:
                     pass
         finally:

@@ -291,6 +291,22 @@ def test_speculative_equals_target_greedy_same_draft(gamma):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_speculative_rejects_undersized_max_len():
+    """An explicit max_len too small for prompt+new+gamma+1 must raise
+    (mirroring greedy/sample_generate), not silently enlarge the cache a
+    caller sized sharded memory budgets by (ADVICE r3 #2)."""
+    from bee_code_interpreter_fs_tpu.models import speculative_generate
+
+    cfg = LlamaConfig.tiny(dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(23), (2, 5), 0, cfg.vocab_size)
+    with pytest.raises(ValueError, match="cache too small"):
+        speculative_generate(
+            params, params, prompt, cfg, cfg,
+            max_new_tokens=9, gamma=3, max_len=10,
+        )
+
+
 def test_speculative_equals_target_greedy_disagreeing_draft():
     """A DIFFERENT (randomly initialized) draft mostly disagrees with the
     target — acceptance hits the rejection path constantly — yet the output

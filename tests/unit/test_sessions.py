@@ -16,6 +16,7 @@ from fakes import FakeBackend
 from bee_code_interpreter_fs_tpu.config import Config
 from bee_code_interpreter_fs_tpu.services.backends.base import Sandbox
 from bee_code_interpreter_fs_tpu.services.code_executor import (
+    CapacityTimeoutError,
     CodeExecutor,
     ExecutorError,
     SessionLimitError,
@@ -304,6 +305,29 @@ async def test_session_holds_capacity_slot(tmp_path):
         assert not stateless.done()
         await executor.close_session("sess-a")
         result = await asyncio.wait_for(stateless, timeout=5)
+        assert result.exit_code == 0
+    finally:
+        await executor.close()
+
+
+async def test_acquire_timeout_yields_retryable_error(tmp_path):
+    """Every constrained slot held by an ACTIVELY USED session (which the
+    idle sweeper by design never touches): a stateless request must get a
+    retryable CapacityTimeoutError after executor_acquire_timeout instead
+    of hanging indefinitely (ADVICE r3 #1). The error subclasses
+    SessionLimitError, so HTTP/gRPC already map it to 429 /
+    RESOURCE_EXHAUSTED."""
+    backend = FakeBackend(capacity=1)
+    executor, server = make_executor(
+        backend, tmp_path, executor_acquire_timeout=0.3
+    )
+    try:
+        await executor.execute("x", executor_id="sess-a")
+        with pytest.raises(CapacityTimeoutError):
+            await asyncio.wait_for(executor.execute("y"), timeout=5)
+        # The slot frees when the session closes; the lane recovers.
+        await executor.close_session("sess-a")
+        result = await asyncio.wait_for(executor.execute("y"), timeout=5)
         assert result.exit_code == 0
     finally:
         await executor.close()
