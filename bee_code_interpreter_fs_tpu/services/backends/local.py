@@ -349,6 +349,12 @@ class LocalSandboxBackend(SandboxBackend):
                 ),
                 "APP_PARENT_DEATH_EXIT": "1",  # die with the control plane
                 "APP_PYTHON": sys.executable,
+                # Local sandboxes share the host's RAM — bound user-code
+                # allocations (runner.py applies the soft-rlimit window).
+                "APP_MAX_USER_MEMORY_BYTES": str(
+                    self.config.sandbox_max_user_memory_bytes
+                ),
+                "APP_MAX_OPEN_FILES": str(self.config.sandbox_max_open_files),
                 "APP_DEFAULT_TIMEOUT": str(self.config.default_execution_timeout),
                 "TMPDIR": str(scratch_tmp),
                 "APP_RESET_EXTRA_WIPE_DIRS": str(scratch_tmp),

@@ -125,6 +125,87 @@ def _profile_requested(env: dict) -> bool:
     return str(env.get("APP_JAX_PROFILE", "")).lower() not in ("", "0", "false")
 
 
+def _resolve_mem_budget() -> int:
+    """APP_MAX_USER_MEMORY_BYTES: extra address-space bytes user code may
+    allocate beyond the warm baseline. "auto" = 80% of the host's physical
+    RAM; 0/unset = no limit."""
+    raw = os.environ.get("APP_MAX_USER_MEMORY_BYTES", "").strip().lower()
+    if not raw or raw in ("0", "false", "off"):
+        return 0
+    if raw == "auto":
+        try:
+            return int(
+                0.8 * os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+            )
+        except (ValueError, OSError):
+            return 0
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        return 0
+
+
+def _apply_user_rlimits():
+    """Bound the user script with soft rlimits; returns a restore thunk.
+
+    RLIMIT_AS soft = current VmSize + budget: an allocation bomb inside
+    user code gets a clean in-process MemoryError (traceback in its stderr,
+    exit_code 1) instead of inviting the host OOM killer. The window is
+    relative to the CURRENT footprint because the warm runner already holds
+    jax + device mappings — an absolute cap below that would fail every
+    future mmap including benign ones. RLIMIT_NOFILE soft comes from
+    APP_MAX_OPEN_FILES (0 = inherit).
+
+    Soft-only on purpose: the hard limits stay put so the post-run restore
+    works without privilege. This is a guardrail against runaway agent
+    snippets, not a security boundary (user code could raise its own soft
+    limit — same residual-risk contract as _reset's). The kubernetes
+    backend bounds memory with container resources instead; the reference
+    delegates isolation wholesale to the cluster runtime (README.md:56-57).
+    """
+    import resource
+
+    restores = []
+    budget = _resolve_mem_budget()
+    if budget > 0:
+        try:
+            with open("/proc/self/statm") as f:
+                vm_bytes = int(f.read().split()[0]) * os.sysconf("SC_PAGE_SIZE")
+            soft, hard = resource.getrlimit(resource.RLIMIT_AS)
+            ceiling = vm_bytes + budget
+            if hard != resource.RLIM_INFINITY:
+                ceiling = min(ceiling, hard)
+            if soft == resource.RLIM_INFINITY or ceiling < soft:
+                resource.setrlimit(resource.RLIMIT_AS, (ceiling, hard))
+                restores.append((resource.RLIMIT_AS, (soft, hard)))
+        except (OSError, ValueError):
+            pass
+    nofile_raw = os.environ.get("APP_MAX_OPEN_FILES", "").strip()
+    if nofile_raw.isdigit() and int(nofile_raw) > 0:
+        try:
+            soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+            target = int(nofile_raw)
+            if hard != resource.RLIM_INFINITY:
+                target = min(target, hard)
+            if target < soft:
+                resource.setrlimit(resource.RLIMIT_NOFILE, (target, hard))
+                restores.append((resource.RLIMIT_NOFILE, (soft, hard)))
+        except (OSError, ValueError):
+            pass
+
+    def restore() -> None:
+        # Idempotent (pops as it goes): called from the except path to get
+        # headroom back BEFORE traceback formatting, then again in finally.
+        while restores:
+            lim, vals = restores.pop()
+            try:
+                resource.setrlimit(lim, vals)
+            except (OSError, ValueError):
+                pass
+
+    return restore
+
+
 def _import_jax_profile():
     return _import_sibling("jax_profile")
 
@@ -167,8 +248,12 @@ def _run_one(req: dict) -> int:
     env = req.get("env") or {}
     # APP_JAX_PROFILE stays out of os.environ: the warm runner profiles the
     # run itself, and leaking the var would make a sitecustomize on the path
-    # double-start the profiler at first jax import.
-    env_to_set = {k: v for k, v in env.items() if k != "APP_JAX_PROFILE"}
+    # double-start the profiler at first jax import. The rlimit knobs stay
+    # out too: they are operator policy from the sandbox's boot env, and a
+    # request-supplied override would let the very snippets the guardrail
+    # targets turn it off.
+    _OPERATOR_ONLY = ("APP_JAX_PROFILE", "APP_MAX_USER_MEMORY_BYTES", "APP_MAX_OPEN_FILES")
+    env_to_set = {k: v for k, v in env.items() if k not in _OPERATOR_ONLY}
     saved_env = {k: os.environ.get(k) for k in env_to_set}
     os.environ.update({k: str(v) for k, v in env_to_set.items()})
 
@@ -184,6 +269,7 @@ def _run_one(req: dict) -> int:
     saved_argv = sys.argv
     exit_code = 0
     trace_dir = _start_profile() if _profile_requested(env) else None
+    restore_rlimits = _apply_user_rlimits()
     try:
         sys.argv = [source_path]  # argv[0] stays the user's path
         runpy.run_path(run_path, run_name="__main__")
@@ -191,9 +277,13 @@ def _run_one(req: dict) -> int:
         code = e.code
         exit_code = code if isinstance(code, int) else (0 if code is None else 1)
     except BaseException:  # noqa: BLE001 — report, don't die
+        # Limits off first: after a window-exhausting MemoryError, the
+        # traceback formatting itself needs allocation headroom.
+        restore_rlimits()
         traceback.print_exc()
         exit_code = 1
     finally:
+        restore_rlimits()
         sys.argv = saved_argv
         if trace_dir is not None:
             # Inside the redirect so profiler chatter lands in the capture.

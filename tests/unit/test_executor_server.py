@@ -654,3 +654,62 @@ def test_sigterm_reaps_runner_session(tmp_path):
             os.killpg(proc.pid, signal.SIGKILL)
         except ProcessLookupError:
             pass
+
+
+def test_allocation_bomb_gets_memoryerror_not_host_oom(tmp_path):
+    """APP_MAX_USER_MEMORY_BYTES bounds user-code address-space growth with
+    a soft RLIMIT_AS window (runner.py:_apply_user_rlimits): an allocation
+    bomb gets a clean in-process MemoryError — traceback in its own stderr,
+    exit_code 1 — instead of inviting the host OOM killer, and the warm
+    runner (limits restored) keeps serving (VERDICT r3 #6; the reference
+    delegates this wholesale to the cluster runtime, README.md:56-57)."""
+    ws = tmp_path / "ws"
+    rp = tmp_path / "rp"
+    ws.mkdir()
+    rp.mkdir()
+    env = _server_env(ws, rp)
+    env["APP_MAX_USER_MEMORY_BYTES"] = str(256 * 1024 * 1024)  # 256 MiB window
+    proc = subprocess.Popen(
+        [str(BINARY)], env=env, stdout=subprocess.PIPE, stderr=None
+    )
+    try:
+        line = proc.stdout.readline().decode()
+        port = int(re.search(r"port=(\d+)", line).group(1))
+        with httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=60.0) as c:
+            for _ in range(200):
+                if c.get("/healthz").json().get("warm"):
+                    break
+                time.sleep(0.05)
+            bomb = c.post(
+                "/execute",
+                json={
+                    "source_code": "chunks = []\n"
+                    "while True:\n"
+                    "    chunks.append(bytearray(64 * 1024 * 1024))\n"
+                },
+            ).json()
+            assert bomb["exit_code"] == 1, bomb
+            assert "MemoryError" in bomb["stderr"], bomb["stderr"][-400:]
+            assert not bomb.get("runner_restarted"), bomb
+            # Limits were restored: the runner still serves normal requests
+            # and can allocate modestly again.
+            after = c.post(
+                "/execute",
+                json={"source_code": "b = bytearray(8 * 1024 * 1024)\nprint(len(b))\n"},
+            ).json()
+            assert after["exit_code"] == 0, after["stderr"]
+            assert after["stdout"].strip() == str(8 * 1024 * 1024)
+            # The knob is operator policy: a request-supplied env override
+            # must NOT reach the run (else the bomb could disarm the limit).
+            override = c.post(
+                "/execute",
+                json={
+                    "source_code": "import os\n"
+                    "print(os.environ.get('APP_MAX_USER_MEMORY_BYTES'))\n",
+                    "env": {"APP_MAX_USER_MEMORY_BYTES": "0"},
+                },
+            ).json()
+            assert override["stdout"].strip() == str(256 * 1024 * 1024)
+    finally:
+        proc.kill()
+        proc.wait()
