@@ -27,6 +27,7 @@ from bee_code_interpreter_fs_tpu.models.llama import (
 from bee_code_interpreter_fs_tpu.models.quant import (
     quantize_params,
     quantized_nbytes,
+    quantized_param_specs,
 )
 
 __all__ = [
@@ -46,4 +47,5 @@ __all__ = [
     "speculative_generate",
     "quantize_params",
     "quantized_nbytes",
+    "quantized_param_specs",
 ]

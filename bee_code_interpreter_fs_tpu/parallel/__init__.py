@@ -22,9 +22,6 @@ from bee_code_interpreter_fs_tpu.parallel.sharding import (
     shard_pytree,
 )
 from bee_code_interpreter_fs_tpu.parallel.collectives import (
-    all_gather,
-    all_reduce_mean,
-    all_reduce_sum,
     reduce_scatter_sum,
     ring_all_reduce,
     ring_permute,
@@ -42,9 +39,6 @@ __all__ = [
     "make_mesh",
     "named_sharding",
     "shard_pytree",
-    "all_gather",
-    "all_reduce_mean",
-    "all_reduce_sum",
     "reduce_scatter_sum",
     "ring_all_reduce",
     "ring_permute",

