@@ -203,6 +203,26 @@ async def main() -> None:
             out.append(
                 await run_config("5c:llama-decode-tpu-x1", LLAMA_DECODE, executor=executor)
             )
+
+            # -- config 5d: int8 vs bf16 fused decode (weight-HBM bound) ------
+            # -- config 5e: TRUE Llama-2-7B shape, int8, one chip -------------
+            # (the north star's real 32-layer/4096-dim geometry; random
+            # weights, identical code path — retires the scale-model caveat)
+            # Both build GB-scale trees; neither belongs in a --quick pass.
+            if not quick:
+                quant = (REPO_ROOT / "examples" / "benchmark-quant.py").read_text()
+                out.append(
+                    await run_config(
+                        "5d:int8-decode-ratio", quant, executor=executor,
+                        timeout=1200.0,
+                    )
+                )
+                b7 = (REPO_ROOT / "examples" / "benchmark-7b.py").read_text()
+                out.append(
+                    await run_config(
+                        "5e:llama2-7b-int8", b7, executor=executor, timeout=1200.0
+                    )
+                )
         finally:
             await executor.close()
 

@@ -5,6 +5,7 @@ one kernel with K/V tiles streaming through VMEM. Steady state over chained
 iterations (each consumes the previous output as queries) with one final
 sync, per the rig's benchmarking methodology."""
 
+import os
 import time
 from functools import partial
 
@@ -15,6 +16,10 @@ from bee_code_interpreter_fs_tpu.ops.flash_attention import flash_attention
 
 ON_TPU = jax.devices()[0].platform == "tpu"
 B, T, H, D = (1, 16384, 4, 128) if ON_TPU else (1, 128, 2, 16)
+# Tile-sweep knobs (powers of two; see flash_attention's clamp rule).
+BLOCK_Q = int(os.environ.get("BENCH_BLOCK_Q", "512"))
+BLOCK_K = int(os.environ.get("BENCH_BLOCK_K", "1024"))
+T = int(os.environ.get("BENCH_SEQ_LEN", str(T)))
 # Enough chained iterations that the rig's ~65 ms host<->device sync is
 # amortized into noise (at 4 iters the sync dominated and underreported the
 # kernel ~8x).
@@ -30,7 +35,9 @@ q, k, v = (
 @jax.jit
 def chain(q, k, v):
     def body(_, q):
-        return flash_attention(q, k, v, interpret=not ON_TPU).astype(q.dtype)
+        return flash_attention(
+            q, k, v, block_q=BLOCK_Q, block_k=BLOCK_K, interpret=not ON_TPU
+        ).astype(q.dtype)
 
     out = jax.lax.fori_loop(0, ITERS, body, q)
     return out[0, 0, 0, 0].astype(jnp.float32)
@@ -45,5 +52,8 @@ for _ in range(2):
 
 # Causal attention flops: QK^T + PV, each 2*b*h*(t^2/2)*d.
 flops = ITERS * 4 * B * H * (T * T / 2) * D
-print(f"backend: {jax.devices()[0].platform} t={T} iters={ITERS}")
+print(
+    f"backend: {jax.devices()[0].platform} t={T} iters={ITERS} "
+    f"blocks={BLOCK_Q}x{BLOCK_K}"
+)
 print(f"ATTN_TFLOPS={flops / best / 1e12:.2f}")
