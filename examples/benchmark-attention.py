@@ -52,8 +52,15 @@ for _ in range(2):
 
 # Causal attention flops: QK^T + PV, each 2*b*h*(t^2/2)*d.
 flops = ITERS * 4 * B * H * (T * T / 2) * D
+# Report the EFFECTIVE tile sizes (after the kernel's clamp-to-t +
+# power-of-two rounding), not the requested ones — sweep data points must
+# be labeled with the configuration that actually ran.
+from bee_code_interpreter_fs_tpu.ops.flash_attention import _pow2_at_least
+
+eff_q = _pow2_at_least(min(BLOCK_Q, T))
+eff_k = _pow2_at_least(min(BLOCK_K, T))
 print(
     f"backend: {jax.devices()[0].platform} t={T} iters={ITERS} "
-    f"blocks={BLOCK_Q}x{BLOCK_K}"
+    f"blocks={eff_q}x{eff_k}"
 )
 print(f"ATTN_TFLOPS={flops / best / 1e12:.2f}")
