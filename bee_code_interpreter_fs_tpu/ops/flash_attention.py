@@ -49,6 +49,17 @@ def _pow2_at_least(n: int) -> int:
     return max(8, 1 << (int(n) - 1).bit_length())
 
 
+def effective_blocks(t: int, block_q: int, block_k: int) -> tuple[int, int]:
+    """The (block_q, block_k) the kernel will actually run for sequence
+    length t, after clamp-to-t + power-of-two rounding. Public so sweep
+    tooling labels data points with the configuration that ran, and stays
+    in lockstep if the clamp rule changes."""
+    return (
+        _pow2_at_least(min(block_q, max(t, 1))),
+        _pow2_at_least(min(block_k, max(t, 1))),
+    )
+
+
 def _tile_update(q, k_tile, v_tile, acc, m, l, *, scale, mask):
     """One online-softmax tile fold — the numerically delicate recurrence,
     shared by the full kernel and the ring-step partial kernel so the two
@@ -318,8 +329,7 @@ def flash_attention(q, k, v, *, scale: float | None = None, block_q: int = 512,
     # over an array padded to 1024 for block_q) divides nothing Mosaic
     # accepts. Powers of two make lcm(block_q, block_k) = max(...), so
     # padding to the larger block satisfies both.
-    block_q = _pow2_at_least(min(block_q, max(t, 1)))
-    block_k = _pow2_at_least(min(block_k, max(t, 1)))
+    block_q, block_k = effective_blocks(t, block_q, block_k)
 
     pad = (-t) % max(block_q, block_k)
     if pad:

@@ -55,10 +55,9 @@ flops = ITERS * 4 * B * H * (T * T / 2) * D
 # Report the EFFECTIVE tile sizes (after the kernel's clamp-to-t +
 # power-of-two rounding), not the requested ones — sweep data points must
 # be labeled with the configuration that actually ran.
-from bee_code_interpreter_fs_tpu.ops.flash_attention import _pow2_at_least
+from bee_code_interpreter_fs_tpu.ops.flash_attention import effective_blocks
 
-eff_q = _pow2_at_least(min(BLOCK_Q, T))
-eff_k = _pow2_at_least(min(BLOCK_K, T))
+eff_q, eff_k = effective_blocks(T, BLOCK_Q, BLOCK_K)
 print(
     f"backend: {jax.devices()[0].platform} t={T} iters={ITERS} "
     f"blocks={eff_q}x{eff_k}"
