@@ -59,6 +59,11 @@ class LlamaConfig:
     # instead of O(t²), the long-context choice). Ring attention (mesh with
     # sp > 1) takes precedence over either.
     attn_impl: str = "plain"
+    # Rematerialize decoder blocks on the backward pass (jax.checkpoint
+    # around the layer-scan body, dot-saveable policy): activation memory
+    # for training drops from O(n_layers·b·t·dim) to ~one block, for one
+    # extra forward's FLOPs — how long-context training fits HBM.
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -325,6 +330,21 @@ def forward(params, tokens, cfg: LlamaConfig, *, mesh: Mesh | None = None):
     def layer(x, lp):
         return transformer_block(x, lp, cfg, attn_fn), None
 
+    if cfg.remat:
+        # Rematerialize each block on the backward pass: activation
+        # residency drops from O(n_layers · b · t · dim) to one block's
+        # worth (the scan carry), bought with one extra forward — the
+        # standard long-context training trade on HBM-limited chips.
+        # Matmul results still save (they're the expensive thing to
+        # recompute); only cheap elementwise/norm work replays.
+        # prevent_cse=False: safe (and documented as the right call) under
+        # lax.scan, and skips optimization barriers that would block XLA
+        # fusion inside every iteration.
+        layer = jax.checkpoint(
+            layer,
+            prevent_cse=False,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
     x, _ = lax.scan(layer, x, params["layers"])
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     return (x @ _w(params["lm_head"], dt)).astype(jnp.float32)

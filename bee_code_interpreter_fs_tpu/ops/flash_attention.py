@@ -49,15 +49,19 @@ def _pow2_at_least(n: int) -> int:
     return max(8, 1 << (int(n) - 1).bit_length())
 
 
+def effective_block(n: int, block: int) -> int:
+    """One dimension's effective tile for array length n: clamp to n, then
+    round up to a power of two (the kernel's shared rule — every call site,
+    incl. the ring-step partial kernel, goes through here so a rule change
+    can't drift between kernels and sweep labels)."""
+    return _pow2_at_least(min(block, max(n, 1)))
+
+
 def effective_blocks(t: int, block_q: int, block_k: int) -> tuple[int, int]:
-    """The (block_q, block_k) the kernel will actually run for sequence
-    length t, after clamp-to-t + power-of-two rounding. Public so sweep
-    tooling labels data points with the configuration that ran, and stays
-    in lockstep if the clamp rule changes."""
-    return (
-        _pow2_at_least(min(block_q, max(t, 1))),
-        _pow2_at_least(min(block_k, max(t, 1))),
-    )
+    """The (block_q, block_k) flash_attention will actually run for
+    sequence length t. Public so sweep tooling labels data points with the
+    configuration that ran."""
+    return effective_block(t, block_q), effective_block(t, block_k)
 
 
 def _tile_update(q, k_tile, v_tile, acc, m, l, *, scale, mask):
@@ -223,12 +227,12 @@ def flash_attention_partial(q, k, v, acc, m, l, *, q_offset, k_offset,
     b, tq, h, d = q.shape
     tk = k.shape[1]
     scale = d ** -0.5 if scale is None else scale
-    # Same clamp-then-pow2 rule as flash_attention: a block clamped to an
-    # odd chunk length would rely on Mosaic's "block == array dim" escape
-    # hatch; rounding up to a power of two (and padding to it) keeps every
-    # block dividing its padded dim outright.
-    block_q = _pow2_at_least(min(block_q, max(tq, 1)))
-    block_k = _pow2_at_least(min(block_k, max(tk, 1)))
+    # Same clamp-then-pow2 rule as flash_attention (shared via
+    # effective_block): a block clamped to an odd chunk length would rely
+    # on Mosaic's "block == array dim" escape hatch; rounding up to a power
+    # of two (and padding to it) keeps every block dividing its padded dim.
+    block_q = effective_block(tq, block_q)
+    block_k = effective_block(tk, block_k)
     pad_q = (-tq) % block_q
     pad_k = (-tk) % block_k
     if pad_q:

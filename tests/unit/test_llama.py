@@ -429,3 +429,28 @@ def test_loss_finite():
     loss = loss_fn(params, {"tokens": tokens}, cfg)
     assert bool(jnp.isfinite(loss))
     assert float(loss) > 0
+
+
+def test_remat_matches_plain_loss_and_grads():
+    """cfg.remat wraps the layer-scan body in jax.checkpoint: same math,
+    recomputed on the backward pass — loss AND gradients must match the
+    plain configuration to float tolerance (the option trades FLOPs for
+    activation HBM, never values)."""
+    cfg = LlamaConfig.tiny(dtype="float32")
+    cfg_r = LlamaConfig.tiny(dtype="float32", remat=True)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    batch = {
+        "tokens": jax.random.randint(
+            jax.random.PRNGKey(1), (2, 17), 0, cfg.vocab_size
+        )
+    }
+    loss_p, grads_p = jax.value_and_grad(loss_fn)(params, batch, cfg)
+    loss_r, grads_r = jax.value_and_grad(loss_fn)(params, batch, cfg_r)
+    np.testing.assert_allclose(float(loss_p), float(loss_r), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6
+        ),
+        grads_p,
+        grads_r,
+    )
