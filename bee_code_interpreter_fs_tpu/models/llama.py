@@ -618,16 +618,26 @@ def greedy_generate(params, prompt_tokens, cfg: LlamaConfig, *,
 
 
 @partial(
-    jax.jit, static_argnames=("cfg", "max_new_tokens", "top_k", "max_len")
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "top_k", "top_p", "max_len"),
 )
 def sample_generate(params, prompt_tokens, key, cfg: LlamaConfig, *,
                     max_new_tokens: int, temperature=1.0, top_k: int = 0,
-                    max_len: int | None = None):
+                    top_p: float = 1.0, max_len: int | None = None):
     """Stochastic generation, fully jitted like greedy_generate: temperature
-    scaling plus optional top-k truncation, sampled with jax.random
-    (counter-based PRNG — same key, same output, any device). `temperature`
-    is a traced scalar (no recompile per setting); `top_k` 0 disables
-    truncation. Returns [b, prompt + max_new_tokens]."""
+    scaling plus optional top-k and/or nucleus (top-p) truncation, sampled
+    with jax.random (counter-based PRNG — same key, same output, any
+    device). `temperature` is a traced scalar (no recompile per setting);
+    `top_k` 0 / `top_p` 1.0 disable their truncations (both static: they
+    change the traced graph). With both set, top-k applies first, then the
+    nucleus is taken within the surviving set — the usual composition.
+    Returns [b, prompt + max_new_tokens]."""
+    if not 0.0 < top_p <= 1.0:
+        # top_p=0 would otherwise mask EVERY logit (empty nucleus) and
+        # degenerate to uniform sampling over the vocab — the opposite of
+        # what a caller passing 0 ("basically greedy") means. Static arg,
+        # so this raises at trace time.
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     b, prompt_len = prompt_tokens.shape
     needed = prompt_len + max_new_tokens
     max_len = max_len or needed
@@ -643,6 +653,19 @@ def sample_generate(params, prompt_tokens, key, cfg: LlamaConfig, *,
         if top_k > 0:
             kth = lax.top_k(scaled, top_k)[0][..., -1:]
             scaled = jnp.where(scaled < kth, NEG_INF_LOGIT, scaled)
+        if top_p < 1.0:
+            # Nucleus: keep the smallest logit-sorted prefix whose
+            # cumulative probability reaches top_p. A token survives when
+            # the mass STRICTLY BEFORE it is < top_p — this always keeps
+            # the argmax and includes the token that crosses the
+            # threshold. One sort over the vocab per step; the scan keeps
+            # it on-device like everything else.
+            sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            mass_before = jnp.cumsum(probs, axis=-1) - probs
+            kept = jnp.where(mass_before < top_p, sorted_desc, jnp.inf)
+            cutoff = jnp.min(kept, axis=-1, keepdims=True)
+            scaled = jnp.where(scaled < cutoff, NEG_INF_LOGIT, scaled)
         return jax.random.categorical(step_key, scaled).astype(jnp.int32)
 
     def body(carry, step_key):
@@ -704,14 +727,59 @@ def loss_fn(params, batch, cfg: LlamaConfig, *, mesh: Mesh | None = None):
     return nll.mean()
 
 
-def make_train_step(cfg: LlamaConfig, optimizer, *, mesh: Mesh | None = None):
+def make_train_step(cfg: LlamaConfig, optimizer, *, mesh: Mesh | None = None,
+                    accum_steps: int = 1):
     """Returns `train_step(params, opt_state, batch) -> (params, opt_state,
     loss)` — pure, jittable; shard via jit's in_shardings or device_put on
     the arguments (GSPMD propagates; grads of tp-sharded params come out
-    tp-sharded, dp reduction is the implicit psum from the mean loss)."""
+    tp-sharded, dp reduction is the implicit psum from the mean loss).
+
+    `accum_steps > 1` splits the batch's leading dim into that many
+    microbatches, accumulates gradients in float32 over a lax.scan, and
+    applies ONE optimizer update — the effective-batch lever when
+    activations for the full batch don't fit HBM (composes with
+    cfg.remat, which shrinks depth-wise residency the same way this
+    shrinks batch-wise)."""
 
     def train_step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh=mesh)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, batch, cfg, mesh=mesh
+            )
+        else:
+            b = batch["tokens"].shape[0]
+            if b % accum_steps:
+                raise ValueError(
+                    f"batch size {b} not divisible by accum_steps={accum_steps}"
+                )
+            # Microbatch the WHOLE batch tree, not just "tokens": any field
+            # loss_fn grows later (a loss mask, say) must split identically
+            # or the accum path would silently train on different data.
+            micro = jax.tree.map(
+                lambda x: x.reshape(accum_steps, b // accum_steps, *x.shape[1:]),
+                batch,
+            )
+
+            def accumulate(carry, micro_batch):
+                loss_sum, grad_sum = carry
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    params, micro_batch, cfg, mesh=mesh
+                )
+                grad_sum = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), grad_sum, grads
+                )
+                return (loss_sum + loss, grad_sum), None
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
+            (loss_sum, grad_sum), _ = lax.scan(
+                accumulate, (jnp.float32(0), zeros), micro
+            )
+            loss = loss_sum / accum_steps
+            grads = jax.tree.map(
+                lambda g, p: (g / accum_steps).astype(p.dtype), grad_sum, params
+            )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u.astype(p.dtype), params, updates)
         return params, opt_state, loss

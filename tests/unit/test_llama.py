@@ -454,3 +454,81 @@ def test_remat_matches_plain_loss_and_grads():
         grads_p,
         grads_r,
     )
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps=k over a batch == one step on the full batch: the mean
+    of per-microbatch mean losses equals the full-batch mean (equal sizes),
+    and the f32-accumulated, averaged grads feed the SAME optimizer update.
+    float32 end to end so only real bugs can break the tolerance."""
+    cfg = LlamaConfig.tiny(dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    optimizer = optax.adamw(1e-2)
+    opt_state = optimizer.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 17), 0, cfg.vocab_size)
+    batch = {"tokens": tokens}
+
+    p1, _, loss1 = jax.jit(make_train_step(cfg, optimizer))(
+        params, opt_state, batch
+    )
+    p2, _, loss2 = jax.jit(make_train_step(cfg, optimizer, accum_steps=2))(
+        params, opt_state, batch
+    )
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+    # Reduction order differs (sum-of-micro-means vs full-batch mean), and
+    # adamw's 1/sqrt(v) amplifies that float noise — tolerance covers it.
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=5e-5
+        ),
+        p1,
+        p2,
+    )
+
+
+def test_grad_accumulation_rejects_indivisible_batch():
+    cfg = LlamaConfig.tiny(dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    optimizer = optax.adamw(1e-2)
+    opt_state = optimizer.init(params)
+    tokens = jnp.zeros((3, 17), jnp.int32)
+    with pytest.raises(ValueError, match="not divisible"):
+        make_train_step(cfg, optimizer, accum_steps=2)(
+            params, opt_state, {"tokens": tokens}
+        )
+
+
+def test_sample_generate_top_p():
+    """top_p -> 0 keeps only the argmax (greedy); top_p=1.0 is the
+    untruncated distribution (same key => same tokens as no-top_p call)."""
+    from bee_code_interpreter_fs_tpu.models import greedy_generate, sample_generate
+
+    cfg = LlamaConfig.tiny(dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 5), 0, cfg.vocab_size)
+    key = jax.random.PRNGKey(7)
+
+    tiny_p = sample_generate(
+        params, prompt, key, cfg, max_new_tokens=8, top_p=1e-6
+    )
+    want = greedy_generate(params, prompt, cfg, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(tiny_p), np.asarray(want))
+
+    full_p = sample_generate(
+        params, prompt, key, cfg, max_new_tokens=8, top_p=1.0
+    )
+    plain = sample_generate(params, prompt, key, cfg, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(full_p), np.asarray(plain))
+
+
+def test_sample_generate_rejects_nonpositive_top_p():
+    from bee_code_interpreter_fs_tpu.models import sample_generate
+
+    cfg = LlamaConfig.tiny(dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="top_p"):
+        sample_generate(
+            params, prompt, jax.random.PRNGKey(0), cfg,
+            max_new_tokens=2, top_p=0.0,
+        )
