@@ -617,27 +617,37 @@ def greedy_generate(params, prompt_tokens, cfg: LlamaConfig, *,
     return jnp.concatenate([prompt_tokens, new_tokens.T], axis=1)
 
 
-@partial(
-    jax.jit,
-    static_argnames=("cfg", "max_new_tokens", "top_k", "top_p", "max_len"),
-)
 def sample_generate(params, prompt_tokens, key, cfg: LlamaConfig, *,
                     max_new_tokens: int, temperature=1.0, top_k: int = 0,
-                    top_p: float = 1.0, max_len: int | None = None):
+                    top_p=None, max_len: int | None = None):
     """Stochastic generation, fully jitted like greedy_generate: temperature
     scaling plus optional top-k and/or nucleus (top-p) truncation, sampled
     with jax.random (counter-based PRNG — same key, same output, any
-    device). `temperature` is a traced scalar (no recompile per setting);
-    `top_k` 0 / `top_p` 1.0 disable their truncations (both static: they
-    change the traced graph). With both set, top-k applies first, then the
-    nucleus is taken within the surviving set — the usual composition.
-    Returns [b, prompt + max_new_tokens]."""
-    if not 0.0 < top_p <= 1.0:
+    device). `temperature` and the top_p VALUE are traced scalars (sweeping
+    settings never recompiles); `top_k` is static (it changes shapes) and
+    `top_p=None` statically omits the nucleus block. With both set, top-k
+    applies first, then the nucleus is taken within the surviving set — the
+    usual composition. Returns [b, prompt + max_new_tokens]."""
+    if isinstance(top_p, (int, float)) and not 0.0 < top_p <= 1.0:
         # top_p=0 would otherwise mask EVERY logit (empty nucleus) and
         # degenerate to uniform sampling over the vocab — the opposite of
-        # what a caller passing 0 ("basically greedy") means. Static arg,
-        # so this raises at trace time.
+        # what a caller passing 0 ("basically greedy") means. Validated
+        # HERE, outside jit, where top_p is still a python number (inside
+        # the jitted impl it is a tracer); a traced top_p from a caller's
+        # own jit is their contract to keep in range.
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    return _sample_generate_jit(
+        params, prompt_tokens, key, cfg, max_new_tokens=max_new_tokens,
+        temperature=temperature, top_k=top_k, top_p=top_p, max_len=max_len,
+    )
+
+
+@partial(
+    jax.jit, static_argnames=("cfg", "max_new_tokens", "top_k", "max_len")
+)
+def _sample_generate_jit(params, prompt_tokens, key, cfg: LlamaConfig, *,
+                         max_new_tokens: int, temperature, top_k: int,
+                         top_p, max_len: int | None):
     b, prompt_len = prompt_tokens.shape
     needed = prompt_len + max_new_tokens
     max_len = max_len or needed
@@ -653,7 +663,7 @@ def sample_generate(params, prompt_tokens, key, cfg: LlamaConfig, *,
         if top_k > 0:
             kth = lax.top_k(scaled, top_k)[0][..., -1:]
             scaled = jnp.where(scaled < kth, NEG_INF_LOGIT, scaled)
-        if top_p < 1.0:
+        if top_p is not None:
             # Nucleus: keep the smallest logit-sorted prefix whose
             # cumulative probability reaches top_p. A token survives when
             # the mass STRICTLY BEFORE it is < top_p — this always keeps

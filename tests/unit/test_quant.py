@@ -138,3 +138,20 @@ def test_quantized_tree_halves_weight_bytes():
     # int8 vs bf16: ~half, plus the (tiny) per-channel scales.
     assert quant_matmul_bytes < 0.6 * full_matmul_bytes
     assert quantized_nbytes(qparams) < quantized_nbytes(params)
+
+
+def test_speculative_compose_with_quantized_models():
+    """Speculative decoding's exactness invariant must survive int8: with
+    BOTH draft and target quantized, the output still exactly equals the
+    quantized target's own greedy decode (draft = target here, the
+    every-proposal-accepted bound; content comes from the target alone)."""
+    from bee_code_interpreter_fs_tpu.models import speculative_generate
+
+    cfg = LlamaConfig.tiny(dtype="float32")
+    qparams = quantize_params(init_params(jax.random.PRNGKey(0), cfg))
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 5), 0, cfg.vocab_size)
+    want = greedy_generate(qparams, prompt, cfg, max_new_tokens=9)
+    got = speculative_generate(
+        qparams, qparams, prompt, cfg, cfg, max_new_tokens=9, gamma=3
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
