@@ -84,6 +84,24 @@ class LlamaConfig:
     def llama2_7b() -> "LlamaConfig":
         return LlamaConfig()  # defaults are the 7B shape
 
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        """Llama-3-8B geometry: GQA 32q/8kv, 128k vocab, theta 5e5."""
+        return LlamaConfig(
+            vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, hidden_dim=14336, max_seq_len=8192,
+            rope_theta=500000.0,
+        )
+
+    @staticmethod
+    def mixtral_8x7b() -> "LlamaConfig":
+        """Mixtral-8x7B geometry: 8 experts, top-2 routing, GQA 32q/8kv."""
+        return LlamaConfig(
+            vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, hidden_dim=14336, max_seq_len=32768,
+            rope_theta=1000000.0, n_experts=8, n_experts_per_token=2,
+        )
+
 
 # ---------------------------------------------------------------- params
 

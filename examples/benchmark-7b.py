@@ -15,6 +15,7 @@ weight materializes as {int8 q, f32 s} on device — so the bf16 tree never
 exists and peak HBM stays at the int8 footprint.
 """
 
+import os
 import time
 
 import jax
@@ -30,8 +31,16 @@ from bee_code_interpreter_fs_tpu.models import (
 from bee_code_interpreter_fs_tpu.models.quant import QUANTIZED_LAYER_WEIGHTS
 
 ON_TPU = jax.devices()[0].platform == "tpu"
+# BENCH_MODEL picks the geometry: llama2_7b (default) or llama3_8b — both
+# fit one v5e chip at int8 (~6.8 / ~8.6 GB incl. the bf16 embed table,
+# which stays full precision). mixtral_8x7b deliberately NOT offered:
+# 46.7B params can't fit one chip at any supported precision.
+PRESETS = ("llama2_7b", "llama3_8b")
+MODEL = os.environ.get("BENCH_MODEL", "llama2_7b")
+if MODEL not in PRESETS:
+    raise SystemExit(f"BENCH_MODEL must be one of {PRESETS}, got {MODEL!r}")
 if ON_TPU:
-    cfg = LlamaConfig.llama2_7b()
+    cfg = getattr(LlamaConfig, MODEL)()
     PREFILL_T, NEW_TOKENS, BATCH = 512, 64, 1
 else:  # correctness-check shapes for dev machines / CI
     cfg = LlamaConfig.tiny(dtype="float32")
@@ -79,7 +88,7 @@ params = build_quantized_params(jax.random.PRNGKey(0), cfg)
 jax.block_until_ready(params)
 nbytes = quantized_nbytes(params)
 print(
-    f"backend: {jax.devices()[0].platform} "
+    f"backend: {jax.devices()[0].platform} model={MODEL if ON_TPU else 'tiny'} "
     f"params={nbytes / 1e9:.2f}GB int8 (built in {time.perf_counter() - t0:.1f}s)"
 )
 

@@ -532,3 +532,21 @@ def test_sample_generate_rejects_nonpositive_top_p():
             params, prompt, jax.random.PRNGKey(0), cfg,
             max_new_tokens=2, top_p=0.0,
         )
+
+
+def test_real_model_presets_have_expected_param_counts():
+    """The well-known geometries land within 2% of their published param
+    counts (abstract shapes only — nothing materializes), and their trees
+    carry valid sharding specs."""
+    cases = [
+        (LlamaConfig.llama2_7b(), 6.74e9),
+        (LlamaConfig.llama3_8b(), 8.03e9),
+        (LlamaConfig.mixtral_8x7b(), 46.7e9),
+    ]
+    for cfg, want in cases:
+        shapes = jax.eval_shape(lambda k, c=cfg: init_params(k, c),
+                                jax.random.PRNGKey(0))
+        n = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
+        assert abs(n - want) / want < 0.02, (cfg, n, want)
+        specs = param_specs(cfg)
+        assert jax.tree.structure(specs) == jax.tree.structure(shapes)
