@@ -606,12 +606,19 @@ def speculative_generate(draft_params, target_params, prompt_tokens,
 
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "max_len"))
 def greedy_generate(params, prompt_tokens, cfg: LlamaConfig, *,
-                    max_new_tokens: int, max_len: int | None = None):
+                    max_new_tokens: int, max_len: int | None = None,
+                    eos_id=None):
     """Whole-generation greedy decode as ONE jitted program: batched prefill
     then a lax.scan over decode steps, token selection included. One device
     dispatch serves the entire generation — the per-step host round-trip
     that dominates a Python decode loop (milliseconds per token on a
     networked device) disappears. Returns [b, prompt + max_new_tokens].
+
+    `eos_id` (None = off): a row that emits it has every LATER position
+    pinned to eos_id — the fused scan's shape is static, so "stopping" is
+    per-row pinning, not early exit (the saved work would be a partial
+    scan's; batched serving pads to the longest row anyway). The value is
+    traced: changing eos ids never recompiles.
     `generate()` below is the step-by-step reference implementation."""
     b, prompt_len = prompt_tokens.shape
     needed = prompt_len + max_new_tokens
@@ -624,20 +631,27 @@ def greedy_generate(params, prompt_tokens, cfg: LlamaConfig, *,
     logits, cache = prefill(params, prompt_tokens, cache, cfg)
 
     def body(carry, i):
-        logits, cache = carry
-        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
-        logits, cache = decode_step(params, token, cache, prompt_len + i, cfg)
-        return (logits, cache), token[:, 0]
+        logits, cache, done = carry
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if eos_id is not None:
+            token = jnp.where(done, eos_id, token)
+            done = done | (token == eos_id)
+        logits, cache = decode_step(
+            params, token[:, None], cache, prompt_len + i, cfg
+        )
+        return (logits, cache, done), token
 
     _, new_tokens = lax.scan(
-        body, (logits, cache), jnp.arange(max_new_tokens)
+        body,
+        (logits, cache, jnp.zeros((b,), bool)),
+        jnp.arange(max_new_tokens),
     )
     return jnp.concatenate([prompt_tokens, new_tokens.T], axis=1)
 
 
 def sample_generate(params, prompt_tokens, key, cfg: LlamaConfig, *,
                     max_new_tokens: int, temperature=1.0, top_k: int = 0,
-                    top_p=None, max_len: int | None = None):
+                    top_p=None, max_len: int | None = None, eos_id=None):
     """Stochastic generation, fully jitted like greedy_generate: temperature
     scaling plus optional top-k and/or nucleus (top-p) truncation, sampled
     with jax.random (counter-based PRNG — same key, same output, any
@@ -645,7 +659,8 @@ def sample_generate(params, prompt_tokens, key, cfg: LlamaConfig, *,
     settings never recompiles); `top_k` is static (it changes shapes) and
     `top_p=None` statically omits the nucleus block. With both set, top-k
     applies first, then the nucleus is taken within the surviving set — the
-    usual composition. Returns [b, prompt + max_new_tokens]."""
+    usual composition. `eos_id` pins a row's positions after its first eos
+    (see greedy_generate). Returns [b, prompt + max_new_tokens]."""
     if isinstance(top_p, (int, float)) and not 0.0 < top_p <= 1.0:
         # top_p=0 would otherwise mask EVERY logit (empty nucleus) and
         # degenerate to uniform sampling over the vocab — the opposite of
@@ -657,6 +672,7 @@ def sample_generate(params, prompt_tokens, key, cfg: LlamaConfig, *,
     return _sample_generate_jit(
         params, prompt_tokens, key, cfg, max_new_tokens=max_new_tokens,
         temperature=temperature, top_k=top_k, top_p=top_p, max_len=max_len,
+        eos_id=eos_id,
     )
 
 
@@ -665,7 +681,7 @@ def sample_generate(params, prompt_tokens, key, cfg: LlamaConfig, *,
 )
 def _sample_generate_jit(params, prompt_tokens, key, cfg: LlamaConfig, *,
                          max_new_tokens: int, temperature, top_k: int,
-                         top_p, max_len: int | None):
+                         top_p, max_len: int | None, eos_id):
     b, prompt_len = prompt_tokens.shape
     needed = prompt_len + max_new_tokens
     max_len = max_len or needed
@@ -697,14 +713,19 @@ def _sample_generate_jit(params, prompt_tokens, key, cfg: LlamaConfig, *,
         return jax.random.categorical(step_key, scaled).astype(jnp.int32)
 
     def body(carry, step_key):
-        logits, cache, pos = carry
-        token = pick(step_key, logits)[:, None]
-        logits, cache = decode_step(params, token, cache, pos, cfg)
-        return (logits, cache, pos + 1), token[:, 0]
+        logits, cache, pos, done = carry
+        token = pick(step_key, logits)
+        if eos_id is not None:
+            token = jnp.where(done, eos_id, token)
+            done = done | (token == eos_id)
+        logits, cache = decode_step(params, token[:, None], cache, pos, cfg)
+        return (logits, cache, pos + 1, done), token
 
     step_keys = jax.random.split(key, max_new_tokens)
     _, new_tokens = lax.scan(
-        body, (logits, cache, jnp.int32(prompt_len)), step_keys
+        body,
+        (logits, cache, jnp.int32(prompt_len), jnp.zeros((b,), bool)),
+        step_keys,
     )
     return jnp.concatenate([prompt_tokens, new_tokens.T], axis=1)
 

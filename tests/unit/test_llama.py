@@ -550,3 +550,37 @@ def test_real_model_presets_have_expected_param_counts():
         assert abs(n - want) / want < 0.02, (cfg, n, want)
         specs = param_specs(cfg)
         assert jax.tree.structure(specs) == jax.tree.structure(shapes)
+
+
+def test_eos_pinning_matches_unpinned_prefix():
+    """With eos_id set to a token the unpinned greedy decode actually
+    emits, the pinned run must equal the unpinned one up to and including
+    that first occurrence, and be all-eos after it."""
+    from bee_code_interpreter_fs_tpu.models import greedy_generate, sample_generate
+
+    cfg = LlamaConfig.tiny(dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(11), (1, 5), 0, cfg.vocab_size)
+    plain = np.asarray(greedy_generate(params, prompt, cfg, max_new_tokens=10))
+    new = plain[0, 5:]
+    eos = int(new[3])  # pretend the 4th generated token is the eos id
+    first = int(np.argmax(new == eos))  # its first occurrence may be earlier
+
+    pinned = np.asarray(
+        greedy_generate(params, prompt, cfg, max_new_tokens=10, eos_id=eos)
+    )[0, 5:]
+    np.testing.assert_array_equal(pinned[: first + 1], new[: first + 1])
+    assert (pinned[first + 1 :] == eos).all(), pinned
+
+    # Same contract for the sampler (deterministic under one key).
+    key = jax.random.PRNGKey(3)
+    s_plain = np.asarray(
+        sample_generate(params, prompt, key, cfg, max_new_tokens=10)
+    )[0, 5:]
+    s_eos = int(s_plain[2])
+    s_first = int(np.argmax(s_plain == s_eos))
+    s_pinned = np.asarray(
+        sample_generate(params, prompt, key, cfg, max_new_tokens=10, eos_id=s_eos)
+    )[0, 5:]
+    np.testing.assert_array_equal(s_pinned[: s_first + 1], s_plain[: s_first + 1])
+    assert (s_pinned[s_first + 1 :] == s_eos).all(), s_pinned
