@@ -23,6 +23,7 @@ from bee_code_interpreter_fs_tpu.models.llama import (
     prefill,
     sample_generate,
     speculative_generate,
+    speculative_sample_generate,
 )
 from bee_code_interpreter_fs_tpu.models.quant import (
     quantize_params,
@@ -45,6 +46,7 @@ __all__ = [
     "prefill",
     "sample_generate",
     "speculative_generate",
+    "speculative_sample_generate",
     "quantize_params",
     "quantized_nbytes",
     "quantized_param_specs",

@@ -493,6 +493,38 @@ def decode_chunk(params, tokens, cache, pos, cfg: LlamaConfig):
     return logits, {"k": new_k, "v": new_v}
 
 
+def _spec_setup(draft_params, target_params, prompt_tokens, cfg_draft,
+                cfg_target, *, max_new_tokens, gamma, max_len, plain_decoder):
+    """Shared speculative preamble: validation, cache sizing (slack: the
+    last pass may overshoot max_new_tokens by up to γ), dual prefill, and
+    the output buffer with the prompt written. Mirrors greedy/
+    sample_generate on max_len: an explicit value that can't hold the
+    generation is a caller error, never silently enlarged — a caller sizing
+    sharded caches by max_len must get what it asked for."""
+    if cfg_draft.vocab_size != cfg_target.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    if gamma < 1:
+        raise ValueError(
+            "gamma must be >= 1 (0 proposals leaves nothing to verify; "
+            f"use {plain_decoder} for plain decoding)"
+        )
+    b, p = prompt_tokens.shape
+    total = p + max_new_tokens + gamma + 1
+    if max_len is None:
+        max_len = total
+    elif max_len < total:
+        raise ValueError(
+            f"max_len={max_len} < prompt+new+gamma+1={total}: cache too small"
+        )
+    d_cache = init_cache(cfg_draft, b, max_len)
+    t_cache = init_cache(cfg_target, b, max_len)
+    t_logits, t_cache = prefill(target_params, prompt_tokens, t_cache, cfg_target)
+    _, d_cache = prefill(draft_params, prompt_tokens, d_cache, cfg_draft)
+    buf = jnp.zeros((b, total), jnp.int32)
+    buf = lax.dynamic_update_slice(buf, prompt_tokens, (0, 0))
+    return b, p, total, d_cache, t_cache, t_logits, buf
+
+
 @partial(
     jax.jit,
     static_argnames=("cfg_draft", "cfg_target", "max_new_tokens", "gamma", "max_len"),
@@ -518,33 +550,11 @@ def speculative_generate(draft_params, target_params, prompt_tokens,
     that rarely agrees makes this SLOWER than greedy_generate — measure
     acceptance before deploying a draft.
     """
-    if cfg_draft.vocab_size != cfg_target.vocab_size:
-        raise ValueError("draft and target must share a vocabulary")
-    if gamma < 1:
-        raise ValueError(
-            "gamma must be >= 1 (0 proposals leaves nothing to verify; "
-            "use greedy_generate for plain decoding)"
-        )
-    b, p = prompt_tokens.shape
-    # Slack: the last pass may overshoot max_new_tokens by up to γ.
-    total = p + max_new_tokens + gamma + 1
-    # Mirror greedy/sample_generate: an explicit max_len that can't hold the
-    # generation is a caller error, not something to silently enlarge — a
-    # caller sizing sharded caches by max_len must get what it asked for.
-    if max_len is None:
-        max_len = total
-    elif max_len < total:
-        raise ValueError(
-            f"max_len={max_len} < prompt+new+gamma+1={total}: cache too small"
-        )
-
-    d_cache = init_cache(cfg_draft, b, max_len)
-    t_cache = init_cache(cfg_target, b, max_len)
-    t_logits, t_cache = prefill(target_params, prompt_tokens, t_cache, cfg_target)
-    _, d_cache = prefill(draft_params, prompt_tokens, d_cache, cfg_draft)
-
-    buf = jnp.zeros((b, total), jnp.int32)
-    buf = lax.dynamic_update_slice(buf, prompt_tokens, (0, 0))
+    b, p, total, d_cache, t_cache, t_logits, buf = _spec_setup(
+        draft_params, target_params, prompt_tokens, cfg_draft, cfg_target,
+        max_new_tokens=max_new_tokens, gamma=gamma, max_len=max_len,
+        plain_decoder="greedy_generate",
+    )
     buf = buf.at[:, p].set(jnp.argmax(t_logits, axis=-1).astype(jnp.int32))
     # Invariant at the top of each pass: n_done tokens emitted; both caches
     # hold positions 0..L-1 where L = p + n_done - 1; the newest emitted
@@ -600,6 +610,143 @@ def speculative_generate(draft_params, target_params, prompt_tokens,
 
     buf, _, _, _ = lax.while_loop(
         cond, body, (buf, jnp.int32(1), d_cache, t_cache)
+    )
+    return buf[:, : p + max_new_tokens]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg_draft", "cfg_target", "max_new_tokens", "gamma", "max_len"),
+)
+def speculative_sample_generate(draft_params, target_params, prompt_tokens,
+                                key, cfg_draft: LlamaConfig,
+                                cfg_target: LlamaConfig, *,
+                                max_new_tokens: int, gamma: int = 4,
+                                temperature=1.0, max_len: int | None = None):
+    """SAMPLED speculative decoding (the accept/resample algorithm of
+    speculative sampling — PAPERS.md; `speculative_generate` above is its
+    greedy special case). Per pass: the draft samples γ proposals
+    autoregressively at `temperature`; the target scores the chunk in one
+    decode_chunk forward; proposal d_j is accepted with probability
+    min(1, p_j(d_j)/q_j(d_j)), the first rejection resamples from
+    norm(max(0, p_j − q_j)), and a fully-accepted pass samples one extra
+    token from p_{γ+1}. The emitted sequence is distributed EXACTLY as
+    target-only ancestral sampling at the same temperature — the draft
+    decides speed, never the distribution.
+
+    Batch rows advance in lockstep by the BATCH-MINIMUM acceptance (same
+    trade as speculative_generate): the token at the boundary position is
+    per-row correct — rows that accepted further keep their accepted draft
+    token, rows that rejected there get the residual resample — and every
+    later position is rewritten by the next pass before it can be emitted.
+    `temperature` is traced; the whole generation is ONE jitted program.
+    """
+    temp = jnp.maximum(temperature, 1e-6)
+    b, p, total, d_cache, t_cache, t_logits, buf = _spec_setup(
+        draft_params, target_params, prompt_tokens, cfg_draft, cfg_target,
+        max_new_tokens=max_new_tokens, gamma=gamma, max_len=max_len,
+        plain_decoder="sample_generate",
+    )
+    key, k0 = jax.random.split(key)
+    buf = buf.at[:, p].set(
+        jax.random.categorical(k0, t_logits / temp).astype(jnp.int32)
+    )
+    # Same invariant as speculative_generate: n_done emitted, caches cover
+    # 0..L-1, newest emitted token at buf[:, L] not yet fed to either model.
+
+    def cond(state):
+        _, n_done, _, _, _ = state
+        return n_done < max_new_tokens
+
+    def body(state):
+        buf, n_done, d_cache, t_cache, key = state
+        key, k_draft, k_accept, k_res, k_extra = jax.random.split(key, 5)
+        L = p + n_done - 1
+        pending = lax.dynamic_slice(buf, (0, L), (b, 1))[:, 0]
+
+        # Draft rollout, γ+1 steps (the extra step keeps the draft cache
+        # covering L+γ for the all-accepted case), SAMPLING each proposal
+        # and keeping its full logits row for the acceptance ratio.
+        def droll(carry, inputs):
+            j, step_key = inputs
+            tok, cache = carry
+            logits, cache = decode_step(
+                draft_params, tok[:, None], cache, L + j, cfg_draft
+            )
+            nxt = jax.random.categorical(step_key, logits / temp)
+            return (nxt.astype(jnp.int32), cache), (nxt.astype(jnp.int32), logits)
+
+        (_, d_cache), (props, q_logits) = lax.scan(
+            droll,
+            (pending, d_cache),
+            (jnp.arange(gamma + 1), jax.random.split(k_draft, gamma + 1)),
+        )
+        drafts = props[:gamma].T  # [b, γ]
+        q_probs = jax.nn.softmax(
+            q_logits[:gamma].transpose(1, 0, 2) / temp, axis=-1
+        )  # [b, γ, V]
+
+        chunk = jnp.concatenate([pending[:, None], drafts], axis=1)
+        v_logits, t_cache = decode_chunk(
+            target_params, chunk, t_cache, L, cfg_target
+        )
+        p_probs = jax.nn.softmax(v_logits / temp, axis=-1)  # [b, γ+1, V]
+
+        # Acceptance: d_j accepted with prob min(1, p_j(d_j)/q_j(d_j)).
+        p_at_draft = jnp.take_along_axis(
+            p_probs[:, :gamma], drafts[..., None], axis=-1
+        )[..., 0]
+        q_at_draft = jnp.take_along_axis(
+            q_probs, drafts[..., None], axis=-1
+        )[..., 0]
+        ratio = p_at_draft / jnp.maximum(q_at_draft, 1e-30)
+        u = jax.random.uniform(k_accept, (b, gamma))
+        # Strict <: uniform() can return exactly 0.0, and 0.0 <= 0.0 would
+        # accept a token the target gives ZERO probability (visible in the
+        # greedy limit, where disagreeing proposals underflow to p=0).
+        accepted = u < ratio
+        row_accept = jnp.where(
+            accepted.all(axis=1), gamma, jnp.argmin(accepted, axis=1)
+        )
+        accept = jnp.min(row_accept)
+
+        # Boundary token at position L+1+accept, per row:
+        # - rows still accepting there keep their draft token;
+        # - rows rejecting there resample from the residual
+        #   norm(max(0, p − q)) (+eps so an exact p==q tie — a
+        #   probability-zero rejection — stays finite);
+        # - when every row accepted everything (accept == γ), sample the
+        #   bonus token from p_{γ+1}.
+        idx = jnp.minimum(accept, gamma - 1)
+        p_at = lax.dynamic_index_in_dim(p_probs, accept, 1, keepdims=False)
+        q_at = lax.dynamic_index_in_dim(q_probs, idx, 1, keepdims=False)
+        residual = jnp.clip(p_at - q_at, 0.0, None)
+        resample = jax.random.categorical(
+            k_res, jnp.log(residual + 1e-30)
+        ).astype(jnp.int32)
+        extra = jax.random.categorical(
+            k_extra, jnp.log(p_at + 1e-30)
+        ).astype(jnp.int32)
+        rejected_token = jnp.where(accept == gamma, extra, resample)
+        draft_token = lax.dynamic_index_in_dim(
+            drafts, idx, 1, keepdims=False
+        )
+        final = jnp.where(row_accept > accept, draft_token, rejected_token)
+
+        # Emit d_1..d_accept then `final`; junk past the boundary is
+        # rewritten by the next pass before it can be emitted (same
+        # argument as speculative_generate's whole-row write).
+        row = jnp.concatenate(
+            [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1
+        )
+        row = jnp.where(
+            jnp.arange(gamma + 1)[None, :] == accept, final[:, None], row
+        )
+        buf = lax.dynamic_update_slice(buf, row, (0, L + 1))
+        return buf, n_done + accept + 1, d_cache, t_cache, key
+
+    buf, _, _, _, _ = lax.while_loop(
+        cond, body, (buf, jnp.int32(1), d_cache, t_cache, key)
     )
     return buf[:, : p + max_new_tokens]
 

@@ -99,3 +99,28 @@ print(f"exact_match=True")
 print(f"GREEDY_TOKS={NEW_TOKENS / t_greedy:.1f}")
 print(f"SPEC_TOKS={NEW_TOKENS / t_spec:.1f}")
 print(f"SPEC_SPEEDUP={t_greedy / t_spec:.2f}")
+
+# Sampled variant (accept/resample at temperature): same trained pair, so
+# proposals still mostly agree; baseline is plain fused ancestral sampling.
+from bee_code_interpreter_fs_tpu.models import (  # noqa: E402
+    sample_generate,
+    speculative_sample_generate,
+)
+
+TEMP = 0.8
+key = jax.random.PRNGKey(5)
+_, t_sample = timed(
+    lambda: sample_generate(
+        target, prompt, key, cfg_t, max_new_tokens=NEW_TOKENS,
+        temperature=TEMP,
+    )
+)
+_, t_spec_sample = timed(
+    lambda: speculative_sample_generate(
+        draft, target, prompt, key, cfg_d, cfg_t,
+        max_new_tokens=NEW_TOKENS, gamma=GAMMA, temperature=TEMP,
+    )
+)
+print(f"SAMPLE_TOKS={NEW_TOKENS / t_sample:.1f}")
+print(f"SPEC_SAMPLE_TOKS={NEW_TOKENS / t_spec_sample:.1f}")
+print(f"SPEC_SAMPLE_SPEEDUP={t_sample / t_spec_sample:.2f}")

@@ -584,3 +584,72 @@ def test_eos_pinning_matches_unpinned_prefix():
     )[0, 5:]
     np.testing.assert_array_equal(s_pinned[: s_first + 1], s_plain[: s_first + 1])
     assert (s_pinned[s_first + 1 :] == s_eos).all(), s_pinned
+
+
+def test_speculative_sampling_low_temperature_equals_greedy():
+    """temperature -> 0 collapses sampled speculative decoding to the
+    greedy algorithm: proposals become draft argmaxes, acceptance becomes
+    token equality, resampling becomes the target argmax — so the output
+    must EXACTLY equal greedy_generate(target), even with a disagreeing
+    draft driving constant rejections."""
+    from bee_code_interpreter_fs_tpu.models import (
+        greedy_generate,
+        speculative_sample_generate,
+    )
+
+    cfg = LlamaConfig.tiny(dtype="float32")
+    target = init_params(jax.random.PRNGKey(0), cfg)
+    draft = init_params(jax.random.PRNGKey(1), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 5), 0, cfg.vocab_size)
+    want = greedy_generate(target, prompt, cfg, max_new_tokens=9)
+    got = speculative_sample_generate(
+        draft, target, prompt, jax.random.PRNGKey(3), cfg, cfg,
+        max_new_tokens=9, gamma=3, temperature=1e-4,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_speculative_sampling_matches_target_distribution():
+    """The speculative-sampling invariant: emitted tokens are distributed
+    exactly as target-only ancestral sampling. Empirical check on a tiny
+    vocab — the batch dimension IS the trial count — against the exact
+    target distributions computed from its own logits. A disagreeing draft
+    keeps the accept/resample path hot (acceptance is rare)."""
+    from bee_code_interpreter_fs_tpu.models import speculative_sample_generate
+
+    cfg = LlamaConfig.tiny(
+        dtype="float32", vocab_size=16, dim=32, n_layers=2, n_heads=2,
+        n_kv_heads=2, hidden_dim=64, max_seq_len=32,
+    )
+    target = init_params(jax.random.PRNGKey(0), cfg)
+    draft = init_params(jax.random.PRNGKey(1), cfg)
+    base_prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 4), 0, 16)
+    N = 8192
+    prompt = jnp.tile(base_prompt, (N, 1))
+
+    out = np.asarray(
+        speculative_sample_generate(
+            draft, target, prompt, jax.random.PRNGKey(3), cfg, cfg,
+            max_new_tokens=2, gamma=2, temperature=1.0,
+        )
+    )
+    t1, t2 = out[:, 4], out[:, 5]
+
+    # Exact target marginals: p(t1) from the prompt's last logits; p(t2)
+    # marginalized over every possible t1 continuation.
+    logits1 = np.asarray(forward(target, base_prompt, cfg))[0, -1]
+    p1 = np.exp(logits1 - logits1.max())
+    p1 /= p1.sum()
+    p2 = np.zeros(16)
+    for v in range(16):
+        ext = jnp.concatenate(
+            [base_prompt, jnp.full((1, 1), v, jnp.int32)], axis=1
+        )
+        lv = np.asarray(forward(target, ext, cfg))[0, -1]
+        pv = np.exp(lv - lv.max())
+        p2 += p1[v] * pv / pv.sum()
+
+    for emp_tokens, exact in ((t1, p1), (t2, p2)):
+        emp = np.bincount(emp_tokens, minlength=16) / N
+        tv = 0.5 * np.abs(emp - exact).sum()
+        assert tv < 0.06, (tv, emp, exact)
