@@ -64,6 +64,13 @@ class LlamaConfig:
     # for training drops from O(n_layers·b·t·dim) to ~one block, for one
     # extra forward's FLOPs — how long-context training fits HBM.
     remat: bool = False
+    # Sequence-parallel strategy when the mesh's "sp" axis is > 1:
+    # "ring" streams K/V chunks around the ring (bandwidth-optimal,
+    # parallel/ring_attention.py) while "ulysses" repartitions via two
+    # all-to-alls and runs full-sequence attention on a head subset per
+    # device (latency-friendly; heads are also tp-sharded, so it needs
+    # (n_heads / tp) % sp == 0 — parallel/ulysses.py).
+    sp_impl: str = "ring"
 
     @property
     def head_dim(self) -> int:
@@ -301,20 +308,35 @@ def transformer_block(x, lp, cfg: LlamaConfig, attn_fn, *, rope_offset=0):
 def forward(params, tokens, cfg: LlamaConfig, *, mesh: Mesh | None = None):
     """Token ids [b, t] -> logits [b, t, vocab] (float32).
 
-    If `mesh` has an "sp" axis of size > 1, attention runs as ring attention
-    over sequence shards (shard_map + ppermute); otherwise plain fused causal
-    attention — XLA's GSPMD handles dp/tp either way.
+    If `mesh` has an "sp" axis of size > 1, attention runs sequence-parallel
+    with the strategy cfg.sp_impl selects — "ring" (shard_map + ppermute
+    K/V streaming) or "ulysses" (two all_to_alls, full-sequence attention
+    on a head subset per device); otherwise plain fused causal attention.
+    XLA's GSPMD handles dp/tp either way.
     """
     dt = jnp.dtype(cfg.dtype)
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     scale = hd ** -0.5
     use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
     if use_ring:
-        # attn_impl="flash" composes: the Pallas partial kernel computes each
-        # ring step's local contribution (no per-chunk-pair score tensor).
+        # attn_impl="flash" composes with BOTH sp strategies: ring uses the
+        # Pallas partial kernel per step (no per-chunk-pair score tensor);
+        # ulysses runs the full flash kernel over the gathered sequence.
+        if cfg.sp_impl == "ulysses":
+            from bee_code_interpreter_fs_tpu.parallel.ulysses import (
+                ulysses_attention,
+            )
+
+            sp_fn = ulysses_attention
+        elif cfg.sp_impl == "ring":
+            sp_fn = ring_attention
+        else:
+            raise ValueError(
+                f"sp_impl must be 'ring' or 'ulysses', got {cfg.sp_impl!r}"
+            )
         ring = shard_map(
             partial(
-                ring_attention,
+                sp_fn,
                 axis_name="sp",
                 scale=scale,
                 use_flash=cfg.attn_impl == "flash",

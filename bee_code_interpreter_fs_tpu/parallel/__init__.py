@@ -32,6 +32,7 @@ from bee_code_interpreter_fs_tpu.parallel.pipeline import (
     pipelined_transformer,
 )
 from bee_code_interpreter_fs_tpu.parallel.ring_attention import ring_attention
+from bee_code_interpreter_fs_tpu.parallel.ulysses import ulysses_attention
 
 __all__ = [
     "MeshSpec",
@@ -43,6 +44,7 @@ __all__ = [
     "ring_all_reduce",
     "ring_permute",
     "ring_attention",
+    "ulysses_attention",
     "pipeline_apply",
     "pipeline_stages",
     "pipelined_transformer",

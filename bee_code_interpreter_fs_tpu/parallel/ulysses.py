@@ -1,0 +1,107 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence repartition.
+
+The second of the two long-context strategies (SURVEY.md §2's parallelism
+census names both; the reference has neither). Where ring attention
+(parallel/ring_attention.py) STREAMS K/V chunks around the ring —
+bandwidth-optimal, n−1 neighbor hops, memory O(chunk²) per step — Ulysses
+REPARTITIONS: one all-to-all turns sequence sharding into head sharding, so
+each device computes ordinary full-sequence attention for h/n of the heads,
+and a second all-to-all turns the result back. Two collectives total per
+attention call (latency-friendly), full-sequence attention locally (so the
+fused flash kernel applies unchanged over the whole sequence), at the cost
+of requiring the LOCAL head count to divide by the axis size — with heads
+also tensor-parallel that means (n_heads / tp) % sp == 0 — and O(t·h/n·d)
+local residency.
+
+Reference pattern: DeepSpeed-Ulysses (PAPERS.md); implementation is
+original, built on lax.all_to_all inside shard_map.
+
+Called inside `shard_map` with q/k/v already local sequence chunks:
+    out = ulysses_attention(q, k, v, axis_name="sp")   # [b, Tc, H, D] each
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = True,
+                      scale=None, use_flash: bool = False,
+                      flash_interpret: bool = False):
+    """Exact attention where q, k, v are per-device sequence chunks.
+
+    Args:
+      q, k, v: [batch, chunk_len, heads, head_dim] local shards (kv heads
+        must equal q heads — expand GQA first, as ring_attention requires).
+      axis_name: mesh axis the sequence is sharded over; the heads arriving
+        HERE (already tp-local under shard_map) must divide by its size,
+        i.e. (n_heads / tp) % sp == 0 for the model path.
+      causal: standard causal mask (positions are global after the gather,
+        so no offset bookkeeping is needed — that's Ulysses' simplicity).
+      use_flash: run the local full-sequence attention through the Pallas
+        flash kernel (ops/flash_attention.py) instead of the dense path.
+
+    Returns the local output chunk [batch, chunk_len, heads, head_dim].
+    """
+    n = lax.axis_size(axis_name)
+    b, t_local, h, d = q.shape
+    if h % n:
+        raise ValueError(
+            f"ulysses needs n_heads % axis_size == 0, got {h} % {n}"
+        )
+    if scale is None:
+        scale = d ** -0.5
+    if n == 1:
+        return _local_attention(
+            q, k, v, causal=causal, scale=scale, use_flash=use_flash,
+            flash_interpret=flash_interpret,
+        ).astype(q.dtype)
+
+    def seq_to_heads(x):
+        # [b, t/n, h, d] --all_to_all--> [b, t, h/n, d]: each device trades
+        # its head range for every other device's sequence range. Chunks
+        # concatenate in axis-index order, which IS global sequence order
+        # under the standard contiguous sp sharding.
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def heads_to_seq(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = _local_attention(
+        qh, kh, vh, causal=causal, scale=scale, use_flash=use_flash,
+        flash_interpret=flash_interpret,
+    )
+    return heads_to_seq(out.astype(q.dtype))
+
+
+def _local_attention(q, k, v, *, causal, scale, use_flash, flash_interpret):
+    """Full-sequence attention over a local head subset: the Pallas flash
+    kernel (causal only) or the masked-dense formulation."""
+    if use_flash and causal:
+        from bee_code_interpreter_fs_tpu.ops.flash_attention import (
+            flash_attention,
+        )
+
+        return flash_attention(
+            q, k, v, scale=scale, interpret=flash_interpret
+        )
+    b, t, h, d = q.shape
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out

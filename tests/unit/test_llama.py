@@ -653,3 +653,19 @@ def test_speculative_sampling_matches_target_distribution():
         emp = np.bincount(emp_tokens, minlength=16) / N
         tv = 0.5 * np.abs(emp - exact).sum()
         assert tv < 0.06, (tv, emp, exact)
+
+
+def test_ulysses_forward_matches():
+    """forward(mesh with sp>1, cfg.sp_impl='ulysses') == plain forward —
+    the all-to-all strategy slots into the model exactly where ring
+    attention does."""
+    cfg = LlamaConfig.tiny(dtype="float32", sp_impl="ulysses")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh(best_mesh_shape(8, tp=2, sp=2))
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 32), 0, cfg.vocab_size)
+    expected = forward(params, tokens, LlamaConfig.tiny(dtype="float32"))
+
+    sharded_params = shard_pytree(mesh, params, param_specs(cfg))
+    got = jax.jit(lambda p, t: forward(p, t, cfg, mesh=mesh))(sharded_params, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=5e-3, atol=5e-3)
