@@ -25,6 +25,7 @@ from bee_code_interpreter_fs_tpu.models.llama import (
     speculative_generate,
     speculative_sample_generate,
 )
+from bee_code_interpreter_fs_tpu.models.hf_convert import from_hf_state_dict
 from bee_code_interpreter_fs_tpu.models.quant import (
     quantize_params,
     quantized_nbytes,
@@ -36,6 +37,7 @@ __all__ = [
     "decode_chunk",
     "decode_step",
     "forward",
+    "from_hf_state_dict",
     "generate",
     "greedy_generate",
     "init_cache",

@@ -1,0 +1,154 @@
+"""HuggingFace Llama/Mixtral checkpoint → this framework's param tree.
+
+A user switching from any HF-format Llama-family checkpoint gets the exact
+model here: `from_hf_state_dict(state_dict, cfg)` maps transformers' naming
+(`model.layers.N.self_attn.q_proj.weight`, …) onto the stacked-layer tree
+`init_params` produces, transposing projections to our [in, out] layout and
+stacking layers along axis 0 (the lax.scan axis).
+
+The one genuinely subtle step is RoPE: transformers stores q/k projection
+rows in the ROTATE-HALF layout (the rotation pairs dimension i with
+i + head_dim/2), while models/llama.py applies the INTERLEAVED convention
+(pairs 2i / 2i+1 — the original GPT-J/Llama formulation). The two are
+equivalent under a fixed permutation of each head's output rows, applied
+here once at conversion time (`_unpermute_rope`), so runtime kernels stay
+permutation-free. Correctness is pinned by tests/unit/test_hf_convert.py:
+logits parity against transformers' own forward pass on randomly
+initialized tiny models (dense, GQA, and Mixtral-MoE).
+
+Tensors are accepted as anything numpy can view (torch CPU tensors
+included); nothing here imports torch or transformers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def _np(t) -> np.ndarray:
+    """View a checkpoint tensor (torch / numpy / array-like) as numpy.
+    Published checkpoints ship bfloat16, which numpy cannot view — upcast
+    those to float32 first (the tree is re-cast to the target dtype
+    anyway)."""
+    detach = getattr(t, "detach", None)
+    if detach is not None:
+        t = detach()
+    if getattr(getattr(t, "dtype", None), "itemsize", None) == 2 and "bfloat16" in str(
+        getattr(t, "dtype", "")
+    ):
+        t = t.float()
+    return np.asarray(t)
+
+
+def _unpermute_rope(w: np.ndarray, n_heads: int) -> np.ndarray:
+    """Reorder a [n_heads*hd, in] projection's output rows from HF's
+    rotate-half layout to the interleaved layout _rope expects: per head,
+    row 2i comes from i, row 2i+1 from i + hd/2."""
+    out_dim, in_dim = w.shape
+    hd = out_dim // n_heads
+    half = hd // 2
+    w = w.reshape(n_heads, hd, in_dim)
+    interleaved = np.empty_like(w)
+    interleaved[:, 0::2] = w[:, :half]
+    interleaved[:, 1::2] = w[:, half:]
+    return interleaved.reshape(out_dim, in_dim)
+
+
+def from_hf_state_dict(state_dict, cfg, dtype=None):
+    """Build this framework's param tree from a HF Llama/Mixtral state dict.
+
+    Args:
+      state_dict: mapping of HF parameter names to tensors (torch's
+        `model.state_dict()`, a safetensors file's dict, …).
+      cfg: the matching LlamaConfig (shapes are validated implicitly by the
+        reshapes; set n_experts for Mixtral checkpoints).
+      dtype: leaf dtype for the converted weights; default cfg.dtype.
+
+    Returns the same tree structure as init_params(cfg) — drop-in for
+    forward/prefill/generate/quantize_params.
+    """
+    dt = jnp.dtype(cfg.dtype if dtype is None else dtype)
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    L = cfg.n_layers
+
+    def take(fmt, i):
+        return sd[fmt.format(i=i)]
+
+    def stack(fmt, transform=lambda w: w):
+        return jnp.asarray(
+            np.stack([transform(take(fmt, i)) for i in range(L)]), dt
+        )
+
+    tl = "model.layers.{i}."
+    layers = {
+        "attn_norm": jnp.asarray(
+            np.stack([take(tl + "input_layernorm.weight", i) for i in range(L)]),
+            jnp.float32,
+        ),
+        "mlp_norm": jnp.asarray(
+            np.stack(
+                [take(tl + "post_attention_layernorm.weight", i) for i in range(L)]
+            ),
+            jnp.float32,
+        ),
+        # HF projections are [out, in]; ours are [in, out] → transpose.
+        # q/k additionally unpermute to the interleaved RoPE layout.
+        "wq": stack(
+            tl + "self_attn.q_proj.weight",
+            lambda w: _unpermute_rope(w, cfg.n_heads).T,
+        ),
+        "wk": stack(
+            tl + "self_attn.k_proj.weight",
+            lambda w: _unpermute_rope(w, cfg.n_kv_heads).T,
+        ),
+        "wv": stack(tl + "self_attn.v_proj.weight", lambda w: w.T),
+        "wo": stack(tl + "self_attn.o_proj.weight", lambda w: w.T),
+    }
+    if cfg.n_experts > 0:
+        E = cfg.n_experts
+        moe = tl + "block_sparse_moe."
+
+        def experts(wname):
+            return jnp.asarray(
+                np.stack(
+                    [
+                        np.stack(
+                            [
+                                sd[moe.format(i=i) + f"experts.{e}.{wname}.weight"].T
+                                for e in range(E)
+                            ]
+                        )
+                        for i in range(L)
+                    ]
+                ),
+                dt,
+            )
+
+        layers.update(
+            {
+                "router": stack(moe + "gate.weight", lambda w: w.T),
+                "w_gate": experts("w1"),
+                "w_down": experts("w2"),
+                "w_up": experts("w3"),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w_gate": stack(tl + "mlp.gate_proj.weight", lambda w: w.T),
+                "w_up": stack(tl + "mlp.up_proj.weight", lambda w: w.T),
+                "w_down": stack(tl + "mlp.down_proj.weight", lambda w: w.T),
+            }
+        )
+
+    # Tied-embedding checkpoints (e.g. Llama-3.2-1B/3B) omit lm_head from
+    # safetensors files (shared tensors aren't serialized) — the head IS
+    # the embedding, transposed.
+    lm_head = sd.get("lm_head.weight", sd["model.embed_tokens.weight"])
+    return {
+        "embed": jnp.asarray(sd["model.embed_tokens.weight"], dt),
+        "layers": layers,
+        "final_norm": jnp.asarray(sd["model.norm.weight"], jnp.float32),
+        "lm_head": jnp.asarray(lm_head.T, dt),
+    }

@@ -350,7 +350,13 @@ def forward(params, tokens, cfg: LlamaConfig, *, mesh: Mesh | None = None):
 
     x = params["embed"].astype(dt)[tokens]  # [b, t, dim]
     if use_ring:
-        attn_fn = lambda q, k, v: ring(q, *_expand_gqa(k, v, nh))  # noqa: E731
+        if cfg.sp_impl == "ulysses":
+            # Ulysses takes UNexpanded kv: when the kv head count divides
+            # sp it rides the all-to-alls at 1/rep the bytes and expands
+            # after the repartition (parallel/ulysses.py).
+            attn_fn = lambda q, k, v: ring(q, k, v)  # noqa: E731
+        else:
+            attn_fn = lambda q, k, v: ring(q, *_expand_gqa(k, v, nh))  # noqa: E731
     elif cfg.attn_impl == "flash":
         from bee_code_interpreter_fs_tpu.ops.flash_attention import (
             flash_attention,

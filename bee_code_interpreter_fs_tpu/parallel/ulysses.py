@@ -33,11 +33,17 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = True,
     """Exact attention where q, k, v are per-device sequence chunks.
 
     Args:
-      q, k, v: [batch, chunk_len, heads, head_dim] local shards (kv heads
-        must equal q heads — expand GQA first, as ring_attention requires).
-      axis_name: mesh axis the sequence is sharded over; the heads arriving
-        HERE (already tp-local under shard_map) must divide by its size,
-        i.e. (n_heads / tp) % sp == 0 for the model path.
+      q: [batch, chunk_len, heads, head_dim] local shard.
+      k, v: same, but MAY carry fewer (GQA) heads than q — unlike
+        ring_attention, don't expand first: when the kv head count also
+        divides the axis size, the unexpanded k/v ride the all-to-alls
+        (1/rep of the bytes over ICI) and expand LOCALLY after the
+        repartition — contiguous head slices line up exactly with the
+        repeat-interleave pairing _expand_gqa uses. Otherwise they expand
+        before as a fallback.
+      axis_name: mesh axis the sequence is sharded over; the q heads
+        arriving HERE (already tp-local under shard_map) must divide by
+        its size, i.e. (n_heads / tp) % sp == 0 for the model path.
       causal: standard causal mask (positions are global after the gather,
         so no offset bookkeeping is needed — that's Ulysses' simplicity).
       use_flash: run the local full-sequence attention through the Pallas
@@ -47,13 +53,24 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = True,
     """
     n = lax.axis_size(axis_name)
     b, t_local, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % max(h_kv, 1):
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    rep = h // h_kv
     if h % n:
         raise ValueError(
             f"ulysses needs n_heads % axis_size == 0, got {h} % {n}"
         )
     if scale is None:
         scale = d ** -0.5
+    expand_after = rep > 1 and h_kv % n == 0
+    if rep > 1 and not expand_after:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     if n == 1:
+        if expand_after:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         return _local_attention(
             q, k, v, causal=causal, scale=scale, use_flash=use_flash,
             flash_interpret=flash_interpret,
@@ -74,6 +91,9 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = True,
         )
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if expand_after:
+        kh = jnp.repeat(kh, rep, axis=2)
+        vh = jnp.repeat(vh, rep, axis=2)
     out = _local_attention(
         qh, kh, vh, causal=causal, scale=scale, use_flash=use_flash,
         flash_interpret=flash_interpret,

@@ -323,3 +323,33 @@ def test_ulysses_attention_sp4_with_flash():
     )(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_gqa_unexpanded_kv_both_paths():
+    """GQA kv heads enter ulysses UNexpanded. When the local kv head count
+    divides sp, the comm-saving path expands AFTER the all-to-all; when it
+    doesn't, the fallback expands before. Both must match plain attention
+    over the expanded heads."""
+    from bee_code_interpreter_fs_tpu.models.llama import _expand_gqa
+    from bee_code_interpreter_fs_tpu.parallel import ulysses_attention
+
+    b, t, h, d = 4, 32, 4, 8  # b divides the dp=4 the 8-device mesh implies
+    kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(kq, (b, t, h, d), jnp.float32)
+    for n_kv, spec_axes in ((2, (None, "sp", None, None)),  # 2 % sp(2) == 0
+                            (1, (None, "sp", None, None))):  # 1 % 2 != 0
+        k = jax.random.normal(kk, (b, t, n_kv, d), jnp.float32)
+        v = jax.random.normal(kv_, (b, t, n_kv, d), jnp.float32)
+        expected = _plain_causal_attention(q, *_expand_gqa(k, v, h), d ** -0.5)
+        mesh = make_mesh(best_mesh_shape(8, tp=1, sp=2))
+        got = jax.jit(
+            shard_map(
+                partial(ulysses_attention, axis_name="sp"),
+                mesh=mesh,
+                in_specs=(P("dp", "sp", None, None),) * 3,
+                out_specs=P("dp", "sp", None, None),
+                check_rep=False,
+            )
+        )(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   rtol=2e-5, atol=2e-5, err_msg=f"n_kv={n_kv}")
