@@ -27,6 +27,7 @@ from bee_code_interpreter_fs_tpu.models.llama import (
 )
 from bee_code_interpreter_fs_tpu.models.hf_convert import from_hf_state_dict
 from bee_code_interpreter_fs_tpu.models.quant import (
+    quantize4_params,
     quantize_params,
     quantized_nbytes,
     quantized_param_specs,
@@ -49,6 +50,7 @@ __all__ = [
     "sample_generate",
     "speculative_generate",
     "speculative_sample_generate",
+    "quantize4_params",
     "quantize_params",
     "quantized_nbytes",
     "quantized_param_specs",

@@ -266,15 +266,23 @@ def _expand_gqa(k, v, n_heads):
 
 def _w(leaf, dt):
     """Matmul-weight accessor: dense arrays pass through (cast is a no-op at
-    the model dtype); int8-quantized {"q","s"} leaves (models/quant.py)
-    dequantize HERE, at the use site inside the layer scan — XLA then reads
-    1 byte/param from HBM and fuses convert*scale into the matmul operand,
-    which is the whole point of weight-only quantization on a decode path
-    that is weight-bandwidth-bound."""
-    from bee_code_interpreter_fs_tpu.models.quant import dequantize, is_quantized
+    the model dtype); int8-quantized {"q","s"} and int4-packed {"q4","s4"}
+    leaves (models/quant.py) dequantize HERE, at the use site inside the
+    layer scan — XLA then reads 1 (or 0.5) byte/param from HBM and fuses
+    unpack/convert/scale into the matmul operand path, which is the whole
+    point of weight-only quantization on a decode path that is
+    weight-bandwidth-bound."""
+    from bee_code_interpreter_fs_tpu.models.quant import (
+        dequantize,
+        dequantize4,
+        is_quantized,
+        is_quantized4,
+    )
 
     if is_quantized(leaf):
         return dequantize(leaf, dt)
+    if is_quantized4(leaf):
+        return dequantize4(leaf, dt)
     return leaf.astype(dt)
 
 

@@ -105,6 +105,100 @@ def quantized_param_specs(cfg) -> dict:
     return out
 
 
+# ------------------------------------------------------------------ int4
+
+def quantize_int4(w, group: int = 128) -> dict:
+    """Symmetric GROUP-WISE int4: w ≈ unpack(q4) * s, two values per byte.
+
+    int8's per-output-channel scale is too coarse at 4 bits (15 levels);
+    scales here are per (group-of-`group`-inputs, output-channel), the
+    standard weight-only-int4 recipe. Values clip to [-7, 7] (symmetric),
+    and PACK explicitly — q4 stores two nibbles per int8 along the
+    contraction axis, so the HBM bytes are genuinely 0.5/param on every
+    backend (jnp.int4 arrays are byte-unpacked on some) plus the f32
+    scales (1/group per weight column group).
+
+    Shapes: w [*, in, out] → q4 [*, in/2, out] int8, s [*, in/group, 1,
+    out] float32 (the singleton broadcasts over the group at dequant).
+    `in` must divide by `group` (or by 2*ceil: group clamps to `in`).
+    """
+    w32 = w.astype(jnp.float32)
+    *lead, n_in, n_out = w32.shape
+    group = min(group, n_in)
+    if n_in % group or group % 2:
+        raise ValueError(f"in dim {n_in} must divide by even group {group}")
+    g = w32.reshape(*lead, n_in // group, group, n_out)
+    s = jnp.max(jnp.abs(g), axis=-2, keepdims=True) / 7.0
+    s = jnp.maximum(s, 1e-12)
+    q = jnp.clip(jnp.round(g / s), -7, 7).astype(jnp.int8)
+    q = q.reshape(*lead, n_in, n_out)
+    # Pack adjacent IN-axis pairs: even rows → low nibble, odd → high.
+    lo = q[..., 0::2, :] & 0x0F
+    hi = q[..., 1::2, :] & 0x0F
+    packed = (lo | (hi << 4)).astype(jnp.int8)
+    return {"q4": packed, "s4": s}
+
+
+def is_quantized4(leaf) -> bool:
+    return isinstance(leaf, dict) and set(leaf) == {"q4", "s4"}
+
+
+def dequantize4(leaf, dtype):
+    packed, s = leaf["q4"], leaf["s4"]
+    *lead, half_in, n_out = packed.shape
+    n_in = 2 * half_in
+    group = n_in // s.shape[-3]  # static: recovered from the scale shape
+    # Sign-extend each nibble: shift up into the sign position, then
+    # arithmetic-shift back down (int8 >> sign-extends).
+    lo = (packed << 4).astype(jnp.int8) >> 4
+    hi = packed >> 4
+    # stack axis=-2 puts (lo_i, hi_i) adjacent; the reshape interleaves
+    # them back to original row order 2i, 2i+1.
+    q = jnp.stack([lo, hi], axis=-2).reshape(*lead, n_in, n_out)
+    g = q.reshape(*lead, n_in // group, group, n_out)
+    return (g.astype(jnp.float32) * s).reshape(*lead, n_in, n_out).astype(dtype)
+
+
+def quantize4_params(params, group: int = 128) -> dict:
+    """int4-quantize a Llama tree's matmul weights (same weight set as
+    int8's quantize_params): ~0.25 bytes/param + scales — a 7B fits in
+    ~3.6 GB, a 13B-class model on one v5e chip."""
+    layers = dict(params["layers"])
+    for name in QUANTIZED_LAYER_WEIGHTS:
+        if name in layers:
+            layers[name] = quantize_int4(layers[name], group)
+    out = dict(params)
+    out["layers"] = layers
+    out["lm_head"] = quantize_int4(params["lm_head"], group)
+    return out
+
+
+def quantized4_param_specs(cfg) -> dict:
+    """PartitionSpec tree matching quantize4_params' structure (the int4
+    counterpart of quantized_param_specs): q4 keeps the weight's own spec
+    (the packed in/2 axis shards under the same mesh axis as in), and s4
+    — rank+1: [*, groups, 1, out] — shards its group axis like in and its
+    out axis like out, with the broadcast singleton unsharded."""
+    from jax.sharding import PartitionSpec as P
+
+    from bee_code_interpreter_fs_tpu.models.llama import param_specs
+
+    def qspec(spec):
+        parts = list(spec)
+        scale_parts = parts[:-2] + [parts[-2], None, parts[-1]]
+        return {"q4": P(*parts), "s4": P(*scale_parts)}
+
+    specs = param_specs(cfg)
+    layers = dict(specs["layers"])
+    for name in QUANTIZED_LAYER_WEIGHTS:
+        if name in layers:
+            layers[name] = qspec(layers[name])
+    out = dict(specs)
+    out["layers"] = layers
+    out["lm_head"] = qspec(specs["lm_head"])
+    return out
+
+
 def quantized_nbytes(params) -> int:
     """Total bytes of the weight leaves (quantized dicts count q + s) —
     the HBM-residency number the scheme exists to halve."""
@@ -112,10 +206,12 @@ def quantized_nbytes(params) -> int:
 
     total = 0
     for leaf in jax.tree.leaves(
-        params, is_leaf=lambda x: is_quantized(x)
+        params, is_leaf=lambda x: is_quantized(x) or is_quantized4(x)
     ):
         if is_quantized(leaf):
             total += leaf["q"].nbytes + leaf["s"].nbytes
+        elif is_quantized4(leaf):
+            total += leaf["q4"].nbytes + leaf["s4"].nbytes
         else:
             total += leaf.nbytes
     return total

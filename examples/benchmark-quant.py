@@ -1,5 +1,5 @@
-"""Weight-only int8 decode benchmark: fused greedy decode tok/s, bf16 vs
-int8, same model / prompt / batch.
+"""Weight-only quantized decode benchmark: fused greedy decode tok/s for
+bf16 vs int8 vs group-wise packed int4, same model / prompt / batch.
 
 Autoregressive decode at small batch is weight-HBM-bound: every step
 streams every matmul weight from HBM for a sliver of MXU work, so halving
@@ -22,14 +22,16 @@ from bee_code_interpreter_fs_tpu.models import (
     LlamaConfig,
     greedy_generate,
     init_params,
+    quantize4_params,
     quantize_params,
     quantized_nbytes,
 )
 
 ON_TPU = jax.devices()[0].platform == "tpu"
 if ON_TPU:
-    # ~0.94B params: bf16 (1.9 GB) and int8 (1.0 GB) trees coexist in HBM
-    # so both legs run in one process against identical weights.
+    # ~0.94B params: the bf16 (1.9 GB), int8 (1.0 GB), and int4 (~0.55 GB)
+    # trees coexist in HBM so all three legs run in one process against
+    # identical weights — size cfg with the SUM in mind.
     cfg = LlamaConfig(
         vocab_size=32000, dim=2048, n_layers=16, n_heads=16, n_kv_heads=16,
         hidden_dim=5504, max_seq_len=512,
@@ -63,16 +65,23 @@ t_bf16 = timed_best(
 t_int8 = timed_best(
     lambda: greedy_generate(qparams, prompt, cfg, max_new_tokens=NEW_TOKENS)
 )
+q4params = quantize4_params(params)
+t_int4 = timed_best(
+    lambda: greedy_generate(q4params, prompt, cfg, max_new_tokens=NEW_TOKENS)
+)
 
 bf16_bytes = quantized_nbytes(params)
 int8_bytes = quantized_nbytes(qparams)
+int4_bytes = quantized_nbytes(q4params)
 print(f"backend: {jax.devices()[0].platform}")
 print(
     f"model: dim={cfg.dim} layers={cfg.n_layers} "
     f"weights bf16={bf16_bytes / 1e9:.2f}GB int8={int8_bytes / 1e9:.2f}GB "
-    f"(ratio {int8_bytes / bf16_bytes:.2f})"
+    f"int4={int4_bytes / 1e9:.2f}GB"
 )
 print(f"batch={BATCH} new_tokens={NEW_TOKENS} (fused greedy decode)")
 print(f"BF16_DECODE_TOKS={BATCH * NEW_TOKENS / t_bf16:.1f}")
 print(f"INT8_DECODE_TOKS={BATCH * NEW_TOKENS / t_int8:.1f}")
 print(f"INT8_DECODE_SPEEDUP={t_bf16 / t_int8:.2f}")
+print(f"INT4_DECODE_TOKS={BATCH * NEW_TOKENS / t_int4:.1f}")
+print(f"INT4_DECODE_SPEEDUP={t_bf16 / t_int4:.2f}")
