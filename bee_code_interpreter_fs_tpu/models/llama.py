@@ -92,6 +92,14 @@ class LlamaConfig:
         return LlamaConfig()  # defaults are the 7B shape
 
     @staticmethod
+    def llama2_13b() -> "LlamaConfig":
+        """Llama-2-13B geometry: 40L / 5120 / 13824, MHA."""
+        return LlamaConfig(
+            vocab_size=32000, dim=5120, n_layers=40, n_heads=40,
+            n_kv_heads=40, hidden_dim=13824, max_seq_len=4096,
+        )
+
+    @staticmethod
     def llama3_8b() -> "LlamaConfig":
         """Llama-3-8B geometry: GQA 32q/8kv, 128k vocab, theta 5e5."""
         return LlamaConfig(

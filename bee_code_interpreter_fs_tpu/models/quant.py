@@ -177,15 +177,18 @@ def quantized4_param_specs(cfg) -> dict:
     """PartitionSpec tree matching quantize4_params' structure (the int4
     counterpart of quantized_param_specs): q4 keeps the weight's own spec
     (the packed in/2 axis shards under the same mesh axis as in), and s4
-    — rank+1: [*, groups, 1, out] — shards its group axis like in and its
-    out axis like out, with the broadcast singleton unsharded."""
+    — rank+1: [*, groups, 1, out] — shards only its OUT axis. The group
+    axis stays replicated on purpose: group counts (in/group, e.g. 86 for
+    a 7B w_down) routinely don't divide tp sizes the weight itself shards
+    fine at, and the scales are ~1/group of the weight bytes — replicating
+    them costs nothing."""
     from jax.sharding import PartitionSpec as P
 
     from bee_code_interpreter_fs_tpu.models.llama import param_specs
 
     def qspec(spec):
         parts = list(spec)
-        scale_parts = parts[:-2] + [parts[-2], None, parts[-1]]
+        scale_parts = parts[:-2] + [None, None, parts[-1]]
         return {"q4": P(*parts), "s4": P(*scale_parts)}
 
     specs = param_specs(cfg)

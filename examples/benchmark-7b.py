@@ -31,14 +31,21 @@ from bee_code_interpreter_fs_tpu.models import (
 from bee_code_interpreter_fs_tpu.models.quant import QUANTIZED_LAYER_WEIGHTS
 
 ON_TPU = jax.devices()[0].platform == "tpu"
-# BENCH_MODEL picks the geometry: llama2_7b (default) or llama3_8b — both
-# fit one v5e chip at int8 (~6.8 / ~8.6 GB incl. the bf16 embed table,
-# which stays full precision). mixtral_8x7b deliberately NOT offered:
-# 46.7B params can't fit one chip at any supported precision.
-PRESETS = ("llama2_7b", "llama3_8b")
+# BENCH_MODEL picks the geometry; BENCH_PRECISION picks int8 (default) or
+# group-wise packed int4. One-v5e-chip (16 GB HBM) footprints incl. the
+# bf16 embed table (full precision): llama2_7b ~6.8 GB int8 / ~3.6 GB
+# int4; llama3_8b ~8.6 / ~4.8; llama2_13b ~6.9 GB at int4 ONLY (13 GB at
+# int8 leaves no activation headroom). mixtral_8x7b deliberately NOT
+# offered: 46.7B params can't fit one chip at any supported precision.
+PRESETS = ("llama2_7b", "llama3_8b", "llama2_13b")
 MODEL = os.environ.get("BENCH_MODEL", "llama2_7b")
+PRECISION = os.environ.get("BENCH_PRECISION", "int8")
 if MODEL not in PRESETS:
     raise SystemExit(f"BENCH_MODEL must be one of {PRESETS}, got {MODEL!r}")
+if PRECISION not in ("int8", "int4"):
+    raise SystemExit(f"BENCH_PRECISION must be int8 or int4, got {PRECISION!r}")
+if MODEL == "llama2_13b" and PRECISION != "int4":
+    raise SystemExit("llama2_13b only fits one chip at BENCH_PRECISION=int4")
 if ON_TPU:
     cfg = getattr(LlamaConfig, MODEL)()
     PREFILL_T, NEW_TOKENS, BATCH = 512, 64, 1
@@ -47,18 +54,31 @@ else:  # correctness-check shapes for dev machines / CI
     PREFILL_T, NEW_TOKENS, BATCH = 32, 8, 1
 
 
-def build_quantized_params(key, cfg):
-    """Random int8-serving tree at cfg's exact shapes, no bf16 detour."""
+def build_quantized_params(key, cfg, precision="int8"):
+    """Random quantized-serving tree at cfg's exact shapes, no bf16 detour."""
     shapes = jax.eval_shape(lambda k: init_params(k, cfg), key)
 
     def leaf(path_key, shape_dtype, k):
         shape = shape_dtype.shape
         if path_key in QUANTIZED_LAYER_WEIGHTS or path_key == "lm_head":
             kq, ks = jax.random.split(k)
+            if precision == "int4":
+                group = min(128, shape[-2])
+                return {
+                    # Random bytes = random nibble pairs; scales sized like
+                    # a real quantized init so logit magnitudes stay sane.
+                    "q4": jax.random.randint(
+                        kq, shape[:-2] + (shape[-2] // 2,) + shape[-1:],
+                        -128, 128, jnp.int8,
+                    ),
+                    "s4": jnp.full(
+                        shape[:-2] + (shape[-2] // group, 1) + shape[-1:],
+                        shape[-2] ** -0.5 / 7.0,
+                        jnp.float32,
+                    ),
+                }
             return {
                 "q": jax.random.randint(kq, shape, -127, 128, jnp.int8),
-                # Scales sized like a real quantized init (~fan_in^-0.5/127)
-                # so logit magnitudes stay sane.
                 "s": jnp.full(
                     shape[:-2] + (1,) + shape[-1:],
                     shape[-2] ** -0.5 / 127.0,
@@ -84,12 +104,13 @@ def build_quantized_params(key, cfg):
 
 
 t0 = time.perf_counter()
-params = build_quantized_params(jax.random.PRNGKey(0), cfg)
+params = build_quantized_params(jax.random.PRNGKey(0), cfg, PRECISION)
 jax.block_until_ready(params)
 nbytes = quantized_nbytes(params)
 print(
     f"backend: {jax.devices()[0].platform} model={MODEL if ON_TPU else 'tiny'} "
-    f"params={nbytes / 1e9:.2f}GB int8 (built in {time.perf_counter() - t0:.1f}s)"
+    f"params={nbytes / 1e9:.2f}GB {PRECISION} "
+    f"(built in {time.perf_counter() - t0:.1f}s)"
 )
 
 def timed_best(fn, iters=3):

@@ -65,23 +65,26 @@ t_bf16 = timed_best(
 t_int8 = timed_best(
     lambda: greedy_generate(qparams, prompt, cfg, max_new_tokens=NEW_TOKENS)
 )
-q4params = quantize4_params(params)
-t_int4 = timed_best(
-    lambda: greedy_generate(q4params, prompt, cfg, max_new_tokens=NEW_TOKENS)
-)
 
+# bf16/int8 results go out BEFORE the int4 leg starts: a partial run
+# (int4 OOM / timeout under bench.py's deadline) must still carry the
+# measurements already made.
 bf16_bytes = quantized_nbytes(params)
 int8_bytes = quantized_nbytes(qparams)
-int4_bytes = quantized_nbytes(q4params)
-print(f"backend: {jax.devices()[0].platform}")
+print(f"backend: {jax.devices()[0].platform}", flush=True)
 print(
     f"model: dim={cfg.dim} layers={cfg.n_layers} "
-    f"weights bf16={bf16_bytes / 1e9:.2f}GB int8={int8_bytes / 1e9:.2f}GB "
-    f"int4={int4_bytes / 1e9:.2f}GB"
+    f"weights bf16={bf16_bytes / 1e9:.2f}GB int8={int8_bytes / 1e9:.2f}GB"
 )
 print(f"batch={BATCH} new_tokens={NEW_TOKENS} (fused greedy decode)")
 print(f"BF16_DECODE_TOKS={BATCH * NEW_TOKENS / t_bf16:.1f}")
 print(f"INT8_DECODE_TOKS={BATCH * NEW_TOKENS / t_int8:.1f}")
-print(f"INT8_DECODE_SPEEDUP={t_bf16 / t_int8:.2f}")
+print(f"INT8_DECODE_SPEEDUP={t_bf16 / t_int8:.2f}", flush=True)
+
+q4params = quantize4_params(params)
+t_int4 = timed_best(
+    lambda: greedy_generate(q4params, prompt, cfg, max_new_tokens=NEW_TOKENS)
+)
+print(f"int4_weights_gb={quantized_nbytes(q4params) / 1e9:.2f}")
 print(f"INT4_DECODE_TOKS={BATCH * NEW_TOKENS / t_int4:.1f}")
 print(f"INT4_DECODE_SPEEDUP={t_bf16 / t_int4:.2f}")

@@ -540,6 +540,7 @@ def test_real_model_presets_have_expected_param_counts():
     carry valid sharding specs."""
     cases = [
         (LlamaConfig.llama2_7b(), 6.74e9),
+        (LlamaConfig.llama2_13b(), 13.0e9),
         (LlamaConfig.llama3_8b(), 8.03e9),
         (LlamaConfig.mixtral_8x7b(), 46.7e9),
     ]

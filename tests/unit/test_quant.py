@@ -180,8 +180,10 @@ def test_int4_quarter_weight_bytes():
     params = init_params(jax.random.PRNGKey(0), cfg)
     from bee_code_interpreter_fs_tpu.models import quantize4_params
 
+    from bee_code_interpreter_fs_tpu.models.quant import QUANTIZED_LAYER_WEIGHTS
+
     q4 = quantize4_params(params, group=64)
-    names = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+    names = [n for n in QUANTIZED_LAYER_WEIGHTS if n in params["layers"]]
     full = sum(params["layers"][n].nbytes for n in names) + params["lm_head"].nbytes
     packed = sum(
         q4["layers"][n]["q4"].nbytes + q4["layers"][n]["s4"].nbytes for n in names
