@@ -29,6 +29,7 @@ from bee_code_interpreter_fs_tpu.models.hf_convert import from_hf_state_dict
 from bee_code_interpreter_fs_tpu.models.quant import (
     quantize4_params,
     quantize_params,
+    quantized4_param_specs,
     quantized_nbytes,
     quantized_param_specs,
 )
@@ -52,6 +53,7 @@ __all__ = [
     "speculative_sample_generate",
     "quantize4_params",
     "quantize_params",
+    "quantized4_param_specs",
     "quantized_nbytes",
     "quantized_param_specs",
 ]

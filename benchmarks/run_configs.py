@@ -208,7 +208,9 @@ async def main() -> None:
             # -- config 5e: TRUE Llama-2-7B shape, int8, one chip -------------
             # (the north star's real 32-layer/4096-dim geometry; random
             # weights, identical code path — retires the scale-model caveat)
-            # Both build GB-scale trees; neither belongs in a --quick pass.
+            # 5d/5e build GB-scale trees; 5f trains its draft/target pair
+            # in-sandbox (~300 steps) then times four generations — all
+            # too slow for a --quick pass, for different reasons.
             if not quick:
                 quant = (REPO_ROOT / "examples" / "benchmark-quant.py").read_text()
                 out.append(
@@ -221,6 +223,16 @@ async def main() -> None:
                 out.append(
                     await run_config(
                         "5e:llama2-7b-int8", b7, executor=executor, timeout=1200.0
+                    )
+                )
+
+                # -- config 5f: speculative decoding (greedy + sampled) ------
+                spec = (
+                    REPO_ROOT / "examples" / "benchmark-speculative.py"
+                ).read_text()
+                out.append(
+                    await run_config(
+                        "5f:speculative", spec, executor=executor, timeout=1200.0
                     )
                 )
         finally:
