@@ -71,6 +71,13 @@ class LlamaConfig:
     # device (latency-friendly; heads are also tp-sharded, so it needs
     # (n_heads / tp) % sp == 0 — parallel/ulysses.py).
     sp_impl: str = "ring"
+    # Sliding-window attention (Mistral-style): each position attends to
+    # at most the last `sliding_window` keys (itself included). 0 = full
+    # causal. Applies to prefill (plain and flash paths — the flash kernel
+    # skips out-of-window tiles' DMAs AND FLOPs, so prefill scales
+    # O(t·window)) and to the KV-cache decode path. Not composed with
+    # sequence parallelism (sp > 1 raises).
+    sliding_window: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -254,10 +261,13 @@ def _moe_mlp(h, lp, cfg: LlamaConfig):
     return jnp.einsum("bted,bte->btd", y, weights.astype(y.dtype))
 
 
-def _plain_causal_attention(q, k, v, scale):
+def _plain_causal_attention(q, k, v, scale, window: int = 0):
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     t = q.shape[1]
     mask = jnp.tril(jnp.ones((t, t), bool))
+    if window > 0:
+        # Sliding window: drop keys older than q_pos - window + 1.
+        mask &= jnp.tril(jnp.ones((t, t), bool), -window) == 0
     s = jnp.where(mask[None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
@@ -334,6 +344,12 @@ def forward(params, tokens, cfg: LlamaConfig, *, mesh: Mesh | None = None):
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     scale = hd ** -0.5
     use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
+    if use_ring and cfg.sliding_window > 0:
+        raise ValueError(
+            "sliding_window is not composed with sequence parallelism "
+            "(windowing across ring/ulysses shards is unimplemented); "
+            "use a mesh without an sp axis"
+        )
     if use_ring:
         # attn_impl="flash" composes with BOTH sp strategies: ring uses the
         # Pallas partial kernel per step (no per-chunk-pair score tensor);
@@ -382,11 +398,12 @@ def forward(params, tokens, cfg: LlamaConfig, *, mesh: Mesh | None = None):
         # same kernel runs interpreted.
         interpret = jax.default_backend() != "tpu"
         attn_fn = lambda q, k, v: flash_attention(  # noqa: E731
-            q, *_expand_gqa(k, v, nh), scale=scale, interpret=interpret
+            q, *_expand_gqa(k, v, nh), scale=scale,
+            window=cfg.sliding_window, interpret=interpret,
         )
     else:
         attn_fn = lambda q, k, v: _plain_causal_attention(  # noqa: E731
-            q, *_expand_gqa(k, v, nh), scale
+            q, *_expand_gqa(k, v, nh), scale, window=cfg.sliding_window
         )
 
     def layer(x, lp):
@@ -486,7 +503,8 @@ def prefill(params, tokens, cache, cfg: LlamaConfig):
                 lax.dynamic_update_slice(cv, v, (0, 0, 0, 0)),
             )
             return _plain_causal_attention(
-                q, *_expand_gqa(k, v, cfg.n_heads), scale
+                q, *_expand_gqa(k, v, cfg.n_heads), scale,
+                window=cfg.sliding_window,
             )
 
         x = transformer_block(x, lp, cfg, attn_fn)
@@ -513,9 +531,15 @@ def decode_chunk(params, tokens, cache, pos, cfg: LlamaConfig):
     scale = cfg.head_dim ** -0.5
     s = tokens.shape[1]
     max_len = cache["k"].shape[2]
-    # Chunk-local query i (global pos+i) sees cache positions <= pos+i.
+    # Chunk-local query i (global pos+i) sees cache positions <= pos+i
+    # (and, with a sliding window, none older than pos+i-window+1).
     q_pos = pos + jnp.arange(s)
-    valid = (jnp.arange(max_len)[None, :] <= q_pos[:, None])[None, None, None]
+    valid2d = jnp.arange(max_len)[None, :] <= q_pos[:, None]
+    if cfg.sliding_window > 0:
+        valid2d &= (
+            jnp.arange(max_len)[None, :] > q_pos[:, None] - cfg.sliding_window
+        )
+    valid = valid2d[None, None, None]
     x = params["embed"].astype(dt)[tokens]
 
     def layer(x, inputs):

@@ -96,12 +96,14 @@ def _tile_update(q, k_tile, v_tile, acc, m, l, *, scale, mask):
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  scale, block_q, block_k, seq_len):
+                  scale, block_q, block_k, seq_len, window):
     """Grid is (bh, q_tiles, k_tiles) with k innermost: only ONE [block_k, d]
     K and V tile is VMEM-resident at a time (the pipeline double-buffers the
     next), so sequence length is bounded by HBM, not by VMEM. The online-
     softmax carry lives in VMEM scratch, persisting across the k iterations
-    of each (bh, qi); the output tile is written once, at the last k tile."""
+    of each (bh, qi); the output tile is written once, at the last k tile.
+    `window` (0 = full causal) additionally masks keys older than
+    q_pos - window + 1 — sliding-window attention."""
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -115,10 +117,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     q_positions = qi * block_q + jax.lax.iota(jnp.int32, block_q)
     k_start = kj * block_k
 
-    # Tiles entirely beyond this query tile's diagonal contribute nothing —
-    # skip their MXU work (the grid still visits them; the guard makes each
-    # visit a no-op).
-    @pl.when(k_start <= qi * block_q + block_q - 1)
+    # Tiles entirely beyond this query tile's diagonal — or, with a
+    # window, entirely before its oldest visible key — contribute nothing:
+    # skip their MXU work (the grid still visits them; the guard makes
+    # each visit a no-op, and the index_map clamps make it DMA-free too).
+    live = k_start <= qi * block_q + block_q - 1
+    if window > 0:
+        live &= k_start + block_k - 1 >= qi * block_q - window + 1
+
+    @pl.when(live)
     def _update():
         q = q_ref[0]
         k_tile = k_ref[0]
@@ -127,6 +134,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         mask = (q_positions[:, None] >= k_positions[None, :]) & (
             k_positions[None, :] < seq_len  # padding tail masked
         )
+        if window > 0:
+            mask &= k_positions[None, :] > q_positions[:, None] - window
         acc, m, l = _tile_update(
             q, k_tile, v_tile,
             acc_ref[:], m_ref[:, 0], l_ref[:, 0],
@@ -138,6 +147,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(kj == n_k - 1)
     def _finalize():
+        # A fully-windowed-out row (impossible for window>=1, since the
+        # diagonal itself is always visible) would divide by zero; the
+        # causal diagonal guarantees l >= its own row's term.
         o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
 
 
@@ -306,7 +318,8 @@ def flash_attention_partial(q, k, v, acc, m, l, *, q_offset, k_offset,
 
 
 def flash_attention(q, k, v, *, scale: float | None = None, block_q: int = 512,
-                    block_k: int = 1024, interpret: bool = False):
+                    block_k: int = 1024, window: int = 0,
+                    interpret: bool = False):
     """Causal flash attention over [b, t, h, d] (kv heads must equal q
     heads — expand GQA first, models.llama._expand_gqa). Returns [b, t, h,
     d] in q's dtype. Sequence lengths that don't divide the block sizes are
@@ -314,6 +327,13 @@ def flash_attention(q, k, v, *, scale: float | None = None, block_q: int = 512,
     then rounded UP to the next power of two (both must divide one shared
     padded length) — pass powers of two when tuning, or the sweep points
     collapse onto each other.
+
+    `window > 0` = sliding-window attention (Mistral-style): each query
+    sees only the last `window` keys (itself included). Out-of-window key
+    tiles are dead the same two ways dead causal tiles are — the pl.when
+    guard skips their MXU work and the index_map clamp (both directions)
+    skips their DMAs — so compute AND bandwidth scale with O(t·window),
+    not O(t²/2).
 
     Default blocks are 512x1024 (clamped to t): measured on v5e at t=16k,
     128x128 tiles leave the kernel grid-overhead-bound at ~15 TFLOPS while
@@ -355,6 +375,7 @@ def flash_attention(q, k, v, *, scale: float | None = None, block_q: int = 512,
         block_q=block_q,
         block_k=block_k,
         seq_len=t,
+        window=window,
     )
     def kv_index(bh, qi, kj):
         # Clamp at the causal frontier: a key tile wholly past query tile
@@ -363,9 +384,14 @@ def flash_attention(q, k, v, *, scale: float | None = None, block_q: int = 512,
         # changes between grid steps, so the dead tiles cost no HBM traffic.
         # At t=16k/512x1024 blocks that's ~half of all K/V DMAs, each of
         # which (~0.6 us for 512 KB) rivals a live tile's MXU time — they
-        # were never "cheap relative to the saved matmuls".
-        last_live = (qi * block_q + block_q - 1) // block_k
-        return (bh, jnp.minimum(kj, last_live), 0)
+        # were never "cheap relative to the saved matmuls". With a sliding
+        # window the clamp works both ways: tiles wholly older than the
+        # window's trailing edge repeat the first live index.
+        idx = jnp.minimum(kj, (qi * block_q + block_q - 1) // block_k)
+        if window > 0:
+            first_live = jnp.maximum(qi * block_q - window + 1, 0) // block_k
+            idx = jnp.maximum(idx, first_live)
+        return (bh, idx, 0)
 
     out = pl.pallas_call(
         kernel,

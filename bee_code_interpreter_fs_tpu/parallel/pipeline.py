@@ -128,7 +128,8 @@ def pipelined_transformer(params, tokens, cfg, *, mesh: Mesh,
 
         def attn_fn(q, k, v):
             return _plain_causal_attention(
-                q, *_expand_gqa(k, v, cfg.n_heads), scale
+                q, *_expand_gqa(k, v, cfg.n_heads), scale,
+                window=cfg.sliding_window,
             )
 
         def one(x, lp):

@@ -197,3 +197,26 @@ def test_shape_mismatch_rejected():
     k = jnp.zeros((1, 8, 1, 4))
     with pytest.raises(ValueError, match="shapes differ"):
         flash_attention(q, k, k)
+
+
+def test_sliding_window_matches_plain():
+    """flash_attention(window=w) == the masked-dense formulation with the
+    same window, including non-divisible lengths (padding) and a window
+    that doesn't align with tile boundaries."""
+    from bee_code_interpreter_fs_tpu.models.llama import _plain_causal_attention
+    from bee_code_interpreter_fs_tpu.ops.flash_attention import flash_attention
+
+    b, t, h, d = 2, 100, 2, 16
+    q, k, v = (
+        jax.random.normal(s, (b, t, h, d), jnp.float32)
+        for s in jax.random.split(jax.random.PRNGKey(11), 3)
+    )
+    for w in (1, 7, 33, 100, 0):
+        want = _plain_causal_attention(q, k, v, d ** -0.5, window=w)
+        got = flash_attention(
+            q, k, v, block_q=16, block_k=32, window=w, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5,
+            err_msg=f"window={w}",
+        )

@@ -670,3 +670,44 @@ def test_ulysses_forward_matches():
     got = jax.jit(lambda p, t: forward(p, t, cfg, mesh=mesh))(sharded_params, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                rtol=5e-3, atol=5e-3)
+
+
+def test_sliding_window_decode_matches_forward():
+    """cfg.sliding_window: the windowed forward differs from full causal,
+    the flash path agrees with the plain path, and the KV-cache
+    incremental decode reproduces the windowed forward position by
+    position (the decode-path window mask)."""
+    from bee_code_interpreter_fs_tpu.models import decode_step, init_cache
+
+    cfg_w = LlamaConfig.tiny(dtype="float32", sliding_window=5)
+    cfg_full = LlamaConfig.tiny(dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg_w)
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (2, 12), 0, cfg_w.vocab_size)
+
+    windowed = forward(params, tokens, cfg_w)
+    full = forward(params, tokens, cfg_full)
+    assert not np.allclose(np.asarray(windowed), np.asarray(full), atol=1e-3)
+
+    cfg_wf = LlamaConfig.tiny(dtype="float32", sliding_window=5, attn_impl="flash")
+    flash = forward(params, tokens, cfg_wf)
+    np.testing.assert_allclose(
+        np.asarray(flash), np.asarray(windowed), rtol=2e-4, atol=2e-4
+    )
+
+    cache = init_cache(cfg_w, 2, max_len=12)
+    for t in range(12):
+        logits, cache = decode_step(
+            params, tokens[:, t : t + 1], cache, jnp.int32(t), cfg_w
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(windowed[:, t]), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_sliding_window_rejects_sequence_parallel():
+    cfg = LlamaConfig.tiny(dtype="float32", sliding_window=4)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh(best_mesh_shape(8, tp=2, sp=2))
+    tokens = jnp.zeros((2, 32), jnp.int32)
+    with pytest.raises(ValueError, match="sliding_window"):
+        forward(params, tokens, cfg, mesh=mesh)

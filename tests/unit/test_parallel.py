@@ -353,3 +353,30 @@ def test_ulysses_gqa_unexpanded_kv_both_paths():
         )(q, k, v)
         np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                    rtol=2e-5, atol=2e-5, err_msg=f"n_kv={n_kv}")
+
+
+def test_pipelined_transformer_respects_sliding_window():
+    """pp path parity for cfg.sliding_window: the pipelined forward must
+    match forward() under the same window (and so differ from full
+    causal)."""
+    from bee_code_interpreter_fs_tpu.models import (
+        LlamaConfig,
+        forward,
+        init_params,
+    )
+    from bee_code_interpreter_fs_tpu.parallel import (
+        MeshSpec,
+        pipelined_transformer,
+    )
+
+    cfg = LlamaConfig.tiny(dtype="float32", n_layers=4, sliding_window=5)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(13), (4, 16), 0, cfg.vocab_size)
+    expected = forward(params, tokens, cfg)
+    mesh = make_mesh(MeshSpec(shape=(4,), axes=("pp",)))
+    got = jax.jit(
+        lambda p, t: pipelined_transformer(p, t, cfg, mesh=mesh, n_microbatches=2)
+    )(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expected), rtol=5e-3, atol=5e-3
+    )
