@@ -78,6 +78,11 @@ class LlamaConfig:
     # O(t·window)) and to the KV-cache decode path. Not composed with
     # sequence parallelism (sp > 1 raises).
     sliding_window: int = 0
+    # StreamingLLM attention sinks: with a sliding window, keep the first
+    # `attention_sinks` positions visible to EVERY query — the trick that
+    # keeps windowed models stable far past their window. 0 = none;
+    # ignored without a window.
+    attention_sinks: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -261,13 +266,18 @@ def _moe_mlp(h, lp, cfg: LlamaConfig):
     return jnp.einsum("bted,bte->btd", y, weights.astype(y.dtype))
 
 
-def _plain_causal_attention(q, k, v, scale, window: int = 0):
+def _plain_causal_attention(q, k, v, scale, window: int = 0, sinks: int = 0):
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     t = q.shape[1]
     mask = jnp.tril(jnp.ones((t, t), bool))
     if window > 0:
-        # Sliding window: drop keys older than q_pos - window + 1.
-        mask &= jnp.tril(jnp.ones((t, t), bool), -window) == 0
+        # Sliding window: drop keys older than q_pos - window + 1 — except
+        # the first `sinks` keys (StreamingLLM attention sinks), which
+        # every query keeps seeing.
+        visible = jnp.tril(jnp.ones((t, t), bool), -window) == 0
+        if sinks > 0:
+            visible |= (jnp.arange(t) < sinks)[None, :]
+        mask &= visible
     s = jnp.where(mask[None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
@@ -399,11 +409,13 @@ def forward(params, tokens, cfg: LlamaConfig, *, mesh: Mesh | None = None):
         interpret = jax.default_backend() != "tpu"
         attn_fn = lambda q, k, v: flash_attention(  # noqa: E731
             q, *_expand_gqa(k, v, nh), scale=scale,
-            window=cfg.sliding_window, interpret=interpret,
+            window=cfg.sliding_window, sinks=cfg.attention_sinks,
+            interpret=interpret,
         )
     else:
         attn_fn = lambda q, k, v: _plain_causal_attention(  # noqa: E731
-            q, *_expand_gqa(k, v, nh), scale, window=cfg.sliding_window
+            q, *_expand_gqa(k, v, nh), scale,
+            window=cfg.sliding_window, sinks=cfg.attention_sinks,
         )
 
     def layer(x, lp):
@@ -504,7 +516,7 @@ def prefill(params, tokens, cache, cfg: LlamaConfig):
             )
             return _plain_causal_attention(
                 q, *_expand_gqa(k, v, cfg.n_heads), scale,
-                window=cfg.sliding_window,
+                window=cfg.sliding_window, sinks=cfg.attention_sinks,
             )
 
         x = transformer_block(x, lp, cfg, attn_fn)
@@ -536,9 +548,12 @@ def decode_chunk(params, tokens, cache, pos, cfg: LlamaConfig):
     q_pos = pos + jnp.arange(s)
     valid2d = jnp.arange(max_len)[None, :] <= q_pos[:, None]
     if cfg.sliding_window > 0:
-        valid2d &= (
+        visible = (
             jnp.arange(max_len)[None, :] > q_pos[:, None] - cfg.sliding_window
         )
+        if cfg.attention_sinks > 0:
+            visible |= (jnp.arange(max_len) < cfg.attention_sinks)[None, :]
+        valid2d &= visible
     valid = valid2d[None, None, None]
     x = params["embed"].astype(dt)[tokens]
 

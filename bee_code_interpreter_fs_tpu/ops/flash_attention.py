@@ -96,7 +96,7 @@ def _tile_update(q, k_tile, v_tile, acc, m, l, *, scale, mask):
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  scale, block_q, block_k, seq_len, window):
+                  scale, block_q, block_k, seq_len, window, sinks):
     """Grid is (bh, q_tiles, k_tiles) with k innermost: only ONE [block_k, d]
     K and V tile is VMEM-resident at a time (the pipeline double-buffers the
     next), so sequence length is bounded by HBM, not by VMEM. The online-
@@ -118,12 +118,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     k_start = kj * block_k
 
     # Tiles entirely beyond this query tile's diagonal — or, with a
-    # window, entirely before its oldest visible key — contribute nothing:
-    # skip their MXU work (the grid still visits them; the guard makes
-    # each visit a no-op, and the index_map clamps make it DMA-free too).
+    # window, entirely before its oldest visible key (unless they hold
+    # sink tokens) — contribute nothing: skip their MXU work (the grid
+    # still visits them; the guard makes each visit a no-op, and the
+    # index_map clamps/remaps make it DMA-free too).
     live = k_start <= qi * block_q + block_q - 1
     if window > 0:
-        live &= k_start + block_k - 1 >= qi * block_q - window + 1
+        in_window = k_start + block_k - 1 >= qi * block_q - window + 1
+        if sinks > 0:
+            in_window |= k_start < sinks
+        live &= in_window
 
     @pl.when(live)
     def _update():
@@ -135,7 +139,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
             k_positions[None, :] < seq_len  # padding tail masked
         )
         if window > 0:
-            mask &= k_positions[None, :] > q_positions[:, None] - window
+            visible = k_positions[None, :] > q_positions[:, None] - window
+            if sinks > 0:
+                # StreamingLLM attention sinks: the first `sinks` keys stay
+                # visible to every query regardless of the window.
+                visible |= k_positions[None, :] < sinks
+            mask &= visible
         acc, m, l = _tile_update(
             q, k_tile, v_tile,
             acc_ref[:], m_ref[:, 0], l_ref[:, 0],
@@ -318,7 +327,7 @@ def flash_attention_partial(q, k, v, acc, m, l, *, q_offset, k_offset,
 
 
 def flash_attention(q, k, v, *, scale: float | None = None, block_q: int = 512,
-                    block_k: int = 1024, window: int = 0,
+                    block_k: int = 1024, window: int = 0, sinks: int = 0,
                     interpret: bool = False):
     """Causal flash attention over [b, t, h, d] (kv heads must equal q
     heads — expand GQA first, models.llama._expand_gqa). Returns [b, t, h,
@@ -333,7 +342,11 @@ def flash_attention(q, k, v, *, scale: float | None = None, block_q: int = 512,
     tiles are dead the same two ways dead causal tiles are — the pl.when
     guard skips their MXU work and the index_map clamp (both directions)
     skips their DMAs — so compute AND bandwidth scale with O(t·window),
-    not O(t²/2).
+    not O(t²/2). `sinks > 0` (needs window > 0) additionally keeps the
+    first `sinks` keys visible to every query — StreamingLLM attention
+    sinks; the leading tiles that hold them stay live (their own DMAs and
+    a bit of masked MXU work), mid-range dead tiles remain DMA-free via
+    an index remap.
 
     Default blocks are 512x1024 (clamped to t): measured on v5e at t=16k,
     128x128 tiles leave the kernel grid-overhead-bound at ~15 TFLOPS while
@@ -376,6 +389,7 @@ def flash_attention(q, k, v, *, scale: float | None = None, block_q: int = 512,
         block_k=block_k,
         seq_len=t,
         window=window,
+        sinks=sinks,
     )
     def kv_index(bh, qi, kj):
         # Clamp at the causal frontier: a key tile wholly past query tile
@@ -391,6 +405,13 @@ def flash_attention(q, k, v, *, scale: float | None = None, block_q: int = 512,
         if window > 0:
             first_live = jnp.maximum(qi * block_q - window + 1, 0) // block_k
             idx = jnp.maximum(idx, first_live)
+            if sinks > 0:
+                # Sink-holding leading tiles keep their own index (their
+                # keys stay visible); tiles between them and the window
+                # remap forward to first_live — consecutive repeats, so
+                # still no DMA for the mid-range dead tiles.
+                sink_tiles = (sinks + block_k - 1) // block_k
+                idx = jnp.where(kj < sink_tiles, jnp.minimum(kj, idx), idx)
         return (bh, idx, 0)
 
     out = pl.pallas_call(

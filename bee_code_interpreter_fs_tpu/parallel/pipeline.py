@@ -129,7 +129,7 @@ def pipelined_transformer(params, tokens, cfg, *, mesh: Mesh,
         def attn_fn(q, k, v):
             return _plain_causal_attention(
                 q, *_expand_gqa(k, v, cfg.n_heads), scale,
-                window=cfg.sliding_window,
+                window=cfg.sliding_window, sinks=cfg.attention_sinks,
             )
 
         def one(x, lp):

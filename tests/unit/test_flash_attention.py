@@ -220,3 +220,27 @@ def test_sliding_window_matches_plain():
             np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5,
             err_msg=f"window={w}",
         )
+
+
+def test_attention_sinks_match_plain():
+    """window + sinks in the kernel == the masked-dense formulation,
+    including sink counts that don't align with tile boundaries and sinks
+    inside/outside the window's reach."""
+    from bee_code_interpreter_fs_tpu.models.llama import _plain_causal_attention
+    from bee_code_interpreter_fs_tpu.ops.flash_attention import flash_attention
+
+    b, t, h, d = 2, 100, 2, 16
+    q, k, v = (
+        jax.random.normal(s, (b, t, h, d), jnp.float32)
+        for s in jax.random.split(jax.random.PRNGKey(12), 3)
+    )
+    for w, sinks in ((7, 4), (7, 33), (33, 1), (100, 4)):
+        want = _plain_causal_attention(q, k, v, d ** -0.5, window=w, sinks=sinks)
+        got = flash_attention(
+            q, k, v, block_q=16, block_k=32, window=w, sinks=sinks,
+            interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5,
+            err_msg=f"window={w} sinks={sinks}",
+        )

@@ -711,3 +711,36 @@ def test_sliding_window_rejects_sequence_parallel():
     tokens = jnp.zeros((2, 32), jnp.int32)
     with pytest.raises(ValueError, match="sliding_window"):
         forward(params, tokens, cfg, mesh=mesh)
+
+
+def test_attention_sinks_decode_matches_forward():
+    """cfg.attention_sinks composes with the window through every
+    single-shard path: flash == plain, the sinks CHANGE the windowed
+    output, and incremental decode reproduces the sunk forward."""
+    from bee_code_interpreter_fs_tpu.models import decode_step, init_cache
+
+    cfg_s = LlamaConfig.tiny(dtype="float32", sliding_window=4, attention_sinks=2)
+    cfg_w = LlamaConfig.tiny(dtype="float32", sliding_window=4)
+    params = init_params(jax.random.PRNGKey(0), cfg_s)
+    tokens = jax.random.randint(jax.random.PRNGKey(14), (2, 12), 0, cfg_s.vocab_size)
+
+    sunk = forward(params, tokens, cfg_s)
+    windowed = forward(params, tokens, cfg_w)
+    assert not np.allclose(np.asarray(sunk), np.asarray(windowed), atol=1e-3)
+
+    cfg_sf = LlamaConfig.tiny(
+        dtype="float32", sliding_window=4, attention_sinks=2, attn_impl="flash"
+    )
+    flash = forward(params, tokens, cfg_sf)
+    np.testing.assert_allclose(
+        np.asarray(flash), np.asarray(sunk), rtol=2e-4, atol=2e-4
+    )
+
+    cache = init_cache(cfg_s, 2, max_len=12)
+    for t in range(12):
+        logits, cache = decode_step(
+            params, tokens[:, t : t + 1], cache, jnp.int32(t), cfg_s
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(sunk[:, t]), rtol=2e-4, atol=2e-4
+        )
