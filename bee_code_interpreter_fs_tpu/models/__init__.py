@@ -26,6 +26,12 @@ from bee_code_interpreter_fs_tpu.models.llama import (
     speculative_sample_generate,
 )
 from bee_code_interpreter_fs_tpu.models.hf_convert import from_hf_state_dict
+from bee_code_interpreter_fs_tpu.models.rolling import (
+    init_rolling_cache,
+    rolling_decode_logits,
+    rolling_decode_step,
+    rolling_greedy_generate,
+)
 from bee_code_interpreter_fs_tpu.models.quant import (
     quantize4_params,
     quantize_params,
@@ -44,6 +50,10 @@ __all__ = [
     "greedy_generate",
     "init_cache",
     "init_params",
+    "init_rolling_cache",
+    "rolling_decode_logits",
+    "rolling_decode_step",
+    "rolling_greedy_generate",
     "loss_fn",
     "make_train_step",
     "param_specs",

@@ -1,0 +1,197 @@
+"""Rolling (ring-buffer) KV cache: O(window) decode memory for
+sliding-window models.
+
+cfg.sliding_window bounds ATTENTION to the last W keys, and the flash
+kernel already bounds prefill COMPUTE to O(t·W) — but the standard cache
+(init_cache) still holds max_len positions. For streaming/serving beyond
+the window that's the wrong residency: a windowed model only ever reads
+the last W keys (plus the attention sinks), so the cache can be a ring of
+W slots + a write-once sink buffer, and decode memory becomes O(W+S) per
+layer regardless of how long the stream runs.
+
+Mechanics (softmax is permutation-invariant over keys, so ring ORDER never
+matters — only the visible SET does):
+- slot ``pos % W`` is overwritten each step; the position a slot currently
+  holds is ``p_j = pos - ((pos - j) % W)``, which is negative (never
+  written) early on and always in ``(pos-W, pos]`` once warm;
+- ring validity: ``p_j >= max(S, 0)`` — sink positions live in their own
+  buffer (write-once, valid when ``s <= pos``), so the early-phase ring
+  copies of them are masked out rather than double-counted;
+- keys are stored post-RoPE at absolute positions, exactly like the
+  standard cache, so scores agree with the full-cache path bit-for-bit
+  up to contraction order.
+
+`rolling_decode_logits` (teacher-forced, the equivalence oracle) and
+`rolling_greedy_generate` (fused greedy loop) both scan step-by-step from
+position 0 — prefill IS the stream here; batch prefill belongs to the
+bounded-length path (prefill/decode_chunk)."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bee_code_interpreter_fs_tpu.models.llama import (
+    LlamaConfig,
+    _cached_gqa_attention,
+    _rms_norm,
+    _w,
+    transformer_block,
+)
+
+
+def init_rolling_cache(cfg: LlamaConfig, batch_size: int):
+    """Ring of cfg.sliding_window K/V slots + cfg.attention_sinks
+    write-once slots per layer. Sizes come from cfg ONLY: the decode step
+    derives its visible-key semantics from the cache shapes, so an
+    override here would silently diverge from forward() under the same
+    config."""
+    W = cfg.sliding_window
+    S = cfg.attention_sinks
+    if W <= 0:
+        raise ValueError("rolling cache needs a sliding window (W > 0)")
+    dt = jnp.dtype(cfg.dtype)
+    L, nkv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    shape = lambda n: (L, batch_size, n, nkv, hd)  # noqa: E731
+    cache = {
+        "k": jnp.zeros(shape(W), dt),
+        "v": jnp.zeros(shape(W), dt),
+    }
+    if S > 0:
+        cache["sink_k"] = jnp.zeros(shape(S), dt)
+        cache["sink_v"] = jnp.zeros(shape(S), dt)
+    return cache
+
+
+def rolling_decode_step(params, tokens, cache, pos, cfg: LlamaConfig):
+    """One decode step against the ring: tokens [b, 1] at position `pos`
+    (traced). Returns (logits [b, vocab] float32, updated cache)."""
+    if cfg.sliding_window <= 0:
+        raise ValueError("rolling_decode_step requires cfg.sliding_window > 0")
+    dt = jnp.dtype(cfg.dtype)
+    scale = cfg.head_dim ** -0.5
+    W = cache["k"].shape[2]
+    S = cache["sink_k"].shape[2] if "sink_k" in cache else 0
+
+    # Ring slot j currently holds absolute position pos - ((pos - j) % W)
+    # (negative = never written). Valid ring keys: written, and not a sink
+    # position (those attend from the sink buffer to avoid double counting).
+    j = jnp.arange(W)
+    p_j = pos - ((pos - j) % W)
+    ring_valid = p_j >= S
+    if S > 0:
+        sink_valid = jnp.arange(S) <= pos
+        valid = jnp.concatenate([sink_valid, ring_valid])[None, :]
+    else:
+        valid = ring_valid[None, :]
+    valid = valid[None, None, None]  # -> broadcast over [b, g, r, t, k]
+
+    slot = pos % W
+    x = params["embed"].astype(dt)[tokens]
+
+    def layer(x, inputs):
+        if S > 0:
+            lp, ck, cv, sk, sv = inputs
+        else:
+            lp, ck, cv = inputs
+        cell = {}
+
+        def attn_fn(q, k, v):
+            new_k = lax.dynamic_update_slice(ck, k, (0, slot, 0, 0))
+            new_v = lax.dynamic_update_slice(cv, v, (0, slot, 0, 0))
+            if S > 0:
+                # Write-once: only positions < S land in the sink buffer.
+                sink_slot = jnp.minimum(pos, S - 1)
+                write = (pos < S).astype(k.dtype)
+                old_k = lax.dynamic_slice(
+                    sk, (0, sink_slot, 0, 0), k.shape
+                )
+                old_v = lax.dynamic_slice(
+                    sv, (0, sink_slot, 0, 0), v.shape
+                )
+                new_sk = lax.dynamic_update_slice(
+                    sk, write * k + (1 - write) * old_k, (0, sink_slot, 0, 0)
+                )
+                new_sv = lax.dynamic_update_slice(
+                    sv, write * v + (1 - write) * old_v, (0, sink_slot, 0, 0)
+                )
+                cell["kv"] = (new_k, new_v, new_sk, new_sv)
+                keys = jnp.concatenate([new_sk, new_k], axis=1)
+                values = jnp.concatenate([new_sv, new_v], axis=1)
+            else:
+                cell["kv"] = (new_k, new_v)
+                keys, values = new_k, new_v
+            return _cached_gqa_attention(q, keys, values, valid, scale)
+
+        x = transformer_block(x, lp, cfg, attn_fn, rope_offset=pos)
+        return x, cell["kv"]
+
+    if S > 0:
+        xs = (params["layers"], cache["k"], cache["v"],
+              cache["sink_k"], cache["sink_v"])
+    else:
+        xs = (params["layers"], cache["k"], cache["v"])
+    x, new = lax.scan(layer, x, xs)
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ _w(params["lm_head"], dt)).astype(jnp.float32)
+    out = {"k": new[0], "v": new[1]}
+    if S > 0:
+        out["sink_k"], out["sink_v"] = new[2], new[3]
+    return logits, out
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def rolling_decode_logits(params, tokens, cfg: LlamaConfig):
+    """Teacher-forced logits [b, t, vocab] via the ring — the equivalence
+    oracle against forward() with the same window/sinks, at O(W+S) cache
+    residency instead of O(t)."""
+    b, t = tokens.shape
+    cache = init_rolling_cache(cfg, b)
+
+    def step(carry, inputs):
+        cache = carry
+        pos, tok = inputs
+        logits, cache = rolling_decode_step(
+            params, tok[:, None], cache, pos, cfg
+        )
+        return cache, logits
+
+    _, logits = lax.scan(
+        step, cache, (jnp.arange(t), tokens.T)
+    )
+    return logits.transpose(1, 0, 2)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
+def rolling_greedy_generate(params, prompt_tokens, cfg: LlamaConfig, *,
+                            max_new_tokens: int):
+    """Fused greedy decode over the ring: unbounded-stream serving shape —
+    cache bytes depend on (window + sinks), never on total length."""
+    b, p = prompt_tokens.shape
+    cache = init_rolling_cache(cfg, b)
+    total = p + max_new_tokens
+
+    def step(carry, pos):
+        cache, last_logits, buf = carry
+        prompt_tok = lax.dynamic_slice(
+            buf, (0, jnp.minimum(pos, p - 1)), (b, 1)
+        )[:, 0]
+        gen_tok = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+        tok = jnp.where(pos < p, prompt_tok, gen_tok)
+        logits, cache = rolling_decode_step(
+            params, tok[:, None], cache, pos, cfg
+        )
+        return (cache, logits, buf), tok
+
+    _, toks = lax.scan(
+        step,
+        (cache, jnp.zeros((b, cfg.vocab_size), jnp.float32), prompt_tokens),
+        jnp.arange(total),
+    )
+    # toks[pos] is the token FED at position pos: the prompt for pos < p,
+    # then each argmax of the previous step's logits — i.e. exactly the
+    # [b, prompt + max_new_tokens] sequence greedy_generate returns.
+    return toks.T
