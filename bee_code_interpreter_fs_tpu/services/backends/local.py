@@ -121,6 +121,8 @@ class LocalSandboxBackend(SandboxBackend):
         # dead — so a pool refill can never race the in-flight execution
         # for the chip (the round-1 bench wedge).
         self._tpu_slots = asyncio.Semaphore(max(1, self.config.local_tpu_slots))
+        self._build_lock = asyncio.Lock()
+        self._build_failed = False  # memo: never re-run a failed auto-build
         self._slot_holders: set[str] = set()  # sandbox/host ids holding a slot
 
     def _tpu_exclusive(self) -> bool:
@@ -141,6 +143,49 @@ class LocalSandboxBackend(SandboxBackend):
         del chip_count
         return max(1, self.config.local_tpu_slots) if self._tpu_exclusive() else None
 
+    async def _build_binary(self) -> None:
+        """Build the executor server on first use if the checkout is fresh.
+
+        `executor/build/` is gitignored, so a re-imaged machine (or a clean
+        clone) has sources but no binary — which would fail every spawn,
+        including the driver's round-end bench. Only attempted for the
+        default in-repo path; a custom `executor_binary` is the operator's
+        to provide."""
+        if self.binary != DEFAULT_BINARY:
+            return
+        async with self._build_lock:
+            if self.binary.exists() or self._build_failed:
+                return
+            makedir = self.binary.parent.parent
+            logger.info("executor binary missing; building via make -C %s", makedir)
+            try:
+                proc = await asyncio.create_subprocess_exec(
+                    "make",
+                    "-C",
+                    str(makedir),
+                    stdout=asyncio.subprocess.PIPE,
+                    stderr=asyncio.subprocess.STDOUT,
+                )
+            except OSError as e:  # no `make` on PATH → fall to the message
+                logger.error("executor auto-build unavailable: %s", e)
+                self._build_failed = True
+                return
+            try:
+                out, _ = await asyncio.wait_for(proc.communicate(), timeout=300.0)
+            except asyncio.TimeoutError:
+                proc.kill()
+                await proc.wait()
+                logger.error("executor build timed out after 300s; killed")
+                self._build_failed = True
+                return
+            if proc.returncode != 0:
+                self._build_failed = True
+                logger.error(
+                    "executor build failed rc=%s:\n%s",
+                    proc.returncode,
+                    out.decode("utf-8", "replace")[-1500:],
+                )
+
     def _stderr_tail(self, host_ids: list[str], limit: int = 1500) -> str:
         """Tail of the sandbox server's stderr log(s) — the only place a
         wedged `import jax` leaves its traceback (round-1's bench failure
@@ -157,6 +202,8 @@ class LocalSandboxBackend(SandboxBackend):
         return "\n".join(parts)
 
     async def spawn(self, chip_count: int = 0) -> Sandbox:
+        if not self.binary.exists():
+            await self._build_binary()
         if not self.binary.exists():
             raise SandboxSpawnError(
                 f"executor binary not found at {self.binary}; run `make -C executor`"

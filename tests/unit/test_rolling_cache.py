@@ -2,7 +2,6 @@
 logits equal to the full-cache windowed path."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

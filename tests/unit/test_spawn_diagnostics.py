@@ -213,3 +213,39 @@ async def test_server_log_written_per_sandbox(tmp_path):
             raise AssertionError("server.log never saw the startup line")
     finally:
         await backend.close()
+
+
+async def test_missing_binary_triggers_auto_build(tmp_path, monkeypatch):
+    """A fresh checkout has no executor binary (`executor/build/` is
+    gitignored); the first spawn must attempt `make -C executor` instead of
+    failing outright — a re-imaged driver machine runs bench.py without a
+    manual build step."""
+    from bee_code_interpreter_fs_tpu.services.backends import local as local_mod
+
+    backend = LocalSandboxBackend(_config(tmp_path), warm_import_jax=False)
+    fake_default = tmp_path / "build" / "executor-server"
+    monkeypatch.setattr(local_mod, "DEFAULT_BINARY", fake_default)
+    backend.binary = fake_default
+
+    calls: list[str] = []
+
+    async def fake_build() -> None:
+        calls.append("build")
+
+    monkeypatch.setattr(backend, "_build_binary", fake_build)
+    # The (failed) build leaves no binary, so the spawn still raises the
+    # actionable error — the assertion is that the build hook ran first.
+    with pytest.raises(SandboxSpawnError, match="executor binary not found"):
+        await backend.spawn()
+    assert calls == ["build"]
+
+
+async def test_custom_binary_path_is_not_auto_built(tmp_path):
+    """An operator-specified `executor_binary` that is missing is an
+    operator error: no build attempt, just the actionable message."""
+    missing = tmp_path / "no-such-binary"
+    backend = LocalSandboxBackend(
+        _config(tmp_path, executor_binary=str(missing)), warm_import_jax=False
+    )
+    with pytest.raises(SandboxSpawnError, match="executor binary not found"):
+        await backend.spawn()
