@@ -224,15 +224,20 @@ def _rms_norm(x, w, eps):
 
 def _rope(x, theta, offset=0):
     """Rotary embedding over [b, t, h, d]; `offset` shifts the position
-    index (incremental decoding: the single new token sits at `pos`)."""
+    index (incremental decoding: the single new token sits at `pos`).
+    `offset` may be a scalar (whole batch at one position) or a [b] vector
+    (continuous batching: every slot decodes at its own sequence length —
+    models/serving.py)."""
     b, t, h, d = x.shape
     freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    positions = jnp.arange(t, dtype=jnp.float32) + offset
-    angles = positions[:, None] * freqs[None, :]  # [t, d/2]
+    off = jnp.asarray(offset, dtype=jnp.float32)
+    # [b, t] positions; a scalar offset broadcasts to identical rows.
+    positions = jnp.arange(t, dtype=jnp.float32)[None, :] + jnp.atleast_1d(off)[:, None]
+    angles = positions[..., None] * freqs[None, None, :]  # [b|1, t, d/2]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
     x1, x2 = x[..., 0::2], x[..., 1::2]
-    cos = cos[None, :, None, :].astype(x.dtype)
-    sin = sin[None, :, None, :].astype(x.dtype)
+    cos = cos[:, :, None, :].astype(x.dtype)
+    sin = sin[:, :, None, :].astype(x.dtype)
     out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.reshape(b, t, h, d)
 
@@ -458,6 +463,24 @@ def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int | None = None):
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
+def decode_valid_mask(q_pos, max_len, cfg: LlamaConfig):
+    """Which cache positions queries at positions `q_pos` [n] may attend:
+    causal prefix, minus anything a sliding window retires, plus
+    StreamingLLM sinks. Returns bool [n, max_len]. The ONE home of the
+    window/sinks visibility formula for every cached-decode path
+    (decode_chunk, decode_step via decode_chunk, the continuous-batching
+    engine's per-slot step in models/serving.py)."""
+    valid = jnp.arange(max_len)[None, :] <= q_pos[:, None]
+    if cfg.sliding_window > 0:
+        visible = (
+            jnp.arange(max_len)[None, :] > q_pos[:, None] - cfg.sliding_window
+        )
+        if cfg.attention_sinks > 0:
+            visible |= (jnp.arange(max_len) < cfg.attention_sinks)[None, :]
+        valid &= visible
+    return valid
+
+
 def _cached_gqa_attention(q, keys, values, valid, scale):
     """Attention of `q` [b, t, nh, hd] against an UNexpanded cache
     ([b, max, nkv, hd]) via a grouped contraction — no jnp.repeat copy of
@@ -545,16 +568,7 @@ def decode_chunk(params, tokens, cache, pos, cfg: LlamaConfig):
     max_len = cache["k"].shape[2]
     # Chunk-local query i (global pos+i) sees cache positions <= pos+i
     # (and, with a sliding window, none older than pos+i-window+1).
-    q_pos = pos + jnp.arange(s)
-    valid2d = jnp.arange(max_len)[None, :] <= q_pos[:, None]
-    if cfg.sliding_window > 0:
-        visible = (
-            jnp.arange(max_len)[None, :] > q_pos[:, None] - cfg.sliding_window
-        )
-        if cfg.attention_sinks > 0:
-            visible |= (jnp.arange(max_len) < cfg.attention_sinks)[None, :]
-        valid2d &= visible
-    valid = valid2d[None, None, None]
+    valid = decode_valid_mask(pos + jnp.arange(s), max_len, cfg)[None, None, None]
     x = params["embed"].astype(dt)[tokens]
 
     def layer(x, inputs):

@@ -185,6 +185,15 @@ class LocalSandboxBackend(SandboxBackend):
                     proc.returncode,
                     out.decode("utf-8", "replace")[-1500:],
                 )
+            elif not self.binary.exists():
+                # rc=0 but no binary at the expected path (e.g. the Makefile's
+                # output target moved) — memoize, or every spawn re-runs a
+                # full no-op make before failing.
+                self._build_failed = True
+                logger.error(
+                    "executor build succeeded but %s does not exist; "
+                    "not retrying", self.binary,
+                )
 
     def _stderr_tail(self, host_ids: list[str], limit: int = 1500) -> str:
         """Tail of the sandbox server's stderr log(s) — the only place a
