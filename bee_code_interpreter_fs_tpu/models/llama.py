@@ -304,7 +304,12 @@ def _w(leaf, dt):
     layer scan — XLA then reads 1 (or 0.5) byte/param from HBM and fuses
     unpack/convert/scale into the matmul operand path, which is the whole
     point of weight-only quantization on a decode path that is
-    weight-bandwidth-bound."""
+    weight-bandwidth-bound.
+
+    LoRA composite leaves {"base","lora_a","lora_b"} (models/lora.py)
+    resolve recursively to base + a @ b — the base may itself be a
+    quantized leaf (QLoRA), and every model path (forward, fused decode,
+    serving, pipeline) picks adapters up through this one accessor."""
     from bee_code_interpreter_fs_tpu.models.quant import (
         dequantize,
         dequantize4,
@@ -312,11 +317,35 @@ def _w(leaf, dt):
         is_quantized4,
     )
 
+    from bee_code_interpreter_fs_tpu.models.lora import is_lora_leaf
+
+    if is_lora_leaf(leaf):
+        # Correctness fallback only: materializes the full [in, out] delta.
+        # Every model matmul goes through _mm below, which applies the
+        # low-rank update activation-side and never builds this product.
+        return _w(leaf["base"], dt) + (
+            leaf["lora_a"].astype(dt) @ leaf["lora_b"].astype(dt)
+        )
     if is_quantized(leaf):
         return dequantize(leaf, dt)
     if is_quantized4(leaf):
         return dequantize4(leaf, dt)
     return leaf.astype(dt)
+
+
+def _mm(h, leaf, dt):
+    """``h @ W`` for any weight-leaf kind. LoRA composite leaves apply
+    activation-side — ``h @ base + (h @ a) @ b`` — so the update costs two
+    skinny matmuls (in×r, r×out) and the dense [in, out] delta is never
+    materialized; the (possibly int8/int4-quantized — QLoRA) base keeps its
+    reduced HBM traffic on the weight-bandwidth-bound decode path."""
+    from bee_code_interpreter_fs_tpu.models.lora import is_lora_leaf
+
+    if is_lora_leaf(leaf):
+        return _mm(h, leaf["base"], dt) + (
+            h @ leaf["lora_a"].astype(dt)
+        ) @ leaf["lora_b"].astype(dt)
+    return h @ _w(leaf, dt)
 
 
 def transformer_block(x, lp, cfg: LlamaConfig, attn_fn, *, rope_offset=0):
@@ -329,20 +358,20 @@ def transformer_block(x, lp, cfg: LlamaConfig, attn_fn, *, rope_offset=0):
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     dt = x.dtype
     h = _rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ _w(lp["wq"], dt)).reshape(b, t, nh, hd)
-    k = (h @ _w(lp["wk"], dt)).reshape(b, t, nkv, hd)
-    v = (h @ _w(lp["wv"], dt)).reshape(b, t, nkv, hd)
+    q = _mm(h, lp["wq"], dt).reshape(b, t, nh, hd)
+    k = _mm(h, lp["wk"], dt).reshape(b, t, nkv, hd)
+    v = _mm(h, lp["wv"], dt).reshape(b, t, nkv, hd)
     q = _rope(q, cfg.rope_theta, offset=rope_offset)
     k = _rope(k, cfg.rope_theta, offset=rope_offset)
     attn = attn_fn(q, k, v)
-    x = x + attn.reshape(b, t, nh * hd) @ _w(lp["wo"], dt)
+    x = x + _mm(attn.reshape(b, t, nh * hd), lp["wo"], dt)
 
     h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts > 0:
         x = x + _moe_mlp(h, lp, cfg)
     else:
-        gate = jax.nn.silu(h @ _w(lp["w_gate"], dt))
-        x = x + (gate * (h @ _w(lp["w_up"], dt))) @ _w(lp["w_down"], dt)
+        gate = jax.nn.silu(_mm(h, lp["w_gate"], dt))
+        x = x + _mm(gate * _mm(h, lp["w_up"], dt), lp["w_down"], dt)
     return x
 
 

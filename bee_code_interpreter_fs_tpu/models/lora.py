@@ -1,0 +1,199 @@
+"""LoRA / QLoRA adapters — parameter-efficient fine-tuning on TPU.
+
+The reference project has no fine-tuning story at all (its sandbox runs
+user-supplied torch/CUDA scripts; nothing in
+`/root/reference/src` or `/root/reference/executor` trains); this module is
+part of the TPU framework surface that replaces it. Design:
+
+- An adapted weight is a COMPOSITE LEAF ``{"base", "lora_a", "lora_b"}``
+  in the same stacked-[n_layers, ...] layout the layer `lax.scan` consumes.
+  The model's single matmul-weight accessor (`llama._w`) materializes
+  ``base + a @ b`` at the use site inside the scan, so every existing code
+  path — forward, fused generate, speculative decode, the continuous-
+  batching engine, pipeline stages — serves adapted weights with ZERO
+  changes: `lora_wrap` produces a params tree that drops in anywhere a
+  params tree goes.
+- ``base`` may itself be an int8 ``{"q","s"}`` or packed-int4
+  ``{"q4","s4"}`` leaf (models/quant.py): that composition IS QLoRA — the
+  frozen base streams from HBM at 1 or 0.5 bytes/param while the trainable
+  adapters stay in float32. Nothing special-cases it; `_w` recurses.
+- Training optimizes ONLY the adapter tree: `make_lora_train_step` closes
+  over the frozen base, so jax.grad never touches it, the optimizer state
+  is adapter-sized (rank × dims, thousands of times smaller than the
+  model), and the base can stay quantized the whole time.
+
+TPU notes: the rank-r update adds two skinny matmuls per adapted weight
+per step (in×r, r×out) — XLA fuses the cast/scale chain; at serving time
+`merge_lora` folds the update into dense weights so inference pays zero
+adapter cost (quantized bases serve wrapped instead — merging would
+dequantize).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bee_code_interpreter_fs_tpu.models.llama import LlamaConfig
+from bee_code_interpreter_fs_tpu.models.quant import is_quantized, is_quantized4
+
+__all__ = [
+    "DEFAULT_TARGETS",
+    "init_lora",
+    "lora_wrap",
+    "lora_param_specs",
+    "merge_lora",
+    "make_lora_train_step",
+    "is_lora_leaf",
+]
+
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo")
+
+# target -> (in_dim, out_dim) as functions of the config
+def _target_dims(cfg: LlamaConfig, name: str) -> tuple[int, int]:
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    dims = {
+        "wq": (cfg.dim, nh * hd),
+        "wk": (cfg.dim, nkv * hd),
+        "wv": (cfg.dim, nkv * hd),
+        "wo": (nh * hd, cfg.dim),
+    }
+    if cfg.n_experts == 0:
+        dims.update({
+            "w_gate": (cfg.dim, cfg.hidden_dim),
+            "w_up": (cfg.dim, cfg.hidden_dim),
+            "w_down": (cfg.hidden_dim, cfg.dim),
+        })
+    if name not in dims:
+        extra = (
+            " (MoE expert MLPs are not adaptable: their stacked [E, ...] "
+            "weights would need per-expert adapters)"
+            if cfg.n_experts > 0 and name in ("w_gate", "w_up", "w_down")
+            else ""
+        )
+        raise ValueError(f"unknown LoRA target {name!r}{extra}")
+    return dims[name]
+
+
+def is_lora_leaf(leaf) -> bool:
+    return isinstance(leaf, dict) and "lora_a" in leaf
+
+
+def init_lora(key, cfg: LlamaConfig, *, rank: int = 8,
+              targets: tuple = DEFAULT_TARGETS):
+    """Adapter tree {"layers": {target: {"a": [L, in, r], "b": [L, r, out]}}}.
+
+    `a` gets a fan-in-scaled normal init, `b` starts at ZERO — the wrapped
+    model is exactly the base model at step 0 (the standard LoRA identity
+    init, so fine-tuning departs smoothly from the pretrained function).
+    Adapters are float32 regardless of cfg.dtype: they are tiny, and they
+    are the only thing the optimizer touches.
+    """
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    L = cfg.n_layers
+    out = {}
+    for name in targets:
+        d_in, d_out = _target_dims(cfg, name)
+        key, k = jax.random.split(key)
+        out[name] = {
+            "a": jax.random.normal(k, (L, d_in, rank), jnp.float32)
+            * d_in ** -0.5,
+            "b": jnp.zeros((L, rank, d_out), jnp.float32),
+        }
+    return {"layers": out}
+
+
+def lora_wrap(params, lora, *, alpha: float = 16.0):
+    """Attach adapters: returns a params tree whose target leaves are
+    composite ``{"base", "lora_a", "lora_b"}`` dicts that `llama._w`
+    resolves to ``base + a @ b`` at every use site. The alpha/rank scale is
+    folded into lora_b here (one cheap [L, r, out] multiply under jit).
+    Works on dense AND quantized bases (QLoRA); cheap enough to call inside
+    the train step every iteration.
+    """
+    layers = dict(params["layers"])
+    for name, ab in lora["layers"].items():
+        rank = ab["a"].shape[-1]
+        layers[name] = {
+            "base": params["layers"][name],
+            "lora_a": ab["a"],
+            "lora_b": ab["b"] * (alpha / rank),
+        }
+    return {**params, "layers": layers}
+
+
+def lora_param_specs(cfg: LlamaConfig, *, targets: tuple = DEFAULT_TARGETS,
+                     base_specs=None):
+    """PartitionSpec tree matching a `lora_wrap` tree — the analog of
+    quant.quantized_param_specs for the LoRA structural leaf change, so
+    explicitly-sharded paths (device_put / jit in_shardings built from
+    specs) keep working on adapted trees.
+
+    Target leaves become {"base": <base spec>, "lora_a", "lora_b"}:
+    `lora_a` shards its input dim like the base weight's input dim and
+    `lora_b` its output dim like the base's output dim (the rank dim
+    replicates) — under tp the skinny adapter matmuls then compose with
+    the base matmul's existing collective placement instead of adding one.
+    `base_specs` defaults to `llama.param_specs(cfg)`; pass
+    quantized(4)_param_specs output for a QLoRA tree.
+    """
+    from bee_code_interpreter_fs_tpu.models.llama import param_specs
+
+    base_specs = base_specs if base_specs is not None else param_specs(cfg)
+    P = jax.sharding.PartitionSpec
+    layers = dict(base_specs["layers"])
+    for name in targets:
+        _target_dims(cfg, name)  # validates the target for this config
+        spec = layers[name]
+        if isinstance(spec, dict):  # quantized base: {"q": P, "s": P}
+            ref = spec["q" if "q" in spec else "q4"]
+        else:
+            ref = spec
+        in_s, out_s = ref[1], ref[2]
+        layers[name] = {
+            "base": spec,
+            "lora_a": P(None, in_s, None),
+            "lora_b": P(None, None, out_s),
+        }
+    return {**base_specs, "layers": layers}
+
+
+def merge_lora(params, lora, *, alpha: float = 16.0):
+    """Fold adapters into the dense base weights (serving: zero adapter
+    cost). Quantized bases refuse — merging would silently dequantize the
+    model; serve the `lora_wrap` tree instead, which keeps the base at
+    1/0.5 bytes/param and adds only the two skinny matmuls."""
+    layers = dict(params["layers"])
+    for name, ab in lora["layers"].items():
+        base = params["layers"][name]
+        if is_quantized(base) or is_quantized4(base):
+            raise ValueError(
+                f"cannot merge LoRA into quantized base {name!r}; serve the "
+                "lora_wrap tree instead"
+            )
+        rank = ab["a"].shape[-1]
+        delta = jnp.einsum("lir,lro->lio", ab["a"], ab["b"]) * (alpha / rank)
+        layers[name] = (base.astype(jnp.float32) + delta).astype(base.dtype)
+    return {**params, "layers": layers}
+
+
+def make_lora_train_step(cfg: LlamaConfig, optimizer, base_params, *,
+                         alpha: float = 16.0, mesh=None):
+    """Returns jittable `step(lora, opt_state, batch) -> (lora, opt_state,
+    loss)` that trains ONLY the adapters against the frozen (possibly
+    quantized — QLoRA) base. Mirrors `llama.make_train_step`'s contract;
+    the optimizer state is adapter-sized."""
+    from bee_code_interpreter_fs_tpu.models.llama import loss_fn
+
+    def adapter_loss(lora, batch):
+        return loss_fn(lora_wrap(base_params, lora, alpha=alpha), batch, cfg,
+                       mesh=mesh)
+
+    def step(lora, opt_state, batch):
+        loss, grads = jax.value_and_grad(adapter_loss)(lora, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, lora)
+        lora = jax.tree.map(lambda p, u: p + u.astype(p.dtype), lora, updates)
+        return lora, opt_state, loss
+
+    return step

@@ -1,0 +1,178 @@
+"""LoRA / QLoRA: identity at init, adapter-only training, quant compose."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from bee_code_interpreter_fs_tpu.models.llama import (
+    LlamaConfig,
+    forward,
+    greedy_generate,
+    init_params,
+)
+from bee_code_interpreter_fs_tpu.models.lora import (
+    init_lora,
+    lora_wrap,
+    make_lora_train_step,
+    merge_lora,
+)
+from bee_code_interpreter_fs_tpu.models.quant import (
+    quantize4_params,
+    quantize_params,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig.tiny(n_layers=2, dim=64, hidden_dim=128, n_heads=4,
+                           n_kv_heads=2, vocab_size=89, max_seq_len=64,
+                           dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def _batch(cfg, b=4, t=16, seed=1):
+    return {"tokens": jax.random.randint(
+        jax.random.PRNGKey(seed), (b, t), 0, cfg.vocab_size
+    )}
+
+
+def test_zero_init_is_identity(model):
+    params, cfg = model
+    lora = init_lora(jax.random.PRNGKey(1), cfg, rank=4)
+    toks = _batch(cfg)["tokens"]
+    base_out = forward(params, toks, cfg)
+    wrapped_out = forward(lora_wrap(params, lora), toks, cfg)
+    np.testing.assert_array_equal(np.asarray(base_out), np.asarray(wrapped_out))
+
+
+def test_training_moves_only_adapters(model):
+    params, cfg = model
+    lora = init_lora(jax.random.PRNGKey(2), cfg, rank=4,
+                     targets=("wq", "wv", "w_down"))
+    opt = optax.adam(1e-2)
+    step = jax.jit(make_lora_train_step(cfg, opt, params))
+    state = opt.init(lora)
+    batch = _batch(cfg)
+    losses = []
+    for _ in range(12):
+        lora, state, loss = step(lora, state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
+    # b departed from zero; the base tree was never touched (closure-frozen).
+    assert float(jnp.abs(lora["layers"]["wq"]["b"]).max()) > 0
+
+
+def test_merge_equals_wrap(model):
+    params, cfg = model
+    lora = init_lora(jax.random.PRNGKey(3), cfg, rank=4)
+    # Give b real values so the test isn't the identity case.
+    lora = jax.tree.map(
+        lambda x: x + 0.01 * jnp.ones_like(x), lora
+    )
+    toks = _batch(cfg, seed=7)["tokens"]
+    wrapped = forward(lora_wrap(params, lora), toks, cfg)
+    merged = forward(merge_lora(params, lora), toks, cfg)
+    np.testing.assert_allclose(
+        np.asarray(wrapped), np.asarray(merged), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_wrapped_tree_drives_fused_generation(model):
+    """The adapted tree must drop into every decode path unchanged —
+    greedy_generate on wrapped == greedy_generate on merged."""
+    params, cfg = model
+    lora = init_lora(jax.random.PRNGKey(4), cfg, rank=2)
+    lora = jax.tree.map(lambda x: x + 0.02 * jnp.ones_like(x), lora)
+    prompt = jnp.asarray([[5, 11, 2]], jnp.int32)
+    out_w = greedy_generate(lora_wrap(params, lora), prompt, cfg,
+                            max_new_tokens=8)
+    out_m = greedy_generate(merge_lora(params, lora), prompt, cfg,
+                            max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(out_w), np.asarray(out_m))
+
+
+@pytest.mark.parametrize("quantize", [quantize_params, quantize4_params])
+def test_qlora_trains_on_quantized_base(model, quantize):
+    params, cfg = model
+    qbase = quantize(params)
+    lora = init_lora(jax.random.PRNGKey(5), cfg, rank=4)
+    # Identity init still holds relative to the QUANTIZED base's forward.
+    toks = _batch(cfg, seed=9)["tokens"]
+    np.testing.assert_array_equal(
+        np.asarray(forward(qbase, toks, cfg)),
+        np.asarray(forward(lora_wrap(qbase, lora), toks, cfg)),
+    )
+    opt = optax.adam(1e-2)
+    step = jax.jit(make_lora_train_step(cfg, opt, qbase))
+    state = opt.init(lora)
+    batch = _batch(cfg, seed=10)
+    losses = []
+    for _ in range(12):
+        lora, state, loss = step(lora, state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_merge_refuses_quantized_base(model):
+    params, cfg = model
+    qbase = quantize_params(params)
+    lora = init_lora(jax.random.PRNGKey(6), cfg, rank=2)
+    with pytest.raises(ValueError, match="quantized"):
+        merge_lora(qbase, lora)
+
+
+def test_lora_param_specs_match_wrapped_tree():
+    """Specs tree must be tree.map-compatible with a lora_wrap tree (the
+    structural contract that keeps explicit sharding paths working), for
+    dense and QLoRA bases alike, and a tp-sharded forward must agree with
+    the unsharded one."""
+    from jax.sharding import Mesh, NamedSharding
+    from bee_code_interpreter_fs_tpu.models.lora import lora_param_specs
+    from bee_code_interpreter_fs_tpu.models.quant import quantized_param_specs
+
+    # tp=2-divisible dims (the module fixture's vocab of 89 is prime).
+    cfg = LlamaConfig.tiny(n_layers=2, dim=64, hidden_dim=128, n_heads=4,
+                           n_kv_heads=2, vocab_size=96, max_seq_len=64,
+                           dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    lora = init_lora(jax.random.PRNGKey(8), cfg, rank=4)
+    lora = jax.tree.map(lambda x: x + 0.01 * jnp.ones_like(x), lora)
+    wrapped = lora_wrap(params, lora)
+    specs = lora_param_specs(cfg)
+    jax.tree.map(lambda s, p: None, specs, wrapped)  # structure match
+
+    qwrapped = lora_wrap(quantize_params(params), lora)
+    qspecs = lora_param_specs(cfg, base_specs=quantized_param_specs(cfg))
+    jax.tree.map(lambda s, p: None, qspecs, qwrapped)
+
+    devs = np.array(jax.devices()[:2]).reshape(2)
+    mesh = Mesh(devs, ("tp",))
+    sharded = jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), wrapped, specs
+    )
+    toks = _batch(cfg, seed=11)["tokens"]
+    np.testing.assert_allclose(
+        np.asarray(forward(sharded, toks, cfg)),
+        np.asarray(forward(wrapped, toks, cfg)),
+        atol=1e-5, rtol=1e-5,
+    )
+
+
+def test_moe_mlp_targets_rejected():
+    cfg = LlamaConfig.tiny(n_layers=2, dim=64, hidden_dim=128, n_heads=4,
+                           n_kv_heads=2, vocab_size=89, n_experts=4,
+                           n_experts_per_token=2, dtype="float32")
+    with pytest.raises(ValueError, match="MoE"):
+        init_lora(jax.random.PRNGKey(0), cfg, rank=2,
+                  targets=("wq", "w_gate"))
+    # Attention targets stay adaptable on MoE models.
+    lora = init_lora(jax.random.PRNGKey(0), cfg, rank=2)
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, 89)
+    np.testing.assert_array_equal(
+        np.asarray(forward(params, toks, cfg)),
+        np.asarray(forward(lora_wrap(params, lora), toks, cfg)),
+    )
