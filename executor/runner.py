@@ -270,6 +270,12 @@ def _run_one(req: dict) -> int:
     exit_code = 0
     trace_dir = _start_profile() if _profile_requested(env) else None
     restore_rlimits = _apply_user_rlimits()
+    # User code may rebind/ignore SIGINT; restore it afterwards or a single
+    # tenant could permanently disable the server's cooperative timeout
+    # cancellation for every later generation of this warm process.
+    import signal as _signal
+
+    saved_sigint = _signal.getsignal(_signal.SIGINT)
     try:
         sys.argv = [source_path]  # argv[0] stays the user's path
         runpy.run_path(run_path, run_name="__main__")
@@ -284,6 +290,10 @@ def _run_one(req: dict) -> int:
         exit_code = 1
     finally:
         restore_rlimits()
+        try:
+            _signal.signal(_signal.SIGINT, saved_sigint)
+        except (ValueError, TypeError):  # non-main thread / exotic handler
+            pass
         sys.argv = saved_argv
         if trace_dir is not None:
             # Inside the redirect so profiler chatter lands in the capture.
@@ -475,7 +485,14 @@ def main() -> None:
 
     buf = b""
     while True:
-        chunk = os.read(REQ_FD, 65536)
+        try:
+            chunk = os.read(REQ_FD, 65536)
+        except KeyboardInterrupt:
+            # The server's cooperative-cancellation SIGINT raced the user
+            # code finishing: it landed here, between requests. Dying now
+            # would throw away a healthy runner (and its device lease) over
+            # a request that already completed — swallow and keep serving.
+            continue
         if not chunk:
             # Server is gone; this sandbox is dead. Skip atexit — nothing
             # needs flushing, and jax.distributed's shutdown barrier would
@@ -487,11 +504,26 @@ def main() -> None:
             if not line.strip():
                 continue
             req = None
+            replied = False
+
+            def _reply(obj):
+                nonlocal replied
+                replied = True
+                _send(obj)
+
+            def _reply_error():
+                if replied:
+                    return
+                if isinstance(req, dict) and req.get("op") == "reset":
+                    _reply({"ok": False})
+                else:
+                    _reply({"exit_code": -2})
+
             try:
                 req = json.loads(line)
                 if req.get("op") == "reset":
                     ok = _reset(snapshot)
-                    _send({"ok": ok})
+                    _reply({"ok": ok})
                     if ok:
                         import gc
 
@@ -501,13 +533,17 @@ def main() -> None:
                         gc.collect()
                 else:
                     exit_code = _run_one(req)
-                    _send({"exit_code": exit_code})
+                    _reply({"exit_code": exit_code})
+            except KeyboardInterrupt:
+                # The cancellation SIGINT raced past user code and landed in
+                # RUNNER code (dispatch, _send, _run_one's unwind after the
+                # handler was restored). The request it aimed at is already
+                # over — answer whatever request is in flight (never twice)
+                # and keep the process, and its device lease, alive.
+                _reply_error()
             except Exception:  # noqa: BLE001
                 traceback.print_exc()
-                if isinstance(req, dict) and req.get("op") == "reset":
-                    _send({"ok": False})
-                else:
-                    _send({"exit_code": -2})
+                _reply_error()
 
 
 if __name__ == "__main__":

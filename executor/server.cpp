@@ -384,7 +384,8 @@ class WarmRunner {
       : python_(std::move(python)),
         runner_script_(std::move(runner_script)),
         workspace_(std::move(workspace)),
-        ready_timeout_s_(ready_timeout_s) {}
+        ready_timeout_s_(ready_timeout_s),
+        interrupt_grace_s_(env_num("APP_RUNNER_INTERRUPT_GRACE_S", 20.0)) {}
 
   bool start() {
     int req_pipe[2];   // server writes → runner fd 3
@@ -449,7 +450,7 @@ class WarmRunner {
   const std::string& backend() const { return backend_; }
   int device_count() const { return device_count_; }
 
-  enum class ExecResult { kOk, kTimeout, kDied };
+  enum class ExecResult { kOk, kTimeout, kDied, kInterrupted };
 
   // Generation reset: scrub the previous sandbox's traces from the warm
   // process (stray children, workspace modules, env/cwd) while keeping the
@@ -467,10 +468,21 @@ class WarmRunner {
   }
 
   // kTimeout = deadline expired (runner killed); kDied = runner crashed or
-  // spoke garbage (killed). The two must be distinguished so a crash isn't
-  // misreported to the user as slow code.
+  // spoke garbage (killed); kInterrupted = deadline expired but cooperative
+  // cancellation worked — the runner unwound user code via SIGINT, reported,
+  // and is still alive with its device lease intact (caller must reset() to
+  // scrub, then may keep serving warm). The distinction matters doubly on a
+  // leased accelerator: SIGKILLing a runner mid-device-op abandons the
+  // device's server-side claim with no goodbye, which can leave the chip
+  // refusing attaches until the stale claim lapses (observed on the
+  // tunneled TPU: one timeout kill cost every later client a ~25-minute
+  // blocked attach).
+  // `allow_interrupt` gates the SIGINT grace to USER-code executes:
+  // control ops (reset) must keep crisp kill-on-timeout semantics — their
+  // handlers don't expect KeyboardInterrupt, and a late "interrupted"
+  // verdict would misread a successful-but-slow reset as failure.
   ExecResult execute(const std::string& request_json, double timeout_s,
-                     minijson::Value& response) {
+                     minijson::Value& response, bool allow_interrupt = false) {
     std::string line = request_json + "\n";
     size_t off = 0;
     while (off < line.size()) {
@@ -485,6 +497,23 @@ class WarmRunner {
     std::string resp_line;
     bool timed_out = false;
     if (!read_line(resp_line, timeout_s, &timed_out)) {
+      if (allow_interrupt && timed_out && interrupt_grace_s_ > 0 && pid_ > 0) {
+        // Cooperative cancellation first: SIGINT surfaces in the user code
+        // as KeyboardInterrupt, the runner's report-don't-die handler
+        // writes a response, and the process (with its device lease)
+        // survives. Python only delivers the signal between bytecodes, so
+        // a runner pinned inside a long native call (an XLA compile) may
+        // outlast the grace — then we fall through to the kill, as before.
+        kill(-pid_, SIGINT);
+        bool late_timeout = false;
+        std::string late_line;
+        if (read_line(late_line, interrupt_grace_s_, &late_timeout)) {
+          log_msg("execute timeout: runner unwound via SIGINT (kept alive)");
+          return ExecResult::kInterrupted;
+        }
+        log_msg("execute timeout: SIGINT grace (%.0fs) expired; killing",
+                interrupt_grace_s_);
+      }
       kill_runner();
       return timed_out ? ExecResult::kTimeout : ExecResult::kDied;
     }
@@ -553,6 +582,7 @@ class WarmRunner {
 
   std::string python_, runner_script_, workspace_;
   double ready_timeout_s_ = 180.0;
+  double interrupt_grace_s_ = 20.0;
   pid_t pid_ = -1;
   int req_fd_ = -1, resp_fd_ = -1;
   bool ready_ = false;
@@ -882,7 +912,7 @@ RunOutcome run_user_code(const std::string& script_path,
         minijson::Value resp;
         WarmRunner::ExecResult r = g_state.runner->execute(
             minijson::Value(reqo).dump(), timeout_s > 0 ? timeout_s + 0.5 : 0,
-            resp);
+            resp, /*allow_interrupt=*/true);
         out.ran_warm = true;
         switch (r) {
           case WarmRunner::ExecResult::kOk:
@@ -891,6 +921,13 @@ RunOutcome run_user_code(const std::string& script_path,
           case WarmRunner::ExecResult::kTimeout:
             out.timed_out = true;
             restart_runner = true;
+            break;
+          case WarmRunner::ExecResult::kInterrupted:
+            // Timed out, but cooperative cancellation unwound the user code
+            // and the runner survived with its device lease. Scrub the
+            // generation; only a failed scrub costs us the warm process.
+            out.timed_out = true;
+            if (!g_state.runner->reset(15.0)) restart_runner = true;
             break;
           case WarmRunner::ExecResult::kDied:
             out.runner_died = true;

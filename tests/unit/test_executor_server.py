@@ -39,6 +39,9 @@ def _server_env(ws, rp) -> dict:
             "APP_WORKSPACE": str(ws),
             "APP_RUNTIME_PACKAGES": str(rp),
             "APP_WARM_IMPORT_JAX": "0",
+            # Short cooperative-cancellation grace so the forced-kill tests
+            # don't stall the suite waiting out the production default.
+            "APP_RUNNER_INTERRUPT_GRACE_S": "2",
         }
     )
     return env
@@ -160,9 +163,34 @@ def test_execute_changed_files_recursive(executor):
     assert "top.txt" in result["files"]
 
 
-def test_execute_timeout_and_recovery(executor):
+def test_execute_timeout_cooperative_cancel(executor):
+    """An interruptible runaway (the common case) is cancelled via SIGINT:
+    the response carries timeout semantics, but the warm runner SURVIVES —
+    no background restart, and the very next request is served warm. On a
+    leased accelerator this is what keeps a timeout from abandoning the
+    device claim (SIGKILL mid-op wedged the tunneled TPU for ~25 min)."""
     client, _ = executor
     result = execute(client, "while True: pass", timeout=1)
+    assert result["exit_code"] == -1
+    assert "timed out" in result["stderr"]
+    assert result["runner_restarted"] is False
+    result = execute(client, "print('still warm')")
+    assert result["stdout"] == "still warm\n"
+    assert result["warm"] is True
+
+
+def test_execute_timeout_and_recovery(executor):
+    """An UNinterruptible runaway (ignores SIGINT outright) exhausts the
+    cancellation grace and exercises the forced-kill + background-rewarm
+    path."""
+    client, _ = executor
+    result = execute(
+        client,
+        "import signal\n"
+        "signal.signal(signal.SIGINT, signal.SIG_IGN)\n"
+        "while True: pass",
+        timeout=1,
+    )
     assert result["exit_code"] == -1
     assert "timed out" in result["stderr"]
     # The runner restart happens in the BACKGROUND (VERDICT r1 #9): the very
@@ -275,14 +303,10 @@ def test_execute_stream_timeout(executor):
     final = events[-1]
     assert final["exit_code"] == -1
     assert "timed out" in final["stderr"]
-    assert final["runner_restarted"] is True
-    # Warm service recovers in the background (same as /execute).
-    for _ in range(100):
-        if client.get("/healthz").json().get("warm"):
-            break
-        time.sleep(0.1)
-    else:
-        pytest.fail("runner did not restart after streamed timeout")
+    # time.sleep is SIGINT-interruptible, so cooperative cancellation keeps
+    # the runner (and a real deployment's device lease) alive — no restart.
+    assert final["runner_restarted"] is False
+    assert client.get("/healthz").json().get("warm") is True
 
 
 def test_execute_mixed_shell_python(executor):
