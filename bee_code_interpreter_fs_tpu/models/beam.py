@@ -1,0 +1,150 @@
+"""Beam-search decoding — whole-search-fused, static shapes throughout.
+
+Rounds out the generation suite (greedy / sampled / speculative /
+continuous-batching) with the classic highest-probability-sequence
+decoder. The reference project has no generation stack at all (its sandbox
+runs user scripts); this sits beside the other TPU-native decoders in
+`models/llama.py`.
+
+TPU-first shape of the algorithm:
+
+- The entire search — prefill, every step's top-k over the joint
+  (beam × vocab) candidates, beam reordering, EOS freezing — is ONE jitted
+  program (`lax.scan` over steps), so a networked accelerator pays one
+  dispatch for the whole search instead of one per token.
+- Beams live as an extra factor folded into the batch dim ([b·k] rows):
+  every model call is a single large batched matmul, and "reordering
+  beams" is a gather over the cache's batch axis — no dynamic shapes, no
+  per-beam Python.
+- Finished beams are FROZEN in-device: once a beam emits `eos_id`, its
+  only continuation is `eos` at log-prob 0, so its score is immutable and
+  it competes unchanged in every later top-k (the fixed-shape equivalent
+  of moving it to a "finished" set).
+- Length normalization (`length_penalty` α, GNMT-style
+  score / ((5+len)/6)^α) is applied once at the end over each batch row's
+  k candidates.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bee_code_interpreter_fs_tpu.models.llama import (
+    LlamaConfig,
+    decode_step,
+    init_cache,
+    prefill,
+    resolve_cache_len,
+)
+
+__all__ = ["beam_generate"]
+
+_NEG_INF = -1e30
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "beam_size",
+                                   "max_len"))
+def beam_generate(params, prompt_tokens, cfg: LlamaConfig, *,
+                  max_new_tokens: int, beam_size: int,
+                  length_penalty: float = 1.0, eos_id=None,
+                  max_len: int | None = None):
+    """Highest-scoring continuation per prompt under beam search.
+
+    prompt_tokens: [b, prompt_len] int32. Returns [b, prompt_len +
+    max_new_tokens] int32 — the best beam per row after length
+    normalization; rows that finished early are padded with `eos_id` (or
+    the last argmax token when eos is off, mirroring greedy_generate's
+    pinning).
+
+    `beam_size=1` degenerates to greedy search and matches
+    `greedy_generate` token-for-token; `beam_size >= vocab**steps` is
+    exhaustive argmax over all continuations (tested both ways).
+    """
+    if beam_size < 1:
+        raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+    b, prompt_len = prompt_tokens.shape
+    k = beam_size
+    vocab = cfg.vocab_size
+    max_len = resolve_cache_len(prompt_len + max_new_tokens, max_len)
+
+    # Prefill once per PROMPT, then tile the cache across beams: [b] rows
+    # become [b*k] (beam-major within each row: row i's beams occupy
+    # i*k..i*k+k-1).
+    cache = init_cache(cfg, b, max_len)
+    logits, cache = prefill(params, prompt_tokens, cache, cfg)
+    cache = jax.tree.map(lambda c: jnp.repeat(c, k, axis=1), cache)
+
+    logp0 = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)  # [b, V]
+    # Step 0 seeds the beams straight from the prompt's top-k tokens (the
+    # joint top-k over k identical copies would just pick duplicates).
+    # k > vocab (exhaustive small-vocab searches) pads dead beams at -inf;
+    # they revive naturally once live beams fan out past them.
+    if k <= vocab:
+        scores, first_tok = lax.top_k(logp0, k)      # [b, k]
+    else:
+        top_scores, top_tok = lax.top_k(logp0, vocab)
+        scores = jnp.full((b, k), _NEG_INF).at[:, :vocab].set(top_scores)
+        first_tok = jnp.zeros((b, k), jnp.int32).at[:, :vocab].set(
+            top_tok.astype(jnp.int32)
+        )
+    flat_tok = first_tok.reshape(b * k)
+    done = (
+        (flat_tok == eos_id) if eos_id is not None
+        else jnp.zeros((b * k,), bool)
+    )
+    # Generated length per beam (tokens up to and including eos).
+    gen_len = jnp.ones((b * k,), jnp.int32)
+    # Token history is CARRIED (and gathered on every reorder), not emitted
+    # as scan outputs: a beam's row at step t is not its ancestor's row at
+    # step t+1, so per-step emissions would interleave unrelated lineages.
+    seqs = jnp.zeros((b * k, max_new_tokens), jnp.int32)
+    seqs = seqs.at[:, 0].set(flat_tok)
+
+    def body(carry, i):
+        cache, scores, tok, done, gen_len, seqs = carry
+        logits, cache = decode_step(
+            params, tok[:, None], cache, prompt_len + i, cfg
+        )
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        if eos_id is not None:
+            # A finished beam's only continuation is eos at log-prob 0:
+            # its score freezes and it stays comparable in the joint top-k.
+            frozen = jnp.full((vocab,), _NEG_INF).at[eos_id].set(0.0)
+            logp = jnp.where(done[:, None], frozen[None, :], logp)
+        cand = scores.reshape(b * k)[:, None] + logp       # [b*k, V]
+        cand = cand.reshape(b, k * vocab)
+        scores, flat_idx = lax.top_k(cand, k)              # [b, k]
+        beam_idx = flat_idx // vocab                       # [b, k] in 0..k-1
+        tok = (flat_idx % vocab).reshape(b * k).astype(jnp.int32)
+        # Reorder beam state (cache rows, done flags, lengths) to follow
+        # the surviving beams: gather over the folded [b*k] axis.
+        src = (jnp.arange(b)[:, None] * k + beam_idx).reshape(b * k)
+        cache = jax.tree.map(lambda c: jnp.take(c, src, axis=1), cache)
+        done = jnp.take(done, src)
+        gen_len = jnp.take(gen_len, src)
+        seqs = jnp.take(seqs, src, axis=0).at[:, i + 1].set(tok)
+        gen_len = gen_len + (~done).astype(jnp.int32)
+        if eos_id is not None:
+            done = done | (tok == eos_id)
+        return (cache, scores, tok, done, gen_len, seqs), None
+
+    steps = max_new_tokens - 1
+    if steps > 0:
+        (cache, scores, flat_tok, done, gen_len, seqs), _ = lax.scan(
+            body,
+            (cache, scores, flat_tok, done, gen_len, seqs),
+            jnp.arange(steps),
+        )
+    tokens = seqs.reshape(b, k, max_new_tokens)
+
+    # GNMT length normalization over each row's k finished/live beams.
+    lp = ((5.0 + gen_len.reshape(b, k).astype(jnp.float32)) / 6.0) ** length_penalty
+    best = jnp.argmax(scores / lp, axis=1)                 # [b]
+    best_tokens = jnp.take_along_axis(
+        tokens, best[:, None, None], axis=1
+    )[:, 0]                                                # [b, max_new_tokens]
+    return jnp.concatenate([prompt_tokens, best_tokens], axis=1)

@@ -492,6 +492,20 @@ def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int | None = None):
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
+def resolve_cache_len(needed: int, max_len: int | None, *,
+                      what: str = "prompt+new") -> int:
+    """The generation-cache sizing contract, in ONE place: default max_len
+    to exactly what the generation needs; reject an explicit max_len that
+    can't hold it (dynamic_update_slice would silently clamp writes past
+    the cache's end — wrong generations with no error)."""
+    max_len = max_len or needed
+    if max_len < needed:
+        raise ValueError(
+            f"max_len={max_len} < {what}={needed}: cache too small"
+        )
+    return max_len
+
+
 def decode_valid_mask(q_pos, max_len, cfg: LlamaConfig):
     """Which cache positions queries at positions `q_pos` [n] may attend:
     causal prefix, minus anything a sliding window retires, plus
@@ -636,12 +650,7 @@ def _spec_setup(draft_params, target_params, prompt_tokens, cfg_draft,
         )
     b, p = prompt_tokens.shape
     total = p + max_new_tokens + gamma + 1
-    if max_len is None:
-        max_len = total
-    elif max_len < total:
-        raise ValueError(
-            f"max_len={max_len} < prompt+new+gamma+1={total}: cache too small"
-        )
+    max_len = resolve_cache_len(total, max_len, what="prompt+new+gamma+1")
     d_cache = init_cache(cfg_draft, b, max_len)
     t_cache = init_cache(cfg_target, b, max_len)
     t_logits, t_cache = prefill(target_params, prompt_tokens, t_cache, cfg_target)
@@ -894,12 +903,7 @@ def greedy_generate(params, prompt_tokens, cfg: LlamaConfig, *,
     traced: changing eos ids never recompiles.
     `generate()` below is the step-by-step reference implementation."""
     b, prompt_len = prompt_tokens.shape
-    needed = prompt_len + max_new_tokens
-    max_len = max_len or needed
-    if max_len < needed:
-        raise ValueError(
-            f"max_len={max_len} < prompt+new={needed}: cache too small"
-        )
+    max_len = resolve_cache_len(prompt_len + max_new_tokens, max_len)
     cache = init_cache(cfg, b, max_len)
     logits, cache = prefill(params, prompt_tokens, cache, cfg)
 
@@ -956,12 +960,7 @@ def _sample_generate_jit(params, prompt_tokens, key, cfg: LlamaConfig, *,
                          max_new_tokens: int, temperature, top_k: int,
                          top_p, max_len: int | None, eos_id):
     b, prompt_len = prompt_tokens.shape
-    needed = prompt_len + max_new_tokens
-    max_len = max_len or needed
-    if max_len < needed:
-        raise ValueError(
-            f"max_len={max_len} < prompt+new={needed}: cache too small"
-        )
+    max_len = resolve_cache_len(prompt_len + max_new_tokens, max_len)
     cache = init_cache(cfg, b, max_len)
     logits, cache = prefill(params, prompt_tokens, cache, cfg)
 
@@ -1012,14 +1011,7 @@ def generate(params, prompt_tokens, cfg: LlamaConfig, *, max_new_tokens: int,
     Returns [b, prompt + max_new_tokens] int32.
     """
     b, prompt_len = prompt_tokens.shape
-    needed = prompt_len + max_new_tokens
-    max_len = max_len or needed
-    if max_len < needed:
-        # dynamic_update_slice would silently clamp writes past the end of
-        # the cache — wrong generations with no error. Fail loudly instead.
-        raise ValueError(
-            f"max_len={max_len} < prompt+new={needed}: cache too small"
-        )
+    max_len = resolve_cache_len(prompt_len + max_new_tokens, max_len)
     cache = init_cache(cfg, b, max_len)
     step = jax.jit(partial(decode_step, cfg=cfg), donate_argnums=(2,))
 

@@ -1,0 +1,129 @@
+"""Beam search: greedy degeneracy, exhaustive-argmax equivalence, EOS."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bee_code_interpreter_fs_tpu.models.beam import beam_generate
+from bee_code_interpreter_fs_tpu.models.llama import (
+    LlamaConfig,
+    forward,
+    greedy_generate,
+    init_params,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig.tiny(n_layers=2, dim=64, hidden_dim=128, n_heads=4,
+                           n_kv_heads=2, vocab_size=61, max_seq_len=64,
+                           dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+@pytest.fixture(scope="module")
+def tiny_vocab_model():
+    cfg = LlamaConfig.tiny(n_layers=2, dim=32, hidden_dim=64, n_heads=2,
+                           n_kv_heads=2, vocab_size=5, max_seq_len=32,
+                           dtype="float32")
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    return params, cfg
+
+
+def _seq_logprob(params, cfg, prompt, continuation):
+    """Total log-prob of `continuation` after `prompt` under the model."""
+    toks = jnp.asarray([list(prompt) + list(continuation)], jnp.int32)
+    logits = forward(params, toks[:, :-1], cfg)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    total = 0.0
+    for i, t in enumerate(continuation):
+        total += float(logp[0, len(prompt) - 1 + i, t])
+    return total
+
+
+def test_beam_one_equals_greedy(model):
+    params, cfg = model
+    prompt = jnp.asarray([[7, 3, 19], [2, 40, 5]], jnp.int32)
+    out_b = beam_generate(params, prompt, cfg, max_new_tokens=9, beam_size=1)
+    out_g = greedy_generate(params, prompt, cfg, max_new_tokens=9)
+    np.testing.assert_array_equal(np.asarray(out_b), np.asarray(out_g))
+
+
+def test_beam_one_equals_greedy_with_eos(model):
+    params, cfg = model
+    prompt = jnp.asarray([[11, 4]], jnp.int32)
+    free = np.asarray(greedy_generate(params, prompt, cfg, max_new_tokens=8))
+    eos = int(free[0, 2 + 3])  # greedy's 4th generated token as eos
+    out_b = beam_generate(params, prompt, cfg, max_new_tokens=8, beam_size=1,
+                          eos_id=eos)
+    out_g = greedy_generate(params, prompt, cfg, max_new_tokens=8, eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(out_b), np.asarray(out_g))
+
+
+def test_beam_exhaustive_is_argmax(tiny_vocab_model):
+    """With beam_size >= vocab**steps the search is exhaustive: the result
+    must be the true argmax continuation, verified by brute force over all
+    vocab**3 = 125 length-3 continuations."""
+    params, cfg = tiny_vocab_model
+    prompt = [1, 2]
+    out = beam_generate(
+        params, jnp.asarray([prompt], jnp.int32), cfg,
+        max_new_tokens=3, beam_size=125, length_penalty=0.0,
+    )
+    got = np.asarray(out)[0, len(prompt):].tolist()
+    best, best_lp = None, -1e18
+    for a in range(5):
+        for c in range(5):
+            for d in range(5):
+                lp = _seq_logprob(params, cfg, prompt, [a, c, d])
+                if lp > best_lp:
+                    best, best_lp = [a, c, d], lp
+    assert got == best, (got, best, best_lp,
+                         _seq_logprob(params, cfg, prompt, got))
+
+
+def test_wider_beam_never_worse(model):
+    """The returned sequence's model log-prob must be non-decreasing in
+    beam width (with length_penalty=0 and no eos, beam search optimizes
+    exactly that)."""
+    params, cfg = model
+    prompt = [9, 33, 17, 2]
+    lps = []
+    for k in (1, 2, 4, 8):
+        out = beam_generate(
+            params, jnp.asarray([prompt], jnp.int32), cfg,
+            max_new_tokens=6, beam_size=k, length_penalty=0.0,
+        )
+        cont = np.asarray(out)[0, len(prompt):].tolist()
+        lps.append(_seq_logprob(params, cfg, prompt, cont))
+    assert all(b >= a - 1e-4 for a, b in zip(lps, lps[1:])), lps
+
+
+def test_eos_finished_beam_padded(model):
+    params, cfg = model
+    prompt = jnp.asarray([[5, 28]], jnp.int32)
+    free = np.asarray(
+        beam_generate(params, prompt, cfg, max_new_tokens=10, beam_size=3)
+    )
+    eos = int(free[0, 2 + 2])  # the winning beam's 3rd token
+    out = np.asarray(
+        beam_generate(params, prompt, cfg, max_new_tokens=10, beam_size=3,
+                      eos_id=eos)
+    )
+    gen = out[0, 2:]
+    hits = np.nonzero(gen == eos)[0]
+    assert hits.size, gen
+    # everything after the first eos is pinned eos
+    assert (gen[hits[0]:] == eos).all()
+
+
+def test_beam_validation(model):
+    params, cfg = model
+    prompt = jnp.asarray([[1]], jnp.int32)
+    with pytest.raises(ValueError, match="beam_size"):
+        beam_generate(params, prompt, cfg, max_new_tokens=2, beam_size=0)
+    with pytest.raises(ValueError, match="cache too small"):
+        beam_generate(params, prompt, cfg, max_new_tokens=8, beam_size=2,
+                      max_len=4)
