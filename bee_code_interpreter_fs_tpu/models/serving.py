@@ -56,6 +56,7 @@ from bee_code_interpreter_fs_tpu.models.llama import (
     decode_chunk,
     decode_valid_mask,
     init_cache,
+    prefill,
     transformer_block,
 )
 
@@ -67,8 +68,9 @@ class Request:
     """One queued generation request (host-side bookkeeping)."""
 
     rid: int
-    prompt: np.ndarray  # [prompt_len] int32
+    prompt: np.ndarray  # [prompt_len] int32 (the suffix when prefix_id set)
     max_new_tokens: int
+    prefix_id: int | None = None
     generated: list = field(default_factory=list)
 
 
@@ -180,6 +182,48 @@ def _admit(params, cache, tokens, slot, true_len, cfg: LlamaConfig):
     return {"k": new_k, "v": new_v}, first_tok
 
 
+# One compile per distinct prefix length, paid at registration time.
+# prefill (not decode_chunk): it projects logits only at the LAST position,
+# so registering a long system prompt never materializes a [plen, vocab]
+# logits buffer it would immediately discard.
+_prefix_prefill = jax.jit(prefill, static_argnames=("cfg",))
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def _admit_prefixed(params, cache, pk, pv, tokens, slot, true_len,
+                    cfg: LlamaConfig):
+    """Admission with a cached prefix: install the prefix's precomputed K/V
+    (positions 0..plen-1) and chunk-prefill only the SUFFIX at
+    rope_offset=plen. One compile per (prefix length, suffix bucket) pair;
+    the prefix forward itself was paid ONCE at register_prefix time no
+    matter how many requests share it."""
+    plen = pk.shape[2]
+    bucket = tokens.shape[1]
+    scratch = init_cache(cfg, 1, plen + bucket)
+    scratch = {
+        "k": lax.dynamic_update_slice(scratch["k"], pk, (0, 0, 0, 0, 0)),
+        "v": lax.dynamic_update_slice(scratch["v"], pv, (0, 0, 0, 0, 0)),
+    }
+    logits_all, scratch = decode_chunk(params, tokens, scratch, plen, cfg)
+    first_tok = jnp.argmax(logits_all[0, true_len - 1]).astype(jnp.int32)
+    new_k = lax.dynamic_update_slice(
+        cache["k"], scratch["k"], (0, slot, 0, 0, 0)
+    )
+    new_v = lax.dynamic_update_slice(
+        cache["v"], scratch["v"], (0, slot, 0, 0, 0)
+    )
+    return {"k": new_k, "v": new_v}, first_tok
+
+
+@partial(jax.jit, donate_argnames=("cache",))
+def _admit_prefix_only(cache, pk, pv, slot):
+    """Admission of a request whose whole prompt IS a cached prefix: pure
+    K/V installation — zero model FLOPs on the admission path."""
+    new_k = lax.dynamic_update_slice(cache["k"], pk, (0, slot, 0, 0, 0))
+    new_v = lax.dynamic_update_slice(cache["v"], pv, (0, slot, 0, 0, 0))
+    return {"k": new_k, "v": new_v}
+
+
 class ServingEngine:
     """Continuous-batching greedy serving over a fixed slot bank.
 
@@ -224,28 +268,67 @@ class ServingEngine:
         self._queue: deque[Request] = deque()
         self._results: dict[int, np.ndarray] = {}
         self._rid = itertools.count()
+        self._prefixes: dict[int, dict] = {}
+        self._prefix_id = itertools.count()
 
     # ------------------------------------------------------------- intake
 
-    def submit(self, prompt, max_new_tokens: int) -> int:
-        """Queue a prompt (sequence of int token ids); returns request id."""
+    def register_prefix(self, tokens) -> int:
+        """Prefill a shared prompt prefix ONCE and cache its K/V; requests
+        submitted with the returned id skip the prefix's prefill entirely
+        (the classic system-prompt amortization). Costs one [L, 1, plen]
+        K/V buffer in device memory per registered prefix."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if tokens.size == 0:
+            raise ValueError("empty prefix")
+        if tokens.size >= self.max_len:
+            raise ValueError(
+                f"prefix ({tokens.size}) leaves no room in max_len "
+                f"{self.max_len}"
+            )
+        plen = int(tokens.size)
+        scratch = init_cache(self.cfg, 1, plen)
+        last_logits, scratch = _prefix_prefill(
+            self.params, jnp.asarray(tokens[None, :]), scratch, self.cfg
+        )
+        pid = next(self._prefix_id)
+        self._prefixes[pid] = {
+            "k": scratch["k"],
+            "v": scratch["v"],
+            "first_tok": int(jnp.argmax(last_logits[0])),
+            "len": plen,
+        }
+        return pid
+
+    def submit(self, prompt, max_new_tokens: int,
+               prefix_id: int | None = None) -> int:
+        """Queue a prompt (sequence of int token ids); returns request id.
+        With `prefix_id`, `prompt` is the SUFFIX after that registered
+        prefix (may be empty — the prefix alone is the prompt)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        if prompt.size == 0:
+        plen = 0
+        if prefix_id is not None:
+            if prefix_id not in self._prefixes:
+                raise ValueError(f"unknown prefix_id {prefix_id}")
+            plen = self._prefixes[prefix_id]["len"]
+        elif prompt.size == 0:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if prompt.size + max_new_tokens > self.max_len:
+        if plen + prompt.size + max_new_tokens > self.max_len:
             raise ValueError(
-                f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds cache max_len {self.max_len}"
+                f"prefix ({plen}) + prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds cache max_len {self.max_len}"
             )
-        if prompt.size > max(self.buckets):
+        if prompt.size > 0 and prompt.size > max(self.buckets):
             raise ValueError(
                 f"prompt length {prompt.size} exceeds largest prefill "
                 f"bucket {max(self.buckets)}"
             )
         rid = next(self._rid)
-        self._queue.append(Request(rid, prompt, int(max_new_tokens)))
+        self._queue.append(
+            Request(rid, prompt, int(max_new_tokens), prefix_id)
+        )
         return rid
 
     def _bucket_len(self, n: int) -> int:
@@ -274,14 +357,42 @@ class ServingEngine:
             while self._queue:
                 req = self._queue.popleft()
                 n = req.prompt.size
-                bl = self._bucket_len(n)
-                padded = np.zeros((1, bl), np.int32)
-                padded[0, :n] = req.prompt
-                self.cache, first_tok = _admit(
-                    self.params, self.cache, jnp.asarray(padded),
-                    jnp.int32(i), jnp.int32(n), self.cfg,
-                )
-                first = int(first_tok)
+                if req.prefix_id is not None:
+                    pf = self._prefixes[req.prefix_id]
+                    plen = pf["len"]
+                    if n == 0:
+                        self.cache = _admit_prefix_only(
+                            self.cache, pf["k"], pf["v"], jnp.int32(i)
+                        )
+                        first = pf["first_tok"]
+                    else:
+                        # Smallest suffix bucket that also fits beside the
+                        # prefix; the exact remainder is the (rare, its own
+                        # compile) fallback and holds n by submit's check.
+                        bl = next(
+                            (b for b in self.buckets
+                             if b >= n and plen + b <= self.max_len),
+                            self.max_len - plen,
+                        )
+                        padded = np.zeros((1, bl), np.int32)
+                        padded[0, :n] = req.prompt
+                        self.cache, first_tok = _admit_prefixed(
+                            self.params, self.cache, pf["k"], pf["v"],
+                            jnp.asarray(padded), jnp.int32(i), jnp.int32(n),
+                            self.cfg,
+                        )
+                        first = int(first_tok)
+                    prompt_end = plen + n
+                else:
+                    bl = self._bucket_len(n)
+                    padded = np.zeros((1, bl), np.int32)
+                    padded[0, :n] = req.prompt
+                    self.cache, first_tok = _admit(
+                        self.params, self.cache, jnp.asarray(padded),
+                        jnp.int32(i), jnp.int32(n), self.cfg,
+                    )
+                    first = int(first_tok)
+                    prompt_end = n
                 req.generated.append(first)
                 done = req.max_new_tokens <= 1 or (
                     self.eos_id is not None and first == self.eos_id
@@ -292,7 +403,7 @@ class ServingEngine:
                     )
                     continue
                 self._slot_req[i] = req
-                self.pos = self.pos.at[i].set(n)
+                self.pos = self.pos.at[i].set(prompt_end)
                 self.last_tok = self.last_tok.at[i].set(first)
                 self.remaining = self.remaining.at[i].set(
                     req.max_new_tokens - 1

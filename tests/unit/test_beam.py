@@ -84,10 +84,13 @@ def test_beam_exhaustive_is_argmax(tiny_vocab_model):
                          _seq_logprob(params, cfg, prompt, got))
 
 
-def test_wider_beam_never_worse(model):
-    """The returned sequence's model log-prob must be non-decreasing in
-    beam width (with length_penalty=0 and no eos, beam search optimizes
-    exactly that)."""
+def test_wider_beam_not_worse_on_fixture(model):
+    """On THIS pinned fixture, wider beams find sequences of
+    non-decreasing model log-prob. Beam search does NOT guarantee
+    monotonicity in width in general (a wider beam can crowd out the
+    narrower beam's eventual winner); this is a seeded regression probe
+    that the search machinery improves over greedy here, not an invariant.
+    The exhaustive-width test above is the real correctness anchor."""
     params, cfg = model
     prompt = [9, 33, 17, 2]
     lps = []

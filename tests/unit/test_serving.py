@@ -113,6 +113,56 @@ def test_submit_validation(model):
         eng.submit([1], max_new_tokens=0)
 
 
+def test_prefix_cached_requests_match_full_prompt(model):
+    """prefix+suffix submission must be token-exact with submitting the
+    concatenated prompt plainly — across slot reuse and mixed traffic."""
+    params, cfg = model
+    sys_prompt = [9, 1, 1, 4, 27, 60, 2]
+    eng = ServingEngine(params, cfg, n_slots=2, max_len=96, steps_per_sync=4)
+    pid = eng.register_prefix(sys_prompt)
+    cases = [([3, 5], 7), ([44], 9), (list(range(10, 30)), 5), ([8, 8, 8], 6)]
+    rids = {}
+    for suffix, m in cases:
+        rids[eng.submit(suffix, m, prefix_id=pid)] = (suffix, m)
+    rids[eng.submit([7, 7], 5)] = ("plain", [7, 7], 5)  # unprefixed alongside
+    res = eng.run()
+    for rid, case in rids.items():
+        if case[0] == "plain":
+            ref = _reference(params, cfg, case[1], case[2])
+        else:
+            suffix, m = case
+            ref = _reference(params, cfg, sys_prompt + suffix, m)
+        np.testing.assert_array_equal(res[rid], ref)
+
+
+def test_prefix_only_prompt(model):
+    """Empty suffix: the registered prefix IS the prompt — admission does
+    zero model FLOPs and the output still matches the plain decode."""
+    params, cfg = model
+    sys_prompt = [5, 40, 3, 3, 21]
+    eng = ServingEngine(params, cfg, n_slots=1, max_len=64)
+    pid = eng.register_prefix(sys_prompt)
+    rid = eng.submit([], 8, prefix_id=pid)
+    res = eng.run()
+    np.testing.assert_array_equal(
+        res[rid], _reference(params, cfg, sys_prompt, 8)
+    )
+
+
+def test_prefix_validation(model):
+    params, cfg = model
+    eng = ServingEngine(params, cfg, n_slots=1, max_len=32)
+    with pytest.raises(ValueError, match="unknown prefix_id"):
+        eng.submit([1], 2, prefix_id=99)
+    with pytest.raises(ValueError, match="empty prefix"):
+        eng.register_prefix([])
+    pid = eng.register_prefix(list(range(20)))
+    with pytest.raises(ValueError, match="exceeds cache"):
+        eng.submit(list(range(8)), 8, prefix_id=pid)
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.submit([], 4)
+
+
 def test_prefill_compiles_once_per_bucket(model):
     """Two same-bucket prompts of different lengths must share one compile
     (the bucket is the static shape; slot and true length are traced)."""
