@@ -71,6 +71,8 @@ class Request:
     prompt: np.ndarray  # [prompt_len] int32 (the suffix when prefix_id set)
     max_new_tokens: int
     prefix_id: int | None = None
+    temperature: float = 0.0  # 0 = greedy
+    seed: int | None = None
     generated: list = field(default_factory=list)
 
 
@@ -118,7 +120,7 @@ def _perslot_decode_step(params, tokens, cache, pos, cfg: LlamaConfig):
 @partial(jax.jit, static_argnames=("cfg", "steps", "eos_id"),
          donate_argnames=("cache",))
 def _decode_burst(params, cache, pos, last_tok, remaining, active,
-                  cfg: LlamaConfig, steps: int, eos_id):
+                  temp, keys, cfg: LlamaConfig, steps: int, eos_id):
     """`steps` continuous-batching decode steps as ONE compiled program.
 
     Carry per slot: position, last emitted token, remaining token budget,
@@ -126,6 +128,13 @@ def _decode_burst(params, cache, pos, last_tok, remaining, active,
     computation but are fully masked: their position doesn't advance, their
     token doesn't change, and their cache row only rewrites its own frontier
     with values nothing ever attends to.
+
+    Per-slot sampling: `temp` [b] f32 (0 = greedy) and `keys` [b, 2]
+    uint32 per-request PRNG keys. Each sampled token's randomness is
+    `fold_in(key, position)` — the key never advances, so a request's
+    stream depends only on its seed and token positions, not on scheduling
+    (the same request replays identically whatever traffic shares the
+    batch).
 
     Returns (cache, pos, last_tok, remaining, active, toks [steps, b],
     emitted [steps, b]) — toks[s, i] is a real generated token for slot i
@@ -135,7 +144,11 @@ def _decode_burst(params, cache, pos, last_tok, remaining, active,
     def one(carry, _):
         cache, pos, tok, remaining, active = carry
         logits, cache = _perslot_decode_step(params, tok[:, None], cache, pos, cfg)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        subkeys = jax.vmap(jax.random.fold_in)(keys, pos + 1)
+        scaled = logits / jnp.where(temp > 0, temp, 1.0)[:, None]
+        sampled = jax.vmap(jax.random.categorical)(subkeys, scaled)
+        nxt = jnp.where(temp > 0, sampled.astype(jnp.int32), greedy)
         tok = jnp.where(active, nxt, tok)
         emitted = active
         pos = pos + active.astype(jnp.int32)
@@ -157,10 +170,11 @@ def _admit(params, cache, tokens, slot, true_len, cfg: LlamaConfig):
 
     tokens: [1, bucket_len] (prompt right-padded to the bucket); `slot` and
     `true_len` are traced scalars, so one compile serves every admission at
-    this bucket length. Returns (cache, first_token) — the first generated
-    token (greedy over the prompt's last-position logits), which seeds the
-    decode burst. K/V written for padded positions (>= true_len) are
-    garbage by construction and provably never attended (see module doc).
+    this bucket length. Returns (cache, last_logits) — the prompt's
+    last-position logits, from which the host picks the first generated
+    token (greedy or sampled per the request). K/V written for padded
+    positions (>= true_len) are garbage by construction and provably never
+    attended (see module doc).
 
     The scratch cache is BUCKET-sized, not max_len-sized, so prefill
     attention costs O(bucket²) rather than O(bucket·max_len); the slot
@@ -172,14 +186,14 @@ def _admit(params, cache, tokens, slot, true_len, cfg: LlamaConfig):
     bucket = tokens.shape[1]
     slot_cache = init_cache(cfg, 1, bucket)
     logits_all, slot_cache = decode_chunk(params, tokens, slot_cache, 0, cfg)
-    first_tok = jnp.argmax(logits_all[0, true_len - 1]).astype(jnp.int32)
+    last_logits = logits_all[0, true_len - 1]
     new_k = lax.dynamic_update_slice(
         cache["k"], slot_cache["k"], (0, slot, 0, 0, 0)
     )
     new_v = lax.dynamic_update_slice(
         cache["v"], slot_cache["v"], (0, slot, 0, 0, 0)
     )
-    return {"k": new_k, "v": new_v}, first_tok
+    return {"k": new_k, "v": new_v}, last_logits
 
 
 # One compile per distinct prefix length, paid at registration time.
@@ -205,14 +219,14 @@ def _admit_prefixed(params, cache, pk, pv, tokens, slot, true_len,
         "v": lax.dynamic_update_slice(scratch["v"], pv, (0, 0, 0, 0, 0)),
     }
     logits_all, scratch = decode_chunk(params, tokens, scratch, plen, cfg)
-    first_tok = jnp.argmax(logits_all[0, true_len - 1]).astype(jnp.int32)
+    last_logits = logits_all[0, true_len - 1]
     new_k = lax.dynamic_update_slice(
         cache["k"], scratch["k"], (0, slot, 0, 0, 0)
     )
     new_v = lax.dynamic_update_slice(
         cache["v"], scratch["v"], (0, slot, 0, 0, 0)
     )
-    return {"k": new_k, "v": new_v}, first_tok
+    return {"k": new_k, "v": new_v}, last_logits
 
 
 @partial(jax.jit, donate_argnames=("cache",))
@@ -239,7 +253,8 @@ class ServingEngine:
 
     def __init__(self, params, cfg: LlamaConfig, *, n_slots: int = 4,
                  max_len: int | None = None, steps_per_sync: int = 8,
-                 prefill_buckets: tuple = (), eos_id: int | None = None):
+                 prefill_buckets: tuple = (), eos_id: int | None = None,
+                 seed: int = 0):
         self.params = params
         self.cfg = cfg
         self.n_slots = int(n_slots)
@@ -270,6 +285,9 @@ class ServingEngine:
         self._rid = itertools.count()
         self._prefixes: dict[int, dict] = {}
         self._prefix_id = itertools.count()
+        self.temp = jnp.zeros((self.n_slots,), jnp.float32)
+        self.keys = jnp.zeros((self.n_slots, 2), jnp.uint32)
+        self._base_seed = int(seed)
 
     # ------------------------------------------------------------- intake
 
@@ -295,17 +313,26 @@ class ServingEngine:
         self._prefixes[pid] = {
             "k": scratch["k"],
             "v": scratch["v"],
-            "first_tok": int(jnp.argmax(last_logits[0])),
+            "last_logits": np.asarray(last_logits[0], np.float32),
             "len": plen,
         }
         return pid
 
     def submit(self, prompt, max_new_tokens: int,
-               prefix_id: int | None = None) -> int:
+               prefix_id: int | None = None, *, temperature: float = 0.0,
+               seed: int | None = None) -> int:
         """Queue a prompt (sequence of int token ids); returns request id.
         With `prefix_id`, `prompt` is the SUFFIX after that registered
-        prefix (may be empty — the prefix alone is the prompt)."""
+        prefix (may be empty — the prefix alone is the prompt).
+
+        `temperature` > 0 samples instead of greedy decoding; the request's
+        random stream is `fold_in(key, token position)`, so with an explicit
+        `seed` the output is reproducible regardless of what other traffic
+        shares the batch or how the scheduler slices bursts (seed=None
+        derives a key from the engine seed and the request id)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if temperature < 0:
+            raise ValueError("temperature must be >= 0")
         plen = 0
         if prefix_id is not None:
             if prefix_id not in self._prefixes:
@@ -327,7 +354,8 @@ class ServingEngine:
             )
         rid = next(self._rid)
         self._queue.append(
-            Request(rid, prompt, int(max_new_tokens), prefix_id)
+            Request(rid, prompt, int(max_new_tokens), prefix_id,
+                    float(temperature), seed)
         )
         return rid
 
@@ -336,6 +364,25 @@ class ServingEngine:
             if n <= b:
                 return b
         raise ValueError(f"no bucket holds prompt of length {n}")
+
+    def _req_key(self, req: Request):
+        if req.seed is not None:
+            return jax.random.PRNGKey(req.seed)
+        return jax.random.fold_in(
+            jax.random.PRNGKey(self._base_seed), req.rid
+        )
+
+    def _pick_first(self, req: Request, last_logits, prompt_end: int) -> int:
+        """First generated token from admission logits: greedy, or sampled
+        with the same fold_in(key, position) stream the burst continues."""
+        if req.temperature <= 0:
+            # Device-side argmax: a greedy admission moves one scalar to
+            # host, never the vocab-wide logits row.
+            return int(jnp.argmax(jnp.asarray(last_logits)))
+        sub = jax.random.fold_in(self._req_key(req), prompt_end)
+        return int(jax.random.categorical(
+            sub, jnp.asarray(last_logits) / req.temperature
+        ))
 
     # ---------------------------------------------------------- scheduling
 
@@ -364,7 +411,7 @@ class ServingEngine:
                         self.cache = _admit_prefix_only(
                             self.cache, pf["k"], pf["v"], jnp.int32(i)
                         )
-                        first = pf["first_tok"]
+                        first = self._pick_first(req, pf["last_logits"], plen)
                     else:
                         # Smallest suffix bucket that also fits beside the
                         # prefix; the exact remainder is the (rare, its own
@@ -376,22 +423,22 @@ class ServingEngine:
                         )
                         padded = np.zeros((1, bl), np.int32)
                         padded[0, :n] = req.prompt
-                        self.cache, first_tok = _admit_prefixed(
+                        self.cache, last_logits = _admit_prefixed(
                             self.params, self.cache, pf["k"], pf["v"],
                             jnp.asarray(padded), jnp.int32(i), jnp.int32(n),
                             self.cfg,
                         )
-                        first = int(first_tok)
+                        first = self._pick_first(req, last_logits, plen + n)
                     prompt_end = plen + n
                 else:
                     bl = self._bucket_len(n)
                     padded = np.zeros((1, bl), np.int32)
                     padded[0, :n] = req.prompt
-                    self.cache, first_tok = _admit(
+                    self.cache, last_logits = _admit(
                         self.params, self.cache, jnp.asarray(padded),
                         jnp.int32(i), jnp.int32(n), self.cfg,
                     )
-                    first = int(first_tok)
+                    first = self._pick_first(req, last_logits, n)
                     prompt_end = n
                 req.generated.append(first)
                 done = req.max_new_tokens <= 1 or (
@@ -404,6 +451,10 @@ class ServingEngine:
                     continue
                 self._slot_req[i] = req
                 self.pos = self.pos.at[i].set(prompt_end)
+                self.temp = self.temp.at[i].set(req.temperature)
+                self.keys = self.keys.at[i].set(
+                    jnp.asarray(self._req_key(req), jnp.uint32)
+                )
                 self.last_tok = self.last_tok.at[i].set(first)
                 self.remaining = self.remaining.at[i].set(
                     req.max_new_tokens - 1
@@ -420,8 +471,8 @@ class ServingEngine:
         (self.cache, self.pos, self.last_tok, self.remaining, self.active,
          toks, emitted) = _decode_burst(
             self.params, self.cache, self.pos, self.last_tok,
-            self.remaining, self.active, self.cfg, self.steps_per_sync,
-            self.eos_id,
+            self.remaining, self.active, self.temp, self.keys, self.cfg,
+            self.steps_per_sync, self.eos_id,
         )
         toks = np.asarray(toks)
         emitted = np.asarray(emitted)
