@@ -117,6 +117,44 @@ def _perslot_decode_step(params, tokens, cache, pos, cfg: LlamaConfig):
     return logits, {"k": new_k, "v": new_v}
 
 
+def _sample_next(logits, temp, keys, pos):
+    """Next token per slot: greedy where temp == 0, else a categorical draw
+    whose key is fold_in(slot key, the sampled token's position) — the ONE
+    definition of the engine's sampling stream (the paged engine's burst
+    uses it too, so both engines are stream-identical)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    subkeys = jax.vmap(jax.random.fold_in)(keys, pos + 1)
+    scaled = logits / jnp.where(temp > 0, temp, 1.0)[:, None]
+    sampled = jax.vmap(jax.random.categorical)(subkeys, scaled)
+    return jnp.where(temp > 0, sampled.astype(jnp.int32), greedy)
+
+
+def _burst_scan(step_fn, store, pos, last_tok, remaining, active, temp,
+                keys, steps: int, eos_id):
+    """The ONE burst loop body both engines run: step_fn produces logits and
+    the updated KV store; everything else — the sampling stream, emit
+    bookkeeping, budget/EOS masking — lives here so the dense and paged
+    engines cannot drift."""
+
+    def one(carry, _):
+        store, pos, tok, remaining, active = carry
+        logits, store = step_fn(store, tok[:, None], pos, active)
+        nxt = _sample_next(logits, temp, keys, pos)
+        tok = jnp.where(active, nxt, tok)
+        emitted = active
+        pos = pos + active.astype(jnp.int32)
+        remaining = remaining - active.astype(jnp.int32)
+        active = active & (remaining > 0)
+        if eos_id is not None:
+            active = active & (tok != eos_id)
+        return (store, pos, tok, remaining, active), (tok, emitted)
+
+    (store, pos, tok, remaining, active), (toks, emitted) = lax.scan(
+        one, (store, pos, last_tok, remaining, active), None, length=steps
+    )
+    return store, pos, tok, remaining, active, toks, emitted
+
+
 @partial(jax.jit, static_argnames=("cfg", "steps", "eos_id"),
          donate_argnames=("cache",))
 def _decode_burst(params, cache, pos, last_tok, remaining, active,
@@ -141,27 +179,12 @@ def _decode_burst(params, cache, pos, last_tok, remaining, active,
     iff emitted[s, i].
     """
 
-    def one(carry, _):
-        cache, pos, tok, remaining, active = carry
-        logits, cache = _perslot_decode_step(params, tok[:, None], cache, pos, cfg)
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        subkeys = jax.vmap(jax.random.fold_in)(keys, pos + 1)
-        scaled = logits / jnp.where(temp > 0, temp, 1.0)[:, None]
-        sampled = jax.vmap(jax.random.categorical)(subkeys, scaled)
-        nxt = jnp.where(temp > 0, sampled.astype(jnp.int32), greedy)
-        tok = jnp.where(active, nxt, tok)
-        emitted = active
-        pos = pos + active.astype(jnp.int32)
-        remaining = remaining - active.astype(jnp.int32)
-        active = active & (remaining > 0)
-        if eos_id is not None:
-            active = active & (tok != eos_id)
-        return (cache, pos, tok, remaining, active), (tok, emitted)
+    def step_fn(cache, tokens, pos, active):
+        del active  # a dense slot's idle frontier rewrite is harmless
+        return _perslot_decode_step(params, tokens, cache, pos, cfg)
 
-    (cache, pos, tok, remaining, active), (toks, emitted) = lax.scan(
-        one, (cache, pos, last_tok, remaining, active), None, length=steps
-    )
-    return cache, pos, tok, remaining, active, toks, emitted
+    return _burst_scan(step_fn, cache, pos, last_tok, remaining, active,
+                       temp, keys, steps, eos_id)
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
@@ -274,7 +297,7 @@ class ServingEngine:
             pows = [b for b in (2 ** i for i in range(4, 32))
                     if b < self.max_len - 1]
             self.buckets = tuple(pows + [self.max_len - 1])
-        self.cache = init_cache(cfg, self.n_slots, self.max_len)
+        self._init_device_state()
         self.pos = jnp.zeros((self.n_slots,), jnp.int32)
         self.last_tok = jnp.zeros((self.n_slots,), jnp.int32)
         self.remaining = jnp.zeros((self.n_slots,), jnp.int32)
@@ -288,6 +311,12 @@ class ServingEngine:
         self.temp = jnp.zeros((self.n_slots,), jnp.float32)
         self.keys = jnp.zeros((self.n_slots, 2), jnp.uint32)
         self._base_seed = int(seed)
+
+    def _init_device_state(self):
+        """Device-side KV state. The base engine holds one dense
+        [n_slots, max_len] cache; PagedServingEngine overrides with a
+        block pool + tables."""
+        self.cache = init_cache(self.cfg, self.n_slots, self.max_len)
 
     # ------------------------------------------------------------- intake
 
@@ -359,6 +388,21 @@ class ServingEngine:
         )
         return rid
 
+    def _suffix_bucket(self, plen: int, n: int) -> int:
+        """Smallest bucket holding an n-token suffix beside a plen-token
+        prefix; the exact remainder is the (rare, its own compile) fallback
+        and holds n by submit's total-length check."""
+        return next(
+            (b for b in self.buckets if b >= n and plen + b <= self.max_len),
+            self.max_len - plen,
+        )
+
+    @staticmethod
+    def _padded_prompt(prompt: np.ndarray, bl: int) -> np.ndarray:
+        padded = np.zeros((1, bl), np.int32)
+        padded[0, : prompt.size] = prompt
+        return padded
+
     def _bucket_len(self, n: int) -> int:
         for b in self.buckets:
             if n <= b:
@@ -393,6 +437,43 @@ class ServingEngine:
             if req is not None and not active_np[i]:
                 self._results[req.rid] = np.asarray(req.generated, np.int32)
                 self._slot_req[i] = None
+                self._on_retire(i)
+
+    def _install(self, req: Request, i: int):
+        """Prefill `req`'s prompt into slot `i`'s KV storage. Returns
+        (first_token, prompt_end), or None when the engine cannot place the
+        request right now (paged engine out of blocks) — the caller
+        requeues it and stops admitting."""
+        n = req.prompt.size
+        if req.prefix_id is not None:
+            pf = self._prefixes[req.prefix_id]
+            plen = pf["len"]
+            if n == 0:
+                self.cache = _admit_prefix_only(
+                    self.cache, pf["k"], pf["v"], jnp.int32(i)
+                )
+                first = self._pick_first(req, pf["last_logits"], plen)
+            else:
+                bl = self._suffix_bucket(plen, n)
+                padded = self._padded_prompt(req.prompt, bl)
+                self.cache, last_logits = _admit_prefixed(
+                    self.params, self.cache, pf["k"], pf["v"],
+                    jnp.asarray(padded), jnp.int32(i), jnp.int32(n),
+                    self.cfg,
+                )
+                first = self._pick_first(req, last_logits, plen + n)
+            return first, plen + n
+        bl = self._bucket_len(n)
+        padded = self._padded_prompt(req.prompt, bl)
+        self.cache, last_logits = _admit(
+            self.params, self.cache, jnp.asarray(padded),
+            jnp.int32(i), jnp.int32(n), self.cfg,
+        )
+        return self._pick_first(req, last_logits, n), n
+
+    def _on_retire(self, i: int) -> None:
+        """Hook: slot i's request just finished (paged engine frees its
+        blocks here)."""
 
     def _admit_waiting(self):
         for i in range(self.n_slots):
@@ -403,43 +484,11 @@ class ServingEngine:
             # occupies the slot — keep feeding the slot from the queue.
             while self._queue:
                 req = self._queue.popleft()
-                n = req.prompt.size
-                if req.prefix_id is not None:
-                    pf = self._prefixes[req.prefix_id]
-                    plen = pf["len"]
-                    if n == 0:
-                        self.cache = _admit_prefix_only(
-                            self.cache, pf["k"], pf["v"], jnp.int32(i)
-                        )
-                        first = self._pick_first(req, pf["last_logits"], plen)
-                    else:
-                        # Smallest suffix bucket that also fits beside the
-                        # prefix; the exact remainder is the (rare, its own
-                        # compile) fallback and holds n by submit's check.
-                        bl = next(
-                            (b for b in self.buckets
-                             if b >= n and plen + b <= self.max_len),
-                            self.max_len - plen,
-                        )
-                        padded = np.zeros((1, bl), np.int32)
-                        padded[0, :n] = req.prompt
-                        self.cache, last_logits = _admit_prefixed(
-                            self.params, self.cache, pf["k"], pf["v"],
-                            jnp.asarray(padded), jnp.int32(i), jnp.int32(n),
-                            self.cfg,
-                        )
-                        first = self._pick_first(req, last_logits, plen + n)
-                    prompt_end = plen + n
-                else:
-                    bl = self._bucket_len(n)
-                    padded = np.zeros((1, bl), np.int32)
-                    padded[0, :n] = req.prompt
-                    self.cache, last_logits = _admit(
-                        self.params, self.cache, jnp.asarray(padded),
-                        jnp.int32(i), jnp.int32(n), self.cfg,
-                    )
-                    first = self._pick_first(req, last_logits, n)
-                    prompt_end = n
+                placed = self._install(req, i)
+                if placed is None:
+                    self._queue.appendleft(req)
+                    return
+                first, prompt_end = placed
                 req.generated.append(first)
                 done = req.max_new_tokens <= 1 or (
                     self.eos_id is not None and first == self.eos_id
@@ -448,6 +497,10 @@ class ServingEngine:
                     self._results[req.rid] = np.asarray(
                         req.generated, np.int32
                     )
+                    # The slot was never occupied, but _install may have
+                    # claimed per-slot resources (the paged engine's block
+                    # reservation) — release them.
+                    self._on_retire(i)
                     continue
                 self._slot_req[i] = req
                 self.pos = self.pos.at[i].set(prompt_end)
@@ -468,12 +521,7 @@ class ServingEngine:
         self._admit_waiting()
         if not bool(np.asarray(self.active).any()):
             return
-        (self.cache, self.pos, self.last_tok, self.remaining, self.active,
-         toks, emitted) = _decode_burst(
-            self.params, self.cache, self.pos, self.last_tok,
-            self.remaining, self.active, self.temp, self.keys, self.cfg,
-            self.steps_per_sync, self.eos_id,
-        )
+        toks, emitted = self._run_burst()
         toks = np.asarray(toks)
         emitted = np.asarray(emitted)
         for i in range(self.n_slots):
@@ -481,6 +529,15 @@ class ServingEngine:
             if req is None:
                 continue
             req.generated.extend(toks[emitted[:, i], i].tolist())
+
+    def _run_burst(self):
+        (self.cache, self.pos, self.last_tok, self.remaining, self.active,
+         toks, emitted) = _decode_burst(
+            self.params, self.cache, self.pos, self.last_tok,
+            self.remaining, self.active, self.temp, self.keys, self.cfg,
+            self.steps_per_sync, self.eos_id,
+        )
+        return toks, emitted
 
     def run(self) -> dict[int, np.ndarray]:
         """Drain the queue and all active slots; returns {rid: generated}."""
