@@ -209,8 +209,10 @@ async def main() -> None:
             # (the north star's real 32-layer/4096-dim geometry; random
             # weights, identical code path — retires the scale-model caveat)
             # 5d/5e build GB-scale trees; 5f trains its draft/target pair
-            # in-sandbox (~300 steps) then times four generations — all
-            # too slow for a --quick pass, for different reasons.
+            # in-sandbox (~300 steps) then times four generations; 5g runs
+            # two full engine replays plus a per-prompt-length sequential
+            # compile pass — all too slow for a --quick pass, for
+            # different reasons.
             if not quick:
                 quant = (REPO_ROOT / "examples" / "benchmark-quant.py").read_text()
                 out.append(
@@ -233,6 +235,17 @@ async def main() -> None:
                 out.append(
                     await run_config(
                         "5f:speculative", spec, executor=executor, timeout=1200.0
+                    )
+                )
+
+                # -- config 5g: continuous-batching engine throughput --------
+                serv = (
+                    REPO_ROOT / "examples" / "benchmark-serving.py"
+                ).read_text()
+                out.append(
+                    await run_config(
+                        "5g:serving-engine", serv, executor=executor,
+                        timeout=1200.0,
                     )
                 )
         finally:
