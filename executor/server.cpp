@@ -470,8 +470,10 @@ class WarmRunner {
   // kTimeout = deadline expired (runner killed); kDied = runner crashed or
   // spoke garbage (killed); kInterrupted = deadline expired but cooperative
   // cancellation worked — the runner unwound user code via SIGINT, reported,
-  // and is still alive with its device lease intact (caller must reset() to
-  // scrub, then may keep serving warm). The distinction matters doubly on a
+  // and is still alive with its device lease AND in-process state intact —
+  // the caller keeps serving warm and must NOT scrub (to a session the
+  // interrupt is just a failed request; pool turnover resets between
+  // tenants via /reset as usual). The distinction matters doubly on a
   // leased accelerator: SIGKILLing a runner mid-device-op abandons the
   // device's server-side claim with no goodbye, which can leave the chip
   // refusing attaches until the stale claim lapses (observed on the
@@ -924,10 +926,14 @@ RunOutcome run_user_code(const std::string& script_path,
             break;
           case WarmRunner::ExecResult::kInterrupted:
             // Timed out, but cooperative cancellation unwound the user code
-            // and the runner survived with its device lease. Scrub the
-            // generation; only a failed scrub costs us the warm process.
+            // and the runner survived with its device lease AND state. No
+            // scrub here: to a session the interrupt is just an exception
+            // (its in-process state legitimately lives on, like any other
+            // failed request), and pool turnover already resets between
+            // tenants via /reset — an immediate scrub would silently break
+            // the session contract while runner_restarted=false claims
+            // state survived.
             out.timed_out = true;
-            if (!g_state.runner->reset(15.0)) restart_runner = true;
             break;
           case WarmRunner::ExecResult::kDied:
             out.runner_died = true;

@@ -22,6 +22,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # plugin's trigger env so sandbox subprocesses spawned by e2e tests also run
 # on CPU.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# Sandboxes inherit this process's env: keep the executor's cooperative-
+# cancellation grace short so forced-kill timeout tests don't idle for the
+# 20 s production default.
+os.environ.setdefault("APP_RUNNER_INTERRUPT_GRACE_S", "2")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -274,13 +274,16 @@ async def test_execute_custom_tool_session(client):
 
 
 async def test_execute_custom_tool_session_death_visible_on_error(client):
-    """gRPC mirror of the HTTP error-continuity test: a tool call that
-    times out (killing the session's runner) returns the Error variant WITH
+    """gRPC mirror of the HTTP error-continuity test: a tool call whose
+    timeout KILLS the session's runner (SIGINT ignored, so cooperative
+    cancellation can't save it) returns the Error variant WITH
     session_ended=true — the agent must see its session died."""
     tool = (
-        "import time\n"
+        "import signal\n"
         "def hang() -> int:\n"
-        "    time.sleep(30)\n"
+        "    signal.signal(signal.SIGINT, signal.SIG_IGN)\n"
+        "    while True:\n"
+        "        pass\n"
         "    return 1\n"
     )
     try:

@@ -407,11 +407,13 @@ async def test_custom_tool_in_session(client):
     assert not any(s["executor_id"] == "tool-sess" for s in sessions)
 
 
-async def test_custom_tool_session_death_visible_on_error(client):
-    """A tool call that times out (killing the session's runner) fails —
-    AND tells the agent its session died, via the error body's continuity
-    fields. A silent session reset behind a 400 would strand the agent."""
-    tool = (
+async def test_custom_tool_timeout_session_continuity(client):
+    """Tool-call timeout continuity, both flavors. An INTERRUPTIBLE hang is
+    cooperatively cancelled: the error body reports the session ALIVE
+    (session_ended False) — the agent can keep using it. An uninterruptible
+    hang kills the runner and the body must say the session died; a silent
+    reset behind a 400 would strand the agent."""
+    coop_tool = (
         "import time\n"
         "def hang() -> int:\n"
         "    time.sleep(30)\n"
@@ -421,7 +423,32 @@ async def test_custom_tool_session_death_visible_on_error(client):
         resp = await client.post(
             "/v1/execute-custom-tool",
             json={
-                "tool_source_code": tool,
+                "tool_source_code": coop_tool,
+                "tool_input_json": "{}",
+                "executor_id": "tool-coop-sess",
+                "timeout": 1,
+            },
+        )
+        assert resp.status == 400
+        body = await resp.json()
+        assert "timed out" in body["stderr"].lower()
+        assert body["session_ended"] is False
+    finally:
+        await client.delete("/v1/executors/tool-coop-sess")
+
+    kill_tool = (
+        "import signal\n"
+        "def hang() -> int:\n"
+        "    signal.signal(signal.SIGINT, signal.SIG_IGN)\n"
+        "    while True:\n"
+        "        pass\n"
+        "    return 1\n"
+    )
+    try:
+        resp = await client.post(
+            "/v1/execute-custom-tool",
+            json={
+                "tool_source_code": kill_tool,
                 "tool_input_json": "{}",
                 "executor_id": "tool-kill-sess",
                 "timeout": 1,

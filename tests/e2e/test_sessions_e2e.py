@@ -78,6 +78,37 @@ async def test_session_workspace_persists_across_executes(stack):
     assert fresh.stdout.strip() == "False"
 
 
+async def test_session_survives_cooperative_timeout(stack):
+    """An INTERRUPTIBLE runaway is cancelled via SIGINT: to the session the
+    timeout is just a failed request — its in-process state and workspace
+    legitimately survive (the runner was never killed)."""
+    executor, backend = stack
+
+    first = await executor.execute(
+        "import os\nopen('state.txt', 'w').write('x')\nprint(os.getpid())",
+        executor_id="sess-coop",
+    )
+    assert first.exit_code == 0, first.stderr
+    pid = first.stdout.strip()
+
+    hung = await executor.execute(
+        "import time\ntime.sleep(30)", executor_id="sess-coop", timeout=1.0
+    )
+    assert hung.exit_code == -1
+    assert "timed out" in hung.stderr.lower()
+    assert "sess-coop" in executor._sessions
+
+    # Same warm PROCESS (never killed) and same workspace afterwards.
+    cont = await executor.execute(
+        "import os\nprint(os.getpid(), os.path.exists('state.txt'))",
+        executor_id="sess-coop",
+    )
+    assert cont.exit_code == 0, cont.stderr
+    assert cont.stdout.strip() == f"{pid} True"
+    await executor.close_session("sess-coop")
+    await _settle(executor)
+
+
 async def test_session_timeout_kill_ends_session(stack):
     executor, backend = stack
 
@@ -86,10 +117,13 @@ async def test_session_timeout_kill_ends_session(stack):
     )
     assert first.exit_code == 0, first.stderr
 
-    # The warm runner is killed by the timeout -> runner_restarted -> the
-    # session ends (its in-process state is gone, the contract is broken).
+    # An UNinterruptible runaway (ignores SIGINT) exhausts the cancellation
+    # grace; the warm runner is killed -> runner_restarted -> the session
+    # ends (its in-process state is gone, the contract is broken).
     hung = await executor.execute(
-        "import time\ntime.sleep(30)", executor_id="sess-kill", timeout=1.0
+        "import signal\nsignal.signal(signal.SIGINT, signal.SIG_IGN)\n"
+        "while True: pass",
+        executor_id="sess-kill", timeout=1.0,
     )
     assert hung.exit_code == -1
     assert "timed out" in hung.stderr.lower()
