@@ -319,6 +319,12 @@ def _w(leaf, dt):
 
     from bee_code_interpreter_fs_tpu.models.lora import is_lora_leaf
 
+    if isinstance(leaf, dict) and "lora_a_stack" in leaf:
+        raise TypeError(
+            "multi-adapter LoRA leaves select weights PER BATCH ROW and "
+            "have no single-matrix form; they are consumed activation-side "
+            "by _mm (all model matmuls route through it)"
+        )
     if is_lora_leaf(leaf):
         # Correctness fallback only: materializes the full [in, out] delta.
         # Every model matmul goes through _mm below, which applies the
@@ -341,6 +347,19 @@ def _mm(h, leaf, dt):
     reduced HBM traffic on the weight-bandwidth-bound decode path."""
     from bee_code_interpreter_fs_tpu.models.lora import is_lora_leaf
 
+    if isinstance(leaf, dict) and "lora_a_stack" in leaf:
+        # Multi-adapter serving (lora.multi_lora_wrap): batch row i applies
+        # adapter lora_ids[i] — gather the per-row [in, r]/[r, out] pair
+        # and run two batched skinny matmuls. Inside the layer scan the
+        # stacks are [N, in, r]/[N, r, out] and lora_ids is [b].
+        ids = leaf["lora_ids"]
+        # Gather BEFORE casting: convert only the b selected adapters, not
+        # the whole bank.
+        a_sel = leaf["lora_a_stack"][ids].astype(dt)
+        b_sel = leaf["lora_b_stack"][ids].astype(dt)
+        delta = jnp.einsum("btr,bro->bto",
+                           jnp.einsum("btd,bdr->btr", h, a_sel), b_sel)
+        return _mm(h, leaf["base"], dt) + delta
     if is_lora_leaf(leaf):
         return _mm(h, leaf["base"], dt) + (
             h @ leaf["lora_a"].astype(dt)

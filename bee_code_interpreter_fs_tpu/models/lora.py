@@ -45,6 +45,9 @@ __all__ = [
     "merge_lora",
     "make_lora_train_step",
     "is_lora_leaf",
+    "stack_loras",
+    "multi_lora_wrap",
+    "zero_lora",
 ]
 
 DEFAULT_TARGETS = ("wq", "wk", "wv", "wo")
@@ -176,6 +179,67 @@ def merge_lora(params, lora, *, alpha: float = 16.0):
         delta = jnp.einsum("lir,lro->lio", ab["a"], ab["b"]) * (alpha / rank)
         layers[name] = (base.astype(jnp.float32) + delta).astype(base.dtype)
     return {**params, "layers": layers}
+
+
+def stack_loras(loras, *, targets: tuple = DEFAULT_TARGETS,
+                alpha: float = 16.0):
+    """Stack N adapter trees (same rank/targets) for multi-adapter serving:
+    {"layers": {t: {"a": [L, N, in, r], "b": [L, N, r, out]}}} — adapter
+    index is axis 1 so the layer `lax.scan` still slices axis 0. The
+    alpha/rank scale is folded into the b-stack HERE, once — wrapping per
+    burst must stay allocation-free over the bank."""
+    if not loras:
+        raise ValueError("need at least one adapter")
+    out = {}
+    for name in targets:
+        abs_ = [lo["layers"][name] for lo in loras]
+        ranks = {ab["a"].shape[-1] for ab in abs_}
+        if len(ranks) != 1:
+            raise ValueError(
+                f"adapters disagree on rank for {name!r}: {sorted(ranks)}"
+            )
+        rank = next(iter(ranks))
+        out[name] = {
+            "a": jnp.stack([ab["a"] for ab in abs_], axis=1),
+            "b": jnp.stack([ab["b"] for ab in abs_], axis=1)
+            * (alpha / rank),
+        }
+    return {"layers": out}
+
+
+def multi_lora_wrap(params, stacked, ids):
+    """Attach a STACK of adapters with a per-batch-row selection: target
+    leaves become {"base", "lora_a_stack" [L, N, in, r], "lora_b_stack",
+    "lora_ids" [L, b]} and `llama._mm` applies row i's adapter ids[i]
+    activation-side (batched gather + two skinny bmms). `ids` is [b] and is
+    broadcast with a leading layer axis only so it can ride the layer scan
+    beside the weights; pass it as a traced array — changing the selection
+    never recompiles. Cheap enough for every burst: it only rebuilds leaf
+    dicts around the SAME arrays (stack_loras already folded the
+    alpha/rank scale in). The serving engines use this to serve MANY
+    fine-tunes from one resident base model (multi-tenant adapter
+    serving)."""
+    layers = dict(params["layers"])
+    ids = jnp.asarray(ids, jnp.int32)
+    L = next(iter(stacked["layers"].values()))["a"].shape[0]
+    ids_l = jnp.broadcast_to(ids[None, :], (L, ids.shape[0]))
+    for name, ab in stacked["layers"].items():
+        layers[name] = {
+            "base": params["layers"][name],
+            "lora_a_stack": ab["a"],
+            "lora_b_stack": ab["b"],
+            "lora_ids": ids_l,
+        }
+    return {**params, "layers": layers}
+
+
+def zero_lora(cfg: LlamaConfig, *, rank: int = 8,
+              targets: tuple = DEFAULT_TARGETS):
+    """The identity adapter (all-zero a and b): multi-adapter stacks put it
+    at index 0 so un-adapted requests select it and get the exact base
+    model."""
+    lora = init_lora(jax.random.PRNGKey(0), cfg, rank=rank, targets=targets)
+    return jax.tree.map(jnp.zeros_like, lora)
 
 
 def make_lora_train_step(cfg: LlamaConfig, optimizer, base_params, *,

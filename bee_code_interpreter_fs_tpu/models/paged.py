@@ -257,8 +257,8 @@ class PagedServingEngine(ServingEngine):
                 pad_to = self._pad_to_blocks(plen + bl)
                 padded = self._padded_prompt(req.prompt, bl)
                 last_logits, scratch = _prefill_scratch_prefixed(
-                    self.params, pf["k"], pf["v"], jnp.asarray(padded),
-                    jnp.int32(n), self.cfg, pad_to,
+                    self._req_params(req), pf["k"], pf["v"],
+                    jnp.asarray(padded), jnp.int32(n), self.cfg, pad_to,
                 )
                 self.pool = self._install_scratch(scratch, blks, pad_to,
                                                   need)
@@ -268,8 +268,8 @@ class PagedServingEngine(ServingEngine):
             pad_to = self._pad_to_blocks(bl)
             padded = self._padded_prompt(req.prompt, bl)
             last_logits, scratch = _prefill_scratch(
-                self.params, jnp.asarray(padded), jnp.int32(n), self.cfg,
-                pad_to,
+                self._req_params(req), jnp.asarray(padded), jnp.int32(n),
+                self.cfg, pad_to,
             )
             self.pool = self._install_scratch(scratch, blks, pad_to, need)
             first = self._pick_first(req, last_logits, prompt_end)
@@ -301,7 +301,8 @@ class PagedServingEngine(ServingEngine):
     def _run_burst(self):
         (self.pool, self.pos, self.last_tok, self.remaining, self.active,
          toks, emitted) = _decode_burst_paged(
-            self.params, self.pool, self.tables, self.pos, self.last_tok,
+            self._params_for(self._slot_adapter), self.pool, self.tables,
+            self.pos, self.last_tok,
             self.remaining, self.active, self.temp, self.keys, self.cfg,
             self.steps_per_sync, self.eos_id,
         )

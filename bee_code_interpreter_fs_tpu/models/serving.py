@@ -73,6 +73,7 @@ class Request:
     prefix_id: int | None = None
     temperature: float = 0.0  # 0 = greedy
     seed: int | None = None
+    adapter: str | None = None  # multi-LoRA adapter name (None = base)
     generated: list = field(default_factory=list)
 
 
@@ -277,7 +278,13 @@ class ServingEngine:
     def __init__(self, params, cfg: LlamaConfig, *, n_slots: int = 4,
                  max_len: int | None = None, steps_per_sync: int = 8,
                  prefill_buckets: tuple = (), eos_id: int | None = None,
-                 seed: int = 0):
+                 seed: int = 0, adapters: dict | None = None,
+                 lora_alpha: float = 16.0):
+        """`adapters`: {name: lora tree (models/lora.init_lora shape)} —
+        multi-tenant adapter serving. Every request picks one by name (or
+        None for the bare base model); one resident base plus one stacked
+        adapter bank serve them all in the same bursts, with index 0 the
+        zero adapter so un-adapted rows compute the exact base model."""
         self.params = params
         self.cfg = cfg
         self.n_slots = int(n_slots)
@@ -311,6 +318,28 @@ class ServingEngine:
         self.temp = jnp.zeros((self.n_slots,), jnp.float32)
         self.keys = jnp.zeros((self.n_slots, 2), jnp.uint32)
         self._base_seed = int(seed)
+        self._lora_alpha = float(lora_alpha)
+        self._stacked = None
+        self._adapter_idx: dict = {None: 0}
+        self._slot_adapter = np.zeros((self.n_slots,), np.int32)
+        if adapters:
+            from bee_code_interpreter_fs_tpu.models.lora import (
+                stack_loras,
+                zero_lora,
+            )
+
+            names = list(adapters)
+            first = adapters[names[0]]["layers"]
+            targets = tuple(first)
+            rank = next(iter(first.values()))["a"].shape[-1]
+            zero = zero_lora(cfg, rank=rank, targets=targets)
+            self._stacked = stack_loras(
+                [zero] + [adapters[n] for n in names], targets=targets,
+                alpha=self._lora_alpha,
+            )
+            self._adapter_idx.update(
+                {n: i + 1 for i, n in enumerate(names)}
+            )
 
     def _init_device_state(self):
         """Device-side KV state. The base engine holds one dense
@@ -320,7 +349,7 @@ class ServingEngine:
 
     # ------------------------------------------------------------- intake
 
-    def register_prefix(self, tokens) -> int:
+    def register_prefix(self, tokens, adapter: str | None = None) -> int:
         """Prefill a shared prompt prefix ONCE and cache its K/V; requests
         submitted with the returned id skip the prefix's prefill entirely
         (the classic system-prompt amortization). Costs one [L, 1, plen]
@@ -333,10 +362,13 @@ class ServingEngine:
                 f"prefix ({tokens.size}) leaves no room in max_len "
                 f"{self.max_len}"
             )
+        if adapter is not None and adapter not in self._adapter_idx:
+            raise ValueError(f"unknown adapter {adapter!r}")
         plen = int(tokens.size)
         scratch = init_cache(self.cfg, 1, plen)
         last_logits, scratch = _prefix_prefill(
-            self.params, jnp.asarray(tokens[None, :]), scratch, self.cfg
+            self._params_for([self._adapter_idx.get(adapter, 0)]),
+            jnp.asarray(tokens[None, :]), scratch, self.cfg,
         )
         pid = next(self._prefix_id)
         self._prefixes[pid] = {
@@ -344,12 +376,13 @@ class ServingEngine:
             "v": scratch["v"],
             "last_logits": np.asarray(last_logits[0], np.float32),
             "len": plen,
+            "adapter": adapter,
         }
         return pid
 
     def submit(self, prompt, max_new_tokens: int,
                prefix_id: int | None = None, *, temperature: float = 0.0,
-               seed: int | None = None) -> int:
+               seed: int | None = None, adapter: str | None = None) -> int:
         """Queue a prompt (sequence of int token ids); returns request id.
         With `prefix_id`, `prompt` is the SUFFIX after that registered
         prefix (may be empty — the prefix alone is the prompt).
@@ -362,11 +395,20 @@ class ServingEngine:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if temperature < 0:
             raise ValueError("temperature must be >= 0")
+        if adapter is not None and adapter not in self._adapter_idx:
+            raise ValueError(f"unknown adapter {adapter!r}")
         plen = 0
         if prefix_id is not None:
             if prefix_id not in self._prefixes:
                 raise ValueError(f"unknown prefix_id {prefix_id}")
-            plen = self._prefixes[prefix_id]["len"]
+            pf = self._prefixes[prefix_id]
+            if pf["adapter"] != adapter:
+                raise ValueError(
+                    f"prefix {prefix_id} was registered under adapter "
+                    f"{pf['adapter']!r}; request uses {adapter!r} — prefix "
+                    "K/V is adapter-specific"
+                )
+            plen = pf["len"]
         elif prompt.size == 0:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -384,7 +426,7 @@ class ServingEngine:
         rid = next(self._rid)
         self._queue.append(
             Request(rid, prompt, int(max_new_tokens), prefix_id,
-                    float(temperature), seed)
+                    float(temperature), seed, adapter)
         )
         return rid
 
@@ -408,6 +450,22 @@ class ServingEngine:
             if n <= b:
                 return b
         raise ValueError(f"no bucket holds prompt of length {n}")
+
+    def _params_for(self, ids) -> dict:
+        """Base params, or the multi-adapter wrapped tree selecting adapter
+        ids[i] for batch row i. The wrap rebuilds only composite-leaf dicts
+        around the same arrays — structure is identical across calls, so
+        the jitted programs never recompile on adapter churn."""
+        if self._stacked is None:
+            return self.params
+        from bee_code_interpreter_fs_tpu.models.lora import multi_lora_wrap
+
+        return multi_lora_wrap(
+            self.params, self._stacked, jnp.asarray(ids, jnp.int32)
+        )
+
+    def _req_params(self, req: Request) -> dict:
+        return self._params_for([self._adapter_idx[req.adapter]])
 
     def _req_key(self, req: Request):
         if req.seed is not None:
@@ -457,7 +515,7 @@ class ServingEngine:
                 bl = self._suffix_bucket(plen, n)
                 padded = self._padded_prompt(req.prompt, bl)
                 self.cache, last_logits = _admit_prefixed(
-                    self.params, self.cache, pf["k"], pf["v"],
+                    self._req_params(req), self.cache, pf["k"], pf["v"],
                     jnp.asarray(padded), jnp.int32(i), jnp.int32(n),
                     self.cfg,
                 )
@@ -466,7 +524,7 @@ class ServingEngine:
         bl = self._bucket_len(n)
         padded = self._padded_prompt(req.prompt, bl)
         self.cache, last_logits = _admit(
-            self.params, self.cache, jnp.asarray(padded),
+            self._req_params(req), self.cache, jnp.asarray(padded),
             jnp.int32(i), jnp.int32(n), self.cfg,
         )
         return self._pick_first(req, last_logits, n), n
@@ -503,6 +561,7 @@ class ServingEngine:
                     self._on_retire(i)
                     continue
                 self._slot_req[i] = req
+                self._slot_adapter[i] = self._adapter_idx[req.adapter]
                 self.pos = self.pos.at[i].set(prompt_end)
                 self.temp = self.temp.at[i].set(req.temperature)
                 self.keys = self.keys.at[i].set(
@@ -533,7 +592,8 @@ class ServingEngine:
     def _run_burst(self):
         (self.cache, self.pos, self.last_tok, self.remaining, self.active,
          toks, emitted) = _decode_burst(
-            self.params, self.cache, self.pos, self.last_tok,
+            self._params_for(self._slot_adapter), self.cache, self.pos,
+            self.last_tok,
             self.remaining, self.active, self.temp, self.keys, self.cfg,
             self.steps_per_sync, self.eos_id,
         )
