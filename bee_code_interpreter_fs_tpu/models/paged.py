@@ -296,6 +296,16 @@ class PagedServingEngine(ServingEngine):
         self._free.extend(self._slot_blocks[i])
         self._slot_blocks[i] = []
 
+    def stats(self) -> dict:
+        out = super().stats()
+        total = len(self._free) + sum(len(b) for b in self._slot_blocks)
+        out.update({
+            "free_blocks": len(self._free),
+            "total_blocks": total,
+            "block_size": self.block_size,
+        })
+        return out
+
     # -------------------------------------------------------------- burst
 
     def _run_burst(self):

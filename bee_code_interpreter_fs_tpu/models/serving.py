@@ -74,6 +74,7 @@ class Request:
     temperature: float = 0.0  # 0 = greedy
     seed: int | None = None
     adapter: str | None = None  # multi-LoRA adapter name (None = base)
+    on_token: object = None  # callable(list[int]) | None — streaming sink
     generated: list = field(default_factory=list)
 
 
@@ -331,6 +332,14 @@ class ServingEngine:
             names = list(adapters)
             first = adapters[names[0]]["layers"]
             targets = tuple(first)
+            for n in names[1:]:
+                if tuple(adapters[n]["layers"]) != targets:
+                    raise ValueError(
+                        f"adapters must share one target set: {names[0]!r} "
+                        f"has {targets}, {n!r} has "
+                        f"{tuple(adapters[n]['layers'])} (pad the smaller "
+                        "adapter with zero targets or retrain)"
+                    )
             rank = next(iter(first.values()))["a"].shape[-1]
             zero = zero_lora(cfg, rank=rank, targets=targets)
             self._stacked = stack_loras(
@@ -382,11 +391,19 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens: int,
                prefix_id: int | None = None, *, temperature: float = 0.0,
-               seed: int | None = None, adapter: str | None = None) -> int:
+               seed: int | None = None, adapter: str | None = None,
+               on_token=None) -> int:
         """Queue a prompt (sequence of int token ids); returns request id.
         With `prefix_id`, `prompt` is the SUFFIX after that registered
         prefix (may be empty — the prefix alone is the prompt).
 
+        `on_token` (callable taking a list[int]) streams the request's new
+        tokens at every scheduler sync — burst-granular (up to
+        steps_per_sync tokens per call), in order, concatenating to
+        exactly the final result. Exceptions from a callback propagate out
+        of step()/run() only after every slot's tokens are recorded and
+        every other sink is delivered — a broken sink never corrupts any
+        request's results (resume by calling run() again).
         `temperature` > 0 samples instead of greedy decoding; the request's
         random stream is `fold_in(key, token position)`, so with an explicit
         `seed` the output is reproducible regardless of what other traffic
@@ -426,7 +443,7 @@ class ServingEngine:
         rid = next(self._rid)
         self._queue.append(
             Request(rid, prompt, int(max_new_tokens), prefix_id,
-                    float(temperature), seed, adapter)
+                    float(temperature), seed, adapter, on_token)
         )
         return rid
 
@@ -559,6 +576,8 @@ class ServingEngine:
                     # claimed per-slot resources (the paged engine's block
                     # reservation) — release them.
                     self._on_retire(i)
+                    if req.on_token is not None:
+                        req.on_token([first])
                     continue
                 self._slot_req[i] = req
                 self._slot_adapter[i] = self._adapter_idx[req.adapter]
@@ -572,6 +591,10 @@ class ServingEngine:
                     req.max_new_tokens - 1
                 )
                 self.active = self.active.at[i].set(True)
+                # Callback last: if it raises, every token is already
+                # recorded and the slot/block bookkeeping is consistent.
+                if req.on_token is not None:
+                    req.on_token([first])
                 break
 
     def step(self):
@@ -583,11 +606,27 @@ class ServingEngine:
         toks, emitted = self._run_burst()
         toks = np.asarray(toks)
         emitted = np.asarray(emitted)
+        # Two phases: record EVERY slot's tokens, then fire callbacks — a
+        # raising callback must never cost another request (or a later
+        # chunk of its own request) its recorded tokens.
+        fired = []
         for i in range(self.n_slots):
             req = self._slot_req[i]
             if req is None:
                 continue
-            req.generated.extend(toks[emitted[:, i], i].tolist())
+            new = toks[emitted[:, i], i].tolist()
+            req.generated.extend(new)
+            if req.on_token is not None and new:
+                fired.append((req.on_token, new))
+        first_exc = None
+        for cb, new in fired:
+            try:
+                cb(new)
+            except Exception as e:  # noqa: BLE001 — deliver to all sinks
+                if first_exc is None:
+                    first_exc = e
+        if first_exc is not None:
+            raise first_exc
 
     def _run_burst(self):
         (self.cache, self.pos, self.last_tok, self.remaining, self.active,
@@ -598,6 +637,19 @@ class ServingEngine:
             self.steps_per_sync, self.eos_id,
         )
         return toks, emitted
+
+    def stats(self) -> dict:
+        """Scheduler snapshot: queue depth, slot occupancy, finished-but-
+        uncollected results (the paged engine adds pool utilization)."""
+        return {
+            "queued": len(self._queue),
+            "active_slots": int(np.asarray(self.active).sum()),
+            "occupied_slots": sum(
+                r is not None for r in self._slot_req
+            ),
+            "n_slots": self.n_slots,
+            "results_pending": len(self._results),
+        }
 
     def run(self) -> dict[int, np.ndarray]:
         """Drain the queue and all active slots; returns {rid: generated}."""

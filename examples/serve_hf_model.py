@@ -1,0 +1,82 @@
+"""End-to-end Llama-COMPATIBILITY demo: a HuggingFace transformers model,
+converted and served by this framework's continuous-batching engine, with
+the output checked token-for-token against transformers' own generate().
+
+No network involved: the script builds a small random-weight
+`LlamaForCausalLM` in memory (the same model class real checkpoints load
+into — swap in `from_pretrained(...)` and a bigger `LlamaConfig` to serve
+a real one; `models/quant.py` int8/int4 fits 7B/13B on one v5e chip).
+
+The path exercised is the production one end to end:
+  transformers state_dict
+    -> models/hf_convert.from_hf_state_dict   (naming + RoPE unpermute)
+    -> models/serving.ServingEngine           (continuous batching,
+       bucketed prefill, fused decode bursts, streaming callback)
+and the final check is EXACT agreement with
+`transformers.generate(do_sample=False)` on every request.
+"""
+
+import numpy as np
+import torch
+import transformers
+
+import jax.numpy as jnp
+
+from bee_code_interpreter_fs_tpu.models import LlamaConfig
+from bee_code_interpreter_fs_tpu.models.hf_convert import from_hf_state_dict
+from bee_code_interpreter_fs_tpu.models.serving import ServingEngine
+
+# -- a Llama-architecture model from the HF ecosystem (random weights) ----
+hf_cfg = transformers.LlamaConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=256,
+    num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=4,
+    max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=10000.0,
+    attention_bias=False, mlp_bias=False, tie_word_embeddings=False,
+)
+torch.manual_seed(7)
+hf_model = transformers.LlamaForCausalLM(hf_cfg).float().eval()
+
+cfg = LlamaConfig(
+    vocab_size=512, dim=128, n_layers=4, n_heads=8, n_kv_heads=4,
+    hidden_dim=256, max_seq_len=256, dtype="float32",
+)
+params = from_hf_state_dict(hf_model.state_dict(), cfg)
+
+# -- serve a batch of prompts through the engine, streaming as we go ------
+rng = np.random.default_rng(3)
+prompts = [rng.integers(1, 511, size=int(n)).tolist() for n in (5, 17, 9, 2)]
+MAX_NEW = 24
+
+# eos matches the HF config's so both sides stop at the same place (the
+# engine emits the eos token then stops; generate() does the same).
+eng = ServingEngine(params, cfg, n_slots=2, max_len=128, steps_per_sync=6,
+                    eos_id=hf_cfg.eos_token_id)
+streamed: dict[int, list] = {}
+rids = []
+for p in prompts:
+    rid = eng.submit(
+        p, MAX_NEW,
+        on_token=lambda toks, key=len(rids): streamed.setdefault(
+            key, []
+        ).extend(toks),
+    )
+    rids.append(rid)
+results = eng.run()
+
+# -- the ground truth: transformers' own greedy generate ------------------
+ok = 0
+for i, (rid, p) in enumerate(zip(rids, prompts)):
+    with torch.no_grad():
+        ref = hf_model.generate(
+            torch.tensor([p]), max_new_tokens=MAX_NEW, do_sample=False,
+            pad_token_id=0,
+        )[0, len(p):].numpy()
+    got = results[rid]
+    assert np.array_equal(got, ref), (i, got, ref)
+    assert streamed[i] == got.tolist(), "streamed chunks != final result"
+    ok += 1
+
+print(f"backend: {jnp.zeros(1).devices()}")
+print(f"served {ok}/{len(prompts)} requests from a transformers "
+      f"LlamaForCausalLM, token-exact vs transformers.generate, "
+      f"streaming verified")

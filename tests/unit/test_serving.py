@@ -240,6 +240,43 @@ def test_admission_sampling_exact_vs_reimplementation(model):
     assert tv < 0.35, tv  # gross-error guard only; n=300 over ~97 tokens
 
 
+def test_streaming_callback_and_stats(model):
+    """on_token chunks arrive in order, burst-granular, and concatenate to
+    exactly the final result; stats() tracks the lifecycle."""
+    params, cfg = model
+    eng = ServingEngine(params, cfg, n_slots=2, max_len=64, steps_per_sync=3)
+    chunks: dict[int, list] = {}
+
+    def sink_for(rid):
+        chunks[rid] = []
+        return lambda toks: chunks[rid].append(list(toks))
+
+    rids = []
+    for p, m in (([4, 9], 10), ([17] * 5, 7), ([2], 12)):
+        rid = eng.submit(p, m)
+        eng._queue[-1].on_token = sink_for(rid)  # attach post-hoc via rid
+        rids.append(rid)
+    s0 = eng.stats()
+    assert s0["queued"] == 3 and s0["active_slots"] == 0
+    res = eng.run()
+    for rid in rids:
+        flat = [t for c in chunks[rid] for t in c]
+        np.testing.assert_array_equal(np.asarray(flat, np.int32), res[rid])
+        assert all(len(c) <= 1 + eng.steps_per_sync for c in chunks[rid])
+    s1 = eng.stats()
+    assert s1["queued"] == 0 and s1["occupied_slots"] == 0
+    assert s1["results_pending"] == 0  # run() drained them
+
+
+def test_on_token_via_submit(model):
+    params, cfg = model
+    eng = ServingEngine(params, cfg, n_slots=1, max_len=64, steps_per_sync=4)
+    got = []
+    rid = eng.submit([8, 3], 9, on_token=lambda t: got.extend(t))
+    res = eng.run()
+    np.testing.assert_array_equal(np.asarray(got, np.int32), res[rid])
+
+
 def test_prefill_compiles_once_per_bucket(model):
     """Two same-bucket prompts of different lengths must share one compile
     (the bucket is the static shape; slot and true length are traced)."""
@@ -253,3 +290,34 @@ def test_prefill_compiles_once_per_bucket(model):
         eng.submit(p, max_new_tokens=2)
     eng.run()
     assert serving._admit._cache_size() - before <= 1
+
+
+def test_raising_callback_corrupts_nothing(model):
+    """A sink that raises must not cost any request (including its own
+    later chunks) recorded tokens; run() can resume and complete."""
+    params, cfg = model
+    eng = ServingEngine(params, cfg, n_slots=2, max_len=64, steps_per_sync=3)
+
+    state = {"raised": False}
+
+    def bomb(_):
+        if not state["raised"]:  # transient sink failure, once
+            state["raised"] = True
+            raise RuntimeError("sink down")
+
+    r_bomb = eng.submit([4, 9], 10, on_token=bomb)
+    r_ok = eng.submit([17, 2], 10)
+    with pytest.raises(RuntimeError, match="sink down"):
+        eng.run()
+    res = eng.run()  # resume
+    all_res = {**res}
+    for _ in range(50):
+        if r_bomb in all_res and r_ok in all_res:
+            break
+        all_res.update(eng.run())
+    np.testing.assert_array_equal(
+        all_res[r_ok], _reference(params, cfg, [17, 2], 10)
+    )
+    np.testing.assert_array_equal(
+        all_res[r_bomb], _reference(params, cfg, [4, 9], 10)
+    )
