@@ -53,6 +53,7 @@ from bee_code_interpreter_fs_tpu.models.serving import (
     Request,
     ServingEngine,
     _burst_scan,
+    _chunked_scratch_prefill,
 )
 
 __all__ = ["PagedServingEngine"]
@@ -174,6 +175,13 @@ class PagedServingEngine(ServingEngine):
                  n_blocks: int | None = None, **kwargs):
         if block_size < 1:
             raise ValueError("block_size must be >= 1")
+        pc = kwargs.get("prefill_chunk")
+        if pc and pc % block_size:
+            raise ValueError(
+                f"prefill_chunk ({pc}) must be a multiple of block_size "
+                f"({block_size}) so chunk-aligned scratches stay "
+                "block-aligned"
+            )
         self.block_size = int(block_size)
         self._requested_blocks = n_blocks
         super().__init__(params, cfg, **kwargs)
@@ -266,11 +274,20 @@ class PagedServingEngine(ServingEngine):
         else:
             bl = self._bucket_len(n)
             pad_to = self._pad_to_blocks(bl)
-            padded = self._padded_prompt(req.prompt, bl)
-            last_logits, scratch = _prefill_scratch(
-                self._req_params(req), jnp.asarray(padded), jnp.int32(n),
-                self.cfg, pad_to,
-            )
+            if (self.prefill_chunk is not None
+                    and pad_to > self.prefill_chunk
+                    and pad_to % self.prefill_chunk == 0):
+                padded = self._padded_prompt(req.prompt, pad_to)
+                last_logits, scratch = _chunked_scratch_prefill(
+                    self._req_params(req), jnp.asarray(padded),
+                    jnp.int32(n), self.cfg, self.prefill_chunk,
+                )
+            else:
+                padded = self._padded_prompt(req.prompt, bl)
+                last_logits, scratch = _prefill_scratch(
+                    self._req_params(req), jnp.asarray(padded), jnp.int32(n),
+                    self.cfg, pad_to,
+                )
             self.pool = self._install_scratch(scratch, blks, pad_to, need)
             first = self._pick_first(req, last_logits, prompt_end)
         return first, prompt_end

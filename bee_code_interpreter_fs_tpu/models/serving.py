@@ -221,6 +221,56 @@ def _admit(params, cache, tokens, slot, true_len, cfg: LlamaConfig):
     return {"k": new_k, "v": new_v}, last_logits
 
 
+@partial(jax.jit, static_argnames=("cfg", "chunk"))
+def _chunked_scratch_prefill(params, tokens, true_len, cfg: LlamaConfig,
+                             chunk: int):
+    """Prefill a (bucketed, chunk-aligned) prompt in fixed-size chunks: a
+    lax.scan feeds `chunk` tokens at a time against the growing scratch
+    cache, so attention's score tensor peaks at O(chunk x bucket) instead
+    of O(bucket^2) — the long-prompt admission path. Returns (last_logits
+    [vocab] at true_len-1, scratch kv [L, 1, bucket, ...])."""
+    bucket = tokens.shape[1]
+    if bucket % chunk:
+        raise ValueError(
+            f"bucket {bucket} is not a multiple of prefill chunk {chunk} — "
+            "the tail would silently never prefill"
+        )
+    n_chunks = bucket // chunk
+    scratch = init_cache(cfg, 1, bucket)
+    vocab = cfg.vocab_size
+
+    def body(carry, i):
+        scratch, out = carry
+        chunk_toks = lax.dynamic_slice(tokens, (0, i * chunk), (1, chunk))
+        logits, scratch = decode_chunk(params, chunk_toks, scratch,
+                                       i * chunk, cfg)
+        # The prompt's last real position lives in exactly one chunk.
+        sel = (true_len - 1) // chunk == i
+        out = jnp.where(sel, logits[0, (true_len - 1) % chunk], out)
+        return (scratch, out), None
+
+    (scratch, last_logits), _ = lax.scan(
+        body, (scratch, jnp.zeros((vocab,), jnp.float32)),
+        jnp.arange(n_chunks),
+    )
+    return last_logits, scratch
+
+
+@partial(jax.jit, donate_argnames=("cache",))
+def _install_row(cache, scratch, slot):
+    """Install a contiguous scratch ([L, 1, T <= max_len, ...]) into dense
+    cache row `slot` (the chunked-admission counterpart of _admit's
+    in-jit install)."""
+    return {
+        "k": lax.dynamic_update_slice(
+            cache["k"], scratch["k"], (0, slot, 0, 0, 0)
+        ),
+        "v": lax.dynamic_update_slice(
+            cache["v"], scratch["v"], (0, slot, 0, 0, 0)
+        ),
+    }
+
+
 # One compile per distinct prefix length, paid at registration time.
 # prefill (not decode_chunk): it projects logits only at the LAST position,
 # so registering a long system prompt never materializes a [plen, vocab]
@@ -280,7 +330,7 @@ class ServingEngine:
                  max_len: int | None = None, steps_per_sync: int = 8,
                  prefill_buckets: tuple = (), eos_id: int | None = None,
                  seed: int = 0, adapters: dict | None = None,
-                 lora_alpha: float = 16.0):
+                 lora_alpha: float = 16.0, prefill_chunk: int | None = None):
         """`adapters`: {name: lora tree (models/lora.init_lora shape)} —
         multi-tenant adapter serving. Every request picks one by name (or
         None for the bare base model); one resident base plus one stacked
@@ -292,6 +342,15 @@ class ServingEngine:
         self.max_len = int(max_len or cfg.max_seq_len)
         self.steps_per_sync = int(steps_per_sync)
         self.eos_id = eos_id
+        self.prefill_chunk = int(prefill_chunk) if prefill_chunk else None
+        if self.prefill_chunk is not None and not (
+            1 <= self.prefill_chunk < self.max_len
+        ):
+            raise ValueError(
+                f"prefill_chunk ({self.prefill_chunk}) must be in "
+                f"[1, max_len={self.max_len}) — a chunk that can never "
+                "fire is a misconfiguration"
+            )
         if prefill_buckets:
             self.buckets = tuple(sorted(int(b) for b in prefill_buckets))
             if self.buckets[0] < 1 or self.buckets[-1] > self.max_len:
@@ -305,6 +364,18 @@ class ServingEngine:
             pows = [b for b in (2 ** i for i in range(4, 32))
                     if b < self.max_len - 1]
             self.buckets = tuple(pows + [self.max_len - 1])
+        if self.prefill_chunk is not None:
+            # Chunked admission scans fixed-size chunks, so add chunk-
+            # aligned bucket variants — but KEEP the original top bucket:
+            # capacity never shrinks (an unaligned bucket simply routes
+            # through the single-pass path).
+            c = self.prefill_chunk
+            aligned = {
+                min(-(-b // c) * c, (self.max_len // c) * c)
+                for b in self.buckets
+            }
+            aligned = {b for b in aligned if b > 0}
+            self.buckets = tuple(sorted(aligned | {max(self.buckets)}))
         self._init_device_state()
         self.pos = jnp.zeros((self.n_slots,), jnp.int32)
         self.last_tok = jnp.zeros((self.n_slots,), jnp.int32)
@@ -374,16 +445,32 @@ class ServingEngine:
         if adapter is not None and adapter not in self._adapter_idx:
             raise ValueError(f"unknown adapter {adapter!r}")
         plen = int(tokens.size)
-        scratch = init_cache(self.cfg, 1, plen)
-        last_logits, scratch = _prefix_prefill(
-            self._params_for([self._adapter_idx.get(adapter, 0)]),
-            jnp.asarray(tokens[None, :]), scratch, self.cfg,
-        )
+        p = self._params_for([self._adapter_idx.get(adapter, 0)])
+        if self.prefill_chunk is not None and plen > self.prefill_chunk:
+            # Long system prompts are where chunked prefill matters most:
+            # registration memory peaks at O(chunk x plen), not O(plen^2).
+            c = self.prefill_chunk
+            pad = -(-plen // c) * c
+            padded = np.zeros((1, pad), np.int32)
+            padded[0, :plen] = tokens
+            row_logits, scratch = _chunked_scratch_prefill(
+                p, jnp.asarray(padded), jnp.int32(plen), self.cfg, c
+            )
+            scratch = {
+                "k": scratch["k"][:, :, :plen],
+                "v": scratch["v"][:, :, :plen],
+            }
+        else:
+            scratch = init_cache(self.cfg, 1, plen)
+            batch_logits, scratch = _prefix_prefill(
+                p, jnp.asarray(tokens[None, :]), scratch, self.cfg
+            )
+            row_logits = batch_logits[0]
         pid = next(self._prefix_id)
         self._prefixes[pid] = {
             "k": scratch["k"],
             "v": scratch["v"],
-            "last_logits": np.asarray(last_logits[0], np.float32),
+            "last_logits": np.asarray(row_logits, np.float32),
             "len": plen,
             "adapter": adapter,
         }
@@ -540,10 +627,18 @@ class ServingEngine:
             return first, plen + n
         bl = self._bucket_len(n)
         padded = self._padded_prompt(req.prompt, bl)
-        self.cache, last_logits = _admit(
-            self._req_params(req), self.cache, jnp.asarray(padded),
-            jnp.int32(i), jnp.int32(n), self.cfg,
-        )
+        if (self.prefill_chunk is not None and bl > self.prefill_chunk
+                and bl % self.prefill_chunk == 0):
+            last_logits, scratch = _chunked_scratch_prefill(
+                self._req_params(req), jnp.asarray(padded), jnp.int32(n),
+                self.cfg, self.prefill_chunk,
+            )
+            self.cache = _install_row(self.cache, scratch, jnp.int32(i))
+        else:
+            self.cache, last_logits = _admit(
+                self._req_params(req), self.cache, jnp.asarray(padded),
+                jnp.int32(i), jnp.int32(n), self.cfg,
+            )
         return self._pick_first(req, last_logits, n), n
 
     def _on_retire(self, i: int) -> None:

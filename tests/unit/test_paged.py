@@ -131,3 +131,19 @@ def test_pool_sizing_validation(model):
                            n_blocks=4)
     with pytest.raises(ValueError, match="block_size"):
         PagedServingEngine(params, cfg, block_size=0)
+
+
+def test_chunked_prefill_paged(model):
+    params, cfg = model
+    eng = PagedServingEngine(params, cfg, n_slots=2, max_len=128,
+                             block_size=8, prefill_chunk=16,
+                             steps_per_sync=3)
+    long_prompt = list(range(2, 60))
+    r1 = eng.submit(long_prompt, 6)
+    r2 = eng.submit([7], 8)
+    res = eng.run()
+    np.testing.assert_array_equal(
+        res[r1], _reference(params, cfg, long_prompt, 6))
+    np.testing.assert_array_equal(res[r2], _reference(params, cfg, [7], 8))
+    with pytest.raises(ValueError, match="multiple of block_size"):
+        PagedServingEngine(params, cfg, block_size=8, prefill_chunk=12)

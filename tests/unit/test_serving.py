@@ -321,3 +321,28 @@ def test_raising_callback_corrupts_nothing(model):
     np.testing.assert_array_equal(
         all_res[r_bomb], _reference(params, cfg, [4, 9], 10)
     )
+
+
+@pytest.mark.parametrize("chunk", [8, 16])
+def test_chunked_prefill_token_exact(model, chunk):
+    """Chunked admission (O(chunk x len) attention memory) must be token-
+    exact with the single-pass path for long and short prompts alike, and
+    for a long chunked-registered prefix."""
+    params, cfg = model
+    long_prompt = list(range(1, 52))       # spans several chunks
+    short_prompt = [5, 9]                  # stays on the unchunked path
+    sysp = [3] * 37                        # long prefix registers chunked
+
+    eng = ServingEngine(params, cfg, n_slots=2, max_len=128,
+                        steps_per_sync=4, prefill_chunk=chunk)
+    pid = eng.register_prefix(sysp)
+    r1 = eng.submit(long_prompt, 7)
+    r2 = eng.submit(short_prompt, 9)
+    r3 = eng.submit([8, 1], 6, prefix_id=pid)
+    res = eng.run()
+    np.testing.assert_array_equal(
+        res[r1], _reference(params, cfg, long_prompt, 7))
+    np.testing.assert_array_equal(
+        res[r2], _reference(params, cfg, short_prompt, 9))
+    np.testing.assert_array_equal(
+        res[r3], _reference(params, cfg, sysp + [8, 1], 6))
