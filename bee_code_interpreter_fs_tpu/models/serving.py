@@ -733,6 +733,28 @@ class ServingEngine:
         )
         return toks, emitted
 
+    def cancel(self, rid: int) -> bool:
+        """Cancel a request: queued requests are dropped, active ones stop
+        at the next sync boundary; either way the tokens generated so far
+        become the request's result. Returns False when the rid is unknown
+        or already finished (its result, if any, is untouched)."""
+        for idx, req in enumerate(self._queue):
+            if req.rid == rid:
+                del self._queue[idx]
+                self._results[rid] = np.asarray(req.generated, np.int32)
+                return True
+        for i in range(self.n_slots):
+            req = self._slot_req[i]
+            if req is not None and req.rid == rid:
+                # A slot can hold a request that already FINISHED in the
+                # last burst but hasn't been swept yet — that's a
+                # completion, not a cancellation.
+                was_active = bool(np.asarray(self.active)[i])
+                self.active = self.active.at[i].set(False)
+                self._retire()  # one retirement path for all bookkeeping
+                return was_active
+        return False
+
     def stats(self) -> dict:
         """Scheduler snapshot: queue depth, slot occupancy, finished-but-
         uncollected results (the paged engine adds pool utilization)."""

@@ -147,3 +147,17 @@ def test_chunked_prefill_paged(model):
     np.testing.assert_array_equal(res[r2], _reference(params, cfg, [7], 8))
     with pytest.raises(ValueError, match="multiple of block_size"):
         PagedServingEngine(params, cfg, block_size=8, prefill_chunk=12)
+
+
+def test_cancel_frees_blocks(model):
+    params, cfg = model
+    eng = PagedServingEngine(params, cfg, n_slots=2, max_len=64,
+                             block_size=8, n_blocks=12, steps_per_sync=2)
+    total = eng.free_blocks
+    rid = eng.submit([3] * 20, 30)
+    eng.step()
+    assert eng.free_blocks < total
+    assert eng.cancel(rid) is True
+    assert eng.free_blocks == total
+    res = eng.run()
+    assert res[rid].size >= 1

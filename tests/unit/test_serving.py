@@ -346,3 +346,28 @@ def test_chunked_prefill_token_exact(model, chunk):
         res[r2], _reference(params, cfg, short_prompt, 9))
     np.testing.assert_array_equal(
         res[r3], _reference(params, cfg, sysp + [8, 1], 6))
+
+
+def test_cancel_queued_and_active(model):
+    """Cancelling a queued request drops it (empty result); cancelling an
+    active one stops at the sync boundary with the partial tokens as its
+    result, and its slot serves the next request."""
+    params, cfg = model
+    eng = ServingEngine(params, cfg, n_slots=1, max_len=64, steps_per_sync=3)
+    r_active = eng.submit([4, 9], 40)
+    r_queued = eng.submit([8, 8], 5)
+    eng.step()  # admits r_active, runs one burst
+    assert eng.cancel(r_queued) is True
+    assert eng.cancel(r_active) is True
+    assert eng.cancel(12345) is False
+    res = eng.run()
+    assert res[r_queued].size == 0
+    partial = res[r_active]
+    assert 0 < partial.size < 40
+    full = _reference(params, cfg, [4, 9], 40)
+    np.testing.assert_array_equal(partial, full[: partial.size])
+    # slot is reusable afterwards
+    r_next = eng.submit([17], 4)
+    res2 = eng.run()
+    np.testing.assert_array_equal(res2[r_next], _reference(params, cfg, [17], 4))
+    assert eng.cancel(r_next) is False  # already finished
