@@ -105,11 +105,12 @@ def _perslot_decode_step_paged(params, tokens, pool, tables, pos, active,
     return logits, {"k": new_k, "v": new_v}
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "eos_id"),
+@partial(jax.jit,
+         static_argnames=("cfg", "steps", "eos_id", "with_logprobs"),
          donate_argnames=("pool",))
 def _decode_burst_paged(params, pool, tables, pos, last_tok, remaining,
                         active, temp, keys, cfg: LlamaConfig, steps: int,
-                        eos_id):
+                        eos_id, with_logprobs: bool = False):
     """The paged twin of serving._decode_burst: same carry, same sampling
     stream, decode steps against the block pool (tables are constant for a
     burst — reservation admission pre-allocates every block a request can
@@ -121,7 +122,7 @@ def _decode_burst_paged(params, pool, tables, pos, last_tok, remaining,
         )
 
     return _burst_scan(step_fn, pool, pos, last_tok, remaining, active,
-                       temp, keys, steps, eos_id)
+                       temp, keys, steps, eos_id, with_logprobs)
 
 
 @partial(jax.jit, static_argnames=("cfg", "pad_to"))
@@ -325,12 +326,12 @@ class PagedServingEngine(ServingEngine):
 
     # -------------------------------------------------------------- burst
 
-    def _run_burst(self):
+    def _run_burst(self, with_logprobs: bool = False):
         (self.pool, self.pos, self.last_tok, self.remaining, self.active,
-         toks, emitted) = _decode_burst_paged(
+         toks, emitted, lps) = _decode_burst_paged(
             self._params_for(self._slot_adapter), self.pool, self.tables,
             self.pos, self.last_tok,
             self.remaining, self.active, self.temp, self.keys, self.cfg,
-            self.steps_per_sync, self.eos_id,
+            self.steps_per_sync, self.eos_id, with_logprobs,
         )
-        return toks, emitted
+        return toks, emitted, lps

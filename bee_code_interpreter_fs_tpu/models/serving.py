@@ -75,7 +75,9 @@ class Request:
     seed: int | None = None
     adapter: str | None = None  # multi-LoRA adapter name (None = base)
     on_token: object = None  # callable(list[int]) | None — streaming sink
+    want_logprobs: bool = False
     generated: list = field(default_factory=list)
+    logprobs: list = field(default_factory=list)
 
 
 def _perslot_decode_step(params, tokens, cache, pos, cfg: LlamaConfig):
@@ -132,7 +134,7 @@ def _sample_next(logits, temp, keys, pos):
 
 
 def _burst_scan(step_fn, store, pos, last_tok, remaining, active, temp,
-                keys, steps: int, eos_id):
+                keys, steps: int, eos_id, with_logprobs: bool):
     """The ONE burst loop body both engines run: step_fn produces logits and
     the updated KV store; everything else — the sampling stream, emit
     bookkeeping, budget/EOS masking — lives here so the dense and paged
@@ -142,6 +144,17 @@ def _burst_scan(step_fn, store, pos, last_tok, remaining, active, temp,
         store, pos, tok, remaining, active = carry
         logits, store = step_fn(store, tok[:, None], pos, active)
         nxt = _sample_next(logits, temp, keys, pos)
+        if with_logprobs:
+            # Chosen-token log-prob under the RAW model distribution (the
+            # OpenAI-style convention: temperature shapes sampling, not
+            # the reported likelihoods).
+            lp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits, axis=-1), nxt[:, None], axis=1
+            )[:, 0]
+        else:
+            # Static no-logprob variant: no vocab-wide softmax in the hot
+            # loop; the lane stays shape-stable as zeros.
+            lp = jnp.zeros((logits.shape[0],), jnp.float32)
         tok = jnp.where(active, nxt, tok)
         emitted = active
         pos = pos + active.astype(jnp.int32)
@@ -149,18 +162,20 @@ def _burst_scan(step_fn, store, pos, last_tok, remaining, active, temp,
         active = active & (remaining > 0)
         if eos_id is not None:
             active = active & (tok != eos_id)
-        return (store, pos, tok, remaining, active), (tok, emitted)
+        return (store, pos, tok, remaining, active), (tok, emitted, lp)
 
-    (store, pos, tok, remaining, active), (toks, emitted) = lax.scan(
+    (store, pos, tok, remaining, active), (toks, emitted, lps) = lax.scan(
         one, (store, pos, last_tok, remaining, active), None, length=steps
     )
-    return store, pos, tok, remaining, active, toks, emitted
+    return store, pos, tok, remaining, active, toks, emitted, lps
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "eos_id"),
+@partial(jax.jit,
+         static_argnames=("cfg", "steps", "eos_id", "with_logprobs"),
          donate_argnames=("cache",))
 def _decode_burst(params, cache, pos, last_tok, remaining, active,
-                  temp, keys, cfg: LlamaConfig, steps: int, eos_id):
+                  temp, keys, cfg: LlamaConfig, steps: int, eos_id,
+                  with_logprobs: bool = False):
     """`steps` continuous-batching decode steps as ONE compiled program.
 
     Carry per slot: position, last emitted token, remaining token budget,
@@ -177,8 +192,8 @@ def _decode_burst(params, cache, pos, last_tok, remaining, active,
     batch).
 
     Returns (cache, pos, last_tok, remaining, active, toks [steps, b],
-    emitted [steps, b]) — toks[s, i] is a real generated token for slot i
-    iff emitted[s, i].
+    emitted [steps, b], lps [steps, b]) — toks[s, i] is a real generated
+    token for slot i iff emitted[s, i]; lps[s, i] its model log-prob.
     """
 
     def step_fn(cache, tokens, pos, active):
@@ -186,7 +201,7 @@ def _decode_burst(params, cache, pos, last_tok, remaining, active,
         return _perslot_decode_step(params, tokens, cache, pos, cfg)
 
     return _burst_scan(step_fn, cache, pos, last_tok, remaining, active,
-                       temp, keys, steps, eos_id)
+                       temp, keys, steps, eos_id, with_logprobs)
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
@@ -384,6 +399,7 @@ class ServingEngine:
         self._slot_req: list[Request | None] = [None] * self.n_slots
         self._queue: deque[Request] = deque()
         self._results: dict[int, np.ndarray] = {}
+        self._logprob_results: dict[int, np.ndarray] = {}
         self._rid = itertools.count()
         self._prefixes: dict[int, dict] = {}
         self._prefix_id = itertools.count()
@@ -479,7 +495,7 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: int,
                prefix_id: int | None = None, *, temperature: float = 0.0,
                seed: int | None = None, adapter: str | None = None,
-               on_token=None) -> int:
+               on_token=None, logprobs: bool = False) -> int:
         """Queue a prompt (sequence of int token ids); returns request id.
         With `prefix_id`, `prompt` is the SUFFIX after that registered
         prefix (may be empty — the prefix alone is the prompt).
@@ -530,7 +546,8 @@ class ServingEngine:
         rid = next(self._rid)
         self._queue.append(
             Request(rid, prompt, int(max_new_tokens), prefix_id,
-                    float(temperature), seed, adapter, on_token)
+                    float(temperature), seed, adapter, on_token,
+                    bool(logprobs))
         )
         return rid
 
@@ -580,15 +597,24 @@ class ServingEngine:
 
     def _pick_first(self, req: Request, last_logits, prompt_end: int) -> int:
         """First generated token from admission logits: greedy, or sampled
-        with the same fold_in(key, position) stream the burst continues."""
+        with the same fold_in(key, position) stream the burst continues.
+        Records the token's model log-prob when the request asked for
+        logprobs."""
+        last_logits = jnp.asarray(last_logits)
         if req.temperature <= 0:
             # Device-side argmax: a greedy admission moves one scalar to
             # host, never the vocab-wide logits row.
-            return int(jnp.argmax(jnp.asarray(last_logits)))
-        sub = jax.random.fold_in(self._req_key(req), prompt_end)
-        return int(jax.random.categorical(
-            sub, jnp.asarray(last_logits) / req.temperature
-        ))
+            tok = int(jnp.argmax(last_logits))
+        else:
+            sub = jax.random.fold_in(self._req_key(req), prompt_end)
+            tok = int(jax.random.categorical(
+                sub, last_logits / req.temperature
+            ))
+        if req.want_logprobs:
+            req.logprobs.append(
+                float(jax.nn.log_softmax(last_logits)[tok])
+            )
+        return tok
 
     # ---------------------------------------------------------- scheduling
 
@@ -597,7 +623,7 @@ class ServingEngine:
         for i in range(self.n_slots):
             req = self._slot_req[i]
             if req is not None and not active_np[i]:
-                self._results[req.rid] = np.asarray(req.generated, np.int32)
+                self._record_result(req)
                 self._slot_req[i] = None
                 self._on_retire(i)
 
@@ -641,6 +667,14 @@ class ServingEngine:
             )
         return self._pick_first(req, last_logits, n), n
 
+    def _record_result(self, req: Request) -> None:
+        """THE one place a finished/cancelled request's channels land."""
+        self._results[req.rid] = np.asarray(req.generated, np.int32)
+        if req.want_logprobs:
+            self._logprob_results[req.rid] = np.asarray(
+                req.logprobs, np.float32
+            )
+
     def _on_retire(self, i: int) -> None:
         """Hook: slot i's request just finished (paged engine frees its
         blocks here)."""
@@ -664,9 +698,7 @@ class ServingEngine:
                     self.eos_id is not None and first == self.eos_id
                 )
                 if done:
-                    self._results[req.rid] = np.asarray(
-                        req.generated, np.int32
-                    )
+                    self._record_result(req)
                     # The slot was never occupied, but _install may have
                     # claimed per-slot resources (the paged engine's block
                     # reservation) — release them.
@@ -698,9 +730,14 @@ class ServingEngine:
         self._admit_waiting()
         if not bool(np.asarray(self.active).any()):
             return
-        toks, emitted = self._run_burst()
+        want_lp = any(
+            r is not None and r.want_logprobs for r in self._slot_req
+        )
+        toks, emitted, lps = self._run_burst(want_lp)
         toks = np.asarray(toks)
         emitted = np.asarray(emitted)
+        if want_lp:
+            lps = np.asarray(lps)
         # Two phases: record EVERY slot's tokens, then fire callbacks — a
         # raising callback must never cost another request (or a later
         # chunk of its own request) its recorded tokens.
@@ -711,6 +748,8 @@ class ServingEngine:
                 continue
             new = toks[emitted[:, i], i].tolist()
             req.generated.extend(new)
+            if req.want_logprobs:
+                req.logprobs.extend(lps[emitted[:, i], i].tolist())
             if req.on_token is not None and new:
                 fired.append((req.on_token, new))
         first_exc = None
@@ -723,15 +762,21 @@ class ServingEngine:
         if first_exc is not None:
             raise first_exc
 
-    def _run_burst(self):
+    def _run_burst(self, with_logprobs: bool = False):
         (self.cache, self.pos, self.last_tok, self.remaining, self.active,
-         toks, emitted) = _decode_burst(
+         toks, emitted, lps) = _decode_burst(
             self._params_for(self._slot_adapter), self.cache, self.pos,
             self.last_tok,
             self.remaining, self.active, self.temp, self.keys, self.cfg,
-            self.steps_per_sync, self.eos_id,
+            self.steps_per_sync, self.eos_id, with_logprobs,
         )
-        return toks, emitted
+        return toks, emitted, lps
+
+    def take_logprobs(self, rid: int):
+        """Pop the finished request's per-token model log-probs (aligned
+        1:1 with its result tokens). None unless it was submitted with
+        logprobs=True and has finished."""
+        return self._logprob_results.pop(rid, None)
 
     def cancel(self, rid: int) -> bool:
         """Cancel a request: queued requests are dropped, active ones stop
@@ -741,7 +786,7 @@ class ServingEngine:
         for idx, req in enumerate(self._queue):
             if req.rid == rid:
                 del self._queue[idx]
-                self._results[rid] = np.asarray(req.generated, np.int32)
+                self._record_result(req)
                 return True
         for i in range(self.n_slots):
             req = self._slot_req[i]
@@ -774,4 +819,10 @@ class ServingEngine:
             self.step()
         self._retire()
         out, self._results = self._results, {}
+        # Unclaimed logprobs from EARLIER drains would pile up forever in a
+        # long-lived engine: keep only the batch being returned (poppable
+        # via take_logprobs until the next run() returns).
+        self._logprob_results = {
+            r: v for r, v in self._logprob_results.items() if r in out
+        }
         return out

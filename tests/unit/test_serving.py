@@ -371,3 +371,34 @@ def test_cancel_queued_and_active(model):
     res2 = eng.run()
     np.testing.assert_array_equal(res2[r_next], _reference(params, cfg, [17], 4))
     assert eng.cancel(r_next) is False  # already finished
+
+
+def test_logprobs_match_teacher_forcing(model):
+    """Per-token logprobs (greedy and sampled rows) must equal a teacher-
+    forced forward's log_softmax at each generated position."""
+    from bee_code_interpreter_fs_tpu.models.llama import forward
+
+    params, cfg = model
+    eng = ServingEngine(params, cfg, n_slots=2, max_len=64, steps_per_sync=3)
+    cases = {
+        eng.submit([4, 9, 2], 7, logprobs=True): ([4, 9, 2], 0.0),
+        eng.submit([11, 5], 6, temperature=1.1, seed=3, logprobs=True):
+            ([11, 5], 1.1),
+        eng.submit([8], 5): ([8], 0.0),  # no logprobs requested
+    }
+    res = eng.run()
+    for rid, (prompt, _temp) in cases.items():
+        lps = eng.take_logprobs(rid)
+        toks = res[rid]
+        if len(prompt) == 1:
+            assert lps is None
+            continue
+        assert lps is not None and lps.shape == toks.shape
+        full = jnp.asarray([prompt + toks.tolist()], jnp.int32)
+        ref_lp = jax.nn.log_softmax(
+            forward(params, full[:, :-1], cfg).astype(jnp.float32), axis=-1
+        )
+        for i, t in enumerate(toks.tolist()):
+            want = float(ref_lp[0, len(prompt) - 1 + i, t])
+            assert abs(float(lps[i]) - want) < 1e-4, (i, lps[i], want)
+        assert eng.take_logprobs(rid) is None  # popped
