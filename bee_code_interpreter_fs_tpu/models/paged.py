@@ -54,6 +54,8 @@ from bee_code_interpreter_fs_tpu.models.serving import (
     ServingEngine,
     _burst_scan,
     _chunked_scratch_prefill,
+    _prefill_scratch,
+    _prefill_scratch_prefixed,
 )
 
 __all__ = ["PagedServingEngine"]
@@ -125,30 +127,6 @@ def _decode_burst_paged(params, pool, tables, pos, last_tok, remaining,
                        temp, keys, steps, eos_id, with_logprobs)
 
 
-@partial(jax.jit, static_argnames=("cfg", "pad_to"))
-def _prefill_scratch(params, tokens, true_len, cfg: LlamaConfig, pad_to: int):
-    """Prefill a bucketed prompt into a BLOCK-ALIGNED contiguous scratch
-    ([L, 1, pad_to, ...]); returns (last_logits, scratch kv)."""
-    scratch = init_cache(cfg, 1, pad_to)
-    logits_all, scratch = decode_chunk(params, tokens, scratch, 0, cfg)
-    return logits_all[0, true_len - 1], scratch
-
-
-@partial(jax.jit, static_argnames=("cfg", "pad_to"))
-def _prefill_scratch_prefixed(params, pk, pv, tokens, true_len,
-                              cfg: LlamaConfig, pad_to: int):
-    """Prefix-cached variant: install the prefix K/V then chunk-prefill the
-    suffix at rope offset plen, all in one block-aligned scratch."""
-    plen = pk.shape[2]
-    scratch = init_cache(cfg, 1, pad_to)
-    scratch = {
-        "k": lax.dynamic_update_slice(scratch["k"], pk, (0, 0, 0, 0, 0)),
-        "v": lax.dynamic_update_slice(scratch["v"], pv, (0, 0, 0, 0, 0)),
-    }
-    logits_all, scratch = decode_chunk(params, tokens, scratch, plen, cfg)
-    return logits_all[0, true_len - 1], scratch
-
-
 @partial(jax.jit, donate_argnames=("pool",))
 def _pool_install(pool, kv, blk_ids):
     """Scatter a block-aligned scratch ([L, 1, nb*bs, ...]) into the pool
@@ -188,6 +166,11 @@ class PagedServingEngine(ServingEngine):
         super().__init__(params, cfg, **kwargs)
 
     def _init_device_state(self):
+        if self.kv_quant:
+            raise NotImplementedError(
+                "kv_quant is implemented for the dense ServingEngine; the "
+                "paged pool stores full-precision K/V"
+            )
         bs = self.block_size
         self.max_blocks = -(-self.max_len // bs)
         n_blocks = (

@@ -36,17 +36,21 @@ import jax.numpy as jnp
 QUANTIZED_LAYER_WEIGHTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
-def quantize_int8(w) -> dict:
-    """Symmetric per-output-channel int8: w ≈ q * s (see module docstring).
+def _sym_int8(x, axis: int):
+    """The ONE symmetric-int8 recipe (f32 scale math — in bf16 the division
+    near q=±127 can land a full level off and the scale itself carries
+    ~0.4% rounding, breaking the |error| <= s/2 bound). Returns (q, s)."""
+    x32 = x.astype(jnp.float32)
+    s = jnp.max(jnp.abs(x32), axis=axis, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-12)  # all-zero vectors must not divide by zero
+    q = jnp.clip(jnp.round(x32 / s), -127, 127).astype(jnp.int8)
+    return q, s
 
-    The scale/divide/round math runs in float32 regardless of the weight's
-    dtype: in bf16 (the model default) the division near q=±127 can land a
-    full level off and the scale itself carries ~0.4% rounding, breaking
-    the |error| <= s/2 bound the scheme promises."""
-    w32 = w.astype(jnp.float32)
-    s = jnp.max(jnp.abs(w32), axis=-2, keepdims=True) / 127.0
-    s = jnp.maximum(s, 1e-12)  # all-zero channels must not divide by zero
-    q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
+
+def quantize_int8(w) -> dict:
+    """Symmetric per-output-channel int8: w ≈ q * s (see module
+    docstring); axis=-2 is the contraction dim of every matmul weight."""
+    q, s = _sym_int8(w, axis=-2)
     return {"q": q, "s": s}
 
 
@@ -218,3 +222,22 @@ def quantized_nbytes(params) -> int:
         else:
             total += leaf.nbytes
     return total
+
+
+# ---------------------------------------------------------- KV-cache int8
+
+def quantize_kv(x):
+    """Symmetric per-vector int8 for K/V cache entries: one f32 scale per
+    trailing head_dim vector (the granularity a decode write produces).
+    Halves the KV cache's HBM residency and read traffic — the decode-step
+    bandwidth term that GROWS with context length, complementing
+    weight-only quantization's fixed term. Returns (q int8 [...], s f32
+    [..., 1])."""
+    return _sym_int8(x, axis=-1)
+
+
+def dequantize_kv(q, s, dtype):
+    """`q * s` in the compute dtype (mirrors `dequantize`) — call at the
+    attention read site so XLA fuses the dequantize into the contraction
+    operand path and HBM serves 1 byte/element + scales."""
+    return q.astype(dtype) * s.astype(dtype)

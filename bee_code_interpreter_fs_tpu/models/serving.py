@@ -48,6 +48,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from bee_code_interpreter_fs_tpu.models.quant import (
+    dequantize_kv,
+    quantize_kv,
+)
 from bee_code_interpreter_fs_tpu.models.llama import (
     LlamaConfig,
     _cached_gqa_attention,
@@ -91,34 +95,65 @@ def _perslot_decode_step(params, tokens, cache, pos, cfg: LlamaConfig):
     """
     dt = jnp.dtype(cfg.dtype)
     scale = cfg.head_dim ** -0.5
-    max_len = cache["k"].shape[2]
+    quant = "kq" in cache  # int8 KV cache (engine kv_quant=True)
+    max_len = (cache["kq"] if quant else cache["k"]).shape[2]
     # Slot i sees cache positions <= pos[i] (its own prefix + itself);
     # broadcast the [b, max] mask over [b, g, r, t, k].
     valid = decode_valid_mask(pos, max_len, cfg)[:, None, None, None, :]
     x = params["embed"].astype(dt)[tokens]
     bidx = jnp.arange(tokens.shape[0])
 
+    # One layer body for both cache formats: only the row write and the
+    # K/V handed to attention differ, captured by write_read below — the
+    # frontier-scatter / rope / mask logic exists exactly once.
+    if quant:
+        cache_keys = ("kq", "ks", "vq", "vs")
+
+        def write_read(cs, k, v):
+            ckq, cks, cvq, cvs = cs
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            new = (
+                ckq.at[bidx, pos].set(kq),
+                cks.at[bidx, pos].set(ks),
+                cvq.at[bidx, pos].set(vq),
+                cvs.at[bidx, pos].set(vs),
+            )
+            # Dequantize AT THE READ: HBM streams int8 + scales; the
+            # multiply fuses into the attention contraction.
+            return new, dequantize_kv(new[0], new[1], dt), dequantize_kv(
+                new[2], new[3], dt
+            )
+    else:
+        cache_keys = ("k", "v")
+
+        def write_read(cs, k, v):
+            ck, cv = cs
+            # Per-slot scatter at each slot's own frontier (the [b] pos
+            # vector rules out one dynamic_update_slice for the batch).
+            new = (ck.at[bidx, pos].set(k), cv.at[bidx, pos].set(v))
+            return new, new[0], new[1]
+
     def layer(x, inputs):
-        lp, ck, cv = inputs
+        lp = inputs[0]
+        cs = inputs[1:]
         cell = {}
 
         def attn_fn(q, k, v):
-            # Per-slot scatter at each slot's own frontier (the [b] pos
-            # vector rules out one dynamic_update_slice for the batch).
-            nk = ck.at[bidx, pos].set(k[:, 0])
-            nv = cv.at[bidx, pos].set(v[:, 0])
-            cell["kv"] = (nk, nv)
-            return _cached_gqa_attention(q, nk, nv, valid, scale)
+            new, keys, vals = write_read(cs, k[:, 0], v[:, 0])
+            cell["kv"] = new
+            return _cached_gqa_attention(q, keys, vals, valid, scale)
 
         x = transformer_block(x, lp, cfg, attn_fn, rope_offset=pos)
         return x, cell["kv"]
 
-    x, (new_k, new_v) = lax.scan(
-        layer, x, (params["layers"], cache["k"], cache["v"])
+    x, new_leaves = lax.scan(
+        layer, x, (params["layers"],) + tuple(cache[k] for k in cache_keys)
     )
+    new_cache = dict(zip(cache_keys, new_leaves))
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ _w(params["lm_head"], dt)).astype(jnp.float32)
-    return logits, {"k": new_k, "v": new_v}
+    return logits, new_cache
 
 
 def _sample_next(logits, temp, keys, pos):
@@ -236,6 +271,30 @@ def _admit(params, cache, tokens, slot, true_len, cfg: LlamaConfig):
     return {"k": new_k, "v": new_v}, last_logits
 
 
+@partial(jax.jit, static_argnames=("cfg", "pad_to"))
+def _prefill_scratch(params, tokens, true_len, cfg: LlamaConfig, pad_to: int):
+    """Prefill a bucketed prompt into a BLOCK-ALIGNED contiguous scratch
+    ([L, 1, pad_to, ...]); returns (last_logits, scratch kv)."""
+    scratch = init_cache(cfg, 1, pad_to)
+    logits_all, scratch = decode_chunk(params, tokens, scratch, 0, cfg)
+    return logits_all[0, true_len - 1], scratch
+
+
+@partial(jax.jit, static_argnames=("cfg", "pad_to"))
+def _prefill_scratch_prefixed(params, pk, pv, tokens, true_len,
+                              cfg: LlamaConfig, pad_to: int):
+    """Prefix-cached variant: install the prefix K/V then chunk-prefill the
+    suffix at rope offset plen, all in one block-aligned scratch."""
+    plen = pk.shape[2]
+    scratch = init_cache(cfg, 1, pad_to)
+    scratch = {
+        "k": lax.dynamic_update_slice(scratch["k"], pk, (0, 0, 0, 0, 0)),
+        "v": lax.dynamic_update_slice(scratch["v"], pv, (0, 0, 0, 0, 0)),
+    }
+    logits_all, scratch = decode_chunk(params, tokens, scratch, plen, cfg)
+    return logits_all[0, true_len - 1], scratch
+
+
 @partial(jax.jit, static_argnames=("cfg", "chunk"))
 def _chunked_scratch_prefill(params, tokens, true_len, cfg: LlamaConfig,
                              chunk: int):
@@ -269,6 +328,22 @@ def _chunked_scratch_prefill(params, tokens, true_len, cfg: LlamaConfig,
         jnp.arange(n_chunks),
     )
     return last_logits, scratch
+
+
+@partial(jax.jit, donate_argnames=("cache",))
+def _install_row_quant(cache, scratch, slot):
+    """Quantize a DENSE prefill scratch and install it into an int8 KV
+    cache row: prompts prefill at full precision (exact logits for the
+    first token), and only the stored cache pays the quantization."""
+    kq, ks = quantize_kv(scratch["k"])
+    vq, vs = quantize_kv(scratch["v"])
+    at = (0, slot, 0, 0, 0)
+    return {
+        "kq": lax.dynamic_update_slice(cache["kq"], kq, at),
+        "ks": lax.dynamic_update_slice(cache["ks"], ks, at),
+        "vq": lax.dynamic_update_slice(cache["vq"], vq, at),
+        "vs": lax.dynamic_update_slice(cache["vs"], vs, at),
+    }
 
 
 @partial(jax.jit, donate_argnames=("cache",))
@@ -345,7 +420,8 @@ class ServingEngine:
                  max_len: int | None = None, steps_per_sync: int = 8,
                  prefill_buckets: tuple = (), eos_id: int | None = None,
                  seed: int = 0, adapters: dict | None = None,
-                 lora_alpha: float = 16.0, prefill_chunk: int | None = None):
+                 lora_alpha: float = 16.0, prefill_chunk: int | None = None,
+                 kv_quant: bool = False):
         """`adapters`: {name: lora tree (models/lora.init_lora shape)} —
         multi-tenant adapter serving. Every request picks one by name (or
         None for the bare base model); one resident base plus one stacked
@@ -357,6 +433,7 @@ class ServingEngine:
         self.max_len = int(max_len or cfg.max_seq_len)
         self.steps_per_sync = int(steps_per_sync)
         self.eos_id = eos_id
+        self.kv_quant = bool(kv_quant)
         self.prefill_chunk = int(prefill_chunk) if prefill_chunk else None
         if self.prefill_chunk is not None and not (
             1 <= self.prefill_chunk < self.max_len
@@ -439,9 +516,22 @@ class ServingEngine:
 
     def _init_device_state(self):
         """Device-side KV state. The base engine holds one dense
-        [n_slots, max_len] cache; PagedServingEngine overrides with a
-        block pool + tables."""
-        self.cache = init_cache(self.cfg, self.n_slots, self.max_len)
+        [n_slots, max_len] cache — int8-quantized per head-dim vector when
+        kv_quant is on (the context-length-proportional HBM term halves);
+        PagedServingEngine overrides with a block pool + tables."""
+        if self.kv_quant:
+            cfg = self.cfg
+            shape = (cfg.n_layers, self.n_slots, self.max_len,
+                     cfg.n_kv_heads, cfg.head_dim)
+            sshape = shape[:-1] + (1,)
+            self.cache = {
+                "kq": jnp.zeros(shape, jnp.int8),
+                "ks": jnp.zeros(sshape, jnp.float32),
+                "vq": jnp.zeros(shape, jnp.int8),
+                "vs": jnp.zeros(sshape, jnp.float32),
+            }
+        else:
+            self.cache = init_cache(self.cfg, self.n_slots, self.max_len)
 
     # ------------------------------------------------------------- intake
 
@@ -633,22 +723,39 @@ class ServingEngine:
         request right now (paged engine out of blocks) — the caller
         requeues it and stops admitting."""
         n = req.prompt.size
+        install = _install_row_quant if self.kv_quant else _install_row
         if req.prefix_id is not None:
             pf = self._prefixes[req.prefix_id]
             plen = pf["len"]
             if n == 0:
-                self.cache = _admit_prefix_only(
-                    self.cache, pf["k"], pf["v"], jnp.int32(i)
-                )
+                if self.kv_quant:
+                    # Prefixes are stored dense (exact); the cache copy is
+                    # where quantization happens.
+                    self.cache = _install_row_quant(
+                        self.cache, {"k": pf["k"], "v": pf["v"]},
+                        jnp.int32(i),
+                    )
+                else:
+                    self.cache = _admit_prefix_only(
+                        self.cache, pf["k"], pf["v"], jnp.int32(i)
+                    )
                 first = self._pick_first(req, pf["last_logits"], plen)
             else:
                 bl = self._suffix_bucket(plen, n)
                 padded = self._padded_prompt(req.prompt, bl)
-                self.cache, last_logits = _admit_prefixed(
-                    self._req_params(req), self.cache, pf["k"], pf["v"],
-                    jnp.asarray(padded), jnp.int32(i), jnp.int32(n),
-                    self.cfg,
-                )
+                if self.kv_quant:
+                    last_logits, scratch = _prefill_scratch_prefixed(
+                        self._req_params(req), pf["k"], pf["v"],
+                        jnp.asarray(padded), jnp.int32(n), self.cfg,
+                        plen + bl,
+                    )
+                    self.cache = install(self.cache, scratch, jnp.int32(i))
+                else:
+                    self.cache, last_logits = _admit_prefixed(
+                        self._req_params(req), self.cache, pf["k"], pf["v"],
+                        jnp.asarray(padded), jnp.int32(i), jnp.int32(n),
+                        self.cfg,
+                    )
                 first = self._pick_first(req, last_logits, plen + n)
             return first, plen + n
         bl = self._bucket_len(n)
@@ -659,7 +766,13 @@ class ServingEngine:
                 self._req_params(req), jnp.asarray(padded), jnp.int32(n),
                 self.cfg, self.prefill_chunk,
             )
-            self.cache = _install_row(self.cache, scratch, jnp.int32(i))
+            self.cache = install(self.cache, scratch, jnp.int32(i))
+        elif self.kv_quant:
+            last_logits, scratch = _prefill_scratch(
+                self._req_params(req), jnp.asarray(padded), jnp.int32(n),
+                self.cfg, bl,
+            )
+            self.cache = install(self.cache, scratch, jnp.int32(i))
         else:
             self.cache, last_logits = _admit(
                 self._req_params(req), self.cache, jnp.asarray(padded),

@@ -161,3 +161,25 @@ def test_cancel_frees_blocks(model):
     assert eng.free_blocks == total
     res = eng.run()
     assert res[rid].size >= 1
+
+
+def test_paged_logprobs_match_dense(model):
+    """The paged burst's logprob lane (with_logprobs static variant, the
+    8-tuple return through the inherited step()) must agree with the dense
+    engine's on identical traffic."""
+    params, cfg = model
+
+    def drive(cls, **kw):
+        eng = cls(params, cfg, n_slots=2, max_len=64, steps_per_sync=3, **kw)
+        rid = eng.submit([4, 9, 2], 7, logprobs=True)
+        rs = eng.submit([11, 5], 6, temperature=1.1, seed=3, logprobs=True)
+        res = eng.run()
+        return (res[rid], eng.take_logprobs(rid),
+                res[rs], eng.take_logprobs(rs))
+
+    dt, dlp, dst, dslp = drive(ServingEngine)
+    pt, plp, pst, pslp = drive(PagedServingEngine, block_size=8)
+    np.testing.assert_array_equal(dt, pt)
+    np.testing.assert_allclose(dlp, plp, atol=1e-4)
+    np.testing.assert_array_equal(dst, pst)
+    np.testing.assert_allclose(dslp, pslp, atol=1e-4)
