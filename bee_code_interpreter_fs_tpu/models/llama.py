@@ -945,6 +945,22 @@ def greedy_generate(params, prompt_tokens, cfg: LlamaConfig, *,
     return jnp.concatenate([prompt_tokens, new_tokens.T], axis=1)
 
 
+def nucleus_mask(scaled, top_p):
+    """Top-p (nucleus) truncation: keep the smallest logit-sorted prefix
+    whose cumulative probability reaches top_p. A token survives when the
+    mass STRICTLY BEFORE it is < top_p — this always keeps the argmax and
+    includes the token that crosses the threshold. `top_p` broadcasts
+    against the leading dims (a scalar, or [b] -> pass [b, 1]); 1.0 masks
+    nothing bit-exactly. The ONE nucleus rule — sample_generate and both
+    serving engines share it."""
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    mass_before = jnp.cumsum(probs, axis=-1) - probs
+    kept = jnp.where(mass_before < top_p, sorted_desc, jnp.inf)
+    cutoff = jnp.min(kept, axis=-1, keepdims=True)
+    return jnp.where(scaled < cutoff, NEG_INF_LOGIT, scaled)
+
+
 def sample_generate(params, prompt_tokens, key, cfg: LlamaConfig, *,
                     max_new_tokens: int, temperature=1.0, top_k: int = 0,
                     top_p=None, max_len: int | None = None, eos_id=None):
@@ -989,18 +1005,7 @@ def _sample_generate_jit(params, prompt_tokens, key, cfg: LlamaConfig, *,
             kth = lax.top_k(scaled, top_k)[0][..., -1:]
             scaled = jnp.where(scaled < kth, NEG_INF_LOGIT, scaled)
         if top_p is not None:
-            # Nucleus: keep the smallest logit-sorted prefix whose
-            # cumulative probability reaches top_p. A token survives when
-            # the mass STRICTLY BEFORE it is < top_p — this always keeps
-            # the argmax and includes the token that crosses the
-            # threshold. One sort over the vocab per step; the scan keeps
-            # it on-device like everything else.
-            sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
-            probs = jax.nn.softmax(sorted_desc, axis=-1)
-            mass_before = jnp.cumsum(probs, axis=-1) - probs
-            kept = jnp.where(mass_before < top_p, sorted_desc, jnp.inf)
-            cutoff = jnp.min(kept, axis=-1, keepdims=True)
-            scaled = jnp.where(scaled < cutoff, NEG_INF_LOGIT, scaled)
+            scaled = nucleus_mask(scaled, top_p)
         return jax.random.categorical(step_key, scaled).astype(jnp.int32)
 
     def body(carry, step_key):

@@ -60,6 +60,7 @@ from bee_code_interpreter_fs_tpu.models.llama import (
     decode_chunk,
     decode_valid_mask,
     init_cache,
+    nucleus_mask,
     prefill,
     transformer_block,
 )
@@ -80,6 +81,7 @@ class Request:
     adapter: str | None = None  # multi-LoRA adapter name (None = base)
     on_token: object = None  # callable(list[int]) | None — streaming sink
     want_logprobs: bool = False
+    top_p: float = 1.0  # nucleus truncation (1.0 = off)
     generated: list = field(default_factory=list)
     logprobs: list = field(default_factory=list)
 
@@ -156,20 +158,25 @@ def _perslot_decode_step(params, tokens, cache, pos, cfg: LlamaConfig):
     return logits, new_cache
 
 
-def _sample_next(logits, temp, keys, pos):
+def _sample_next(logits, temp, keys, pos, top_p=None):
     """Next token per slot: greedy where temp == 0, else a categorical draw
     whose key is fold_in(slot key, the sampled token's position) — the ONE
     definition of the engine's sampling stream (the paged engine's burst
-    uses it too, so both engines are stream-identical)."""
+    uses it too, so both engines are stream-identical). `top_p` ([b] or
+    None — a STATIC distinction, compiled separately) truncates to the
+    nucleus before drawing."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     subkeys = jax.vmap(jax.random.fold_in)(keys, pos + 1)
     scaled = logits / jnp.where(temp > 0, temp, 1.0)[:, None]
+    if top_p is not None:
+        scaled = nucleus_mask(scaled, top_p[:, None])
     sampled = jax.vmap(jax.random.categorical)(subkeys, scaled)
     return jnp.where(temp > 0, sampled.astype(jnp.int32), greedy)
 
 
 def _burst_scan(step_fn, store, pos, last_tok, remaining, active, temp,
-                keys, steps: int, eos_id, with_logprobs: bool):
+                keys, steps: int, eos_id, with_logprobs: bool,
+                top_p=None):
     """The ONE burst loop body both engines run: step_fn produces logits and
     the updated KV store; everything else — the sampling stream, emit
     bookkeeping, budget/EOS masking — lives here so the dense and paged
@@ -178,7 +185,7 @@ def _burst_scan(step_fn, store, pos, last_tok, remaining, active, temp,
     def one(carry, _):
         store, pos, tok, remaining, active = carry
         logits, store = step_fn(store, tok[:, None], pos, active)
-        nxt = _sample_next(logits, temp, keys, pos)
+        nxt = _sample_next(logits, temp, keys, pos, top_p)
         if with_logprobs:
             # Chosen-token log-prob under the RAW model distribution (the
             # OpenAI-style convention: temperature shapes sampling, not
@@ -206,11 +213,12 @@ def _burst_scan(step_fn, store, pos, last_tok, remaining, active, temp,
 
 
 @partial(jax.jit,
-         static_argnames=("cfg", "steps", "eos_id", "with_logprobs"),
+         static_argnames=("cfg", "steps", "eos_id", "with_logprobs",
+                          "with_top_p"),
          donate_argnames=("cache",))
 def _decode_burst(params, cache, pos, last_tok, remaining, active,
-                  temp, keys, cfg: LlamaConfig, steps: int, eos_id,
-                  with_logprobs: bool = False):
+                  temp, keys, top_p, cfg: LlamaConfig, steps: int, eos_id,
+                  with_logprobs: bool = False, with_top_p: bool = False):
     """`steps` continuous-batching decode steps as ONE compiled program.
 
     Carry per slot: position, last emitted token, remaining token budget,
@@ -236,7 +244,8 @@ def _decode_burst(params, cache, pos, last_tok, remaining, active,
         return _perslot_decode_step(params, tokens, cache, pos, cfg)
 
     return _burst_scan(step_fn, cache, pos, last_tok, remaining, active,
-                       temp, keys, steps, eos_id, with_logprobs)
+                       temp, keys, steps, eos_id, with_logprobs,
+                       top_p if with_top_p else None)
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
@@ -481,6 +490,7 @@ class ServingEngine:
         self._prefixes: dict[int, dict] = {}
         self._prefix_id = itertools.count()
         self.temp = jnp.zeros((self.n_slots,), jnp.float32)
+        self.top_p = jnp.ones((self.n_slots,), jnp.float32)
         self.keys = jnp.zeros((self.n_slots, 2), jnp.uint32)
         self._base_seed = int(seed)
         self._lora_alpha = float(lora_alpha)
@@ -585,7 +595,8 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: int,
                prefix_id: int | None = None, *, temperature: float = 0.0,
                seed: int | None = None, adapter: str | None = None,
-               on_token=None, logprobs: bool = False) -> int:
+               on_token=None, logprobs: bool = False,
+               top_p: float = 1.0) -> int:
         """Queue a prompt (sequence of int token ids); returns request id.
         With `prefix_id`, `prompt` is the SUFFIX after that registered
         prefix (may be empty — the prefix alone is the prompt).
@@ -605,6 +616,8 @@ class ServingEngine:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if temperature < 0:
             raise ValueError("temperature must be >= 0")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if adapter is not None and adapter not in self._adapter_idx:
             raise ValueError(f"unknown adapter {adapter!r}")
         plen = 0
@@ -637,7 +650,7 @@ class ServingEngine:
         self._queue.append(
             Request(rid, prompt, int(max_new_tokens), prefix_id,
                     float(temperature), seed, adapter, on_token,
-                    bool(logprobs))
+                    bool(logprobs), float(top_p))
         )
         return rid
 
@@ -697,9 +710,10 @@ class ServingEngine:
             tok = int(jnp.argmax(last_logits))
         else:
             sub = jax.random.fold_in(self._req_key(req), prompt_end)
-            tok = int(jax.random.categorical(
-                sub, last_logits / req.temperature
-            ))
+            scaled = last_logits / req.temperature
+            if req.top_p < 1.0:
+                scaled = nucleus_mask(scaled[None, :], req.top_p)[0]
+            tok = int(jax.random.categorical(sub, scaled))
         if req.want_logprobs:
             req.logprobs.append(
                 float(jax.nn.log_softmax(last_logits)[tok])
@@ -823,6 +837,7 @@ class ServingEngine:
                 self._slot_adapter[i] = self._adapter_idx[req.adapter]
                 self.pos = self.pos.at[i].set(prompt_end)
                 self.temp = self.temp.at[i].set(req.temperature)
+                self.top_p = self.top_p.at[i].set(req.top_p)
                 self.keys = self.keys.at[i].set(
                     jnp.asarray(self._req_key(req), jnp.uint32)
                 )
@@ -846,7 +861,11 @@ class ServingEngine:
         want_lp = any(
             r is not None and r.want_logprobs for r in self._slot_req
         )
-        toks, emitted, lps = self._run_burst(want_lp)
+        want_tp = any(
+            r is not None and r.top_p < 1.0 and r.temperature > 0
+            for r in self._slot_req
+        )
+        toks, emitted, lps = self._run_burst(want_lp, want_tp)
         toks = np.asarray(toks)
         emitted = np.asarray(emitted)
         if want_lp:
@@ -875,13 +894,15 @@ class ServingEngine:
         if first_exc is not None:
             raise first_exc
 
-    def _run_burst(self, with_logprobs: bool = False):
+    def _run_burst(self, with_logprobs: bool = False,
+                   with_top_p: bool = False):
         (self.cache, self.pos, self.last_tok, self.remaining, self.active,
          toks, emitted, lps) = _decode_burst(
             self._params_for(self._slot_adapter), self.cache, self.pos,
             self.last_tok,
-            self.remaining, self.active, self.temp, self.keys, self.cfg,
-            self.steps_per_sync, self.eos_id, with_logprobs,
+            self.remaining, self.active, self.temp, self.keys, self.top_p,
+            self.cfg, self.steps_per_sync, self.eos_id, with_logprobs,
+            with_top_p,
         )
         return toks, emitted, lps
 
