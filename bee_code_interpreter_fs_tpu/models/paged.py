@@ -109,12 +109,14 @@ def _perslot_decode_step_paged(params, tokens, pool, tables, pos, active,
 
 @partial(jax.jit,
          static_argnames=("cfg", "steps", "eos_id", "with_logprobs",
-                          "with_top_p"),
+                          "with_top_p", "with_penalties"),
          donate_argnames=("pool",))
 def _decode_burst_paged(params, pool, tables, pos, last_tok, remaining,
-                        active, temp, keys, top_p, cfg: LlamaConfig,
+                        active, temp, keys, top_p, presence, frequency,
+                        counts, cfg: LlamaConfig,
                         steps: int, eos_id, with_logprobs: bool = False,
-                        with_top_p: bool = False):
+                        with_top_p: bool = False,
+                        with_penalties: bool = False):
     """The paged twin of serving._decode_burst: same carry, same sampling
     stream, decode steps against the block pool (tables are constant for a
     burst — reservation admission pre-allocates every block a request can
@@ -127,7 +129,9 @@ def _decode_burst_paged(params, pool, tables, pos, last_tok, remaining,
 
     return _burst_scan(step_fn, pool, pos, last_tok, remaining, active,
                        temp, keys, steps, eos_id, with_logprobs,
-                       top_p if with_top_p else None)
+                       top_p if with_top_p else None,
+                       (presence, frequency, counts) if with_penalties
+                       else None)
 
 
 @partial(jax.jit, donate_argnames=("pool",))
@@ -313,13 +317,18 @@ class PagedServingEngine(ServingEngine):
     # -------------------------------------------------------------- burst
 
     def _run_burst(self, with_logprobs: bool = False,
-                   with_top_p: bool = False):
+                   with_top_p: bool = False,
+                   with_penalties: bool = False):
         (self.pool, self.pos, self.last_tok, self.remaining, self.active,
-         toks, emitted, lps) = _decode_burst_paged(
+         toks, emitted, lps, counts) = _decode_burst_paged(
             self._params_for(self._slot_adapter), self.pool, self.tables,
             self.pos, self.last_tok,
             self.remaining, self.active, self.temp, self.keys, self.top_p,
+            self.presence, self.frequency,
+            self.counts if self.counts is not None else self._counts_dummy,
             self.cfg, self.steps_per_sync, self.eos_id, with_logprobs,
-            with_top_p,
+            with_top_p, with_penalties,
         )
+        if counts is not None:
+            self.counts = counts
         return toks, emitted, lps

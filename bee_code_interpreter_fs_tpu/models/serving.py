@@ -82,6 +82,9 @@ class Request:
     on_token: object = None  # callable(list[int]) | None — streaming sink
     want_logprobs: bool = False
     top_p: float = 1.0  # nucleus truncation (1.0 = off)
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    hist: object = None  # cached text-so-far histogram (penalized reqs)
     generated: list = field(default_factory=list)
     logprobs: list = field(default_factory=list)
 
@@ -176,16 +179,33 @@ def _sample_next(logits, temp, keys, pos, top_p=None):
 
 def _burst_scan(step_fn, store, pos, last_tok, remaining, active, temp,
                 keys, steps: int, eos_id, with_logprobs: bool,
-                top_p=None):
+                top_p=None, penalties=None):
     """The ONE burst loop body both engines run: step_fn produces logits and
     the updated KV store; everything else — the sampling stream, emit
     bookkeeping, budget/EOS masking — lives here so the dense and paged
-    engines cannot drift."""
+    engines cannot drift.
+
+    `penalties` (static None = off): (presence [b], frequency [b],
+    counts [b, vocab] int32) — OpenAI-style repetition control. Penalties
+    shape token CHOICE (greedy argmax included); reported logprobs stay
+    raw-model, like temperature."""
 
     def one(carry, _):
-        store, pos, tok, remaining, active = carry
+        if penalties is None:
+            store, pos, tok, remaining, active = carry
+        else:
+            store, pos, tok, remaining, active, counts = carry
         logits, store = step_fn(store, tok[:, None], pos, active)
-        nxt = _sample_next(logits, temp, keys, pos, top_p)
+        if penalties is None:
+            choice_logits = logits
+        else:
+            presence, frequency = penalties
+            choice_logits = (
+                logits
+                - presence[:, None] * (counts > 0)
+                - frequency[:, None] * counts
+            )
+        nxt = _sample_next(choice_logits, temp, keys, pos, top_p)
         if with_logprobs:
             # Chosen-token log-prob under the RAW model distribution (the
             # OpenAI-style convention: temperature shapes sampling, not
@@ -204,21 +224,36 @@ def _burst_scan(step_fn, store, pos, last_tok, remaining, active, temp,
         active = active & (remaining > 0)
         if eos_id is not None:
             active = active & (tok != eos_id)
-        return (store, pos, tok, remaining, active), (tok, emitted, lp)
+        if penalties is None:
+            return (store, pos, tok, remaining, active), (tok, emitted, lp)
+        counts = counts.at[jnp.arange(tok.shape[0]), tok].add(
+            emitted.astype(jnp.int32)
+        )
+        return (store, pos, tok, remaining, active, counts), (
+            tok, emitted, lp
+        )
 
-    (store, pos, tok, remaining, active), (toks, emitted, lps) = lax.scan(
-        one, (store, pos, last_tok, remaining, active), None, length=steps
-    )
-    return store, pos, tok, remaining, active, toks, emitted, lps
+    if penalties is None:
+        carry0 = (store, pos, last_tok, remaining, active)
+    else:
+        presence, frequency, counts0 = penalties
+        penalties = (presence, frequency)
+        carry0 = (store, pos, last_tok, remaining, active, counts0)
+    carry, (toks, emitted, lps) = lax.scan(one, carry0, None, length=steps)
+    store, pos, tok, remaining, active = carry[:5]
+    counts = carry[5] if len(carry) > 5 else None
+    return store, pos, tok, remaining, active, toks, emitted, lps, counts
 
 
 @partial(jax.jit,
          static_argnames=("cfg", "steps", "eos_id", "with_logprobs",
-                          "with_top_p"),
+                          "with_top_p", "with_penalties"),
          donate_argnames=("cache",))
 def _decode_burst(params, cache, pos, last_tok, remaining, active,
-                  temp, keys, top_p, cfg: LlamaConfig, steps: int, eos_id,
-                  with_logprobs: bool = False, with_top_p: bool = False):
+                  temp, keys, top_p, presence, frequency, counts,
+                  cfg: LlamaConfig, steps: int, eos_id,
+                  with_logprobs: bool = False, with_top_p: bool = False,
+                  with_penalties: bool = False):
     """`steps` continuous-batching decode steps as ONE compiled program.
 
     Carry per slot: position, last emitted token, remaining token budget,
@@ -245,7 +280,9 @@ def _decode_burst(params, cache, pos, last_tok, remaining, active,
 
     return _burst_scan(step_fn, cache, pos, last_tok, remaining, active,
                        temp, keys, steps, eos_id, with_logprobs,
-                       top_p if with_top_p else None)
+                       top_p if with_top_p else None,
+                       (presence, frequency, counts) if with_penalties
+                       else None)
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
@@ -491,6 +528,12 @@ class ServingEngine:
         self._prefix_id = itertools.count()
         self.temp = jnp.zeros((self.n_slots,), jnp.float32)
         self.top_p = jnp.ones((self.n_slots,), jnp.float32)
+        self.presence = jnp.zeros((self.n_slots,), jnp.float32)
+        self.frequency = jnp.zeros((self.n_slots,), jnp.float32)
+        # [n_slots, vocab] i32, allocated lazily at the first penalized
+        # admission — a no-penalty deployment never pays the residency.
+        self.counts = None
+        self._counts_dummy = jnp.zeros((self.n_slots, 1), jnp.int32)
         self.keys = jnp.zeros((self.n_slots, 2), jnp.uint32)
         self._base_seed = int(seed)
         self._lora_alpha = float(lora_alpha)
@@ -589,6 +632,7 @@ class ServingEngine:
             "last_logits": np.asarray(row_logits, np.float32),
             "len": plen,
             "adapter": adapter,
+            "tokens": tokens,
         }
         return pid
 
@@ -596,7 +640,8 @@ class ServingEngine:
                prefix_id: int | None = None, *, temperature: float = 0.0,
                seed: int | None = None, adapter: str | None = None,
                on_token=None, logprobs: bool = False,
-               top_p: float = 1.0) -> int:
+               top_p: float = 1.0, presence_penalty: float = 0.0,
+               frequency_penalty: float = 0.0) -> int:
         """Queue a prompt (sequence of int token ids); returns request id.
         With `prefix_id`, `prompt` is the SUFFIX after that registered
         prefix (may be empty — the prefix alone is the prompt).
@@ -650,7 +695,8 @@ class ServingEngine:
         self._queue.append(
             Request(rid, prompt, int(max_new_tokens), prefix_id,
                     float(temperature), seed, adapter, on_token,
-                    bool(logprobs), float(top_p))
+                    bool(logprobs), float(top_p), float(presence_penalty),
+                    float(frequency_penalty))
         )
         return rid
 
@@ -691,6 +737,15 @@ class ServingEngine:
     def _req_params(self, req: Request) -> dict:
         return self._params_for([self._adapter_idx[req.adapter]])
 
+    def _text_hist(self, req: Request) -> np.ndarray:
+        """Vocab histogram of the request's text so far (prefix + prompt),
+        the penalties' starting state."""
+        hist = np.zeros((self.cfg.vocab_size,), np.int32)
+        if req.prefix_id is not None:
+            np.add.at(hist, self._prefixes[req.prefix_id]["tokens"], 1)
+        np.add.at(hist, req.prompt, 1)
+        return hist
+
     def _req_key(self, req: Request):
         if req.seed is not None:
             return jax.random.PRNGKey(req.seed)
@@ -704,6 +759,17 @@ class ServingEngine:
         Records the token's model log-prob when the request asked for
         logprobs."""
         last_logits = jnp.asarray(last_logits)
+        raw_logits = last_logits
+        if req.presence_penalty or req.frequency_penalty:
+            req.hist = self._text_hist(req)
+            h = jnp.asarray(req.hist)
+            # Penalties shape the CHOICE only; reported logprobs stay
+            # raw-model (same convention as the burst path).
+            last_logits = (
+                last_logits
+                - req.presence_penalty * (h > 0)
+                - req.frequency_penalty * h
+            )
         if req.temperature <= 0:
             # Device-side argmax: a greedy admission moves one scalar to
             # host, never the vocab-wide logits row.
@@ -716,7 +782,7 @@ class ServingEngine:
             tok = int(jax.random.categorical(sub, scaled))
         if req.want_logprobs:
             req.logprobs.append(
-                float(jax.nn.log_softmax(last_logits)[tok])
+                float(jax.nn.log_softmax(raw_logits)[tok])
             )
         return tok
 
@@ -838,6 +904,23 @@ class ServingEngine:
                 self.pos = self.pos.at[i].set(prompt_end)
                 self.temp = self.temp.at[i].set(req.temperature)
                 self.top_p = self.top_p.at[i].set(req.top_p)
+                self.presence = self.presence.at[i].set(
+                    req.presence_penalty
+                )
+                self.frequency = self.frequency.at[i].set(
+                    req.frequency_penalty
+                )
+                if req.presence_penalty or req.frequency_penalty:
+                    # "Text so far": the histogram _pick_first cached,
+                    # plus the admission token.
+                    hist = (req.hist if req.hist is not None
+                            else self._text_hist(req))
+                    hist[first] += 1
+                    if self.counts is None:  # lazy: [n_slots, vocab] i32
+                        self.counts = jnp.zeros(
+                            (self.n_slots, self.cfg.vocab_size), jnp.int32
+                        )
+                    self.counts = self.counts.at[i].set(jnp.asarray(hist))
                 self.keys = self.keys.at[i].set(
                     jnp.asarray(self._req_key(req), jnp.uint32)
                 )
@@ -865,7 +948,11 @@ class ServingEngine:
             r is not None and r.top_p < 1.0 and r.temperature > 0
             for r in self._slot_req
         )
-        toks, emitted, lps = self._run_burst(want_lp, want_tp)
+        want_pen = any(
+            r is not None and (r.presence_penalty or r.frequency_penalty)
+            for r in self._slot_req
+        )
+        toks, emitted, lps = self._run_burst(want_lp, want_tp, want_pen)
         toks = np.asarray(toks)
         emitted = np.asarray(emitted)
         if want_lp:
@@ -895,15 +982,20 @@ class ServingEngine:
             raise first_exc
 
     def _run_burst(self, with_logprobs: bool = False,
-                   with_top_p: bool = False):
+                   with_top_p: bool = False,
+                   with_penalties: bool = False):
         (self.cache, self.pos, self.last_tok, self.remaining, self.active,
-         toks, emitted, lps) = _decode_burst(
+         toks, emitted, lps, counts) = _decode_burst(
             self._params_for(self._slot_adapter), self.cache, self.pos,
             self.last_tok,
             self.remaining, self.active, self.temp, self.keys, self.top_p,
+            self.presence, self.frequency,
+            self.counts if self.counts is not None else self._counts_dummy,
             self.cfg, self.steps_per_sync, self.eos_id, with_logprobs,
-            with_top_p,
+            with_top_p, with_penalties,
         )
+        if counts is not None:
+            self.counts = counts
         return toks, emitted, lps
 
     def take_logprobs(self, rid: int):
