@@ -39,6 +39,18 @@ from bee_code_interpreter_fs_tpu.models.quant import (
     quantized_nbytes,
     quantized_param_specs,
 )
+from bee_code_interpreter_fs_tpu.models.beam import beam_generate
+from bee_code_interpreter_fs_tpu.models.lora import (
+    init_lora,
+    lora_param_specs,
+    lora_wrap,
+    make_lora_train_step,
+    merge_lora,
+    multi_lora_wrap,
+    stack_loras,
+)
+from bee_code_interpreter_fs_tpu.models.paged import PagedServingEngine
+from bee_code_interpreter_fs_tpu.models.serving import ServingEngine
 
 __all__ = [
     "LlamaConfig",
@@ -66,4 +78,14 @@ __all__ = [
     "quantized4_param_specs",
     "quantized_nbytes",
     "quantized_param_specs",
+    "beam_generate",
+    "init_lora",
+    "lora_param_specs",
+    "lora_wrap",
+    "make_lora_train_step",
+    "merge_lora",
+    "multi_lora_wrap",
+    "stack_loras",
+    "PagedServingEngine",
+    "ServingEngine",
 ]
