@@ -64,6 +64,15 @@ class LlamaConfig:
     # for training drops from O(n_layers·b·t·dim) to ~one block, for one
     # extra forward's FLOPs — how long-context training fits HBM.
     remat: bool = False
+    # MoE dispatch implementation: "dense" computes every expert over every
+    # token (zero dynamic shapes, ep-shardable via param specs — the right
+    # trade at small scale) while "capacity" routes each token to only its
+    # top-k experts through a fixed per-expert capacity buffer
+    # (scatter/gather, FLOPs drop ~E/(k·factor)-fold; tokens overflowing an
+    # expert's buffer lose that expert's contribution, the standard
+    # GShard/Switch trade). Single-shard path; meshes keep dense dispatch.
+    moe_impl: str = "dense"
+    moe_capacity_factor: float = 1.25
     # Sequence-parallel strategy when the mesh's "sp" axis is > 1:
     # "ring" streams K/V chunks around the ring (bandwidth-optimal,
     # parallel/ring_attention.py) while "ulysses" repartitions via two
@@ -242,6 +251,16 @@ def _rope(x, theta, offset=0):
     return out.reshape(b, t, h, d)
 
 
+def _route(h, lp, cfg: LlamaConfig):
+    """Top-k expert routing (softmax over router logits, renormalized over
+    the selected k) — the ONE routing rule both MoE dispatch
+    implementations share; works over any leading dims."""
+    router_logits = (h @ lp["router"].astype(h.dtype)).astype(jnp.float32)
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    top_w, top_i = lax.top_k(probs, cfg.n_experts_per_token)
+    return top_w / top_w.sum(axis=-1, keepdims=True), top_i
+
+
 def _moe_mlp(h, lp, cfg: LlamaConfig):
     """Mixtral-class top-k MoE MLP, SPMD-first dense dispatch.
 
@@ -256,10 +275,7 @@ def _moe_mlp(h, lp, cfg: LlamaConfig):
     scale where ragged dispatch kernels pay for themselves; swap in a
     Pallas ragged dispatch at Mixtral-8x7B scale.
     """
-    router_logits = (h @ lp["router"].astype(h.dtype)).astype(jnp.float32)
-    probs = jax.nn.softmax(router_logits, axis=-1)  # [b, t, E]
-    top_w, top_i = lax.top_k(probs, cfg.n_experts_per_token)  # [b, t, k]
-    top_w = top_w / top_w.sum(axis=-1, keepdims=True)
+    top_w, top_i = _route(h, lp, cfg)  # [b, t, k]
     # Dense per-token expert weights: zero outside the top-k.
     weights = (
         jax.nn.one_hot(top_i, cfg.n_experts, dtype=jnp.float32)
@@ -269,6 +285,53 @@ def _moe_mlp(h, lp, cfg: LlamaConfig):
     up = jnp.einsum("btd,edh->bteh", h, _w(lp["w_up"], h.dtype))
     y = jnp.einsum("bteh,ehd->bted", gate * up, _w(lp["w_down"], h.dtype))
     return jnp.einsum("bted,bte->btd", y, weights.astype(y.dtype))
+
+
+def _moe_mlp_capacity(h, lp, cfg: LlamaConfig):
+    """Capacity-based top-k MoE dispatch (GShard/Switch style), the
+    FLOP-efficient alternative to `_moe_mlp`'s dense dispatch: each token
+    reaches only its k routed experts through fixed [E, capacity] buffers
+    — expert compute drops from E token-passes to ~factor·k — with
+    linear-cost scatter/gather (no quadratic one-hot dispatch matmuls).
+
+    capacity = ceil(factor · k · T / E) is static (shapes only). A token
+    slot that overflows its expert's buffer is DROPPED for that expert
+    (its routing weight contributes nothing; the residual stream still
+    carries the token) — the standard trade; factor >= E/k makes drops
+    impossible and the result equals dense dispatch exactly (tested).
+    Single-shard implementation: mesh runs keep the ep-shardable dense
+    path."""
+    b, t, d = h.shape
+    E, k = cfg.n_experts, cfg.n_experts_per_token
+    T = b * t
+    x = h.reshape(T, d)
+    top_w, top_i = _route(x, lp, cfg)                       # [T, k]
+
+    import math
+
+    cap = max(1, math.ceil(cfg.moe_capacity_factor * k * T / E))
+    flat_e = top_i.reshape(T * k)                           # expert per slot
+    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)     # [T*k, E]
+    # Position of each slot within its expert's buffer: count of earlier
+    # slots routed to the same expert.
+    pos = ((jnp.cumsum(onehot, axis=0) - onehot) * onehot).sum(-1)
+    keep = pos < cap
+    # Overflowing slots scatter into a trash row past the buffers.
+    slot_idx = jnp.where(keep, flat_e * cap + pos, E * cap)
+    xk = jnp.repeat(x, k, axis=0)                           # [T*k, d]
+    xe = jnp.zeros((E * cap + 1, d), h.dtype).at[slot_idx].add(xk)
+    xe = xe[:-1].reshape(E, cap, d)
+
+    gate = jax.nn.silu(
+        jnp.einsum("ecd,edh->ech", xe, _w(lp["w_gate"], h.dtype))
+    )
+    up = jnp.einsum("ecd,edh->ech", xe, _w(lp["w_up"], h.dtype))
+    ye = jnp.einsum("ech,ehd->ecd", gate * up, _w(lp["w_down"], h.dtype))
+
+    yk = ye.reshape(E * cap, d)[jnp.where(keep, slot_idx, 0)]
+    w_slot = (top_w.reshape(T * k) * keep).astype(yk.dtype)
+    y = (yk * w_slot[:, None]).reshape(T, k, d).sum(axis=1)
+    return y.reshape(b, t, d)
 
 
 def _plain_causal_attention(q, k, v, scale, window: int = 0, sinks: int = 0):
@@ -387,7 +450,13 @@ def transformer_block(x, lp, cfg: LlamaConfig, attn_fn, *, rope_offset=0):
 
     h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts > 0:
-        x = x + _moe_mlp(h, lp, cfg)
+        if cfg.moe_impl not in ("dense", "capacity"):
+            raise ValueError(
+                f"unknown moe_impl {cfg.moe_impl!r}; use 'dense' or "
+                "'capacity'"
+            )
+        moe = _moe_mlp_capacity if cfg.moe_impl == "capacity" else _moe_mlp
+        x = x + moe(h, lp, cfg)
     else:
         gate = jax.nn.silu(_mm(h, lp["w_gate"], dt))
         x = x + _mm(gate * _mm(h, lp["w_up"], dt), lp["w_down"], dt)
@@ -407,6 +476,13 @@ def forward(params, tokens, cfg: LlamaConfig, *, mesh: Mesh | None = None):
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     scale = hd ** -0.5
     use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
+    if (mesh is not None and cfg.n_experts > 0
+            and cfg.moe_impl == "capacity"):
+        raise ValueError(
+            "moe_impl='capacity' is the single-shard dispatch (its flat "
+            "scatter defeats ep sharding); meshes use the ep-shardable "
+            "dense dispatch — drop the mesh or set moe_impl='dense'"
+        )
     if use_ring and cfg.sliding_window > 0:
         raise ValueError(
             "sliding_window is not composed with sequence parallelism "
