@@ -787,3 +787,18 @@ def test_capacity_moe_tight_buffer_drops_gracefully():
     out_d = np.asarray(forward(params, toks, cfg_d))
     assert np.isfinite(out_c).all()
     assert not np.allclose(out_c, out_d, atol=1e-4)
+
+
+def test_capacity_moe_guards():
+    """Capacity dispatch is single-shard by contract (meshes raise), and
+    unknown moe_impl names raise instead of silently running dense."""
+    kw = dict(n_experts=4, n_experts_per_token=2, dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), LlamaConfig.tiny(**kw))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 256)
+
+    mesh = make_mesh(best_mesh_shape(2, tp=1, sp=1))
+    with pytest.raises(ValueError, match="single-shard"):
+        forward(params, toks, LlamaConfig.tiny(**kw, moe_impl="capacity"),
+                mesh=mesh)
+    with pytest.raises(ValueError, match="unknown moe_impl"):
+        forward(params, toks, LlamaConfig.tiny(**kw, moe_impl="capcity"))
