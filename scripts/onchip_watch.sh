@@ -1,0 +1,62 @@
+#!/bin/bash
+# Patient TPU recovery watcher: probe until an attach succeeds, then fire
+# the full on-chip measurement suite, writing results INTO the repo so the
+# round-end auto-commit preserves them even if nobody is at the keyboard.
+#
+# Usage: nohup scripts/onchip_watch.sh & (from the repo root; safe to leave
+# running — probes are never killed mid-attach, which is what wedges the
+# tunneled device). Operator note from round 4: a persistent wedge (every
+# attach blocking 25-75 min then UNAVAILABLE) cleared once at a HOST
+# reboot; if attaches keep failing for hours, a reboot of the machine
+# hosting the tunnel relay is the known remedy, after which this watcher
+# (relaunched) captures everything automatically.
+OUT=/root/repo/benchmarks/onchip_r04
+LOG=/tmp/tpuprobe/probe.log
+mkdir -p "$OUT"
+cd /root/repo || exit 1
+while true; do
+  timeout 2400 python -c "
+import time
+t0=time.time()
+import jax
+d=jax.devices()
+import jax.numpy as jnp
+x=jnp.ones((1024,1024), dtype=jnp.bfloat16)
+(x@x).block_until_ready()
+print('attach+matmul ok in %.1fs' % (time.time()-t0), d, flush=True)
+" >> "$LOG" 2>&1
+  rc=$?
+  echo "$(date -u +%FT%TZ) probe rc=$rc" >> "$LOG"
+  if [ $rc -eq 0 ]; then echo ALIVE > /tmp/tpuprobe/status; break; fi
+  echo DEAD > /tmp/tpuprobe/status
+  sleep 30
+done
+
+echo "$(date -u +%FT%TZ) chip recovered; firing on-chip suite" >> "$LOG"
+echo "recovered_at: $(date -u +%FT%TZ)" > "$OUT/STATUS.txt"
+
+run_leg() {  # name, timeout, command...
+  name=$1; tmo=$2; shift 2
+  echo "$(date -u +%FT%TZ) leg $name starting" >> "$LOG"
+  PYTHONPATH=/root/repo timeout "$tmo" "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  echo "leg $name rc=$?" >> "$OUT/STATUS.txt"
+  echo "$(date -u +%FT%TZ) leg $name done" >> "$LOG"
+}
+
+# 1. The driver-format bench (headline/matmul/flash/p50/int8).
+run_leg bench 1800 python bench.py
+# 2. Full config suite (1-4, 5a-5g incl. int8 ratio, true-7B, speculative,
+#    serving engine).
+run_leg run_configs 7200 python benchmarks/run_configs.py
+# 3. Flash-attention tile sweep at t=16k (VERDICT next-4).
+for bq in 256 512 1024; do
+  for bk in 512 1024 2048; do
+    BENCH_BLOCK_Q=$bq BENCH_BLOCK_K=$bk \
+      run_leg "flash_q${bq}_k${bk}" 900 python examples/benchmark-attention.py
+  done
+done
+BENCH_SEQ_LEN=32768 run_leg flash_32k 900 python examples/benchmark-attention.py
+# 4. True-13B int4 on one chip.
+BENCH_MODEL=llama2_13b BENCH_PRECISION=int4 \
+  run_leg llama2_13b_int4 1800 python examples/benchmark-7b.py
+echo "suite_complete: $(date -u +%FT%TZ)" >> "$OUT/STATUS.txt"
