@@ -4,18 +4,21 @@
 # round-end auto-commit preserves them even if nobody is at the keyboard.
 #
 # Usage: nohup scripts/onchip_watch.sh & (from the repo root; safe to leave
-# running — probes are never killed mid-attach, which is what wedges the
-# tunneled device). Operator note from round 4: a persistent wedge (every
+# running — probe attempts end via SIGINT so the client unwinds cleanly;
+# abrupt SIGKILLs mid-device-op are what wedge the tunneled device). Operator note from round 4: a persistent wedge (every
 # attach blocking 25-75 min then UNAVAILABLE) cleared once at a HOST
 # reboot; if attaches keep failing for hours, a reboot of the machine
 # hosting the tunnel relay is the known remedy, after which this watcher
 # (relaunched) captures everything automatically.
 OUT=/root/repo/benchmarks/onchip_r04
 LOG=/tmp/tpuprobe/probe.log
-mkdir -p "$OUT"
+mkdir -p "$OUT" /tmp/tpuprobe
 cd /root/repo || exit 1
 while true; do
-  timeout 2400 python -c "
+  # 90 min per attempt (observed wedge blocks 25-76 min); on expiry the
+  # probe gets SIGINT (Python unwinds and the client says goodbye) with
+  # SIGKILL only a minute later — never an abrupt kill mid-attach.
+  timeout --signal=INT --kill-after=60 5400 python -c "
 import time
 t0=time.time()
 import jax
