@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from bee_code_interpreter_fs_tpu.models.quant import quantize_kv
 from bee_code_interpreter_fs_tpu.models.llama import (
     LlamaConfig,
     _cached_gqa_attention,
@@ -53,6 +54,7 @@ from bee_code_interpreter_fs_tpu.models.serving import (
     Request,
     ServingEngine,
     _burst_scan,
+    _kv_write_read,
     _chunked_scratch_prefill,
     _prefill_scratch,
     _prefill_scratch_prefixed,
@@ -74,9 +76,11 @@ def _perslot_decode_step_paged(params, tokens, pool, tables, pos, active,
     block, never allocated) instead — same static shapes, no branches."""
     dt = jnp.dtype(cfg.dtype)
     scale = cfg.head_dim ** -0.5
+    quant = "kq" in pool  # int8 pool (engine kv_quant=True)
     b, max_blocks = tables.shape
-    bs = pool["k"].shape[2]
-    trash = pool["k"].shape[1] - 1
+    ref = pool["kq"] if quant else pool["k"]
+    bs = ref.shape[2]
+    trash = ref.shape[1] - 1
     logical = max_blocks * bs
     valid = decode_valid_mask(pos, logical, cfg)[:, None, None, None, :]
     blk = jnp.take_along_axis(tables, (pos // bs)[:, None], axis=1)[:, 0]
@@ -84,27 +88,32 @@ def _perslot_decode_step_paged(params, tokens, pool, tables, pos, active,
     off = pos % bs
     x = params["embed"].astype(dt)[tokens]
 
+    def gathered(c):
+        return c[tables].reshape(b, logical, *c.shape[2:])
+
+    pool_keys, write_read = _kv_write_read(
+        quant, lambda c, x: c.at[blk, off].set(x), gathered, dt
+    )
+
     def layer(x, inputs):
-        lp, ck, cv = inputs  # [n_blocks, bs, nkv, hd]
+        lp = inputs[0]
+        cs = inputs[1:]
         cell = {}
 
         def attn_fn(q, k, v):
-            nk = ck.at[blk, off].set(k[:, 0])
-            nv = cv.at[blk, off].set(v[:, 0])
-            cell["kv"] = (nk, nv)
-            gk = nk[tables].reshape(b, logical, *nk.shape[2:])
-            gv = nv[tables].reshape(b, logical, *nv.shape[2:])
-            return _cached_gqa_attention(q, gk, gv, valid, scale)
+            new, keys, vals = write_read(cs, k[:, 0], v[:, 0])
+            cell["kv"] = new
+            return _cached_gqa_attention(q, keys, vals, valid, scale)
 
         x = transformer_block(x, lp, cfg, attn_fn, rope_offset=pos)
         return x, cell["kv"]
 
-    x, (new_k, new_v) = lax.scan(
-        layer, x, (params["layers"], pool["k"], pool["v"])
+    x, new_leaves = lax.scan(
+        layer, x, (params["layers"],) + tuple(pool[k] for k in pool_keys)
     )
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ _w(params["lm_head"], dt)).astype(jnp.float32)
-    return logits, {"k": new_k, "v": new_v}
+    return logits, dict(zip(pool_keys, new_leaves))
 
 
 @partial(jax.jit,
@@ -132,6 +141,28 @@ def _decode_burst_paged(params, pool, tables, pos, last_tok, remaining,
                        top_p if with_top_p else None,
                        (presence, frequency, counts) if with_penalties
                        else None)
+
+
+@partial(jax.jit, donate_argnames=("pool",))
+def _pool_install_quant(pool, kv, blk_ids):
+    """Quantize a DENSE block-aligned scratch and scatter it into the int8
+    pool (prefill stays exact; only storage quantizes — mirrors the dense
+    engine's _install_row_quant)."""
+    L, _, T = kv["k"].shape[:3]
+    bs = pool["kq"].shape[2]
+    nb = T // bs
+    kq, ks = quantize_kv(kv["k"])
+    vq, vs = quantize_kv(kv["v"])
+
+    def blocked(a):
+        return a.reshape(L, nb, bs, *a.shape[3:])
+
+    return {
+        "kq": pool["kq"].at[:, blk_ids].set(blocked(kq)),
+        "ks": pool["ks"].at[:, blk_ids].set(blocked(ks)),
+        "vq": pool["vq"].at[:, blk_ids].set(blocked(vq)),
+        "vs": pool["vs"].at[:, blk_ids].set(blocked(vs)),
+    }
 
 
 @partial(jax.jit, donate_argnames=("pool",))
@@ -173,11 +204,6 @@ class PagedServingEngine(ServingEngine):
         super().__init__(params, cfg, **kwargs)
 
     def _init_device_state(self):
-        if self.kv_quant:
-            raise NotImplementedError(
-                "kv_quant is implemented for the dense ServingEngine; the "
-                "paged pool stores full-precision K/V"
-            )
         bs = self.block_size
         self.max_blocks = -(-self.max_len // bs)
         n_blocks = (
@@ -194,7 +220,16 @@ class PagedServingEngine(ServingEngine):
         # write into (see _perslot_decode_step_paged); never allocated.
         shape = (cfg.n_layers, n_blocks + 1, bs, cfg.n_kv_heads, cfg.head_dim)
         dt = jnp.dtype(cfg.dtype)
-        self.pool = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        if self.kv_quant:
+            sshape = shape[:-1] + (1,)
+            self.pool = {
+                "kq": jnp.zeros(shape, jnp.int8),
+                "ks": jnp.zeros(sshape, jnp.float32),
+                "vq": jnp.zeros(shape, jnp.int8),
+                "vs": jnp.zeros(sshape, jnp.float32),
+            }
+        else:
+            self.pool = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
         self.tables = jnp.zeros((self.n_slots, self.max_blocks), jnp.int32)
         self._free: list[int] = list(range(n_blocks))
         self._slot_blocks: list[list[int]] = [[] for _ in range(self.n_slots)]
@@ -246,7 +281,9 @@ class PagedServingEngine(ServingEngine):
                     else:
                         pf["aligned_kv"] = {"k": pf["k"], "v": pf["v"]}
                 nb = pad_to // self.block_size
-                self.pool = _pool_install(
+                install = (_pool_install_quant if self.kv_quant
+                           else _pool_install)
+                self.pool = install(
                     self.pool, pf["aligned_kv"],
                     jnp.asarray(blks[:nb], jnp.int32),
                 )
@@ -284,7 +321,8 @@ class PagedServingEngine(ServingEngine):
         return first, prompt_end
 
     def _install_scratch(self, scratch, blks, pad_to: int, need: int):
-        """Scatter the prompt scratch into the reserved blocks. The bucket
+        """Scatter the prompt scratch into the reserved blocks (via the
+        quantizing installer on an int8 pool). The bucket
         padding can overshoot the request's reservation (a short prompt in
         a big bucket with a tiny budget): trim to the reserved extent —
         everything real (the prompt itself) always fits inside it, because
@@ -296,7 +334,8 @@ class PagedServingEngine(ServingEngine):
                 "k": scratch["k"][:, :, :t_inst],
                 "v": scratch["v"][:, :, :t_inst],
             }
-        return _pool_install(
+        install = _pool_install_quant if self.kv_quant else _pool_install
+        return install(
             self.pool, scratch, jnp.asarray(blks[: t_inst // bs], jnp.int32)
         )
 

@@ -109,35 +109,13 @@ def _perslot_decode_step(params, tokens, cache, pos, cfg: LlamaConfig):
     bidx = jnp.arange(tokens.shape[0])
 
     # One layer body for both cache formats: only the row write and the
-    # K/V handed to attention differ, captured by write_read below — the
-    # frontier-scatter / rope / mask logic exists exactly once.
-    if quant:
-        cache_keys = ("kq", "ks", "vq", "vs")
-
-        def write_read(cs, k, v):
-            ckq, cks, cvq, cvs = cs
-            kq, ks = quantize_kv(k)
-            vq, vs = quantize_kv(v)
-            new = (
-                ckq.at[bidx, pos].set(kq),
-                cks.at[bidx, pos].set(ks),
-                cvq.at[bidx, pos].set(vq),
-                cvs.at[bidx, pos].set(vs),
-            )
-            # Dequantize AT THE READ: HBM streams int8 + scales; the
-            # multiply fuses into the attention contraction.
-            return new, dequantize_kv(new[0], new[1], dt), dequantize_kv(
-                new[2], new[3], dt
-            )
-    else:
-        cache_keys = ("k", "v")
-
-        def write_read(cs, k, v):
-            ck, cv = cs
-            # Per-slot scatter at each slot's own frontier (the [b] pos
-            # vector rules out one dynamic_update_slice for the batch).
-            new = (ck.at[bidx, pos].set(k), cv.at[bidx, pos].set(v))
-            return new, new[0], new[1]
+    # K/V handed to attention differ — the shared strategy factory keeps
+    # the int8 recipe in ONE place for the dense and paged engines alike.
+    # Per-slot scatter at each slot's own frontier (the [b] pos vector
+    # rules out one dynamic_update_slice for the batch).
+    cache_keys, write_read = _kv_write_read(
+        quant, lambda c, x: c.at[bidx, pos].set(x), lambda c: c, dt
+    )
 
     def layer(x, inputs):
         lp = inputs[0]
@@ -159,6 +137,36 @@ def _perslot_decode_step(params, tokens, cache, pos, cfg: LlamaConfig):
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ _w(params["lm_head"], dt)).astype(jnp.float32)
     return logits, new_cache
+
+
+def _kv_write_read(quant: bool, write_at, read_tf, dt):
+    """Build the per-layer KV (cache_keys, write_read) strategy shared by
+    the dense and paged decode steps: `write_at(cache_leaf, value)` places
+    the new token's K/V (row scatter vs block scatter) and `read_tf`
+    produces the attention-readable view (identity vs block-table gather).
+    With `quant`, values quantize at the write and dequantize AT THE READ —
+    HBM streams int8 + scales and the multiply fuses into the attention
+    contraction; the recipe exists exactly once for both engines."""
+    if quant:
+        keys = ("kq", "ks", "vq", "vs")
+
+        def write_read(cs, k, v):
+            ckq, cks, cvq, cvs = cs
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            new = (write_at(ckq, kq), write_at(cks, ks),
+                   write_at(cvq, vq), write_at(cvs, vs))
+            return new, dequantize_kv(
+                read_tf(new[0]), read_tf(new[1]), dt
+            ), dequantize_kv(read_tf(new[2]), read_tf(new[3]), dt)
+    else:
+        keys = ("k", "v")
+
+        def write_read(cs, k, v):
+            new = (write_at(cs[0], k), write_at(cs[1], v))
+            return new, read_tf(new[0]), read_tf(new[1])
+
+    return keys, write_read
 
 
 def _sample_next(logits, temp, keys, pos, top_p=None):

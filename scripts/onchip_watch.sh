@@ -16,8 +16,10 @@ mkdir -p "$OUT" /tmp/tpuprobe
 cd /root/repo || exit 1
 while true; do
   # 90 min per attempt (observed wedge blocks 25-76 min); on expiry the
-  # probe gets SIGINT (Python unwinds and the client says goodbye) with
-  # SIGKILL only a minute later — never an abrupt kill mid-attach.
+  # probe gets SIGINT first (Python unwinds and says goodbye when it CAN —
+  # an attach stuck inside an uninterruptible C call still eats the
+  # +60s SIGKILL, so a >90-min attach can still be cut abruptly; the
+  # budget is sized well past every observed block to keep that rare).
   timeout --signal=INT --kill-after=60 5400 python -c "
 import time
 t0=time.time()

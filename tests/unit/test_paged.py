@@ -183,3 +183,33 @@ def test_paged_logprobs_match_dense(model):
     np.testing.assert_allclose(dlp, plp, atol=1e-4)
     np.testing.assert_array_equal(dst, pst)
     np.testing.assert_allclose(dslp, pslp, atol=1e-4)
+
+
+def test_paged_kv_quant_matches_dense_quant(model):
+    """The int8 block pool must emit the same tokens as the dense engine's
+    int8 cache on identical traffic (same quantization granularity, same
+    write/read points), at roughly half the pool bytes."""
+    params, cfg = model
+
+    def drive(cls, **kw):
+        eng = cls(params, cfg, n_slots=2, max_len=96, steps_per_sync=3,
+                  kv_quant=True, **kw)
+        pid = eng.register_prefix([9, 1, 4])
+        rids = [
+            eng.submit(list(range(1, 20)), 8),
+            eng.submit([5], 7, prefix_id=pid),
+            eng.submit([8, 3], 6, temperature=1.0, seed=2),
+        ]
+        res = eng.run()
+        return eng, [res[r] for r in rids]
+
+    de, dense_out = drive(ServingEngine)
+    pe, paged_out = drive(PagedServingEngine, block_size=8)
+    for d, p in zip(dense_out, paged_out):
+        np.testing.assert_array_equal(d, p)
+
+    full = PagedServingEngine(params, cfg, n_slots=2, max_len=96,
+                              block_size=8)
+    quant_bytes = sum(v.nbytes for v in pe.pool.values())
+    dense_bytes = sum(v.nbytes for v in full.pool.values())
+    assert quant_bytes < 0.6 * dense_bytes
