@@ -1,0 +1,126 @@
+"""Adaptive headline sampling in bench.py (VERDICT r4 #2).
+
+The driver's r4 headline under-sampled: a fixed ``runs=4`` cut the loop off
+mid-warm-up (3.7 → 15.8 → 19.0 → 19.1 GFLOPS, still climbing) and recorded
+less than half the chip's steady state. These tests drive ``run_gflops``
+against a simulated slow-warm-up backend and assert the adaptive loop keeps
+sampling until the plateau (or stops early on the budget), with the plateau
+value landing in the artifact info.
+"""
+
+import time
+
+import pytest
+
+import bench
+
+
+class _FakeResult:
+    def __init__(self, gflops: float):
+        self.exit_code = 0
+        self.stdout = f"backend: jax\nGFLOPS={gflops}\n"
+        self.stderr = ""
+        self.phases = {}
+
+
+class _FakeExecutor:
+    """Stands in for CodeExecutor: returns a scripted GFLOPS ramp."""
+
+    script: list[float] = []
+    sleep_s: float = 0.0
+
+    def __init__(self, *a, **kw):
+        self.calls = 0
+
+    async def fill_pool(self):
+        pass
+
+    async def execute(self, source, timeout=None):
+        idx = min(self.calls, len(self.script) - 1)
+        self.calls += 1
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+        return _FakeResult(self.script[idx])
+
+    async def close(self):
+        pass
+
+
+@pytest.fixture
+def fake_backend(monkeypatch):
+    monkeypatch.setattr(bench, "LocalSandboxBackend", lambda *a, **kw: None)
+    monkeypatch.setattr(bench, "Storage", lambda *a, **kw: None)
+    monkeypatch.setattr(bench, "CodeExecutor", _FakeExecutor)
+    monkeypatch.setattr(bench, "_DEADLINE_AT", None)
+    return _FakeExecutor
+
+
+async def test_slow_warmup_reaches_plateau(fake_backend, tmp_path):
+    # r4's observed ramp, then the steady state a fixed runs=4 never saw.
+    fake_backend.script = [3.7, 15.8, 19.0, 25.0, 38.0, 45.0, 45.2, 45.2]
+    fake_backend.sleep_s = 0.0
+    best, info = await bench.run_gflops(
+        dispatch=True, runs=4, tmp=tmp_path, adaptive=True, budget_s=60.0
+    )
+    assert best == pytest.approx(45.2)
+    assert len(info["gflops_samples"]) > 4  # kept going past the old cutoff
+    assert info["gflops_plateaued"] is True
+    # stopped at the plateau, not at max_runs
+    assert len(info["gflops_samples"]) <= 8
+
+
+async def test_midclimb_flat_spot_does_not_stop(fake_backend, tmp_path):
+    # The EXACT r4 driver failure: 19.0 -> 19.1 is a two-sample flat spot
+    # in the middle of the climb to ~45. A last-two plateau rule stops
+    # there with the >2x understatement; the last-three rule must ride
+    # through it to the real steady state.
+    fake_backend.script = [3.7, 15.8, 19.0, 19.1, 30.0, 44.0, 45.0, 45.1]
+    fake_backend.sleep_s = 0.0
+    best, info = await bench.run_gflops(
+        dispatch=True, runs=4, tmp=tmp_path, adaptive=True, budget_s=60.0
+    )
+    assert best == pytest.approx(45.1)
+    assert info["gflops_plateaued"] is True
+
+
+async def test_fixed_mode_unchanged(fake_backend, tmp_path):
+    fake_backend.script = [3.7, 15.8, 19.0, 19.1, 45.0]
+    fake_backend.sleep_s = 0.0
+    best, info = await bench.run_gflops(dispatch=True, runs=4, tmp=tmp_path)
+    assert len(info["gflops_samples"]) == 4
+    assert best == pytest.approx(19.1)
+    assert "gflops_plateaued" not in info
+
+
+async def test_budget_stops_a_climbing_ramp(fake_backend, tmp_path):
+    # Monotonic ramp that never plateaus; per-run cost ~0.05s with a budget
+    # that only fits a few extra runs past the minimum.
+    fake_backend.script = [float(i * 10 + 1) for i in range(50)]
+    fake_backend.sleep_s = 0.05
+    best, info = await bench.run_gflops(
+        dispatch=True, runs=4, tmp=tmp_path, adaptive=True, budget_s=0.35
+    )
+    n = len(info["gflops_samples"])
+    assert 4 <= n < 12
+    assert info["gflops_plateaued"] is False
+    assert best == pytest.approx(info["gflops_samples"][-1])
+
+
+async def test_max_runs_backstop(fake_backend, tmp_path):
+    fake_backend.script = [float(i * 10 + 1) for i in range(50)]
+    fake_backend.sleep_s = 0.0
+    best, info = await bench.run_gflops(
+        dispatch=True, runs=4, tmp=tmp_path, adaptive=True, budget_s=600.0,
+        max_runs=7,
+    )
+    assert len(info["gflops_samples"]) == 7
+
+
+def test_plateau_predicate():
+    assert not bench._plateaued([], 0.05)
+    assert not bench._plateaued([10.0], 0.05)
+    assert not bench._plateaued([10.0, 10.2], 0.05)  # two is not enough
+    assert bench._plateaued([10.0, 10.2, 10.1], 0.05)
+    assert not bench._plateaued([10.0, 19.0, 19.1], 0.05)  # mid-climb flat
+    # only the last three matter
+    assert bench._plateaued([3.0, 44.0, 45.0, 44.8], 0.05)
