@@ -84,7 +84,6 @@ class Request:
     top_p: float = 1.0  # nucleus truncation (1.0 = off)
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
-    hist: object = None  # cached text-so-far histogram (penalized reqs)
     generated: list = field(default_factory=list)
     logprobs: list = field(default_factory=list)
 
@@ -640,9 +639,25 @@ class ServingEngine:
             "last_logits": np.asarray(row_logits, np.float32),
             "len": plen,
             "adapter": adapter,
-            "tokens": tokens,
         }
         return pid
+
+    def unregister_prefix(self, prefix_id: int) -> None:
+        """Release a registered prefix's device K/V (including any engine-
+        side memos keyed off it, e.g. the paged engine's block-aligned
+        copy), reclaiming its memory in a long-lived engine. Requests
+        already ADMITTED with it copied what they needed and are
+        unaffected; raises while QUEUED requests still reference it (they
+        would crash at admission after the K/V is gone)."""
+        if prefix_id not in self._prefixes:
+            raise ValueError(f"unknown prefix_id {prefix_id}")
+        users = [r.rid for r in self._queue if r.prefix_id == prefix_id]
+        if users:
+            raise ValueError(
+                f"prefix {prefix_id} is referenced by queued request(s) "
+                f"{users}; drain or cancel them first"
+            )
+        del self._prefixes[prefix_id]
 
     def submit(self, prompt, max_new_tokens: int,
                prefix_id: int | None = None, *, temperature: float = 0.0,
@@ -665,7 +680,11 @@ class ServingEngine:
         random stream is `fold_in(key, token position)`, so with an explicit
         `seed` the output is reproducible regardless of what other traffic
         shares the batch or how the scheduler slices bursts (seed=None
-        derives a key from the engine seed and the request id)."""
+        derives a key from the engine seed and the request id).
+        `presence_penalty` / `frequency_penalty` follow the OpenAI
+        convention: they count GENERATED tokens only (prompt and prefix
+        text never feed the histogram), shape token choice (greedy argmax
+        included), and leave reported logprobs raw-model."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if temperature < 0:
             raise ValueError("temperature must be >= 0")
@@ -694,7 +713,12 @@ class ServingEngine:
                 f"prefix ({plen}) + prompt ({prompt.size}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds cache max_len {self.max_len}"
             )
-        if prompt.size > 0 and prompt.size > max(self.buckets):
+        if (prefix_id is None and prompt.size > 0
+                and prompt.size > max(self.buckets)):
+            # Prefixed suffixes skip this gate: _suffix_bucket's exact-
+            # remainder fallback (max_len - plen) holds any suffix the
+            # total-length check above admitted, even when the caller
+            # configured only small custom prefill_buckets.
             raise ValueError(
                 f"prompt length {prompt.size} exceeds largest prefill "
                 f"bucket {max(self.buckets)}"
@@ -724,10 +748,24 @@ class ServingEngine:
         return padded
 
     def _bucket_len(self, n: int) -> int:
-        for b in self.buckets:
-            if n <= b:
-                return b
-        raise ValueError(f"no bucket holds prompt of length {n}")
+        plain = next((b for b in self.buckets if n <= b), None)
+        if plain is None:
+            raise ValueError(f"no bucket holds prompt of length {n}")
+        c = self.prefill_chunk
+        if c is not None and plain > c and plain % c:
+            # An unaligned bucket above the chunk size routes through the
+            # O(bucket^2) single-pass admit — exactly the long-prompt range
+            # chunked prefill exists for. Prefer the smallest chunk-aligned
+            # bucket that also holds the prompt; keep the unaligned bucket
+            # only when no aligned one can (capacity never shrinks).
+            aligned = next(
+                (b for b in self.buckets
+                 if n <= b and b > c and b % c == 0),
+                None,
+            )
+            if aligned is not None:
+                return aligned
+        return plain
 
     def _params_for(self, ids) -> dict:
         """Base params, or the multi-adapter wrapped tree selecting adapter
@@ -745,15 +783,6 @@ class ServingEngine:
     def _req_params(self, req: Request) -> dict:
         return self._params_for([self._adapter_idx[req.adapter]])
 
-    def _text_hist(self, req: Request) -> np.ndarray:
-        """Vocab histogram of the request's text so far (prefix + prompt),
-        the penalties' starting state."""
-        hist = np.zeros((self.cfg.vocab_size,), np.int32)
-        if req.prefix_id is not None:
-            np.add.at(hist, self._prefixes[req.prefix_id]["tokens"], 1)
-        np.add.at(hist, req.prompt, 1)
-        return hist
-
     def _req_key(self, req: Request):
         if req.seed is not None:
             return jax.random.PRNGKey(req.seed)
@@ -768,16 +797,9 @@ class ServingEngine:
         logprobs."""
         last_logits = jnp.asarray(last_logits)
         raw_logits = last_logits
-        if req.presence_penalty or req.frequency_penalty:
-            req.hist = self._text_hist(req)
-            h = jnp.asarray(req.hist)
-            # Penalties shape the CHOICE only; reported logprobs stay
-            # raw-model (same convention as the burst path).
-            last_logits = (
-                last_logits
-                - req.presence_penalty * (h > 0)
-                - req.frequency_penalty * h
-            )
+        # Penalties count GENERATED tokens only (the OpenAI convention the
+        # API names): at admission nothing has been generated, so the first
+        # token's choice is unpenalized by construction.
         if req.temperature <= 0:
             # Device-side argmax: a greedy admission moves one scalar to
             # host, never the vocab-wide logits row.
@@ -880,7 +902,12 @@ class ServingEngine:
         """Hook: slot i's request just finished (paged engine frees its
         blocks here)."""
 
-    def _admit_waiting(self):
+    def _admit_waiting(self) -> list:
+        """Admit queued requests into free slots. Returns the admission-time
+        streaming deliveries [(callback, [token]), ...] for step() to fire
+        AFTER all bookkeeping — a raising sink must never abort remaining
+        admissions or the burst (the two-phase guarantee submit promises)."""
+        fired: list = []
         for i in range(self.n_slots):
             if self._slot_req[i] is not None:
                 continue
@@ -892,7 +919,7 @@ class ServingEngine:
                 placed = self._install(req, i)
                 if placed is None:
                     self._queue.appendleft(req)
-                    return
+                    return fired
                 first, prompt_end = placed
                 req.generated.append(first)
                 done = req.max_new_tokens <= 1 or (
@@ -905,7 +932,7 @@ class ServingEngine:
                     # reservation) — release them.
                     self._on_retire(i)
                     if req.on_token is not None:
-                        req.on_token([first])
+                        fired.append((req.on_token, [first]))
                     continue
                 self._slot_req[i] = req
                 self._slot_adapter[i] = self._adapter_idx[req.adapter]
@@ -919,11 +946,11 @@ class ServingEngine:
                     req.frequency_penalty
                 )
                 if req.presence_penalty or req.frequency_penalty:
-                    # "Text so far": the histogram _pick_first cached,
-                    # plus the admission token.
-                    hist = (req.hist if req.hist is not None
-                            else self._text_hist(req))
-                    hist[first] += 1
+                    # Generated-only histogram (OpenAI semantics): starts
+                    # at zero, counting just the admission token — prompt
+                    # and prefix text never feed the penalties.
+                    hist = np.zeros((self.cfg.vocab_size,), np.int32)
+                    hist[first] = 1
                     if self.counts is None:  # lazy: [n_slots, vocab] i32
                         self.counts = jnp.zeros(
                             (self.n_slots, self.cfg.vocab_size), jnp.int32
@@ -937,17 +964,20 @@ class ServingEngine:
                     req.max_new_tokens - 1
                 )
                 self.active = self.active.at[i].set(True)
-                # Callback last: if it raises, every token is already
-                # recorded and the slot/block bookkeeping is consistent.
+                # Deliveries are deferred to step(): by fire time every
+                # token is recorded and all slot/block bookkeeping (this
+                # admission AND later ones) is consistent.
                 if req.on_token is not None:
-                    req.on_token([first])
+                    fired.append((req.on_token, [first]))
                 break
+        return fired
 
     def step(self):
         """One scheduler iteration: retire, admit, one fused decode burst."""
         self._retire()
-        self._admit_waiting()
+        fired = self._admit_waiting()
         if not bool(np.asarray(self.active).any()):
+            self._deliver(fired)
             return
         want_lp = any(
             r is not None and r.want_logprobs for r in self._slot_req
@@ -965,10 +995,10 @@ class ServingEngine:
         emitted = np.asarray(emitted)
         if want_lp:
             lps = np.asarray(lps)
-        # Two phases: record EVERY slot's tokens, then fire callbacks — a
-        # raising callback must never cost another request (or a later
-        # chunk of its own request) its recorded tokens.
-        fired = []
+        # Two phases: record EVERY slot's tokens, then fire callbacks
+        # (admission-time deliveries included) — a raising callback must
+        # never cost another request (or a later chunk of its own request)
+        # its recorded tokens or a sibling sink its delivery.
         for i in range(self.n_slots):
             req = self._slot_req[i]
             if req is None:
@@ -979,6 +1009,12 @@ class ServingEngine:
                 req.logprobs.extend(lps[emitted[:, i], i].tolist())
             if req.on_token is not None and new:
                 fired.append((req.on_token, new))
+        self._deliver(fired)
+
+    @staticmethod
+    def _deliver(fired: list) -> None:
+        """Fire streaming sinks; every sink gets its delivery before the
+        first exception (if any) propagates."""
         first_exc = None
         for cb, new in fired:
             try:

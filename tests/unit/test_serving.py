@@ -506,10 +506,11 @@ def test_top_p_validation(model):
 
 
 def test_repetition_penalties(model):
-    """A huge presence penalty forbids any token from appearing twice in
-    the text-so-far (prompt included); zero penalties in a penalties-on
-    batch are bit-identical to a penalties-off engine; logprobs stay
-    raw-model."""
+    """A huge presence penalty forbids any token from being GENERATED
+    twice — but prompt tokens may still be generated once (penalties count
+    generated tokens only, the OpenAI convention; ADVICE r4 #3). Zero
+    penalties in a penalties-on batch are bit-identical to a penalties-off
+    engine; logprobs stay raw-model."""
     params, cfg = model
     eng = ServingEngine(params, cfg, n_slots=2, max_len=64, steps_per_sync=3)
     prompt = [4, 9, 2]
@@ -517,7 +518,7 @@ def test_repetition_penalties(model):
     r_zero = eng.submit([7, 7], 8, logprobs=True)  # penalties default 0
     res = eng.run()
     out = res[r_pen]
-    seen = set(prompt)
+    seen = set()
     for t in out.tolist():
         assert t not in seen, (t, out)
         seen.add(t)
@@ -568,3 +569,99 @@ def test_frequency_penalty_discourages_repeats(model):
     n_base = repeats(base.run()[rb])
     n_pen = repeats(pen.run()[rp])
     assert n_pen < n_base, (n_pen, n_base)
+
+
+def test_chunk_aligned_bucket_preferred(model):
+    """ADVICE r4 #1: with prefill_chunk set, a long prompt must route to
+    the smallest chunk-ALIGNED bucket (chunked O(chunk x len) admission),
+    not the unaligned top bucket's O(bucket^2) single-pass — while short
+    prompts keep their small buckets and results stay token-exact."""
+    params, cfg = model
+    eng = ServingEngine(params, cfg, n_slots=1, max_len=128,
+                        steps_per_sync=3, prefill_chunk=32)
+    # Default buckets with chunk=32 at max_len=128: aligned {32, 64, 128}
+    # plus the retained unaligned top 127.
+    assert 127 in eng.buckets and 128 in eng.buckets
+    bl = eng._bucket_len(100)
+    assert bl == 128, (bl, eng.buckets)  # aligned beats the 127 shadow
+    assert bl % eng.prefill_chunk == 0 and bl > eng.prefill_chunk
+    assert eng._bucket_len(10) == 32    # small prompts unchanged
+    prompt = list(range(1, 101))        # lands in the once-shadowed range
+    rid = eng.submit(prompt, 6)
+    res = eng.run()
+    np.testing.assert_array_equal(
+        res[rid], _reference(params, cfg, prompt, 6))
+
+
+def test_prefixed_suffix_skips_max_bucket_gate(model):
+    """ADVICE r4 #2: with custom small prefill_buckets, a valid
+    prefix+suffix request longer than max(buckets) must admit via
+    _suffix_bucket's exact-remainder fallback instead of being rejected;
+    plain prompts keep the gate."""
+    params, cfg = model
+    eng = ServingEngine(params, cfg, n_slots=1, max_len=64,
+                        prefill_buckets=(4,))
+    sysp = [9, 1, 4, 27]
+    pid = eng.register_prefix(sysp)
+    suffix = list(range(30, 38))  # 8 > max bucket 4
+    rid = eng.submit(suffix, 5, prefix_id=pid)
+    with pytest.raises(ValueError, match="exceeds largest prefill bucket"):
+        eng.submit(suffix, 5)  # unprefixed: still gated
+    res = eng.run()
+    np.testing.assert_array_equal(
+        res[rid], _reference(params, cfg, sysp + suffix, 5))
+
+
+def test_admission_callback_raise_defers(model):
+    """ADVICE r4 #4: a raising sink at ADMISSION must not abort the other
+    slot's admission, the burst, or any other sink's delivery — the
+    exception surfaces only after the sync's full two-phase delivery."""
+    params, cfg = model
+    eng = ServingEngine(params, cfg, n_slots=2, max_len=64, steps_per_sync=3)
+
+    got_ok: list = []
+
+    def bomb(_):
+        raise RuntimeError("admission sink down")
+
+    r_bomb = eng.submit([4, 9], 8, on_token=bomb)
+    r_ok = eng.submit([17, 2], 8, on_token=got_ok.extend)
+    with pytest.raises(RuntimeError, match="admission sink down"):
+        eng.step()
+    # Both requests were admitted and decoded through the burst; the OK
+    # sink got its admission token AND the burst chunk before the raise.
+    assert eng.stats()["occupied_slots"] == 2
+    assert len(got_ok) == 1 + eng.steps_per_sync
+    bomb_req = next(r for r in eng._slot_req if r and r.rid == r_bomb)
+    assert len(bomb_req.generated) == 1 + eng.steps_per_sync
+    # Detach the broken sink and drain: results stay token-exact.
+    bomb_req.on_token = None
+    res = eng.run()
+    np.testing.assert_array_equal(
+        res[r_ok], _reference(params, cfg, [17, 2], 8))
+    np.testing.assert_array_equal(
+        res[r_bomb], _reference(params, cfg, [4, 9], 8))
+    np.testing.assert_array_equal(np.asarray(got_ok, np.int32), res[r_ok])
+
+
+def test_unregister_prefix(model):
+    """ADVICE r4 #5: unregister_prefix reclaims the prefix K/V; admitted
+    traffic is unaffected, later submits see 'unknown prefix_id', queued
+    references block the unregister."""
+    params, cfg = model
+    eng = ServingEngine(params, cfg, n_slots=1, max_len=64)
+    sysp = [5, 40, 3, 21]
+    pid = eng.register_prefix(sysp)
+    rid = eng.submit([7, 2], 6, prefix_id=pid)
+    # Queued reference: refused with a pointer at the offender.
+    with pytest.raises(ValueError, match="queued request"):
+        eng.unregister_prefix(pid)
+    res = eng.run()
+    np.testing.assert_array_equal(
+        res[rid], _reference(params, cfg, sysp + [7, 2], 6))
+    eng.unregister_prefix(pid)
+    assert pid not in eng._prefixes  # device K/V released
+    with pytest.raises(ValueError, match="unknown prefix_id"):
+        eng.submit([1], 2, prefix_id=pid)
+    with pytest.raises(ValueError, match="unknown prefix_id"):
+        eng.unregister_prefix(pid)
