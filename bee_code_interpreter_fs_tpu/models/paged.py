@@ -25,6 +25,13 @@ re-shaped for XLA:
 - Prefill lands in a block-aligned contiguous scratch, then one scatter
   installs the whole prompt's blocks — admission stays O(bucket²) like
   the dense engine.
+- **Registered prefixes share physical blocks.** A prefix's full blocks
+  install once per engine; every sharing request's table points at the
+  same ids, with private copy-on-write blocks from the frontier (partial
+  last prefix block + suffix + generation). N sharers cost ~1x prefix +
+  Nx suffix of pool residency — the block-table win the dense engine's
+  per-slot prefix copy cannot express. Shared blocks pin while the
+  prefix is registered (and in use); `unregister_prefix` reclaims them.
 
 Everything the dense engine verifies holds here too (the test suite runs
 the same token-exactness matrix against both): greedy == greedy_generate,
@@ -233,6 +240,9 @@ class PagedServingEngine(ServingEngine):
         self.tables = jnp.zeros((self.n_slots, self.max_blocks), jnp.int32)
         self._free: list[int] = list(range(n_blocks))
         self._slot_blocks: list[list[int]] = [[] for _ in range(self.n_slots)]
+        # Which registered prefix (if any) each slot's table references —
+        # shared prefix blocks are pinned while any slot uses them.
+        self._slot_prefix: list[int | None] = [None] * self.n_slots
 
     # ------------------------------------------------------------ helpers
 
@@ -251,10 +261,8 @@ class PagedServingEngine(ServingEngine):
     def _install(self, req: Request, i: int):
         n = req.prompt.size
         if req.prefix_id is not None:
-            plen = self._prefixes[req.prefix_id]["len"]
-        else:
-            plen = 0
-        prompt_end = plen + n
+            return self._install_prefixed(req, i)
+        prompt_end = n
         need = self._blocks_for(prompt_end + req.max_new_tokens)
         if need > len(self._free):
             return None  # wait for retirements
@@ -263,60 +271,108 @@ class PagedServingEngine(ServingEngine):
         self.tables = self.tables.at[i, :need].set(
             jnp.asarray(blks, jnp.int32)
         )
-
-        if req.prefix_id is not None:
-            pf = self._prefixes[req.prefix_id]
-            if n == 0:
-                pad_to = self._pad_to_blocks(plen)
-                # Block-aligned copy memoized per prefix (block_size is
-                # fixed per engine): N sharing requests pay the pad once.
-                if "aligned_kv" not in pf:
-                    if pad_to != plen:
-                        grow = ((0, 0), (0, 0), (0, pad_to - plen),
-                                (0, 0), (0, 0))
-                        pf["aligned_kv"] = {
-                            "k": jnp.pad(pf["k"], grow),
-                            "v": jnp.pad(pf["v"], grow),
-                        }
-                    else:
-                        pf["aligned_kv"] = {"k": pf["k"], "v": pf["v"]}
-                nb = pad_to // self.block_size
-                install = (_pool_install_quant if self.kv_quant
-                           else _pool_install)
-                self.pool = install(
-                    self.pool, pf["aligned_kv"],
-                    jnp.asarray(blks[:nb], jnp.int32),
-                )
-                first = self._pick_first(req, pf["last_logits"], plen)
-            else:
-                bl = self._suffix_bucket(plen, n)
-                pad_to = self._pad_to_blocks(plen + bl)
-                padded = self._padded_prompt(req.prompt, bl)
-                last_logits, scratch = _prefill_scratch_prefixed(
-                    self._req_params(req), pf["k"], pf["v"],
-                    jnp.asarray(padded), jnp.int32(n), self.cfg, pad_to,
-                )
-                self.pool = self._install_scratch(scratch, blks, pad_to,
-                                                  need)
-                first = self._pick_first(req, last_logits, prompt_end)
+        bl = self._bucket_len(n)
+        pad_to = self._pad_to_blocks(bl)
+        if (self.prefill_chunk is not None
+                and pad_to > self.prefill_chunk
+                and pad_to % self.prefill_chunk == 0):
+            padded = self._padded_prompt(req.prompt, pad_to)
+            last_logits, scratch = _chunked_scratch_prefill(
+                self._req_params(req), jnp.asarray(padded),
+                jnp.int32(n), self.cfg, self.prefill_chunk,
+            )
         else:
-            bl = self._bucket_len(n)
-            pad_to = self._pad_to_blocks(bl)
-            if (self.prefill_chunk is not None
-                    and pad_to > self.prefill_chunk
-                    and pad_to % self.prefill_chunk == 0):
-                padded = self._padded_prompt(req.prompt, pad_to)
-                last_logits, scratch = _chunked_scratch_prefill(
-                    self._req_params(req), jnp.asarray(padded),
-                    jnp.int32(n), self.cfg, self.prefill_chunk,
+            padded = self._padded_prompt(req.prompt, bl)
+            last_logits, scratch = _prefill_scratch(
+                self._req_params(req), jnp.asarray(padded), jnp.int32(n),
+                self.cfg, pad_to,
+            )
+        self.pool = self._install_scratch(scratch, blks, pad_to, need)
+        first = self._pick_first(req, last_logits, prompt_end)
+        return first, prompt_end
+
+    def _install_prefixed(self, req: Request, i: int):
+        """Admission with a registered prefix, SHARING the prefix's full
+        blocks across requests (the block-table version of vLLM's prefix
+        caching): the prefix's `plen // block_size` full blocks are
+        installed into the pool ONCE per engine and every sharing request's
+        table points at the same physical ids; only the frontier — the
+        prefix's partial last block plus the request's suffix and
+        generation span — occupies private copy-on-write blocks. Pool
+        residency for N sharing requests is ~1x prefix + Nx suffix instead
+        of Nx (prefix + suffix).
+
+        Generation can never corrupt a shared block: decode writes land at
+        pos >= prompt_end >= shared_tokens, and pos // block_size >=
+        shared_nb indexes past the shared span of the table."""
+        pf = self._prefixes[req.prefix_id]
+        plen, n = pf["len"], req.prompt.size
+        bs = self.block_size
+        shared_nb = plen // bs
+        shared_tok = shared_nb * bs
+        prompt_end = plen + n
+        need_priv = self._blocks_for(
+            prompt_end + req.max_new_tokens
+        ) - shared_nb
+        alloc_shared = shared_nb if "pool_blocks" not in pf else 0
+        if need_priv + alloc_shared > len(self._free):
+            return None  # wait for retirements
+        install = _pool_install_quant if self.kv_quant else _pool_install
+        if alloc_shared:
+            shared = [self._free.pop() for _ in range(shared_nb)]
+            self.pool = install(
+                self.pool,
+                {"k": pf["k"][:, :, :shared_tok],
+                 "v": pf["v"][:, :, :shared_tok]},
+                jnp.asarray(shared, jnp.int32),
+            )
+            pf["pool_blocks"] = shared
+        blks = [self._free.pop() for _ in range(need_priv)]
+        self._slot_blocks[i] = blks  # private only; shared pins via prefix
+        table = list(pf.get("pool_blocks", ())) + blks
+        self.tables = self.tables.at[i, : len(table)].set(
+            jnp.asarray(table, jnp.int32)
+        )
+        self._slot_prefix[i] = req.prefix_id
+        pf["active_users"] = pf.get("active_users", 0) + 1
+
+        if n == 0:
+            rem = plen - shared_tok
+            if rem:
+                # Copy-on-write frontier: the prefix's partial last block
+                # becomes this request's first private block (padded copy
+                # memoized per prefix — N sharers pay the pad once).
+                if "aligned_rem" not in pf:
+                    grow = ((0, 0), (0, 0), (0, bs - rem), (0, 0), (0, 0))
+                    pf["aligned_rem"] = {
+                        "k": jnp.pad(pf["k"][:, :, shared_tok:], grow),
+                        "v": jnp.pad(pf["v"][:, :, shared_tok:], grow),
+                    }
+                self.pool = install(
+                    self.pool, pf["aligned_rem"],
+                    jnp.asarray(blks[:1], jnp.int32),
                 )
-            else:
-                padded = self._padded_prompt(req.prompt, bl)
-                last_logits, scratch = _prefill_scratch(
-                    self._req_params(req), jnp.asarray(padded), jnp.int32(n),
-                    self.cfg, pad_to,
-                )
-            self.pool = self._install_scratch(scratch, blks, pad_to, need)
+            first = self._pick_first(req, pf["last_logits"], plen)
+        else:
+            bl = self._suffix_bucket(plen, n)
+            pad_to = self._pad_to_blocks(plen + bl)
+            padded = self._padded_prompt(req.prompt, bl)
+            last_logits, scratch = _prefill_scratch_prefixed(
+                self._req_params(req), pf["k"], pf["v"],
+                jnp.asarray(padded), jnp.int32(n), self.cfg, pad_to,
+            )
+            # Install only the frontier: [shared_tok, ...) — the shared
+            # span already lives in the pool. Trim to the private
+            # reservation (bucket padding can overshoot it).
+            t_inst = min(pad_to - shared_tok, need_priv * bs)
+            frontier = {
+                "k": scratch["k"][:, :, shared_tok:shared_tok + t_inst],
+                "v": scratch["v"][:, :, shared_tok:shared_tok + t_inst],
+            }
+            self.pool = install(
+                self.pool, frontier,
+                jnp.asarray(blks[: t_inst // bs], jnp.int32),
+            )
             first = self._pick_first(req, last_logits, prompt_end)
         return first, prompt_end
 
@@ -342,12 +398,35 @@ class PagedServingEngine(ServingEngine):
     def _on_retire(self, i: int) -> None:
         self._free.extend(self._slot_blocks[i])
         self._slot_blocks[i] = []
+        pid = self._slot_prefix[i]
+        if pid is not None:
+            self._slot_prefix[i] = None
+            pf = self._prefixes.get(pid)
+            if pf is not None:
+                pf["active_users"] -= 1
+
+    def unregister_prefix(self, prefix_id: int) -> None:
+        pf = self._prefixes.get(prefix_id)
+        if pf is not None and pf.get("active_users", 0) > 0:
+            raise ValueError(
+                f"prefix {prefix_id} is referenced by {pf['active_users']} "
+                "active slot(s); drain or cancel them first"
+            )
+        super().unregister_prefix(prefix_id)  # raises for unknown/queued
+        if pf is not None and "pool_blocks" in pf:
+            self._free.extend(pf["pool_blocks"])
 
     def stats(self) -> dict:
         out = super().stats()
-        total = len(self._free) + sum(len(b) for b in self._slot_blocks)
+        shared = sum(
+            len(pf.get("pool_blocks", ()))
+            for pf in self._prefixes.values()
+        )
+        total = (len(self._free) + shared
+                 + sum(len(b) for b in self._slot_blocks))
         out.update({
             "free_blocks": len(self._free),
+            "shared_prefix_blocks": shared,
             "total_blocks": total,
             "block_size": self.block_size,
         })

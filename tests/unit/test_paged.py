@@ -213,3 +213,104 @@ def test_paged_kv_quant_matches_dense_quant(model):
     quant_bytes = sum(v.nbytes for v in pe.pool.values())
     dense_bytes = sum(v.nbytes for v in full.pool.values())
     assert quant_bytes < 0.6 * dense_bytes
+
+
+def test_prefix_blocks_shared_across_requests(model):
+    """VERDICT r4 #7: N requests sharing a registered prefix must occupy
+    ~1x prefix + Nx suffix of pool residency — their tables point at the
+    SAME physical prefix blocks — while staying token-exact. The pool here
+    is sized so per-request prefix COPIES (old behavior: 4 blocks each)
+    could not fit; admission succeeding at all proves the sharing."""
+    params, cfg = model
+    bs = 4
+    sysp = list(range(1, 11))           # plen=10: 2 shared blocks + rem 2
+    eng = PagedServingEngine(params, cfg, n_slots=3, max_len=64,
+                             block_size=bs, n_blocks=16, steps_per_sync=3)
+    pid = eng.register_prefix(sysp)
+    free0 = eng.free_blocks
+    # Each: suffix 2 -> prompt_end 12, +8 new = 20 tokens -> 5 blocks total,
+    # minus 2 shared = 3 private. Dense copies would need 3*5=15 blocks;
+    # shared needs 2 + 3*3 = 11 <= 16.
+    suffixes = [[20, 21], [30, 31], [40, 41]]
+    rids = [eng.submit(s, 8, prefix_id=pid) for s in suffixes]
+    eng.step()  # all three admit concurrently
+    s = eng.stats()
+    assert s["shared_prefix_blocks"] == 2
+    assert s["occupied_slots"] == 3
+    # Residency while all 3 are resident: 2 shared + 3x3 private.
+    assert free0 - eng.free_blocks == 2 + 3 * 3
+    # Tables literally share the physical prefix block ids.
+    tables = np.asarray(eng.tables)
+    pf_blocks = eng._prefixes[pid]["pool_blocks"]
+    for row in range(3):
+        np.testing.assert_array_equal(tables[row, :2], pf_blocks)
+    res = eng.run()
+    for rid, sfx in zip(rids, suffixes):
+        np.testing.assert_array_equal(
+            res[rid], _reference(params, cfg, sysp + sfx, 8))
+    # Private blocks returned; shared stay pinned until unregister.
+    assert eng.free_blocks == free0 - 2
+    eng.unregister_prefix(pid)
+    assert eng.free_blocks == free0
+
+
+def test_prefix_sharing_empty_suffix_and_aligned(model):
+    """Empty-suffix sharers and a block-ALIGNED prefix (no remainder, no
+    copy-on-write block) both stay token-exact; generation after the
+    shared span never corrupts a sibling's output."""
+    params, cfg = model
+    bs = 4
+    for plen in (8, 10):               # aligned (rem 0) and unaligned
+        sysp = [3] * plen
+        eng = PagedServingEngine(params, cfg, n_slots=2, max_len=64,
+                                 block_size=bs, steps_per_sync=4)
+        pid = eng.register_prefix(sysp)
+        r1 = eng.submit([], 6, prefix_id=pid)
+        r2 = eng.submit([], 6, prefix_id=pid)
+        r3 = eng.submit([9, 8, 7], 5, prefix_id=pid)
+        res = eng.run()
+        ref_empty = _reference(params, cfg, sysp, 6)
+        np.testing.assert_array_equal(res[r1], ref_empty)
+        np.testing.assert_array_equal(res[r2], ref_empty)
+        np.testing.assert_array_equal(
+            res[r3], _reference(params, cfg, sysp + [9, 8, 7], 5))
+
+
+def test_prefix_sharing_kv_quant(model):
+    """Shared prefix blocks through the int8 pool: same quantization
+    granularity as the dense engine's whole-row quantize, so outputs match
+    the dense int8 engine token-exactly."""
+    params, cfg = model
+    sysp = list(range(5, 18))  # plen=13: 1 shared block (bs=8) + rem 5
+
+    def drive(cls, **kw):
+        eng = cls(params, cfg, n_slots=2, max_len=96, steps_per_sync=3,
+                  kv_quant=True, **kw)
+        pid = eng.register_prefix(sysp)
+        rids = [eng.submit([40, 2], 7, prefix_id=pid),
+                eng.submit([], 6, prefix_id=pid)]
+        res = eng.run()
+        return [res[r] for r in rids]
+
+    dense = drive(ServingEngine)
+    paged = drive(PagedServingEngine, block_size=8)
+    for d, p in zip(dense, paged):
+        np.testing.assert_array_equal(d, p)
+
+
+def test_unregister_prefix_paged_guards(model):
+    """unregister while a sharer is ACTIVE is refused; after drain it
+    frees the shared blocks and subsequent submits fail cleanly."""
+    params, cfg = model
+    eng = PagedServingEngine(params, cfg, n_slots=1, max_len=64,
+                             block_size=4, steps_per_sync=2)
+    pid = eng.register_prefix([7] * 9)
+    rid = eng.submit([1], 8, prefix_id=pid)
+    eng.step()  # admitted, still active
+    with pytest.raises(ValueError, match="active slot"):
+        eng.unregister_prefix(pid)
+    res = eng.run()
+    assert res[rid].size == 8
+    eng.unregister_prefix(pid)
+    with pytest.raises(ValueError, match="unknown prefix_id"):
+        eng.submit([1], 2, prefix_id=pid)
