@@ -51,6 +51,9 @@ from bee_code_interpreter_fs_tpu.models.lora import (
 )
 from bee_code_interpreter_fs_tpu.models.paged import PagedServingEngine
 from bee_code_interpreter_fs_tpu.models.serving import ServingEngine
+from bee_code_interpreter_fs_tpu.models.spec_serving import (
+    SpeculativeServingEngine,
+)
 
 __all__ = [
     "LlamaConfig",
@@ -88,4 +91,5 @@ __all__ = [
     "stack_loras",
     "PagedServingEngine",
     "ServingEngine",
+    "SpeculativeServingEngine",
 ]

@@ -1,0 +1,285 @@
+"""Speculative decoding composed INTO the continuous-batching engine.
+
+The framework's two best decode accelerators could not previously be used
+together: `speculative_generate` (llama.py) is a whole-generation,
+batch-lockstep program, and `ServingEngine` (serving.py) decodes one token
+per slot per step. This module puts a draft/verify loop inside the burst
+body, per SLOT — the shape production TPU servers use:
+
+- Every burst pass, a cheap DRAFT model proposes γ tokens per slot
+  autoregressively (γ+1 fused per-slot decode steps), then the TARGET
+  scores all proposals in ONE per-slot chunked forward
+  (`_perslot_decode_chunk`): up to γ+1 target tokens per slot per pass
+  instead of 1.
+- Unlike the lockstep generator, slots accept INDEPENDENTLY — the slot
+  bank's per-slot position vector already carries ragged progress, so a
+  slot that agreed γ deep advances γ+1 while its neighbor advances 1.
+- Greedy acceptance = token equality, so the emitted stream is EXACTLY
+  the non-speculative engine's (token-exact; the draft only decides how
+  many target tokens a pass yields, never what they are).
+
+The win is at LOW slot occupancy: decode at small active-batch is
+weight-HBM-bound, so γ draft steps (a model 10-30x smaller) plus one
+γ+1-token target pass reads the big weight tree once where plain decode
+reads it γ+1 times. At high occupancy the target pass is already
+compute-dense and speculation's edge shrinks — measure before deploying
+(examples/benchmark-serving-spec.py).
+
+Cache-consistency invariant (same overwrite-before-read rule the dense
+engine relies on): the verify chunk writes K/V for positions
+pos..pos+γ; positions past the acceptance point hold K/V of REJECTED
+draft tokens, but the next pass's chunk starts at pos' <= pos+accept+1
+and rewrites every such position before any query can attend it (a query
+at q only sees keys <= q, and key q is rewritten by the chunk covering it
+before the first query with q' >= q runs).
+
+v1 scope: greedy requests on the dense bf16/f32 cache. Sampling,
+logprobs, penalties, prefix caching, LoRA adapters, and kv_quant are
+rejected at submit()/__init__ — compose with the plain engine for those.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bee_code_interpreter_fs_tpu.models.llama import (
+    LlamaConfig,
+    _cached_gqa_attention,
+    _rms_norm,
+    _w,
+    decode_valid_mask,
+    init_cache,
+    transformer_block,
+)
+from bee_code_interpreter_fs_tpu.models.serving import (
+    Request,
+    ServingEngine,
+    _admit,
+    _perslot_decode_step,
+)
+
+__all__ = ["SpeculativeServingEngine"]
+
+
+def _perslot_decode_chunk(params, tokens, cache, pos, cfg: LlamaConfig):
+    """Chunked decode where every slot's chunk starts at its OWN position:
+    tokens [b, s] with slot i's token j at global position pos[i]+j — the
+    s>1 generalization of serving._perslot_decode_step (vector RoPE
+    offsets, per-slot-per-query causal masks, per-slot chunk scatters).
+    Returns (logits [b, s, vocab] f32 for all s positions, updated cache).
+    This is the serving engine's speculative VERIFY pass."""
+    dt = jnp.dtype(cfg.dtype)
+    scale = cfg.head_dim ** -0.5
+    b, s = tokens.shape
+    max_len = cache["k"].shape[2]
+    qpos = pos[:, None] + jnp.arange(s)[None, :]  # [b, s]
+    # Slot i's query j sees cache positions <= pos[i]+j (window/sinks via
+    # the one shared visibility formula).
+    valid = decode_valid_mask(qpos.reshape(-1), max_len, cfg).reshape(
+        b, s, max_len
+    )[:, None, None, :, :]
+    x = params["embed"].astype(dt)[tokens]
+    bidx = jnp.arange(b)
+
+    def layer(x, inputs):
+        lp, ck, cv = inputs
+        cell = {}
+
+        def attn_fn(q, k, v):
+            # Per-slot scatter of the whole chunk at each slot's frontier
+            # (out-of-bounds rows of an inactive slot's stale qpos drop).
+            new_k = ck.at[bidx[:, None], qpos].set(k)
+            new_v = cv.at[bidx[:, None], qpos].set(v)
+            cell["kv"] = (new_k, new_v)
+            return _cached_gqa_attention(q, new_k, new_v, valid, scale)
+
+        x = transformer_block(x, lp, cfg, attn_fn, rope_offset=pos)
+        return x, cell["kv"]
+
+    x, (new_k, new_v) = lax.scan(
+        layer, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ _w(params["lm_head"], dt)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "dcfg", "steps", "gamma", "eos_id"),
+    donate_argnames=("cache", "dcache"),
+)
+def _spec_decode_burst(params, dparams, cache, dcache, pos, last_tok,
+                       remaining, active, cfg: LlamaConfig,
+                       dcfg: LlamaConfig, steps: int, gamma: int, eos_id):
+    """`steps` draft/verify passes over the slot bank, one jitted program.
+
+    Invariant at the top of each pass (per slot): `last_tok[i]` is the
+    newest emitted token, sitting unfed at position pos[i]; both caches
+    hold K/V for positions < pos[i]. Each pass emits 1..γ+1 tokens per
+    active slot (clamped by budget and eos). Returns the updated carry
+    plus (toks [steps, b, γ+1], emitted [steps, b, γ+1]) — pass-major
+    emission order, so flattening the trailing axis reconstructs each
+    slot's stream exactly."""
+    b = pos.shape[0]
+    bidx = jnp.arange(b)
+    idx = jnp.arange(gamma + 1)
+
+    def one(carry, _):
+        cache, dcache, pos, tok, remaining, active = carry
+
+        # Draft rollout: γ+1 per-slot steps. Step j feeds the token at
+        # position pos+j; steps 0..γ-1 yield proposals d_1..d_γ, the extra
+        # step feeds d_γ so the draft cache covers pos+γ for the
+        # all-accepted case (mirrors llama.speculative_generate's droll).
+        def droll(c, j):
+            t, dc = c
+            logits, dc = _perslot_decode_step(
+                dparams, t[:, None], dc, pos + j, dcfg
+            )
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (nxt, dc), nxt
+
+        (_, dcache), props = lax.scan(
+            droll, (tok, dcache), jnp.arange(gamma + 1)
+        )
+        drafts = props[:gamma].T  # [b, γ]
+
+        # Verify: target scores [pending, d_1..d_γ] at pos..pos+γ in one
+        # per-slot chunk; t_preds[:, j] is the target's choice for
+        # position pos+j+1.
+        chunk = jnp.concatenate([tok[:, None], drafts], axis=1)
+        v_logits, cache = _perslot_decode_chunk(params, chunk, cache, pos, cfg)
+        t_preds = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)  # [b, γ+1]
+
+        # Per-slot longest agreeing prefix — NO batch-min lockstep: the
+        # slot bank's position vector carries ragged progress natively.
+        agree = drafts == t_preds[:, :gamma]
+        row_accept = jnp.where(
+            agree.all(axis=1), gamma,
+            jnp.argmin(agree.astype(jnp.int32), axis=1),
+        )
+        emit_n = jnp.minimum(row_accept + 1, remaining)
+        if eos_id is not None:
+            # Stop at (and include) the first emitted eos.
+            is_eos = (t_preds == eos_id) & (idx[None] < emit_n[:, None])
+            first_eos = jnp.where(
+                is_eos.any(axis=1), jnp.argmax(is_eos, axis=1), gamma + 1
+            )
+            emit_n = jnp.minimum(emit_n, first_eos + 1)
+        emit_n = jnp.where(active, emit_n, 0)
+        emitted = idx[None, :] < emit_n[:, None]  # [b, γ+1]
+        new_tok = jnp.where(
+            active, t_preds[bidx, jnp.maximum(emit_n - 1, 0)], tok
+        )
+        pos = pos + emit_n
+        remaining = remaining - emit_n
+        active = active & (remaining > 0)
+        if eos_id is not None:
+            active = active & (new_tok != eos_id)
+        return (cache, dcache, pos, new_tok, remaining, active), (
+            t_preds, emitted
+        )
+
+    carry, (toks, emitted) = lax.scan(
+        one, (cache, dcache, pos, last_tok, remaining, active),
+        None, length=steps,
+    )
+    cache, dcache, pos, tok, remaining, active = carry
+    return cache, dcache, pos, tok, remaining, active, toks, emitted
+
+
+class SpeculativeServingEngine(ServingEngine):
+    """Continuous batching with per-slot speculative decoding.
+
+    >>> eng = SpeculativeServingEngine(params, cfg, draft_params=dp,
+    ...                                draft_cfg=dcfg, gamma=4, n_slots=4)
+    >>> rid = eng.submit([1, 5, 9], max_new_tokens=64)
+    >>> eng.run()   # token-exact vs ServingEngine on the same traffic
+
+    Each scheduler sync runs `steps_per_sync` draft/verify passes, so a
+    slot can emit up to steps_per_sync*(γ+1) tokens per sync (streaming
+    chunks grow accordingly). Scope: greedy only — see module doc."""
+
+    def __init__(self, params, cfg: LlamaConfig, *, draft_params,
+                 draft_cfg: LlamaConfig, gamma: int = 4, **kwargs):
+        if draft_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError("draft and target must share a vocabulary")
+        if gamma < 1:
+            raise ValueError(
+                "gamma must be >= 1 (0 proposals leaves nothing to "
+                "verify; use ServingEngine for plain decoding)"
+            )
+        for unsupported in ("kv_quant", "adapters"):
+            if kwargs.get(unsupported):
+                raise ValueError(
+                    f"{unsupported} is not supported by the speculative "
+                    "engine (v1); use ServingEngine"
+                )
+        self.draft_params = draft_params
+        self.dcfg = draft_cfg
+        self.gamma = int(gamma)
+        super().__init__(params, cfg, **kwargs)
+        self.dcache = init_cache(self.dcfg, self.n_slots, self.max_len)
+
+    def submit(self, prompt, max_new_tokens: int, prefix_id=None, **kw):
+        if prefix_id is not None:
+            raise ValueError(
+                "prefix caching is not supported by the speculative "
+                "engine (v1): the draft model would need its own prefix "
+                "K/V; use ServingEngine"
+            )
+        if kw.get("temperature", 0.0) > 0 or kw.get("top_p", 1.0) < 1.0:
+            raise ValueError(
+                "the speculative engine is greedy-only (v1): token "
+                "equality is the acceptance rule; use ServingEngine for "
+                "sampling"
+            )
+        for unsupported in ("logprobs", "presence_penalty",
+                            "frequency_penalty", "adapter"):
+            if kw.get(unsupported):
+                raise ValueError(
+                    f"{unsupported} is not supported by the speculative "
+                    "engine (v1); use ServingEngine"
+                )
+        return super().submit(prompt, max_new_tokens, None, **kw)
+
+    def _install(self, req: Request, i: int):
+        placed = super()._install(req, i)
+        if placed is None:  # pragma: no cover — dense engine never defers
+            return None
+        # Mirror the admission into the DRAFT cache: same bucket, same
+        # slot row; the draft's admission logits are discarded (the
+        # target picked the first token).
+        n = req.prompt.size
+        bl = self._bucket_len(n)
+        padded = self._padded_prompt(req.prompt, bl)
+        self.dcache, _ = _admit(
+            self.draft_params, self.dcache, jnp.asarray(padded),
+            jnp.int32(i), jnp.int32(n), self.dcfg,
+        )
+        return placed
+
+    def _run_burst(self, with_logprobs: bool = False,
+                   with_top_p: bool = False, with_penalties: bool = False):
+        # submit() rejected everything that could set these flags.
+        assert not (with_logprobs or with_top_p or with_penalties)
+        (self.cache, self.dcache, self.pos, self.last_tok, self.remaining,
+         self.active, toks, emitted) = _spec_decode_burst(
+            self.params, self.draft_params, self.cache, self.dcache,
+            self.pos, self.last_tok, self.remaining, self.active,
+            self.cfg, self.dcfg, self.steps_per_sync, self.gamma,
+            self.eos_id,
+        )
+        # [steps, b, γ+1] → [steps*(γ+1), b], pass-major then within-pass:
+        # exactly each slot's emission order, so the base step() consumes
+        # it unchanged.
+        s, b, g1 = toks.shape
+        toks = jnp.transpose(toks, (0, 2, 1)).reshape(s * g1, b)
+        emitted = jnp.transpose(emitted, (0, 2, 1)).reshape(s * g1, b)
+        return toks, emitted, None

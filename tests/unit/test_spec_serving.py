@@ -1,0 +1,163 @@
+"""Speculative serving engine: token-exact vs the plain engine, ragged
+per-slot acceptance, and fewer scheduler syncs when the draft agrees."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bee_code_interpreter_fs_tpu.models.llama import (
+    LlamaConfig,
+    greedy_generate,
+    init_params,
+)
+from bee_code_interpreter_fs_tpu.models.serving import ServingEngine
+from bee_code_interpreter_fs_tpu.models.spec_serving import (
+    SpeculativeServingEngine,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig.tiny(n_layers=2, dim=64, hidden_dim=128, n_heads=4,
+                           n_kv_heads=2, vocab_size=97, max_seq_len=128,
+                           dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    # Draft: a DIFFERENT (smaller) model sharing the vocabulary — realistic
+    # partial agreement with the target.
+    dcfg = LlamaConfig.tiny(n_layers=1, dim=32, hidden_dim=64, n_heads=2,
+                            n_kv_heads=2, vocab_size=97, max_seq_len=128,
+                            dtype="float32")
+    dparams = init_params(jax.random.PRNGKey(3), dcfg)
+    return params, cfg, dparams, dcfg
+
+
+def _reference(params, cfg, prompt, max_new, eos_id=None):
+    out = greedy_generate(
+        params, jnp.asarray([prompt], jnp.int32), cfg,
+        max_new_tokens=max_new, eos_id=eos_id,
+    )
+    gen = np.asarray(out)[0, len(prompt):]
+    if eos_id is not None:
+        hits = np.nonzero(gen == eos_id)[0]
+        if hits.size:
+            gen = gen[: hits[0] + 1]
+    return gen
+
+
+def test_token_exact_vs_plain_engine(model):
+    """Mixed staggered traffic through the speculative engine must emit
+    EXACTLY what the plain engine emits (= greedy_generate), with a draft
+    that only partially agrees — acceptance shapes speed, never tokens."""
+    params, cfg, dparams, dcfg = model
+    reqs = [
+        ([5], 7),
+        ([1, 2, 3, 4, 5, 6, 7], 11),
+        (list(range(20, 40)), 5),
+        ([88, 2], 15),
+    ]
+    eng = SpeculativeServingEngine(
+        params, cfg, draft_params=dparams, draft_cfg=dcfg, gamma=3,
+        n_slots=2, max_len=96, steps_per_sync=2,
+    )
+    rids = [eng.submit(p, m) for p, m in reqs]
+    res = eng.run()
+    for rid, (p, m) in zip(rids, reqs):
+        np.testing.assert_array_equal(
+            res[rid], _reference(params, cfg, p, m))
+
+
+def test_eos_stops_mid_pass(model):
+    """An eos emitted mid-acceptance must truncate the pass's emission at
+    (and including) the eos, exactly like the plain engine."""
+    params, cfg, dparams, dcfg = model
+    prompt = [7, 42, 3]
+    free = _reference(params, cfg, prompt, 12)
+    eos = int(free[2])
+    ref = _reference(params, cfg, prompt, 12, eos_id=eos)
+    assert ref.size < 12
+    eng = SpeculativeServingEngine(
+        params, cfg, draft_params=dparams, draft_cfg=dcfg, gamma=4,
+        n_slots=2, max_len=64, steps_per_sync=3, eos_id=eos,
+    )
+    rid = eng.submit(prompt, 12)
+    other = eng.submit([9, 9, 1], 8)
+    res = eng.run()
+    np.testing.assert_array_equal(res[rid], ref)
+    np.testing.assert_array_equal(
+        res[other], _reference(params, cfg, [9, 9, 1], 8, eos_id=eos))
+
+
+def test_perfect_draft_advances_gamma_plus_one(model):
+    """With draft == target, every pass accepts γ proposals + the bonus
+    token: the generation finishes in ~max_new/(γ+1) passes instead of
+    max_new — the speculation speedup made deterministic."""
+    params, cfg, _, _ = model
+    gamma = 3
+
+    def syncs_to_finish(make):
+        eng = make()
+        eng.submit([4, 9, 2], 24)
+        n = 0
+        while eng._queue or any(r is not None for r in eng._slot_req):
+            eng.step()
+            n += 1
+        return n
+
+    plain = syncs_to_finish(lambda: ServingEngine(
+        params, cfg, n_slots=1, max_len=64, steps_per_sync=1))
+    spec = syncs_to_finish(lambda: SpeculativeServingEngine(
+        params, cfg, draft_params=params, draft_cfg=cfg, gamma=gamma,
+        n_slots=1, max_len=64, steps_per_sync=1))
+    # plain: 1 token/sync (admission covers the first). spec: γ+1/sync.
+    assert plain == 23 + 1  # 23 burst tokens + final retire sweep
+    assert spec <= -(-23 // (gamma + 1)) + 1
+    # And still token-exact.
+    eng = SpeculativeServingEngine(
+        params, cfg, draft_params=params, draft_cfg=cfg, gamma=gamma,
+        n_slots=1, max_len=64)
+    rid = eng.submit([4, 9, 2], 24)
+    np.testing.assert_array_equal(
+        eng.run()[rid], _reference(params, cfg, [4, 9, 2], 24))
+
+
+def test_streaming_and_budget(model):
+    """on_token chunks concatenate to exactly the final result (chunks may
+    carry up to steps*(γ+1) tokens), and max_new_tokens is never
+    overshot even when acceptance would run past it."""
+    params, cfg, _, _ = model
+    eng = SpeculativeServingEngine(
+        params, cfg, draft_params=params, draft_cfg=cfg, gamma=4,
+        n_slots=1, max_len=64, steps_per_sync=2)
+    got = []
+    rid = eng.submit([8, 3], 9, on_token=got.extend)
+    res = eng.run()
+    assert res[rid].size == 9  # perfect draft would accept past the budget
+    np.testing.assert_array_equal(np.asarray(got, np.int32), res[rid])
+    np.testing.assert_array_equal(res[rid], _reference(params, cfg, [8, 3], 9))
+
+
+def test_validation(model):
+    params, cfg, dparams, dcfg = model
+    mk = lambda **kw: SpeculativeServingEngine(  # noqa: E731
+        params, cfg, draft_params=dparams, draft_cfg=dcfg,
+        n_slots=1, max_len=32, **kw)
+    with pytest.raises(ValueError, match="gamma"):
+        mk(gamma=0)
+    with pytest.raises(ValueError, match="vocabulary"):
+        SpeculativeServingEngine(
+            params, cfg, draft_params=dparams,
+            draft_cfg=LlamaConfig.tiny(vocab_size=11), n_slots=1)
+    with pytest.raises(ValueError, match="kv_quant"):
+        mk(kv_quant=True)
+    eng = mk(gamma=2)
+    with pytest.raises(ValueError, match="greedy-only"):
+        eng.submit([1], 2, temperature=0.7)
+    with pytest.raises(ValueError, match="greedy-only"):
+        eng.submit([1], 2, top_p=0.9)
+    with pytest.raises(ValueError, match="logprobs"):
+        eng.submit([1], 2, logprobs=True)
+    with pytest.raises(ValueError, match="prefix"):
+        eng.submit([1], 2, prefix_id=0)
+    with pytest.raises(ValueError, match="presence_penalty"):
+        eng.submit([1], 2, presence_penalty=0.5)
