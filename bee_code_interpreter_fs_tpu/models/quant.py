@@ -224,6 +224,71 @@ def quantized_nbytes(params) -> int:
     return total
 
 
+def random_quantized_params(key, cfg, precision: str = "int8") -> dict:
+    """Random weight tree at cfg's exact shapes with the matmul weights
+    ALREADY quantized — the bf16 tree never exists, so peak HBM stays at
+    the quantized footprint (a 7B bf16 tree is ~13.5 GB and cannot
+    coexist with its own quantized copy on a 16 GB v5e). Scales are sized
+    like a real symmetric-quantized Gaussian init so logit magnitudes stay
+    sane; the code path downstream (`_w` accessor, fused decode) is
+    byte-for-byte the one real checkpoints take. Used by the true-scale
+    single-chip benchmarks (examples/benchmark-7b.py,
+    examples/benchmark-serving-7b.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bee_code_interpreter_fs_tpu.models.llama import init_params
+
+    if precision not in ("int8", "int4"):
+        raise ValueError(f"precision must be int8 or int4, got {precision!r}")
+    shapes = jax.eval_shape(lambda k: init_params(k, cfg), key)
+
+    def leaf(path_key, shape_dtype, k):
+        shape = shape_dtype.shape
+        if path_key in QUANTIZED_LAYER_WEIGHTS or path_key == "lm_head":
+            kq, _ = jax.random.split(k)
+            if precision == "int4":
+                group = min(128, shape[-2])
+                return {
+                    # Random bytes = random nibble pairs; scale magnitude
+                    # mirrors quantize_int4 of a 0.02-std init.
+                    "q4": jax.random.randint(
+                        kq, shape[:-2] + (shape[-2] // 2,) + shape[-1:],
+                        -128, 128, jnp.int8,
+                    ),
+                    "s4": jnp.full(
+                        shape[:-2] + (shape[-2] // group, 1) + shape[-1:],
+                        shape[-2] ** -0.5 / 7.0,
+                        jnp.float32,
+                    ),
+                }
+            return {
+                "q": jax.random.randint(kq, shape, -127, 128, jnp.int8),
+                "s": jnp.full(
+                    shape[:-2] + (1,) + shape[-1:],
+                    shape[-2] ** -0.5 / 127.0,
+                    jnp.float32,
+                ),
+            }
+        if "norm" in path_key:
+            return jnp.ones(shape, shape_dtype.dtype)
+        return jax.random.normal(k, shape, jnp.float32).astype(
+            shape_dtype.dtype
+        ) * (0.02 if path_key != "embed" else 1.0)
+
+    out = {}
+    keyit = iter(jax.random.split(key, 64))
+    for name, sub in shapes.items():
+        if isinstance(sub, dict):
+            out[name] = {
+                child: leaf(child, sd, next(keyit))
+                for child, sd in sub.items()
+            }
+        else:
+            out[name] = leaf(name, sub, next(keyit))
+    return out
+
+
 # ---------------------------------------------------------- KV-cache int8
 
 def quantize_kv(x):

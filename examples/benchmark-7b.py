@@ -28,7 +28,7 @@ from bee_code_interpreter_fs_tpu.models import (
     init_params,
     quantized_nbytes,
 )
-from bee_code_interpreter_fs_tpu.models.quant import QUANTIZED_LAYER_WEIGHTS
+from bee_code_interpreter_fs_tpu.models.quant import random_quantized_params
 
 ON_TPU = jax.devices()[0].platform == "tpu"
 # BENCH_MODEL picks the geometry; BENCH_PRECISION picks int8 (default) or
@@ -54,53 +54,9 @@ else:  # correctness-check shapes for dev machines / CI
     PREFILL_T, NEW_TOKENS, BATCH = 32, 8, 1
 
 
-def build_quantized_params(key, cfg, precision="int8"):
-    """Random quantized-serving tree at cfg's exact shapes, no bf16 detour."""
-    shapes = jax.eval_shape(lambda k: init_params(k, cfg), key)
-
-    def leaf(path_key, shape_dtype, k):
-        shape = shape_dtype.shape
-        if path_key in QUANTIZED_LAYER_WEIGHTS or path_key == "lm_head":
-            kq, ks = jax.random.split(k)
-            if precision == "int4":
-                group = min(128, shape[-2])
-                return {
-                    # Random bytes = random nibble pairs; scales sized like
-                    # a real quantized init so logit magnitudes stay sane.
-                    "q4": jax.random.randint(
-                        kq, shape[:-2] + (shape[-2] // 2,) + shape[-1:],
-                        -128, 128, jnp.int8,
-                    ),
-                    "s4": jnp.full(
-                        shape[:-2] + (shape[-2] // group, 1) + shape[-1:],
-                        shape[-2] ** -0.5 / 7.0,
-                        jnp.float32,
-                    ),
-                }
-            return {
-                "q": jax.random.randint(kq, shape, -127, 128, jnp.int8),
-                "s": jnp.full(
-                    shape[:-2] + (1,) + shape[-1:],
-                    shape[-2] ** -0.5 / 127.0,
-                    jnp.float32,
-                ),
-            }
-        if "norm" in path_key:
-            return jnp.ones(shape, shape_dtype.dtype)
-        return jax.random.normal(k, shape, jnp.float32).astype(
-            shape_dtype.dtype
-        ) * (0.02 if path_key != "embed" else 1.0)
-
-    out = {}
-    keyit = iter(jax.random.split(key, 64))
-    for name, sub in shapes.items():
-        if isinstance(sub, dict):
-            out[name] = {
-                child: leaf(child, sd, next(keyit)) for child, sd in sub.items()
-            }
-        else:
-            out[name] = leaf(name, sub, next(keyit))
-    return out
+# The quantized-tree builder lives in the framework (models/quant.py
+# random_quantized_params) so every true-scale bench shares one recipe.
+build_quantized_params = random_quantized_params
 
 
 t0 = time.perf_counter()

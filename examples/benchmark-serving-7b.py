@@ -1,0 +1,123 @@
+"""The config-5 capstone at TRUE scale: Llama-2-7B (int8 weights, int8 KV)
+resident on ONE v5e chip, serving 16 concurrent requests through the
+continuous-batching engine — the honest single-chip version of BASELINE
+config 5's "16 concurrent requests" (one resident model, 16 requests,
+instead of 16 CPU sandboxes; VERDICT r4 #5).
+
+Reports, from the chip:
+  SERVING7B_TOKS           aggregate generated tok/s (submit -> drain)
+  SERVING7B_PER_TOKEN_MS   median per-token streaming latency a client
+                           sees (inter-chunk gap / chunk size via on_token)
+  SERVING7B_UTILIZATION    mean active-slot fraction across scheduler syncs
+  SERVING7B_SLOTS / _REQS  engine geometry for the BASELINE row
+
+Memory budget (v5e 16 GB HBM): ~6.8 GB int8 weights + ~1.1 GB int8 KV
+(8 slots x 512 ctx) + activations — the bf16 weight tree (13.5 GB) never
+exists (models/quant.py random_quantized_params) and the bf16 KV cache
+(2.1 GB) is halved by kv_quant. On CPU rigs a tiny config keeps the
+script test-fast and verifies the engine's output token-exactly against
+the whole-generation greedy decode on the SAME quantized tree.
+"""
+
+import os
+import time
+import statistics
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bee_code_interpreter_fs_tpu.models import LlamaConfig
+from bee_code_interpreter_fs_tpu.models.llama import greedy_generate
+from bee_code_interpreter_fs_tpu.models.quant import (
+    quantized_nbytes,
+    random_quantized_params,
+)
+from bee_code_interpreter_fs_tpu.models.serving import ServingEngine
+
+ON_TPU = jax.devices()[0].platform == "tpu"
+if ON_TPU:
+    cfg = LlamaConfig.llama2_7b()
+    N_REQ, MAX_NEW, N_SLOTS, STEPS, MAX_LEN = 16, 64, 8, 16, 512
+    PROMPT_RANGE = (48, 128)
+else:  # correctness shapes for dev machines / CI
+    cfg = LlamaConfig.tiny(dtype="float32", vocab_size=251)
+    N_REQ, MAX_NEW, N_SLOTS, STEPS, MAX_LEN = 6, 12, 3, 4, 64
+    PROMPT_RANGE = (4, 24)
+
+t0 = time.perf_counter()
+params = random_quantized_params(jax.random.PRNGKey(0), cfg, "int8")
+jax.block_until_ready(params)
+print(
+    f"backend: {jax.devices()[0].platform} "
+    f"model={'llama2_7b' if ON_TPU else 'tiny'} "
+    f"params={quantized_nbytes(params) / 1e9:.2f}GB int8 "
+    f"(built in {time.perf_counter() - t0:.1f}s)"
+)
+
+rng = np.random.RandomState(7)
+traffic = [
+    rng.randint(1, cfg.vocab_size - 1,
+                size=rng.randint(*PROMPT_RANGE)).tolist()
+    for _ in range(N_REQ)
+]
+
+eng = ServingEngine(
+    params, cfg, n_slots=N_SLOTS, max_len=MAX_LEN, steps_per_sync=STEPS,
+    kv_quant=True,
+)
+
+# Streaming sinks record (arrival time, chunk length) per request — the
+# client-visible per-token latency is the inter-chunk gap spread over the
+# chunk's tokens.
+arrivals: dict[int, list] = {}
+
+t0 = time.perf_counter()
+rids = []
+for p in traffic:
+    chunks: list = []
+    rid = eng.submit(
+        p, MAX_NEW,
+        on_token=lambda toks, c=chunks: c.append(
+            (time.perf_counter(), len(toks))
+        ),
+    )
+    arrivals[rid] = chunks
+    rids.append(rid)
+# Drive the scheduler step-by-step (instead of one run() call) to sample
+# slot occupancy at every sync; the final run() on the drained engine
+# just collects the results.
+occupancy = []
+while eng.stats()["queued"] or eng.stats()["occupied_slots"]:
+    eng.step()
+    occupancy.append(eng.stats()["active_slots"])
+res = eng.run()
+elapsed = time.perf_counter() - t0
+
+total_tokens = sum(len(res[r]) for r in rids)
+per_token_ms = []
+for rid in rids:
+    chunks = arrivals[rid]
+    for (t_prev, _), (t_cur, n_cur) in zip(chunks, chunks[1:]):
+        per_token_ms.extend([(t_cur - t_prev) * 1e3 / n_cur] * n_cur)
+
+print(f"SERVING7B_SLOTS={N_SLOTS}")
+print(f"SERVING7B_REQS={N_REQ}")
+print(f"SERVING7B_TOKS={total_tokens / elapsed:.1f}  "
+      f"(total={total_tokens}, wall={elapsed:.1f}s)")
+if per_token_ms:
+    print(f"SERVING7B_PER_TOKEN_MS={statistics.median(per_token_ms):.2f}")
+active_sum = sum(occupancy)
+print(f"SERVING7B_UTILIZATION={active_sum / (len(occupancy) * N_SLOTS):.3f}  "
+      f"(syncs={len(occupancy)})")
+
+if not ON_TPU:
+    # Token-exactness: the engine's output on the quantized tree must match
+    # the whole-generation fused greedy decode on the same tree.
+    for p, rid in zip(traffic, rids):
+        ref = np.asarray(
+            greedy_generate(params, jnp.asarray([p], jnp.int32), cfg,
+                            max_new_tokens=MAX_NEW)
+        )[0, len(p):]
+        np.testing.assert_array_equal(res[rid], ref)
+    print("token-exact vs greedy_generate: OK")
