@@ -3,13 +3,22 @@ transformers' own forward pass on randomly initialized tiny models — the
 gold test that this Llama family is Llama-COMPATIBLE, not just
 Llama-shaped (incl. the rotate-half → interleaved RoPE unpermute)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-transformers = pytest.importorskip("transformers")
-torch = pytest.importorskip("torch")
+if os.environ.get("CI"):
+    # In CI the parity gate is load-bearing: a missing transformers/torch
+    # must turn the job RED, not silently skip the one suite that proves
+    # Llama-compatibility (VERDICT r4 #4). GitHub Actions always sets CI=true.
+    import torch
+    import transformers
+else:
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
 
 from bee_code_interpreter_fs_tpu.models import LlamaConfig, forward, greedy_generate
 from bee_code_interpreter_fs_tpu.models.hf_convert import from_hf_state_dict
