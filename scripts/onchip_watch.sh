@@ -1,19 +1,29 @@
 #!/bin/bash
-# Patient TPU recovery watcher: probe until an attach succeeds, then fire
-# the full on-chip measurement suite, writing results INTO the repo so the
-# round-end auto-commit preserves them even if nobody is at the keyboard.
+# Patient TPU recovery watcher (round 5): probe until an attach succeeds,
+# then fire the full on-chip measurement suite, writing results INTO the
+# repo so the round-end auto-commit preserves them even if nobody is at
+# the keyboard.
 #
 # Usage: nohup scripts/onchip_watch.sh & (from the repo root; safe to leave
 # running — probe attempts end via SIGINT so the client unwinds cleanly;
-# abrupt SIGKILLs mid-device-op are what wedge the tunneled device). Operator note from round 4: a persistent wedge (every
-# attach blocking 25-75 min then UNAVAILABLE) cleared once at a HOST
-# reboot; if attaches keep failing for hours, a reboot of the machine
-# hosting the tunnel relay is the known remedy, after which this watcher
-# (relaunched) captures everything automatically.
-OUT=/root/repo/benchmarks/onchip_r04
+# abrupt SIGKILLs mid-device-op are what wedge the tunneled device).
+# WAIT_PID=<pid>: wait for that process (an older probe mid-attach) to exit
+# before probing, so two clients never contend for the attach.
+# Operator note from round 4: a persistent wedge (every attach blocking
+# 25-75 min then UNAVAILABLE) cleared once at a HOST reboot; if attaches
+# keep failing for hours, a reboot of the machine hosting the tunnel relay
+# is the known remedy, after which this watcher (relaunched) captures
+# everything automatically.
+OUT=/root/repo/benchmarks/onchip_r05
 LOG=/tmp/tpuprobe/probe.log
 mkdir -p "$OUT" /tmp/tpuprobe
 cd /root/repo || exit 1
+
+if [ -n "$WAIT_PID" ]; then
+  echo "$(date -u +%FT%TZ) waiting for old probe pid=$WAIT_PID" >> "$LOG"
+  tail --pid="$WAIT_PID" -f /dev/null 2>/dev/null
+fi
+
 while true; do
   # 90 min per attempt (observed wedge blocks 25-76 min); on expiry the
   # probe gets SIGINT first (Python unwinds and says goodbye when it CAN —
@@ -43,17 +53,26 @@ echo "recovered_at: $(date -u +%FT%TZ)" > "$OUT/STATUS.txt"
 run_leg() {  # name, timeout, command...
   name=$1; tmo=$2; shift 2
   echo "$(date -u +%FT%TZ) leg $name starting" >> "$LOG"
-  PYTHONPATH=/root/repo timeout "$tmo" "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  PYTHONPATH=/root/repo timeout --signal=INT --kill-after=120 "$tmo" \
+    "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
   echo "leg $name rc=$?" >> "$OUT/STATUS.txt"
   echo "$(date -u +%FT%TZ) leg $name done" >> "$LOG"
 }
 
-# 1. The driver-format bench (headline/matmul/flash/p50/int8).
+# 1. The driver-format bench (headline now ADAPTIVE-sampled: runs until
+#    the steady state plateaus — VERDICT r4 #2 — plus matmul/flash/p50/int8).
 run_leg bench 1800 python bench.py
-# 2. Full config suite (1-4, 5a-5g incl. int8 ratio, true-7B, speculative,
-#    serving engine).
-run_leg run_configs 7200 python benchmarks/run_configs.py
-# 3. Flash-attention tile sweep at t=16k (VERDICT next-4).
+# 2. The capstone: 7B-int8 continuous batching, 16 concurrent requests on
+#    one resident model (VERDICT r4 #5). Standalone first so the number
+#    lands even if the full config suite dies midway.
+run_leg serving_7b 1800 python examples/benchmark-serving-7b.py
+# 3. Speculative decoding composed into the serving engine (VERDICT r4
+#    #8): draft/verify per slot, low- and mid-occupancy speedup rows.
+run_leg serving_spec 1200 python examples/benchmark-serving-spec.py
+# 4. Full config suite (1-4, 5a-5h incl. int8 ratio, true-7B, speculative,
+#    serving engine, the 5h capstone through Execute).
+run_leg run_configs 9000 python benchmarks/run_configs.py
+# 5. Flash-attention tile sweep at t=16k (VERDICT r4 #3).
 for bq in 256 512 1024; do
   for bk in 512 1024 2048; do
     BENCH_BLOCK_Q=$bq BENCH_BLOCK_K=$bk \
@@ -61,7 +80,7 @@ for bq in 256 512 1024; do
   done
 done
 BENCH_SEQ_LEN=32768 run_leg flash_32k 900 python examples/benchmark-attention.py
-# 4. True-13B int4 on one chip.
+# 6. True-13B int4 on one chip.
 BENCH_MODEL=llama2_13b BENCH_PRECISION=int4 \
   run_leg llama2_13b_int4 1800 python examples/benchmark-7b.py
 echo "suite_complete: $(date -u +%FT%TZ)" >> "$OUT/STATUS.txt"
