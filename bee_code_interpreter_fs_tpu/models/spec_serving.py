@@ -33,9 +33,13 @@ and rewrites every such position before any query can attend it (a query
 at q only sees keys <= q, and key q is rewritten by the chunk covering it
 before the first query with q' >= q runs).
 
-v1 scope: greedy requests on the dense bf16/f32 cache. Sampling,
-logprobs, penalties, prefix caching, LoRA adapters, and kv_quant are
-rejected at submit()/__init__ — compose with the plain engine for those.
+The TARGET cache may be int8 (`kv_quant=True`): the verify chunk routes
+through the one shared quantize-at-write / dequantize-at-read recipe, so
+long-context HBM savings and speculation compose; the DRAFT cache stays
+dense (the draft is small — its cache is not the memory term that
+matters). v1 scope beyond that: greedy requests only. Sampling,
+logprobs, penalties, prefix caching, and LoRA adapters are rejected at
+submit()/__init__ — compose with the plain engine for those.
 """
 
 from __future__ import annotations
@@ -60,6 +64,7 @@ from bee_code_interpreter_fs_tpu.models.serving import (
     Request,
     ServingEngine,
     _admit,
+    _kv_write_read,
     _perslot_decode_step,
 )
 
@@ -72,11 +77,16 @@ def _perslot_decode_chunk(params, tokens, cache, pos, cfg: LlamaConfig):
     s>1 generalization of serving._perslot_decode_step (vector RoPE
     offsets, per-slot-per-query causal masks, per-slot chunk scatters).
     Returns (logits [b, s, vocab] f32 for all s positions, updated cache).
-    This is the serving engine's speculative VERIFY pass."""
+    This is the serving engine's speculative VERIFY pass. An int8 cache
+    ("kq" present, engine kv_quant=True) routes through the one shared
+    quantize-at-write / dequantize-at-read recipe (_kv_write_read) — the
+    same per-vector granularity as the plain engine's decode step, so
+    spec+int8 stays token-exact vs plain+int8."""
     dt = jnp.dtype(cfg.dtype)
     scale = cfg.head_dim ** -0.5
+    quant = "kq" in cache
     b, s = tokens.shape
-    max_len = cache["k"].shape[2]
+    max_len = (cache["kq"] if quant else cache["k"]).shape[2]
     qpos = pos[:, None] + jnp.arange(s)[None, :]  # [b, s]
     # Slot i's query j sees cache positions <= pos[i]+j (window/sinks via
     # the one shared visibility formula).
@@ -86,27 +96,32 @@ def _perslot_decode_chunk(params, tokens, cache, pos, cfg: LlamaConfig):
     x = params["embed"].astype(dt)[tokens]
     bidx = jnp.arange(b)
 
+    # Per-slot scatter of the whole chunk at each slot's frontier
+    # (out-of-bounds rows of an inactive slot's stale qpos drop).
+    cache_keys, write_read = _kv_write_read(
+        quant, lambda c, x: c.at[bidx[:, None], qpos].set(x),
+        lambda c: c, dt,
+    )
+
     def layer(x, inputs):
-        lp, ck, cv = inputs
+        lp = inputs[0]
+        cs = inputs[1:]
         cell = {}
 
         def attn_fn(q, k, v):
-            # Per-slot scatter of the whole chunk at each slot's frontier
-            # (out-of-bounds rows of an inactive slot's stale qpos drop).
-            new_k = ck.at[bidx[:, None], qpos].set(k)
-            new_v = cv.at[bidx[:, None], qpos].set(v)
-            cell["kv"] = (new_k, new_v)
-            return _cached_gqa_attention(q, new_k, new_v, valid, scale)
+            new, keys_r, vals_r = write_read(cs, k, v)
+            cell["kv"] = new
+            return _cached_gqa_attention(q, keys_r, vals_r, valid, scale)
 
         x = transformer_block(x, lp, cfg, attn_fn, rope_offset=pos)
         return x, cell["kv"]
 
-    x, (new_k, new_v) = lax.scan(
-        layer, x, (params["layers"], cache["k"], cache["v"])
+    x, new_leaves = lax.scan(
+        layer, x, (params["layers"],) + tuple(cache[k] for k in cache_keys)
     )
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ _w(params["lm_head"], dt)).astype(jnp.float32)
-    return logits, {"k": new_k, "v": new_v}
+    return logits, dict(zip(cache_keys, new_leaves))
 
 
 @partial(
@@ -215,12 +230,11 @@ class SpeculativeServingEngine(ServingEngine):
                 "gamma must be >= 1 (0 proposals leaves nothing to "
                 "verify; use ServingEngine for plain decoding)"
             )
-        for unsupported in ("kv_quant", "adapters"):
-            if kwargs.get(unsupported):
-                raise ValueError(
-                    f"{unsupported} is not supported by the speculative "
-                    "engine (v1); use ServingEngine"
-                )
+        if kwargs.get("adapters"):
+            raise ValueError(
+                "adapters are not supported by the speculative engine "
+                "(v1); use ServingEngine"
+            )
         self.draft_params = draft_params
         self.dcfg = draft_cfg
         self.gamma = int(gamma)

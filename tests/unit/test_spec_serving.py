@@ -148,8 +148,8 @@ def test_validation(model):
         SpeculativeServingEngine(
             params, cfg, draft_params=dparams,
             draft_cfg=LlamaConfig.tiny(vocab_size=11), n_slots=1)
-    with pytest.raises(ValueError, match="kv_quant"):
-        mk(kv_quant=True)
+    with pytest.raises(ValueError, match="adapters"):
+        mk(adapters={"x": {}})
     eng = mk(gamma=2)
     with pytest.raises(ValueError, match="greedy-only"):
         eng.submit([1], 2, temperature=0.7)
@@ -161,3 +161,25 @@ def test_validation(model):
         eng.submit([1], 2, prefix_id=0)
     with pytest.raises(ValueError, match="presence_penalty"):
         eng.submit([1], 2, presence_penalty=0.5)
+
+
+def test_kv_quant_matches_plain_int8_engine(model):
+    """Speculation over an int8 TARGET cache (draft cache stays dense)
+    must emit exactly what the plain int8 engine emits: the verify chunk
+    quantizes at the same per-vector granularity as the plain decode
+    step (shared _kv_write_read recipe)."""
+    params, cfg, dparams, dcfg = model
+    reqs = [([4, 9, 2], 10), (list(range(30, 45)), 7), ([8], 12)]
+
+    plain = ServingEngine(params, cfg, n_slots=2, max_len=64,
+                          steps_per_sync=3, kv_quant=True)
+    p_rids = [plain.submit(p, m) for p, m in reqs]
+    p_res = plain.run()
+
+    spec = SpeculativeServingEngine(
+        params, cfg, draft_params=dparams, draft_cfg=dcfg, gamma=3,
+        n_slots=2, max_len=64, steps_per_sync=2, kv_quant=True)
+    s_rids = [spec.submit(p, m) for p, m in reqs]
+    s_res = spec.run()
+    for pr, sr in zip(p_rids, s_rids):
+        np.testing.assert_array_equal(p_res[pr], s_res[sr])
