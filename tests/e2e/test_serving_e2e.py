@@ -77,3 +77,40 @@ async def test_serving_engine_inside_sandbox(stack):
     result = await executor.execute(SERVING_SNIPPET, timeout=240.0)
     assert result.exit_code == 0, result.stderr[-1200:]
     assert "serving_ok prefix+qlora+sampled" in result.stdout
+
+SPEC_SNIPPET = """
+import jax, jax.numpy as jnp, numpy as np
+from bee_code_interpreter_fs_tpu.models import (
+    LlamaConfig, SpeculativeServingEngine, greedy_generate, init_params,
+)
+
+cfg = LlamaConfig.tiny(n_layers=2, dim=64, n_heads=4, n_kv_heads=2,
+                       hidden_dim=128, vocab_size=97, max_seq_len=64,
+                       dtype="float32")
+dcfg = LlamaConfig.tiny(n_layers=1, dim=32, n_heads=2, n_kv_heads=2,
+                        hidden_dim=64, vocab_size=97, max_seq_len=64,
+                        dtype="float32")
+target = init_params(jax.random.PRNGKey(0), cfg)
+draft = init_params(jax.random.PRNGKey(3), dcfg)
+
+eng = SpeculativeServingEngine(target, cfg, draft_params=draft,
+                               draft_cfg=dcfg, gamma=3, n_slots=2,
+                               max_len=64, steps_per_sync=2)
+r1 = eng.submit([3, 17, 55, 9], 8)
+r2 = eng.submit([8], 6)
+res = eng.run()
+ref = np.asarray(greedy_generate(
+    target, jnp.asarray([[3, 17, 55, 9]], jnp.int32), cfg,
+    max_new_tokens=8))[0, 4:]
+assert np.array_equal(res[r1], ref), (res[r1], ref)
+assert len(res[r2]) == 6
+print("spec_serving_ok draft+verify")
+"""
+
+
+async def test_speculative_engine_inside_sandbox(stack):
+    executor = stack
+    await executor.fill_pool()
+    result = await executor.execute(SPEC_SNIPPET, timeout=240.0)
+    assert result.exit_code == 0, result.stderr[-1200:]
+    assert "spec_serving_ok draft+verify" in result.stdout
