@@ -17,6 +17,10 @@ body, per SLOT — the shape production TPU servers use:
 - Greedy acceptance = token equality, so the emitted stream is EXACTLY
   the non-speculative engine's (token-exact; the draft only decides how
   many target tokens a pass yields, never what they are).
+- Sampled requests (temperature > 0) run the full accept/resample
+  speculative-sampling algorithm per slot — distribution-exact vs
+  ancestral sampling from the target (the engine-level counterpart of
+  llama.speculative_sample_generate), sharing bursts with greedy slots.
 
 The win is at LOW slot occupancy: decode at small active-batch is
 weight-HBM-bound, so γ draft steps (a model 10-30x smaller) plus one
@@ -37,9 +41,11 @@ The TARGET cache may be int8 (`kv_quant=True`): the verify chunk routes
 through the one shared quantize-at-write / dequantize-at-read recipe, so
 long-context HBM savings and speculation compose; the DRAFT cache stays
 dense (the draft is small — its cache is not the memory term that
-matters). v1 scope beyond that: greedy requests only. Sampling,
-logprobs, penalties, prefix caching, and LoRA adapters are rejected at
-submit()/__init__ — compose with the plain engine for those.
+matters). Prefix caching works on both sides: register_prefix prefills
+the prefix through the draft too, so sharing requests skip the prefix
+forward for BOTH models. v1 scope beyond that: top_p, logprobs,
+penalties, and LoRA adapters are rejected at submit()/__init__ —
+compose with the plain engine for those.
 """
 
 from __future__ import annotations
@@ -64,8 +70,13 @@ from bee_code_interpreter_fs_tpu.models.serving import (
     Request,
     ServingEngine,
     _admit,
+    _admit_prefix_only,
+    _admit_prefixed,
+    _chunked_scratch_prefill,
+    _install_row,
     _kv_write_read,
     _perslot_decode_step,
+    _prefix_prefill,
 )
 
 __all__ = ["SpeculativeServingEngine"]
@@ -124,14 +135,26 @@ def _perslot_decode_chunk(params, tokens, cache, pos, cfg: LlamaConfig):
     return logits, dict(zip(cache_keys, new_leaves))
 
 
+def _fold2(keys, data, tag: int):
+    """Per-slot subkey for one decision site: fold_in(key, position) then
+    fold_in(tag) — distinct tags give the draft draw, the accept test,
+    and the boundary draw independent streams at the same position."""
+    k2 = jax.vmap(jax.random.fold_in)(keys, data)
+    return jax.vmap(jax.random.fold_in)(
+        k2, jnp.full(data.shape, tag, jnp.uint32)
+    )
+
+
 @partial(
     jax.jit,
-    static_argnames=("cfg", "dcfg", "steps", "gamma", "eos_id"),
+    static_argnames=("cfg", "dcfg", "steps", "gamma", "eos_id",
+                     "with_sampling"),
     donate_argnames=("cache", "dcache"),
 )
 def _spec_decode_burst(params, dparams, cache, dcache, pos, last_tok,
-                       remaining, active, cfg: LlamaConfig,
-                       dcfg: LlamaConfig, steps: int, gamma: int, eos_id):
+                       remaining, active, temp, keys, cfg: LlamaConfig,
+                       dcfg: LlamaConfig, steps: int, gamma: int, eos_id,
+                       with_sampling: bool = False):
     """`steps` draft/verify passes over the slot bank, one jitted program.
 
     Invariant at the top of each pass (per slot): `last_tok[i]` is the
@@ -140,7 +163,22 @@ def _spec_decode_burst(params, dparams, cache, dcache, pos, last_tok,
     active slot (clamped by budget and eos). Returns the updated carry
     plus (toks [steps, b, γ+1], emitted [steps, b, γ+1]) — pass-major
     emission order, so flattening the trailing axis reconstructs each
-    slot's stream exactly."""
+    slot's stream exactly.
+
+    Greedy slots (temp == 0) accept by TOKEN EQUALITY — output exactly
+    the plain engine's greedy stream. With `with_sampling` (static; only
+    compiled when a sampled request occupies a slot), temp > 0 slots run
+    the full accept/resample speculative-sampling algorithm per slot
+    (Leviathan et al.): the draft PROPOSES d_j ~ q_j, position j accepts
+    with prob min(1, p_j(d_j)/q_j(d_j)), and the first rejection
+    resamples from normalize(relu(p_j - q_j)); all-accepted rows draw
+    the bonus token from p_γ — which is exactly the γ-th residual once
+    q_γ is defined as the zero vector, so one gather serves both cases.
+    The emitted sequence is distribution-exact vs ancestral sampling
+    from the target (empirically pinned in tests), though not
+    stream-identical to the plain engine (different algorithm, different
+    draw sites). Decisions key off fold_in(slot key, token position), so
+    a seeded request reproduces regardless of batch composition."""
     b = pos.shape[0]
     bidx = jnp.arange(b)
     idx = jnp.arange(gamma + 1)
@@ -158,31 +196,90 @@ def _spec_decode_burst(params, dparams, cache, dcache, pos, last_tok,
                 dparams, t[:, None], dc, pos + j, dcfg
             )
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return (nxt, dc), nxt
+            if with_sampling:
+                scaled = logits / jnp.where(temp > 0, temp, 1.0)[:, None]
+                q = jax.nn.softmax(scaled, axis=-1)  # [b, vocab] f32
+                draw = jax.vmap(jax.random.categorical)(
+                    _fold2(keys, pos + j + 1, 1), scaled
+                ).astype(jnp.int32)
+                nxt = jnp.where(temp > 0, draw, nxt)
+            else:
+                q = jnp.zeros((b, 1), jnp.float32)  # unused, shape-stable
+            return (nxt, dc), (nxt, q)
 
-        (_, dcache), props = lax.scan(
+        (_, dcache), (props, qs) = lax.scan(
             droll, (tok, dcache), jnp.arange(gamma + 1)
         )
         drafts = props[:gamma].T  # [b, γ]
 
         # Verify: target scores [pending, d_1..d_γ] at pos..pos+γ in one
-        # per-slot chunk; t_preds[:, j] is the target's choice for
+        # per-slot chunk; t_preds[:, j] is the target's greedy choice for
         # position pos+j+1.
         chunk = jnp.concatenate([tok[:, None], drafts], axis=1)
         v_logits, cache = _perslot_decode_chunk(params, chunk, cache, pos, cfg)
         t_preds = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)  # [b, γ+1]
 
-        # Per-slot longest agreeing prefix — NO batch-min lockstep: the
-        # slot bank's position vector carries ragged progress natively.
+        # Greedy acceptance: per-slot longest agreeing prefix — NO
+        # batch-min lockstep: the slot bank's position vector carries
+        # ragged progress natively.
         agree = drafts == t_preds[:, :gamma]
         row_accept = jnp.where(
             agree.all(axis=1), gamma,
             jnp.argmin(agree.astype(jnp.int32), axis=1),
         )
+        out = t_preds
+
+        if with_sampling:
+            pt = jax.nn.softmax(
+                v_logits / jnp.where(temp > 0, temp, 1.0)[:, None, None],
+                axis=-1,
+            )  # [b, γ+1, vocab]
+            q_d = jnp.take_along_axis(
+                jnp.transpose(qs[:gamma], (1, 0, 2)), drafts[..., None],
+                axis=-1,
+            )[..., 0]  # [b, γ] — q_j(d_j)
+            p_d = jnp.take_along_axis(
+                pt[:, :gamma], drafts[..., None], axis=-1
+            )[..., 0]  # [b, γ] — p_j(d_j)
+            u = jax.vmap(
+                lambda k: jax.random.uniform(k, (gamma,)),
+            )(_fold2(keys, pos, 2))  # per-pass accept draws
+            acc = u * jnp.maximum(q_d, 1e-30) < p_d  # u < min(1, p/q)
+            s_accept = jnp.where(
+                acc.all(axis=1), gamma,
+                jnp.argmin(acc.astype(jnp.int32), axis=1),
+            )
+            # Boundary distribution: residual at the rejection row, and
+            # with q_γ := 0 the all-accepted case's bonus p_γ is the same
+            # gather — append a zero row to q.
+            qs_ext = jnp.concatenate(
+                [jnp.transpose(qs[:gamma], (1, 0, 2)),
+                 jnp.zeros_like(pt[:, :1])], axis=1,
+            )  # [b, γ+1, vocab]
+            p_b = jnp.take_along_axis(
+                pt, s_accept[:, None, None], axis=1
+            )[:, 0]
+            q_b = jnp.take_along_axis(
+                qs_ext, s_accept[:, None, None], axis=1
+            )[:, 0]
+            residual = jnp.maximum(p_b - q_b, 0.0)
+            boundary = jax.vmap(jax.random.categorical)(
+                _fold2(keys, pos + s_accept + 1, 3),
+                jnp.log(residual + 1e-30),
+            ).astype(jnp.int32)
+            out_s = jnp.where(
+                idx[None, :] < s_accept[:, None],
+                jnp.pad(drafts, ((0, 0), (0, 1))),
+                boundary[:, None],
+            )
+            sampled = temp > 0
+            row_accept = jnp.where(sampled, s_accept, row_accept)
+            out = jnp.where(sampled[:, None], out_s, out)
+
         emit_n = jnp.minimum(row_accept + 1, remaining)
         if eos_id is not None:
             # Stop at (and include) the first emitted eos.
-            is_eos = (t_preds == eos_id) & (idx[None] < emit_n[:, None])
+            is_eos = (out == eos_id) & (idx[None] < emit_n[:, None])
             first_eos = jnp.where(
                 is_eos.any(axis=1), jnp.argmax(is_eos, axis=1), gamma + 1
             )
@@ -190,7 +287,7 @@ def _spec_decode_burst(params, dparams, cache, dcache, pos, last_tok,
         emit_n = jnp.where(active, emit_n, 0)
         emitted = idx[None, :] < emit_n[:, None]  # [b, γ+1]
         new_tok = jnp.where(
-            active, t_preds[bidx, jnp.maximum(emit_n - 1, 0)], tok
+            active, out[bidx, jnp.maximum(emit_n - 1, 0)], tok
         )
         pos = pos + emit_n
         remaining = remaining - emit_n
@@ -198,7 +295,7 @@ def _spec_decode_burst(params, dparams, cache, dcache, pos, last_tok,
         if eos_id is not None:
             active = active & (new_tok != eos_id)
         return (cache, dcache, pos, new_tok, remaining, active), (
-            t_preds, emitted
+            out, emitted
         )
 
     carry, (toks, emitted) = lax.scan(
@@ -219,7 +316,9 @@ class SpeculativeServingEngine(ServingEngine):
 
     Each scheduler sync runs `steps_per_sync` draft/verify passes, so a
     slot can emit up to steps_per_sync*(γ+1) tokens per sync (streaming
-    chunks grow accordingly). Scope: greedy only — see module doc."""
+    chunks grow accordingly). Greedy requests are token-exact vs the
+    plain engine; temperature>0 requests are distribution-exact vs the
+    target (accept/resample) — see module doc for scope."""
 
     def __init__(self, params, cfg: LlamaConfig, *, draft_params,
                  draft_cfg: LlamaConfig, gamma: int = 4, **kwargs):
@@ -242,17 +341,11 @@ class SpeculativeServingEngine(ServingEngine):
         self.dcache = init_cache(self.dcfg, self.n_slots, self.max_len)
 
     def submit(self, prompt, max_new_tokens: int, prefix_id=None, **kw):
-        if prefix_id is not None:
+        if kw.get("top_p", 1.0) < 1.0:
             raise ValueError(
-                "prefix caching is not supported by the speculative "
-                "engine (v1): the draft model would need its own prefix "
-                "K/V; use ServingEngine"
-            )
-        if kw.get("temperature", 0.0) > 0 or kw.get("top_p", 1.0) < 1.0:
-            raise ValueError(
-                "the speculative engine is greedy-only (v1): token "
-                "equality is the acceptance rule; use ServingEngine for "
-                "sampling"
+                "top_p is not supported by the speculative engine (v1): "
+                "nucleus truncation must be applied consistently to both "
+                "the draft and target distributions; use ServingEngine"
             )
         for unsupported in ("logprobs", "presence_penalty",
                             "frequency_penalty", "adapter"):
@@ -261,7 +354,39 @@ class SpeculativeServingEngine(ServingEngine):
                     f"{unsupported} is not supported by the speculative "
                     "engine (v1); use ServingEngine"
                 )
-        return super().submit(prompt, max_new_tokens, None, **kw)
+        return super().submit(prompt, max_new_tokens, prefix_id, **kw)
+
+    def register_prefix(self, tokens, adapter: str | None = None) -> int:
+        """Prefix caching for BOTH models: the base registration stores
+        the target's prefix K/V; this adds the draft's, prefilled once —
+        sharing requests skip the prefix forward on both sides. Long
+        prefixes chunk on the draft side too (same O(chunk x plen)
+        attention-memory bound the base class applies to the target)."""
+        pid = super().register_prefix(tokens, adapter)
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        plen = int(toks.size)
+        if self.prefill_chunk is not None and plen > self.prefill_chunk:
+            c = self.prefill_chunk
+            pad = -(-plen // c) * c
+            padded = np.zeros((1, pad), np.int32)
+            padded[0, :plen] = toks
+            _, scratch = _chunked_scratch_prefill(
+                self.draft_params, jnp.asarray(padded), jnp.int32(plen),
+                self.dcfg, c,
+            )
+            scratch = {
+                "k": scratch["k"][:, :, :plen],
+                "v": scratch["v"][:, :, :plen],
+            }
+        else:
+            scratch = init_cache(self.dcfg, 1, plen)
+            _, scratch = _prefix_prefill(
+                self.draft_params, jnp.asarray(toks[None, :]), scratch,
+                self.dcfg,
+            )
+        self._prefixes[pid]["dk"] = scratch["k"]
+        self._prefixes[pid]["dv"] = scratch["v"]
+        return pid
 
     def _install(self, req: Request, i: int):
         placed = super()._install(req, i)
@@ -271,24 +396,56 @@ class SpeculativeServingEngine(ServingEngine):
         # slot row; the draft's admission logits are discarded (the
         # target picked the first token).
         n = req.prompt.size
+        if req.prefix_id is not None:
+            pf = self._prefixes[req.prefix_id]
+            if n == 0:
+                self.dcache = _admit_prefix_only(
+                    self.dcache, pf["dk"], pf["dv"], jnp.int32(i)
+                )
+            else:
+                bl = self._suffix_bucket(pf["len"], n)
+                padded = self._padded_prompt(req.prompt, bl)
+                self.dcache, _ = _admit_prefixed(
+                    self.draft_params, self.dcache, pf["dk"], pf["dv"],
+                    jnp.asarray(padded), jnp.int32(i), jnp.int32(n),
+                    self.dcfg,
+                )
+            return placed
         bl = self._bucket_len(n)
-        padded = self._padded_prompt(req.prompt, bl)
-        self.dcache, _ = _admit(
-            self.draft_params, self.dcache, jnp.asarray(padded),
-            jnp.int32(i), jnp.int32(n), self.dcfg,
-        )
+        if (self.prefill_chunk is not None and bl > self.prefill_chunk
+                and bl % self.prefill_chunk == 0):
+            # Long prompts chunk on the draft side too (the base class
+            # already chunked the target's admission above).
+            padded = self._padded_prompt(req.prompt, bl)
+            _, dscratch = _chunked_scratch_prefill(
+                self.draft_params, jnp.asarray(padded), jnp.int32(n),
+                self.dcfg, self.prefill_chunk,
+            )
+            self.dcache = _install_row(
+                self.dcache, dscratch, jnp.int32(i)
+            )
+        else:
+            padded = self._padded_prompt(req.prompt, bl)
+            self.dcache, _ = _admit(
+                self.draft_params, self.dcache, jnp.asarray(padded),
+                jnp.int32(i), jnp.int32(n), self.dcfg,
+            )
         return placed
 
     def _run_burst(self, with_logprobs: bool = False,
                    with_top_p: bool = False, with_penalties: bool = False):
         # submit() rejected everything that could set these flags.
         assert not (with_logprobs or with_top_p or with_penalties)
+        with_sampling = any(
+            r is not None and r.temperature > 0 for r in self._slot_req
+        )
         (self.cache, self.dcache, self.pos, self.last_tok, self.remaining,
          self.active, toks, emitted) = _spec_decode_burst(
             self.params, self.draft_params, self.cache, self.dcache,
             self.pos, self.last_tok, self.remaining, self.active,
+            self.temp, self.keys,
             self.cfg, self.dcfg, self.steps_per_sync, self.gamma,
-            self.eos_id,
+            self.eos_id, with_sampling,
         )
         # [steps, b, γ+1] → [steps*(γ+1), b], pass-major then within-pass:
         # exactly each slot's emission order, so the base step() consumes
