@@ -35,6 +35,10 @@ BENCH_SOURCE = (REPO_ROOT / "examples" / "benchmark-numpy.py").read_text()
 MATMUL_SOURCE = (REPO_ROOT / "examples" / "benchmark-matmul.py").read_text()
 ATTENTION_SOURCE = (REPO_ROOT / "examples" / "benchmark-attention.py").read_text()
 QUANT_SOURCE = (REPO_ROOT / "examples" / "benchmark-quant.py").read_text()
+SERVING_SOURCE = (REPO_ROOT / "examples" / "benchmark-serving.py").read_text()
+ENGINE_TOKS_RE = re.compile(r"ENGINE_TOKS_PER_S=([0-9.]+)")
+PAGED_TOKS_RE = re.compile(r"PAGED_TOKS_PER_S=([0-9.]+)")
+ENGINE_SPEEDUP_RE = re.compile(r"ENGINE_SPEEDUP=([0-9.]+)")
 METRIC = "benchmark-numpy.py GFLOPS/chip via Execute (1e8 sum-of-squares)"
 INT8_SPEEDUP_RE = re.compile(r"INT8_DECODE_SPEEDUP=([0-9.]+)")
 INT8_TOKS_RE = re.compile(r"INT8_DECODE_TOKS=([0-9.]+)")
@@ -225,16 +229,29 @@ async def run_matmul(tmp: Path) -> dict:
         await executor.close()
 
 
-async def run_quant(tmp: Path) -> None:
-    """int8 vs bf16 fused greedy decode through Execute — the weight-HBM
-    ratio models/quant.py exists for, in the DRIVER's artifact rather than
-    only a self-measured one. Last leg on purpose: best-effort under the
-    remaining deadline (failure or a skip never costs the headline)."""
+async def _best_effort_leg(name: str, source: str, tmp: Path,
+                           parse: tuple) -> None:
+    """Shared body of the trailing best-effort legs (int8 decode ratio,
+    serving-engine throughput): its own pool, a deadline-clamped execute,
+    parse whatever reached stdout — both source scripts flush each marker
+    AS IT IS MEASURED, so a timeout kill still leaves every completed
+    number parseable — and a teardown that never raises. A failure or a
+    skip never costs the already-measured legs.
+
+    The deadline check runs BEFORE any pool fill: a cold fill with
+    warm_import_jax can burn minutes, and paying it for a leg that is
+    about to skip would steal time from nothing."""
     executor = None
     try:
+        # No artificial floor: a timeout may never outlive the backstop
+        # (which would clobber the measured headline with a deadline
+        # error). 120 s execute minimum + 60 s margin.
+        if _remaining_s() - 60.0 < 120.0:
+            log(f"skipping {name} leg (deadline too near)")
+            return
         config = Config(
-            file_storage_path=str(tmp / "storage-q"),
-            local_sandbox_root=str(tmp / "sb-q"),
+            file_storage_path=str(tmp / f"storage-{name}"),
+            local_sandbox_root=str(tmp / f"sb-{name}"),
             executor_pod_queue_target_length=1,
             default_execution_timeout=900.0,
             max_execution_timeout=1200.0,
@@ -244,40 +261,53 @@ async def run_quant(tmp: Path) -> None:
             config, warm_import_jax=True, numpy_dispatch=True
         )
         executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
-        log("int8 decode ratio: filling pool...")
+        log(f"{name}: filling pool...")
         await executor.fill_pool()
-        # No artificial floor: a timeout may never outlive the backstop
-        # (which would clobber the measured headline with a deadline error).
         timeout = min(_remaining_s() - 60.0, 900.0)
         if timeout < 120.0:
-            log("skipping int8 execute (deadline too near)")
+            log(f"skipping {name} execute (deadline too near)")
             return
-        result = await executor.execute(QUANT_SOURCE, timeout=timeout)
-        # The quant script flushes bf16/int8 lines before its int4 leg, so a
-        # timeout kill mid-int4 still leaves the ratio in stdout — parse
-        # whatever made it out regardless of exit code.
+        result = await executor.execute(source, timeout=timeout)
         found = 0
-        for key, rx in (
-            ("int8_decode_speedup", INT8_SPEEDUP_RE),
-            ("int8_decode_tok_s", INT8_TOKS_RE),
-            ("bf16_decode_tok_s", BF16_TOKS_RE),
-        ):
+        for key, rx in parse:
             match = rx.search(result.stdout or "")
             if match:
                 PARTIAL[key] = float(match.group(1))
                 found += 1
         if result.exit_code != 0 and not found:
-            log(f"int8 leg failed (non-fatal): {result.stderr[-300:]}")
+            log(f"{name} leg failed (non-fatal): {result.stderr[-300:]}")
             return
-        log(f"int8 decode speedup: {PARTIAL.get('int8_decode_speedup')}")
+        log(f"{name} leg: parsed {found}/{len(parse)} metrics")
     except Exception as e:  # noqa: BLE001 — best-effort leg
-        log(f"int8 leg failed (non-fatal): {e}")
+        log(f"{name} leg failed (non-fatal): {e}")
     finally:
         if executor is not None:
             try:
                 await executor.close()
             except Exception as e:  # noqa: BLE001 — still best-effort
-                log(f"int8 leg teardown failed (non-fatal): {e}")
+                log(f"{name} leg teardown failed (non-fatal): {e}")
+
+
+async def run_quant(tmp: Path) -> None:
+    """int8 vs bf16 fused greedy decode through Execute — the weight-HBM
+    ratio models/quant.py exists for, in the DRIVER's artifact rather
+    than only a self-measured one."""
+    await _best_effort_leg("int8", QUANT_SOURCE, tmp, (
+        ("int8_decode_speedup", INT8_SPEEDUP_RE),
+        ("int8_decode_tok_s", INT8_TOKS_RE),
+        ("bf16_decode_tok_s", BF16_TOKS_RE),
+    ))
+
+
+async def run_serving(tmp: Path) -> None:
+    """Continuous-batching engine throughput through Execute (config 5g's
+    driver-artifact counterpart): dense + paged engine aggregate tok/s and
+    the batching speedup over sequential decode."""
+    await _best_effort_leg("serving", SERVING_SOURCE, tmp, (
+        ("serving_engine_tok_s", ENGINE_TOKS_RE),
+        ("serving_paged_tok_s", PAGED_TOKS_RE),
+        ("serving_engine_speedup", ENGINE_SPEEDUP_RE),
+    ))
 
 
 async def cold_start_p50(tmp: Path, samples: int = 5, warm_jax: bool = True) -> float:
@@ -457,13 +487,20 @@ async def main(prime_ok: bool, prime_detail: str) -> None:
         PARTIAL["execute_p50_warm_pool_s"] = round(p50, 4)
         if _remaining_s() > 300.0:
             # run_quant guards itself, but the headline must survive even a
-            # bug in that guard — belt and braces for the last leg.
+            # bug in that guard — belt and braces for the last legs.
             try:
                 await run_quant(tmp)
             except Exception as e:  # noqa: BLE001
                 log(f"int8 leg failed (non-fatal): {e}")
         else:
             log("skipping int8 leg (deadline near)")
+        if _remaining_s() > 300.0:
+            try:
+                await run_serving(tmp)
+            except Exception as e:  # noqa: BLE001
+                log(f"serving leg failed (non-fatal): {e}")
+        else:
+            log("skipping serving leg (deadline near)")
 
     line = {
         "metric": METRIC,

@@ -87,27 +87,28 @@ run_engine(mk_dense)
 run_engine(mk_paged)
 run_sequential()
 
-# Pass 2, timed.
-dense_out, dense_tps, dense_s = run_engine(mk_dense)
-paged_out, paged_tps, paged_s = run_engine(mk_paged)
-seq_outs, seq_s = run_sequential()
-seq_toks = sum(len(o) for o in seq_outs)
-
-for got, ref in zip(dense_out, seq_outs):
-    assert np.array_equal(got, ref), "engine output diverged from greedy"
-for got, ref in zip(paged_out, seq_outs):
-    assert np.array_equal(got, ref), "paged output diverged from greedy"
-
-print(f"backend: {jax.devices()[0].platform}")
+# Pass 2, timed. Each marker flushes AS SOON as it is measured so a
+# timeout mid-script still leaves every completed number in stdout (the
+# driver bench parses whatever made it out).
+print(f"backend: {jax.devices()[0].platform}", flush=True)
 if not ON_TPU:
     # The tiny-CPU shape is a correctness smoke: host-side scheduling
     # dominates a model this small, so sequential fused generates win.
     # The batching case the engine exists for — decode bound by device
     # weight streaming, many concurrent requests — is the TPU config.
     print("note: tiny CPU config; ratios are not meaningful at this scale")
-print(f"requests={N_REQ} max_new={MAX_NEW} slots={N_SLOTS}")
-print(f"SEQUENTIAL_TOKS_PER_S={seq_toks / seq_s:.1f}")
-print(f"ENGINE_TOKS_PER_S={dense_tps:.1f}")
-print(f"PAGED_TOKS_PER_S={paged_tps:.1f}")
-print(f"ENGINE_SPEEDUP={dense_tps / (seq_toks / seq_s):.2f}")
+print(f"requests={N_REQ} max_new={MAX_NEW} slots={N_SLOTS}", flush=True)
+dense_out, dense_tps, dense_s = run_engine(mk_dense)
+print(f"ENGINE_TOKS_PER_S={dense_tps:.1f}", flush=True)
+paged_out, paged_tps, paged_s = run_engine(mk_paged)
+print(f"PAGED_TOKS_PER_S={paged_tps:.1f}", flush=True)
+seq_outs, seq_s = run_sequential()
+seq_toks = sum(len(o) for o in seq_outs)
+print(f"SEQUENTIAL_TOKS_PER_S={seq_toks / seq_s:.1f}", flush=True)
+print(f"ENGINE_SPEEDUP={dense_tps / (seq_toks / seq_s):.2f}", flush=True)
+
+for got, ref in zip(dense_out, seq_outs):
+    assert np.array_equal(got, ref), "engine output diverged from greedy"
+for got, ref in zip(paged_out, seq_outs):
+    assert np.array_equal(got, ref), "paged output diverged from greedy"
 print("outputs: token-exact vs per-request greedy_generate")
