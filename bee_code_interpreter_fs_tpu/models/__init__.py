@@ -52,6 +52,7 @@ from bee_code_interpreter_fs_tpu.models.lora import (
 from bee_code_interpreter_fs_tpu.models.paged import PagedServingEngine
 from bee_code_interpreter_fs_tpu.models.serving import ServingEngine
 from bee_code_interpreter_fs_tpu.models.spec_serving import (
+    PagedSpeculativeServingEngine,
     SpeculativeServingEngine,
 )
 
@@ -91,5 +92,6 @@ __all__ = [
     "stack_loras",
     "PagedServingEngine",
     "ServingEngine",
+    "PagedSpeculativeServingEngine",
     "SpeculativeServingEngine",
 ]

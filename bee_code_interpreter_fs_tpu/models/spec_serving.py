@@ -66,6 +66,9 @@ from bee_code_interpreter_fs_tpu.models.llama import (
     init_cache,
     transformer_block,
 )
+from bee_code_interpreter_fs_tpu.models.paged import (
+    PagedServingEngine,
+)
 from bee_code_interpreter_fs_tpu.models.serving import (
     Request,
     ServingEngine,
@@ -79,7 +82,7 @@ from bee_code_interpreter_fs_tpu.models.serving import (
     _prefix_prefill,
 )
 
-__all__ = ["SpeculativeServingEngine"]
+__all__ = ["PagedSpeculativeServingEngine", "SpeculativeServingEngine"]
 
 
 def _perslot_decode_chunk(params, tokens, cache, pos, cfg: LlamaConfig):
@@ -145,25 +148,24 @@ def _fold2(keys, data, tag: int):
     )
 
 
-@partial(
-    jax.jit,
-    static_argnames=("cfg", "dcfg", "steps", "gamma", "eos_id",
-                     "with_sampling"),
-    donate_argnames=("cache", "dcache"),
-)
-def _spec_decode_burst(params, dparams, cache, dcache, pos, last_tok,
-                       remaining, active, temp, keys, cfg: LlamaConfig,
-                       dcfg: LlamaConfig, steps: int, gamma: int, eos_id,
-                       with_sampling: bool = False):
-    """`steps` draft/verify passes over the slot bank, one jitted program.
+def _spec_burst_scan(verify_fn, dparams, store, dcache, pos, last_tok,
+                     remaining, active, temp, keys, dcfg: LlamaConfig,
+                     steps: int, gamma: int, eos_id,
+                     with_sampling: bool = False):
+    """The ONE speculative burst loop both storage backends run —
+    `verify_fn(store, chunk, pos, active)` is the only difference between
+    the dense slot-bank and the paged block-pool engines (mirrors how
+    serving._burst_scan is shared by the plain engines), so the
+    draft/accept/resample/clamp logic cannot drift between them.
 
-    Invariant at the top of each pass (per slot): `last_tok[i]` is the
-    newest emitted token, sitting unfed at position pos[i]; both caches
-    hold K/V for positions < pos[i]. Each pass emits 1..γ+1 tokens per
-    active slot (clamped by budget and eos). Returns the updated carry
-    plus (toks [steps, b, γ+1], emitted [steps, b, γ+1]) — pass-major
-    emission order, so flattening the trailing axis reconstructs each
-    slot's stream exactly.
+    `steps` draft/verify passes over the slot bank. Invariant at the top
+    of each pass (per slot): `last_tok[i]` is the newest emitted token,
+    sitting unfed at position pos[i]; both caches hold K/V for positions
+    < pos[i]. Each pass emits 1..γ+1 tokens per active slot (clamped by
+    budget and eos). Returns the updated carry plus
+    (toks [steps, b, γ+1], emitted [steps, b, γ+1]) — pass-major emission
+    order, so flattening the trailing axis reconstructs each slot's
+    stream exactly.
 
     Greedy slots (temp == 0) accept by TOKEN EQUALITY — output exactly
     the plain engine's greedy stream. With `with_sampling` (static; only
@@ -184,7 +186,7 @@ def _spec_decode_burst(params, dparams, cache, dcache, pos, last_tok,
     idx = jnp.arange(gamma + 1)
 
     def one(carry, _):
-        cache, dcache, pos, tok, remaining, active = carry
+        store, dcache, pos, tok, remaining, active = carry
 
         # Draft rollout: γ+1 per-slot steps. Step j feeds the token at
         # position pos+j; steps 0..γ-1 yield proposals d_1..d_γ, the extra
@@ -216,7 +218,7 @@ def _spec_decode_burst(params, dparams, cache, dcache, pos, last_tok,
         # per-slot chunk; t_preds[:, j] is the target's greedy choice for
         # position pos+j+1.
         chunk = jnp.concatenate([tok[:, None], drafts], axis=1)
-        v_logits, cache = _perslot_decode_chunk(params, chunk, cache, pos, cfg)
+        v_logits, store = verify_fn(store, chunk, pos, active)
         t_preds = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)  # [b, γ+1]
 
         # Greedy acceptance: per-slot longest agreeing prefix — NO
@@ -294,31 +296,140 @@ def _spec_decode_burst(params, dparams, cache, dcache, pos, last_tok,
         active = active & (remaining > 0)
         if eos_id is not None:
             active = active & (new_tok != eos_id)
-        return (cache, dcache, pos, new_tok, remaining, active), (
+        return (store, dcache, pos, new_tok, remaining, active), (
             out, emitted
         )
 
     carry, (toks, emitted) = lax.scan(
-        one, (cache, dcache, pos, last_tok, remaining, active),
+        one, (store, dcache, pos, last_tok, remaining, active),
         None, length=steps,
     )
-    cache, dcache, pos, tok, remaining, active = carry
-    return cache, dcache, pos, tok, remaining, active, toks, emitted
+    store, dcache, pos, tok, remaining, active = carry
+    return store, dcache, pos, tok, remaining, active, toks, emitted
 
 
-class SpeculativeServingEngine(ServingEngine):
-    """Continuous batching with per-slot speculative decoding.
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "dcfg", "steps", "gamma", "eos_id",
+                     "with_sampling"),
+    donate_argnames=("cache", "dcache"),
+)
+def _spec_decode_burst(params, dparams, cache, dcache, pos, last_tok,
+                       remaining, active, temp, keys, cfg: LlamaConfig,
+                       dcfg: LlamaConfig, steps: int, gamma: int, eos_id,
+                       with_sampling: bool = False):
+    """Dense-cache speculative burst: verify against the [n_slots,
+    max_len] slot bank (see _spec_burst_scan for the shared loop)."""
 
-    >>> eng = SpeculativeServingEngine(params, cfg, draft_params=dp,
-    ...                                draft_cfg=dcfg, gamma=4, n_slots=4)
-    >>> rid = eng.submit([1, 5, 9], max_new_tokens=64)
-    >>> eng.run()   # token-exact vs ServingEngine on the same traffic
+    def verify_fn(cache, chunk, pos, active):
+        # Dense rows are slot-private: an inactive slot's stale-frontier
+        # rewrite is harmless, so `active` is unused here.
+        return _perslot_decode_chunk(params, chunk, cache, pos, cfg)
 
-    Each scheduler sync runs `steps_per_sync` draft/verify passes, so a
-    slot can emit up to steps_per_sync*(γ+1) tokens per sync (streaming
-    chunks grow accordingly). Greedy requests are token-exact vs the
-    plain engine; temperature>0 requests are distribution-exact vs the
-    target (accept/resample) — see module doc for scope."""
+    return _spec_burst_scan(verify_fn, dparams, cache, dcache, pos,
+                            last_tok, remaining, active, temp, keys, dcfg,
+                            steps, gamma, eos_id, with_sampling)
+
+
+def _perslot_decode_chunk_paged(params, tokens, pool, tables, pos, active,
+                                limit, cfg: LlamaConfig):
+    """The paged twin of _perslot_decode_chunk: a γ+1-token chunk per
+    slot against the block pool, each slot at its own position. Writes
+    land at (table[(pos+j)//bs], (pos+j)%bs); reads attend the gathered
+    logical cache.
+
+    The chunk can reach up to γ positions PAST a slot's real end (the
+    rejected-proposal tail when `remaining` is nearly spent). In the
+    dense engine those writes harmlessly rewrite the slot's own row; here
+    a position beyond the slot's RESERVATION would write through a table
+    row the slot does not own — another request's block. `limit` [b] is
+    each slot's reserved token extent: writes at qpos >= limit (and all
+    writes of inactive slots) divert to the pool's trash block. Every
+    eventually-EMITTED position is < limit by construction
+    (reservation covers prompt + max_new), so diverted writes are only
+    ever rejected-tail garbage, rewritten through the real block by the
+    pass whose chunk covers them."""
+    dt = jnp.dtype(cfg.dtype)
+    scale = cfg.head_dim ** -0.5
+    quant = "kq" in pool
+    b, s = tokens.shape
+    ref = pool["kq"] if quant else pool["k"]
+    bs = ref.shape[2]
+    trash = ref.shape[1] - 1
+    max_blocks = tables.shape[1]
+    logical = max_blocks * bs
+    qpos = pos[:, None] + jnp.arange(s)[None, :]  # [b, s]
+    valid = decode_valid_mask(qpos.reshape(-1), logical, cfg).reshape(
+        b, s, logical
+    )[:, None, None, :, :]
+    ok = active[:, None] & (qpos < limit[:, None])
+    safe_rows = jnp.minimum(qpos, logical - 1) // bs
+    blk = jnp.take_along_axis(tables, safe_rows, axis=1)  # [b, s]
+    blk = jnp.where(ok, blk, trash)
+    off = qpos % bs
+    x = params["embed"].astype(dt)[tokens]
+
+    def gathered(c):
+        # Per-layer leaf [nb, bs, ...] (the layer axis is scanned off):
+        # gather table rows then flatten blocks into the logical axis.
+        return c[tables].reshape(b, logical, *c.shape[2:])
+
+    pool_keys, write_read = _kv_write_read(
+        quant, lambda c, v: c.at[blk, off].set(v), gathered, dt
+    )
+
+    def layer(x, inputs):
+        lp = inputs[0]
+        cs = inputs[1:]
+        cell = {}
+
+        def attn_fn(q, k, v):
+            new, keys_r, vals_r = write_read(cs, k, v)
+            cell["kv"] = new
+            return _cached_gqa_attention(q, keys_r, vals_r, valid, scale)
+
+        x = transformer_block(x, lp, cfg, attn_fn, rope_offset=pos)
+        return x, cell["kv"]
+
+    x, new_leaves = lax.scan(
+        layer, x, (params["layers"],) + tuple(pool[k] for k in pool_keys)
+    )
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ _w(params["lm_head"], dt)).astype(jnp.float32)
+    return logits, dict(zip(pool_keys, new_leaves))
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "dcfg", "steps", "gamma", "eos_id",
+                     "with_sampling"),
+    donate_argnames=("pool", "dcache"),
+)
+def _spec_decode_burst_paged(params, dparams, pool, tables, limit, dcache,
+                             pos, last_tok, remaining, active, temp, keys,
+                             cfg: LlamaConfig, dcfg: LlamaConfig,
+                             steps: int, gamma: int, eos_id,
+                             with_sampling: bool = False):
+    """Paged speculative burst: same shared loop, verify against the
+    block pool (tables and per-slot limits are constant for a burst —
+    reservation admission pre-allocates every block a request can
+    touch)."""
+
+    def verify_fn(pool, chunk, pos, active):
+        return _perslot_decode_chunk_paged(
+            params, chunk, pool, tables, pos, active, limit, cfg
+        )
+
+    return _spec_burst_scan(verify_fn, dparams, pool, dcache, pos,
+                            last_tok, remaining, active, temp, keys, dcfg,
+                            steps, gamma, eos_id, with_sampling)
+
+
+class _SpeculativeMixin:
+    """Draft-model state, validation, admission mirroring, and two-sided
+    prefix caching shared by the dense and paged speculative engines.
+    The draft cache is always the dense slot bank (the draft is small);
+    only the TARGET's storage differs between the concrete classes."""
 
     def __init__(self, params, cfg: LlamaConfig, *, draft_params,
                  draft_cfg: LlamaConfig, gamma: int = 4, **kwargs):
@@ -390,7 +501,9 @@ class SpeculativeServingEngine(ServingEngine):
 
     def _install(self, req: Request, i: int):
         placed = super()._install(req, i)
-        if placed is None:  # pragma: no cover — dense engine never defers
+        if placed is None:
+            # Paged backend out of blocks: the caller requeues; nothing
+            # was placed, so nothing to mirror.
             return None
         # Mirror the admission into the DRAFT cache: same bucket, same
         # slot row; the draft's admission logits are discarded (the
@@ -432,25 +545,98 @@ class SpeculativeServingEngine(ServingEngine):
             )
         return placed
 
+    def _with_sampling(self) -> bool:
+        return any(
+            r is not None and r.temperature > 0 for r in self._slot_req
+        )
+
+    @staticmethod
+    def _flatten_burst(toks, emitted):
+        """[steps, b, γ+1] → [steps*(γ+1), b], pass-major then
+        within-pass: exactly each slot's emission order, so the base
+        step() consumes it unchanged."""
+        s, b, g1 = toks.shape
+        toks = jnp.transpose(toks, (0, 2, 1)).reshape(s * g1, b)
+        emitted = jnp.transpose(emitted, (0, 2, 1)).reshape(s * g1, b)
+        return toks, emitted
+
+
+class SpeculativeServingEngine(_SpeculativeMixin, ServingEngine):
+    """Continuous batching with per-slot speculative decoding over the
+    dense slot-bank cache.
+
+    >>> eng = SpeculativeServingEngine(params, cfg, draft_params=dp,
+    ...                                draft_cfg=dcfg, gamma=4, n_slots=4)
+    >>> rid = eng.submit([1, 5, 9], max_new_tokens=64)
+    >>> eng.run()   # token-exact vs ServingEngine on the same traffic
+
+    Each scheduler sync runs `steps_per_sync` draft/verify passes, so a
+    slot can emit up to steps_per_sync*(γ+1) tokens per sync (streaming
+    chunks grow accordingly). Greedy requests are token-exact vs the
+    plain engine; temperature>0 requests are distribution-exact vs the
+    target (accept/resample) — see module doc for scope."""
+
     def _run_burst(self, with_logprobs: bool = False,
                    with_top_p: bool = False, with_penalties: bool = False):
         # submit() rejected everything that could set these flags.
         assert not (with_logprobs or with_top_p or with_penalties)
-        with_sampling = any(
-            r is not None and r.temperature > 0 for r in self._slot_req
-        )
         (self.cache, self.dcache, self.pos, self.last_tok, self.remaining,
          self.active, toks, emitted) = _spec_decode_burst(
             self.params, self.draft_params, self.cache, self.dcache,
             self.pos, self.last_tok, self.remaining, self.active,
             self.temp, self.keys,
             self.cfg, self.dcfg, self.steps_per_sync, self.gamma,
-            self.eos_id, with_sampling,
+            self.eos_id, self._with_sampling(),
         )
-        # [steps, b, γ+1] → [steps*(γ+1), b], pass-major then within-pass:
-        # exactly each slot's emission order, so the base step() consumes
-        # it unchanged.
-        s, b, g1 = toks.shape
-        toks = jnp.transpose(toks, (0, 2, 1)).reshape(s * g1, b)
-        emitted = jnp.transpose(emitted, (0, 2, 1)).reshape(s * g1, b)
+        toks, emitted = self._flatten_burst(toks, emitted)
+        return toks, emitted, None
+
+
+class PagedSpeculativeServingEngine(_SpeculativeMixin, PagedServingEngine):
+    """Per-slot speculative decoding over the paged block pool: the full
+    composition — continuous batching, block-table KV memory (with
+    block-level prefix sharing and optional int8 pool), and draft/verify
+    speculation — in one engine. Semantics match
+    SpeculativeServingEngine exactly (same shared burst loop); only the
+    TARGET's storage differs.
+
+    The one paged-specific concern is the chunk's rejected-proposal tail:
+    writes up to γ positions past a slot's reservation divert to the
+    trash block via the per-slot `limit` vector (see
+    _perslot_decode_chunk_paged) instead of corrupting a neighbor's
+    blocks."""
+
+    def _init_device_state(self):
+        super()._init_device_state()
+        # Reserved token extent per slot, set at admission: the paged
+        # verify chunk's write guard.
+        self._slot_limit = jnp.zeros((self.n_slots,), jnp.int32)
+
+    def _install(self, req: Request, i: int):
+        placed = super()._install(req, i)
+        if placed is None:
+            return None
+        shared = 0
+        if req.prefix_id is not None:
+            shared = len(
+                self._prefixes[req.prefix_id].get("pool_blocks", ())
+            )
+        self._slot_limit = self._slot_limit.at[i].set(
+            (shared + len(self._slot_blocks[i])) * self.block_size
+        )
+        return placed
+
+    def _run_burst(self, with_logprobs: bool = False,
+                   with_top_p: bool = False, with_penalties: bool = False):
+        assert not (with_logprobs or with_top_p or with_penalties)
+        (self.pool, self.dcache, self.pos, self.last_tok, self.remaining,
+         self.active, toks, emitted) = _spec_decode_burst_paged(
+            self.params, self.draft_params, self.pool, self.tables,
+            self._slot_limit, self.dcache,
+            self.pos, self.last_tok, self.remaining, self.active,
+            self.temp, self.keys,
+            self.cfg, self.dcfg, self.steps_per_sync, self.gamma,
+            self.eos_id, self._with_sampling(),
+        )
+        toks, emitted = self._flatten_burst(toks, emitted)
         return toks, emitted, None

@@ -303,3 +303,94 @@ def test_chunked_prefill_spec(model):
         res[r2], _reference(params, cfg, [5, 9], 9))
     np.testing.assert_array_equal(
         res[r3], _reference(params, cfg, sysp + [8, 1], 6))
+
+
+def test_paged_spec_token_exact(model):
+    """The full composition — paged pool + prefix sharing + speculation —
+    must emit exactly what the dense speculative engine (and therefore
+    greedy_generate) emits, across staggered mixed traffic."""
+    from bee_code_interpreter_fs_tpu.models.spec_serving import (
+        PagedSpeculativeServingEngine,
+    )
+
+    params, cfg, dparams, dcfg = model
+    sysp = [9, 1, 4, 27, 60]
+    reqs = [([5], 7), (list(range(20, 40)), 5), ([88, 2], 12)]
+    eng = PagedSpeculativeServingEngine(
+        params, cfg, draft_params=dparams, draft_cfg=dcfg, gamma=3,
+        n_slots=2, max_len=96, steps_per_sync=2, block_size=8)
+    pid = eng.register_prefix(sysp)
+    rids = [eng.submit(p, m) for p, m in reqs]
+    rp = eng.submit([3, 5], 6, prefix_id=pid)
+    res = eng.run()
+    for rid, (p, m) in zip(rids, reqs):
+        np.testing.assert_array_equal(
+            res[rid], _reference(params, cfg, p, m))
+    np.testing.assert_array_equal(
+        res[rp], _reference(params, cfg, sysp + [3, 5], 6))
+    assert eng.stats()["shared_prefix_blocks"] == 0  # plen 5 < bs 8
+    eng.unregister_prefix(pid)
+    assert eng.free_blocks == eng.stats()["total_blocks"]
+
+
+def test_paged_spec_overrun_cannot_corrupt_neighbor(model):
+    """The corruption hazard the per-slot limit guard exists for: slot A
+    nearly out of budget (remaining < γ) shares a pass with slot B whose
+    blocks include low physical ids; A's rejected-tail writes beyond its
+    reservation must divert to trash, never into B's blocks. B's output
+    must stay token-exact."""
+    from bee_code_interpreter_fs_tpu.models.spec_serving import (
+        PagedSpeculativeServingEngine,
+    )
+
+    params, cfg, dparams, dcfg = model
+    eng = PagedSpeculativeServingEngine(
+        params, cfg, draft_params=dparams, draft_cfg=dcfg, gamma=4,
+        n_slots=2, max_len=64, steps_per_sync=1, block_size=4, n_blocks=20)
+    # B admits first (pops high ids off the free list, leaving low ids
+    # free), generates long; A's budget expires mid-pass repeatedly.
+    rb = eng.submit(list(range(2, 12)), 20)
+    ra = eng.submit([7, 7], 2)       # remaining=1 after admission
+    ra2 = eng.submit([8, 1, 3], 3)   # reuses A's slot, small budget again
+    res = eng.run()
+    np.testing.assert_array_equal(
+        res[rb], _reference(params, cfg, list(range(2, 12)), 20))
+    np.testing.assert_array_equal(
+        res[ra], _reference(params, cfg, [7, 7], 2))
+    np.testing.assert_array_equal(
+        res[ra2], _reference(params, cfg, [8, 1, 3], 3))
+    assert eng.free_blocks == eng.stats()["total_blocks"]
+
+
+def test_paged_spec_int8_and_sampled(model):
+    """int8 pool + speculation + sampled traffic on the paged engine:
+    greedy rows match the plain paged-int8 engine; sampled rows are
+    seed-deterministic."""
+    from bee_code_interpreter_fs_tpu.models.paged import PagedServingEngine
+    from bee_code_interpreter_fs_tpu.models.spec_serving import (
+        PagedSpeculativeServingEngine,
+    )
+
+    params, cfg, dparams, dcfg = model
+
+    plain = PagedServingEngine(params, cfg, n_slots=2, max_len=64,
+                               steps_per_sync=3, block_size=8,
+                               kv_quant=True)
+    pg = plain.submit([4, 9, 2], 9)
+    pres = plain.run()
+
+    def drive():
+        eng = PagedSpeculativeServingEngine(
+            params, cfg, draft_params=dparams, draft_cfg=dcfg, gamma=3,
+            n_slots=2, max_len=64, steps_per_sync=2, block_size=8,
+            kv_quant=True)
+        g = eng.submit([4, 9, 2], 9)
+        s = eng.submit([8], 7, temperature=1.1, seed=5)
+        res = eng.run()
+        return res[g], res[s]
+
+    g_a, s_a = drive()
+    g_b, s_b = drive()
+    np.testing.assert_array_equal(g_a, pres[pg])  # spec+paged+int8 exact
+    np.testing.assert_array_equal(g_a, g_b)
+    np.testing.assert_array_equal(s_a, s_b)       # seeded sampled replay
