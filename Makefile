@@ -2,7 +2,10 @@
 # test; pyproject.toml:45-57 — done as make targets since this project is
 # setuptools-based).
 
-.PHONY: all executor run health-check test test-sanitizers bench proto clean
+# verify uses bash-only ${PIPESTATUS[0]} (the ROADMAP tier-1 command verbatim).
+SHELL := /bin/bash
+
+.PHONY: all executor run health-check test test-sanitizers verify bench proto clean
 
 all: executor
 
@@ -17,6 +20,12 @@ health-check:
 
 test: executor
 	python -m pytest tests/ -q
+
+# The ROADMAP.md "Tier-1 verify" command, verbatim ($ doubled for make):
+# the acceptance gate every PR must keep no worse than the seed. CI calls
+# this so local `make verify` and the workflow can never drift apart.
+verify:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 test-sanitizers:
 	$(MAKE) -C executor asan tsan

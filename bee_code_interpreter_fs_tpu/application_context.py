@@ -16,6 +16,7 @@ from .services.code_executor import CodeExecutor
 from .services.custom_tool_executor import CustomToolExecutor
 from .services.storage import Storage
 from .utils.logs import setup_logging
+from .utils.metrics import ExecutorMetrics
 
 
 class ApplicationContext:
@@ -28,6 +29,10 @@ class ApplicationContext:
         return Storage(self.config.file_storage_path)
 
     @cached_property
+    def metrics(self) -> ExecutorMetrics:
+        return ExecutorMetrics()
+
+    @cached_property
     def backend(self) -> SandboxBackend:
         if self.config.executor_backend == "kubernetes":
             try:
@@ -35,16 +40,32 @@ class ApplicationContext:
             except ImportError as e:
                 raise ValueError(f"kubernetes backend unavailable: {e}") from e
 
-            return KubernetesSandboxBackend(self.config)
-        if self.config.executor_backend == "local":
+            backend: SandboxBackend = KubernetesSandboxBackend(self.config)
+        elif self.config.executor_backend == "local":
             from .services.backends.local import LocalSandboxBackend
 
-            return LocalSandboxBackend(self.config)
-        raise ValueError(f"unknown executor backend: {self.config.executor_backend}")
+            backend = LocalSandboxBackend(self.config)
+        else:
+            raise ValueError(
+                f"unknown executor backend: {self.config.executor_backend}"
+            )
+        if self.config.executor_fault_spec:
+            # Chaos mode: wrap the real backend with the seeded fault plan
+            # (reproducible failure injection for resilience drills/CI).
+            from .services.backends.faults import FaultInjectingBackend, FaultSpec
+
+            backend = FaultInjectingBackend(
+                backend,
+                FaultSpec.parse(self.config.executor_fault_spec),
+                on_fault=lambda kind: self.metrics.injected_faults.inc(fault=kind),
+            )
+        return backend
 
     @cached_property
     def code_executor(self) -> CodeExecutor:
-        return CodeExecutor(self.backend, self.storage, self.config)
+        return CodeExecutor(
+            self.backend, self.storage, self.config, metrics=self.metrics
+        )
 
     @cached_property
     def custom_tool_executor(self) -> CustomToolExecutor:

@@ -133,6 +133,23 @@ class Config(BaseModel):
     # a capacity-constrained lane held by ACTIVELY USED sessions, which the
     # idle sweeper (by design) never touches. 0 = wait forever.
     executor_acquire_timeout: float = 300.0
+    # -- resilience ----------------------------------------------------------
+    # Spawn retry ladder length (calls, not retries): each failed attempt
+    # backs off exponentially (0.5s base, 5s cap) with full jitter via
+    # utils/retrying.py — the in-repo engine that replaced tenacity.
+    executor_spawn_retry_attempts: int = 3
+    # Per-chip-count-lane circuit breaker: after this many CONSECUTIVE spawn
+    # failures the lane opens and new work fails fast with a retryable
+    # error (HTTP 503 + Retry-After / gRPC UNAVAILABLE) instead of
+    # burning the acquire budget against a backend that is down.
+    breaker_failure_threshold: int = 5
+    # Seconds an open lane waits before letting a half-open probe through;
+    # one probe success closes the lane, one failure re-opens it.
+    breaker_cooldown: float = 30.0
+    # Deterministic fault-injection plan for chaos runs, e.g.
+    # "spawn_fail:0.3,seed:7" (grammar in services/backends/faults.py).
+    # Empty = no injection. NEVER set in production.
+    executor_fault_spec: str = ""
     # -- sandbox resource limits (local backend) ----------------------------
     # Extra address-space bytes user code may allocate beyond the warm
     # runner's baseline (soft RLIMIT_AS window in executor/runner.py): an

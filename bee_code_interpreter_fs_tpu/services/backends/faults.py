@@ -1,0 +1,198 @@
+"""Deterministic fault injection for any `SandboxBackend`.
+
+Chaos testing the pool requires failures that are (a) realistic — spawn
+errors, slow readiness, refused recycles, hanging deletes, mid-execute
+connection drops — and (b) **reproducible**, or a CI chaos run that fails
+once can never be debugged. `FaultInjectingBackend` wraps a real backend
+with a seeded fault plan: every fault category draws from its own
+`random.Random` stream (seeded from the plan seed + category name), so the
+spawn-failure sequence does not depend on how exec-drop rolls interleave
+with it under concurrency.
+
+The plan is configured as a compact spec string so one env var turns chaos
+on in any deployment (``APP_EXECUTOR_FAULT_SPEC=spawn_fail:0.3,seed:7``):
+
+    spawn_fail:<rate>    probability a spawn raises SandboxSpawnError
+    slow_ready:<seconds> added latency before a successful spawn returns
+    reset_fail:<rate>    probability a reset refuses (returns None)
+    delete_hang:<seconds> added latency inside delete()
+    exec_drop:<rate>     probability a sandbox HTTP request raises
+                         ConnectError mid-flight (via the injectable httpx
+                         transport the orchestrator asks backends for)
+    seed:<int>           the plan seed (default 0)
+
+Rates are in [0, 1]; delays are seconds. Unknown keys fail loudly — a typo'd
+chaos knob silently injecting nothing is itself a reliability bug.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from collections.abc import Callable
+from dataclasses import dataclass, fields
+
+import httpx
+
+from .base import Sandbox, SandboxBackend, SandboxSpawnError
+
+logger = logging.getLogger(__name__)
+
+SPAWN_FAIL = "spawn_fail"
+SLOW_READY = "slow_ready"
+RESET_FAIL = "reset_fail"
+DELETE_HANG = "delete_hang"
+EXEC_DROP = "exec_drop"
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    spawn_fail: float = 0.0
+    slow_ready: float = 0.0
+    reset_fail: float = 0.0
+    delete_hang: float = 0.0
+    exec_drop: float = 0.0
+    seed: int = 0
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        """Parse ``key:value,key:value`` (whitespace tolerated). An empty
+        string is the null plan (inject nothing)."""
+        values: dict[str, float | int] = {}
+        known = {f.name for f in fields(cls)}
+        for item in text.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            key, sep, raw = item.partition(":")
+            key = key.strip()
+            if not sep or key not in known:
+                raise ValueError(
+                    f"bad fault spec item {item!r}: want one of "
+                    f"{sorted(known)} as key:value"
+                )
+            try:
+                values[key] = int(raw) if key == "seed" else float(raw)
+            except ValueError:
+                raise ValueError(
+                    f"bad fault spec value for {key}: {raw!r}"
+                ) from None
+        spec = cls(**values)
+        for name in (SPAWN_FAIL, RESET_FAIL, EXEC_DROP):
+            rate = getattr(spec, name)
+            if not 0.0 <= rate <= 1.0:
+                raise ValueError(f"fault rate {name} must be in [0,1]: {rate}")
+        for name in (SLOW_READY, DELETE_HANG):
+            if getattr(spec, name) < 0.0:
+                raise ValueError(f"fault delay {name} must be >= 0")
+        return spec
+
+    @property
+    def active(self) -> bool:
+        return any(
+            getattr(self, f.name) for f in fields(self) if f.name != "seed"
+        )
+
+
+class DroppingTransport(httpx.AsyncBaseTransport):
+    """httpx transport that raises `httpx.ConnectError` on a seeded fraction
+    of requests before delegating to the real transport — the mid-execute
+    connection drop no backend-level fault can produce (the request dies on
+    the wire, not in the sandbox)."""
+
+    def __init__(
+        self,
+        rate: float,
+        rng: random.Random,
+        on_fault: Callable[[str], None] | None = None,
+        inner: httpx.AsyncBaseTransport | None = None,
+    ) -> None:
+        self.rate = rate
+        self.rng = rng
+        self.on_fault = on_fault
+        self.inner = inner or httpx.AsyncHTTPTransport()
+
+    async def handle_async_request(self, request):
+        if self.rng.random() < self.rate:
+            if self.on_fault is not None:
+                self.on_fault(EXEC_DROP)
+            raise httpx.ConnectError(
+                f"injected connection drop ({request.url})", request=request
+            )
+        return await self.inner.handle_async_request(request)
+
+    async def aclose(self) -> None:
+        await self.inner.aclose()
+
+
+class FaultInjectingBackend(SandboxBackend):
+    """Wraps any backend with the seeded fault plan above. Transparent when
+    the plan is null; delete() never raises (base-class contract) even while
+    injecting hangs."""
+
+    def __init__(
+        self,
+        inner: SandboxBackend,
+        spec: FaultSpec,
+        *,
+        on_fault: Callable[[str], None] | None = None,
+    ) -> None:
+        self.inner = inner
+        self.spec = spec
+        self.on_fault = on_fault
+        self._rngs = {
+            name: random.Random(f"{spec.seed}:{name}")
+            for name in (SPAWN_FAIL, SLOW_READY, RESET_FAIL, DELETE_HANG, EXEC_DROP)
+        }
+        if spec.active:
+            logger.warning("fault injection ACTIVE: %s", spec)
+
+    def _fire(self, name: str, rate: float) -> bool:
+        if rate <= 0.0 or self._rngs[name].random() >= rate:
+            return False
+        if self.on_fault is not None:
+            self.on_fault(name)
+        return True
+
+    # ---------------------------------------------------------------- backend
+
+    async def spawn(self, chip_count: int = 0) -> Sandbox:
+        if self._fire(SPAWN_FAIL, self.spec.spawn_fail):
+            raise SandboxSpawnError(
+                f"injected spawn failure (lane={chip_count}, "
+                f"seed={self.spec.seed})"
+            )
+        if self.spec.slow_ready > 0.0:
+            self._fire(SLOW_READY, 1.0)  # counted, never skipped
+            await asyncio.sleep(self.spec.slow_ready)
+        return await self.inner.spawn(chip_count)
+
+    def pool_capacity(self, chip_count: int) -> int | None:
+        capacity_fn = getattr(self.inner, "pool_capacity", None)
+        return capacity_fn(chip_count) if capacity_fn is not None else None
+
+    async def reset(self, sandbox: Sandbox) -> Sandbox | None:
+        if self._fire(RESET_FAIL, self.spec.reset_fail):
+            return None
+        return await self.inner.reset(sandbox)
+
+    async def delete(self, sandbox: Sandbox) -> None:
+        if self.spec.delete_hang > 0.0:
+            self._fire(DELETE_HANG, 1.0)
+            await asyncio.sleep(self.spec.delete_hang)
+        await self.inner.delete(sandbox)
+
+    async def close(self) -> None:
+        await self.inner.close()
+
+    # ------------------------------------------------------------- http hook
+
+    def http_transport(self) -> httpx.AsyncBaseTransport | None:
+        """Transport the orchestrator should build its sandbox HTTP client
+        with (None = default). This is how exec_drop reaches the wire."""
+        if self.spec.exec_drop <= 0.0:
+            return None
+        return DroppingTransport(
+            self.spec.exec_drop, self._rngs[EXEC_DROP], self.on_fault
+        )

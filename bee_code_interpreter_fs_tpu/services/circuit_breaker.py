@@ -1,0 +1,168 @@
+"""Per-lane spawn circuit breaker for the sandbox pool.
+
+Podracer-style fleets (arxiv 2104.06272) and the Kubernetes GenAI-inference
+study (arxiv 2602.04900) both land on the same serving invariant: when a
+backend is persistently failing, requests must fail FAST with a retryable
+signal, not queue against it. Here that shows up concretely: a down backend
+would otherwise make every Execute burn up to ``executor_acquire_timeout``
+(300 s) in `_acquire`, plus three spawn attempts with backoff — per request.
+
+States (classic three-state breaker):
+
+- **closed** — spawns flow; consecutive failures are counted.
+- **open** — after ``failure_threshold`` consecutive failures. `allow()` is
+  False until ``cooldown`` elapses; callers raise `CircuitOpenError`
+  (retryable, carries a retry-after hint) immediately.
+- **half-open** — cooldown elapsed: probes are allowed through. One success
+  closes the breaker; one failure re-opens it with a fresh cooldown.
+  Half-open deliberately does NOT ration probes to a single in-flight
+  attempt: a permit reserved by `allow()` and leaked on cancellation would
+  wedge the lane open forever, which is strictly worse than a brief probe
+  herd on a lane that is (probably) recovering.
+
+One breaker per chip-count lane (`BreakerBoard`): a dead 4-chip slice
+nodepool must not fail CPU-lane traffic fast, and vice versa.
+
+The clock is injectable so tests drive transitions deterministically.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections.abc import Callable
+
+from .errors import CircuitOpenError
+
+logger = logging.getLogger(__name__)
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+# Prometheus gauge encoding (utils/metrics.py breaker-state gauge).
+STATE_CODES = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        *,
+        failure_threshold: int = 5,
+        cooldown: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        name: str = "",
+    ) -> None:
+        self.failure_threshold = max(1, failure_threshold)
+        self.cooldown = cooldown
+        self.clock = clock
+        self.name = name
+        self._failures = 0
+        self._opened_at: float | None = None
+
+    # ------------------------------------------------------------------ state
+
+    @property
+    def state(self) -> str:
+        if self._opened_at is None:
+            return CLOSED
+        if self.clock() - self._opened_at >= self.cooldown:
+            return HALF_OPEN
+        return OPEN
+
+    @property
+    def is_open(self) -> bool:
+        """True only for the hard-open window (cooldown still pending):
+        half-open lanes accept probe traffic and must not fail fast."""
+        return self.state == OPEN
+
+    def retry_after(self) -> float:
+        """Seconds until the next probe is allowed (0 when traffic flows)."""
+        if self._opened_at is None:
+            return 0.0
+        return max(0.0, self.cooldown - (self.clock() - self._opened_at))
+
+    # ----------------------------------------------------------------- events
+
+    def allow(self) -> bool:
+        """May a spawn attempt proceed right now? (closed or half-open)"""
+        return self.state != OPEN
+
+    def check(self, lane: int | None = None) -> None:
+        """Raise `CircuitOpenError` (retryable, with a retry-after hint)
+        unless a spawn attempt may proceed."""
+        if self.allow():
+            return
+        retry_after = self.retry_after()
+        raise CircuitOpenError(
+            f"lane-{self.name or lane} spawn circuit is open after "
+            f"{self._failures} consecutive failures; retry in "
+            f"{retry_after:.1f}s",
+            lane=lane if lane is not None else 0,
+            retry_after=retry_after,
+        )
+
+    def record_success(self) -> None:
+        if self._opened_at is not None:
+            logger.info(
+                "circuit breaker %s closed (probe succeeded)", self.name
+            )
+        self._failures = 0
+        self._opened_at = None
+
+    def record_failure(self) -> None:
+        was = self.state
+        self._failures += 1
+        if was == HALF_OPEN or self._failures >= self.failure_threshold:
+            # Half-open probe failure re-opens with a FRESH cooldown; a
+            # closed lane crossing the threshold opens for the first time.
+            self._opened_at = self.clock()
+            if was != OPEN:
+                logger.warning(
+                    "circuit breaker %s opened (%d consecutive failures; "
+                    "cooldown %.1fs)",
+                    self.name,
+                    self._failures,
+                    self.cooldown,
+                )
+
+
+class BreakerBoard:
+    """Per-chip-count-lane breakers sharing one parameter set. Lanes are
+    created lazily on first use so the board mirrors the pool's own lane
+    dict; `states()` feeds the scrape-time metrics gauge."""
+
+    def __init__(
+        self,
+        *,
+        failure_threshold: int = 5,
+        cooldown: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.failure_threshold = failure_threshold
+        self.cooldown = cooldown
+        self.clock = clock
+        self._lanes: dict[int, CircuitBreaker] = {}
+
+    def lane(self, chip_count: int) -> CircuitBreaker:
+        breaker = self._lanes.get(chip_count)
+        if breaker is None:
+            breaker = CircuitBreaker(
+                failure_threshold=self.failure_threshold,
+                cooldown=self.cooldown,
+                clock=self.clock,
+                name=str(chip_count),
+            )
+            self._lanes[chip_count] = breaker
+        return breaker
+
+    def is_open(self, chip_count: int) -> bool:
+        breaker = self._lanes.get(chip_count)
+        return breaker.is_open if breaker is not None else False
+
+    def retry_after(self, chip_count: int) -> float:
+        breaker = self._lanes.get(chip_count)
+        return breaker.retry_after() if breaker is not None else 0.0
+
+    def states(self) -> dict[int, str]:
+        return {lane: breaker.state for lane, breaker in self._lanes.items()}

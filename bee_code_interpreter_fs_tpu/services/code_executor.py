@@ -29,39 +29,23 @@ from collections import deque
 from dataclasses import dataclass, field
 
 import httpx
-from tenacity import (
-    retry,
-    retry_if_exception_type,
-    stop_after_attempt,
-    wait_exponential,
-)
 
 from ..config import Config
 from ..utils.logs import PhaseTimer
 from ..utils.metrics import ExecutorMetrics
+from ..utils.retrying import RetryPolicy, retry_async
 from ..utils.validation import OBJECT_ID_RE, normalize_workspace_path
 from .backends.base import Sandbox, SandboxBackend, SandboxSpawnError, num_hosts_for
+from .circuit_breaker import BreakerBoard
+from .errors import (  # noqa: F401 — canonical home is errors.py; re-exported
+    CapacityTimeoutError,
+    CircuitOpenError,
+    ExecutorError,
+    SessionLimitError,
+)
 from .storage import Storage
 
 logger = logging.getLogger(__name__)
-
-
-class ExecutorError(RuntimeError):
-    """Infrastructure-level execution failure (retried, then surfaced)."""
-
-
-class SessionLimitError(RuntimeError):
-    """All executor_id session slots are in use (retryable: HTTP 429 /
-    gRPC RESOURCE_EXHAUSTED — not a defect in the request itself)."""
-
-
-class CapacityTimeoutError(SessionLimitError):
-    """A request waited ``executor_acquire_timeout`` seconds for a sandbox
-    slot without one turning over — e.g. a capacity-constrained TPU lane
-    whose every chip is held by actively-used sessions. Subclasses
-    SessionLimitError so both API layers already map it to a retryable
-    HTTP 429 / gRPC RESOURCE_EXHAUSTED instead of the caller hanging
-    indefinitely (ADVICE r3 #1)."""
 
 
 def _drain(pool: deque) -> list:
@@ -115,11 +99,35 @@ class CodeExecutor:
         storage: Storage,
         config: Config | None = None,
         metrics: ExecutorMetrics | None = None,
+        breakers: BreakerBoard | None = None,
     ) -> None:
         self.backend = backend
         self.storage = storage
         self.config = config or Config()
         self.metrics = metrics or ExecutorMetrics()
+        # Per-lane spawn circuit breakers: fail fast (retryable) while the
+        # backend is persistently failing instead of burning each request's
+        # 300s acquire budget plus a full retry ladder (injectable for
+        # deterministic chaos tests).
+        self.breakers = breakers or BreakerBoard(
+            failure_threshold=self.config.breaker_failure_threshold,
+            cooldown=self.config.breaker_cooldown,
+        )
+        # Spawn retries mirror the reference's ladder (3 attempts, 0.5s
+        # exponential base capped at 5s) with full jitter so parallel refill
+        # failures don't re-synchronize into retry waves.
+        self._spawn_retry_policy = RetryPolicy(
+            attempts=max(1, self.config.executor_spawn_retry_attempts),
+            base_delay=0.5,
+            max_delay=5.0,
+            retry_on=(SandboxSpawnError,),
+        )
+        self._execute_retry_policy = RetryPolicy(
+            attempts=3,
+            base_delay=0.5,
+            max_delay=5.0,
+            retry_on=(ExecutorError,),
+        )
         self._pools: dict[int, deque[Sandbox]] = {}
         self._spawning: dict[int, int] = {}
         # Requests currently holding a sandbox, per lane. With reuse on,
@@ -150,11 +158,35 @@ class CodeExecutor:
         self._client: httpx.AsyncClient | None = None
         self.metrics.bind_pool(self._pools)
         self.metrics.bind_sessions(self._sessions)
+        self.metrics.bind_breakers(self.breakers)
 
     def _http_client(self) -> httpx.AsyncClient:
         if self._client is None or self._client.is_closed:
-            self._client = httpx.AsyncClient(timeout=httpx.Timeout(30.0))
+            # A fault-injecting backend supplies a transport that drops a
+            # seeded fraction of requests on the wire (chaos testing the
+            # mid-execute connection-loss path); real backends supply none.
+            transport_fn = getattr(self.backend, "http_transport", None)
+            transport = transport_fn() if transport_fn is not None else None
+            self._client = httpx.AsyncClient(
+                timeout=httpx.Timeout(30.0), transport=transport
+            )
         return self._client
+
+    # ------------------------------------------------------------ degradation
+
+    def degraded(self) -> bool:
+        """Is the control plane in degraded mode? True while the DEFAULT
+        lane's spawn breaker is hard-open (the lane an Execute without an
+        explicit chip_count lands on — config.default_chip_count, not a
+        literal lane 0): new work there fails fast, so health surfaces must
+        advertise NOT_SERVING/503 and shed load until a half-open probe
+        succeeds."""
+        return self.breakers.is_open(self.config.default_chip_count)
+
+    def degraded_retry_after(self) -> float:
+        """Seconds a shedding response should tell clients to wait
+        (Retry-After); 0 when serving normally."""
+        return self.breakers.retry_after(self.config.default_chip_count)
 
     # ------------------------------------------------------------------ pool
 
@@ -227,6 +259,14 @@ class CodeExecutor:
         deadlock against the in-flight request for the physical TPU slot."""
         if self._closed:
             return
+        if self.breakers.is_open(chip_count):
+            # Refill spawns against an open breaker would only feed its
+            # failure count; the half-open probe (first real request after
+            # cooldown) is what re-tests the backend.
+            logger.debug(
+                "pool refill skipped: lane-%d breaker open", chip_count
+            )
+            return
         pool = self._pool(chip_count)
         target = self._lane_target(chip_count)
         in_use = (
@@ -250,6 +290,11 @@ class CodeExecutor:
                 # degraded pool: log and continue (parity: reference logs and
                 # keeps going, kubernetes_code_executor.py:184-194)
                 logger.exception("pool prefill spawn failed (lane=%d)", chip_count)
+            except CircuitOpenError as e:
+                # The breaker opened while this refill was in flight (e.g.
+                # a sibling spawn crossed the threshold): stop quietly — the
+                # lane refills on the first request after a successful probe.
+                logger.warning("pool prefill stopped (lane=%d): %s", chip_count, e)
             finally:
                 self._spawning[chip_count] -= 1
                 self._notify_lane(chip_count)
@@ -263,24 +308,40 @@ class CodeExecutor:
         self._fill_tasks.add(task)
         task.add_done_callback(self._fill_tasks.discard)
 
-    @retry(
-        retry=retry_if_exception_type(SandboxSpawnError),
-        stop=stop_after_attempt(3),
-        wait=wait_exponential(multiplier=0.5, max=5),
-        reraise=True,
-    )
     async def _spawn_with_retry(self, chip_count: int) -> Sandbox:
-        # Evict on EVERY attempt, not once before the retry loop: a
-        # cross-lane refill that was mid-flight during the first eviction can
-        # park an idle slot-holding sandbox right after it, and only a fresh
-        # eviction at the next attempt can free that slot again.
-        await self._evict_idle_other_lanes(chip_count)
-        start = time.perf_counter()
-        sandbox = await self.backend.spawn(chip_count)
-        self.metrics.spawn_seconds.observe(
-            time.perf_counter() - start, chip_count=str(chip_count)
+        """Spawn with the retry engine + circuit breaker: bounded, jittered
+        retries on SandboxSpawnError; every attempt first consults the
+        lane's breaker, so a breaker opened mid-ladder (by this spawn's own
+        failures or a sibling's) aborts the remaining attempts immediately
+        with a retryable CircuitOpenError instead of hammering a backend
+        that is down."""
+        breaker = self.breakers.lane(chip_count)
+
+        async def attempt() -> Sandbox:
+            breaker.check(chip_count)
+            # Evict on EVERY attempt, not once before the retry loop: a
+            # cross-lane refill that was mid-flight during the first eviction
+            # can park an idle slot-holding sandbox right after it, and only
+            # a fresh eviction at the next attempt can free that slot again.
+            await self._evict_idle_other_lanes(chip_count)
+            start = time.perf_counter()
+            try:
+                sandbox = await self.backend.spawn(chip_count)
+            except SandboxSpawnError:
+                breaker.record_failure()
+                raise
+            breaker.record_success()
+            self.metrics.spawn_seconds.observe(
+                time.perf_counter() - start, chip_count=str(chip_count)
+            )
+            return sandbox
+
+        def on_retry(failures: int, error: BaseException, delay: float) -> None:
+            self.metrics.retry_attempts.inc(operation="spawn")
+
+        return await retry_async(
+            attempt, self._spawn_retry_policy, on_retry=on_retry
         )
-        return sandbox
 
     async def _evict_idle_other_lanes(self, chip_count: int) -> None:
         """On a capacity-constrained backend, idle warm sandboxes pooled in
@@ -336,6 +397,16 @@ class CodeExecutor:
                     break
                 spawning = self._spawning.get(chip_count, 0)
                 in_use = self._in_use.get(chip_count, 0)
+                if (
+                    self.breakers.is_open(chip_count)
+                    and spawning == 0
+                    and in_use == 0
+                ):
+                    # Pool empty, nothing in flight or due back, and the
+                    # lane's backend is known-down: waiting out the acquire
+                    # budget (up to 300s) cannot help — fail fast with the
+                    # retryable circuit error instead.
+                    self.breakers.lane(chip_count).check(chip_count)
                 session_held = self._session_held_constrained()
                 capacity = self._lane_capacity(chip_count)
                 if capacity is not None:
@@ -451,6 +522,10 @@ class CodeExecutor:
                     env=env,
                     chip_count=chip_count,
                 )
+        except CircuitOpenError as e:
+            self.metrics.breaker_rejections.inc(chip_count=str(e.lane))
+            self.metrics.executions.inc(outcome="rejected")
+            raise
         except SessionLimitError:
             # Capacity-cap rejections must be visible on dashboards — a
             # burst of 429s with no counter movement reads as "healthy idle".
@@ -462,12 +537,6 @@ class CodeExecutor:
         self._count_execution(result, session=executor_id is not None)
         return result
 
-    @retry(
-        retry=retry_if_exception_type(ExecutorError),
-        stop=stop_after_attempt(3),
-        wait=wait_exponential(multiplier=0.5, max=5),
-        reraise=True,
-    )
     async def _execute_with_retry(
         self,
         source_code: str | None = None,
@@ -478,13 +547,24 @@ class CodeExecutor:
         env: dict[str, str] | None = None,
         chip_count: int | None = None,
     ) -> Result:
-        return await self._execute_once(
-            source_code,
-            source_file=source_file,
-            files=files,
-            timeout=timeout,
-            env=env,
-            chip_count=chip_count,
+        """Stateless execute with bounded infra retries (ExecutorError only:
+        user-code failures are results, capacity/breaker rejections are not
+        infrastructure flakes — neither is retried)."""
+
+        def on_retry(failures: int, error: BaseException, delay: float) -> None:
+            self.metrics.retry_attempts.inc(operation="execute")
+
+        return await retry_async(
+            lambda: self._execute_once(
+                source_code,
+                source_file=source_file,
+                files=files,
+                timeout=timeout,
+                env=env,
+                chip_count=chip_count,
+            ),
+            self._execute_retry_policy,
+            on_retry=on_retry,
         )
 
     async def _execute_once(
@@ -724,6 +804,10 @@ class CodeExecutor:
                 yield event
             try:
                 result = await task
+            except CircuitOpenError as e:
+                self.metrics.breaker_rejections.inc(chip_count=str(e.lane))
+                self.metrics.executions.inc(outcome="rejected")
+                raise
             except SessionLimitError:
                 self.metrics.executions.inc(outcome="rejected")
                 raise
@@ -785,7 +869,7 @@ class CodeExecutor:
     ) -> Result:
         """Run one request inside the executor_id's session sandbox.
 
-        No tenacity retry wrapper: an infra failure means the session's
+        No retry wrapper: an infra failure means the session's
         sandbox (and its state) is gone — retrying on a replacement would
         silently pretend the state survived. The session is closed and the
         error surfaces; the client decides whether to rebuild.

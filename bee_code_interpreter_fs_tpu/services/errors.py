@@ -1,0 +1,47 @@
+"""Control-plane error hierarchy, shared by the executor, the circuit
+breaker, and both API servers.
+
+Lives in its own module so `services/circuit_breaker.py` can raise a
+retryable `SessionLimitError` subclass without importing the executor (which
+imports the breaker — a cycle otherwise). `services/code_executor.py`
+re-exports everything here, so existing importers keep working.
+"""
+
+from __future__ import annotations
+
+
+class ExecutorError(RuntimeError):
+    """Infrastructure-level execution failure (retried, then surfaced)."""
+
+
+class SessionLimitError(RuntimeError):
+    """All executor_id session slots are in use (retryable: HTTP 429 /
+    gRPC RESOURCE_EXHAUSTED — not a defect in the request itself)."""
+
+
+class CapacityTimeoutError(SessionLimitError):
+    """A request waited ``executor_acquire_timeout`` seconds for a sandbox
+    slot without one turning over — e.g. a capacity-constrained TPU lane
+    whose every chip is held by actively-used sessions. Subclasses
+    SessionLimitError so both API layers already map it to a retryable
+    HTTP 429 / gRPC RESOURCE_EXHAUSTED instead of the caller hanging
+    indefinitely (ADVICE r3 #1)."""
+
+
+class CircuitOpenError(SessionLimitError):
+    """The lane's spawn circuit breaker is open: the backend failed N
+    consecutive spawns and the cooldown has not elapsed, so the request
+    fails fast instead of burning its acquire budget against a backend
+    that is down. Retryable, but mapped DISTINCTLY from its
+    SessionLimitError parent on both API surfaces: HTTP 503 + Retry-After
+    and gRPC UNAVAILABLE (degraded service), versus the parent's 429 /
+    RESOURCE_EXHAUSTED (healthy service, caller hit a capacity cap). The
+    subclass relationship is the safety net — an unanticipated path that
+    only knows SessionLimitError still returns something retryable."""
+
+    def __init__(
+        self, message: str, *, lane: int = 0, retry_after: float = 0.0
+    ) -> None:
+        super().__init__(message)
+        self.lane = lane
+        self.retry_after = retry_after

@@ -11,6 +11,7 @@ services are registered via generic handlers against the vendored protos
 from __future__ import annotations
 
 import logging
+from collections.abc import Callable
 
 import grpc
 from google.protobuf import descriptor_pool
@@ -32,17 +33,31 @@ logger = logging.getLogger(__name__)
 
 
 class HealthServicer:
-    """grpc.health.v1.Health — Check + Watch (single-update stream)."""
+    """grpc.health.v1.Health — Check + Watch (single-update stream).
 
-    def __init__(self) -> None:
+    ``degraded_check`` (graceful degradation) is consulted at Check time: a
+    control plane whose default-lane spawn breaker is open reports
+    NOT_SERVING so load balancers drain it while it cannot take new work —
+    health that reflects reality, not process liveness. It recovers on the
+    breaker's half-open probe success without a restart."""
+
+    def __init__(self, degraded_check: Callable[[], bool] | None = None) -> None:
         self.serving = True
+        self.degraded_check = degraded_check
+
+    def _currently_serving(self) -> bool:
+        if not self.serving:
+            return False
+        if self.degraded_check is not None and self.degraded_check():
+            return False
+        return True
 
     async def Check(self, request, context) -> health_pb2.HealthCheckResponse:
         if request.service not in ("", SERVICE_NAME, HEALTH_SERVICE_NAME):
             await context.abort(grpc.StatusCode.NOT_FOUND, "unknown service")
         status = (
             health_pb2.HealthCheckResponse.SERVING
-            if self.serving
+            if self._currently_serving()
             else health_pb2.HealthCheckResponse.NOT_SERVING
         )
         return health_pb2.HealthCheckResponse(status=status)
@@ -173,7 +188,7 @@ class GrpcServer:
     ) -> None:
         self.config = config
         self.servicer = CodeInterpreterServicer(code_executor, custom_tool_executor)
-        self.health = HealthServicer()
+        self.health = HealthServicer(degraded_check=code_executor.degraded)
         self.reflection = ReflectionServicer(
             [SERVICE_NAME, HEALTH_SERVICE_NAME, REFLECTION_SERVICE_NAME]
         )

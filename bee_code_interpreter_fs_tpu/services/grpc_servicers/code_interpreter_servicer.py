@@ -19,7 +19,12 @@ import grpc
 from ...proto import code_interpreter_pb2 as pb2
 from ...utils.logs import new_request_id
 from ...utils.validation import OBJECT_ID_RE
-from ..code_executor import CodeExecutor, ExecutorError, SessionLimitError
+from ..code_executor import (
+    CircuitOpenError,
+    CodeExecutor,
+    ExecutorError,
+    SessionLimitError,
+)
 from ..custom_tool_executor import (
     CustomToolExecuteError,
     CustomToolExecutor,
@@ -100,6 +105,12 @@ class CodeInterpreterServicer:
             )
         except ValueError as e:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except CircuitOpenError as e:
+            # Degraded mode (spawn circuit open): UNAVAILABLE, mirroring the
+            # HTTP layer's 503 shed — the health service reports NOT_SERVING
+            # over the same window. Distinct from RESOURCE_EXHAUSTED below,
+            # which means the service is healthy but capacity-capped.
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         except SessionLimitError as e:
             # Retryable resource exhaustion, not a defect in the request.
             await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
@@ -143,6 +154,8 @@ class CodeInterpreterServicer:
                     )
         except ValueError as e:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except CircuitOpenError as e:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         except SessionLimitError as e:
             await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except (ExecutorError, SandboxSpawnError) as e:
@@ -215,6 +228,8 @@ class CodeInterpreterServicer:
             )
         except ValueError as e:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except CircuitOpenError as e:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         except SessionLimitError as e:
             await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except (ExecutorError, SandboxSpawnError) as e:

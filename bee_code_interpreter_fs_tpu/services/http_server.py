@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 
 from aiohttp import web
@@ -25,7 +26,12 @@ from pydantic import BaseModel, Field, ValidationError
 from ..utils.logs import new_request_id
 from ..utils.validation import OBJECT_ID_RE
 from .backends.base import SandboxSpawnError
-from .code_executor import CodeExecutor, ExecutorError, SessionLimitError
+from .code_executor import (
+    CircuitOpenError,
+    CodeExecutor,
+    ExecutorError,
+    SessionLimitError,
+)
 from .custom_tool_executor import (
     CustomToolExecuteError,
     CustomToolExecutor,
@@ -79,6 +85,17 @@ def create_http_app(
     def bad_request(message, **extra) -> web.Response:
         return web.json_response({"error": message, **extra}, status=400)
 
+    def shed(e: CircuitOpenError) -> web.Response:
+        """Load-shedding response while a lane's breaker is open: 503 +
+        Retry-After (degraded SERVICE — distinct from 429, which means the
+        service is healthy but THIS caller hit a capacity cap)."""
+        retry_after = max(1, math.ceil(e.retry_after or 1.0))
+        return web.json_response(
+            {"error": str(e), "degraded": True},
+            status=503,
+            headers={"Retry-After": str(retry_after)},
+        )
+
     async def parse_model(request: web.Request, model):
         try:
             return model.model_validate(await request.json())
@@ -95,6 +112,16 @@ def create_http_app(
 
     @routes.get("/healthz")
     async def healthz(request: web.Request) -> web.Response:
+        if code_executor.degraded():
+            retry_after = max(1, math.ceil(code_executor.degraded_retry_after() or 1.0))
+            return web.json_response(
+                {
+                    "status": "degraded",
+                    "reason": "default-lane spawn circuit open",
+                },
+                status=503,
+                headers={"Retry-After": str(retry_after)},
+            )
         return web.json_response({"status": "ok"})
 
     @routes.get("/metrics")
@@ -155,6 +182,8 @@ def create_http_app(
             )
         except ValueError as e:
             return bad_request(str(e))
+        except CircuitOpenError as e:
+            return shed(e)
         except SessionLimitError as e:
             # Resource exhaustion, not a request defect: retryable.
             return web.json_response({"error": str(e)}, status=429)
@@ -204,6 +233,12 @@ def create_http_app(
         except ValueError as e:
             if not started:
                 return bad_request(str(e))
+            await response.write(
+                (json.dumps({"error": str(e)}) + "\n").encode("utf-8")
+            )
+        except CircuitOpenError as e:
+            if not started:
+                return shed(e)
             await response.write(
                 (json.dumps({"error": str(e)}) + "\n").encode("utf-8")
             )
@@ -281,6 +316,8 @@ def create_http_app(
             )
         except ValueError as e:
             return bad_request(str(e))
+        except CircuitOpenError as e:
+            return shed(e)
         except SessionLimitError as e:
             return web.json_response({"error": str(e)}, status=429)
         except (ExecutorError, SandboxSpawnError) as e:

@@ -238,8 +238,26 @@ class ExecutorMetrics:
             "Sandbox spawn-to-ready latency by chip-count lane.",
             ("chip_count",),
         )
+        self.retry_attempts = self.registry.counter(
+            "code_interpreter_retry_attempts_total",
+            "Retries performed by the in-repo retry engine, by operation "
+            "(spawn/execute). Counts retries, not first attempts.",
+            ("operation",),
+        )
+        self.injected_faults = self.registry.counter(
+            "code_interpreter_injected_faults_total",
+            "Faults injected by the chaos backend, by fault type. Nonzero "
+            "outside a chaos run is a deployment error.",
+            ("fault",),
+        )
+        self.breaker_rejections = self.registry.counter(
+            "code_interpreter_breaker_rejections_total",
+            "Requests failed fast because a lane's spawn circuit was open.",
+            ("chip_count",),
+        )
         self.pool_depth: Gauge | None = None
         self.active_sessions: Gauge | None = None
+        self.breaker_state: Gauge | None = None
 
     def bind_pool(self, pools) -> None:
         """Expose warm-pool depth per chip-count lane, read at scrape time."""
@@ -266,5 +284,24 @@ class ExecutorMetrics:
             "code_interpreter_active_sessions",
             "Live executor_id sessions (sandboxes parked out of the pool).",
             (),
+            callback=sample,
+        )
+
+    def bind_breakers(self, board) -> None:
+        """Expose per-lane breaker state at scrape time
+        (0=closed, 1=half-open, 2=open)."""
+        from ..services.circuit_breaker import STATE_CODES
+
+        def sample() -> dict[tuple[str, ...], float]:
+            return {
+                (str(lane),): STATE_CODES[state]
+                for lane, state in board.states().items()
+            }
+
+        self.breaker_state = self.registry.gauge(
+            "code_interpreter_breaker_state",
+            "Spawn circuit-breaker state per chip-count lane "
+            "(0=closed, 1=half-open, 2=open).",
+            ("chip_count",),
             callback=sample,
         )

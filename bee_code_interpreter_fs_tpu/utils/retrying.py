@@ -1,0 +1,124 @@
+"""Dependency-free async retry engine for the control plane.
+
+Replaces the `tenacity` decorators the orchestrator previously declared (the
+dependency was never installed in this environment, so every import of
+`services/code_executor.py` died at collection). Scope is deliberately small —
+exactly what the pool and execute paths need:
+
+- exponential backoff with a cap (tenacity's ``wait_exponential``), with
+  **full jitter** (AWS architecture-blog style: sleep ~ U(0, backoff)) so a
+  burst of failures doesn't re-synchronize into retry waves against a
+  struggling backend;
+- attempt-count stop AND a wall-clock **deadline** stop: a retry whose
+  backoff would land past the deadline is not slept on at all — the last
+  error surfaces immediately instead of burning the caller's budget;
+- exception-type **predicates** (`retry_on` / `retry_if`) so user-code errors
+  and fail-fast signals (e.g. an open circuit breaker) are never retried;
+- an `on_retry` hook for metrics/breaker integration. The hook may raise to
+  abort the retry loop (the new exception propagates).
+
+Determinism for tests: `rng`, `sleep`, and `clock` are injectable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from collections.abc import Awaitable, Callable
+from dataclasses import dataclass
+from typing import TypeVar
+
+T = TypeVar("T")
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """When and how long to retry.
+
+    ``attempts`` counts calls, not retries: attempts=3 means 1 call + up to
+    2 retries (tenacity's ``stop_after_attempt(3)``). ``deadline`` bounds the
+    whole loop in wall-clock seconds measured from the first call.
+    """
+
+    attempts: int = 3
+    base_delay: float = 0.5
+    max_delay: float = 5.0
+    multiplier: float = 2.0
+    jitter: bool = True
+    deadline: float | None = None
+    retry_on: tuple[type[BaseException], ...] = (Exception,)
+    retry_if: Callable[[BaseException], bool] | None = None
+
+    def should_retry(self, error: BaseException) -> bool:
+        if not isinstance(error, self.retry_on):
+            return False
+        if self.retry_if is not None and not self.retry_if(error):
+            return False
+        return True
+
+    def backoff(self, failure_count: int, rng=None) -> float:
+        """Sleep before the retry following the ``failure_count``-th failure
+        (1-based): base * multiplier^(n-1), capped, then full-jittered."""
+        raw = min(
+            self.max_delay,
+            self.base_delay * self.multiplier ** max(0, failure_count - 1),
+        )
+        if not self.jitter:
+            return raw
+        return (rng or random).uniform(0.0, raw)
+
+
+async def retry_async(
+    fn: Callable[[], Awaitable[T]],
+    policy: RetryPolicy | None = None,
+    *,
+    on_retry: Callable[[int, BaseException, float], None] | None = None,
+    rng=None,
+    sleep: Callable[[float], Awaitable[None]] = asyncio.sleep,
+    clock: Callable[[], float] = time.monotonic,
+) -> T:
+    """Call ``fn`` until it succeeds, the policy stops, or the deadline would
+    be overrun. The LAST error is re-raised (no wrapper exception — callers
+    keep matching on their own domain types)."""
+    policy = policy or RetryPolicy()
+    start = clock()
+    failures = 0
+    while True:
+        try:
+            return await fn()
+        except BaseException as error:  # noqa: BLE001 — predicate decides
+            failures += 1
+            if failures >= policy.attempts or not policy.should_retry(error):
+                raise
+            delay = policy.backoff(failures, rng)
+            if (
+                policy.deadline is not None
+                and clock() - start + delay > policy.deadline
+            ):
+                raise
+            if on_retry is not None:
+                on_retry(failures, error, delay)
+            await sleep(delay)
+
+
+def retryable(
+    policy: RetryPolicy,
+    *,
+    on_retry: Callable[[int, BaseException, float], None] | None = None,
+):
+    """Decorator form of :func:`retry_async` for free functions/methods whose
+    call sites don't need per-call hooks."""
+
+    def decorate(fn):
+        async def wrapped(*args, **kwargs):
+            return await retry_async(
+                lambda: fn(*args, **kwargs), policy, on_retry=on_retry
+            )
+
+        wrapped.__name__ = getattr(fn, "__name__", "retryable")
+        wrapped.__doc__ = fn.__doc__
+        wrapped.__wrapped__ = fn
+        return wrapped
+
+    return decorate

@@ -1,0 +1,128 @@
+"""Per-lane spawn circuit breaker unit tests (services/circuit_breaker.py):
+deterministic closed→open→half-open→closed transitions on an injected clock,
+fail-fast semantics, and lane isolation on the board."""
+
+import pytest
+
+from bee_code_interpreter_fs_tpu.services.circuit_breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakerBoard,
+    CircuitBreaker,
+)
+from bee_code_interpreter_fs_tpu.services.errors import (
+    CircuitOpenError,
+    SessionLimitError,
+)
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.now = 100.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+def make(threshold=3, cooldown=30.0):
+    clock = FakeClock()
+    breaker = CircuitBreaker(
+        failure_threshold=threshold, cooldown=cooldown, clock=clock, name="0"
+    )
+    return breaker, clock
+
+
+def test_starts_closed_and_allows():
+    breaker, _ = make()
+    assert breaker.state == CLOSED
+    assert breaker.allow()
+    assert breaker.retry_after() == 0.0
+    breaker.check(0)  # must not raise
+
+
+def test_opens_after_threshold_consecutive_failures():
+    breaker, _ = make(threshold=3, cooldown=30.0)
+    breaker.record_failure()
+    breaker.record_failure()
+    assert breaker.state == CLOSED, "below threshold stays closed"
+    breaker.record_failure()
+    assert breaker.state == OPEN
+    assert breaker.is_open
+    assert not breaker.allow()
+    assert breaker.retry_after() == pytest.approx(30.0)
+    with pytest.raises(CircuitOpenError) as exc_info:
+        breaker.check(4)
+    assert exc_info.value.lane == 4
+    assert exc_info.value.retry_after == pytest.approx(30.0)
+    # Retryable by contract: both API layers already map this family.
+    assert isinstance(exc_info.value, SessionLimitError)
+
+
+def test_success_resets_the_consecutive_count():
+    breaker, _ = make(threshold=3)
+    breaker.record_failure()
+    breaker.record_failure()
+    breaker.record_success()
+    breaker.record_failure()
+    breaker.record_failure()
+    assert breaker.state == CLOSED, "non-consecutive failures must not open"
+
+
+def test_cooldown_elapse_transitions_to_half_open():
+    breaker, clock = make(threshold=1, cooldown=30.0)
+    breaker.record_failure()
+    assert breaker.state == OPEN
+    clock.advance(29.9)
+    assert breaker.state == OPEN
+    assert breaker.retry_after() == pytest.approx(0.1)
+    clock.advance(0.2)
+    assert breaker.state == HALF_OPEN
+    assert not breaker.is_open, "half-open lanes accept probe traffic"
+    assert breaker.allow()
+    breaker.check(0)  # probes flow
+
+
+def test_half_open_probe_success_closes():
+    breaker, clock = make(threshold=1, cooldown=30.0)
+    breaker.record_failure()
+    clock.advance(31.0)
+    assert breaker.state == HALF_OPEN
+    breaker.record_success()
+    assert breaker.state == CLOSED
+    # ...and the failure count restarted from zero.
+    assert breaker.retry_after() == 0.0
+
+
+def test_half_open_probe_failure_reopens_with_fresh_cooldown():
+    breaker, clock = make(threshold=3, cooldown=30.0)
+    for _ in range(3):
+        breaker.record_failure()
+    clock.advance(31.0)
+    assert breaker.state == HALF_OPEN
+    # ONE failure re-opens (no need for a fresh threshold's worth).
+    breaker.record_failure()
+    assert breaker.state == OPEN
+    assert breaker.retry_after() == pytest.approx(30.0)
+
+
+def test_board_lanes_are_isolated():
+    clock = FakeClock()
+    board = BreakerBoard(failure_threshold=1, cooldown=30.0, clock=clock)
+    board.lane(4).record_failure()
+    assert board.is_open(4)
+    assert not board.is_open(0), "a dead 4-chip nodepool must not fail lane 0"
+    assert board.retry_after(4) == pytest.approx(30.0)
+    assert board.retry_after(0) == 0.0
+    assert board.states() == {4: OPEN}
+    # Unknown lanes are implicitly closed (no breaker materialized).
+    assert not board.is_open(8)
+
+
+def test_board_reuses_one_breaker_per_lane():
+    board = BreakerBoard(failure_threshold=2, cooldown=5.0)
+    assert board.lane(0) is board.lane(0)
+    assert board.lane(0) is not board.lane(4)

@@ -1,0 +1,265 @@
+"""Retryable-error mapping across both API surfaces (ISSUE 1 satellite).
+
+Pins the contract operators and clients depend on:
+- `CapacityTimeoutError` (capacity pressure, service healthy) → HTTP 429 /
+  gRPC RESOURCE_EXHAUSTED on all three executing servicer methods;
+- `CircuitOpenError` (degraded service, backend down) → HTTP 503 +
+  ``Retry-After`` / gRPC UNAVAILABLE — deliberately DISTINCT from the 429
+  path so dashboards and clients can tell "you sent too much" from
+  "the service is sick";
+- `/healthz` flips 200→503 with the lane-0 breaker and back.
+"""
+
+import asyncio
+import json
+
+import grpc
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+from fakes import FakeBackend
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.proto import code_interpreter_pb2 as pb2
+from bee_code_interpreter_fs_tpu.services.circuit_breaker import BreakerBoard
+from bee_code_interpreter_fs_tpu.services.code_executor import (
+    CapacityTimeoutError,
+    CircuitOpenError,
+    CodeExecutor,
+)
+from bee_code_interpreter_fs_tpu.services.custom_tool_executor import (
+    CustomToolExecutor,
+)
+from bee_code_interpreter_fs_tpu.services.grpc_servicers.code_interpreter_servicer import (
+    CodeInterpreterServicer,
+)
+from bee_code_interpreter_fs_tpu.services.http_server import create_http_app
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+CAPACITY_ERROR = CapacityTimeoutError(
+    "no lane-0 sandbox slot freed within 300s; retry later"
+)
+CIRCUIT_ERROR = CircuitOpenError(
+    "lane-0 spawn circuit is open", lane=0, retry_after=17.2
+)
+
+TOOL_SOURCE = "def add(a: int, b: int) -> int:\n    return a + b\n"
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+def make_stack(tmp_path, error=None, clock=None):
+    """CodeExecutor + CustomToolExecutor with every executing entrypoint
+    stubbed to raise `error` (None = leave real paths in place)."""
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        executor_pod_queue_target_length=1,
+    )
+    breakers = BreakerBoard(
+        failure_threshold=1, cooldown=30.0, clock=clock or FakeClock()
+    )
+    executor = CodeExecutor(
+        FakeBackend(), Storage(config.file_storage_path), config,
+        breakers=breakers,
+    )
+    tools = CustomToolExecutor(executor)
+    if error is not None:
+        async def raise_error(*args, **kwargs):
+            raise error
+
+        async def raise_error_stream(*args, **kwargs):
+            raise error
+            yield  # pragma: no cover — makes this an async generator
+
+        executor.execute = raise_error
+        executor.execute_stream = raise_error_stream
+        tools.execute_with_result = raise_error
+    return executor, tools
+
+
+# ----------------------------------------------------------------- gRPC side
+
+
+class AbortRaised(Exception):
+    def __init__(self, code: grpc.StatusCode, details: str) -> None:
+        super().__init__(details)
+        self.code = code
+        self.details = details
+
+
+class FakeContext:
+    """Minimal grpc.aio context: abort raises (as the real one does)."""
+
+    async def abort(self, code: grpc.StatusCode, details: str = "") -> None:
+        raise AbortRaised(code, details)
+
+
+async def grpc_status_for(servicer: CodeInterpreterServicer, method: str):
+    context = FakeContext()
+    if method == "Execute":
+        call = servicer.Execute(pb2.ExecuteRequest(source_code="x"), context)
+    elif method == "ExecuteStream":
+        async def drain():
+            async for _ in servicer.ExecuteStream(
+                pb2.ExecuteRequest(source_code="x"), context
+            ):
+                pass
+
+        call = drain()
+    elif method == "ExecuteCustomTool":
+        call = servicer.ExecuteCustomTool(
+            pb2.ExecuteCustomToolRequest(
+                tool_source_code=TOOL_SOURCE, tool_input_json="{}"
+            ),
+            context,
+        )
+    else:  # pragma: no cover — test bug
+        raise AssertionError(method)
+    with pytest.raises(AbortRaised) as exc_info:
+        await call
+    return exc_info.value
+
+
+@pytest.mark.parametrize(
+    "method", ["Execute", "ExecuteStream", "ExecuteCustomTool"]
+)
+async def test_capacity_timeout_maps_to_resource_exhausted(tmp_path, method):
+    executor, tools = make_stack(tmp_path, CAPACITY_ERROR)
+    try:
+        servicer = CodeInterpreterServicer(executor, tools)
+        abort = await grpc_status_for(servicer, method)
+        assert abort.code == grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert "retry later" in abort.details
+    finally:
+        await executor.close()
+
+
+@pytest.mark.parametrize(
+    "method", ["Execute", "ExecuteStream", "ExecuteCustomTool"]
+)
+async def test_circuit_open_maps_to_unavailable(tmp_path, method):
+    executor, tools = make_stack(tmp_path, CIRCUIT_ERROR)
+    try:
+        servicer = CodeInterpreterServicer(executor, tools)
+        abort = await grpc_status_for(servicer, method)
+        assert abort.code == grpc.StatusCode.UNAVAILABLE
+        assert "circuit is open" in abort.details
+    finally:
+        await executor.close()
+
+
+# ----------------------------------------------------------------- HTTP side
+
+
+async def http_client_for(executor, tools):
+    app = create_http_app(executor, tools, executor.storage)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+EXECUTE_BODY = {"source_code": "print('hi')"}
+TOOL_BODY = {"tool_source_code": TOOL_SOURCE, "tool_input_json": "{}"}
+
+
+@pytest.mark.parametrize(
+    "path,body",
+    [
+        ("/v1/execute", EXECUTE_BODY),
+        ("/v1/execute/stream", EXECUTE_BODY),
+        ("/v1/execute-custom-tool", TOOL_BODY),
+    ],
+)
+async def test_capacity_timeout_maps_to_http_429(tmp_path, path, body):
+    executor, tools = make_stack(tmp_path, CAPACITY_ERROR)
+    client = await http_client_for(executor, tools)
+    try:
+        resp = await client.post(path, json=body)
+        assert resp.status == 429
+        assert "retry later" in (await resp.json())["error"]
+    finally:
+        await client.close()
+        await executor.close()
+
+
+@pytest.mark.parametrize(
+    "path,body",
+    [
+        ("/v1/execute", EXECUTE_BODY),
+        ("/v1/execute/stream", EXECUTE_BODY),
+        ("/v1/execute-custom-tool", TOOL_BODY),
+    ],
+)
+async def test_circuit_open_sheds_with_http_503(tmp_path, path, body):
+    executor, tools = make_stack(tmp_path, CIRCUIT_ERROR)
+    client = await http_client_for(executor, tools)
+    try:
+        resp = await client.post(path, json=body)
+        assert resp.status == 503
+        # Retry-After carries the breaker's cooldown remainder, rounded up.
+        assert resp.headers["Retry-After"] == "18"
+        payload = await resp.json()
+        assert payload["degraded"] is True
+        assert "circuit is open" in payload["error"]
+    finally:
+        await client.close()
+        await executor.close()
+
+
+async def test_healthz_flips_with_breaker(tmp_path):
+    clock = FakeClock()
+    executor, tools = make_stack(tmp_path, clock=clock)
+    client = await http_client_for(executor, tools)
+    try:
+        resp = await client.get("/healthz")
+        assert resp.status == 200
+        assert (await resp.json())["status"] == "ok"
+
+        executor.breakers.lane(0).record_failure()  # threshold=1 → open
+        resp = await client.get("/healthz")
+        assert resp.status == 503
+        assert resp.headers["Retry-After"] == "30"
+        assert (await resp.json())["status"] == "degraded"
+
+        # Cooldown elapsed (half-open): probes must be able to reach the
+        # service, so health reports OK again.
+        clock.advance(30.1)
+        resp = await client.get("/healthz")
+        assert resp.status == 200
+    finally:
+        await client.close()
+        await executor.close()
+
+
+async def test_mid_stream_circuit_error_emits_error_line(tmp_path):
+    """A breaker rejection AFTER streaming started cannot become a 503
+    (headers are gone): the stream must end with an {"error": ...} line."""
+    executor, tools = make_stack(tmp_path)
+
+    async def half_stream(*args, **kwargs):
+        yield {"stream": "stdout", "data": "partial"}
+        raise CIRCUIT_ERROR
+
+    executor.execute_stream = half_stream
+    client = await http_client_for(executor, tools)
+    try:
+        resp = await client.post("/v1/execute/stream", json=EXECUTE_BODY)
+        assert resp.status == 200  # headers were already committed
+        lines = [
+            json.loads(line)
+            for line in (await resp.text()).splitlines()
+            if line.strip()
+        ]
+        assert lines[0] == {"stream": "stdout", "data": "partial"}
+        assert "circuit is open" in lines[-1]["error"]
+    finally:
+        await client.close()
+        await executor.close()

@@ -65,7 +65,7 @@ async def test_stream_event_order_then_result(tmp_path):
 
 async def test_stream_infra_error_not_retried(tmp_path):
     """Streamed output cannot be un-streamed: infra failures surface
-    immediately instead of the stateless path's tenacity retry."""
+    immediately instead of the stateless path's bounded infra retry."""
     executor = make_executor(tmp_path)
     calls = 0
 

@@ -1,0 +1,189 @@
+"""Fault-injection backend unit tests (services/backends/faults.py):
+spec-grammar parsing, seeded determinism, per-category fault behavior, and
+the injectable httpx transport that drops requests on the wire."""
+
+import httpx
+import pytest
+from fakes import FakeBackend
+
+from bee_code_interpreter_fs_tpu.services.backends.base import (
+    Sandbox,
+    SandboxSpawnError,
+)
+from bee_code_interpreter_fs_tpu.services.backends.faults import (
+    DroppingTransport,
+    FaultInjectingBackend,
+    FaultSpec,
+)
+
+
+# ------------------------------------------------------------------- parsing
+
+
+def test_parse_full_grammar():
+    spec = FaultSpec.parse(
+        "spawn_fail:0.3, slow_ready:1.5,reset_fail:0.2,"
+        "delete_hang:0.5 , exec_drop:0.1, seed:7"
+    )
+    assert spec == FaultSpec(
+        spawn_fail=0.3,
+        slow_ready=1.5,
+        reset_fail=0.2,
+        delete_hang=0.5,
+        exec_drop=0.1,
+        seed=7,
+    )
+    assert spec.active
+
+
+def test_parse_empty_is_null_plan():
+    spec = FaultSpec.parse("")
+    assert spec == FaultSpec()
+    assert not spec.active
+
+
+def test_parse_rejects_unknown_keys_and_bad_values():
+    with pytest.raises(ValueError, match="bad fault spec item"):
+        FaultSpec.parse("spawn_fial:0.3")  # typo must fail loudly
+    with pytest.raises(ValueError, match="bad fault spec value"):
+        FaultSpec.parse("spawn_fail:lots")
+    with pytest.raises(ValueError, match="must be in"):
+        FaultSpec.parse("spawn_fail:1.5")
+    with pytest.raises(ValueError, match=">= 0"):
+        FaultSpec.parse("slow_ready:-1")
+    with pytest.raises(ValueError, match="bad fault spec item"):
+        FaultSpec.parse("spawn_fail=0.3")  # wrong separator
+
+
+# -------------------------------------------------------------- determinism
+
+
+async def spawn_outcomes(seed: int, n: int = 24) -> list[bool]:
+    backend = FaultInjectingBackend(
+        FakeBackend(), FaultSpec(spawn_fail=0.5, seed=seed)
+    )
+    outcomes = []
+    for _ in range(n):
+        try:
+            await backend.spawn()
+            outcomes.append(True)
+        except SandboxSpawnError:
+            outcomes.append(False)
+    return outcomes
+
+
+async def test_same_seed_reproduces_the_same_fault_plan():
+    assert await spawn_outcomes(7) == await spawn_outcomes(7)
+
+
+async def test_fault_categories_draw_from_independent_streams():
+    """Interleaving reset rolls must not perturb the spawn sequence — per-
+    category RNG streams are what make a concurrent chaos run replayable."""
+    spec = FaultSpec(spawn_fail=0.5, reset_fail=0.5, seed=7)
+    plain = FaultInjectingBackend(FakeBackend(), spec)
+    interleaved = FaultInjectingBackend(FakeBackend(), spec)
+
+    async def outcome(backend):
+        try:
+            await backend.spawn()
+            return True
+        except SandboxSpawnError:
+            return False
+
+    first = [await outcome(plain) for _ in range(12)]
+    second = []
+    for _ in range(12):
+        second.append(await outcome(interleaved))
+        await interleaved.reset(Sandbox(id="x", url="http://fake"))
+    assert first == second
+
+
+# ------------------------------------------------------------ fault behavior
+
+
+async def test_spawn_fail_raises_and_counts():
+    faults: list[str] = []
+    backend = FaultInjectingBackend(
+        FakeBackend(),
+        FaultSpec(spawn_fail=1.0, seed=1),
+        on_fault=faults.append,
+    )
+    with pytest.raises(SandboxSpawnError, match="injected spawn failure"):
+        await backend.spawn(chip_count=4)
+    assert faults == ["spawn_fail"]
+    assert backend.inner.spawns == 0, "the real backend was never reached"
+
+
+async def test_reset_fail_refuses_recycle():
+    inner = FakeBackend()
+    backend = FaultInjectingBackend(
+        inner, FaultSpec(reset_fail=1.0, seed=1)
+    )
+    sandbox = await backend.spawn()
+    assert await backend.reset(sandbox) is None
+    assert inner.resets == 0
+
+
+async def test_delete_hang_still_deletes():
+    inner = FakeBackend()
+    backend = FaultInjectingBackend(
+        inner, FaultSpec(delete_hang=0.01, seed=1)
+    )
+    sandbox = await backend.spawn()
+    await backend.delete(sandbox)
+    assert inner.deletes == 1
+    assert not inner.live
+
+
+async def test_slow_ready_spawn_still_succeeds():
+    inner = FakeBackend()
+    backend = FaultInjectingBackend(
+        inner, FaultSpec(slow_ready=0.01, seed=1)
+    )
+    sandbox = await backend.spawn()
+    assert sandbox.id in inner.live
+
+
+async def test_capacity_passthrough():
+    backend = FaultInjectingBackend(
+        FakeBackend(capacity=2), FaultSpec(seed=1)
+    )
+    assert backend.pool_capacity(0) == 2
+
+
+# ---------------------------------------------------------------- transport
+
+
+async def test_http_transport_absent_without_exec_drop():
+    backend = FaultInjectingBackend(FakeBackend(), FaultSpec(spawn_fail=0.5))
+    assert backend.http_transport() is None
+
+
+async def test_dropping_transport_raises_connect_error():
+    faults: list[str] = []
+    backend = FaultInjectingBackend(
+        FakeBackend(),
+        FaultSpec(exec_drop=1.0, seed=3),
+        on_fault=faults.append,
+    )
+    transport = backend.http_transport()
+    assert isinstance(transport, DroppingTransport)
+    async with httpx.AsyncClient(transport=transport) as client:
+        with pytest.raises(httpx.ConnectError, match="injected connection drop"):
+            await client.get("http://sandbox.invalid/execute")
+    assert faults == ["exec_drop"]
+
+
+async def test_dropping_transport_passes_through_below_rate():
+    inner = httpx.MockTransport(lambda request: httpx.Response(200, json={"ok": True}))
+    backend = FaultInjectingBackend(
+        FakeBackend(), FaultSpec(exec_drop=0.0, seed=3)
+    )
+    assert backend.http_transport() is None
+    # rate 0 via a directly-built transport: every request reaches the inner.
+    import random
+
+    transport = DroppingTransport(0.0, random.Random(0), inner=inner)
+    async with httpx.AsyncClient(transport=transport) as client:
+        resp = await client.get("http://sandbox.invalid/healthz")
+    assert resp.status_code == 200

@@ -1,0 +1,156 @@
+"""Regression tests for sweep_pool_health (ISSUE 1 satellite).
+
+Pins two behaviors that existed but had no test coverage:
+- the disposal race window: a sandbox popped by a request BETWEEN the
+  failed probe and ``pool.remove`` must be left alone (the request owns it
+  now — disposing it under a live request would kill the execution);
+- multi-host probes run concurrently per sandbox (serialized 3s timeouts
+  across a hung slice's hosts would make one sweep take minutes).
+"""
+
+import asyncio
+
+import pytest
+from fakes import FakeBackend
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.backends.base import Sandbox
+from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+
+class FakeProbeClient:
+    """Stands in for the executor's httpx client inside sweep_pool_health.
+
+    Each GET consults ``responses`` (url -> status code, default 200).
+    ``gate`` (when set) makes every probe wait until the test releases it —
+    the window the disposal-race test widens. Concurrency is tracked so the
+    multi-host test can assert parallel fan-out."""
+
+    def __init__(self) -> None:
+        self.responses: dict[str, int] = {}
+        self.gate: asyncio.Event | None = None
+        self.probing = asyncio.Event()
+        self.active = 0
+        self.max_active = 0
+
+    async def get(self, url: str, timeout=None):
+        self.active += 1
+        self.max_active = max(self.max_active, self.active)
+        self.probing.set()
+        try:
+            if self.gate is not None:
+                await self.gate.wait()
+            else:
+                await asyncio.sleep(0.01)
+            base = url.rsplit("/healthz", 1)[0]
+            status = self.responses.get(base, 200)
+
+            class Response:
+                status_code = status
+
+            return Response()
+        finally:
+            self.active -= 1
+
+
+def make_executor(tmp_path, backend=None):
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        executor_pod_queue_target_length=1,
+    )
+    backend = backend or FakeBackend()
+    executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
+    client = FakeProbeClient()
+    executor._http_client = lambda: client
+    return executor, backend, client
+
+
+async def test_unresponsive_pooled_sandbox_is_disposed(tmp_path):
+    executor, backend, client = make_executor(tmp_path)
+    try:
+        dead = Sandbox(id="dead", url="http://dead")
+        live = Sandbox(id="live", url="http://live")
+        backend.live.update({"dead", "live"})
+        executor._pool(0).extend([dead, live])
+        client.responses["http://dead"] = 500
+
+        removed = await executor.sweep_pool_health()
+        assert removed == 1
+        assert [s.id for s in executor._pool(0)] == ["live"]
+        # Dispose runs via a tracked background task; let it land.
+        await asyncio.gather(*executor._dispose_tasks, return_exceptions=True)
+        assert "dead" not in backend.live
+    finally:
+        await executor.close()
+
+
+async def test_sandbox_popped_mid_probe_is_not_disposed(tmp_path):
+    """The race window: the probe fails, but a request pops the sandbox
+    before the sweep's ``pool.remove`` runs. The sweep must skip it — that
+    sandbox now belongs to the request, and its "failure" may simply be
+    the probe losing to the pop."""
+    executor, backend, client = make_executor(tmp_path)
+    try:
+        sandbox = Sandbox(id="contested", url="http://contested")
+        backend.live.add("contested")
+        executor._pool(0).append(sandbox)
+        client.responses["http://contested"] = 500
+        client.gate = asyncio.Event()  # hold every probe open
+
+        sweep = asyncio.create_task(executor.sweep_pool_health())
+        await client.probing.wait()  # the probe is in flight...
+        popped = executor._pool(0).popleft()  # ...and a request wins the pop
+        client.gate.set()
+
+        removed = await sweep
+        assert removed == 0, "a popped sandbox must not count as swept"
+        assert not executor._dispose_tasks
+        assert backend.deletes == 0, "the request's sandbox must survive"
+        assert popped.id == "contested"
+    finally:
+        await executor.close()
+
+
+async def test_multi_host_probes_fan_out_concurrently(tmp_path):
+    executor, backend, client = make_executor(tmp_path)
+    try:
+        slice_sandbox = Sandbox(
+            id="slice",
+            url="http://host0",
+            chip_count=8,
+            host_urls=["http://host0", "http://host1", "http://host2"],
+        )
+        backend.live.add("slice")
+        executor._pool(8).append(slice_sandbox)
+
+        removed = await executor.sweep_pool_health()
+        assert removed == 0
+        assert client.max_active == 3, "per-sandbox host probes must overlap"
+        assert [s.id for s in executor._pool(8)] == ["slice"]
+    finally:
+        await executor.close()
+
+
+async def test_one_dead_host_fails_the_whole_slice(tmp_path):
+    """A multi-host sandbox is one scheduling unit: any dead host means the
+    jax.distributed mesh is gone, so the whole slice is disposed."""
+    executor, backend, client = make_executor(tmp_path)
+    try:
+        slice_sandbox = Sandbox(
+            id="slice",
+            url="http://host0",
+            chip_count=8,
+            host_urls=["http://host0", "http://host1"],
+        )
+        backend.live.add("slice")
+        executor._pool(8).append(slice_sandbox)
+        client.responses["http://host1"] = 500
+
+        removed = await executor.sweep_pool_health()
+        assert removed == 1
+        assert not executor._pool(8)
+        await asyncio.gather(*executor._dispose_tasks, return_exceptions=True)
+        assert "slice" not in backend.live
+    finally:
+        await executor.close()

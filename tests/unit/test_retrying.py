@@ -1,0 +1,193 @@
+"""Unit tests for the in-repo async retry engine (utils/retrying.py) that
+replaced tenacity: backoff schedule, full jitter determinism, deadline-aware
+stop, exception predicates, and the on_retry hook contract."""
+
+import random
+
+import pytest
+
+from bee_code_interpreter_fs_tpu.utils.retrying import (
+    RetryPolicy,
+    retry_async,
+    retryable,
+)
+
+
+class Clock:
+    """Deterministic monotonic clock driven by the recorded sleeps."""
+
+    def __init__(self) -> None:
+        self.now = 0.0
+        self.sleeps: list[float] = []
+
+    def __call__(self) -> float:
+        return self.now
+
+    async def sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self.now += seconds
+
+
+class Flaky:
+    def __init__(self, failures: int, error: Exception) -> None:
+        self.remaining = failures
+        self.error = error
+        self.calls = 0
+
+    async def __call__(self) -> str:
+        self.calls += 1
+        if self.remaining > 0:
+            self.remaining -= 1
+            raise self.error
+        return "ok"
+
+
+async def test_success_first_try_never_sleeps():
+    clock = Clock()
+    fn = Flaky(0, RuntimeError("nope"))
+    result = await retry_async(fn, RetryPolicy(), sleep=clock.sleep, clock=clock)
+    assert result == "ok"
+    assert fn.calls == 1
+    assert clock.sleeps == []
+
+
+async def test_exponential_backoff_schedule_without_jitter():
+    clock = Clock()
+    fn = Flaky(3, RuntimeError("flake"))
+    policy = RetryPolicy(attempts=5, base_delay=0.5, max_delay=5.0, jitter=False)
+    result = await retry_async(fn, policy, sleep=clock.sleep, clock=clock)
+    assert result == "ok"
+    assert fn.calls == 4
+    # tenacity-parity ladder: 0.5 * 2^(n-1), capped at max_delay.
+    assert clock.sleeps == [0.5, 1.0, 2.0]
+
+
+async def test_backoff_caps_at_max_delay():
+    clock = Clock()
+    fn = Flaky(4, RuntimeError("flake"))
+    policy = RetryPolicy(
+        attempts=6, base_delay=1.0, max_delay=2.0, jitter=False
+    )
+    await retry_async(fn, policy, sleep=clock.sleep, clock=clock)
+    assert clock.sleeps == [1.0, 2.0, 2.0, 2.0]
+
+
+async def test_full_jitter_is_seeded_and_bounded():
+    policy = RetryPolicy(attempts=4, base_delay=0.5, max_delay=5.0)
+
+    async def run(seed: int) -> list[float]:
+        clock = Clock()
+        fn = Flaky(3, RuntimeError("flake"))
+        await retry_async(
+            fn,
+            policy,
+            rng=random.Random(seed),
+            sleep=clock.sleep,
+            clock=clock,
+        )
+        return clock.sleeps
+
+    first = await run(7)
+    second = await run(7)
+    assert first == second, "same seed must reproduce the same plan"
+    # Full jitter: each sleep is U(0, raw) where raw follows the ladder.
+    for sleep, raw in zip(first, [0.5, 1.0, 2.0]):
+        assert 0.0 <= sleep <= raw
+
+
+async def test_attempts_exhausted_reraises_last_error():
+    clock = Clock()
+    fn = Flaky(99, RuntimeError("persistent"))
+    policy = RetryPolicy(attempts=3, jitter=False)
+    with pytest.raises(RuntimeError, match="persistent"):
+        await retry_async(fn, policy, sleep=clock.sleep, clock=clock)
+    assert fn.calls == 3
+    assert len(clock.sleeps) == 2
+
+
+async def test_non_matching_exception_type_is_not_retried():
+    clock = Clock()
+    fn = Flaky(99, KeyError("wrong type"))
+    policy = RetryPolicy(attempts=5, retry_on=(ValueError,), jitter=False)
+    with pytest.raises(KeyError):
+        await retry_async(fn, policy, sleep=clock.sleep, clock=clock)
+    assert fn.calls == 1
+    assert clock.sleeps == []
+
+
+async def test_retry_if_predicate_vetoes_retry():
+    clock = Clock()
+    fn = Flaky(99, ValueError("fatal: no"))
+    policy = RetryPolicy(
+        attempts=5,
+        retry_on=(ValueError,),
+        retry_if=lambda e: "fatal" not in str(e),
+        jitter=False,
+    )
+    with pytest.raises(ValueError):
+        await retry_async(fn, policy, sleep=clock.sleep, clock=clock)
+    assert fn.calls == 1
+
+
+async def test_deadline_stops_before_sleeping_past_it():
+    clock = Clock()
+    fn = Flaky(99, RuntimeError("slow backend"))
+    # First backoff (0.5s) fits the 0.6s budget; the second (1.0s) would
+    # land past it — the engine must raise THEN, without sleeping.
+    policy = RetryPolicy(
+        attempts=10, base_delay=0.5, max_delay=5.0, jitter=False, deadline=0.6
+    )
+    with pytest.raises(RuntimeError):
+        await retry_async(fn, policy, sleep=clock.sleep, clock=clock)
+    assert fn.calls == 2
+    assert clock.sleeps == [0.5]
+
+
+async def test_on_retry_hook_sees_each_retry_and_may_abort():
+    clock = Clock()
+    seen: list[tuple[int, str, float]] = []
+
+    def hook(failures, error, delay):
+        seen.append((failures, str(error), delay))
+
+    fn = Flaky(2, RuntimeError("flake"))
+    await retry_async(
+        fn,
+        RetryPolicy(attempts=5, jitter=False),
+        on_retry=hook,
+        sleep=clock.sleep,
+        clock=clock,
+    )
+    assert [(n, d) for n, _, d in seen] == [(1, 0.5), (2, 1.0)]
+
+    class Abort(Exception):
+        pass
+
+    def aborting_hook(failures, error, delay):
+        raise Abort("breaker opened")
+
+    fn2 = Flaky(99, RuntimeError("flake"))
+    with pytest.raises(Abort):
+        await retry_async(
+            fn2,
+            RetryPolicy(attempts=5, jitter=False),
+            on_retry=aborting_hook,
+            sleep=clock.sleep,
+            clock=clock,
+        )
+    assert fn2.calls == 1
+
+
+async def test_retryable_decorator_wraps_methods():
+    calls = 0
+
+    @retryable(RetryPolicy(attempts=3, base_delay=0.0, jitter=False))
+    async def flaky(value: int) -> int:
+        nonlocal calls
+        calls += 1
+        if calls < 2:
+            raise RuntimeError("flake")
+        return value * 2
+
+    assert await flaky(21) == 42
+    assert calls == 2
