@@ -146,6 +146,38 @@ class Config(BaseModel):
     # Seconds an open lane waits before letting a half-open probe through;
     # one probe success closes the lane, one failure re-opens it.
     breaker_cooldown: float = 30.0
+    # -- scheduler (admission control & fair share) --------------------------
+    # Every sandbox-slot acquisition goes through services/scheduler.py:
+    # per-lane ordered queues with weighted fair queueing across tenants,
+    # priority classes, deadline-aware admission, and bounded per-tenant
+    # queue depth. The tenant comes from gRPC metadata `x-tenant` / HTTP
+    # `X-Tenant` (or the request body); absent = this shared tenant.
+    scheduler_default_tenant: str = "shared"
+    # Per-tenant WFQ weights, e.g. {"interactive-ui": 4, "batch-jobs": 1}.
+    # A tenant absent from the map weighs 1.0. Higher weight = larger share
+    # of grants under contention (a weight-3 tenant gets ~3x the slots of a
+    # weight-1 tenant while both have backlog).
+    scheduler_tenant_weights: dict = Field(default_factory=dict)
+    # Max requests ONE tenant may have queued per lane. At the bound new
+    # requests shed at arrival with HTTP 429 / gRPC RESOURCE_EXHAUSTED and
+    # a computed Retry-After (monotonic in the lane's queue depth) instead
+    # of building unbounded backlog behind the 300s acquire budget.
+    scheduler_max_queue_depth: int = 64
+    # Starvation bound for the `batch` priority class: after this many
+    # consecutive `interactive` grants while batch work waits, the next
+    # grant goes to batch regardless of class preference.
+    scheduler_batch_starvation_limit: int = 8
+    # Smoothing factor for the queue-wait / spawn-latency EWMAs that drive
+    # deadline-aware admission (higher = reacts faster, noisier).
+    scheduler_ewma_alpha: float = 0.2
+    # Floor for the per-queued-request Retry-After estimate while the
+    # EWMAs are still cold (seconds).
+    scheduler_min_retry_after: float = 1.0
+    # Max DISTINCT tenant names exported as metric labels; past the cap,
+    # further tenants collapse into one `_overflow` label (scheduling still
+    # uses the real tenant — only dashboards coarsen). Guards label
+    # cardinality against clients minting unbounded tenant names.
+    scheduler_max_metric_tenants: int = 256
     # Deterministic fault-injection plan for chaos runs, e.g.
     # "spawn_fail:0.3,seed:7" (grammar in services/backends/faults.py).
     # Empty = no injection. NEVER set in production.

@@ -148,6 +148,14 @@ class FaultInjectingBackend(SandboxBackend):
         if spec.active:
             logger.warning("fault injection ACTIVE: %s", spec)
 
+    def bind_breakers(self, board) -> None:
+        """Pass the executor's breaker board through to the wrapped backend
+        (the kubernetes pod-watch integration must keep working under an
+        injected-fault wrapper)."""
+        bind = getattr(self.inner, "bind_breakers", None)
+        if bind is not None:
+            bind(board)
+
     def _fire(self, name: str, rate: float) -> bool:
         if rate <= 0.0 or self._rngs[name].random() >= rate:
             return False

@@ -81,6 +81,38 @@ class KubernetesSandboxBackend(SandboxBackend):
         self._owner_lock = asyncio.Lock()
         self._live: dict[str, Sandbox] = {}
         self._cleanup_tasks: set[asyncio.Task] = set()
+        self._breakers = None  # BreakerBoard, bound by the executor
+
+    def bind_breakers(self, board) -> None:
+        """Give the pod-watch path direct access to the executor's per-lane
+        spawn breakers: a failed `kubectl wait` / IP-assignment watch counts
+        a lane failure the moment it happens (a multi-host group spawn feeds
+        one strike per failed host watch, not one for the whole group), and
+        the pod-IP polling loop aborts as soon as the lane opens instead of
+        retrying blind against a dead apiserver/nodepool."""
+        self._breakers = board
+
+    def _record_watch_failure(self, lane: int, error: Exception | None = None) -> None:
+        if self._breakers is not None:
+            self._breakers.lane(lane).record_failure()
+            if error is not None:
+                # Tell the executor's spawn ladder this failure already
+                # counted: without the marker it would record the surfaced
+                # SandboxSpawnError again (double strike per failure).
+                error.breaker_recorded = True
+
+    def _check_lane_open(self, lane: int) -> None:
+        """Fail the watch fast when the lane's breaker is hard-open (opened
+        by this watch's own strikes or a sibling host's)."""
+        if self._breakers is not None and self._breakers.is_open(lane):
+            spawn_error = SandboxSpawnError(
+                f"lane-{lane} spawn circuit opened while watching pods; "
+                "aborting watch"
+            )
+            # Not a NEW backend failure — the lane is already open; the
+            # executor must not count the abort as another strike.
+            spawn_error.breaker_recorded = True
+            raise spawn_error
 
     def _delete_soon(self, name: str) -> None:
         """Fire-and-track pod deletion: off the caller's critical path (and
@@ -373,7 +405,9 @@ class KubernetesSandboxBackend(SandboxBackend):
             parts.append(f"(pod logs unavailable: {e})")
         return "\n".join(parts)
 
-    async def _wait_ready_ip(self, name: str) -> str:
+    async def _wait_ready_ip(
+        self, name: str, lane: int = 0, *, record: bool = False
+    ) -> str:
         try:
             await self.kubectl.wait(
                 "pod",
@@ -387,29 +421,48 @@ class KubernetesSandboxBackend(SandboxBackend):
                 raise SandboxSpawnError(f"pod {name} Ready but has no podIP")
             return pod_ip
         except KubectlError as e:
+            # Group spawns record a lane strike PER failed host watch, the
+            # moment it happens — N dead pods of one slice are N independent
+            # failures, not one aggregate strike when the whole spawn
+            # surfaces. Single-host spawns leave the (single) strike to the
+            # executor's spawn ladder — recording here too would double it.
             diagnostics = await self._spawn_diagnostics(name)
-            raise SandboxSpawnError(
+            spawn_error = SandboxSpawnError(
                 f"pod {name} did not become ready: {e}"
                 + (f"\n{diagnostics}" if diagnostics else "")
-            ) from e
+            )
+            if record:
+                self._record_watch_failure(lane, spawn_error)
+            raise spawn_error from e
 
-    async def _wait_pod_ip(self, name: str) -> str:
+    async def _wait_pod_ip(self, name: str, lane: int = 0) -> str:
         """Poll until the pod is scheduled and addressable. Distinct from
         Ready: a multi-host coordinator pod can't pass its readiness probe
-        until its peers join, but peers need its IP to be created at all."""
+        until its peers join, but peers need its IP to be created at all.
+        The poll is breaker-aware: once the lane opens (this watch's own
+        failures or a sibling's), it aborts instead of polling blind."""
         deadline = (
             asyncio.get_running_loop().time() + self.config.executor_pod_ready_timeout
         )
         while True:
+            self._check_lane_open(lane)
             try:
                 pod = await self.kubectl.get("pod", name)
             except KubectlError as e:
-                raise SandboxSpawnError(f"pod {name} vanished while starting: {e}")
+                spawn_error = SandboxSpawnError(
+                    f"pod {name} vanished while starting: {e}"
+                )
+                self._record_watch_failure(lane, spawn_error)
+                raise spawn_error
             pod_ip = pod.get("status", {}).get("podIP")
             if pod_ip:
                 return pod_ip
             if asyncio.get_running_loop().time() > deadline:
-                raise SandboxSpawnError(f"pod {name} was never assigned an IP")
+                spawn_error = SandboxSpawnError(
+                    f"pod {name} was never assigned an IP"
+                )
+                self._record_watch_failure(lane, spawn_error)
+                raise spawn_error
             await asyncio.sleep(0.5)
 
     async def spawn(self, chip_count: int = 0) -> Sandbox:
@@ -485,7 +538,7 @@ class KubernetesSandboxBackend(SandboxBackend):
             # Host 0 binds the coordinator port itself; 0.0.0.0 is valid for
             # the binding side of jax.distributed.initialize.
             await self._create_pod(pod(0, f"0.0.0.0:{coord_port}"))
-            coordinator_ip = await self._wait_pod_ip(names[0])
+            coordinator_ip = await self._wait_pod_ip(names[0], chip_count)
             # return_exceptions on both gathers: every sibling create/wait
             # must settle before cleanup runs, or an in-flight create could
             # land after its delete and leak a pod holding TPU chips.
@@ -498,7 +551,11 @@ class KubernetesSandboxBackend(SandboxBackend):
             )
             _raise_first(created, group)
             ips = await asyncio.gather(
-                *(self._wait_ready_ip(n) for n in names), return_exceptions=True
+                *(
+                    self._wait_ready_ip(n, chip_count, record=True)
+                    for n in names
+                ),
+                return_exceptions=True,
             )
             _raise_first(ips, group)
         except (SandboxSpawnError, asyncio.CancelledError):

@@ -38,11 +38,15 @@ from ..utils.validation import OBJECT_ID_RE, normalize_workspace_path
 from .backends.base import Sandbox, SandboxBackend, SandboxSpawnError, num_hosts_for
 from .circuit_breaker import BreakerBoard
 from .errors import (  # noqa: F401 — canonical home is errors.py; re-exported
+    AdmissionRejectedError,
     CapacityTimeoutError,
     CircuitOpenError,
+    DeadlineInfeasibleError,
     ExecutorError,
+    QueueDepthError,
     SessionLimitError,
 )
+from .scheduler import SandboxScheduler
 from .storage import Storage
 
 logger = logging.getLogger(__name__)
@@ -100,6 +104,7 @@ class CodeExecutor:
         config: Config | None = None,
         metrics: ExecutorMetrics | None = None,
         breakers: BreakerBoard | None = None,
+        scheduler: SandboxScheduler | None = None,
     ) -> None:
         self.backend = backend
         self.storage = storage
@@ -112,6 +117,19 @@ class CodeExecutor:
         self.breakers = breakers or BreakerBoard(
             failure_threshold=self.config.breaker_failure_threshold,
             cooldown=self.config.breaker_cooldown,
+        )
+        # Backends with long-running watch paths (kubernetes pod-watch) feed
+        # the same lane breakers directly, so a watch failure counts without
+        # waiting for the whole spawn ladder to surface it.
+        bind_breakers = getattr(self.backend, "bind_breakers", None)
+        if bind_breakers is not None:
+            bind_breakers(self.breakers)
+        # All sandbox-slot admission goes through the fair-share scheduler:
+        # per-lane ordered queues, weighted fair queueing across tenants,
+        # priority classes, deadline-aware admission, bounded per-tenant
+        # depth. _acquire is a thin client of its grant tokens.
+        self.scheduler = scheduler or SandboxScheduler(
+            self.config, metrics=self.metrics
         )
         # Spawn retries mirror the reference's ladder (3 attempts, 0.5s
         # exponential base capped at 5s) with full jitter so parallel refill
@@ -136,14 +154,6 @@ class CodeExecutor:
         # target — a refill spawn for a sandbox that is about to recycle
         # would fight it for the physical TPU slot and lose (VERDICT r2 #1).
         self._in_use: dict[int, int] = {}
-        # Requests currently blocked in _acquire, per lane: lets a waiter
-        # decide between waiting for a due-back sandbox (sequential traffic —
-        # a recycle lands in milliseconds) and spawning its own (burst —
-        # more demand than sandboxes due back).
-        self._waiting: dict[int, int] = {}
-        # Per-lane turnover signal: set whenever pool/spawning/in_use change
-        # so waiters re-evaluate instead of polling (VERDICT r2 #6).
-        self._lane_events: dict[int, asyncio.Event] = {}
         # executor_id -> live session (sandbox held out of the pool).
         self._sessions: dict[str, _Session] = {}
         # Sandboxes held by sessions, per lane: they occupy physical TPU
@@ -159,6 +169,7 @@ class CodeExecutor:
         self.metrics.bind_pool(self._pools)
         self.metrics.bind_sessions(self._sessions)
         self.metrics.bind_breakers(self.breakers)
+        self.metrics.bind_scheduler(self.scheduler)
 
     def _http_client(self) -> httpx.AsyncClient:
         if self._client is None or self._client.is_closed:
@@ -188,6 +199,12 @@ class CodeExecutor:
         (Retry-After); 0 when serving normally."""
         return self.breakers.retry_after(self.config.default_chip_count)
 
+    def lane_degraded(self, chip_count: int) -> bool:
+        """Per-lane degradation, for gRPC health's per-service-name
+        reporting (`lane-<n>`): a dead 4-chip nodepool must read
+        NOT_SERVING on `lane-4` while CPU-lane traffic stays SERVING."""
+        return self.breakers.is_open(chip_count)
+
     # ------------------------------------------------------------------ pool
 
     def _pool(self, chip_count: int) -> deque[Sandbox]:
@@ -198,16 +215,15 @@ class CodeExecutor:
         return capacity_fn(chip_count) if capacity_fn is not None else None
 
     def _notify_lane(self, chip_count: int) -> None:
-        event = self._lane_events.pop(chip_count, None)
-        if event is not None:
-            event.set()
+        """Capacity turnover on the lane: the scheduler wakes the next
+        waiter in fair order (an explicit grant, not a broadcast)."""
+        self.scheduler.kick(chip_count)
 
     def _notify_all_lanes(self) -> None:
         """Wake waiters on EVERY lane: freed capacity on a constrained
         backend is shared across lanes (see _session_held_constrained), so a
         session closing in lane 0 can unblock a lane-4 waiter."""
-        for chip_count in list(self._lane_events):
-            self._notify_lane(chip_count)
+        self.scheduler.kick_all()
 
     def _session_held_constrained(self) -> int:
         """Session-parked sandboxes summed over ALL capacity-constrained
@@ -327,13 +343,22 @@ class CodeExecutor:
             start = time.perf_counter()
             try:
                 sandbox = await self.backend.spawn(chip_count)
-            except SandboxSpawnError:
-                breaker.record_failure()
+            except SandboxSpawnError as e:
+                # Backends with watch-path breaker integration mark errors
+                # they already counted (kubernetes records one strike per
+                # failed host watch) — counting the surfaced aggregate again
+                # would open the lane faster than the configured threshold.
+                if not getattr(e, "breaker_recorded", False):
+                    breaker.record_failure()
                 raise
             breaker.record_success()
+            elapsed = time.perf_counter() - start
             self.metrics.spawn_seconds.observe(
-                time.perf_counter() - start, chip_count=str(chip_count)
+                elapsed, chip_count=str(chip_count)
             )
+            # Feed the scheduler's spawn-latency EWMA: one input to
+            # deadline-aware admission when the warm pool is empty.
+            self.scheduler.observe_spawn(chip_count, elapsed)
             return sandbox
 
         def on_retry(failures: int, error: BaseException, delay: float) -> None:
@@ -371,32 +396,98 @@ class CodeExecutor:
             )
             await asyncio.gather(*(self._dispose(s) for s in evicted))
 
-    async def _acquire(self, chip_count: int) -> Sandbox:
+    async def _acquire(
+        self,
+        chip_count: int,
+        *,
+        tenant: str | None = None,
+        priority: str | None = None,
+        deadline: float | None = None,
+    ) -> Sandbox:
+        """Acquire a sandbox slot through the scheduler.
+
+        A thin client of the scheduler's grant tokens: submit() runs
+        admission control (per-tenant depth bound, deadline feasibility) and
+        queues a ticket; each explicit grant wakes exactly one waiter — in
+        weighted-fair, priority-aware order — which then runs the same
+        pool-pop / spawn-vs-wait / breaker-fail-fast logic as before. The
+        old 30s safety-net poll is gone: every turnover issues a grant, and
+        a turnover landing mid-evaluation is remembered by the scheduler
+        (pending kicks), so a wake-up cannot be lost."""
         pool = self._pool(chip_count)
+        now = self.scheduler.now()
         # After this long without a sandbox, spawn regardless of what is
         # "due back" — a long-running in-flight execute must not block a
         # waiter on an unconstrained lane indefinitely.
-        grace_deadline = asyncio.get_running_loop().time() + 10.0
+        grace_deadline = now + 10.0
         # On a constrained lane no amount of waiting helps while active
         # sessions hold every slot — bound the wait and surface a
         # retryable error instead of an open-ended hang.
         acquire_deadline = (
-            asyncio.get_running_loop().time() + self.config.executor_acquire_timeout
+            now + self.config.executor_acquire_timeout
             if self.config.executor_acquire_timeout > 0
             else None
         )
-        self._waiting[chip_count] = self._waiting.get(chip_count, 0) + 1
+        # Admission control happens HERE, at arrival: depth-bound sheds and
+        # infeasible deadlines raise retryable errors carrying a computed
+        # Retry-After instead of burning the acquire budget first.
+        ticket = self.scheduler.submit(
+            chip_count,
+            tenant=tenant,
+            priority=priority,
+            deadline=deadline,
+            pool_ready=len(pool),
+        )
+        sandbox: Sandbox | None = None
         try:
             while True:
-                # Grab the event BEFORE checking state: a turnover landing
-                # between the check and the wait sets this same event, so the
-                # wake-up cannot be lost.
-                event = self._lane_events.setdefault(chip_count, asyncio.Event())
-                if pool:
-                    sandbox = pool.popleft()
-                    break
+                capacity = self._lane_capacity(chip_count)
+                # Unconstrained lanes re-wake at the grace deadline even
+                # without a grant: a spawn CREATES capacity rather than
+                # consuming queued supply, so it needn't wait its fair turn.
+                deadline_at = ticket.deadline_at if ticket is not None else None
+                candidates = [
+                    t for t in (acquire_deadline, deadline_at) if t is not None
+                ]
+                if capacity is None and now < grace_deadline:
+                    candidates.append(grace_deadline)
+                timeout_at = min(candidates) if candidates else None
+                granted = await self.scheduler.wait_grant(
+                    ticket, timeout_at=timeout_at
+                )
+                now = self.scheduler.now()
                 spawning = self._spawning.get(chip_count, 0)
                 in_use = self._in_use.get(chip_count, 0)
+                session_held = self._session_held_constrained()
+                if not granted and deadline_at is not None and now >= deadline_at:
+                    # Admission let the request in on an estimate; reality
+                    # disagreed. The declared start deadline has passed, so
+                    # keeping the ticket queued can only waste the client's
+                    # time — reject NOW with the same retryable signal as an
+                    # arrival-time rejection.
+                    raise DeadlineInfeasibleError(
+                        f"deadline ({deadline:.1f}s) expired while queued "
+                        f"for a lane-{chip_count} sandbox slot",
+                        lane=chip_count,
+                        tenant=ticket.tenant,
+                        retry_after=self.scheduler.estimated_wait(
+                            chip_count, pool_ready=len(pool)
+                        ),
+                    )
+                if (
+                    not granted
+                    and acquire_deadline is not None
+                    and now >= acquire_deadline
+                ):
+                    raise CapacityTimeoutError(
+                        f"no lane-{chip_count} sandbox slot freed within "
+                        f"{self.config.executor_acquire_timeout:.0f}s "
+                        f"(in_use={in_use}, session_held={session_held}, "
+                        f"capacity={capacity}); retry later"
+                    )
+                if granted and pool:
+                    sandbox = pool.popleft()
+                    break
                 if (
                     self.breakers.is_open(chip_count)
                     and spawning == 0
@@ -407,8 +498,6 @@ class CodeExecutor:
                     # budget (up to 300s) cannot help — fail fast with the
                     # retryable circuit error instead.
                     self.breakers.lane(chip_count).check(chip_count)
-                session_held = self._session_held_constrained()
-                capacity = self._lane_capacity(chip_count)
                 if capacity is not None:
                     # Constrained lane: a competing spawn would lose the
                     # physical-slot race to an in-flight refill or an
@@ -430,8 +519,11 @@ class CodeExecutor:
                     )
                     can_spawn = (
                         due_back == 0
-                        or self._waiting.get(chip_count, 1) > due_back
-                        or asyncio.get_running_loop().time() > grace_deadline
+                        or self.scheduler.queued(chip_count) > due_back
+                        # >= to match wait_grant's timeout comparison: a
+                        # waiter woken exactly at the grace boundary must
+                        # spawn, not fall through to the acquire deadline.
+                        or now >= grace_deadline
                     )
                 if can_spawn:
                     # Count the direct spawn in _spawning: a concurrent
@@ -441,33 +533,28 @@ class CodeExecutor:
                     self._spawning[chip_count] = (
                         self._spawning.get(chip_count, 0) + 1
                     )
+                    # Leave the queue BEFORE spawning: this waiter now owns
+                    # its own supply, so the grant passes to the next waiter,
+                    # which re-evaluates against the bumped spawn count.
+                    self.scheduler.complete(ticket)
+                    ticket = None
                     try:
                         sandbox = await self._spawn_with_retry(chip_count)
                     finally:
                         self._spawning[chip_count] -= 1
                         self._notify_lane(chip_count)
                     break
-                # Wait for turnover (a recycle, a dispose, or a refill
-                # landing). The timeout is a safety net against a lost
-                # release, not a poll — the event fires long before it in
-                # normal operation.
-                now = asyncio.get_running_loop().time()
-                if acquire_deadline is not None and now >= acquire_deadline:
-                    raise CapacityTimeoutError(
-                        f"no lane-{chip_count} sandbox slot freed within "
-                        f"{self.config.executor_acquire_timeout:.0f}s "
-                        f"(in_use={in_use}, session_held={session_held}, "
-                        f"capacity={capacity}); retry later"
-                    )
-                wait_s = 30.0
-                if acquire_deadline is not None:
-                    wait_s = min(wait_s, max(acquire_deadline - now, 0.1))
-                try:
-                    await asyncio.wait_for(event.wait(), timeout=wait_s)
-                except asyncio.TimeoutError:
-                    pass
-        finally:
-            self._waiting[chip_count] -= 1
+                if granted:
+                    # Nothing to pop and must not spawn: back to sleep in
+                    # fair position (or straight back to evaluation, if a
+                    # turnover landed while this holder was deciding).
+                    self.scheduler.rearm(ticket)
+        except BaseException:
+            if ticket is not None:
+                self.scheduler.abandon(ticket)
+            raise
+        if ticket is not None:
+            self.scheduler.complete(ticket)
         self._in_use[chip_count] = self._in_use.get(chip_count, 0) + 1
         self.fill_pool_soon(chip_count)
         return sandbox
@@ -485,6 +572,9 @@ class CodeExecutor:
         chip_count: int | None = None,
         profile: bool = False,
         executor_id: str | None = None,
+        tenant: str | None = None,
+        priority: str | None = None,
+        deadline: float | None = None,
     ) -> Result:
         """Run user code in a sandbox; returns output + changed files.
 
@@ -492,6 +582,12 @@ class CodeExecutor:
         workspace path that must appear in `files`) is required. With
         ``profile=True`` the sandbox captures a JAX profiler trace of the run
         and ships it back as ``/workspace/profile.zip``.
+
+        `tenant` / `priority` / `deadline` are admission-control inputs for
+        the fair-share scheduler: tenant defaults to the shared tenant,
+        priority is `interactive` (default) or `batch`, and deadline is
+        "this request must START within N seconds" — infeasible deadlines
+        are rejected at arrival with a retryable error.
 
         Without `executor_id` each request gets a pristine sandbox. With it,
         requests sharing the id run in ONE live sandbox whose workspace (and
@@ -512,6 +608,9 @@ class CodeExecutor:
                     timeout=timeout,
                     env=env,
                     chip_count=chip_count,
+                    tenant=tenant,
+                    priority=priority,
+                    deadline=deadline,
                 )
             else:
                 result = await self._execute_with_retry(
@@ -521,6 +620,9 @@ class CodeExecutor:
                     timeout=timeout,
                     env=env,
                     chip_count=chip_count,
+                    tenant=tenant,
+                    priority=priority,
+                    deadline=deadline,
                 )
         except CircuitOpenError as e:
             self.metrics.breaker_rejections.inc(chip_count=str(e.lane))
@@ -546,6 +648,9 @@ class CodeExecutor:
         timeout: float | None = None,
         env: dict[str, str] | None = None,
         chip_count: int | None = None,
+        tenant: str | None = None,
+        priority: str | None = None,
+        deadline: float | None = None,
     ) -> Result:
         """Stateless execute with bounded infra retries (ExecutorError only:
         user-code failures are results, capacity/breaker rejections are not
@@ -562,6 +667,9 @@ class CodeExecutor:
                 timeout=timeout,
                 env=env,
                 chip_count=chip_count,
+                tenant=tenant,
+                priority=priority,
+                deadline=deadline,
             ),
             self._execute_retry_policy,
             on_retry=on_retry,
@@ -576,6 +684,9 @@ class CodeExecutor:
         timeout: float | None = None,
         env: dict[str, str] | None = None,
         chip_count: int | None = None,
+        tenant: str | None = None,
+        priority: str | None = None,
+        deadline: float | None = None,
         emit=None,
     ) -> Result:
         lane, files, timeout = self._validate_request(
@@ -584,7 +695,9 @@ class CodeExecutor:
         timer = PhaseTimer()
 
         with timer.phase("queue_wait"):
-            sandbox = await self._acquire(lane)
+            sandbox = await self._acquire(
+                lane, tenant=tenant, priority=priority, deadline=deadline
+            )
         reusable = False
         try:
             result, _continuable = await self._run_on_sandbox(
@@ -754,6 +867,9 @@ class CodeExecutor:
         chip_count: int | None = None,
         profile: bool = False,
         executor_id: str | None = None,
+        tenant: str | None = None,
+        priority: str | None = None,
+        deadline: float | None = None,
     ):
         """Streaming variant of execute(): an async generator yielding
         ``{"stream": "stdout"|"stderr", "data": str}`` events while the code
@@ -781,6 +897,9 @@ class CodeExecutor:
                         timeout=timeout,
                         env=env,
                         chip_count=chip_count,
+                        tenant=tenant,
+                        priority=priority,
+                        deadline=deadline,
                         emit=emit,
                     )
                 return await self._execute_once(
@@ -790,6 +909,9 @@ class CodeExecutor:
                     timeout=timeout,
                     env=env,
                     chip_count=chip_count,
+                    tenant=tenant,
+                    priority=priority,
+                    deadline=deadline,
                     emit=emit,
                 )
             finally:
@@ -865,6 +987,9 @@ class CodeExecutor:
         timeout: float | None = None,
         env: dict[str, str] | None = None,
         chip_count: int | None = None,
+        tenant: str | None = None,
+        priority: str | None = None,
+        deadline: float | None = None,
         emit=None,
     ) -> Result:
         """Run one request inside the executor_id's session sandbox.
@@ -885,7 +1010,13 @@ class CodeExecutor:
         loop = asyncio.get_running_loop()
         while True:
             with timer.phase("queue_wait"):
-                session = await self._get_session(executor_id, lane)
+                session = await self._get_session(
+                    executor_id,
+                    lane,
+                    tenant=tenant,
+                    priority=priority,
+                    deadline=deadline,
+                )
                 await session.lock.acquire()
             try:
                 if session.closed or self._sessions.get(executor_id) is not session:
@@ -934,9 +1065,19 @@ class CodeExecutor:
             finally:
                 session.lock.release()
 
-    async def _get_session(self, executor_id: str, lane: int) -> _Session:
+    async def _get_session(
+        self,
+        executor_id: str,
+        lane: int,
+        *,
+        tenant: str | None = None,
+        priority: str | None = None,
+        deadline: float | None = None,
+    ) -> _Session:
         """Fetch or create the id's session. Concurrent first requests wait
-        on one creation (the `ready` future) instead of racing spawns."""
+        on one creation (the `ready` future) instead of racing spawns.
+        Admission params apply to the CREATING request's slot acquisition;
+        follow-up requests ride the already-held sandbox."""
         while True:
             session = self._sessions.get(executor_id)
             if session is not None:
@@ -957,7 +1098,9 @@ class CodeExecutor:
             session = _Session(lane=lane, last_used=asyncio.get_running_loop().time())
             self._sessions[executor_id] = session
             try:
-                sandbox = await self._acquire(lane)
+                sandbox = await self._acquire(
+                    lane, tenant=tenant, priority=priority, deadline=deadline
+                )
             except BaseException as e:
                 session.closed = True
                 if self._sessions.get(executor_id) is session:

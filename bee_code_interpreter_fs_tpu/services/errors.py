@@ -28,6 +28,44 @@ class CapacityTimeoutError(SessionLimitError):
     indefinitely (ADVICE r3 #1)."""
 
 
+class AdmissionRejectedError(SessionLimitError):
+    """The scheduler refused the request AT ADMISSION (arrival time), before
+    it spent any of the acquire budget queueing. Retryable — HTTP 429 /
+    gRPC RESOURCE_EXHAUSTED via the SessionLimitError parent — but unlike
+    the parent it always carries a COMPUTED ``retry_after`` (derived from
+    current queue depth and the lane's EWMA wait), which the HTTP layer
+    surfaces as a ``Retry-After`` header so clients back off proportionally
+    to the actual backlog instead of guessing."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        lane: int = 0,
+        tenant: str = "",
+        retry_after: float = 0.0,
+    ) -> None:
+        super().__init__(message)
+        self.lane = lane
+        self.tenant = tenant
+        self.retry_after = retry_after
+
+
+class QueueDepthError(AdmissionRejectedError):
+    """The tenant's per-lane queue-depth bound is full: admitting one more
+    request would let a flooding tenant build unbounded backlog (and
+    unbounded queue-wait for everyone behind it). Shed at arrival with a
+    Retry-After that grows with the lane's total queue depth."""
+
+
+class DeadlineInfeasibleError(AdmissionRejectedError):
+    """The request's start deadline cannot beat the estimated queue wait
+    (EWMA of recent queue-wait + spawn latency), so it is rejected on
+    arrival instead of being parked until the deadline (or the 300s acquire
+    budget) expires — the client learns immediately and can retry
+    elsewhere."""
+
+
 class CircuitOpenError(SessionLimitError):
     """The lane's spawn circuit breaker is open: the backend failed N
     consecutive spawns and the cooldown has not elapsed, so the request

@@ -11,6 +11,7 @@ services are registered via generic handlers against the vendored protos
 from __future__ import annotations
 
 import logging
+import re
 from collections.abc import Callable
 
 import grpc
@@ -39,25 +40,49 @@ class HealthServicer:
     control plane whose default-lane spawn breaker is open reports
     NOT_SERVING so load balancers drain it while it cannot take new work —
     health that reflects reality, not process liveness. It recovers on the
-    breaker's half-open probe success without a restart."""
+    breaker's half-open probe success without a restart.
 
-    def __init__(self, degraded_check: Callable[[], bool] | None = None) -> None:
+    Per-lane degradation is reported through health service NAMES: checking
+    service ``lane-<n>`` (bare, or suffixed onto the main service as
+    ``<SERVICE_NAME>/lane-<n>``) answers for chip-count lane n alone via
+    ``lane_degraded_check`` — a dead 4-chip nodepool reads NOT_SERVING on
+    ``lane-4`` while ``lane-0`` CPU traffic stays SERVING, so a per-lane
+    load balancer can drain exactly the broken slice shape."""
+
+    LANE_SERVICE_RE = re.compile(
+        rf"^(?:{re.escape(SERVICE_NAME)}/)?lane-(\d+)$"
+    )
+
+    def __init__(
+        self,
+        degraded_check: Callable[[], bool] | None = None,
+        lane_degraded_check: Callable[[int], bool] | None = None,
+    ) -> None:
         self.serving = True
         self.degraded_check = degraded_check
+        self.lane_degraded_check = lane_degraded_check
 
-    def _currently_serving(self) -> bool:
+    def _currently_serving(self, lane: int | None = None) -> bool:
         if not self.serving:
             return False
+        if lane is not None:
+            if self.lane_degraded_check is not None:
+                return not self.lane_degraded_check(lane)
+            return True
         if self.degraded_check is not None and self.degraded_check():
             return False
         return True
 
     async def Check(self, request, context) -> health_pb2.HealthCheckResponse:
-        if request.service not in ("", SERVICE_NAME, HEALTH_SERVICE_NAME):
+        lane: int | None = None
+        lane_match = self.LANE_SERVICE_RE.match(request.service)
+        if lane_match is not None:
+            lane = int(lane_match.group(1))
+        elif request.service not in ("", SERVICE_NAME, HEALTH_SERVICE_NAME):
             await context.abort(grpc.StatusCode.NOT_FOUND, "unknown service")
         status = (
             health_pb2.HealthCheckResponse.SERVING
-            if self._currently_serving()
+            if self._currently_serving(lane)
             else health_pb2.HealthCheckResponse.NOT_SERVING
         )
         return health_pb2.HealthCheckResponse(status=status)
@@ -188,7 +213,10 @@ class GrpcServer:
     ) -> None:
         self.config = config
         self.servicer = CodeInterpreterServicer(code_executor, custom_tool_executor)
-        self.health = HealthServicer(degraded_check=code_executor.degraded)
+        self.health = HealthServicer(
+            degraded_check=code_executor.degraded,
+            lane_degraded_check=code_executor.lane_degraded,
+        )
         self.reflection = ReflectionServicer(
             [SERVICE_NAME, HEALTH_SERVICE_NAME, REFLECTION_SERVICE_NAME]
         )

@@ -43,6 +43,46 @@ class CodeInterpreterServicer:
         self.custom_tool_executor = custom_tool_executor
 
     @staticmethod
+    async def _admission_from_metadata(
+        context: grpc.aio.ServicerContext,
+    ) -> dict:
+        """Tenant/priority/deadline for the fair-share scheduler, carried as
+        gRPC invocation metadata (`x-tenant`, `x-priority`,
+        `x-deadline-seconds`) — the transport-level analogue of the HTTP
+        surface's X-Tenant / X-Priority / X-Deadline-Seconds headers, so a
+        gateway can tag requests without touching the message. Value
+        validation (tenant charset, priority names) lives in the scheduler;
+        its ValueError maps to INVALID_ARGUMENT on the shared path."""
+        metadata = {}
+        metadata_fn = getattr(context, "invocation_metadata", None)
+        invocation_metadata = metadata_fn() if metadata_fn is not None else None
+        if invocation_metadata:
+            # grpc.aio yields (key, value) tuples; the sync API yields
+            # entries with .key/.value — accept both (tests fake either).
+            for entry in invocation_metadata:
+                key, value = (
+                    (entry.key, entry.value)
+                    if hasattr(entry, "key")
+                    else (entry[0], entry[1])
+                )
+                metadata.setdefault(key, value)
+        deadline = None
+        raw = metadata.get("x-deadline-seconds")
+        if raw is not None:
+            try:
+                deadline = float(raw)
+            except ValueError:
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    "x-deadline-seconds metadata must be a number",
+                )
+        return {
+            "tenant": metadata.get("x-tenant"),
+            "priority": metadata.get("x-priority"),
+            "deadline": deadline,
+        }
+
+    @staticmethod
     async def _validate_execute_request(
         request: pb2.ExecuteRequest, context: grpc.aio.ServicerContext
     ) -> tuple[bool, bool]:
@@ -90,6 +130,7 @@ class CodeInterpreterServicer:
         request_id = new_request_id()
         logger.info("Execute [%s] chip_count=%d", request_id, request.chip_count)
         has_code, has_file = await self._validate_execute_request(request, context)
+        admission = await self._admission_from_metadata(context)
         # executor_id pattern validation lives in the executor (its
         # ValueError maps to INVALID_ARGUMENT below, same as the HTTP path).
         try:
@@ -102,6 +143,7 @@ class CodeInterpreterServicer:
                 chip_count=request.chip_count or None,
                 profile=request.profile,
                 executor_id=request.executor_id or None,
+                **admission,
             )
         except ValueError as e:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
@@ -129,6 +171,7 @@ class CodeInterpreterServicer:
             "ExecuteStream [%s] chip_count=%d", request_id, request.chip_count
         )
         has_code, has_file = await self._validate_execute_request(request, context)
+        admission = await self._admission_from_metadata(context)
         events = self.code_executor.execute_stream(
             request.source_code if has_code else None,
             source_file=request.source_file if has_file else None,
@@ -138,6 +181,7 @@ class CodeInterpreterServicer:
             chip_count=request.chip_count or None,
             profile=request.profile,
             executor_id=request.executor_id or None,
+            **admission,
         )
         try:
             async for event in events:

@@ -53,6 +53,16 @@ class ExecuteRequest(BaseModel):
     # Session affinity: requests sharing an executor_id run in one live
     # sandbox whose workspace persists across them. Empty/absent = stateless.
     executor_id: str | None = None
+    # Admission control (fair-share scheduler). Body fields win; the
+    # X-Tenant / X-Priority / X-Deadline-Seconds headers are the fallback
+    # (gateways that can't rewrite bodies set headers). Absent = shared
+    # tenant, interactive class, no deadline.
+    tenant: str | None = None
+    priority: str | None = None  # "interactive" | "batch"
+    # Start within N seconds; 0 = "only if a slot is free right now".
+    # ge (not gt) to match the header/metadata paths, which the scheduler
+    # validates with the same >= 0 rule — one value, one verdict.
+    deadline: float | None = Field(default=None, ge=0)
 
 
 class ParseCustomToolRequest(BaseModel):
@@ -141,6 +151,38 @@ def create_http_app(
                 return bad_request(f"invalid file object id for {path}")
         return None
 
+    def admission_params(request: web.Request, req: ExecuteRequest) -> dict:
+        """Tenant/priority/deadline for the scheduler: body fields first,
+        headers as fallback. Value validation (tenant charset, priority
+        names) lives in the scheduler — its ValueError maps to 400 on the
+        same path as every other client error."""
+        tenant = req.tenant or request.headers.get("X-Tenant")
+        priority = req.priority or request.headers.get("X-Priority")
+        deadline = req.deadline
+        if deadline is None:
+            raw = request.headers.get("X-Deadline-Seconds")
+            if raw is not None:
+                try:
+                    deadline = float(raw)
+                except ValueError:
+                    raise web.HTTPBadRequest(
+                        text=json.dumps(
+                            {"error": "X-Deadline-Seconds must be a number"}
+                        ),
+                        content_type="application/json",
+                    )
+        return {"tenant": tenant, "priority": priority, "deadline": deadline}
+
+    def capacity_response(e: SessionLimitError) -> web.Response:
+        """429 for capacity rejections. Admission sheds carry a computed
+        Retry-After (queue-depth/EWMA-derived) — surface it as the header so
+        clients back off proportionally to the actual backlog."""
+        headers = {}
+        retry_after = getattr(e, "retry_after", 0.0)
+        if retry_after:
+            headers["Retry-After"] = str(max(1, math.ceil(retry_after)))
+        return web.json_response({"error": str(e)}, status=429, headers=headers)
+
     def add_session_fields(body: dict, result, executor_id: str | None) -> dict:
         """Session continuity, one rule for every surface: seq==1 on a
         request the client expected to land in an existing session means
@@ -179,6 +221,7 @@ def create_http_app(
                 chip_count=req.chip_count,
                 profile=req.profile,
                 executor_id=req.executor_id,
+                **admission_params(request, req),
             )
         except ValueError as e:
             return bad_request(str(e))
@@ -186,7 +229,7 @@ def create_http_app(
             return shed(e)
         except SessionLimitError as e:
             # Resource exhaustion, not a request defect: retryable.
-            return web.json_response({"error": str(e)}, status=429)
+            return capacity_response(e)
         except (ExecutorError, SandboxSpawnError) as e:
             logger.exception("execute failed")
             return web.json_response({"error": str(e)}, status=502)
@@ -211,6 +254,7 @@ def create_http_app(
             chip_count=req.chip_count,
             profile=req.profile,
             executor_id=req.executor_id,
+            **admission_params(request, req),
         )
         response = web.StreamResponse(
             status=200, headers={"Content-Type": "application/x-ndjson"}
@@ -244,7 +288,7 @@ def create_http_app(
             )
         except SessionLimitError as e:
             if not started:
-                return web.json_response({"error": str(e)}, status=429)
+                return capacity_response(e)
             await response.write(
                 (json.dumps({"error": str(e)}) + "\n").encode("utf-8")
             )
@@ -319,7 +363,7 @@ def create_http_app(
         except CircuitOpenError as e:
             return shed(e)
         except SessionLimitError as e:
-            return web.json_response({"error": str(e)}, status=429)
+            return capacity_response(e)
         except (ExecutorError, SandboxSpawnError) as e:
             logger.exception("custom tool execute failed")
             return web.json_response({"error": str(e)}, status=502)

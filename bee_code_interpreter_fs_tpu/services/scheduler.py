@@ -1,0 +1,502 @@
+"""Admission control & weighted fair-share scheduling for sandbox lanes.
+
+Before this subsystem, sandbox acquisition was an unordered scramble: every
+waiter parked on one shared per-lane event, wake-up order was whatever the
+event loop produced, and the only backpressure was a flat 300s timeout.
+Podracer (arxiv 2104.06272) shows TPU-slice throughput hinges on explicit
+work-queue scheduling rather than ad-hoc contention, and the Kubernetes
+GenAI-inference evaluation (arxiv 2602.04900) finds tail latency under load
+is dominated by queueing policy, not execution — this module is that layer.
+
+The scheduler owns ALL slot admission for `CodeExecutor`:
+
+- **Ordered queues per lane** — one `Ticket` per waiting request; wake-ups
+  are explicit *grants* to one chosen ticket, not a free-for-all broadcast,
+  so FIFO holds within a tenant+priority and lost wake-ups are structurally
+  impossible (every state change re-grants the fair-order head).
+- **Weighted fair queueing across tenants** — start-time fair queueing with
+  unit cost: a ticket's virtual finish tag is `start + 1/weight`, grants go
+  to the smallest finish tag, so a weight-3 tenant gets ~3x the slots of a
+  weight-1 tenant under sustained two-way backlog while an idle tenant's
+  first request is never penalized for history it didn't use.
+- **Priority classes** — `interactive` beats `batch`, bounded by an aging
+  rule: after `scheduler_batch_starvation_limit` consecutive interactive
+  grants while batch waits, the next grant goes to batch (starvation-free).
+- **Deadline-aware admission** — a request declaring "I must start within D
+  seconds" is rejected AT ARRIVAL when D cannot beat the estimated queue
+  wait (EWMA of recent queue waits, plus the spawn-latency EWMA when the
+  warm pool is empty), instead of being parked until the 300s budget burns.
+- **Bounded per-tenant depth** — at `scheduler_max_queue_depth` queued
+  requests, a tenant's next request sheds with a retryable error carrying a
+  computed `Retry-After` that is monotonic in the lane's total queue depth.
+
+Grant protocol (how `CodeExecutor._acquire` consumes this): `submit()` gets
+a ticket (or an admission rejection); `wait_grant()` parks until the ticket
+is chosen; the granted holder tries the pool / decides to spawn, then either
+`complete()`s (got a sandbox, or left to spawn its own), `rearm()`s (nothing
+available — go back to sleep, keeping its fair position), or `abandon()`s
+(error/cancel). Capacity turnover calls `kick()`. A kick that lands while
+the head is mid-evaluation is remembered (`pending_kicks`) and consumed by
+the next `rearm()`, so supply appearing in that window can never strand with
+every waiter asleep — the invariant that lets the old 30s safety-net poll go.
+
+The clock is injectable so every fairness/deadline test runs on a fake clock
+with zero sleeps.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import math
+import re
+import time
+from collections.abc import Callable
+from dataclasses import dataclass, field
+
+from ..config import Config
+from .errors import DeadlineInfeasibleError, QueueDepthError
+
+logger = logging.getLogger(__name__)
+
+PRIORITY_INTERACTIVE = "interactive"
+PRIORITY_BATCH = "batch"
+PRIORITIES = (PRIORITY_INTERACTIVE, PRIORITY_BATCH)
+
+# Tenants become metric labels and log fields: bound the alphabet/length so a
+# hostile header can't explode label cardinality with binary garbage.
+TENANT_RE = re.compile(r"^[0-9a-zA-Z._:-]{1,64}$")
+
+
+class _Ewma:
+    """Exponentially weighted moving average; None until first observation."""
+
+    def __init__(self, alpha: float) -> None:
+        self.alpha = min(max(alpha, 0.01), 1.0)
+        self.value: float | None = None
+
+    def observe(self, sample: float) -> None:
+        if self.value is None:
+            self.value = sample
+        else:
+            self.value = self.alpha * sample + (1.0 - self.alpha) * self.value
+
+    def get(self, default: float = 0.0) -> float:
+        return default if self.value is None else self.value
+
+
+@dataclass(eq=False)  # identity semantics: hashable, never compared by value
+class Ticket:
+    """One queued acquisition. Identity object — never reused."""
+
+    lane: int
+    tenant: str
+    priority: str
+    enqueued_at: float
+    start_tag: float  # WFQ virtual start
+    finish_tag: float  # WFQ virtual finish (grant order key)
+    seq: int  # global FIFO tiebreak
+    deadline_at: float | None = None  # absolute, scheduler clock; None = none
+    granted: bool = False
+    done: bool = False
+    event: asyncio.Event = field(default_factory=asyncio.Event)
+
+
+class _LaneState:
+    """Per-lane queue + WFQ virtual clock + admission estimators."""
+
+    __slots__ = (
+        "tickets",
+        "vtime",
+        "last_finish",
+        "pending_kicks",
+        "interactive_run",
+        "queue_wait_ewma",
+        "spawn_ewma",
+    )
+
+    def __init__(self, alpha: float) -> None:
+        self.tickets: list[Ticket] = []
+        self.vtime = 0.0
+        # (tenant, priority) -> last assigned finish tag: consecutive
+        # requests from one flow get strictly increasing tags (FIFO within
+        # the flow); an idle flow's stale tag is overridden by vtime.
+        self.last_finish: dict[tuple[str, str], float] = {}
+        # Turnover signals that arrived while every ticket was granted
+        # (i.e. mid-evaluation): consumed by rearm() so the evaluating
+        # holder stays awake instead of sleeping past fresh supply.
+        self.pending_kicks = 0
+        # Consecutive interactive SLOT HANDOFFS (completions that actually
+        # acquired) while batch work waited — the aging counter behind
+        # batch starvation-freedom. Counted at completion, not grant: a
+        # fruitless grant (holder finds nothing and rearms) must neither
+        # burn batch's turn nor bank credit for interactive.
+        self.interactive_run = 0
+        self.queue_wait_ewma = _Ewma(alpha)
+        self.spawn_ewma = _Ewma(alpha)
+
+
+class SandboxScheduler:
+    """Admission control + fair-share grant ordering for every pool lane.
+
+    Sync state machine driven by the executor's event loop; the only async
+    surface is `wait_grant`. Thread-unsafe by design (single event loop),
+    like the pool bookkeeping it arbitrates."""
+
+    def __init__(
+        self,
+        config: Config | None = None,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        metrics=None,
+    ) -> None:
+        self.config = config or Config()
+        self.clock = clock
+        self.metrics = metrics
+        self.default_tenant = self.config.scheduler_default_tenant or "shared"
+        self.weights = dict(self.config.scheduler_tenant_weights)
+        self.max_depth = max(1, self.config.scheduler_max_queue_depth)
+        self.starvation_limit = max(1, self.config.scheduler_batch_starvation_limit)
+        self.min_retry_after = max(0.0, self.config.scheduler_min_retry_after)
+        self._lanes: dict[int, _LaneState] = {}
+        self._seq = itertools.count()
+        # Tenants become metric labels; clients mint tenant names freely, so
+        # an unauthenticated flood of random names must not grow label
+        # cardinality without bound. Scheduling always uses the REAL tenant
+        # (fairness is unaffected); metrics collapse everything past the cap
+        # into one overflow label. Configured weights always keep their own
+        # label — they are the tenants operators actually dashboard.
+        self._metric_tenants: set[str] = set(self.weights) | {self.default_tenant}
+        self._max_metric_tenants = max(
+            len(self._metric_tenants), self.config.scheduler_max_metric_tenants
+        )
+
+    # ------------------------------------------------------------- utilities
+
+    def now(self) -> float:
+        return self.clock()
+
+    def _lane(self, lane: int) -> _LaneState:
+        state = self._lanes.get(lane)
+        if state is None:
+            state = _LaneState(self.config.scheduler_ewma_alpha)
+            self._lanes[lane] = state
+        return state
+
+    def normalize_tenant(self, tenant: str | None) -> str:
+        if tenant is None or tenant == "":
+            return self.default_tenant
+        if not TENANT_RE.match(tenant):
+            raise ValueError(
+                "invalid tenant (want ^[0-9a-zA-Z._:-]{1,64}$)"
+            )
+        return tenant
+
+    @staticmethod
+    def normalize_priority(priority: str | None) -> str:
+        if priority is None or priority == "":
+            return PRIORITY_INTERACTIVE
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"invalid priority {priority!r} (want one of {list(PRIORITIES)})"
+            )
+        return priority
+
+    def queued(self, lane: int) -> int:
+        state = self._lanes.get(lane)
+        return len(state.tickets) if state is not None else 0
+
+    def _metric_tenant(self, tenant: str, *, claim: bool = False) -> str:
+        """The tenant label metrics may use: the real name up to the
+        cardinality cap, a single overflow bucket past it. Only a tenant
+        that actually ACQUIRED a slot claims a permanent label (claim=True,
+        from the completion path) — a junk-name flood that only sheds, or a
+        scrape-time read, must not squat the cap and demote later
+        legitimate tenants to the overflow bucket forever."""
+        if tenant in self._metric_tenants:
+            return tenant
+        if claim and len(self._metric_tenants) < self._max_metric_tenants:
+            self._metric_tenants.add(tenant)
+            return tenant
+        return "_overflow"
+
+    def queue_depths(self) -> dict[tuple[str, str, str], float]:
+        """(lane, tenant, priority) -> queued count; scrape-time gauge feed."""
+        depths: dict[tuple[str, str, str], float] = {}
+        for lane, state in self._lanes.items():
+            for ticket in state.tickets:
+                key = (
+                    str(lane),
+                    self._metric_tenant(ticket.tenant),
+                    ticket.priority,
+                )
+                depths[key] = depths.get(key, 0.0) + 1.0
+        return depths
+
+    # ----------------------------------------------------------- estimators
+
+    def observe_spawn(self, lane: int, seconds: float) -> None:
+        """Feed the spawn-latency EWMA (called beside the spawn histogram)."""
+        self._lane(lane).spawn_ewma.observe(max(0.0, seconds))
+
+    def estimated_wait(self, lane: int, *, pool_ready: int = 0) -> float:
+        """Expected seconds until a request submitted NOW would start:
+        the queue-wait EWMA while anything is queued, plus the spawn EWMA
+        while no warm sandbox is pooled. An empty lane with warm supply
+        estimates zero — pops are sub-millisecond."""
+        state = self._lane(lane)
+        if not state.tickets and pool_ready > 0:
+            return 0.0
+        estimate = state.queue_wait_ewma.get(0.0) if state.tickets else 0.0
+        if pool_ready <= 0:
+            estimate += state.spawn_ewma.get(0.0)
+        return estimate
+
+    def shed_retry_after(self, lane: int) -> float:
+        """Retry-After for a depth shed: per-request service estimate (EWMA
+        sum, floored while cold) times the lane's TOTAL queue depth — deeper
+        backlog, monotonically longer back-off."""
+        state = self._lane(lane)
+        per_request = max(
+            state.queue_wait_ewma.get(0.0) + state.spawn_ewma.get(0.0),
+            self.min_retry_after,
+        )
+        return len(state.tickets) * per_request
+
+    # ------------------------------------------------------------ admission
+
+    def submit(
+        self,
+        lane: int,
+        *,
+        tenant: str | None = None,
+        priority: str | None = None,
+        deadline: float | None = None,
+        pool_ready: int = 0,
+    ) -> Ticket:
+        """Admit one acquisition into the lane's queue, or shed it.
+
+        `deadline` is RELATIVE seconds ("must start within D"); `pool_ready`
+        is the lane's current warm-pool depth (admission estimate input).
+        Raises `QueueDepthError` (tenant depth bound), `DeadlineInfeasibleError`
+        (deadline < estimated wait), or `ValueError` (bad tenant/priority —
+        a client error, not capacity)."""
+        tenant = self.normalize_tenant(tenant)
+        priority = self.normalize_priority(priority)
+        # NaN would sail through every comparison below (NaN > x is always
+        # False), silently disabling deadline admission — reject it like any
+        # other malformed client input. +inf is fine: "no deadline".
+        if deadline is not None and (math.isnan(deadline) or deadline < 0):
+            raise ValueError("deadline must be a number >= 0 seconds")
+        state = self._lane(lane)
+        now = self.now()
+        tenant_depth = sum(1 for t in state.tickets if t.tenant == tenant)
+        if tenant_depth >= self.max_depth:
+            retry_after = self.shed_retry_after(lane)
+            self._count_shed(lane, tenant, priority, "depth")
+            raise QueueDepthError(
+                f"tenant {tenant!r} already has {tenant_depth} requests "
+                f"queued on lane {lane} (bound {self.max_depth}); retry in "
+                f"{retry_after:.0f}s",
+                lane=lane,
+                tenant=tenant,
+                retry_after=retry_after,
+            )
+        if deadline is not None:
+            estimate = self.estimated_wait(lane, pool_ready=pool_ready)
+            if estimate > deadline:
+                self._count_shed(lane, tenant, priority, "deadline")
+                raise DeadlineInfeasibleError(
+                    f"deadline {deadline:.1f}s cannot beat the estimated "
+                    f"lane-{lane} queue wait of {estimate:.1f}s; rejected at "
+                    "admission",
+                    lane=lane,
+                    tenant=tenant,
+                    retry_after=estimate,
+                )
+        weight = max(float(self.weights.get(tenant, 1.0)), 1e-3)
+        key = (tenant, priority)
+        start = max(state.vtime, state.last_finish.get(key, 0.0))
+        finish = start + 1.0 / weight
+        state.last_finish[key] = finish
+        ticket = Ticket(
+            lane=lane,
+            tenant=tenant,
+            priority=priority,
+            enqueued_at=now,
+            start_tag=start,
+            finish_tag=finish,
+            seq=next(self._seq),
+            deadline_at=None if deadline is None else now + deadline,
+        )
+        state.tickets.append(ticket)
+        # An empty-of-grants lane must always have an awake head so SOMEONE
+        # evaluates pool-vs-spawn; with a granted holder already out there,
+        # this ticket waits its fair turn.
+        if not any(t.granted for t in state.tickets if not t.done):
+            self._grant_next(state)
+        return ticket
+
+    def _count_shed(self, lane: int, tenant: str, priority: str, reason: str) -> None:
+        logger.warning(
+            "scheduler shed (lane=%d tenant=%s priority=%s reason=%s)",
+            lane,
+            tenant,
+            priority,
+            reason,
+        )
+        sheds = getattr(self.metrics, "scheduler_sheds", None)
+        if sheds is not None:
+            sheds.inc(
+                chip_count=str(lane),
+                tenant=self._metric_tenant(tenant),
+                priority=priority,
+                reason=reason,
+            )
+
+    # ---------------------------------------------------------------- grants
+
+    def _select(self, state: _LaneState) -> Ticket | None:
+        """The next ticket in fair order among the ungranted: interactive
+        before batch (bounded by the aging rule), WFQ finish tags within a
+        class, submission order as the final tiebreak."""
+        ungranted = [t for t in state.tickets if not t.granted and not t.done]
+        if not ungranted:
+            return None
+        interactive = [t for t in ungranted if t.priority == PRIORITY_INTERACTIVE]
+        batch = [t for t in ungranted if t.priority == PRIORITY_BATCH]
+        prefer_batch = bool(batch) and (
+            not interactive or state.interactive_run >= self.starvation_limit
+        )
+        candidates = batch if prefer_batch else (interactive or batch)
+        return min(candidates, key=lambda t: (t.finish_tag, t.seq))
+
+    def _grant_next(self, state: _LaneState) -> bool:
+        ticket = self._select(state)
+        if ticket is None:
+            return False
+        ticket.granted = True
+        ticket.event.set()
+        state.vtime = max(state.vtime, ticket.start_tag)
+        return True
+
+    def kick(self, lane: int) -> None:
+        """Capacity turnover on the lane (recycle landed, spawn finished,
+        dispose freed a slot): wake the next waiter in fair order. If every
+        queued ticket is already granted (mid-evaluation), remember the
+        signal — the next rearm() consumes it and stays awake."""
+        state = self._lanes.get(lane)
+        if state is None or not state.tickets:
+            return
+        if not self._grant_next(state):
+            state.pending_kicks += 1
+
+    def kick_all(self) -> None:
+        """Turnover whose freed capacity is shared across lanes (constrained
+        backends): wake every lane's next waiter."""
+        for lane in list(self._lanes):
+            self.kick(lane)
+
+    async def wait_grant(
+        self, ticket: Ticket, *, timeout_at: float | None = None
+    ) -> bool:
+        """Park until the ticket is granted. Returns False when `timeout_at`
+        (on the scheduler clock) passes first — the caller decides whether
+        that is its acquire budget (raise) or a re-evaluation wake (loop)."""
+        while not ticket.granted:
+            if timeout_at is None:
+                await ticket.event.wait()
+                continue
+            remaining = timeout_at - self.now()
+            if remaining <= 0:
+                return False
+            try:
+                await asyncio.wait_for(ticket.event.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                return False
+        return True
+
+    def rearm(self, ticket: Ticket) -> None:
+        """The granted holder found nothing (pool empty, must not spawn):
+        back to sleep, KEEPING its fair position — unless a turnover landed
+        mid-evaluation, in which case it stays awake to re-check."""
+        if ticket.done or not ticket.granted:
+            return
+        state = self._lane(ticket.lane)
+        if state.pending_kicks > 0:
+            state.pending_kicks -= 1
+            return
+        ticket.granted = False
+        ticket.event.clear()
+
+    # ------------------------------------------------------------ completion
+
+    def complete(self, ticket: Ticket) -> None:
+        """The holder is done waiting: it popped a sandbox or left to spawn
+        its own. Records the observed queue wait (the admission estimator's
+        feed) and passes the grant to the next waiter."""
+        self._finish(ticket, acquired=True)
+
+    def abandon(self, ticket: Ticket) -> None:
+        """The waiter errored or was cancelled: dequeue without polluting
+        the queue-wait estimate, and pass the grant along."""
+        self._finish(ticket, acquired=False)
+
+    def _finish(self, ticket: Ticket, *, acquired: bool) -> None:
+        if ticket.done:
+            return
+        ticket.done = True
+        state = self._lane(ticket.lane)
+        try:
+            state.tickets.remove(ticket)
+        except ValueError:
+            pass
+        was_granted = ticket.granted
+        if acquired:
+            # The aging counter moves on actual slot handoffs only: an
+            # interactive acquisition while batch still waits burns one of
+            # batch's patience slots; a batch acquisition resets them. A
+            # grant that went nowhere (rearm) or an abandoned waiter
+            # touches nothing — otherwise a net-zero-capacity kick at
+            # batch's turn would silently restart its whole waiting period.
+            batch_waiting = any(
+                t.priority == PRIORITY_BATCH for t in state.tickets
+            )
+            if ticket.priority == PRIORITY_INTERACTIVE and batch_waiting:
+                state.interactive_run += 1
+            elif ticket.priority == PRIORITY_BATCH:
+                state.interactive_run = 0
+            wait = max(0.0, self.now() - ticket.enqueued_at)
+            state.queue_wait_ewma.observe(wait)
+            tenant_label = self._metric_tenant(ticket.tenant, claim=True)
+            grants = getattr(self.metrics, "scheduler_grants", None)
+            if grants is not None:
+                grants.inc(
+                    chip_count=str(ticket.lane),
+                    tenant=tenant_label,
+                    priority=ticket.priority,
+                )
+            queue_wait = getattr(self.metrics, "scheduler_queue_wait", None)
+            if queue_wait is not None:
+                queue_wait.observe(
+                    wait,
+                    chip_count=str(ticket.lane),
+                    tenant=tenant_label,
+                    priority=ticket.priority,
+                )
+        if not state.tickets:
+            # Nobody left: stale turnover signals must not leak into the
+            # next burst (they would keep its head awake spuriously), and
+            # the WFQ tag table resets with the busy period — it must not
+            # accumulate one entry per tenant ever seen (unbounded under
+            # client-minted tenant names).
+            state.pending_kicks = 0
+            state.interactive_run = 0
+            state.last_finish.clear()
+        elif was_granted:
+            # The departing holder's wake "token" passes on: if it popped
+            # the pool there may be more supply behind it, and if it left to
+            # spawn, the next waiter must re-evaluate with the bumped spawn
+            # count. Either way the fair-order head must be awake.
+            self._grant_next(state)

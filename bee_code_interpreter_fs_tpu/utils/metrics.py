@@ -255,9 +255,30 @@ class ExecutorMetrics:
             "Requests failed fast because a lane's spawn circuit was open.",
             ("chip_count",),
         )
+        self.scheduler_queue_wait = self.registry.histogram(
+            "code_interpreter_scheduler_queue_wait_seconds",
+            "Seconds a request queued for a sandbox slot before its grant, "
+            "by lane, tenant, and priority class.",
+            ("chip_count", "tenant", "priority"),
+        )
+        self.scheduler_grants = self.registry.counter(
+            "code_interpreter_scheduler_grants_total",
+            "Sandbox-slot grants issued by the fair-share scheduler, by "
+            "lane, tenant, and priority class (the fairness observable: "
+            "under contention, per-tenant rates track configured weights).",
+            ("chip_count", "tenant", "priority"),
+        )
+        self.scheduler_sheds = self.registry.counter(
+            "code_interpreter_scheduler_sheds_total",
+            "Requests shed at admission (reason=depth: per-tenant queue "
+            "bound; reason=deadline: declared deadline cannot beat the "
+            "estimated queue wait).",
+            ("chip_count", "tenant", "priority", "reason"),
+        )
         self.pool_depth: Gauge | None = None
         self.active_sessions: Gauge | None = None
         self.breaker_state: Gauge | None = None
+        self.scheduler_queue_depth: Gauge | None = None
 
     def bind_pool(self, pools) -> None:
         """Expose warm-pool depth per chip-count lane, read at scrape time."""
@@ -284,6 +305,21 @@ class ExecutorMetrics:
             "code_interpreter_active_sessions",
             "Live executor_id sessions (sandboxes parked out of the pool).",
             (),
+            callback=sample,
+        )
+
+    def bind_scheduler(self, scheduler) -> None:
+        """Expose scheduler queue depth per lane x tenant x priority, read
+        at scrape time from the live queues."""
+
+        def sample() -> dict[tuple[str, ...], float]:
+            return dict(scheduler.queue_depths())
+
+        self.scheduler_queue_depth = self.registry.gauge(
+            "code_interpreter_scheduler_queue_depth",
+            "Requests currently queued for a sandbox slot, by lane, "
+            "tenant, and priority class.",
+            ("chip_count", "tenant", "priority"),
             callback=sample,
         )
 

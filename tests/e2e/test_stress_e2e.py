@@ -96,7 +96,9 @@ async def test_mixed_burst_races_pool_machinery(stack):
     assert sum(len(pool) for pool in executor._pools.values()) <= target
     assert all(v == 0 for v in executor._in_use.values())
     assert all(v == 0 for v in executor._spawning.values())
-    assert all(v == 0 for v in executor._waiting.values())
+    assert all(
+        executor.scheduler.queued(lane) == 0 for lane in executor._pools
+    )
 
 
 async def test_timeout_storm_recovers(stack):

@@ -98,6 +98,12 @@ class AbortRaised(Exception):
 class FakeContext:
     """Minimal grpc.aio context: abort raises (as the real one does)."""
 
+    def __init__(self, metadata=()):
+        self.metadata = tuple(metadata)
+
+    def invocation_metadata(self):
+        return self.metadata
+
     async def abort(self, code: grpc.StatusCode, details: str = "") -> None:
         raise AbortRaised(code, details)
 

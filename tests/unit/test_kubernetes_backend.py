@@ -428,3 +428,68 @@ async def test_non_tiling_chip_count_rejected_before_spawn(fake_kubectl, tmp_pat
         await executor.execute("print(1)", chip_count=6)
     assert calls() == []  # rejected before any kubectl traffic
     await executor.close()
+
+
+# ------------------------------------------- pod-watch breaker integration
+
+
+async def test_group_watch_failures_feed_lane_breaker(fake_kubectl):
+    """Satellite (ISSUE 2): multi-host pod-watch failures record one lane
+    strike PER failed host watch, the moment the watch fails — not one
+    aggregate strike when the whole group spawn surfaces."""
+    from bee_code_interpreter_fs_tpu.services.circuit_breaker import BreakerBoard
+
+    kubectl, state, _ = fake_kubectl
+    (state / "fail_wait").touch()  # every readiness watch fails
+    backend = _backend(kubectl, tpu_chips_per_host=4)
+    board = BreakerBoard(failure_threshold=100, cooldown=60.0)
+    backend.bind_breakers(board)
+    with pytest.raises(SandboxSpawnError):
+        await backend.spawn(chip_count=8)  # 2 hosts -> 2 failed watches
+    assert board.lane(8)._failures == 2
+
+
+async def test_single_host_watch_failure_leaves_strike_to_executor(fake_kubectl):
+    """Single-host spawns surface ONE SandboxSpawnError that the executor's
+    spawn ladder counts; the backend must not also record it (double
+    strike)."""
+    from bee_code_interpreter_fs_tpu.services.circuit_breaker import BreakerBoard
+
+    kubectl, state, _ = fake_kubectl
+    (state / "fail_wait").touch()
+    backend = _backend(kubectl)
+    board = BreakerBoard(failure_threshold=100, cooldown=60.0)
+    backend.bind_breakers(board)
+    with pytest.raises(SandboxSpawnError):
+        await backend.spawn(chip_count=0)
+    assert board.lane(0)._failures == 0
+
+
+async def test_pod_ip_watch_aborts_when_lane_opens(fake_kubectl):
+    """The coordinator pod-IP poll is breaker-aware: once the lane opens
+    (e.g. a sibling's failures crossed the threshold), the watch aborts
+    immediately instead of polling blind until its own timeout."""
+    from bee_code_interpreter_fs_tpu.services.circuit_breaker import BreakerBoard
+
+    kubectl, state, _ = fake_kubectl
+    backend = _backend(kubectl, executor_pod_ready_timeout=30.0)
+    board = BreakerBoard(failure_threshold=1, cooldown=60.0)
+    backend.bind_breakers(board)
+    board.lane(8).record_failure()  # opens at threshold 1
+    with pytest.raises(SandboxSpawnError, match="circuit opened"):
+        await backend._wait_pod_ip("nonexistent-pod", 8)
+
+
+async def test_fault_wrapper_passes_breakers_through(fake_kubectl):
+    from bee_code_interpreter_fs_tpu.services.backends.faults import (
+        FaultInjectingBackend,
+        FaultSpec,
+    )
+    from bee_code_interpreter_fs_tpu.services.circuit_breaker import BreakerBoard
+
+    kubectl, _, _ = fake_kubectl
+    inner = _backend(kubectl)
+    wrapped = FaultInjectingBackend(inner, FaultSpec.parse("seed:1"))
+    board = BreakerBoard()
+    wrapped.bind_breakers(board)
+    assert inner._breakers is board
