@@ -90,6 +90,14 @@ class Config(BaseModel):
 
     # -- storage ------------------------------------------------------------
     file_storage_path: str = "/tmp/tpu-code-interpreter/storage"
+    # Delta-based workspace sync (services/transfer.py): skip uploading
+    # files a sandbox host's manifest already holds, skip downloading files
+    # whose server-reported sha256 is already in content-addressed storage,
+    # and resync from GET /workspace-manifest when a sandbox's state is in
+    # doubt. Hosts running an old executor binary (no manifest endpoints)
+    # are detected per host and transparently get full transfers. Disable
+    # to force the legacy full-transfer path everywhere.
+    transfer_manifest_enabled: bool = True
 
     # -- execution ----------------------------------------------------------
     default_execution_timeout: float = 60.0

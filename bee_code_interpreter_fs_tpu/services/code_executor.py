@@ -11,8 +11,11 @@ backend-agnostic and TPU-aware:
   slice gets a sandbox whose warm runner already initialized that topology
   (kubernetes_code_executor.py:163-201 pooled only "a pod"; a TPU pool must
   pool "a topology" — SURVEY.md §2 census).
-- Input files upload in parallel, changed files download in parallel into
-  content-addressed Storage (dedup makes session round-trips cheap).
+- Workspace sync is delta-based (services/transfer.py): per-host SHA-256
+  manifests skip uploads the sandbox already holds and downloads whose
+  content is already in content-addressed Storage — a session turn with
+  unchanged input files moves O(1) bytes, not O(total bytes x hosts). Hosts
+  on an old executor binary transparently fall back to full transfers.
 - Infrastructure failures retry up to 3× with exponential backoff
   (kubernetes_code_executor.py:76-80); user-code failures never retry.
 - Per-request phase timings (queue-wait/upload/exec/download) are returned —
@@ -34,7 +37,11 @@ from ..config import Config
 from ..utils.logs import PhaseTimer
 from ..utils.metrics import ExecutorMetrics
 from ..utils.retrying import RetryPolicy, retry_async
-from ..utils.validation import OBJECT_ID_RE, normalize_workspace_path
+from ..utils.validation import (
+    OBJECT_ID_RE,
+    SHA256_HEX_RE,
+    normalize_workspace_path,
+)
 from .backends.base import Sandbox, SandboxBackend, SandboxSpawnError, num_hosts_for
 from .circuit_breaker import BreakerBoard
 from .errors import (  # noqa: F401 — canonical home is errors.py; re-exported
@@ -47,7 +54,13 @@ from .errors import (  # noqa: F401 — canonical home is errors.py; re-exported
     SessionLimitError,
 )
 from .scheduler import SandboxScheduler
-from .storage import Storage
+from .storage import Storage, StorageObjectNotFound
+from .transfer import (
+    HostManifest,
+    SandboxTransfer,
+    TransferStats,
+    parse_files_field,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -771,22 +784,10 @@ class CodeExecutor:
         # hosts rendezvous via their pre-established jax.distributed
         # mesh), and outputs merge with host-0 precedence.
         hosts = sandbox.host_urls
+        transfer = self._transfer_state(sandbox)
+        stats = TransferStats()
         with timer.phase("upload"):
-            # Validate ids up front (unknown id = client error, not an
-            # upload failure), then stream each object from storage per
-            # host — input files never fully buffer in control-plane
-            # memory (a multi-GB session file times N hosts would
-            # otherwise blow the heap).
-            for object_id in files.values():
-                if not await self.storage.exists(object_id):
-                    raise ValueError(f"unknown file object id: {object_id}")
-            await asyncio.gather(
-                *(
-                    self._upload_file(client, base, path, object_id)
-                    for base in hosts
-                    for path, object_id in files.items()
-                )
-            )
+            await self._upload_inputs(client, hosts, transfer, files, stats)
         with timer.phase("exec"):
             payload: dict = {"timeout": timeout}
             if env:
@@ -815,25 +816,9 @@ class CodeExecutor:
             if failure is not None:
                 raise failure
         with timer.phase("download"):
-            # Host 0 wins path conflicts (it is the coordinator and, per
-            # JAX convention, the process that does singular side
-            # effects); per-shard files unique to other hosts are still
-            # captured. Resolving the winner BEFORE downloading fetches
-            # each path exactly once — no N-way duplicate downloads, no
-            # orphaned storage objects.
-            winner: dict[str, str] = {}
-            for base, body in zip(hosts, bodies):
-                for rel in body.get("files", []):
-                    winner.setdefault(rel, base)
-            changed = await asyncio.gather(
-                *(
-                    self._download_file(client, base, rel)
-                    for rel, base in winner.items()
-                )
+            merged_files = await self._download_changed(
+                client, hosts, transfer, bodies, stats
             )
-        merged_files = {
-            f"/workspace/{rel}": object_id for rel, object_id in changed
-        }
         primary = bodies[0]
         stderr = primary.get("stderr", "")
         exit_code = int(primary.get("exit_code", -1))
@@ -846,12 +831,19 @@ class CodeExecutor:
                     f"[host {host_index}] {body['stderr']}"
                 )
         continuable = not any(bool(b.get("runner_restarted")) for b in bodies)
+        if not continuable:
+            # A runner was killed mid-request: stray user processes may have
+            # mutated the workspace after the post-execute scan, so the
+            # cached manifests are no longer trustworthy. Forget them; the
+            # next upload phase resyncs from GET /workspace-manifest.
+            transfer.invalidate()
+        stats.emit(self.metrics)
         result = Result(
             stdout=primary.get("stdout", ""),
             stderr=stderr,
             exit_code=exit_code,
             files=merged_files,
-            phases=timer.as_dict(),
+            phases={**timer.as_dict(), **stats.as_phases()},
             warm=bool(primary.get("warm", False)),
         )
         return result, continuable
@@ -973,6 +965,8 @@ class CodeExecutor:
         if session:
             self.metrics.session_executions.inc()
         for phase, seconds in result.phases.items():
+            if phase.endswith("_bytes"):
+                continue  # transfer byte counts ride in phases; not timings
             self.metrics.phase_seconds.observe(seconds, phase=phase)
 
     # --------------------------------------------------------------- sessions
@@ -1400,12 +1394,198 @@ class CodeExecutor:
                 f"sandbox {sandbox.id} ({base}) returned malformed JSON: {e}"
             )
 
-    async def _upload_file(
-        self, client: httpx.AsyncClient, base: str, path: str, object_id: str
+    def _transfer_state(self, sandbox: Sandbox) -> SandboxTransfer:
+        """The sandbox's per-host manifest cache, riding in `meta` so it
+        follows the sandbox through pool recycles and session parking."""
+        state = sandbox.meta.get("transfer")
+        if not isinstance(state, SandboxTransfer):
+            state = SandboxTransfer(
+                enabled=self.config.transfer_manifest_enabled
+            )
+            sandbox.meta["transfer"] = state
+        return state
+
+    async def _upload_inputs(
+        self,
+        client: httpx.AsyncClient,
+        hosts: list[str],
+        transfer: SandboxTransfer,
+        files: dict[str, str],
+        stats: TransferStats,
     ) -> None:
-        rel = normalize_workspace_path(path)
-        if rel.startswith("workspace/"):
-            rel = rel[len("workspace/") :]
+        """The upload phase, delta-based: validate each DISTINCT object id
+        exactly once (concurrently — `files` can map many paths to one id),
+        then per host skip every path whose (rel, sha) already matches the
+        manifest and stream only the rest. A session turn whose input files
+        are unchanged uploads nothing at all."""
+        rels: dict[str, str] = {}
+        for path, object_id in files.items():
+            rel = normalize_workspace_path(path)
+            if rel.startswith("workspace/"):
+                rel = rel[len("workspace/") :]
+            rels[rel] = object_id
+        unique_ids = sorted(set(rels.values()))
+
+        async def sized(object_id: str) -> int:
+            # size() doubles as the existence check — one stat per distinct
+            # id covers both validation and byte accounting.
+            try:
+                return await self.storage.size(object_id)
+            except StorageObjectNotFound:
+                raise ValueError(f"unknown file object id: {object_id}") from None
+
+        sizes = dict(
+            zip(
+                unique_ids,
+                await asyncio.gather(*(sized(i) for i in unique_ids)),
+            )
+        )
+        manifests = [transfer.host(base) for base in hosts]
+        # State in doubt (runner killed mid-request earlier, or a failed
+        # earlier resync): one manifest fetch per host — concurrently, like
+        # the uploads — beats full re-uploads. Failure just leaves the
+        # full-upload fallback.
+        await asyncio.gather(
+            *(
+                self._resync_manifest(client, base, manifest)
+                for base, manifest in zip(hosts, manifests)
+                if manifest.entries is None and manifest.supports is not False
+            )
+        )
+        uploads: list[tuple[str, str, str, HostManifest]] = []
+        for base, manifest in zip(hosts, manifests):
+            to_upload, skipped = manifest.delta(rels)
+            stats.upload_skipped_files += len(skipped)
+            stats.upload_skipped_bytes += sum(
+                sizes[object_id] for object_id in skipped.values()
+            )
+            uploads.extend(
+                (base, rel, object_id, manifest)
+                for rel, object_id in to_upload.items()
+            )
+        # Input files never fully buffer in control-plane memory (a multi-GB
+        # session file times N hosts would otherwise blow the heap).
+        await asyncio.gather(
+            *(
+                self._upload_file(client, base, rel, object_id, manifest)
+                for base, rel, object_id, manifest in uploads
+            )
+        )
+        stats.upload_files += len(uploads)
+        stats.upload_bytes += sum(
+            sizes[object_id] for _, _, object_id, _ in uploads
+        )
+
+    async def _resync_manifest(
+        self, client: httpx.AsyncClient, base: str, manifest: HostManifest
+    ) -> None:
+        """Recover a host's manifest from GET /workspace-manifest. A 404
+        proves an old binary (remembered; never probed again); any other
+        failure leaves the manifest unknown — full uploads now, retry on the
+        next request."""
+        try:
+            resp = await client.get(f"{base}/workspace-manifest")
+        except httpx.HTTPError:
+            return
+        if resp.status_code == 404:
+            manifest.mark_legacy()
+            return
+        if resp.status_code != 200:
+            return
+        try:
+            entries = resp.json().get("files", {})
+        except ValueError:
+            return
+        if isinstance(entries, dict):
+            manifest.resynced(
+                {
+                    rel: sha
+                    for rel, sha in entries.items()
+                    if isinstance(sha, str) and SHA256_HEX_RE.match(sha)
+                }
+            )
+
+    async def _download_changed(
+        self,
+        client: httpx.AsyncClient,
+        hosts: list[str],
+        transfer: SandboxTransfer,
+        bodies: list[dict],
+        stats: TransferStats,
+    ) -> dict[str, str]:
+        """The download phase, hash-negotiated: each host's reported files
+        fold into its manifest cache, then every changed path is fetched
+        exactly once — host 0 wins path conflicts (it is the coordinator
+        and, per JAX convention, the process that does singular side
+        effects), and a path whose sha already exists() in storage records
+        the mapping without moving bytes. A host answering without hashes
+        (old binary) is marked legacy and downloads fully, exactly as the
+        pre-manifest control plane did."""
+        winner: dict[str, tuple[str, str | None]] = {}
+        for base, body in zip(hosts, bodies):
+            entries, has_hashes = parse_files_field(body.get("files", []))
+            manifest = transfer.host(base)
+            if not has_hashes:
+                manifest.mark_legacy()
+            else:
+                deleted = body.get("deleted") or []
+                manifest.apply_execute_response(
+                    entries, deleted if isinstance(deleted, list) else []
+                )
+            for rel, sha in entries:
+                winner.setdefault(rel, (base, sha))
+        changed = await asyncio.gather(
+            *(
+                # The kill switch disables BOTH halves of the negotiation:
+                # with transfer off, reported shas are ignored and every
+                # changed file downloads fully, like the upload side.
+                self._fetch_changed(
+                    client, base, rel, sha if transfer.enabled else None, stats
+                )
+                for rel, (base, sha) in winner.items()
+            )
+        )
+        return {f"/workspace/{rel}": object_id for rel, object_id in changed}
+
+    async def _fetch_changed(
+        self,
+        client: httpx.AsyncClient,
+        base: str,
+        rel: str,
+        sha: str | None,
+        stats: TransferStats,
+    ) -> tuple[str, str]:
+        if sha is not None:
+            try:
+                size = await self.storage.size(sha)
+            except (StorageObjectNotFound, ValueError):
+                size = None
+            if size is not None:
+                # Hash negotiation: storage already holds these exact bytes
+                # (the object id IS the sha) — record the mapping, move none.
+                stats.download_skipped_files += 1
+                stats.download_skipped_bytes += size
+                return rel, sha
+        rel, object_id, size = await self._download_file(client, base, rel)
+        stats.download_files += 1
+        stats.download_bytes += size
+        return rel, object_id
+
+    async def _upload_file(
+        self,
+        client: httpx.AsyncClient,
+        base: str,
+        rel: str,
+        object_id: str,
+        manifest: HostManifest,
+    ) -> None:
+        # `If-None-Match: <sha of the body being sent>` lets the server skip
+        # the disk write (304) when the file already holds these bytes —
+        # e.g. a path re-uploaded after the control plane lost its cache.
+        # Old binaries ignore the header; legacy opaque ids can't claim one.
+        headers = {}
+        if manifest.supports is not False and SHA256_HEX_RE.match(object_id):
+            headers["If-None-Match"] = object_id
 
         async def stream():
             async with self.storage.reader(object_id) as reader:
@@ -1416,17 +1596,28 @@ class CodeExecutor:
                     yield data
 
         try:
-            resp = await client.put(f"{base}/workspace/{rel}", content=stream())
+            resp = await client.put(
+                f"{base}/workspace/{rel}", content=stream(), headers=headers
+            )
         except httpx.HTTPError as e:
-            raise ExecutorError(f"upload of {path} failed: {e}")
+            raise ExecutorError(f"upload of {rel} failed: {e}")
+        if resp.status_code == 304:
+            # Conditional hit: the host proved it already has this content.
+            manifest.record_upload(rel, object_id)
+            return
         if resp.status_code != 200:
             raise ExecutorError(
-                f"upload of {path} failed: {resp.status_code} {resp.text[:200]}"
+                f"upload of {rel} failed: {resp.status_code} {resp.text[:200]}"
             )
+        try:
+            sha = resp.json().get("sha256")
+        except ValueError:
+            sha = None
+        manifest.record_upload(rel, sha)
 
     async def _download_file(
         self, client: httpx.AsyncClient, base: str, rel: str
-    ) -> tuple[str, str]:
+    ) -> tuple[str, str, int]:
         try:
             async with self.storage.writer() as writer:
                 async with client.stream("GET", f"{base}/workspace/{rel}") as resp:
@@ -1439,7 +1630,7 @@ class CodeExecutor:
         except httpx.HTTPError as e:
             raise ExecutorError(f"download of {rel} failed: {e}")
         assert writer.hash is not None
-        return rel, writer.hash
+        return rel, writer.hash, writer.size
 
     async def _release(self, sandbox: Sandbox, lane: int, recyclable: bool) -> None:
         """Post-request sandbox release for pool-acquired sandboxes: turnover
@@ -1473,6 +1664,11 @@ class CodeExecutor:
                     recycled = await self.backend.reset(sandbox)
                 except Exception:  # noqa: BLE001 — recycle is best-effort
                     logger.exception("sandbox %s reset failed", sandbox.id)
+                if recycled is not None:
+                    # /reset wiped every host's workspace: the manifest
+                    # cache restarts empty-known for the next generation
+                    # (a stale entry would wrongly skip an upload).
+                    self._transfer_state(recycled).reset()
                 # Concurrent releases race the pool-short check above (all
                 # pass it before any appends) — re-check after the await and
                 # dispose the surplus, or a burst would leave the pool

@@ -236,6 +236,15 @@ class SandboxScheduler:
 
     # ----------------------------------------------------------- estimators
 
+    def queue_wait_ewmas(self) -> dict[int, float]:
+        """Per-lane smoothed queue wait (seconds) for the autoscaling-hint
+        gauge: the exact estimator deadline admission consults, refreshed on
+        every grant that actually acquired a slot."""
+        return {
+            lane: state.queue_wait_ewma.get(0.0)
+            for lane, state in self._lanes.items()
+        }
+
     def observe_spawn(self, lane: int, seconds: float) -> None:
         """Feed the spawn-latency EWMA (called beside the spawn histogram)."""
         self._lane(lane).spawn_ewma.observe(max(0.0, seconds))

@@ -4,9 +4,10 @@ Files flowing into/out of executions and through the ``/v1/files`` API live
 here, keyed by the SHA-256 of their content. This fixes the reference's lie
 (its docstring claims content addressing but names objects with
 ``secrets.token_hex(32)`` — src/code_interpreter/services/storage.py:36-52,
-SURVEY.md §0.3): real content addressing dedups the repeated file round-trips
-that stateless session persistence produces (the same unchanged file is
-re-uploaded on every Execute in a session).
+SURVEY.md §0.3): real content addressing is what makes the delta workspace
+sync possible — the object id IS the content sha, so the executor's
+per-workspace manifest (executor/server.cpp) and this store negotiate by
+hash and unchanged files never cross the wire twice (services/transfer.py).
 
 API shape parity: async streaming ``writer()``/``reader()`` context managers
 and whole-object ``write/read/exists/delete`` (storage.py:44-101), with ids

@@ -275,10 +275,53 @@ class ExecutorMetrics:
             "estimated queue wait).",
             ("chip_count", "tenant", "priority", "reason"),
         )
+        # Transfer observability: how many bytes the delta workspace sync
+        # actually moved vs. negotiated away. On a session turn with
+        # unchanged inputs the skipped counters move and the moved ones
+        # don't — that asymmetry IS the feature working.
+        byte_buckets = (
+            1024.0,
+            10240.0,
+            102400.0,
+            1048576.0,
+            10485760.0,
+            104857600.0,
+            1073741824.0,
+        )
+        self.transfer_bytes = self.registry.counter(
+            "code_interpreter_transfer_bytes_total",
+            "Workspace file bytes actually moved between control plane and "
+            "sandboxes, by direction (upload/download).",
+            ("direction",),
+        )
+        self.transfer_files = self.registry.counter(
+            "code_interpreter_transfer_files_total",
+            "Workspace files actually moved, by direction.",
+            ("direction",),
+        )
+        self.transfer_skipped_bytes = self.registry.counter(
+            "code_interpreter_transfer_skipped_bytes_total",
+            "Workspace file bytes NOT moved thanks to manifest delta "
+            "uploads / hash-negotiated downloads, by direction.",
+            ("direction",),
+        )
+        self.transfer_skipped_files = self.registry.counter(
+            "code_interpreter_transfer_skipped_files_total",
+            "Workspace files skipped by manifest/hash negotiation, "
+            "by direction.",
+            ("direction",),
+        )
+        self.transfer_phase_bytes = self.registry.histogram(
+            "code_interpreter_transfer_phase_bytes",
+            "Bytes moved per Execute per transfer phase (upload/download).",
+            ("phase",),
+            buckets=byte_buckets,
+        )
         self.pool_depth: Gauge | None = None
         self.active_sessions: Gauge | None = None
         self.breaker_state: Gauge | None = None
         self.scheduler_queue_depth: Gauge | None = None
+        self.scheduler_queue_wait_ewma: Gauge | None = None
 
     def bind_pool(self, pools) -> None:
         """Expose warm-pool depth per chip-count lane, read at scrape time."""
@@ -321,6 +364,25 @@ class ExecutorMetrics:
             "tenant, and priority class.",
             ("chip_count", "tenant", "priority"),
             callback=sample,
+        )
+
+        def ewma_sample() -> dict[tuple[str, ...], float]:
+            return {
+                (str(lane),): value
+                for lane, value in scheduler.queue_wait_ewmas().items()
+            }
+
+        # Autoscaling hint (ROADMAP follow-up): the same smoothed queue-wait
+        # the scheduler's deadline admission uses, exported per lane so an
+        # operator can scale the warm pool from queue pressure instead of
+        # eyeballing raw histogram quantiles. Updated on each grant.
+        self.scheduler_queue_wait_ewma = self.registry.gauge(
+            "scheduler_queue_wait_ewma_seconds",
+            "Exponentially weighted moving average of sandbox-slot queue "
+            "wait, by chip-count lane (the scheduler's own admission "
+            "estimator; updated on each grant).",
+            ("chip_count",),
+            callback=ewma_sample,
         )
 
     def bind_breakers(self, board) -> None:

@@ -310,6 +310,7 @@ class Conn {
     switch (status) {
       case 200: return "OK";
       case 201: return "Created";
+      case 304: return "Not Modified";
       case 400: return "Bad Request";
       case 403: return "Forbidden";
       case 404: return "Not Found";

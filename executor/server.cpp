@@ -20,7 +20,9 @@
 // APP_WORKSPACE (/workspace), APP_RUNTIME_PACKAGES (/runtime-packages),
 // APP_PYTHON (python3), APP_WARM_RUNNER (1), APP_WARM_EAGER (1; 0 = warm-up
 // waits for POST /warmup), APP_RUNNER_READY_TIMEOUT (180), APP_AUTO_INSTALL_DEPS
-// (0), APP_DEFAULT_TIMEOUT (60), APP_MAX_OUTPUT_BYTES (10485760).
+// (0), APP_DEFAULT_TIMEOUT (60), APP_MAX_OUTPUT_BYTES (10485760),
+// APP_WORKSPACE_MANIFEST (1; 0 = legacy wire format: no sha256 manifest,
+// plain-string `files` arrays, no /workspace-manifest route).
 
 #include <dirent.h>
 #include <fcntl.h>
@@ -46,6 +48,7 @@
 
 #include "http.hpp"
 #include "json.hpp"
+#include "sha256.hpp"
 
 // Runner session id, mirrored for the SIGTERM handler (async-signal-safe
 // cleanup): the runner lives in its own session, so killing the server's
@@ -221,6 +224,80 @@ std::vector<std::string> diff_snapshots(const std::map<std::string, FileSig>& be
     if (it == before.end() || !(it->second == sig)) changed.push_back(path);
   }
   return changed;
+}
+
+// ---------------------------------------------------------------------------
+// Workspace manifest: rel path -> content sha256, the executor half of the
+// delta transfer protocol. Uploads hash as they stream in; the post-execute
+// scan and GET /workspace-manifest rehash lazily — only entries whose
+// size/mtime signature no longer matches. Protected by its own mutex
+// (uploads are concurrent; /execute holds exec_mutex, which never nests
+// inside this one).
+
+struct ManifestEntry {
+  std::string sha;
+  FileSig sig;
+};
+
+std::map<std::string, ManifestEntry> g_ws_manifest;
+std::mutex g_ws_manifest_mutex;
+
+// Hashes one workspace file through the same race-free confined open the
+// transfer routes use (user code may have planted symlinks). Returns false
+// when the file vanished or cannot be read; `sig_out` gets the fstat
+// signature of the bytes actually hashed.
+bool hash_workspace_file(const std::string& workspace, const std::string& rel,
+                         std::string& hex_out, FileSig* sig_out) {
+  int fd = open_confined(workspace, rel, O_RDONLY, 0, /*create_dirs=*/false);
+  if (fd < 0) return false;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || !S_ISREG(st.st_mode)) {
+    close(fd);
+    return false;
+  }
+  minisha::Sha256 hasher;
+  char buf[1 << 16];
+  ssize_t n;
+  while ((n = read(fd, buf, sizeof(buf))) > 0) hasher.update(buf, static_cast<size_t>(n));
+  close(fd);
+  if (n < 0) return false;
+  hex_out = hasher.hex();
+  if (sig_out) {
+    *sig_out = FileSig{st.st_mtim.tv_sec * 1000000000LL + st.st_mtim.tv_nsec,
+                       st.st_size};
+  }
+  return true;
+}
+
+// Reconciles the manifest with the workspace as it exists NOW and returns
+// rel -> sha: entries whose signature still matches keep their cached sha,
+// changed/new files are rehashed, gone files are dropped. Caller must NOT
+// hold g_ws_manifest_mutex.
+std::map<std::string, std::string> manifest_snapshot(const std::string& workspace) {
+  std::map<std::string, FileSig> on_disk;
+  scan_dir(workspace, "", on_disk);
+  std::map<std::string, std::string> out;
+  std::lock_guard<std::mutex> lock(g_ws_manifest_mutex);
+  for (auto it = g_ws_manifest.begin(); it != g_ws_manifest.end();) {
+    if (on_disk.find(it->first) == on_disk.end()) {
+      it = g_ws_manifest.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  for (const auto& [rel, sig] : on_disk) {
+    auto it = g_ws_manifest.find(rel);
+    if (it != g_ws_manifest.end() && it->second.sig == sig) {
+      out[rel] = it->second.sha;
+      continue;
+    }
+    std::string hex;
+    FileSig fresh;
+    if (!hash_workspace_file(workspace, rel, hex, &fresh)) continue;
+    g_ws_manifest[rel] = ManifestEntry{hex, fresh};
+    out[rel] = hex;
+  }
+  return out;
 }
 
 // Recursively deletes everything INSIDE dfd (the dir itself survives — it is
@@ -605,6 +682,11 @@ struct ServerState {
   bool warm_enabled = true;
   bool warm_eager = true;  // start warm-up at boot (pods); 0 = wait for /warmup
   bool auto_install = false;
+  // Workspace-manifest protocol (delta transfers). 0 = legacy wire behavior:
+  // no sha256 hashing, plain-string `files` arrays, 404 on
+  // /workspace-manifest, If-None-Match ignored — exactly the pre-manifest
+  // binary, which is also how the control plane's fallback path is tested.
+  bool manifest_enabled = true;
   // Extra directories whose CONTENTS are wiped on /reset (colon-separated;
   // "~/x" = HOME-relative; missing dirs are fine). Closes the cross-
   // generation channels outside workspace/runtime-packages: the sandbox's
@@ -719,6 +801,41 @@ void handle_upload(const minihttp::Request& req, minihttp::Conn& conn) {
     conn.send_response(404, "application/json", "{\"error\":\"unknown prefix\"}");
     return;
   }
+  bool manifested = g_state.manifest_enabled && prefix == "workspace";
+  // Conditional upload: `If-None-Match: <sha256 of the body being sent>`.
+  // When the manifest says the file at `rel` already holds exactly that
+  // content (and the disk signature still matches — user code may have
+  // touched it since), the body is drained and skipped with a 304: no disk
+  // write, no rehash. On mismatch the PUT proceeds as a normal upload — the
+  // header is a claim about the body, so writing it is always correct.
+  std::string cond = req.header("if-none-match");
+  if (!cond.empty() && cond.front() == '"' && cond.back() == '"' && cond.size() >= 2)
+    cond = cond.substr(1, cond.size() - 2);
+  if (manifested && !cond.empty()) {
+    bool matches = false;
+    FileSig cached{0, 0};
+    {
+      std::lock_guard<std::mutex> lock(g_ws_manifest_mutex);
+      auto it = g_ws_manifest.find(rel);
+      if (it != g_ws_manifest.end() && it->second.sha == cond) {
+        matches = true;
+        cached = it->second.sig;
+      }
+    }
+    if (matches) {
+      struct stat st;
+      int fd = open_confined(*base, rel, O_RDONLY, 0, /*create_dirs=*/false);
+      bool fresh = fd >= 0 && fstat(fd, &st) == 0 && S_ISREG(st.st_mode) &&
+                   FileSig{st.st_mtim.tv_sec * 1000000000LL + st.st_mtim.tv_nsec,
+                           st.st_size} == cached;
+      if (fd >= 0) close(fd);
+      if (fresh) {
+        conn.drain_body();
+        conn.send_response(304, "application/json", "");
+        return;
+      }
+    }
+  }
   int fd = open_confined(*base, rel, O_WRONLY | O_CREAT | O_TRUNC, 0644,
                          /*create_dirs=*/true);
   if (fd < 0) {
@@ -728,11 +845,72 @@ void handle_upload(const minihttp::Request& req, minihttp::Conn& conn) {
                        "{\"error\":\"open failed (confined)\"}");
     return;
   }
-  size_t total = conn.read_body_to_fd(fd);
+  // Stream-hash while writing: the manifest learns the sha at upload time,
+  // so the post-execute scan never rehashes bytes the PUT already saw.
+  minisha::Sha256 hasher;
+  size_t total = 0;
+  try {
+    std::string chunk;
+    while (true) {
+      chunk.clear();
+      if (conn.read_body_some(chunk, 1 << 20) == 0) break;
+      if (manifested) hasher.update(chunk.data(), chunk.size());
+      size_t off = 0;
+      while (off < chunk.size()) {
+        ssize_t n = write(fd, chunk.data() + off, chunk.size() - off);
+        if (n < 0) {
+          if (errno == EINTR) continue;
+          close(fd);
+          conn.send_response(500, "application/json",
+                             "{\"error\":\"write failed\"}");
+          return;
+        }
+        off += static_cast<size_t>(n);
+      }
+      total += chunk.size();
+    }
+  } catch (...) {
+    // Client aborted mid-body (the control plane cancels sibling uploads
+    // when one fails): the connection is already doomed, but a long-lived
+    // warm sandbox must not leak one fd per aborted PUT until EMFILE.
+    close(fd);
+    throw;
+  }
+  struct stat st;
+  bool have_sig = fstat(fd, &st) == 0;
   close(fd);
   minijson::Object resp;
   resp["path"] = minijson::Value("/" + prefix + "/" + rel);
   resp["size"] = minijson::Value(static_cast<int64_t>(total));
+  if (manifested) {
+    std::string sha = hasher.hex();
+    if (have_sig) {
+      std::lock_guard<std::mutex> lock(g_ws_manifest_mutex);
+      g_ws_manifest[rel] = ManifestEntry{
+          sha,
+          FileSig{st.st_mtim.tv_sec * 1000000000LL + st.st_mtim.tv_nsec,
+                  st.st_size}};
+    }
+    resp["sha256"] = minijson::Value(sha);
+  }
+  conn.send_response(200, "application/json", minijson::Value(resp).dump());
+}
+
+// GET /workspace-manifest — the resync surface: the full rel -> sha256 map
+// of the workspace as it exists now (lazily rehashed). 404 when the
+// manifest protocol is disabled, which is what an old binary answers too —
+// the control plane treats both identically (full-transfer fallback).
+void handle_manifest(const minihttp::Request&, minihttp::Conn& conn) {
+  if (!g_state.manifest_enabled) {
+    conn.send_response(404, "application/json", "{\"error\":\"no route\"}");
+    return;
+  }
+  minijson::Object files;
+  for (const auto& [rel, sha] : manifest_snapshot(g_state.workspace)) {
+    files[rel] = minijson::Value(sha);
+  }
+  minijson::Object resp;
+  resp["files"] = minijson::Value(files);
   conn.send_response(200, "application/json", minijson::Value(resp).dump());
 }
 
@@ -1163,8 +1341,43 @@ void handle_execute_impl(minihttp::Conn& conn, bool streaming) {
   drop_scratch();
 
   minijson::Array files;
-  for (const auto& rel : diff_snapshots(before, after)) {
-    files.push_back(minijson::Value(rel));
+  minijson::Array deleted;
+  if (g_state.manifest_enabled) {
+    // Changed files carry their content sha so the control plane can skip
+    // downloading bytes its content-addressed storage already holds. The
+    // manifest is reconciled in the same pass: changed entries rehash (the
+    // mtime+size diff already singled them out — this is the "lazy" in lazy
+    // rehash), gone entries drop and are reported in `deleted` so a cached
+    // client manifest never claims a file the workspace lost.
+    std::lock_guard<std::mutex> mlock(g_ws_manifest_mutex);
+    for (auto it = g_ws_manifest.begin(); it != g_ws_manifest.end();) {
+      if (after.find(it->first) == after.end()) {
+        it = g_ws_manifest.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    for (const auto& rel : diff_snapshots(before, after)) {
+      minijson::Object entry;
+      entry["path"] = minijson::Value(rel);
+      std::string hex;
+      FileSig sig;
+      if (hash_workspace_file(g_state.workspace, rel, hex, &sig)) {
+        g_ws_manifest[rel] = ManifestEntry{hex, sig};
+        entry["sha256"] = minijson::Value(hex);
+      }
+      // Hash failure = the file vanished between scan and hash; the entry
+      // still reports the path (sans sha) and the download path surfaces
+      // the 404 exactly as the pre-manifest protocol did.
+      files.push_back(minijson::Value(entry));
+    }
+    for (const auto& [rel, sig] : before) {
+      if (after.find(rel) == after.end()) deleted.push_back(minijson::Value(rel));
+    }
+  } else {
+    for (const auto& rel : diff_snapshots(before, after)) {
+      files.push_back(minijson::Value(rel));
+    }
   }
 
   minijson::Object resp;
@@ -1172,6 +1385,7 @@ void handle_execute_impl(minihttp::Conn& conn, bool streaming) {
   resp["stderr"] = minijson::Value(err_s);
   resp["exit_code"] = minijson::Value(exit_code);
   resp["files"] = minijson::Value(files);
+  if (g_state.manifest_enabled) resp["deleted"] = minijson::Value(deleted);
   resp["duration_s"] = minijson::Value(duration);
   resp["warm"] = minijson::Value(ran_warm);
   // True when the warm runner was killed (timeout) or died during this
@@ -1287,6 +1501,12 @@ void handle_reset(const minihttp::Request&, minihttp::Conn& conn) {
       return;
     }
   }
+  // The workspace is empty now: a stale manifest would let a conditional
+  // upload from the NEXT generation 304 against content the wipe removed.
+  {
+    std::lock_guard<std::mutex> mlock(g_ws_manifest_mutex);
+    g_ws_manifest.clear();
+  }
   minijson::Value status = warm_status_body();
   status.as_object()["ok"] = minijson::Value(true);
   conn.send_response(200, "application/json", status.dump());
@@ -1301,6 +1521,8 @@ void route(const minihttp::Request& req, minihttp::Conn& conn) {
     handle_warmup(req, conn);
   } else if (req.method == "POST" && req.target == "/reset") {
     handle_reset(req, conn);
+  } else if (req.method == "GET" && req.target == "/workspace-manifest") {
+    handle_manifest(req, conn);
   } else if (req.method == "GET" && req.target == "/healthz") {
     handle_healthz(req, conn);
   } else if (req.method == "GET" && req.target == "/readyz") {
@@ -1344,6 +1566,7 @@ int main() {
   g_state.warm_enabled = env_flag("APP_WARM_RUNNER", true);
   g_state.warm_eager = env_flag("APP_WARM_EAGER", true);
   g_state.auto_install = env_flag("APP_AUTO_INSTALL_DEPS", false);
+  g_state.manifest_enabled = env_flag("APP_WORKSPACE_MANIFEST", true);
   {
     std::string dirs = env_or("APP_RESET_EXTRA_WIPE_DIRS", "");
     std::string home = env_or("HOME", "");

@@ -1,0 +1,173 @@
+#!/usr/bin/env python3
+"""Workspace-sync microbench: Execute latency and bytes moved for
+(a) a cold first session turn, (b) a session turn with unchanged input
+files, and (c) a turn with exactly one changed file.
+
+Drives the real local backend + C++ executor (warm JAX import off — this
+measures the transfer protocol, not TPU init) and reads the byte movement
+straight out of ``Result.phases``, which the delta sync populates. Emits a
+``BENCH_transfer.json`` blob::
+
+    {"config": {...}, "cold": {...}, "unchanged": {...}, "one_changed": {...},
+     "ok": true}
+
+The headline invariant (the ISSUE acceptance criterion): the unchanged turn
+moves ZERO upload bytes regardless of file count or size — O(1) wire cost,
+not O(total bytes x hosts) — and its skipped-bytes counters are nonzero
+while the cold turn's are zero. ``--smoke`` (CI) shrinks the file set and
+exits nonzero when any invariant breaks.
+
+Usage:
+    python scripts/bench_transfer.py [--files 16] [--bytes 65536]
+        [--repeats 3] [--out BENCH_transfer.json] [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import secrets
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+# The transfer bench must not fight a TPU plugin for the chip; everything
+# here is control-plane + wire mechanics.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+from bee_code_interpreter_fs_tpu.config import Config  # noqa: E402
+from bee_code_interpreter_fs_tpu.services.backends.local import (  # noqa: E402
+    LocalSandboxBackend,
+)
+from bee_code_interpreter_fs_tpu.services.code_executor import (  # noqa: E402
+    CodeExecutor,
+)
+from bee_code_interpreter_fs_tpu.services.storage import Storage  # noqa: E402
+
+
+def _phase_blob(result, wall_s: float) -> dict:
+    phases = result.phases
+    return {
+        "wall_s": round(wall_s, 4),
+        "upload_s": round(phases.get("upload", 0.0), 4),
+        "download_s": round(phases.get("download", 0.0), 4),
+        "upload_bytes": int(phases.get("upload_bytes", 0.0)),
+        "upload_skipped_bytes": int(phases.get("upload_skipped_bytes", 0.0)),
+        "download_bytes": int(phases.get("download_bytes", 0.0)),
+        "download_skipped_bytes": int(
+            phases.get("download_skipped_bytes", 0.0)
+        ),
+    }
+
+
+async def _timed_execute(executor, source, files, session) -> dict:
+    start = time.perf_counter()
+    result = await executor.execute(source, files=files, executor_id=session)
+    wall = time.perf_counter() - start
+    if result.exit_code != 0:
+        raise RuntimeError(f"bench execute failed: {result.stderr[:500]}")
+    return _phase_blob(result, wall)
+
+
+async def run_bench(num_files: int, file_bytes: int, repeats: int) -> dict:
+    tmp = tempfile.mkdtemp(prefix="bench-transfer-")
+    config = Config(
+        file_storage_path=f"{tmp}/storage",
+        local_sandbox_root=f"{tmp}/sandboxes",
+        executor_pod_queue_target_length=1,
+        jax_compilation_cache_dir="",
+        default_execution_timeout=120.0,
+    )
+    backend = LocalSandboxBackend(config, warm_import_jax=False)
+    executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
+    try:
+        files = {}
+        for i in range(num_files):
+            # Distinct random content per file: dedup must come from the
+            # manifest protocol, not accidentally-identical payloads.
+            object_id = await executor.storage.write(
+                secrets.token_bytes(file_bytes)
+            )
+            files[f"/workspace/input-{i:03d}.bin"] = object_id
+        changed_id = await executor.storage.write(secrets.token_bytes(file_bytes))
+        session = "bench-transfer"
+        source = "import glob; print(len(glob.glob('input-*.bin')))"
+
+        cold = await _timed_execute(executor, source, files, session)
+        unchanged_runs = [
+            await _timed_execute(executor, source, files, session)
+            for _ in range(max(1, repeats))
+        ]
+        one_changed_files = dict(files)
+        one_changed_files[f"/workspace/input-000.bin"] = changed_id
+        one_changed = await _timed_execute(
+            executor, source, one_changed_files, session
+        )
+
+        unchanged = min(unchanged_runs, key=lambda r: r["wall_s"])
+        total_bytes = num_files * file_bytes
+        checks = {
+            "cold_moves_all_bytes": cold["upload_bytes"] == total_bytes,
+            "cold_skips_nothing": cold["upload_skipped_bytes"] == 0,
+            "unchanged_moves_zero_bytes": unchanged["upload_bytes"] == 0,
+            "unchanged_skips_all_bytes": (
+                unchanged["upload_skipped_bytes"] == total_bytes
+            ),
+            "one_changed_moves_one_file": (
+                one_changed["upload_bytes"] == file_bytes
+                and one_changed["upload_skipped_bytes"]
+                == total_bytes - file_bytes
+            ),
+        }
+        return {
+            "metric": "workspace-sync bytes moved per session turn",
+            "config": {
+                "files": num_files,
+                "file_bytes": file_bytes,
+                "total_bytes": total_bytes,
+                "repeats": repeats,
+            },
+            "cold": cold,
+            "unchanged": unchanged,
+            "one_changed": one_changed,
+            "checks": checks,
+            "ok": all(checks.values()),
+        }
+    finally:
+        await executor.close()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--files", type=int, default=16)
+    parser.add_argument("--bytes", type=int, default=65536)
+    parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument("--out", default=str(REPO_ROOT / "BENCH_transfer.json"))
+    parser.add_argument(
+        "--smoke",
+        action="store_true",
+        help="tiny file set + hard-fail on invariant breakage (CI leg)",
+    )
+    args = parser.parse_args()
+    if args.smoke:
+        args.files = min(args.files, 4)
+        args.bytes = min(args.bytes, 8192)
+        args.repeats = 1
+    blob = asyncio.run(run_bench(args.files, args.bytes, args.repeats))
+    Path(args.out).write_text(json.dumps(blob, indent=2) + "\n")
+    print(json.dumps(blob))
+    if not blob["ok"]:
+        print("TRANSFER BENCH INVARIANT FAILED", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

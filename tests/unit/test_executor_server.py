@@ -88,6 +88,15 @@ def execute(client, source, **kwargs):
     return resp.json()
 
 
+def file_paths(result):
+    """Changed-file rel paths from an execute response. Manifest-enabled
+    binaries report [{"path", "sha256"}, ...]; legacy mode plain strings."""
+    return [
+        entry["path"] if isinstance(entry, dict) else entry
+        for entry in result["files"]
+    ]
+
+
 def test_healthz_warm(executor):
     client, _ = executor
     health = client.get("/healthz").json()
@@ -159,8 +168,8 @@ def test_execute_changed_files_recursive(executor):
         "open('deep/nested/new.txt', 'w').write('x')\nopen('top.txt', 'w').write('y')",
     )
     assert result["exit_code"] == 0
-    assert "deep/nested/new.txt" in result["files"]
-    assert "top.txt" in result["files"]
+    assert "deep/nested/new.txt" in file_paths(result)
+    assert "top.txt" in file_paths(result)
 
 
 def test_execute_timeout_cooperative_cancel(executor):
@@ -246,7 +255,7 @@ def test_execute_stream_chunks_arrive_live(executor):
     final = events[-1][1]
     assert final["exit_code"] == 0
     assert final["stdout"] == "tick 0\ntick 1\ntick 2\ntick 3\n"
-    assert "streamed.txt" in final["files"]
+    assert "streamed.txt" in file_paths(final)
     assert final["runner_restarted"] is False
     joined = "".join(c["data"] for c in chunks if c["stream"] == "stdout")
     assert joined == final["stdout"]
@@ -319,7 +328,7 @@ def test_execute_mixed_shell_python(executor):
     )
     assert result["exit_code"] == 0
     assert result["stdout"] == "marker-line\n42\n"
-    assert "shell_out.txt" in result["files"]
+    assert "shell_out.txt" in file_paths(result)
 
 
 def client_of(executor):

@@ -89,3 +89,20 @@ def test_executor_metrics_pool_binding():
     assert 'code_interpreter_pool_depth{chip_count="4"} 2' in text
     assert 'code_interpreter_executions_total{outcome="ok"} 1' in text
     assert "code_interpreter_sandbox_spawn_seconds_count" in text
+
+
+def test_scheduler_queue_wait_ewma_gauge():
+    """The autoscaling-hint gauge surfaces the scheduler's own per-lane
+    queue-wait EWMA (fed on each grant) at scrape time."""
+    from bee_code_interpreter_fs_tpu.config import Config
+    from bee_code_interpreter_fs_tpu.services.scheduler import SandboxScheduler
+
+    clock = [0.0]
+    scheduler = SandboxScheduler(Config(), clock=lambda: clock[0])
+    m = ExecutorMetrics()
+    m.bind_scheduler(scheduler)
+    ticket = scheduler.submit(4)
+    clock[0] = 2.5
+    scheduler.complete(ticket)  # records a 2.5s observed queue wait
+    text = m.registry.render()
+    assert 'scheduler_queue_wait_ewma_seconds{chip_count="4"} 2.5' in text

@@ -1,0 +1,403 @@
+"""Control-plane workspace-sync tests over an in-memory fake sandbox host
+(httpx.MockTransport via the backend's http_transport hook — the same seam
+the chaos transport uses). Covers the delta upload skip, conditional-PUT
+304 handling, hash-negotiated download skip, the old-binary full-transfer
+fallback, manifest invalidation + resync after a killed runner, manifest
+reset on pool recycle, and the deduped storage.exists fan-out.
+"""
+
+import asyncio
+import hashlib
+import json
+
+import httpx
+import pytest
+from fakes import FakeBackend
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.backends.base import Sandbox
+from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+
+class FakeSandboxHost:
+    """In-memory executor server speaking the manifest protocol (or the
+    legacy pre-manifest wire format with ``legacy=True``)."""
+
+    def __init__(self, legacy: bool = False):
+        self.legacy = legacy
+        self.files: dict[str, bytes] = {}
+        self.puts: list[str] = []
+        self.conditional_hits: list[str] = []
+        self.downloads: list[str] = []
+        self.manifest_gets = 0
+        self.execute_outputs: list[tuple[str, bytes]] = []
+        self.execute_deletes: list[str] = []
+        self.next_response: dict = {}
+
+    def _sha(self, rel: str) -> str:
+        return hashlib.sha256(self.files[rel]).hexdigest()
+
+    async def handler(self, request: httpx.Request) -> httpx.Response:
+        path = request.url.path
+        if request.method == "PUT" and path.startswith("/workspace/"):
+            rel = path[len("/workspace/") :]
+            body = await request.aread()
+            cond = request.headers.get("If-None-Match")
+            if (
+                not self.legacy
+                and cond
+                and rel in self.files
+                and self._sha(rel) == cond
+            ):
+                self.conditional_hits.append(rel)
+                return httpx.Response(304)
+            self.files[rel] = body
+            self.puts.append(rel)
+            payload: dict = {"path": f"/workspace/{rel}", "size": len(body)}
+            if not self.legacy:
+                payload["sha256"] = hashlib.sha256(body).hexdigest()
+            return httpx.Response(200, json=payload)
+        if request.method == "GET" and path == "/workspace-manifest":
+            self.manifest_gets += 1
+            if self.legacy:
+                return httpx.Response(404, json={"error": "no route"})
+            return httpx.Response(
+                200,
+                json={"files": {rel: self._sha(rel) for rel in self.files}},
+            )
+        if request.method == "GET" and path.startswith("/workspace/"):
+            rel = path[len("/workspace/") :]
+            if rel not in self.files:
+                return httpx.Response(404, json={"error": "not found"})
+            self.downloads.append(rel)
+            return httpx.Response(200, content=self.files[rel])
+        if request.method == "POST" and path == "/execute":
+            changed = []
+            for rel, data in self.execute_outputs:
+                self.files[rel] = data
+                changed.append(rel)
+            self.execute_outputs = []
+            deleted = []
+            for rel in self.execute_deletes:
+                self.files.pop(rel, None)
+                deleted.append(rel)
+            self.execute_deletes = []
+            if self.legacy:
+                files_field: list = changed
+            else:
+                files_field = [
+                    {"path": rel, "sha256": self._sha(rel)} for rel in changed
+                ]
+            body = {
+                "stdout": "ok\n",
+                "stderr": "",
+                "exit_code": 0,
+                "files": files_field,
+                "warm": True,
+                "runner_restarted": False,
+            }
+            if not self.legacy:
+                body["deleted"] = deleted
+            body.update(self.next_response)
+            self.next_response = {}
+            return httpx.Response(200, json=body)
+        if request.method == "POST" and path == "/reset":
+            self.files.clear()
+            return httpx.Response(200, json={"ok": True})
+        return httpx.Response(404, json={"error": "no route"})
+
+
+class TransferBackend(FakeBackend):
+    """FakeBackend whose sandbox HTTP lands on one FakeSandboxHost."""
+
+    def __init__(self, host: FakeSandboxHost, **kwargs):
+        super().__init__(**kwargs)
+        self.fake_host = host
+
+    def http_transport(self):
+        return httpx.MockTransport(self.fake_host.handler)
+
+    async def reset(self, sandbox):
+        recycled = await super().reset(sandbox)
+        if recycled is not None:
+            # Mirror the real /reset: generation turnover wipes the
+            # workspace (and with it the server-side manifest).
+            self.fake_host.files.clear()
+        return recycled
+
+
+def make_stack(tmp_path, legacy=False, **config_kwargs):
+    host = FakeSandboxHost(legacy=legacy)
+    backend = TransferBackend(host)
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        executor_pod_queue_target_length=1,
+        **config_kwargs,
+    )
+    executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
+    return executor, host, backend
+
+
+async def settle(executor):
+    for _ in range(3):
+        await asyncio.sleep(0)
+    tasks = list(executor._dispose_tasks) + list(executor._fill_tasks)
+    if tasks:
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+
+async def test_session_second_turn_skips_unchanged_uploads(tmp_path):
+    executor, host, _ = make_stack(tmp_path)
+    try:
+        object_id = await executor.storage.write(b"input payload")
+        files = {"/workspace/data.txt": object_id}
+        first = await executor.execute("x", files=files, executor_id="s1")
+        assert host.puts == ["data.txt"]
+        assert first.phases["upload_bytes"] == float(len(b"input payload"))
+        assert first.phases["upload_skipped_bytes"] == 0.0
+        second = await executor.execute("x", files=files, executor_id="s1")
+        # The unchanged file never hit the wire: same single historical PUT.
+        assert host.puts == ["data.txt"]
+        assert second.phases["upload_bytes"] == 0.0
+        assert second.phases["upload_skipped_bytes"] == float(
+            len(b"input payload")
+        )
+    finally:
+        await executor.close()
+
+
+async def test_changed_file_uploads_again(tmp_path):
+    executor, host, _ = make_stack(tmp_path)
+    try:
+        v1 = await executor.storage.write(b"version 1")
+        v2 = await executor.storage.write(b"version two")
+        await executor.execute(
+            "x", files={"/workspace/f.txt": v1}, executor_id="s2"
+        )
+        await executor.execute(
+            "x", files={"/workspace/f.txt": v2}, executor_id="s2"
+        )
+        assert host.puts == ["f.txt", "f.txt"]
+        assert host.files["f.txt"] == b"version two"
+    finally:
+        await executor.close()
+
+
+async def test_download_skipped_when_storage_has_content(tmp_path):
+    executor, host, _ = make_stack(tmp_path)
+    try:
+        known = b"already stored output"
+        object_id = await executor.storage.write(known)
+        host.execute_outputs = [("out.txt", known)]
+        result = await executor.execute("x", executor_id="s3")
+        # The changed file's sha was negotiated away: no GET, mapping only.
+        assert host.downloads == []
+        assert result.files == {"/workspace/out.txt": object_id}
+        assert result.phases["download_bytes"] == 0.0
+        assert result.phases["download_skipped_bytes"] == float(len(known))
+    finally:
+        await executor.close()
+
+
+async def test_download_fetches_novel_content(tmp_path):
+    executor, host, _ = make_stack(tmp_path)
+    try:
+        host.execute_outputs = [("novel.txt", b"never seen before")]
+        result = await executor.execute("x", executor_id="s4")
+        assert host.downloads == ["novel.txt"]
+        expected = hashlib.sha256(b"never seen before").hexdigest()
+        assert result.files == {"/workspace/novel.txt": expected}
+        assert await executor.storage.read(expected) == b"never seen before"
+        assert result.phases["download_bytes"] == float(
+            len(b"never seen before")
+        )
+    finally:
+        await executor.close()
+
+
+async def test_deleted_file_reuploads_next_turn(tmp_path):
+    """User code deleting an input file must invalidate the cached manifest
+    entry — the next turn with the same (rel, sha) re-uploads rather than
+    wrongly skipping against a file the workspace lost."""
+    executor, host, _ = make_stack(tmp_path)
+    try:
+        object_id = await executor.storage.write(b"comes and goes")
+        files = {"/workspace/g.txt": object_id}
+        await executor.execute("x", files=files, executor_id="s5")
+        host.execute_deletes = ["g.txt"]
+        # Turn 2 rightly skips the still-unchanged upload, then user code
+        # deletes the file; the reported deletion must evict the cache so
+        # turn 3 re-uploads instead of skipping against a missing file.
+        await executor.execute("x", files=files, executor_id="s5")
+        await executor.execute("x", files=files, executor_id="s5")
+        assert host.puts == ["g.txt", "g.txt"]
+        assert host.files["g.txt"] == b"comes and goes"
+    finally:
+        await executor.close()
+
+
+async def test_legacy_host_full_transfers_both_ways(tmp_path):
+    """Old-binary fallback: a host answering without hashes gets exactly the
+    pre-manifest behavior — every turn re-uploads, every changed file
+    re-downloads, and /workspace-manifest is never probed again."""
+    executor, host, _ = make_stack(tmp_path, legacy=True)
+    try:
+        object_id = await executor.storage.write(b"legacy input")
+        files = {"/workspace/in.txt": object_id}
+        stored = b"stored already"
+        await executor.storage.write(stored)
+        host.execute_outputs = [("out.txt", stored)]
+        first = await executor.execute("x", files=files, executor_id="s6")
+        # Even content storage already holds downloads fully (no hashes).
+        assert host.downloads == ["out.txt"]
+        assert first.phases["download_skipped_bytes"] == 0.0
+        host.execute_outputs = [("out.txt", stored)]
+        second = await executor.execute("x", files=files, executor_id="s6")
+        assert host.puts == ["in.txt", "in.txt"]
+        assert host.downloads == ["out.txt", "out.txt"]
+        assert second.phases["upload_skipped_bytes"] == 0.0
+        assert host.manifest_gets == 0  # legacy learned from PUT, never probed
+    finally:
+        await executor.close()
+
+
+async def test_config_kill_switch_disables_negotiation(tmp_path):
+    executor, host, _ = make_stack(tmp_path, transfer_manifest_enabled=False)
+    try:
+        object_id = await executor.storage.write(b"kill switch")
+        files = {"/workspace/k.txt": object_id}
+        # Output content already in storage: with the switch off it must
+        # STILL download fully (the switch covers both directions).
+        host.execute_outputs = [("k-out.txt", b"kill switch")]
+        first = await executor.execute("x", files=files, executor_id="s7")
+        await executor.execute("x", files=files, executor_id="s7")
+        assert host.puts == ["k.txt", "k.txt"]
+        assert host.manifest_gets == 0
+        assert host.downloads == ["k-out.txt"]
+        assert first.phases["download_skipped_bytes"] == 0.0
+    finally:
+        await executor.close()
+
+
+async def test_runner_kill_invalidates_then_resyncs(tmp_path):
+    """continuable=False poisons the cached manifests; the next upload phase
+    recovers them with ONE GET /workspace-manifest and the unchanged file is
+    skipped again instead of falling back to full uploads forever."""
+    executor, host, _ = make_stack(tmp_path)
+    try:
+        sandbox = Sandbox(id="sb-direct", url="http://fake")
+        object_id = await executor.storage.write(b"resync me")
+        files = {"/workspace/r.txt": object_id}
+        from bee_code_interpreter_fs_tpu.utils.logs import PhaseTimer
+
+        async def run(**kwargs):
+            return await executor._run_on_sandbox(
+                sandbox, "x", None, files, 30.0, None, PhaseTimer(), **kwargs
+            )
+
+        _, continuable = await run()
+        assert continuable and host.puts == ["r.txt"]
+        host.next_response = {"runner_restarted": True}
+        _, continuable = await run()
+        assert not continuable
+        state = executor._transfer_state(sandbox)
+        assert state.host("http://fake").entries is None
+        _, _ = await run()
+        assert host.manifest_gets == 1
+        # Resync proved the file still resident: no third PUT.
+        assert host.puts == ["r.txt"]
+        assert state.host("http://fake").entries is not None
+    finally:
+        await executor.close()
+
+
+async def test_pool_recycle_resets_manifest_cache(tmp_path):
+    """Generation turnover wipes the workspace server-side; the control
+    plane's cache must restart empty-known, so the next request re-uploads
+    (a stale skip would leave the new tenant without its input file)."""
+    executor, host, _ = make_stack(tmp_path)
+    try:
+        object_id = await executor.storage.write(b"per generation")
+        files = {"/workspace/p.txt": object_id}
+        await executor.execute("x", files=files)
+        await settle(executor)
+        await executor.execute("x", files=files)
+        await settle(executor)
+        assert host.puts == ["p.txt", "p.txt"]
+    finally:
+        await executor.close()
+
+
+async def test_exists_fanout_deduped_per_object_id(tmp_path):
+    executor, host, _ = make_stack(tmp_path)
+    try:
+        object_id = await executor.storage.write(b"one object, many paths")
+        calls = []
+        real_size = executor.storage.size
+
+        async def counting_size(oid):
+            calls.append(oid)
+            return await real_size(oid)
+
+        # Validation + byte accounting share one storage.size() pass.
+        executor.storage.size = counting_size
+        files = {
+            "/workspace/a.txt": object_id,
+            "/workspace/b.txt": object_id,
+            "/workspace/c.txt": object_id,
+        }
+        await executor.execute("x", files=files, executor_id="s8")
+        # One id, three paths: validated exactly once.
+        assert calls == [object_id]
+        assert sorted(host.puts) == ["a.txt", "b.txt", "c.txt"]
+    finally:
+        await executor.close()
+
+
+async def test_unknown_object_id_still_rejected(tmp_path):
+    executor, _, _ = make_stack(tmp_path)
+    try:
+        with pytest.raises(ValueError, match="unknown file object id"):
+            await executor.execute(
+                "x", files={"/workspace/a.txt": "f" * 64}, executor_id="s9"
+            )
+    finally:
+        await executor.close()
+
+
+async def test_failed_download_leaves_no_orphan_in_storage(tmp_path):
+    """Regression: _download_file raises on a non-200 INSIDE the
+    storage.writer() context — the writer's error path must unlink the temp
+    file, leaving neither a partial object nor .tmp litter behind."""
+    from bee_code_interpreter_fs_tpu.services.code_executor import ExecutorError
+
+    executor, host, _ = make_stack(tmp_path)
+    try:
+        client = executor._http_client()
+        with pytest.raises(ExecutorError, match="download of gone.txt failed: 404"):
+            await executor._download_file(client, "http://fake", "gone.txt")
+        storage = executor.storage
+        assert [p for p in storage.path.iterdir() if p.is_file()] == []
+        assert list(storage._tmp.iterdir()) == []
+    finally:
+        await executor.close()
+
+
+async def test_conditional_put_304_recorded_as_success(tmp_path):
+    """A cache-less control plane re-uploading resident content gets a 304
+    from the conditional header and treats it as a completed upload."""
+    executor, host, _ = make_stack(tmp_path)
+    try:
+        sandbox = Sandbox(id="sb-cond", url="http://fake")
+        object_id = await executor.storage.write(b"cond body")
+        state = executor._transfer_state(sandbox)
+        manifest = state.host("http://fake")
+        host.files["c.txt"] = b"cond body"  # resident server-side already
+        client = executor._http_client()
+        await executor._upload_file(client, "http://fake", "c.txt", object_id, manifest)
+        assert host.conditional_hits == ["c.txt"]
+        assert host.puts == []  # no write happened
+        assert manifest.entries == {"c.txt": object_id}
+    finally:
+        await executor.close()
