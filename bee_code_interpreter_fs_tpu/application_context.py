@@ -17,6 +17,7 @@ from .services.custom_tool_executor import CustomToolExecutor
 from .services.storage import Storage
 from .utils.logs import setup_logging
 from .utils.metrics import ExecutorMetrics
+from .utils.tracing import Tracer
 
 
 class ApplicationContext:
@@ -31,6 +32,13 @@ class ApplicationContext:
     @cached_property
     def metrics(self) -> ExecutorMetrics:
         return ExecutorMetrics()
+
+    @cached_property
+    def tracer(self) -> Tracer:
+        # One tracer for the whole process: API servers start root spans,
+        # the executor pipeline adds children, both share one sampling
+        # decision and one /traces ring.
+        return Tracer.from_config(self.config, metrics=self.metrics)
 
     @cached_property
     def backend(self) -> SandboxBackend:
@@ -64,7 +72,11 @@ class ApplicationContext:
     @cached_property
     def code_executor(self) -> CodeExecutor:
         return CodeExecutor(
-            self.backend, self.storage, self.config, metrics=self.metrics
+            self.backend,
+            self.storage,
+            self.config,
+            metrics=self.metrics,
+            tracer=self.tracer,
         )
 
     @cached_property

@@ -190,6 +190,25 @@ class Config(BaseModel):
     # "spawn_fail:0.3,seed:7" (grammar in services/backends/faults.py).
     # Empty = no injection. NEVER set in production.
     executor_fault_spec: str = ""
+    # -- tracing (utils/tracing.py) ------------------------------------------
+    # Request-scoped distributed traces: W3C `traceparent` accepted at the
+    # HTTP edge (`x-traceparent` metadata on gRPC) and propagated through
+    # the scheduler, transfer, and into the sandbox executor, whose
+    # install/exec/collect phase timings graft back in as child spans.
+    # APP_TRACING_ENABLED=0 disables the subsystem entirely (every span
+    # factory returns a shared no-op).
+    tracing_enabled: bool = True
+    # Head-based sampling for traces STARTED here (an incoming traceparent's
+    # sampled flag is always respected): 1.0 records everything, 0.0 records
+    # nothing while still propagating ids downstream.
+    tracing_sample_ratio: float = 1.0
+    # Finished spans retained in the in-memory ring (the GET /traces debug
+    # surface and the CI failure artifact). Bounded — this is the whole
+    # memory story for tracing.
+    tracing_ring_capacity: int = 4096
+    # Append-only JSONL span export (one span per line); empty = no file
+    # exporter. Write failure disables the exporter, never the request.
+    tracing_jsonl_path: str = ""
     # -- sandbox resource limits (local backend) ----------------------------
     # Extra address-space bytes user code may allocate beyond the warm
     # runner's baseline (soft RLIMIT_AS window in executor/runner.py): an

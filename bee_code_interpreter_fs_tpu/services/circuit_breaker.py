@@ -32,6 +32,7 @@ import logging
 import time
 from collections.abc import Callable
 
+from ..utils import tracing
 from .errors import CircuitOpenError
 
 logger = logging.getLogger(__name__)
@@ -94,6 +95,14 @@ class CircuitBreaker:
         if self.allow():
             return
         retry_after = self.retry_after()
+        # check() runs in the rejected request's context: the fail-fast
+        # decision lands on its trace (no-op untraced).
+        tracing.add_event(
+            "breaker.reject",
+            lane=self.name or (lane if lane is not None else ""),
+            failures=self._failures,
+            retry_after_s=round(retry_after, 3),
+        )
         raise CircuitOpenError(
             f"lane-{self.name or lane} spawn circuit is open after "
             f"{self._failures} consecutive failures; retry in "

@@ -34,9 +34,11 @@ from dataclasses import dataclass, field
 import httpx
 
 from ..config import Config
+from ..utils import tracing
 from ..utils.logs import PhaseTimer
 from ..utils.metrics import ExecutorMetrics
 from ..utils.retrying import RetryPolicy, retry_async
+from ..utils.tracing import Tracer
 from ..utils.validation import (
     OBJECT_ID_RE,
     SHA256_HEX_RE,
@@ -78,7 +80,9 @@ class Result:
     stderr: str
     exit_code: int
     files: dict[str, str]  # absolute workspace path -> storage object id
-    phases: dict[str, float] = field(default_factory=dict)
+    # Phase timings (seconds) + transfer byte counters, plus the request's
+    # trace_id (a string) when tracing sampled it.
+    phases: dict[str, float | str] = field(default_factory=dict)
     warm: bool = False
     # Session continuity (executor_id requests only; 0/False otherwise):
     # session_seq is this request's 1-based position in its session — a
@@ -118,11 +122,16 @@ class CodeExecutor:
         metrics: ExecutorMetrics | None = None,
         breakers: BreakerBoard | None = None,
         scheduler: SandboxScheduler | None = None,
+        tracer: Tracer | None = None,
     ) -> None:
         self.backend = backend
         self.storage = storage
         self.config = config or Config()
         self.metrics = metrics or ExecutorMetrics()
+        # Request-scoped tracing: the executor owns the tracer so both API
+        # servers (which create the root spans) and the pipeline stages here
+        # (which create children) share one sampling decision and one ring.
+        self.tracer = tracer or Tracer.from_config(self.config, metrics=self.metrics)
         # Per-lane spawn circuit breakers: fail fast (retryable) while the
         # backend is persistently failing instead of burning each request's
         # 300s acquire budget plus a full retry ladder (injectable for
@@ -376,6 +385,13 @@ class CodeExecutor:
 
         def on_retry(failures: int, error: BaseException, delay: float) -> None:
             self.metrics.retry_attempts.inc(operation="spawn")
+            tracing.add_event(
+                "retry",
+                operation="spawn",
+                attempt=failures,
+                delay_s=round(delay, 3),
+                error=str(error)[:200],
+            )
 
         return await retry_async(
             attempt, self._spawn_retry_policy, on_retry=on_retry
@@ -410,6 +426,29 @@ class CodeExecutor:
             await asyncio.gather(*(self._dispose(s) for s in evicted))
 
     async def _acquire(
+        self,
+        chip_count: int,
+        *,
+        tenant: str | None = None,
+        priority: str | None = None,
+        deadline: float | None = None,
+    ) -> Sandbox:
+        """Acquire a sandbox slot — `_acquire_slot` inside a trace span
+        carrying the admission attributes; the scheduler's enqueue/grant/
+        shed events and the breaker's rejections attach to this span."""
+        with self.tracer.span(
+            "scheduler.queue_wait",
+            attributes={
+                "lane": chip_count,
+                "tenant": tenant or self.scheduler.default_tenant,
+                "priority": priority or "interactive",
+            },
+        ):
+            return await self._acquire_slot(
+                chip_count, tenant=tenant, priority=priority, deadline=deadline
+            )
+
+    async def _acquire_slot(
         self,
         chip_count: int,
         *,
@@ -671,6 +710,13 @@ class CodeExecutor:
 
         def on_retry(failures: int, error: BaseException, delay: float) -> None:
             self.metrics.retry_attempts.inc(operation="execute")
+            tracing.add_event(
+                "retry",
+                operation="execute",
+                attempt=failures,
+                delay_s=round(delay, 3),
+                error=str(error)[:200],
+            )
 
         return await retry_async(
             lambda: self._execute_once(
@@ -787,7 +833,16 @@ class CodeExecutor:
         transfer = self._transfer_state(sandbox)
         stats = TransferStats()
         with timer.phase("upload"):
-            await self._upload_inputs(client, hosts, transfer, files, stats)
+            with self.tracer.span("transfer.upload") as upload_span:
+                await self._upload_inputs(client, hosts, transfer, files, stats)
+                upload_span.set_attribute("bytes_moved", stats.upload_bytes)
+                upload_span.set_attribute(
+                    "bytes_skipped", stats.upload_skipped_bytes
+                )
+                upload_span.set_attribute("files_moved", stats.upload_files)
+                upload_span.set_attribute(
+                    "files_skipped", stats.upload_skipped_files
+                )
         with timer.phase("exec"):
             payload: dict = {"timeout": timeout}
             if env:
@@ -798,11 +853,9 @@ class CodeExecutor:
                 payload["source_file"] = source_file
             bodies = await asyncio.gather(
                 *(
-                    self._post_execute_stream(
-                        client, base, payload, timeout, sandbox, emit
+                    self._call_host(
+                        client, index, base, payload, timeout, sandbox, emit
                     )
-                    if emit is not None and index == 0
-                    else self._post_execute(client, base, payload, timeout, sandbox)
                     for index, base in enumerate(hosts)
                 ),
                 # Let every host finish before surfacing a failure — a
@@ -816,9 +869,20 @@ class CodeExecutor:
             if failure is not None:
                 raise failure
         with timer.phase("download"):
-            merged_files = await self._download_changed(
-                client, hosts, transfer, bodies, stats
-            )
+            with self.tracer.span("transfer.download") as download_span:
+                merged_files = await self._download_changed(
+                    client, hosts, transfer, bodies, stats
+                )
+                download_span.set_attribute("bytes_moved", stats.download_bytes)
+                download_span.set_attribute(
+                    "bytes_skipped", stats.download_skipped_bytes
+                )
+                download_span.set_attribute(
+                    "files_moved", stats.download_files
+                )
+                download_span.set_attribute(
+                    "files_skipped", stats.download_skipped_files
+                )
         primary = bodies[0]
         stderr = primary.get("stderr", "")
         exit_code = int(primary.get("exit_code", -1))
@@ -838,12 +902,20 @@ class CodeExecutor:
             # next upload phase resyncs from GET /workspace-manifest.
             transfer.invalidate()
         stats.emit(self.metrics)
+        phases = {**timer.as_dict(), **stats.as_phases()}
+        # Correlate the response with its trace: clients quote this id at
+        # GET /traces/{trace_id} (it also rides the X-Trace-Id header and
+        # gRPC trailing metadata). A string among the float phase values —
+        # consumers that iterate phases numerically skip non-numbers.
+        trace_id = tracing.current_trace_id()
+        if trace_id is not None:
+            phases["trace_id"] = trace_id
         result = Result(
             stdout=primary.get("stdout", ""),
             stderr=stderr,
             exit_code=exit_code,
             files=merged_files,
-            phases={**timer.as_dict(), **stats.as_phases()},
+            phases=phases,
             warm=bool(primary.get("warm", False)),
         )
         return result, continuable
@@ -965,8 +1037,8 @@ class CodeExecutor:
         if session:
             self.metrics.session_executions.inc()
         for phase, seconds in result.phases.items():
-            if phase.endswith("_bytes"):
-                continue  # transfer byte counts ride in phases; not timings
+            if phase.endswith("_bytes") or not isinstance(seconds, (int, float)):
+                continue  # byte counts and the trace id ride in phases
             self.metrics.phase_seconds.observe(seconds, phase=phase)
 
     # --------------------------------------------------------------- sessions
@@ -1293,6 +1365,82 @@ class CodeExecutor:
         task.add_done_callback(self._fill_tasks.discard)
         return task
 
+    async def _call_host(
+        self,
+        client: httpx.AsyncClient,
+        index: int,
+        base: str,
+        payload: dict,
+        timeout: float,
+        sandbox: Sandbox,
+        emit,
+    ) -> dict:
+        """One host's /execute round-trip inside its own trace span. The
+        `traceparent` for the wire hop is read back out of the contextvar by
+        `_trace_headers` (keeping `_post_execute`'s signature stable — tests
+        monkeypatch it), and the sandbox's in-process phase timings come
+        back in the response's `trace` block and graft in as child spans."""
+        with self.tracer.span(
+            "executor.execute", attributes={"host": base, "host_index": index}
+        ) as span:
+            if emit is not None and index == 0:
+                body = await self._post_execute_stream(
+                    client, base, payload, timeout, sandbox, emit
+                )
+            else:
+                body = await self._post_execute(
+                    client, base, payload, timeout, sandbox
+                )
+            self._graft_sandbox_trace(span, base, body)
+            return body
+
+    def _trace_headers(self) -> dict | None:
+        """Headers propagating the current span's context to a sandbox (the
+        executor server echoes the value and stamps its phase timings into a
+        `trace` block). None when there is nothing to propagate."""
+        span = tracing.current_span()
+        if span is None:
+            return None
+        traceparent = span.traceparent()
+        if traceparent is None:
+            return None
+        return {"traceparent": traceparent}
+
+    def _graft_sandbox_trace(self, span, base: str, body) -> None:
+        """Fold a sandbox's reported per-phase timings (install/exec/collect,
+        measured in-process by executor/server.cpp) into the trace as
+        children of this host's executor.execute span. Offsets are relative
+        to the sandbox's own request start and are applied to THIS span's
+        start time, so cross-process clock skew never enters the math (the
+        child spans are guaranteed to nest inside the HTTP call window)."""
+        if not span.recording or not isinstance(body, dict):
+            return
+        block = body.get("trace")
+        entries = block.get("spans") if isinstance(block, dict) else None
+        if not isinstance(entries, list):
+            return
+        for entry in entries:
+            if not isinstance(entry, dict):
+                continue
+            name = entry.get("name")
+            offset = entry.get("start_offset_s")
+            duration = entry.get("duration_s")
+            if (
+                not isinstance(name, str)
+                or not name
+                or not isinstance(offset, (int, float))
+                or not isinstance(duration, (int, float))
+            ):
+                continue
+            self.tracer.record_span(
+                f"sandbox.{name}"[:64],
+                trace_id=span.trace_id,
+                parent_id=span.span_id,
+                start_unix=span.start_unix + max(0.0, float(offset)),
+                duration_s=float(duration),
+                attributes={"host": base},
+            )
+
     async def _post_execute_stream(
         self,
         client: httpx.AsyncClient,
@@ -1311,6 +1459,7 @@ class CodeExecutor:
                 "POST",
                 f"{base}/execute/stream",
                 json=payload,
+                headers=self._trace_headers(),
                 timeout=httpx.Timeout(timeout + 30.0, read=timeout + 30.0),
             ) as resp:
                 if resp.status_code == 403:
@@ -1376,6 +1525,7 @@ class CodeExecutor:
             resp = await client.post(
                 f"{base}/execute",
                 json=payload,
+                headers=self._trace_headers(),
                 timeout=httpx.Timeout(timeout + 30.0),
             )
         except httpx.HTTPError as e:

@@ -42,18 +42,14 @@ class CodeInterpreterServicer:
         self.code_executor = code_executor
         self.custom_tool_executor = custom_tool_executor
 
+    @property
+    def tracer(self):
+        return self.code_executor.tracer
+
     @staticmethod
-    async def _admission_from_metadata(
-        context: grpc.aio.ServicerContext,
-    ) -> dict:
-        """Tenant/priority/deadline for the fair-share scheduler, carried as
-        gRPC invocation metadata (`x-tenant`, `x-priority`,
-        `x-deadline-seconds`) — the transport-level analogue of the HTTP
-        surface's X-Tenant / X-Priority / X-Deadline-Seconds headers, so a
-        gateway can tag requests without touching the message. Value
-        validation (tenant charset, priority names) lives in the scheduler;
-        its ValueError maps to INVALID_ARGUMENT on the shared path."""
-        metadata = {}
+    def _metadata_dict(context) -> dict:
+        """Invocation metadata as a plain dict (first value wins)."""
+        metadata: dict = {}
         metadata_fn = getattr(context, "invocation_metadata", None)
         invocation_metadata = metadata_fn() if metadata_fn is not None else None
         if invocation_metadata:
@@ -66,6 +62,54 @@ class CodeInterpreterServicer:
                     else (entry[0], entry[1])
                 )
                 metadata.setdefault(key, value)
+        return metadata
+
+    def _begin_rpc(
+        self,
+        context,
+        *,
+        trace_name: str | None = None,
+        metadata: dict | None = None,
+    ) -> tuple[str, object]:
+        """Per-RPC correlation: a fresh request id (logging ContextVar) and,
+        for executing RPCs, a root trace span joined from `x-traceparent`
+        metadata (the transport-level analogue of the HTTP `traceparent`
+        header). Both ids are echoed in TRAILING metadata (`x-request-id` /
+        `x-trace-id`) — before this PR the gRPC request id existed only in
+        logs. Trailing (not initial) metadata so streaming RPCs carry it
+        too, and because it survives context.abort()."""
+        request_id = new_request_id()
+        span = None
+        if trace_name is not None:
+            metadata = metadata if metadata is not None else {}
+            span = self.tracer.start_trace(
+                trace_name,
+                traceparent=metadata.get("x-traceparent")
+                or metadata.get("traceparent"),
+                attributes={"request_id": request_id},
+            )
+        trailing = [("x-request-id", request_id)]
+        if span is not None and span.trace_id:
+            trailing.append(("x-trace-id", span.trace_id))
+        set_trailing = getattr(context, "set_trailing_metadata", None)
+        if set_trailing is not None:
+            set_trailing(tuple(trailing))
+        return request_id, span
+
+    @staticmethod
+    async def _admission_from_metadata(
+        context: grpc.aio.ServicerContext,
+        metadata: dict | None = None,
+    ) -> dict:
+        """Tenant/priority/deadline for the fair-share scheduler, carried as
+        gRPC invocation metadata (`x-tenant`, `x-priority`,
+        `x-deadline-seconds`) — the transport-level analogue of the HTTP
+        surface's X-Tenant / X-Priority / X-Deadline-Seconds headers, so a
+        gateway can tag requests without touching the message. Value
+        validation (tenant charset, priority names) lives in the scheduler;
+        its ValueError maps to INVALID_ARGUMENT on the shared path."""
+        if metadata is None:
+            metadata = CodeInterpreterServicer._metadata_dict(context)
         deadline = None
         raw = metadata.get("x-deadline-seconds")
         if raw is not None:
@@ -127,14 +171,66 @@ class CodeInterpreterServicer:
     async def Execute(
         self, request: pb2.ExecuteRequest, context: grpc.aio.ServicerContext
     ) -> pb2.ExecuteResponse:
-        request_id = new_request_id()
+        metadata = self._metadata_dict(context)
+        request_id, span = self._begin_rpc(
+            context, trace_name="grpc Execute", metadata=metadata
+        )
         logger.info("Execute [%s] chip_count=%d", request_id, request.chip_count)
-        has_code, has_file = await self._validate_execute_request(request, context)
-        admission = await self._admission_from_metadata(context)
-        # executor_id pattern validation lives in the executor (its
-        # ValueError maps to INVALID_ARGUMENT below, same as the HTTP path).
-        try:
-            result = await self.code_executor.execute(
+        with span:
+            has_code, has_file = await self._validate_execute_request(
+                request, context
+            )
+            admission = await self._admission_from_metadata(context, metadata)
+            # executor_id pattern validation lives in the executor (its
+            # ValueError maps to INVALID_ARGUMENT below, same as the HTTP
+            # path).
+            try:
+                result = await self.code_executor.execute(
+                    request.source_code if has_code else None,
+                    source_file=request.source_file if has_file else None,
+                    files=dict(request.files),
+                    timeout=request.timeout or None,
+                    env=dict(request.env) or None,
+                    chip_count=request.chip_count or None,
+                    profile=request.profile,
+                    executor_id=request.executor_id or None,
+                    **admission,
+                )
+            except ValueError as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except CircuitOpenError as e:
+                # Degraded mode (spawn circuit open): UNAVAILABLE, mirroring
+                # the HTTP layer's 503 shed — the health service reports
+                # NOT_SERVING over the same window. Distinct from
+                # RESOURCE_EXHAUSTED below, which means the service is
+                # healthy but capacity-capped.
+                await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+            except SessionLimitError as e:
+                # Retryable resource exhaustion, not a defect in the request.
+                await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+            except (ExecutorError, SandboxSpawnError) as e:
+                logger.exception("Execute failed [%s]", request_id)
+                await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+            return self._result_to_response(result)
+
+    async def ExecuteStream(
+        self, request: pb2.ExecuteRequest, context: grpc.aio.ServicerContext
+    ):
+        """Server-streaming Execute: OutputChunk events while the code runs,
+        then one `result` event (identical to Execute's response)."""
+        metadata = self._metadata_dict(context)
+        request_id, span = self._begin_rpc(
+            context, trace_name="grpc ExecuteStream", metadata=metadata
+        )
+        logger.info(
+            "ExecuteStream [%s] chip_count=%d", request_id, request.chip_count
+        )
+        with span:
+            has_code, has_file = await self._validate_execute_request(
+                request, context
+            )
+            admission = await self._admission_from_metadata(context, metadata)
+            events = self.code_executor.execute_stream(
                 request.source_code if has_code else None,
                 source_file=request.source_file if has_file else None,
                 files=dict(request.files),
@@ -145,71 +241,33 @@ class CodeInterpreterServicer:
                 executor_id=request.executor_id or None,
                 **admission,
             )
-        except ValueError as e:
-            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        except CircuitOpenError as e:
-            # Degraded mode (spawn circuit open): UNAVAILABLE, mirroring the
-            # HTTP layer's 503 shed — the health service reports NOT_SERVING
-            # over the same window. Distinct from RESOURCE_EXHAUSTED below,
-            # which means the service is healthy but capacity-capped.
-            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
-        except SessionLimitError as e:
-            # Retryable resource exhaustion, not a defect in the request.
-            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
-        except (ExecutorError, SandboxSpawnError) as e:
-            logger.exception("Execute failed [%s]", request_id)
-            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
-        return self._result_to_response(result)
-
-    async def ExecuteStream(
-        self, request: pb2.ExecuteRequest, context: grpc.aio.ServicerContext
-    ):
-        """Server-streaming Execute: OutputChunk events while the code runs,
-        then one `result` event (identical to Execute's response)."""
-        request_id = new_request_id()
-        logger.info(
-            "ExecuteStream [%s] chip_count=%d", request_id, request.chip_count
-        )
-        has_code, has_file = await self._validate_execute_request(request, context)
-        admission = await self._admission_from_metadata(context)
-        events = self.code_executor.execute_stream(
-            request.source_code if has_code else None,
-            source_file=request.source_file if has_file else None,
-            files=dict(request.files),
-            timeout=request.timeout or None,
-            env=dict(request.env) or None,
-            chip_count=request.chip_count or None,
-            profile=request.profile,
-            executor_id=request.executor_id or None,
-            **admission,
-        )
-        try:
-            async for event in events:
-                if "result" in event:
-                    yield pb2.ExecuteStreamEvent(
-                        result=self._result_to_response(event["result"])
-                    )
-                else:
-                    yield pb2.ExecuteStreamEvent(
-                        chunk=pb2.ExecuteStreamEvent.OutputChunk(
-                            stream=event.get("stream", ""),
-                            data=event.get("data", ""),
+            try:
+                async for event in events:
+                    if "result" in event:
+                        yield pb2.ExecuteStreamEvent(
+                            result=self._result_to_response(event["result"])
                         )
-                    )
-        except ValueError as e:
-            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        except CircuitOpenError as e:
-            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
-        except SessionLimitError as e:
-            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
-        except (ExecutorError, SandboxSpawnError) as e:
-            logger.exception("ExecuteStream failed [%s]", request_id)
-            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+                    else:
+                        yield pb2.ExecuteStreamEvent(
+                            chunk=pb2.ExecuteStreamEvent.OutputChunk(
+                                stream=event.get("stream", ""),
+                                data=event.get("data", ""),
+                            )
+                        )
+            except ValueError as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except CircuitOpenError as e:
+                await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+            except SessionLimitError as e:
+                await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+            except (ExecutorError, SandboxSpawnError) as e:
+                logger.exception("ExecuteStream failed [%s]", request_id)
+                await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
 
     async def CloseExecutor(
         self, request: pb2.CloseExecutorRequest, context: grpc.aio.ServicerContext
     ) -> pb2.CloseExecutorResponse:
-        new_request_id()
+        self._begin_rpc(context)
         if not OBJECT_ID_RE.match(request.executor_id):
             await context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
@@ -221,7 +279,7 @@ class CodeInterpreterServicer:
     async def ParseCustomTool(
         self, request: pb2.ParseCustomToolRequest, context: grpc.aio.ServicerContext
     ) -> pb2.ParseCustomToolResponse:
-        new_request_id()
+        self._begin_rpc(context)
         try:
             tool = self.custom_tool_executor.parse(request.tool_source_code)
         except CustomToolParseError as e:
@@ -239,53 +297,62 @@ class CodeInterpreterServicer:
     async def ExecuteCustomTool(
         self, request: pb2.ExecuteCustomToolRequest, context: grpc.aio.ServicerContext
     ) -> pb2.ExecuteCustomToolResponse:
-        request_id = new_request_id()
-        if request.timeout < 0:
-            await context.abort(
-                grpc.StatusCode.INVALID_ARGUMENT, "timeout must be >= 0"
-            )
-        try:
-            tool_input = json.loads(request.tool_input_json)
-        except json.JSONDecodeError:
-            await context.abort(
-                grpc.StatusCode.INVALID_ARGUMENT, "tool_input_json is not valid JSON"
-            )
-        try:
-            output, exec_result = await self.custom_tool_executor.execute_with_result(
-                request.tool_source_code,
-                tool_input,
-                executor_id=request.executor_id or None,
-                timeout=request.timeout or None,
-            )
-        except CustomToolParseError as e:
+        metadata = self._metadata_dict(context)
+        request_id, span = self._begin_rpc(
+            context, trace_name="grpc ExecuteCustomTool", metadata=metadata
+        )
+        with span:
+            if request.timeout < 0:
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, "timeout must be >= 0"
+                )
+            try:
+                tool_input = json.loads(request.tool_input_json)
+            except json.JSONDecodeError:
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    "tool_input_json is not valid JSON",
+                )
+            try:
+                output, exec_result = (
+                    await self.custom_tool_executor.execute_with_result(
+                        request.tool_source_code,
+                        tool_input,
+                        executor_id=request.executor_id or None,
+                        timeout=request.timeout or None,
+                    )
+                )
+            except CustomToolParseError as e:
+                return pb2.ExecuteCustomToolResponse(
+                    error=pb2.ExecuteCustomToolResponse.Error(
+                        stderr="\n".join(e.errors)
+                    )
+                )
+            except CustomToolExecuteError as e:
+                # Continuity on failure too (see proto Error comment).
+                return pb2.ExecuteCustomToolResponse(
+                    error=pb2.ExecuteCustomToolResponse.Error(
+                        stderr=e.stderr,
+                        session_seq=e.result.session_seq if e.result else 0,
+                        session_ended=e.result.session_ended if e.result else False,
+                    )
+                )
+            except ValueError as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except CircuitOpenError as e:
+                await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+            except SessionLimitError as e:
+                await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+            except (ExecutorError, SandboxSpawnError) as e:
+                logger.exception("ExecuteCustomTool failed [%s]", request_id)
+                await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             return pb2.ExecuteCustomToolResponse(
-                error=pb2.ExecuteCustomToolResponse.Error(stderr="\n".join(e.errors))
-            )
-        except CustomToolExecuteError as e:
-            # Continuity on failure too (see proto Error comment).
-            return pb2.ExecuteCustomToolResponse(
-                error=pb2.ExecuteCustomToolResponse.Error(
-                    stderr=e.stderr,
-                    session_seq=e.result.session_seq if e.result else 0,
-                    session_ended=e.result.session_ended if e.result else False,
+                success=pb2.ExecuteCustomToolResponse.Success(
+                    tool_output_json=json.dumps(output),
+                    session_seq=exec_result.session_seq,
+                    session_ended=exec_result.session_ended,
                 )
             )
-        except ValueError as e:
-            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        except CircuitOpenError as e:
-            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
-        except SessionLimitError as e:
-            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
-        except (ExecutorError, SandboxSpawnError) as e:
-            logger.exception("ExecuteCustomTool failed [%s]", request_id)
-            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
-        return pb2.ExecuteCustomToolResponse(
-            success=pb2.ExecuteCustomToolResponse.Success(
-                tool_output_json=json.dumps(output),
-                session_seq=exec_result.session_seq,
-                session_ended=exec_result.session_ended,
-            )
-        )
 
     def method_handlers(self) -> dict[str, grpc.RpcMethodHandler]:
         return {

@@ -23,7 +23,9 @@ import os
 from aiohttp import web
 from pydantic import BaseModel, Field, ValidationError
 
-from ..utils.logs import new_request_id
+from ..utils import tracing
+from ..utils.logs import new_request_id, request_id_var
+from ..utils.tracing import TRACE_ID_RE, Tracer
 from ..utils.validation import OBJECT_ID_RE
 from .backends.base import SandboxSpawnError
 from .code_executor import (
@@ -78,22 +80,88 @@ class ExecuteCustomToolRequest(BaseModel):
     timeout: float | None = Field(default=None, gt=0)
 
 
-@web.middleware
-async def request_id_middleware(request: web.Request, handler):
-    new_request_id()
-    return await handler(request)
-
-
 def create_http_app(
     code_executor: CodeExecutor,
     custom_tool_executor: CustomToolExecutor,
     storage: Storage,
+    tracer: Tracer | None = None,
 ) -> web.Application:
-    app = web.Application(middlewares=[request_id_middleware], client_max_size=256 * 2**20)
+    tracer = tracer or code_executor.tracer
+
+    @web.middleware
+    async def request_context_middleware(request: web.Request, handler):
+        """Per-request correlation: a fresh request id (logging ContextVar,
+        echoed as X-Request-Id — before this PR the id existed only in
+        logs), and for the business API a root trace span joined from the
+        client's `traceparent` header. Probes/scrapes (/healthz, /metrics)
+        and the trace-debug surface itself stay untraced."""
+        rid = new_request_id()
+        trace_ctx = None
+        if request.path.startswith("/v1/"):
+            # Span names must be a BOUNDED set (they label the span_seconds
+            # histogram): use the route template ("/v1/files/{hash}"), never
+            # the raw path — file hashes / executor ids / 404 garbage would
+            # mint a metric series each. The raw path rides as a span
+            # attribute instead (attributes never become metric labels).
+            resource = request.match_info.route.resource
+            canonical = resource.canonical if resource is not None else "unmatched"
+            trace_ctx = tracer.start_trace(
+                f"http {request.method} {canonical}",
+                traceparent=request.headers.get("traceparent"),
+                attributes={
+                    "http.method": request.method,
+                    "http.path": request.path,
+                    "request_id": rid,
+                },
+            )
+
+        def stamp(response) -> None:
+            # A prepared response (the NDJSON stream) already sent its
+            # headers; mutating them now would be a silent no-op at best.
+            if getattr(response, "prepared", False):
+                return
+            response.headers["X-Request-Id"] = rid
+            if trace_ctx is not None and trace_ctx.trace_id:
+                response.headers["X-Trace-Id"] = trace_ctx.trace_id
+                # Emit the context too (accept/emit symmetry): lets a
+                # caller that did NOT send a traceparent adopt the trace
+                # this service started for it.
+                header = trace_ctx.traceparent()
+                if header:
+                    response.headers["traceparent"] = header
+
+        if trace_ctx is None:
+            response = await handler(request)
+            stamp(response)
+            return response
+        with trace_ctx as span:
+            try:
+                response = await handler(request)
+            except web.HTTPException as e:
+                stamp(e)
+                raise
+            if span.recording:
+                span.set_attribute("http.status", response.status)
+                if response.status >= 500:
+                    span.status = "error"
+            stamp(response)
+            return response
+
+    app = web.Application(
+        middlewares=[request_context_middleware], client_max_size=256 * 2**20
+    )
     routes = web.RouteTableDef()
 
     def bad_request(message, **extra) -> web.Response:
         return web.json_response({"error": message, **extra}, status=400)
+
+    def with_trace_id(body: dict) -> dict:
+        """Error bodies carry the trace id too: a shed/degraded response is
+        exactly the request an operator wants to pull the trace for."""
+        trace_id = tracing.current_trace_id()
+        if trace_id is not None:
+            body["trace_id"] = trace_id
+        return body
 
     def shed(e: CircuitOpenError) -> web.Response:
         """Load-shedding response while a lane's breaker is open: 503 +
@@ -101,7 +169,7 @@ def create_http_app(
         service is healthy but THIS caller hit a capacity cap)."""
         retry_after = max(1, math.ceil(e.retry_after or 1.0))
         return web.json_response(
-            {"error": str(e), "degraded": True},
+            with_trace_id({"error": str(e), "degraded": True}),
             status=503,
             headers={"Retry-After": str(retry_after)},
         )
@@ -142,6 +210,43 @@ def create_http_app(
             charset="utf-8",
         )
 
+    @routes.get("/traces")
+    async def recent_traces(request: web.Request) -> web.Response:
+        """Debug surface: newest traces still in the in-memory ring
+        (trace id, root span, span count, errors). `?limit=` caps rows."""
+        try:
+            limit = int(request.query.get("limit", "20"))
+        except ValueError:
+            return bad_request("limit must be an integer")
+        return web.json_response(
+            {
+                "enabled": tracer.enabled,
+                "sample_ratio": tracer.sample_ratio,
+                "traces": tracer.ring.recent(limit=max(0, min(limit, 200))),
+            }
+        )
+
+    @routes.get("/traces/{trace_id}")
+    async def get_trace(request: web.Request) -> web.Response:
+        """One trace's retained spans in start order. `?format=jsonl` gets
+        the export format (one span per line) instead of the JSON tree."""
+        trace_id = request.match_info["trace_id"].lower()
+        if not TRACE_ID_RE.match(trace_id):
+            return bad_request("invalid trace id (want 32 hex chars)")
+        spans = tracer.ring.trace(trace_id)
+        if not spans:
+            return web.json_response(
+                {"error": "trace not found (expired from the ring, "
+                          "unsampled, or never existed)"},
+                status=404,
+            )
+        if request.query.get("format") == "jsonl":
+            return web.Response(
+                text=tracer.ring.export_jsonl(trace_id),
+                content_type="application/x-ndjson",
+            )
+        return web.json_response({"trace_id": trace_id, "spans": spans})
+
     def validate_execute(req: ExecuteRequest) -> web.Response | None:
         """Shared /v1/execute + /v1/execute/stream pre-flight checks."""
         if (req.source_code is None) == (req.source_file is None):
@@ -181,7 +286,9 @@ def create_http_app(
         retry_after = getattr(e, "retry_after", 0.0)
         if retry_after:
             headers["Retry-After"] = str(max(1, math.ceil(retry_after)))
-        return web.json_response({"error": str(e)}, status=429, headers=headers)
+        return web.json_response(
+            with_trace_id({"error": str(e)}), status=429, headers=headers
+        )
 
     def add_session_fields(body: dict, result, executor_id: str | None) -> dict:
         """Session continuity, one rule for every surface: seq==1 on a
@@ -256,9 +363,14 @@ def create_http_app(
             executor_id=req.executor_id,
             **admission_params(request, req),
         )
-        response = web.StreamResponse(
-            status=200, headers={"Content-Type": "application/x-ndjson"}
-        )
+        # Correlation headers must land BEFORE prepare() on a stream (the
+        # middleware can only stamp unprepared responses).
+        stream_headers = {"Content-Type": "application/x-ndjson"}
+        stream_headers["X-Request-Id"] = request_id_var.get()
+        trace_id = tracing.current_trace_id()
+        if trace_id is not None:
+            stream_headers["X-Trace-Id"] = trace_id
+        response = web.StreamResponse(status=200, headers=stream_headers)
         # Chunked implicitly (no Content-Length); flush per event so clients
         # see output with the code's own cadence.
         started = False

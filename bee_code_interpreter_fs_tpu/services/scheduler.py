@@ -56,6 +56,7 @@ from collections.abc import Callable
 from dataclasses import dataclass, field
 
 from ..config import Config
+from ..utils import tracing
 from .errors import DeadlineInfeasibleError, QueueDepthError
 
 logger = logging.getLogger(__name__)
@@ -340,6 +341,15 @@ class SandboxScheduler:
             deadline_at=None if deadline is None else now + deadline,
         )
         state.tickets.append(ticket)
+        # submit() runs in the requesting task's context, so the event lands
+        # on that request's scheduler span (no-op when untraced).
+        tracing.add_event(
+            "scheduler.enqueue",
+            lane=lane,
+            tenant=tenant,
+            priority=priority,
+            queue_depth=len(state.tickets),
+        )
         # An empty-of-grants lane must always have an awake head so SOMEONE
         # evaluates pool-vs-spawn; with a granted holder already out there,
         # this ticket waits its fair turn.
@@ -348,6 +358,13 @@ class SandboxScheduler:
         return ticket
 
     def _count_shed(self, lane: int, tenant: str, priority: str, reason: str) -> None:
+        tracing.add_event(
+            "scheduler.shed",
+            lane=lane,
+            tenant=tenant,
+            priority=priority,
+            reason=reason,
+        )
         logger.warning(
             "scheduler shed (lane=%d tenant=%s priority=%s reason=%s)",
             lane,
@@ -463,6 +480,15 @@ class SandboxScheduler:
             pass
         was_granted = ticket.granted
         if acquired:
+            # complete() runs in the granted holder's own context — the
+            # grant event lands on that request's scheduler span.
+            tracing.add_event(
+                "scheduler.grant",
+                lane=ticket.lane,
+                tenant=ticket.tenant,
+                priority=ticket.priority,
+                wait_s=round(max(0.0, self.now() - ticket.enqueued_at), 6),
+            )
             # The aging counter moves on actual slot handoffs only: an
             # interactive acquisition while batch still waits burns one of
             # batch's patience slots; a batch acquisition resets them. A

@@ -317,6 +317,17 @@ class ExecutorMetrics:
             ("phase",),
             buckets=byte_buckets,
         )
+        # Tracing's per-stage latency feed: every sampled span's duration,
+        # labeled by span name (a bounded set — http/grpc entry, scheduler
+        # wait, transfer phases, executor call, sandbox install/exec/
+        # collect), so stage histograms exist even for operators who never
+        # open an individual trace.
+        self.span_seconds = self.registry.histogram(
+            "code_interpreter_span_seconds",
+            "Trace-span latency by stage (utils/tracing.py; sampled "
+            "requests only).",
+            ("span",),
+        )
         self.pool_depth: Gauge | None = None
         self.active_sessions: Gauge | None = None
         self.breaker_state: Gauge | None = None

@@ -159,7 +159,10 @@ async def run_gflops(
                 "run": i,
                 "execute_wall_s": round(elapsed, 3),
                 "array_type": backend_line.split(":", 1)[1].strip(),
-                "phases": {k: round(v, 4) for k, v in result.phases.items()},
+                "phases": {
+                    k: round(v, 4) if isinstance(v, (int, float)) else v
+                    for k, v in result.phases.items()
+                },
             }
             log(f"run {i}: {gflops:.3f} GFLOPS ({info['array_type']})")
             samples.append(gflops)

@@ -1157,7 +1157,22 @@ RunOutcome run_user_code(const std::string& script_path,
   return out;
 }
 
-void handle_execute_impl(minihttp::Conn& conn, bool streaming) {
+void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
+                         bool streaming) {
+  // W3C trace context from the control plane: when present, per-phase
+  // timings (install/exec/collect) are stamped into a `trace` block on the
+  // response so the orchestrator can graft them into the request's trace
+  // as child spans. Offsets are relative to this request's own start — the
+  // two processes' clocks never have to agree.
+  std::string traceparent = req.header("traceparent");
+  struct timespec t_req;
+  clock_gettime(CLOCK_MONOTONIC, &t_req);
+  auto since_req = [&t_req]() {
+    struct timespec now;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    return (now.tv_sec - t_req.tv_sec) + (now.tv_nsec - t_req.tv_nsec) / 1e9;
+  };
+
   std::string body = conn.read_body();
   minijson::Value parsed;
   try {
@@ -1182,13 +1197,40 @@ void handle_execute_impl(minihttp::Conn& conn, bool streaming) {
   // Per-request scratch dir: holds the script (source_code mode) and the
   // stdout/stderr capture files. Never inside the workspace — capture files
   // must not appear in the changed-file diff. Honors TMPDIR so sandboxes
-  // with a private scratch tmp (local backend) keep everything inside it.
-  std::string tmpl_s = env_or("TMPDIR", "/tmp") + "/exec-XXXXXX";
+  // with a private scratch tmp (local backend) keep everything inside it —
+  // but an unwritable/missing TMPDIR (operator typo, container without the
+  // mount) falls back to /tmp with a logged warning instead of failing
+  // every request opaquely at mkdtemp.
+  std::string tmpdir = env_or("TMPDIR", "/tmp");
+  if (tmpdir != "/tmp" && access(tmpdir.c_str(), W_OK | X_OK) != 0) {
+    log_msg("TMPDIR %s is not writable (%s); falling back to /tmp",
+            tmpdir.c_str(), strerror(errno));
+    tmpdir = "/tmp";
+  }
+  std::string tmpl_s = tmpdir + "/exec-XXXXXX";
   std::vector<char> tmpl(tmpl_s.begin(), tmpl_s.end());
   tmpl.push_back('\0');
   if (!mkdtemp(tmpl.data())) {
-    conn.send_response(500, "application/json", "{\"error\":\"mkdtemp failed\"}");
-    return;
+    int saved = errno;
+    if (tmpdir != "/tmp") {
+      // A last-resort retry: the writability probe can race a deletion, or
+      // the filesystem can reject mkdtemp for reasons access() can't see.
+      log_msg("mkdtemp in %s failed (%s); retrying under /tmp", tmpdir.c_str(),
+              strerror(saved));
+      tmpl_s = "/tmp/exec-XXXXXX";
+      tmpl.assign(tmpl_s.begin(), tmpl_s.end());
+      tmpl.push_back('\0');
+    }
+    if (tmpdir == "/tmp" || !mkdtemp(tmpl.data())) {
+      saved = errno;
+      minijson::Object err;
+      err["error"] = minijson::Value(
+          std::string("cannot create scratch dir under ") + tmpdir + ": " +
+          strerror(saved) + " (check TMPDIR)");
+      conn.send_response(500, "application/json",
+                         minijson::Value(err).dump());
+      return;
+    }
   }
   std::string scratch(tmpl.data());
   std::string script_path;
@@ -1215,14 +1257,20 @@ void handle_execute_impl(minihttp::Conn& conn, bool streaming) {
     }
   }
 
+  // Phase timings for the trace block: install (dependency auto-install +
+  // pre-exec workspace snapshot), exec (user code), collect (post-exec
+  // snapshot + output read + manifest reconcile).
+  double install_start = since_req();
   maybe_install_deps(script_path);
 
   std::map<std::string, FileSig> before;
   scan_dir(g_state.workspace, "", before);
+  double install_s = since_req() - install_start;
 
   std::string stdout_path = scratch + "/cap.stdout";
   std::string stderr_path = scratch + "/cap.stderr";
 
+  double exec_start = since_req();
   struct timespec t0, t1;
   clock_gettime(CLOCK_MONOTONIC, &t0);
 
@@ -1320,6 +1368,7 @@ void handle_execute_impl(minihttp::Conn& conn, bool streaming) {
   double duration =
       (t1.tv_sec - t0.tv_sec) + (t1.tv_nsec - t0.tv_nsec) / 1e9;
 
+  double collect_start = since_req();
   std::map<std::string, FileSig> after;
   scan_dir(g_state.workspace, "", after);
 
@@ -1387,6 +1436,29 @@ void handle_execute_impl(minihttp::Conn& conn, bool streaming) {
   resp["files"] = minijson::Value(files);
   if (g_state.manifest_enabled) resp["deleted"] = minijson::Value(deleted);
   resp["duration_s"] = minijson::Value(duration);
+  if (!traceparent.empty()) {
+    // The control plane sent trace context: report per-phase timings so it
+    // can graft them into the request's trace as child spans. Offsets are
+    // seconds since THIS request started on this host (the grafter anchors
+    // them to its own span start — no cross-process clock agreement).
+    double collect_s = since_req() - collect_start;
+    minijson::Object trace;
+    trace["traceparent"] = minijson::Value(traceparent);
+    minijson::Array trace_spans;
+    auto add_span = [&trace_spans](const char* name, double start_offset,
+                                   double dur) {
+      minijson::Object s;
+      s["name"] = minijson::Value(std::string(name));
+      s["start_offset_s"] = minijson::Value(start_offset);
+      s["duration_s"] = minijson::Value(dur);
+      trace_spans.push_back(minijson::Value(s));
+    };
+    add_span("install", install_start, install_s);
+    add_span("exec", exec_start, duration);
+    add_span("collect", collect_start, collect_s);
+    trace["spans"] = minijson::Value(trace_spans);
+    resp["trace"] = minijson::Value(trace);
+  }
   resp["warm"] = minijson::Value(ran_warm);
   // True when the warm runner was killed (timeout) or died during this
   // request: its in-process state is gone and a rewarm is in flight. The
@@ -1407,13 +1479,13 @@ void handle_execute_impl(minihttp::Conn& conn, bool streaming) {
   }
 }
 
-void handle_execute(const minihttp::Request& /*req*/, minihttp::Conn& conn) {
-  handle_execute_impl(conn, /*streaming=*/false);
+void handle_execute(const minihttp::Request& req, minihttp::Conn& conn) {
+  handle_execute_impl(req, conn, /*streaming=*/false);
 }
 
-void handle_execute_stream(const minihttp::Request& /*req*/,
+void handle_execute_stream(const minihttp::Request& req,
                            minihttp::Conn& conn) {
-  handle_execute_impl(conn, /*streaming=*/true);
+  handle_execute_impl(req, conn, /*streaming=*/true);
 }
 
 minijson::Value warm_status_body() {

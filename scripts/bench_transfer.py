@@ -17,6 +17,11 @@ not O(total bytes x hosts) — and its skipped-bytes counters are nonzero
 while the cold turn's are zero. ``--smoke`` (CI) shrinks the file set and
 exits nonzero when any invariant breaks.
 
+A second gate (ISSUE 4) measures TRACING overhead on the same unchanged-turn
+path: the p50 with tracing enabled at 0% sampling must stay within 5% of the
+tracing-disabled p50 (the no-op fast path really is a no-op); the 100%
+number is recorded for reference.
+
 Usage:
     python scripts/bench_transfer.py [--files 16] [--bytes 65536]
         [--repeats 3] [--out BENCH_transfer.json] [--smoke]
@@ -29,6 +34,7 @@ import asyncio
 import json
 import os
 import secrets
+import statistics
 import sys
 import tempfile
 import time
@@ -76,17 +82,99 @@ async def _timed_execute(executor, source, files, session) -> dict:
     return _phase_blob(result, wall)
 
 
-async def run_bench(num_files: int, file_bytes: int, repeats: int) -> dict:
-    tmp = tempfile.mkdtemp(prefix="bench-transfer-")
+def _make_executor(tmp: str, **config_overrides) -> CodeExecutor:
     config = Config(
         file_storage_path=f"{tmp}/storage",
         local_sandbox_root=f"{tmp}/sandboxes",
         executor_pod_queue_target_length=1,
         jax_compilation_cache_dir="",
         default_execution_timeout=120.0,
+        **config_overrides,
     )
     backend = LocalSandboxBackend(config, warm_import_jax=False)
-    executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
+    return CodeExecutor(backend, Storage(config.file_storage_path), config)
+
+
+class _OverheadStack:
+    """One config leg of the tracing-overhead probe: a fresh executor stack
+    plus its own session and input set. Traced legs wrap every execute in a
+    root span, because without one the pipeline's child spans no-op
+    regardless of sampling and the comparison would measure nothing."""
+
+    def __init__(self, label: str, **config_overrides) -> None:
+        self.label = label
+        self.config_overrides = config_overrides
+        self.samples: list[float] = []
+        self.executor: CodeExecutor | None = None
+        self.files: dict[str, str] = {}
+
+    async def start(self, num_files: int, file_bytes: int) -> None:
+        tmp = tempfile.mkdtemp(prefix=f"bench-tracing-{self.label}-")
+        self.executor = _make_executor(tmp, **self.config_overrides)
+        for i in range(num_files):
+            object_id = await self.executor.storage.write(
+                secrets.token_bytes(file_bytes)
+            )
+            self.files[f"/workspace/input-{i:03d}.bin"] = object_id
+
+    async def turn(self, record: bool) -> None:
+        with self.executor.tracer.start_trace("bench unchanged-turn"):
+            start = time.perf_counter()
+            result = await self.executor.execute(
+                "import glob; print(len(glob.glob('input-*.bin')))",
+                files=self.files,
+                executor_id="bench-tracing",
+            )
+            wall = time.perf_counter() - start
+        if result.exit_code != 0:
+            raise RuntimeError(f"bench execute failed: {result.stderr[:500]}")
+        if record:
+            self.samples.append(wall)
+
+    def p50(self) -> float:
+        return statistics.median(self.samples)
+
+
+async def tracing_overhead_bench(
+    num_files: int, file_bytes: int, repeats: int
+) -> dict:
+    """ISSUE 4 satellite: unchanged-turn p50 with tracing disabled vs
+    enabled@0% vs enabled@100%. The gate: 0% sampling must be free — within
+    5% of disabled (plus a 5ms epsilon so sub-ms scheduler jitter on a
+    ~50ms path cannot flake CI). The three legs are INTERLEAVED turn by
+    turn, not run back to back: machine-load drift between sequential legs
+    otherwise swamps the very overhead being measured."""
+    stacks = [
+        _OverheadStack("off", tracing_enabled=False),
+        _OverheadStack("s0", tracing_sample_ratio=0.0),
+        _OverheadStack("s100", tracing_sample_ratio=1.0),
+    ]
+    try:
+        for stack in stacks:
+            await stack.start(num_files, file_bytes)
+            await stack.turn(record=False)  # the cold upload turn
+        for _ in range(max(5, repeats)):
+            for stack in stacks:
+                await stack.turn(record=True)
+    finally:
+        for stack in stacks:
+            if stack.executor is not None:
+                await stack.executor.close()
+    off, sampled_0, sampled_100 = (s.p50() for s in stacks)
+    gate = off * 1.05 + 0.005
+    return {
+        "metric": "tracing overhead on the unchanged-turn path (p50 seconds)",
+        "disabled_p50_s": round(off, 4),
+        "sampling_0_p50_s": round(sampled_0, 4),
+        "sampling_100_p50_s": round(sampled_100, 4),
+        "gate_p50_s": round(gate, 4),
+        "checks": {"sampling_0_within_5pct_of_disabled": sampled_0 <= gate},
+    }
+
+
+async def run_bench(num_files: int, file_bytes: int, repeats: int) -> dict:
+    tmp = tempfile.mkdtemp(prefix="bench-transfer-")
+    executor = _make_executor(tmp)
     try:
         files = {}
         for i in range(num_files):
@@ -112,6 +200,7 @@ async def run_bench(num_files: int, file_bytes: int, repeats: int) -> dict:
         )
 
         unchanged = min(unchanged_runs, key=lambda r: r["wall_s"])
+        tracing = await tracing_overhead_bench(num_files, file_bytes, repeats)
         total_bytes = num_files * file_bytes
         checks = {
             "cold_moves_all_bytes": cold["upload_bytes"] == total_bytes,
@@ -137,8 +226,9 @@ async def run_bench(num_files: int, file_bytes: int, repeats: int) -> dict:
             "cold": cold,
             "unchanged": unchanged,
             "one_changed": one_changed,
+            "tracing": tracing,
             "checks": checks,
-            "ok": all(checks.values()),
+            "ok": all(checks.values()) and all(tracing["checks"].values()),
         }
     finally:
         await executor.close()

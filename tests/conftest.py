@@ -96,3 +96,21 @@ def tmp_storage(tmp_path):
     from bee_code_interpreter_fs_tpu.services.storage import Storage
 
     return Storage(tmp_path / "storage")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """CI post-mortem for seeded chaos legs: when CHAOS_TRACE_EXPORT names a
+    path and the run FAILED, dump the tracing flight recorder (every span
+    any tracer exported this process, bounded ring) as JSONL so the workflow
+    can upload it as an artifact — a red seed is then diagnosable without
+    re-running locally."""
+    path = os.environ.get("CHAOS_TRACE_EXPORT")
+    if not path or exitstatus == 0:
+        return
+    try:
+        from bee_code_interpreter_fs_tpu.utils.tracing import GLOBAL_RING
+
+        Path(path).write_text(GLOBAL_RING.export_jsonl())
+        print(f"\n[chaos] exported {len(GLOBAL_RING)} trace spans to {path}")
+    except Exception as error:  # noqa: BLE001 — diagnostics must not mask the failure
+        print(f"\n[chaos] trace export failed: {error}")

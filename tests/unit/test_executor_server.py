@@ -746,3 +746,94 @@ def test_allocation_bomb_gets_memoryerror_not_host_oom(tmp_path):
     finally:
         proc.kill()
         proc.wait()
+
+
+TRACEPARENT = "00-" + "a" * 32 + "-" + "b" * 16 + "-01"
+
+
+def test_execute_trace_block_with_traceparent(executor):
+    """A traceparent header makes the response carry a `trace` block: the
+    echoed context plus install/exec/collect phase spans with offsets
+    relative to the request's own start (ISSUE 4 tentpole — the control
+    plane grafts these into the request's trace as child spans)."""
+    client, ws = executor
+    result = client.post(
+        "/execute",
+        json={"source_code": "print('traced')"},
+        headers={"traceparent": TRACEPARENT},
+    ).json()
+    assert result["exit_code"] == 0
+    trace = result["trace"]
+    assert trace["traceparent"] == TRACEPARENT
+    spans = {s["name"]: s for s in trace["spans"]}
+    assert set(spans) == {"install", "exec", "collect"}
+    for span in spans.values():
+        assert span["start_offset_s"] >= 0
+        assert span["duration_s"] >= 0
+    # Phases run in order: install, then exec, then collect.
+    assert spans["install"]["start_offset_s"] <= spans["exec"]["start_offset_s"]
+    assert spans["exec"]["start_offset_s"] <= spans["collect"]["start_offset_s"]
+    # The exec span is the duration_s the response already reported.
+    assert spans["exec"]["duration_s"] == result["duration_s"]
+
+
+def test_execute_no_trace_block_without_traceparent(executor):
+    """No trace context, no trace block — the wire format is unchanged for
+    untraced callers (and old control planes)."""
+    client, ws = executor
+    result = execute(client, "print('untraced')")
+    assert "trace" not in result
+
+
+def test_execute_stream_trace_block(executor):
+    """The streaming surface's final event carries the same trace block."""
+    client, ws = executor
+    with client.stream(
+        "POST",
+        "/execute/stream",
+        json={"source_code": "print('streamed')"},
+        headers={"traceparent": TRACEPARENT},
+    ) as resp:
+        assert resp.status_code == 200
+        lines = [json.loads(l) for l in resp.iter_lines() if l.strip()]
+    final = lines[-1]
+    assert final["exit_code"] == 0
+    assert final["trace"]["traceparent"] == TRACEPARENT
+    assert {s["name"] for s in final["trace"]["spans"]} == {
+        "install",
+        "exec",
+        "collect",
+    }
+
+
+def test_unwritable_tmpdir_falls_back_to_tmp(tmp_path):
+    """ISSUE 4 satellite: a bogus TMPDIR (operator typo, missing mount)
+    must not fail every request opaquely at mkdtemp — the server falls back
+    to /tmp with a logged warning and keeps serving."""
+    ws = tmp_path / "ws"
+    rp = tmp_path / "rp"
+    ws.mkdir()
+    rp.mkdir()
+    env = _server_env(ws, rp)
+    env["TMPDIR"] = str(tmp_path / "does-not-exist")
+    proc = subprocess.Popen(
+        [str(BINARY)], env=env, stdout=subprocess.PIPE, stderr=None
+    )
+    try:
+        line = proc.stdout.readline().decode()
+        port = int(re.search(r"port=(\d+)", line).group(1))
+        with httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=30.0) as c:
+            for _ in range(200):
+                if c.get("/healthz").json().get("warm"):
+                    break
+                time.sleep(0.1)
+            else:
+                pytest.fail("runner never warmed")
+            result = c.post(
+                "/execute", json={"source_code": "print('fallback ok')"}
+            ).json()
+            assert result["exit_code"] == 0, result
+            assert result["stdout"] == "fallback ok\n"
+    finally:
+        proc.kill()
+        proc.wait()

@@ -1,0 +1,303 @@
+"""Unit tests for the first-party tracing subsystem (utils/tracing.py):
+W3C traceparent handling, contextvar span nesting, head-based sampling,
+exporters, and the no-op fast paths the 0%-overhead gate depends on.
+"""
+
+import asyncio
+import json
+import random
+
+from bee_code_interpreter_fs_tpu.utils import tracing
+from bee_code_interpreter_fs_tpu.utils.tracing import (
+    GLOBAL_RING,
+    NOOP,
+    JsonlExporter,
+    TraceRing,
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+)
+
+TRACE_ID = "a" * 32
+SPAN_ID = "b" * 16
+
+
+def make_tracer(**kwargs):
+    kwargs.setdefault("ring", TraceRing(64))
+    return Tracer(**kwargs)
+
+
+# ------------------------------------------------------------- traceparent
+
+
+def test_traceparent_roundtrip():
+    header = format_traceparent(TRACE_ID, SPAN_ID, True)
+    assert header == f"00-{TRACE_ID}-{SPAN_ID}-01"
+    assert parse_traceparent(header) == (TRACE_ID, SPAN_ID, True)
+    header = format_traceparent(TRACE_ID, SPAN_ID, False)
+    assert parse_traceparent(header) == (TRACE_ID, SPAN_ID, False)
+
+
+def test_parse_traceparent_rejects_malformed():
+    assert parse_traceparent(None) is None
+    assert parse_traceparent("") is None
+    assert parse_traceparent("garbage") is None
+    assert parse_traceparent(f"00-{TRACE_ID}-{SPAN_ID}") is None  # no flags
+    assert parse_traceparent(f"ff-{TRACE_ID}-{SPAN_ID}-01") is None  # version
+    assert parse_traceparent(f"00-{'0' * 32}-{SPAN_ID}-01") is None  # zero id
+    assert parse_traceparent(f"00-{TRACE_ID}-{'0' * 16}-01") is None
+    assert parse_traceparent(f"00-{TRACE_ID.upper()}-{SPAN_ID}-01") == (
+        TRACE_ID,
+        SPAN_ID,
+        True,
+    )  # case-normalized
+
+
+# --------------------------------------------------------- nesting/parents
+
+
+def test_span_nesting_records_parent_ids():
+    tracer = make_tracer()
+    with tracer.start_trace("root") as root:
+        with tracer.span("child") as child:
+            with tracer.span("grandchild") as grandchild:
+                pass
+    spans = {s["name"]: s for s in tracer.ring.trace(root.trace_id)}
+    assert spans["root"]["parent_id"] is None
+    assert spans["child"]["parent_id"] == root.span_id
+    assert spans["grandchild"]["parent_id"] == child.span_id
+    assert {s["trace_id"] for s in spans.values()} == {root.trace_id}
+
+
+def test_incoming_traceparent_joins_trace():
+    tracer = make_tracer()
+    header = format_traceparent(TRACE_ID, SPAN_ID, True)
+    with tracer.start_trace("root", traceparent=header) as root:
+        assert root.trace_id == TRACE_ID
+        assert root.parent_id == SPAN_ID
+    [span] = tracer.ring.trace(TRACE_ID)
+    assert span["parent_id"] == SPAN_ID
+
+
+async def test_concurrent_tasks_keep_independent_current_spans():
+    """gather() runs children in separate tasks with copied contexts: each
+    task's span parents to the root, never to a sibling."""
+    tracer = make_tracer()
+
+    async def leaf(i):
+        with tracer.span(f"leaf-{i}"):
+            await asyncio.sleep(0.01)
+
+    with tracer.start_trace("root") as root:
+        await asyncio.gather(*(leaf(i) for i in range(4)))
+    spans = tracer.ring.trace(root.trace_id)
+    leaves = [s for s in spans if s["name"].startswith("leaf-")]
+    assert len(leaves) == 4
+    assert all(s["parent_id"] == root.span_id for s in leaves)
+
+
+def test_span_error_status_still_exports():
+    tracer = make_tracer()
+    try:
+        with tracer.start_trace("root") as root:
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    [span] = tracer.ring.trace(root.trace_id)
+    assert span["status"] == "error"
+    assert "boom" in span["attributes"]["error"]
+
+
+# -------------------------------------------------------------- sampling
+
+
+def test_unsampled_incoming_propagates_ids_but_records_nothing():
+    tracer = make_tracer()
+    header = format_traceparent(TRACE_ID, SPAN_ID, False)
+    with tracer.start_trace("root", traceparent=header) as root:
+        assert not root.recording
+        assert root.traceparent() == header  # same ids, flag 00, onward
+        with tracer.span("child") as child:
+            assert not child.recording
+            assert child.traceparent() == header  # parent's ids onward
+    assert len(tracer.ring) == 0
+
+
+async def test_unsampled_concurrent_children_do_not_corrupt_context():
+    """Regression: concurrently gathered tasks each enter a child of an
+    unsampled root. Shared context-manager state across task contexts would
+    pop another task's ContextVar token (ValueError); children must be
+    per-call instances that never touch the contextvar."""
+    tracer = make_tracer()
+    header = format_traceparent(TRACE_ID, SPAN_ID, False)
+
+    async def hop(i):
+        with tracer.span(f"hop-{i}") as span:
+            await asyncio.sleep(0.01 * (3 - i))  # exits in reverse order
+            assert span.traceparent() == header
+        assert tracing.current_trace_id() == TRACE_ID  # parent still current
+
+    with tracer.start_trace("root", traceparent=header):
+        await asyncio.gather(*(hop(i) for i in range(3)))
+    assert len(tracer.ring) == 0
+
+
+def test_sample_ratio_zero_records_nothing():
+    tracer = make_tracer(sample_ratio=0.0)
+    with tracer.start_trace("root") as root:
+        assert not root.recording
+        assert root.trace_id  # ids still propagate downstream (flag 00)
+        assert root.traceparent().endswith("-00")
+        assert tracing.current_trace_id() == root.trace_id  # propagation
+    assert len(tracer.ring) == 0
+    assert tracing.current_trace_id() is None  # reset on exit
+
+
+def test_sample_ratio_is_respected():
+    tracer = make_tracer(sample_ratio=0.5, rng=random.Random(42))
+    recorded = sum(
+        1 for _ in range(200) if tracer.start_trace("t").recording
+    )
+    assert 60 < recorded < 140  # deterministic given the seeded rng
+
+
+def test_incoming_sampled_flag_beats_local_ratio():
+    tracer = make_tracer(sample_ratio=0.0)
+    header = format_traceparent(TRACE_ID, SPAN_ID, True)
+    with tracer.start_trace("root", traceparent=header) as root:
+        assert root.recording  # upstream already decided: record
+
+
+def test_disabled_tracer_is_fully_noop():
+    tracer = make_tracer(enabled=False)
+    root = tracer.start_trace("root", traceparent=format_traceparent(TRACE_ID, SPAN_ID, True))
+    assert root is NOOP
+    assert root.traceparent() is None  # nothing propagates at all
+    with root:
+        assert tracer.span("child") is NOOP
+        tracing.add_event("ignored")
+    assert len(tracer.ring) == 0
+    tracer.record_span(
+        "grafted", trace_id=TRACE_ID, parent_id=None, start_unix=0.0,
+        duration_s=1.0,
+    )
+    assert len(tracer.ring) == 0
+
+
+# -------------------------------------------------------------- exporters
+
+
+def test_ring_capacity_bound():
+    tracer = Tracer(ring=TraceRing(capacity=8))
+    for _ in range(20):
+        with tracer.start_trace("t"):
+            pass
+    assert len(tracer.ring) == 8
+
+
+def test_ring_recent_summaries():
+    tracer = make_tracer()
+    ids = []
+    for i in range(3):
+        with tracer.start_trace(f"root-{i}") as root:
+            with tracer.span("child"):
+                pass
+        ids.append(root.trace_id)
+    recent = tracer.ring.recent(limit=2)
+    assert [r["trace_id"] for r in recent] == [ids[2], ids[1]]
+    assert recent[0]["root"] == "root-2"
+    assert recent[0]["spans"] == 2
+
+
+def test_ring_jsonl_export_parses():
+    tracer = make_tracer()
+    with tracer.start_trace("root") as root:
+        with tracer.span("child"):
+            pass
+    lines = tracer.ring.export_jsonl(root.trace_id).splitlines()
+    assert len(lines) == 2
+    parsed = [json.loads(line) for line in lines]
+    assert {s["trace_id"] for s in parsed} == {root.trace_id}
+
+
+def test_jsonl_file_exporter(tmp_path):
+    path = tmp_path / "spans.jsonl"
+    tracer = make_tracer(jsonl_path=str(path))
+    with tracer.start_trace("root") as root:
+        pass
+    [line] = path.read_text().splitlines()
+    assert json.loads(line)["trace_id"] == root.trace_id
+
+
+def test_jsonl_exporter_disables_on_write_failure(tmp_path):
+    exporter = JsonlExporter(str(tmp_path / "nope" / "spans.jsonl"))
+    exporter.add({"name": "x"})  # parent dir missing: must not raise
+    assert exporter._broken
+
+
+def test_global_ring_receives_every_tracers_spans():
+    GLOBAL_RING.clear()
+    tracer = make_tracer()
+    with tracer.start_trace("root") as root:
+        pass
+    assert any(
+        s["trace_id"] == root.trace_id for s in GLOBAL_RING.trace(root.trace_id)
+    )
+
+
+def test_record_span_grafts_child():
+    tracer = make_tracer()
+    tracer.record_span(
+        "sandbox.exec",
+        trace_id=TRACE_ID,
+        parent_id=SPAN_ID,
+        start_unix=123.0,
+        duration_s=0.5,
+        attributes={"host": "http://h0"},
+    )
+    [span] = tracer.ring.trace(TRACE_ID)
+    assert span["parent_id"] == SPAN_ID
+    assert span["start_unix"] == 123.0
+    assert span["duration_s"] == 0.5
+    assert span["attributes"]["host"] == "http://h0"
+
+
+# ---------------------------------------------------------------- metrics
+
+
+class _HistogramStub:
+    def __init__(self):
+        self.observed = []
+
+    def observe(self, value, **labels):
+        self.observed.append((value, labels))
+
+
+class _MetricsStub:
+    def __init__(self):
+        self.span_seconds = _HistogramStub()
+
+
+def test_spans_feed_the_stage_histogram():
+    metrics = _MetricsStub()
+    tracer = make_tracer(metrics=metrics)
+    with tracer.start_trace("root"):
+        with tracer.span("transfer.upload"):
+            pass
+    names = [labels["span"] for _, labels in metrics.span_seconds.observed]
+    assert names == ["transfer.upload", "root"]
+
+
+# ------------------------------------------------------------ module utils
+
+
+def test_add_event_without_current_span_is_noop():
+    assert tracing.current_span() is None
+    tracing.add_event("orphan", x=1)  # must not raise
+
+
+def test_current_trace_id_inside_span():
+    tracer = make_tracer()
+    with tracer.start_trace("root") as root:
+        assert tracing.current_trace_id() == root.trace_id
+    assert tracing.current_trace_id() is None

@@ -1,0 +1,395 @@
+"""Traceparent propagation under chaos (ISSUE 4 satellite): across the
+pinned fault-seed matrix {7, 23, 1337}, every retry attempt and breaker
+rejection must land in ONE connected trace with correct parent ids — the
+whole point of tracing is explaining exactly these paths.
+
+Seed-parameterized like the scheduler chaos suite: CI's chaos leg also sets
+``CHAOS_SEED``, so a red leg replays exactly with
+``CHAOS_SEED=<n> pytest tests/unit/test_tracing_chaos.py``.
+"""
+
+import os
+
+import grpc
+import pytest
+from fakes import FakeBackend
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.proto import code_interpreter_pb2 as pb2
+from bee_code_interpreter_fs_tpu.services.backends.base import SandboxSpawnError
+from bee_code_interpreter_fs_tpu.services.backends.faults import (
+    FaultInjectingBackend,
+    FaultSpec,
+)
+from bee_code_interpreter_fs_tpu.services.circuit_breaker import BreakerBoard
+from bee_code_interpreter_fs_tpu.services.code_executor import (
+    CircuitOpenError,
+    CodeExecutor,
+)
+from bee_code_interpreter_fs_tpu.services.custom_tool_executor import (
+    CustomToolExecutor,
+)
+from bee_code_interpreter_fs_tpu.services.grpc_servicers.code_interpreter_servicer import (
+    CodeInterpreterServicer,
+)
+from bee_code_interpreter_fs_tpu.services.http_server import create_http_app
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+from bee_code_interpreter_fs_tpu.utils import tracing
+from bee_code_interpreter_fs_tpu.utils.tracing import (
+    TraceRing,
+    Tracer,
+    format_traceparent,
+)
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "7"))
+# The pinned matrix from the ISSUE — run ALL of it locally; CI's per-seed
+# legs overlap via CHAOS_SEED without changing coverage.
+SEED_MATRIX = sorted({7, 23, 1337, CHAOS_SEED})
+
+
+def fake_sandbox_server(executor: CodeExecutor) -> None:
+    async def fake_post_execute(client, base, payload, timeout, sandbox):
+        return {
+            "stdout": "ok\n",
+            "stderr": "",
+            "exit_code": 0,
+            "files": [],
+            "warm": True,
+        }
+
+    executor._post_execute = fake_post_execute
+
+
+def make_executor(backend, tmp_path, breakers=None, **config_kwargs):
+    config_kwargs.setdefault("executor_pod_queue_target_length", 1)
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        **config_kwargs,
+    )
+    tracer = Tracer(ring=TraceRing(1024))
+    executor = CodeExecutor(
+        backend,
+        Storage(config.file_storage_path),
+        config,
+        breakers=breakers,
+        tracer=tracer,
+    )
+    fake_sandbox_server(executor)
+    return executor
+
+
+def assert_connected(spans: list[dict], root) -> None:
+    """Every span belongs to the root's trace and parents onto another span
+    of the same trace (or the root's upstream parent) — no orphans."""
+    assert spans, "trace recorded no spans"
+    ids = {s["span_id"] for s in spans}
+    for span in spans:
+        assert span["trace_id"] == root.trace_id
+        if span["parent_id"] is None:
+            assert span["span_id"] == root.span_id
+        else:
+            assert span["parent_id"] in ids | {root.parent_id}
+
+
+def trace_events(spans: list[dict], name: str) -> list[dict]:
+    return [
+        event
+        for span in spans
+        for event in span.get("events", ())
+        if event["name"] == name
+    ]
+
+
+# ----------------------------------------------------- retries stay in-trace
+
+
+@pytest.mark.parametrize("seed", SEED_MATRIX)
+async def test_spawn_retries_land_in_one_connected_trace(tmp_path, seed):
+    backend = FaultInjectingBackend(
+        FakeBackend(), FaultSpec(spawn_fail=0.5, seed=seed)
+    )
+    # Reuse off: every execute walks the spawn retry ladder (with reuse on,
+    # one spawn serves all 8 and the seeded plan may never fire).
+    executor = make_executor(
+        backend, tmp_path, executor_reuse_sandboxes=False
+    )
+    tracer = executor.tracer
+    try:
+        incoming = format_traceparent(f"{seed:032x}", "c" * 16, True)
+        completed = failed = 0
+        with tracer.start_trace("chaos-root", traceparent=incoming) as root:
+            for _ in range(8):
+                try:
+                    result = await executor.execute("x")
+                    assert result.exit_code == 0
+                    completed += 1
+                except SandboxSpawnError:
+                    failed += 1  # retry ladder exhausted — chaos did its job
+        spans = tracer.ring.trace(root.trace_id)
+        assert_connected(spans, root)
+        assert completed + failed == 8
+        # The seeded plan at 0.5 must actually have injected spawn faults;
+        # each one shows up as a retry event (or an exhausted ladder) in
+        # THIS trace — never as orphaned telemetry.
+        retries = trace_events(spans, "retry")
+        errored = [s for s in spans if s["status"] == "error"]
+        assert retries or failed, (
+            f"seed {seed} injected no observable spawn faults"
+        )
+        for event in retries:
+            assert event["attributes"]["operation"] == "spawn"
+            assert event["attributes"]["attempt"] >= 1
+        # Retry events ride the scheduler.queue_wait span (the spawn runs
+        # inside the acquisition), whose parent is the root.
+        queue_spans = [s for s in spans if s["name"] == "scheduler.queue_wait"]
+        assert queue_spans
+        assert all(s["parent_id"] == root.span_id for s in queue_spans)
+        if failed:
+            assert errored  # an exhausted ladder marks its span errored
+        # Scheduler decisions are visible too: every execute enqueued and
+        # every successful acquisition granted, in the same trace.
+        assert len(trace_events(spans, "scheduler.enqueue")) == 8
+        assert len(trace_events(spans, "scheduler.grant")) >= completed
+    finally:
+        await executor.close()
+
+
+# ---------------------------------------------- breaker rejections in-trace
+
+
+@pytest.mark.parametrize("seed", SEED_MATRIX)
+async def test_breaker_rejection_lands_in_same_trace(tmp_path, seed):
+    backend = FaultInjectingBackend(
+        FakeBackend(), FaultSpec(spawn_fail=1.0, seed=seed)
+    )
+    breakers = BreakerBoard(failure_threshold=1, cooldown=300.0)
+    executor = make_executor(backend, tmp_path, breakers=breakers)
+    tracer = executor.tracer
+    try:
+        with tracer.start_trace("chaos-root") as root:
+            with pytest.raises((SandboxSpawnError, CircuitOpenError)):
+                await executor.execute("x")  # opens the lane-0 breaker
+            with pytest.raises(CircuitOpenError):
+                await executor.execute("x")  # fail-fast rejection
+        spans = tracer.ring.trace(root.trace_id)
+        assert_connected(spans, root)
+        rejects = trace_events(spans, "breaker.reject")
+        assert rejects, "breaker rejection did not land in the trace"
+        assert rejects[0]["attributes"]["lane"] == "0"
+        assert rejects[0]["attributes"]["failures"] >= 1
+    finally:
+        await executor.close()
+
+
+# ------------------------------------------- propagation into the executor
+
+
+async def test_traceparent_propagates_to_sandbox_calls(tmp_path):
+    """The header each sandbox host would receive parents onto that host's
+    executor.execute span of the live trace."""
+    backend = FakeBackend()
+    executor = make_executor(backend, tmp_path)
+    tracer = executor.tracer
+    seen: list[str] = []
+
+    async def capturing_post_execute(client, base, payload, timeout, sandbox):
+        headers = executor._trace_headers()
+        seen.append(headers["traceparent"] if headers else None)
+        return {"stdout": "", "stderr": "", "exit_code": 0, "files": []}
+
+    executor._post_execute = capturing_post_execute
+    try:
+        with tracer.start_trace("root") as root:
+            await executor.execute("x")
+        [header] = seen
+        trace_id, parent_span, sampled = tracing.parse_traceparent(header)
+        assert trace_id == root.trace_id
+        assert sampled
+        spans = tracer.ring.trace(root.trace_id)
+        [host_span] = [s for s in spans if s["name"] == "executor.execute"]
+        assert host_span["span_id"] == parent_span
+    finally:
+        await executor.close()
+
+
+async def test_sandbox_trace_block_grafts_as_child_spans(tmp_path):
+    backend = FakeBackend()
+    executor = make_executor(backend, tmp_path)
+    tracer = executor.tracer
+
+    async def post_execute_with_trace(client, base, payload, timeout, sandbox):
+        headers = executor._trace_headers()
+        return {
+            "stdout": "",
+            "stderr": "",
+            "exit_code": 0,
+            "files": [],
+            "trace": {
+                "traceparent": headers["traceparent"],
+                "spans": [
+                    {"name": "install", "start_offset_s": 0.0, "duration_s": 0.01},
+                    {"name": "exec", "start_offset_s": 0.01, "duration_s": 0.5},
+                    {"name": "collect", "start_offset_s": 0.51, "duration_s": 0.02},
+                    {"name": 7, "start_offset_s": 0, "duration_s": 0},  # junk
+                ],
+            },
+        }
+
+    executor._post_execute = post_execute_with_trace
+    try:
+        with tracer.start_trace("root") as root:
+            result = await executor.execute("x")
+        spans = tracer.ring.trace(root.trace_id)
+        [host_span] = [s for s in spans if s["name"] == "executor.execute"]
+        grafted = {
+            s["name"]: s for s in spans if s["name"].startswith("sandbox.")
+        }
+        assert set(grafted) == {"sandbox.install", "sandbox.exec", "sandbox.collect"}
+        for span in grafted.values():
+            assert span["parent_id"] == host_span["span_id"]
+            assert span["start_unix"] >= host_span["start_unix"]
+        assert grafted["sandbox.exec"]["duration_s"] == 0.5
+        assert result.phases["trace_id"] == root.trace_id
+    finally:
+        await executor.close()
+
+
+async def test_untraced_and_disabled_paths_record_nothing(tmp_path):
+    backend = FakeBackend()
+    executor = make_executor(backend, tmp_path)
+    try:
+        # No root span: the pipeline's child spans are no-ops and no
+        # traceparent is sent to sandboxes.
+        result = await executor.execute("x")
+        assert "trace_id" not in result.phases
+        assert len(executor.tracer.ring) == 0
+    finally:
+        await executor.close()
+    # Disabled subsystem (APP_TRACING_ENABLED=0): even a root span records
+    # nothing anywhere.
+    executor = make_executor(backend, tmp_path)
+    executor.tracer = Tracer(enabled=False, ring=TraceRing(64))
+    try:
+        with executor.tracer.start_trace("root"):
+            result = await executor.execute("x")
+        assert "trace_id" not in result.phases
+        assert len(executor.tracer.ring) == 0
+    finally:
+        await executor.close()
+
+
+# --------------------------------------------------- API-surface correlation
+
+
+async def test_http_error_bodies_and_headers_carry_ids(tmp_path):
+    executor = make_executor(FakeBackend(), tmp_path)
+    error = CircuitOpenError("lane-0 spawn circuit is open", lane=0, retry_after=3.0)
+
+    async def raise_error(*args, **kwargs):
+        raise error
+
+    executor.execute = raise_error
+    tools = CustomToolExecutor(executor)
+    from aiohttp.test_utils import TestClient, TestServer
+
+    app = create_http_app(executor, tools, executor.storage)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        incoming = format_traceparent("d" * 32, "e" * 16, True)
+        resp = await client.post(
+            "/v1/execute",
+            json={"source_code": "x"},
+            headers={"traceparent": incoming},
+        )
+        assert resp.status == 503
+        body = await resp.json()
+        # The degraded-response body names the trace an operator should
+        # pull, and the headers echo both correlation ids.
+        assert body["trace_id"] == "d" * 32
+        assert resp.headers["X-Trace-Id"] == "d" * 32
+        assert resp.headers["X-Request-Id"]
+        # The rejection is retrievable as a trace.
+        resp = await client.get(f"/traces/{'d' * 32}")
+        assert resp.status == 200
+        spans = (await resp.json())["spans"]
+        assert spans[0]["parent_id"] == "e" * 16
+    finally:
+        await client.close()
+        await executor.close()
+
+
+async def test_grpc_trailing_metadata_echoes_ids(tmp_path):
+    executor = make_executor(FakeBackend(), tmp_path)
+    tools = CustomToolExecutor(executor)
+    servicer = CodeInterpreterServicer(executor, tools)
+
+    class FakeContext:
+        def __init__(self, metadata=()):
+            self.metadata = tuple(metadata)
+            self.trailing = None
+
+        def invocation_metadata(self):
+            return self.metadata
+
+        def set_trailing_metadata(self, metadata):
+            self.trailing = dict(metadata)
+
+        async def abort(self, code, details=""):
+            raise AssertionError(f"unexpected abort: {code} {details}")
+
+    incoming = format_traceparent("f" * 32, "a" * 16, True)
+    context = FakeContext(metadata=(("x-traceparent", incoming),))
+    try:
+        response = await servicer.Execute(
+            pb2.ExecuteRequest(source_code="x"), context
+        )
+        assert response.exit_code == 0
+        assert context.trailing["x-trace-id"] == "f" * 32
+        assert context.trailing["x-request-id"]
+        spans = executor.tracer.ring.trace("f" * 32)
+        assert spans[0]["name"] == "grpc Execute"
+        assert spans[0]["parent_id"] == "a" * 16
+        # The full pipeline hangs off the gRPC root span.
+        assert {s["name"] for s in spans} >= {
+            "grpc Execute",
+            "scheduler.queue_wait",
+            "transfer.upload",
+            "executor.execute",
+            "transfer.download",
+        }
+    finally:
+        await executor.close()
+
+
+async def test_grpc_abort_still_carries_request_id(tmp_path):
+    """Trailing metadata is set BEFORE the handler can abort, so even an
+    INVALID_ARGUMENT response correlates."""
+    executor = make_executor(FakeBackend(), tmp_path)
+    tools = CustomToolExecutor(executor)
+    servicer = CodeInterpreterServicer(executor, tools)
+
+    class AbortRaised(Exception):
+        pass
+
+    class FakeContext:
+        def __init__(self):
+            self.trailing = None
+
+        def invocation_metadata(self):
+            return ()
+
+        def set_trailing_metadata(self, metadata):
+            self.trailing = dict(metadata)
+
+        async def abort(self, code, details=""):
+            assert code == grpc.StatusCode.INVALID_ARGUMENT
+            raise AbortRaised(details)
+
+    context = FakeContext()
+    try:
+        with pytest.raises(AbortRaised):
+            await servicer.Execute(pb2.ExecuteRequest(), context)
+        assert context.trailing["x-request-id"]
+    finally:
+        await executor.close()
