@@ -67,7 +67,30 @@ async def main(ctx: ApplicationContext | None = None) -> None:
         await asyncio.wait(waiters, return_when=asyncio.FIRST_COMPLETED)
     finally:
         stop_task.cancel()
+        # Graceful drain (APP_SHUTDOWN_GRACE_SECONDS): flip health to
+        # NOT_SERVING / 503 and stop admitting FIRST, so load balancers
+        # route away while in-flight executes finish, then wait out the
+        # grace before anything is torn down — the old hard-coded 2s gRPC
+        # grace cut long-running executes off mid-request.
         if grpc_task is not None:
+            ctx.grpc_server.health.serving = False
+        ctx.code_executor.begin_drain()
+        grace = ctx.config.shutdown_grace_seconds
+        inflight = ctx.code_executor.inflight()
+        if inflight:
+            logger.info(
+                "draining %d in-flight execute(s) (grace %.0fs)", inflight, grace
+            )
+        if not await ctx.code_executor.wait_drained(grace):
+            logger.warning(
+                "shutdown grace (%.0fs) expired with %d execute(s) still "
+                "in flight; closing anyway",
+                grace,
+                ctx.code_executor.inflight(),
+            )
+        if grpc_task is not None:
+            # In-flight RPCs already drained (or were cut off above): the
+            # transport itself needs only a short grace.
             await ctx.grpc_server.stop(grace=2.0)
             grpc_task.cancel()
             with contextlib.suppress(asyncio.CancelledError):

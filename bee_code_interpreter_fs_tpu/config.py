@@ -209,6 +209,36 @@ class Config(BaseModel):
     # Append-only JSONL span export (one span per line); empty = no file
     # exporter. Write failure disables the exporter, never the request.
     tracing_jsonl_path: str = ""
+    # -- sandbox resource governance (services/limits.py) --------------------
+    # Kill switch for the whole governance subsystem: 0 restores the
+    # pre-governance behavior (no limits payload on requests, no APP_LIMIT_*
+    # env on sandboxes, violations impossible).
+    sandbox_limits_enabled: bool = True
+    # Default per-request budget applied to EVERY execute, e.g.
+    # {"cpu_seconds": 120, "nproc": 64, "disk_bytes": 1073741824}. Keys:
+    # memory_bytes, cpu_seconds, nproc, nofile, fsize_bytes, disk_bytes,
+    # output_bytes. Empty = ungoverned unless a lane/request asks.
+    sandbox_default_limits: dict = Field(default_factory=dict)
+    # Per-chip-count-lane budget overrides layered over the defaults, keyed
+    # by the lane as a string (env vars are JSON):
+    # {"0": {"memory_bytes": 2147483648}, "4": {"cpu_seconds": 600}}.
+    sandbox_lane_limits: dict = Field(default_factory=dict)
+    # Server caps that min-clamp whatever defaults/lane/request produce AND
+    # boot every sandbox's APP_LIMIT_* env — the executor re-clamps against
+    # them, so a request (or a compromised control plane) can only ever
+    # TIGHTEN policy, never loosen it.
+    sandbox_limit_caps: dict = Field(default_factory=dict)
+    # The executor's stdout/stderr capture cap (APP_MAX_OUTPUT_BYTES, the
+    # historic hard-coded 10 MiB): beyond it output is truncated — and
+    # truncation is now reported as stdout_truncated/stderr_truncated flags.
+    # A request's limits.output_bytes (below this cap) upgrades truncation
+    # to an output_cap violation kill.
+    sandbox_max_output_bytes: int = 10485760
+    # -- shutdown ------------------------------------------------------------
+    # Graceful drain budget on SIGTERM: health flips to NOT_SERVING and new
+    # executes shed immediately, then shutdown waits up to this many seconds
+    # for in-flight executes to finish before closing the executor.
+    shutdown_grace_seconds: float = 20.0
     # -- sandbox resource limits (local backend) ----------------------------
     # Extra address-space bytes user code may allocate beyond the warm
     # runner's baseline (soft RLIMIT_AS window in executor/runner.py): an

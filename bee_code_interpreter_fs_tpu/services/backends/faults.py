@@ -19,6 +19,13 @@ on in any deployment (``APP_EXECUTOR_FAULT_SPEC=spawn_fail:0.3,seed:7``):
     exec_drop:<rate>     probability a sandbox HTTP request raises
                          ConnectError mid-flight (via the injectable httpx
                          transport the orchestrator asks backends for)
+    violation:<rate>     probability a POST /execute answers with a
+                         synthesized typed limit violation instead of
+                         running (exercises the LimitExceededError path:
+                         422 mapping, no-retry, breaker strikes, host
+                         disposal) — kind set by violation_kind
+    violation_kind:<kind> which violation to inject (default oom; one of
+                         services.limits.VIOLATION_KINDS)
     seed:<int>           the plan seed (default 0)
 
 Rates are in [0, 1]; delays are seconds. Unknown keys fail loudly — a typo'd
@@ -35,6 +42,7 @@ from dataclasses import dataclass, fields
 
 import httpx
 
+from ..limits import VIOLATION_KINDS
 from .base import Sandbox, SandboxBackend, SandboxSpawnError
 
 logger = logging.getLogger(__name__)
@@ -44,6 +52,7 @@ SLOW_READY = "slow_ready"
 RESET_FAIL = "reset_fail"
 DELETE_HANG = "delete_hang"
 EXEC_DROP = "exec_drop"
+VIOLATION = "violation"
 
 
 @dataclass(frozen=True)
@@ -53,13 +62,15 @@ class FaultSpec:
     reset_fail: float = 0.0
     delete_hang: float = 0.0
     exec_drop: float = 0.0
+    violation: float = 0.0
+    violation_kind: str = "oom"
     seed: int = 0
 
     @classmethod
     def parse(cls, text: str) -> "FaultSpec":
         """Parse ``key:value,key:value`` (whitespace tolerated). An empty
         string is the null plan (inject nothing)."""
-        values: dict[str, float | int] = {}
+        values: dict[str, float | int | str] = {}
         known = {f.name for f in fields(cls)}
         for item in text.split(","):
             item = item.strip()
@@ -73,26 +84,92 @@ class FaultSpec:
                     f"{sorted(known)} as key:value"
                 )
             try:
-                values[key] = int(raw) if key == "seed" else float(raw)
+                if key == "seed":
+                    values[key] = int(raw)
+                elif key == "violation_kind":
+                    values[key] = raw.strip()
+                else:
+                    values[key] = float(raw)
             except ValueError:
                 raise ValueError(
                     f"bad fault spec value for {key}: {raw!r}"
                 ) from None
         spec = cls(**values)
-        for name in (SPAWN_FAIL, RESET_FAIL, EXEC_DROP):
+        for name in (SPAWN_FAIL, RESET_FAIL, EXEC_DROP, VIOLATION):
             rate = getattr(spec, name)
             if not 0.0 <= rate <= 1.0:
                 raise ValueError(f"fault rate {name} must be in [0,1]: {rate}")
         for name in (SLOW_READY, DELETE_HANG):
             if getattr(spec, name) < 0.0:
                 raise ValueError(f"fault delay {name} must be >= 0")
+        if spec.violation_kind not in VIOLATION_KINDS:
+            raise ValueError(
+                f"violation_kind must be one of {list(VIOLATION_KINDS)}: "
+                f"{spec.violation_kind!r}"
+            )
         return spec
 
     @property
     def active(self) -> bool:
         return any(
-            getattr(self, f.name) for f in fields(self) if f.name != "seed"
+            getattr(self, f.name)
+            for f in fields(self)
+            if f.name not in ("seed", "violation_kind")
         )
+
+
+class ViolationTransport(httpx.AsyncBaseTransport):
+    """httpx transport that answers a seeded fraction of POST /execute
+    calls with a synthesized typed-limit-violation response — the body a
+    real executor returns after its watchdog killed the runner group —
+    without the request ever reaching a sandbox. This drives the whole
+    control-plane classification path (LimitExceededError, 422 mapping,
+    no-retry, breaker strike, host disposal) deterministically in chaos
+    runs."""
+
+    def __init__(
+        self,
+        rate: float,
+        kind: str,
+        rng: random.Random,
+        on_fault: Callable[[str], None] | None = None,
+        inner: httpx.AsyncBaseTransport | None = None,
+    ) -> None:
+        self.rate = rate
+        self.kind = kind
+        self.rng = rng
+        self.on_fault = on_fault
+        self.inner = inner or httpx.AsyncHTTPTransport()
+
+    async def handle_async_request(self, request):
+        if (
+            request.method == "POST"
+            and request.url.path == "/execute"
+            and self.rng.random() < self.rate
+        ):
+            if self.on_fault is not None:
+                self.on_fault(VIOLATION)
+            # cpu_time is the one kind the in-process guard catches with the
+            # runner surviving; every other kind is a watchdog group kill.
+            killed = self.kind != "cpu_time"
+            body = {
+                "stdout": "",
+                "stderr": f"Resource limit exceeded: {self.kind} (injected)",
+                "exit_code": 137 if killed else 1,
+                "stdout_truncated": False,
+                "stderr_truncated": False,
+                "violation": self.kind,
+                "files": [],
+                "deleted": [],
+                "duration_s": 0.0,
+                "warm": True,
+                "runner_restarted": killed,
+            }
+            return httpx.Response(200, json=body, request=request)
+        return await self.inner.handle_async_request(request)
+
+    async def aclose(self) -> None:
+        await self.inner.aclose()
 
 
 class DroppingTransport(httpx.AsyncBaseTransport):
@@ -143,7 +220,14 @@ class FaultInjectingBackend(SandboxBackend):
         self.on_fault = on_fault
         self._rngs = {
             name: random.Random(f"{spec.seed}:{name}")
-            for name in (SPAWN_FAIL, SLOW_READY, RESET_FAIL, DELETE_HANG, EXEC_DROP)
+            for name in (
+                SPAWN_FAIL,
+                SLOW_READY,
+                RESET_FAIL,
+                DELETE_HANG,
+                EXEC_DROP,
+                VIOLATION,
+            )
         }
         if spec.active:
             logger.warning("fault injection ACTIVE: %s", spec)
@@ -198,9 +282,19 @@ class FaultInjectingBackend(SandboxBackend):
 
     def http_transport(self) -> httpx.AsyncBaseTransport | None:
         """Transport the orchestrator should build its sandbox HTTP client
-        with (None = default). This is how exec_drop reaches the wire."""
-        if self.spec.exec_drop <= 0.0:
-            return None
-        return DroppingTransport(
-            self.spec.exec_drop, self._rngs[EXEC_DROP], self.on_fault
-        )
+        with (None = default). This is how exec_drop and violation reach
+        the wire; both active stacks them (violation checked first)."""
+        transport: httpx.AsyncBaseTransport | None = None
+        if self.spec.exec_drop > 0.0:
+            transport = DroppingTransport(
+                self.spec.exec_drop, self._rngs[EXEC_DROP], self.on_fault
+            )
+        if self.spec.violation > 0.0:
+            transport = ViolationTransport(
+                self.spec.violation,
+                self.spec.violation_kind,
+                self._rngs[VIOLATION],
+                self.on_fault,
+                inner=transport,
+            )
+        return transport

@@ -29,6 +29,7 @@ from typing import Any
 
 from ...config import Config
 from ..kubectl import Kubectl, KubectlError
+from ..limits import sandbox_limit_env
 from .base import (
     Sandbox,
     SandboxBackend,
@@ -214,6 +215,13 @@ class KubernetesSandboxBackend(SandboxBackend):
             # /tmp (tempfile) and ~/.local (pip --user lands on sys.path).
             {"name": "APP_RESET_EXTRA_WIPE_DIRS", "value": "/tmp:~/.local"},
         ]
+        # Resource-governance caps (APP_LIMIT_* + the output cap). Container
+        # resources still bound the pod as a whole; these add the TYPED
+        # per-request enforcement (violation kinds) inside it.
+        env.extend(
+            {"name": name, "value": value}
+            for name, value in sandbox_limit_env(self.config).items()
+        )
         if self.config.jax_compilation_cache_dir:
             env.append(
                 {

@@ -28,6 +28,7 @@ from pathlib import Path
 import httpx
 
 from ...config import Config
+from ..limits import sandbox_limit_env
 from .base import (
     Sandbox,
     SandboxBackend,
@@ -416,6 +417,10 @@ class LocalSandboxBackend(SandboxBackend):
                 "APP_RESET_EXTRA_WIPE_DIRS": str(scratch_tmp),
             }
         )
+        # Resource-governance caps (APP_LIMIT_* + the output cap): the
+        # executor re-clamps every request against these, so sandbox-side
+        # policy holds even if the control plane stops clamping.
+        env.update(sandbox_limit_env(self.config))
         if cache_dir:
             env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
         # sitecustomize (media/json patches + the gated numpy shim) is always

@@ -119,6 +119,24 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = None
 
+    def trip(self, reason: str = "") -> None:
+        """Force the breaker open for one cooldown regardless of the
+        consecutive-failure count. Used by the repeat-offender path: a lane
+        absorbing a violation storm keeps SPAWNING successfully (each refill
+        resets the native failure count), so the storm could never open the
+        lane through record_failure alone — trip() is the explicit verdict
+        once the violation-strike bound is crossed."""
+        self._failures = max(self._failures, self.failure_threshold)
+        already_open = self.state == OPEN
+        self._opened_at = self.clock()
+        if not already_open:
+            logger.warning(
+                "circuit breaker %s tripped open%s (cooldown %.1fs)",
+                self.name,
+                f": {reason}" if reason else "",
+                self.cooldown,
+            )
+
     def record_failure(self) -> None:
         was = self.state
         self._failures += 1

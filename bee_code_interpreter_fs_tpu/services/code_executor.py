@@ -52,9 +52,11 @@ from .errors import (  # noqa: F401 — canonical home is errors.py; re-exported
     CircuitOpenError,
     DeadlineInfeasibleError,
     ExecutorError,
+    LimitExceededError,
     QueueDepthError,
     SessionLimitError,
 )
+from .limits import VIOLATION_KINDS, request_limits, validate_config_limits
 from .scheduler import SandboxScheduler
 from .storage import Storage, StorageObjectNotFound
 from .transfer import (
@@ -84,6 +86,10 @@ class Result:
     # trace_id (a string) when tracing sampled it.
     phases: dict[str, float | str] = field(default_factory=dict)
     warm: bool = False
+    # Per-stream truncation markers (satellite: the executor always tracked
+    # these; clients previously had to pattern-match "[stdout truncated]").
+    stdout_truncated: bool = False
+    stderr_truncated: bool = False
     # Session continuity (executor_id requests only; 0/False otherwise):
     # session_seq is this request's 1-based position in its session — a
     # client expecting an existing session that sees 1 knows prior state was
@@ -127,6 +133,9 @@ class CodeExecutor:
         self.backend = backend
         self.storage = storage
         self.config = config or Config()
+        # Malformed operator limit config must fail HERE (service boot),
+        # not per request as a spurious client 400.
+        validate_config_limits(self.config)
         self.metrics = metrics or ExecutorMetrics()
         # Request-scoped tracing: the executor owns the tracer so both API
         # servers (which create the root spans) and the pipeline stages here
@@ -185,6 +194,16 @@ class CodeExecutor:
         self._fill_tasks: set[asyncio.Task] = set()
         self._dispose_tasks: set[asyncio.Task] = set()
         self._closed = False
+        # Graceful drain (SIGTERM): while draining, new executes shed with a
+        # retryable error and wait_drained() watches this in-flight count.
+        self._draining = False
+        self._inflight = 0
+        # Repeat-offender accounting: CONSECUTIVE runner-killing limit
+        # violations per lane (a clean request on the lane resets it). At
+        # the breaker threshold the lane trips open for one cooldown — the
+        # native failure count can't get there on its own because every
+        # post-violation refill spawn succeeds and resets it.
+        self._violation_strikes: dict[int, int] = {}
         # One persistent client for all sandbox HTTP: connection pooling
         # keeps per-request TCP setup off the Execute path.
         self._client: httpx.AsyncClient | None = None
@@ -226,6 +245,40 @@ class CodeExecutor:
         reporting (`lane-<n>`): a dead 4-chip nodepool must read
         NOT_SERVING on `lane-4` while CPU-lane traffic stays SERVING."""
         return self.breakers.is_open(chip_count)
+
+    # ----------------------------------------------------------------- drain
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def inflight(self) -> int:
+        """Execute/execute_stream requests currently running end to end
+        (admission through release hand-off)."""
+        return self._inflight
+
+    def begin_drain(self) -> None:
+        """Stop admitting new executes (they shed with a retryable capacity
+        error) while in-flight work runs to completion — the SIGTERM half of
+        graceful shutdown; health surfaces flip alongside."""
+        self._draining = True
+
+    async def wait_drained(self, grace: float) -> bool:
+        """Wait up to `grace` seconds for in-flight executes to finish.
+        Returns True when the service drained fully (False = grace expired
+        with work still running; close() will cut it off)."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + max(0.0, grace)
+        while self._inflight > 0 and loop.time() < deadline:
+            await asyncio.sleep(0.05)
+        return self._inflight == 0
+
+    def _check_admission_open(self) -> None:
+        if self._draining:
+            raise SessionLimitError(
+                "service is draining (shutting down); retry against "
+                "another replica"
+            )
 
     # ------------------------------------------------------------------ pool
 
@@ -627,6 +680,7 @@ class CodeExecutor:
         tenant: str | None = None,
         priority: str | None = None,
         deadline: float | None = None,
+        limits: dict | None = None,
     ) -> Result:
         """Run user code in a sandbox; returns output + changed files.
 
@@ -641,6 +695,12 @@ class CodeExecutor:
         "this request must START within N seconds" — infeasible deadlines
         are rejected at arrival with a retryable error.
 
+        `limits` is this request's resource-budget override (keys from
+        services.limits.LIMIT_KEYS); it layers over the configured default
+        and lane budgets and is min-clamped by the server caps — a request
+        can only tighten its box. Breaches surface as LimitExceededError
+        with the typed violation kind, never retried.
+
         Without `executor_id` each request gets a pristine sandbox. With it,
         requests sharing the id run in ONE live sandbox whose workspace (and
         warm process) persists across them — session affinity (the upstream
@@ -650,6 +710,8 @@ class CodeExecutor:
         land on a fresh sandbox and silently drop the session's state.
         """
         env, executor_id = self._normalize_request(env, profile, executor_id)
+        self._check_admission_open()
+        self._inflight += 1
         try:
             if executor_id is not None:
                 result = await self._execute_in_session(
@@ -663,6 +725,7 @@ class CodeExecutor:
                     tenant=tenant,
                     priority=priority,
                     deadline=deadline,
+                    limits=limits,
                 )
             else:
                 result = await self._execute_with_retry(
@@ -675,10 +738,14 @@ class CodeExecutor:
                     tenant=tenant,
                     priority=priority,
                     deadline=deadline,
+                    limits=limits,
                 )
         except CircuitOpenError as e:
             self.metrics.breaker_rejections.inc(chip_count=str(e.lane))
             self.metrics.executions.inc(outcome="rejected")
+            raise
+        except LimitExceededError as e:
+            self._count_violation(e)
             raise
         except SessionLimitError:
             # Capacity-cap rejections must be visible on dashboards — a
@@ -688,8 +755,33 @@ class CodeExecutor:
         except (ExecutorError, SandboxSpawnError):
             self.metrics.executions.inc(outcome="infra_error")
             raise
+        finally:
+            self._inflight -= 1
         self._count_execution(result, session=executor_id is not None)
         return result
+
+    def _count_violation(self, e: LimitExceededError) -> None:
+        """Violation bookkeeping shared by both execute surfaces: the
+        lane×kind counter, the outcome counter, and — when the violation
+        killed the runner (not an in-process guard) — the repeat-offender
+        strike on the lane breaker. Enough CONSECUTIVE killed-runner
+        violations trip the lane open for one cooldown, so a fleet being
+        hammered by violating tenants sheds fast instead of churning
+        through kill/respawn cycles at full request rate."""
+        self.metrics.limit_violations.inc(
+            chip_count=str(e.lane), kind=e.kind
+        )
+        self.metrics.executions.inc(outcome="limit_violation")
+        if not e.continuable:
+            breaker = self.breakers.lane(e.lane)
+            breaker.record_failure()
+            strikes = self._violation_strikes.get(e.lane, 0) + 1
+            self._violation_strikes[e.lane] = strikes
+            if strikes >= self.config.breaker_failure_threshold:
+                breaker.trip(
+                    f"{strikes} consecutive limit violations "
+                    f"(last: {e.kind})"
+                )
 
     async def _execute_with_retry(
         self,
@@ -703,10 +795,13 @@ class CodeExecutor:
         tenant: str | None = None,
         priority: str | None = None,
         deadline: float | None = None,
+        limits: dict | None = None,
     ) -> Result:
         """Stateless execute with bounded infra retries (ExecutorError only:
         user-code failures are results, capacity/breaker rejections are not
-        infrastructure flakes — neither is retried)."""
+        infrastructure flakes, and limit violations are DETERMINISTIC — the
+        same snippet breaches the same budget on any sandbox, so replaying
+        one would burn a fresh host per attempt — none of those retry)."""
 
         def on_retry(failures: int, error: BaseException, delay: float) -> None:
             self.metrics.retry_attempts.inc(operation="execute")
@@ -729,6 +824,7 @@ class CodeExecutor:
                 tenant=tenant,
                 priority=priority,
                 deadline=deadline,
+                limits=limits,
             ),
             self._execute_retry_policy,
             on_retry=on_retry,
@@ -746,10 +842,11 @@ class CodeExecutor:
         tenant: str | None = None,
         priority: str | None = None,
         deadline: float | None = None,
+        limits: dict | None = None,
         emit=None,
     ) -> Result:
-        lane, files, timeout = self._validate_request(
-            source_code, source_file, files, timeout, chip_count
+        lane, files, timeout, limits_payload = self._validate_request(
+            source_code, source_file, files, timeout, chip_count, limits
         )
         timer = PhaseTimer()
 
@@ -761,7 +858,7 @@ class CodeExecutor:
         try:
             result, _continuable = await self._run_on_sandbox(
                 sandbox, source_code, source_file, files, timeout, env, timer,
-                emit=emit,
+                limits=limits_payload, emit=emit,
             )
             # The request completed (user errors included). Whether the
             # sandbox is actually safe to recycle is the server's call —
@@ -770,6 +867,12 @@ class CodeExecutor:
             # point) hard-disqualify reuse here.
             reusable = True
             return result
+        except LimitExceededError as e:
+            # Repeat-offender path: a violation that killed the runner makes
+            # the host non-reusable (disposed + lane refilled); an
+            # in-process guard left it scrubbable, so it recycles normally.
+            reusable = e.continuable
+            raise
         finally:
             # Sandbox release off the hot path: recycle the warm device
             # process back into the pool (generation turnover via /reset),
@@ -787,7 +890,8 @@ class CodeExecutor:
         files: dict[str, str] | None,
         timeout: float | None,
         chip_count: int | None,
-    ) -> tuple[int, dict[str, str], float]:
+        limits: dict | None = None,
+    ) -> tuple[int, dict[str, str], float, dict | None]:
         if (source_code is None) == (source_file is None):
             raise ValueError("exactly one of source_code/source_file is required")
         files = files or {}
@@ -799,7 +903,10 @@ class CodeExecutor:
             timeout or self.config.default_execution_timeout,
             self.config.max_execution_timeout,
         )
-        return lane, files, timeout
+        # Resource budget: defaults -> lane -> request override, clamped by
+        # the server caps; malformed overrides fail here as client errors.
+        limits_payload = request_limits(self.config, lane, limits)
+        return lane, files, timeout, limits_payload
 
     async def _run_on_sandbox(
         self,
@@ -810,6 +917,7 @@ class CodeExecutor:
         timeout: float,
         env: dict[str, str] | None,
         timer: PhaseTimer,
+        limits: dict | None = None,
         emit=None,
     ) -> tuple[Result, bool]:
         """The sandbox round-trip: upload inputs, fan /execute out to every
@@ -817,6 +925,10 @@ class CodeExecutor:
         continuable is False when a host's warm runner was killed (timeout)
         or crashed, i.e. any in-process state is gone and a session must not
         keep using the sandbox.
+
+        A host reporting a typed `violation` raises LimitExceededError
+        BEFORE the download phase: the bytes a disk-filler left behind are
+        exactly what must not be shipped into content-addressed storage.
 
         With `emit` (an async callback), host 0 runs via /execute/stream and
         stdout/stderr chunks are emitted as the code produces them; the final
@@ -834,7 +946,19 @@ class CodeExecutor:
         stats = TransferStats()
         with timer.phase("upload"):
             with self.tracer.span("transfer.upload") as upload_span:
-                await self._upload_inputs(client, hosts, transfer, files, stats)
+                try:
+                    await self._upload_inputs(
+                        client, hosts, transfer, files, stats
+                    )
+                except LimitExceededError as e:
+                    # The executor's PUT quota fired (413): enrich with the
+                    # lane and account it like an exec-phase violation.
+                    e.lane = sandbox.chip_count
+                    tracing.add_event(
+                        "limit.violation", kind=e.kind, lane=e.lane,
+                        phase="upload",
+                    )
+                    raise
                 upload_span.set_attribute("bytes_moved", stats.upload_bytes)
                 upload_span.set_attribute(
                     "bytes_skipped", stats.upload_skipped_bytes
@@ -847,6 +971,8 @@ class CodeExecutor:
             payload: dict = {"timeout": timeout}
             if env:
                 payload["env"] = env
+            if limits:
+                payload["limits"] = limits
             if source_code is not None:
                 payload["source_code"] = source_code
             else:
@@ -868,6 +994,7 @@ class CodeExecutor:
             )
             if failure is not None:
                 raise failure
+            self._raise_on_violation(sandbox, hosts, bodies)
         with timer.phase("download"):
             with self.tracer.span("transfer.download") as download_span:
                 merged_files = await self._download_changed(
@@ -910,6 +1037,9 @@ class CodeExecutor:
         trace_id = tracing.current_trace_id()
         if trace_id is not None:
             phases["trace_id"] = trace_id
+        # A clean run ends the lane's consecutive-violation streak (the
+        # repeat-offender trip targets storms, not a mixed workload).
+        self._violation_strikes.pop(sandbox.chip_count, None)
         result = Result(
             stdout=primary.get("stdout", ""),
             stderr=stderr,
@@ -917,8 +1047,51 @@ class CodeExecutor:
             files=merged_files,
             phases=phases,
             warm=bool(primary.get("warm", False)),
+            stdout_truncated=bool(primary.get("stdout_truncated", False)),
+            stderr_truncated=any(
+                bool(b.get("stderr_truncated", False)) for b in bodies
+            ),
         )
         return result, continuable
+
+    def _raise_on_violation(
+        self, sandbox: Sandbox, hosts: list[str], bodies: list[dict]
+    ) -> None:
+        """Map a host-reported typed `violation` into LimitExceededError.
+        `continuable` mirrors the executor's runner_restarted: an in-process
+        guard (runner alive) leaves the host recyclable; a watchdog kill
+        marks it for disposal and a lane-breaker strike."""
+        for base, body in zip(hosts, bodies):
+            kind = body.get("violation")
+            if not kind or not isinstance(kind, str):
+                continue
+            if kind not in VIOLATION_KINDS:
+                # The kind is a metrics label and a wire contract: an
+                # out-of-contract executor (version skew, compromise) must
+                # not mint unbounded label cardinality or leak junk to
+                # clients.
+                logger.warning(
+                    "sandbox %s reported unknown violation kind %.40r",
+                    sandbox.id,
+                    kind,
+                )
+                kind = "unknown"
+            continuable = not bool(body.get("runner_restarted"))
+            tracing.add_event(
+                "limit.violation",
+                kind=kind,
+                lane=sandbox.chip_count,
+                host=base,
+                continuable=continuable,
+            )
+            stderr_tail = str(body.get("stderr", ""))[-500:]
+            raise LimitExceededError(
+                f"sandbox resource limit exceeded: {kind} "
+                f"(sandbox {sandbox.id}); {stderr_tail}".rstrip("; "),
+                kind=kind,
+                lane=sandbox.chip_count,
+                continuable=continuable,
+            )
 
     async def execute_stream(
         self,
@@ -934,6 +1107,7 @@ class CodeExecutor:
         tenant: str | None = None,
         priority: str | None = None,
         deadline: float | None = None,
+        limits: dict | None = None,
     ):
         """Streaming variant of execute(): an async generator yielding
         ``{"stream": "stdout"|"stderr", "data": str}`` events while the code
@@ -944,6 +1118,7 @@ class CodeExecutor:
         the error surfaces and the client decides (same policy as sessions).
         """
         env, executor_id = self._normalize_request(env, profile, executor_id)
+        self._check_admission_open()
         queue: asyncio.Queue = asyncio.Queue()
         done = object()
 
@@ -964,6 +1139,7 @@ class CodeExecutor:
                         tenant=tenant,
                         priority=priority,
                         deadline=deadline,
+                        limits=limits,
                         emit=emit,
                     )
                 return await self._execute_once(
@@ -976,11 +1152,13 @@ class CodeExecutor:
                     tenant=tenant,
                     priority=priority,
                     deadline=deadline,
+                    limits=limits,
                     emit=emit,
                 )
             finally:
                 queue.put_nowait(done)
 
+        self._inflight += 1
         task = asyncio.create_task(run())
         try:
             while True:
@@ -994,6 +1172,9 @@ class CodeExecutor:
                 self.metrics.breaker_rejections.inc(chip_count=str(e.lane))
                 self.metrics.executions.inc(outcome="rejected")
                 raise
+            except LimitExceededError as e:
+                self._count_violation(e)
+                raise
             except SessionLimitError:
                 self.metrics.executions.inc(outcome="rejected")
                 raise
@@ -1005,6 +1186,8 @@ class CodeExecutor:
             # The run task owns sandbox/session cleanup; let it finish it.
             await asyncio.gather(task, return_exceptions=True)
             raise
+        finally:
+            self._inflight -= 1
         self._count_execution(result, session=executor_id is not None)
         yield {"result": result}
 
@@ -1056,6 +1239,7 @@ class CodeExecutor:
         tenant: str | None = None,
         priority: str | None = None,
         deadline: float | None = None,
+        limits: dict | None = None,
         emit=None,
     ) -> Result:
         """Run one request inside the executor_id's session sandbox.
@@ -1069,8 +1253,8 @@ class CodeExecutor:
             raise ValueError(
                 "invalid executor_id (want ^[0-9a-zA-Z_-]{1,255}$)"
             )
-        lane, files, timeout = self._validate_request(
-            source_code, source_file, files, timeout, chip_count
+        lane, files, timeout, limits_payload = self._validate_request(
+            source_code, source_file, files, timeout, chip_count, limits
         )
         timer = PhaseTimer()
         loop = asyncio.get_running_loop()
@@ -1103,8 +1287,18 @@ class CodeExecutor:
                         timeout,
                         env,
                         timer,
+                        limits=limits_payload,
                         emit=emit,
                     )
+                except LimitExceededError as e:
+                    # A violation breaks the session either way: the killed
+                    # runner lost its state, and even an in-process guard
+                    # leaves the workspace in whatever shape the runaway
+                    # left it. Recycle the host only if its runner survived.
+                    self._end_session_soon(
+                        executor_id, session, recycle=e.continuable
+                    )
+                    raise
                 except (ExecutorError, SandboxSpawnError):
                     # The sandbox is unreachable/broken: session state is
                     # already lost — close it so the id can start fresh.
@@ -1755,6 +1949,15 @@ class CodeExecutor:
             # Conditional hit: the host proved it already has this content.
             manifest.record_upload(rel, object_id)
             return
+        if resp.status_code == 413:
+            # The executor's workspace disk quota refused the upload: a
+            # typed, deterministic violation (the host itself is fine —
+            # the PUT was rejected before any damage).
+            raise LimitExceededError(
+                f"upload of {rel} exceeds the workspace disk quota",
+                kind="disk_quota",
+                continuable=True,
+            )
         if resp.status_code != 200:
             raise ExecutorError(
                 f"upload of {rel} failed: {resp.status_code} {resp.text[:200]}"

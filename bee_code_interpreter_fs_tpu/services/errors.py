@@ -14,6 +14,35 @@ class ExecutorError(RuntimeError):
     """Infrastructure-level execution failure (retried, then surfaced)."""
 
 
+class LimitExceededError(RuntimeError):
+    """A sandbox resource limit ended the execution: the executor killed the
+    runner group (or its in-process guard unwound user code) and reported a
+    typed violation. DETERMINISTIC — the same snippet breaches the same
+    budget every time — so deliberately NOT an ExecutorError subclass: the
+    retry ladder must never replay it against a fresh sandbox. Maps to HTTP
+    422 (the request is well-formed but unprocessable within its budget)
+    and gRPC RESOURCE_EXHAUSTED, both carrying the violation kind.
+
+    ``kind`` is one of services.limits.VIOLATION_KINDS; ``continuable`` is
+    True when the warm process survived (an in-process guard fired — e.g.
+    cpu_time via SIGXCPU), False when the runner group was killed, which is
+    what arms the repeat-offender path (host disposed, lane breaker
+    strike)."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        kind: str,
+        lane: int = 0,
+        continuable: bool = False,
+    ) -> None:
+        super().__init__(message)
+        self.kind = kind
+        self.lane = lane
+        self.continuable = continuable
+
+
 class SessionLimitError(RuntimeError):
     """All executor_id session slots are in use (retryable: HTTP 429 /
     gRPC RESOURCE_EXHAUSTED — not a defect in the request itself)."""

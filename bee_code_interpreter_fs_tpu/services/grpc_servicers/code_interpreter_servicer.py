@@ -23,6 +23,7 @@ from ..code_executor import (
     CircuitOpenError,
     CodeExecutor,
     ExecutorError,
+    LimitExceededError,
     SessionLimitError,
 )
 from ..custom_tool_executor import (
@@ -70,14 +71,16 @@ class CodeInterpreterServicer:
         *,
         trace_name: str | None = None,
         metadata: dict | None = None,
-    ) -> tuple[str, object]:
+    ) -> tuple[str, object, list[tuple[str, str]]]:
         """Per-RPC correlation: a fresh request id (logging ContextVar) and,
         for executing RPCs, a root trace span joined from `x-traceparent`
         metadata (the transport-level analogue of the HTTP `traceparent`
         header). Both ids are echoed in TRAILING metadata (`x-request-id` /
         `x-trace-id`) — before this PR the gRPC request id existed only in
         logs. Trailing (not initial) metadata so streaming RPCs carry it
-        too, and because it survives context.abort()."""
+        too, and because it survives context.abort(). The trailing list is
+        returned so error paths (e.g. `x-violation`) can extend it without
+        losing the correlation ids."""
         request_id = new_request_id()
         span = None
         if trace_name is not None:
@@ -94,7 +97,7 @@ class CodeInterpreterServicer:
         set_trailing = getattr(context, "set_trailing_metadata", None)
         if set_trailing is not None:
             set_trailing(tuple(trailing))
-        return request_id, span
+        return request_id, span, trailing
 
     @staticmethod
     async def _admission_from_metadata(
@@ -125,6 +128,46 @@ class CodeInterpreterServicer:
             "priority": metadata.get("x-priority"),
             "deadline": deadline,
         }
+
+    @staticmethod
+    async def _limits_from_metadata(
+        context: grpc.aio.ServicerContext, metadata: dict
+    ) -> dict | None:
+        """Per-request resource-budget override as `x-sandbox-limits`
+        metadata (a JSON object) — the transport-level analogue of the HTTP
+        X-Sandbox-Limits header; the proto is frozen (no codegen in this
+        environment), so the budget rides metadata like tenant/priority do.
+        Key/value validation lives in services.limits (ValueError →
+        INVALID_ARGUMENT on the shared path)."""
+        raw = metadata.get("x-sandbox-limits")
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "x-sandbox-limits metadata must be a JSON object",
+            )
+
+    @staticmethod
+    async def _abort_violation(
+        context: grpc.aio.ServicerContext,
+        e: LimitExceededError,
+        trailing: list[tuple[str, str]],
+    ) -> None:
+        """Typed limit violations map to RESOURCE_EXHAUSTED with the kind
+        both in the message and as `x-violation` trailing metadata (the
+        proto is frozen; metadata is the structured channel). Deterministic
+        — never blind-retry."""
+        trailing = trailing + [("x-violation", e.kind)]
+        set_trailing = getattr(context, "set_trailing_metadata", None)
+        if set_trailing is not None:
+            set_trailing(tuple(trailing))
+        await context.abort(
+            grpc.StatusCode.RESOURCE_EXHAUSTED,
+            f"sandbox resource limit exceeded [violation={e.kind}]: {e}",
+        )
 
     @staticmethod
     async def _validate_execute_request(
@@ -172,7 +215,7 @@ class CodeInterpreterServicer:
         self, request: pb2.ExecuteRequest, context: grpc.aio.ServicerContext
     ) -> pb2.ExecuteResponse:
         metadata = self._metadata_dict(context)
-        request_id, span = self._begin_rpc(
+        request_id, span, trailing = self._begin_rpc(
             context, trace_name="grpc Execute", metadata=metadata
         )
         logger.info("Execute [%s] chip_count=%d", request_id, request.chip_count)
@@ -181,6 +224,7 @@ class CodeInterpreterServicer:
                 request, context
             )
             admission = await self._admission_from_metadata(context, metadata)
+            limits = await self._limits_from_metadata(context, metadata)
             # executor_id pattern validation lives in the executor (its
             # ValueError maps to INVALID_ARGUMENT below, same as the HTTP
             # path).
@@ -194,10 +238,13 @@ class CodeInterpreterServicer:
                     chip_count=request.chip_count or None,
                     profile=request.profile,
                     executor_id=request.executor_id or None,
+                    limits=limits,
                     **admission,
                 )
             except ValueError as e:
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except LimitExceededError as e:
+                await self._abort_violation(context, e, trailing)
             except CircuitOpenError as e:
                 # Degraded mode (spawn circuit open): UNAVAILABLE, mirroring
                 # the HTTP layer's 503 shed — the health service reports
@@ -219,7 +266,7 @@ class CodeInterpreterServicer:
         """Server-streaming Execute: OutputChunk events while the code runs,
         then one `result` event (identical to Execute's response)."""
         metadata = self._metadata_dict(context)
-        request_id, span = self._begin_rpc(
+        request_id, span, trailing = self._begin_rpc(
             context, trace_name="grpc ExecuteStream", metadata=metadata
         )
         logger.info(
@@ -230,6 +277,7 @@ class CodeInterpreterServicer:
                 request, context
             )
             admission = await self._admission_from_metadata(context, metadata)
+            limits = await self._limits_from_metadata(context, metadata)
             events = self.code_executor.execute_stream(
                 request.source_code if has_code else None,
                 source_file=request.source_file if has_file else None,
@@ -239,6 +287,7 @@ class CodeInterpreterServicer:
                 chip_count=request.chip_count or None,
                 profile=request.profile,
                 executor_id=request.executor_id or None,
+                limits=limits,
                 **admission,
             )
             try:
@@ -256,6 +305,8 @@ class CodeInterpreterServicer:
                         )
             except ValueError as e:
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except LimitExceededError as e:
+                await self._abort_violation(context, e, trailing)
             except CircuitOpenError as e:
                 await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             except SessionLimitError as e:
@@ -298,7 +349,7 @@ class CodeInterpreterServicer:
         self, request: pb2.ExecuteCustomToolRequest, context: grpc.aio.ServicerContext
     ) -> pb2.ExecuteCustomToolResponse:
         metadata = self._metadata_dict(context)
-        request_id, span = self._begin_rpc(
+        request_id, span, trailing = self._begin_rpc(
             context, trace_name="grpc ExecuteCustomTool", metadata=metadata
         )
         with span:
@@ -339,6 +390,8 @@ class CodeInterpreterServicer:
                 )
             except ValueError as e:
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except LimitExceededError as e:
+                await self._abort_violation(context, e, trailing)
             except CircuitOpenError as e:
                 await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             except SessionLimitError as e:

@@ -32,6 +32,7 @@ from .code_executor import (
     CircuitOpenError,
     CodeExecutor,
     ExecutorError,
+    LimitExceededError,
     SessionLimitError,
 )
 from .custom_tool_executor import (
@@ -65,6 +66,13 @@ class ExecuteRequest(BaseModel):
     # ge (not gt) to match the header/metadata paths, which the scheduler
     # validates with the same >= 0 rule — one value, one verdict.
     deadline: float | None = Field(default=None, ge=0)
+    # Per-request resource budget override (keys from services.limits:
+    # memory_bytes/cpu_seconds/nproc/nofile/fsize_bytes/disk_bytes/
+    # output_bytes). Layers over the configured default + lane budgets and
+    # is min-clamped by the server caps — only ever tightens. Header
+    # fallback: X-Sandbox-Limits (a JSON object). Breaches return 422 with
+    # the typed violation kind.
+    limits: dict[str, float] | None = None
 
 
 class ParseCustomToolRequest(BaseModel):
@@ -190,6 +198,13 @@ def create_http_app(
 
     @routes.get("/healthz")
     async def healthz(request: web.Request) -> web.Response:
+        if code_executor.draining:
+            # Graceful shutdown in progress: load balancers must stop
+            # sending work here while in-flight executes finish.
+            return web.json_response(
+                {"status": "draining", "reason": "service is shutting down"},
+                status=503,
+            )
         if code_executor.degraded():
             retry_after = max(1, math.ceil(code_executor.degraded_retry_after() or 1.0))
             return web.json_response(
@@ -278,6 +293,38 @@ def create_http_app(
                     )
         return {"tenant": tenant, "priority": priority, "deadline": deadline}
 
+    def limits_param(request: web.Request, req: ExecuteRequest) -> dict | None:
+        """Per-request resource-budget override: body field first, the
+        X-Sandbox-Limits header (JSON object) as the gateway fallback.
+        Value/key validation lives in services.limits — its ValueError maps
+        to 400 on the same path as every other client error."""
+        if req.limits is not None:
+            return req.limits
+        raw = request.headers.get("X-Sandbox-Limits")
+        if raw is None:
+            return None
+        try:
+            parsed = json.loads(raw)
+        except json.JSONDecodeError:
+            raise web.HTTPBadRequest(
+                text=json.dumps(
+                    {"error": "X-Sandbox-Limits must be a JSON object"}
+                ),
+                content_type="application/json",
+            )
+        return parsed
+
+    def violation_response(e: LimitExceededError) -> web.Response:
+        """422 for typed limit violations: the request was well-formed but
+        unprocessable within its resource budget. Deterministic — clients
+        must not blind-retry (no Retry-After on purpose); the body names
+        the violated limit so they can raise their budget or fix the
+        snippet."""
+        return web.json_response(
+            with_trace_id({"error": str(e), "violation": e.kind}),
+            status=422,
+        )
+
     def capacity_response(e: SessionLimitError) -> web.Response:
         """429 for capacity rejections. Admission sheds carry a computed
         Retry-After (queue-depth/EWMA-derived) — surface it as the header so
@@ -310,6 +357,8 @@ def create_http_app(
             "files": result.files,
             "phases": result.phases,
             "warm": result.warm,
+            "stdout_truncated": result.stdout_truncated,
+            "stderr_truncated": result.stderr_truncated,
         }
         return add_session_fields(body, result, req.executor_id)
 
@@ -328,12 +377,15 @@ def create_http_app(
                 chip_count=req.chip_count,
                 profile=req.profile,
                 executor_id=req.executor_id,
+                limits=limits_param(request, req),
                 **admission_params(request, req),
             )
         except ValueError as e:
             return bad_request(str(e))
         except CircuitOpenError as e:
             return shed(e)
+        except LimitExceededError as e:
+            return violation_response(e)
         except SessionLimitError as e:
             # Resource exhaustion, not a request defect: retryable.
             return capacity_response(e)
@@ -361,6 +413,7 @@ def create_http_app(
             chip_count=req.chip_count,
             profile=req.profile,
             executor_id=req.executor_id,
+            limits=limits_param(request, req),
             **admission_params(request, req),
         )
         # Correlation headers must land BEFORE prepare() on a stream (the
@@ -397,6 +450,16 @@ def create_http_app(
                 return shed(e)
             await response.write(
                 (json.dumps({"error": str(e)}) + "\n").encode("utf-8")
+            )
+        except LimitExceededError as e:
+            # Mid-stream the violation rides the final NDJSON event (the
+            # output already streamed is exactly what ran before the kill).
+            if not started:
+                return violation_response(e)
+            await response.write(
+                (
+                    json.dumps({"error": str(e), "violation": e.kind}) + "\n"
+                ).encode("utf-8")
             )
         except SessionLimitError as e:
             if not started:
@@ -474,6 +537,8 @@ def create_http_app(
             return bad_request(str(e))
         except CircuitOpenError as e:
             return shed(e)
+        except LimitExceededError as e:
+            return violation_response(e)
         except SessionLimitError as e:
             return capacity_response(e)
         except (ExecutorError, SandboxSpawnError) as e:

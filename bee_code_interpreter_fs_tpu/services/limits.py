@@ -1,0 +1,152 @@
+"""Control-plane half of sandbox resource governance.
+
+The executor (executor/limits.hpp + server.cpp) enforces budgets and kills
+runaway runner groups with a typed ``violation`` in the execute response;
+this module owns everything the control plane decides BEFORE that wire hop:
+
+- the closed set of violation kinds both halves agree on,
+- validation of client-supplied limit overrides (unknown keys and
+  non-positive values are client errors, not silent no-ops),
+- the budget pipeline: built-in defaults -> per-lane overrides ->
+  per-request overrides, min-clamped by the operator's server caps (a
+  request may only ever tighten policy),
+- the APP_LIMIT_* environment both backends boot their sandboxes with (the
+  executor-side caps that make the clamp trustworthy even against a
+  compromised control plane).
+
+``APP_SANDBOX_LIMITS_ENABLED=0`` is the kill switch: no limits payload is
+sent, no APP_LIMIT_* env is set, and the service behaves exactly as before
+this subsystem existed.
+"""
+
+from __future__ import annotations
+
+from ..config import Config
+
+# The closed set of typed limit violations the executor reports. Order is
+# cosmetic; membership is contract (faults.py validates injected kinds
+# against it, tests iterate it).
+VIOLATION_KINDS = ("oom", "disk_quota", "nproc", "cpu_time", "output_cap")
+
+# Budget keys -> (python type, executor cap env var). cpu_seconds is a
+# float; everything else is integer bytes/counts.
+_LIMIT_KEYS: dict[str, tuple[type, str | None]] = {
+    "memory_bytes": (int, "APP_LIMIT_MEMORY_BYTES"),
+    "cpu_seconds": (float, "APP_LIMIT_CPU_SECONDS"),
+    "nproc": (int, "APP_LIMIT_NPROC"),
+    "nofile": (int, "APP_LIMIT_NOFILE"),
+    "fsize_bytes": (int, "APP_LIMIT_FSIZE_BYTES"),
+    "disk_bytes": (int, "APP_LIMIT_DISK_BYTES"),
+    "output_bytes": (int, None),  # capped by APP_MAX_OUTPUT_BYTES instead
+}
+
+LIMIT_KEYS = tuple(_LIMIT_KEYS)
+
+
+def parse_limits(raw: object, *, source: str = "limits") -> dict[str, float]:
+    """Validate a limits mapping (request override or config budget) into
+    {key: positive number}. Raises ValueError — mapped to HTTP 400 / gRPC
+    INVALID_ARGUMENT on the API surfaces — on anything malformed: a typo'd
+    key silently enforcing nothing is itself a containment bug."""
+    if raw is None:
+        return {}
+    if not isinstance(raw, dict):
+        raise ValueError(f"{source} must be an object of budget values")
+    out: dict[str, float] = {}
+    for key, value in raw.items():
+        spec = _LIMIT_KEYS.get(key)
+        if spec is None:
+            raise ValueError(
+                f"unknown {source} key {key!r} (want one of {sorted(_LIMIT_KEYS)})"
+            )
+        kind = spec[0]
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise ValueError(f"{source}.{key} must be a number")
+        if value <= 0:
+            raise ValueError(f"{source}.{key} must be > 0 (omit to disable)")
+        if kind is int and float(value) != int(value):
+            # int() would truncate 0.5 -> 0 = "limit off": the exact silent
+            # no-op this validator exists to refuse.
+            raise ValueError(f"{source}.{key} must be an integer")
+        out[key] = kind(value)
+    return out
+
+
+def _merge(*layers: dict[str, float]) -> dict[str, float]:
+    merged: dict[str, float] = {}
+    for layer in layers:
+        merged.update(layer)
+    return merged
+
+
+def _clamp(limits: dict[str, float], caps: dict[str, float]) -> dict[str, float]:
+    """Tighten-only: where a cap exists, the smaller value wins."""
+    return {
+        key: min(value, caps[key]) if key in caps else value
+        for key, value in limits.items()
+    }
+
+
+def request_limits(
+    config: Config, lane: int, overrides: dict | None
+) -> dict[str, float] | None:
+    """The effective limits payload for one execute request: defaults ->
+    lane budget -> request overrides, clamped by the server caps. None when
+    governance is disabled or nothing is configured (the executor then runs
+    the request exactly as before this subsystem).
+
+    Raises ValueError on malformed overrides/config — at validation time,
+    before any pool machinery runs."""
+    if not config.sandbox_limits_enabled:
+        return None
+    base = parse_limits(config.sandbox_default_limits, source="sandbox_default_limits")
+    lane_raw = config.sandbox_lane_limits.get(str(lane), {})
+    lane_over = parse_limits(lane_raw, source=f"sandbox_lane_limits[{lane}]")
+    req = parse_limits(overrides, source="limits")
+    caps = parse_limits(config.sandbox_limit_caps, source="sandbox_limit_caps")
+    effective = _clamp(_merge(base, lane_over, req), caps)
+    return effective or None
+
+
+def validate_config_limits(config: Config) -> None:
+    """Fail fast at BOOT on malformed operator limit config. Without this,
+    a typo'd key in APP_SANDBOX_DEFAULT_LIMITS would boot cleanly and then
+    fail every execute as a client 400 (and a bad caps dict would surface
+    as spawn failures striking the breaker) — an operator mistake
+    masquerading as client error. Called from CodeExecutor.__init__."""
+    parse_limits(config.sandbox_default_limits, source="sandbox_default_limits")
+    parse_limits(config.sandbox_limit_caps, source="sandbox_limit_caps")
+    if not isinstance(config.sandbox_lane_limits, dict):
+        raise ValueError("sandbox_lane_limits must be an object keyed by lane")
+    for lane, raw in config.sandbox_lane_limits.items():
+        try:
+            valid_key = str(int(str(lane))) == str(lane) and int(str(lane)) >= 0
+        except ValueError:
+            valid_key = False
+        if not valid_key:
+            # request_limits looks budgets up by str(lane): a key that can
+            # never match ("lane4", " 4") would silently enforce nothing.
+            raise ValueError(
+                f"sandbox_lane_limits key {lane!r} is not a chip-count lane "
+                "(want a non-negative integer as a string)"
+            )
+        parse_limits(raw, source=f"sandbox_lane_limits[{lane}]")
+
+
+def sandbox_limit_env(config: Config) -> dict[str, str]:
+    """APP_LIMIT_* (+ the output cap knob) for a sandbox's boot environment.
+    The env values are the executor-side caps-and-defaults: they clamp every
+    request the sandbox will ever see, so even a control plane that stops
+    clamping cannot loosen a running sandbox's policy."""
+    env = {"APP_MAX_OUTPUT_BYTES": str(int(config.sandbox_max_output_bytes))}
+    if not config.sandbox_limits_enabled:
+        return env
+    caps = parse_limits(config.sandbox_limit_caps, source="sandbox_limit_caps")
+    for key, (kind, env_name) in _LIMIT_KEYS.items():
+        if env_name is None or key not in caps:
+            continue
+        value = caps[key]
+        env[env_name] = (
+            f"{value:g}" if kind is float else str(int(value))
+        )
+    return env

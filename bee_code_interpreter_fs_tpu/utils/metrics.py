@@ -255,6 +255,13 @@ class ExecutorMetrics:
             "Requests failed fast because a lane's spawn circuit was open.",
             ("chip_count",),
         )
+        self.limit_violations = self.registry.counter(
+            "code_interpreter_limit_violations_total",
+            "Typed sandbox resource-limit violations by chip-count lane and "
+            "kind (oom/disk_quota/nproc/cpu_time/output_cap). Deterministic "
+            "client overruns, never retried.",
+            ("chip_count", "kind"),
+        )
         self.scheduler_queue_wait = self.registry.histogram(
             "code_interpreter_scheduler_queue_wait_seconds",
             "Seconds a request queued for a sandbox slot before its grant, "

@@ -1,0 +1,482 @@
+// Resource governance for sandbox executions: typed limit specs (env caps
+// clamping per-request overrides), rlimit application for the cold-subprocess
+// child, /proc-based process-tree accounting, and the execution watchdog that
+// kills a runaway runner group with a TYPED violation instead of letting it
+// take the host (and, on shared nodes, its neighbors) down.
+//
+// Violation kinds (the closed set both halves of the service agree on):
+//   oom        — runner-group RSS exceeded its budget (beyond the warm
+//                runner's pre-existing baseline)
+//   disk_quota — workspace disk usage exceeded its quota
+//   nproc      — live descendant-process count exceeded its bound (fork bomb)
+//   cpu_time   — cumulative group CPU time exceeded its budget
+//   output_cap — a stdout/stderr capture file outgrew the output cap
+//
+// Env caps (APP_LIMIT_*): operator policy from the sandbox's boot env. They
+// are both the default budget and the ceiling — a request's `limits` object
+// can only LOWER them (min-clamp), never raise them, so the very snippets the
+// guardrail targets cannot turn it off. 0 = that limit is off.
+
+#ifndef EXECUTOR_LIMITS_HPP_
+#define EXECUTOR_LIMITS_HPP_
+
+#include <dirent.h>
+#include <signal.h>
+#include <sys/resource.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "json.hpp"
+
+namespace limits {
+
+inline const char* kOom = "oom";
+inline const char* kDiskQuota = "disk_quota";
+inline const char* kNproc = "nproc";
+inline const char* kCpuTime = "cpu_time";
+inline const char* kOutputCap = "output_cap";
+
+// One execution's effective resource budget. 0 everywhere = ungoverned (the
+// pre-governance behavior, and the kill-switch state).
+struct LimitSpec {
+  long long memory_bytes = 0;  // group RSS beyond the warm baseline
+  double cpu_seconds = 0;      // cumulative group CPU beyond the baseline
+  long long nproc = 0;         // max live descendant processes
+  long long nofile = 0;        // RLIMIT_NOFILE (soft) around user code
+  long long fsize_bytes = 0;   // RLIMIT_FSIZE (soft) around user code
+  long long disk_bytes = 0;    // workspace disk-usage quota
+  long long output_bytes = 0;  // per-stream stdout/stderr capture cap
+
+  bool any() const {
+    return memory_bytes > 0 || cpu_seconds > 0 || nproc > 0 || nofile > 0 ||
+           fsize_bytes > 0 || disk_bytes > 0 || output_bytes > 0;
+  }
+};
+
+inline long long env_ll(const char* name) {
+  const char* v = getenv(name);
+  if (!v || !*v) return 0;
+  long long out = atoll(v);
+  return out > 0 ? out : 0;
+}
+
+inline double env_d(const char* name) {
+  const char* v = getenv(name);
+  if (!v || !*v) return 0;
+  double out = atof(v);
+  return out > 0 ? out : 0;
+}
+
+// The server's caps-and-defaults, read once at boot.
+inline LimitSpec caps_from_env() {
+  LimitSpec caps;
+  caps.memory_bytes = env_ll("APP_LIMIT_MEMORY_BYTES");
+  caps.cpu_seconds = env_d("APP_LIMIT_CPU_SECONDS");
+  caps.nproc = env_ll("APP_LIMIT_NPROC");
+  caps.nofile = env_ll("APP_LIMIT_NOFILE");
+  caps.fsize_bytes = env_ll("APP_LIMIT_FSIZE_BYTES");
+  caps.disk_bytes = env_ll("APP_LIMIT_DISK_BYTES");
+  // output_bytes is seeded by the caller from APP_MAX_OUTPUT_BYTES (the
+  // pre-existing knob), not here — one source of truth for the cap.
+  return caps;
+}
+
+// Per-request overrides from the /execute body's `limits` object. Unknown
+// keys are ignored (wire-compat with future kinds); non-positive values mean
+// "no override".
+inline LimitSpec from_json(const minijson::Value& v) {
+  LimitSpec req;
+  if (!v.is_object()) return req;
+  long long n;
+  if ((n = static_cast<long long>(v.get_number("memory_bytes", 0))) > 0)
+    req.memory_bytes = n;
+  double c = v.get_number("cpu_seconds", 0);
+  if (c > 0) req.cpu_seconds = c;
+  if ((n = static_cast<long long>(v.get_number("nproc", 0))) > 0) req.nproc = n;
+  if ((n = static_cast<long long>(v.get_number("nofile", 0))) > 0)
+    req.nofile = n;
+  if ((n = static_cast<long long>(v.get_number("fsize_bytes", 0))) > 0)
+    req.fsize_bytes = n;
+  if ((n = static_cast<long long>(v.get_number("disk_bytes", 0))) > 0)
+    req.disk_bytes = n;
+  if ((n = static_cast<long long>(v.get_number("output_bytes", 0))) > 0)
+    req.output_bytes = n;
+  return req;
+}
+
+// Tighten-only merge: where the cap is set, the request may only lower it;
+// where the cap is off (0), the request's own bound applies as-is (a client
+// may always volunteer a tighter box than the operator demands).
+inline long long clamp_ll(long long req, long long cap) {
+  if (cap <= 0) return req;
+  if (req <= 0) return cap;
+  return req < cap ? req : cap;
+}
+
+inline double clamp_d(double req, double cap) {
+  if (cap <= 0) return req;
+  if (req <= 0) return cap;
+  return req < cap ? req : cap;
+}
+
+inline LimitSpec clamp(const LimitSpec& req, const LimitSpec& caps) {
+  LimitSpec eff;
+  eff.memory_bytes = clamp_ll(req.memory_bytes, caps.memory_bytes);
+  eff.cpu_seconds = clamp_d(req.cpu_seconds, caps.cpu_seconds);
+  eff.nproc = clamp_ll(req.nproc, caps.nproc);
+  eff.nofile = clamp_ll(req.nofile, caps.nofile);
+  eff.fsize_bytes = clamp_ll(req.fsize_bytes, caps.fsize_bytes);
+  eff.disk_bytes = clamp_ll(req.disk_bytes, caps.disk_bytes);
+  eff.output_bytes = clamp_ll(req.output_bytes, caps.output_bytes);
+  return eff;
+}
+
+// Applies the setrlimit set in a freshly-forked child, before exec. Soft AND
+// hard are set: the cold subprocess is wholly the user's, so unlike the warm
+// runner's soft-only window there is no post-run restore to protect.
+// RLIMIT_NPROC is best-effort (root bypasses it; the watchdog is the
+// enforcement backstop either way).
+//
+// memory_bytes is deliberately NOT mapped to RLIMIT_AS here: the budget
+// means "bytes beyond the baseline" everywhere else (the warm runner's
+// rlimit window and the watchdog both subtract one), and an ABSOLUTE
+// address-space cap of a realistic extra-window size would kill the cold
+// interpreter at import time. Memory in the cold path is the watchdog's
+// job (its first sample of the fresh child is the baseline).
+//
+// SIGXFSZ is set to SIG_IGN — ignored dispositions survive execve — so an
+// RLIMIT_FSIZE breach surfaces in user code as a clean OSError(EFBIG),
+// exactly like the warm runner's handling, instead of an unexplained
+// signal death.
+inline void apply_child_rlimits(const LimitSpec& spec) {
+  auto set = [](int which, rlim_t value) {
+    struct rlimit rl;
+    if (getrlimit(which, &rl) != 0) return;
+    if (rl.rlim_max != RLIM_INFINITY && value > rl.rlim_max)
+      value = rl.rlim_max;
+    rl.rlim_cur = value;
+    if (rl.rlim_max == RLIM_INFINITY || value > rl.rlim_max) rl.rlim_max = value;
+    setrlimit(which, &rl);
+  };
+  // Soft-only lowerer for RLIMIT_CPU: the kernel SIGKILLs at the HARD cpu
+  // limit but sends the catchable/classifiable SIGXCPU at the soft one —
+  // collapsing hard onto soft would turn every cold-path CPU breach into
+  // an untyped exit-137 instead of the 128+SIGXCPU the server classifies
+  // as cpu_time.
+  auto lower_soft = [](int which, rlim_t value) {
+    struct rlimit rl;
+    if (getrlimit(which, &rl) != 0) return;
+    if (rl.rlim_max != RLIM_INFINITY && value > rl.rlim_max)
+      value = rl.rlim_max;
+    if (rl.rlim_cur == RLIM_INFINITY || value < rl.rlim_cur) {
+      rl.rlim_cur = value;
+      setrlimit(which, &rl);
+    }
+  };
+  if (spec.cpu_seconds > 0)
+    lower_soft(RLIMIT_CPU, static_cast<rlim_t>(spec.cpu_seconds + 0.999));
+  if (spec.nproc > 0) set(RLIMIT_NPROC, static_cast<rlim_t>(spec.nproc));
+  if (spec.nofile > 0) set(RLIMIT_NOFILE, static_cast<rlim_t>(spec.nofile));
+  if (spec.fsize_bytes > 0) {
+    signal(SIGXFSZ, SIG_IGN);
+    set(RLIMIT_FSIZE, static_cast<rlim_t>(spec.fsize_bytes));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// /proc process-tree accounting.
+
+struct TreeStats {
+  long long rss_bytes = 0;  // whole tree, root included
+  double cpu_seconds = 0;   // utime+stime of live members + root's reaped
+                            // children (cutime/cstime) — a fork bomb's dead
+                            // generations still count
+  int descendants = 0;      // live processes under root (root excluded)
+};
+
+// One pass over /proc: parent map + per-pid (rss, cpu, reaped-child cpu).
+// Returns false when /proc is unreadable (stats stay zero — the watchdog
+// then simply cannot see, it never false-positives).
+inline bool sample_tree(pid_t root, TreeStats& out) {
+  DIR* d = opendir("/proc");
+  if (!d) return false;
+  struct Row {
+    pid_t ppid;
+    long long rss;
+    double cpu;
+    double reaped_cpu;
+  };
+  std::map<pid_t, Row> rows;
+  long page = sysconf(_SC_PAGESIZE);
+  long hz = sysconf(_SC_CLK_TCK);
+  if (hz <= 0) hz = 100;
+  while (dirent* e = readdir(d)) {
+    if (e->d_name[0] < '0' || e->d_name[0] > '9') continue;
+    pid_t pid = static_cast<pid_t>(atoi(e->d_name));
+    char path[64];
+    snprintf(path, sizeof(path), "/proc/%d/stat", pid);
+    FILE* f = fopen(path, "r");
+    if (!f) continue;
+    char buf[1024];
+    size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+    fclose(f);
+    if (n == 0) continue;
+    buf[n] = 0;
+    // Fields after the parenthesized comm (which may contain spaces):
+    // state ppid pgrp session tty tpgid flags minflt cminflt majflt cmajflt
+    // utime stime cutime cstime ... (22) rss
+    char* close_paren = strrchr(buf, ')');
+    if (!close_paren) continue;
+    const char* rest = close_paren + 1;
+    char state;
+    long ppid;
+    unsigned long long utime, stime;
+    long long cutime, cstime;
+    unsigned long long skip_u;
+    long long rss_pages = 0;
+    // state(1) ppid(2) pgrp session tty tpgid flags minflt cminflt majflt
+    // cmajflt utime(12) stime(13) cutime(14) cstime(15) priority nice
+    // num_threads itrealvalue starttime vsize(21) rss(22)
+    int matched = sscanf(
+        rest,
+        " %c %ld %*d %*d %*d %*d %*u %*u %*u %*u %*u %llu %llu %lld %lld "
+        "%*d %*d %*d %*d %*u %llu %lld",
+        &state, &ppid, &utime, &stime, &cutime, &cstime, &skip_u, &rss_pages);
+    if (matched < 8) continue;
+    rows[pid] = Row{static_cast<pid_t>(ppid),
+                    rss_pages * static_cast<long long>(page),
+                    static_cast<double>(utime + stime) / hz,
+                    static_cast<double>(cutime + cstime) / hz};
+  }
+  closedir(d);
+  auto root_row = rows.find(root);
+  if (root_row == rows.end()) return false;
+  std::map<pid_t, std::vector<pid_t>> children;
+  for (const auto& [pid, row] : rows) children[row.ppid].push_back(pid);
+  std::vector<pid_t> stack = {root};
+  bool first = true;
+  while (!stack.empty()) {
+    pid_t pid = stack.back();
+    stack.pop_back();
+    const Row& row = rows[pid];
+    out.rss_bytes += row.rss;
+    out.cpu_seconds += row.cpu + row.reaped_cpu;
+    if (!first) out.descendants += 1;
+    first = false;
+    auto it = children.find(pid);
+    if (it != children.end())
+      for (pid_t child : it->second) stack.push_back(child);
+  }
+  return true;
+}
+
+// Recursive workspace disk usage (allocated blocks, not nominal size — a
+// sparse-file trick must not count as a quota breach the kernel never paid
+// for). Symlinks are lstat'ed, never followed.
+inline long long dir_usage_bytes(const std::string& base) {
+  long long total = 0;
+  std::vector<std::string> stack = {base};
+  while (!stack.empty()) {
+    std::string dir = stack.back();
+    stack.pop_back();
+    DIR* d = opendir(dir.c_str());
+    if (!d) continue;
+    while (dirent* e = readdir(d)) {
+      std::string name = e->d_name;
+      if (name == "." || name == "..") continue;
+      std::string full = dir + "/" + name;
+      struct stat st;
+      if (lstat(full.c_str(), &st) != 0) continue;
+      total += static_cast<long long>(st.st_blocks) * 512;
+      if (S_ISDIR(st.st_mode)) stack.push_back(full);
+    }
+    closedir(d);
+  }
+  return total;
+}
+
+// ---------------------------------------------------------------------------
+// The execution watchdog: a sampling thread that enforces the spec against a
+// live runner group and kills the WHOLE group (SIGKILL to the session/pgid)
+// on the first breach, recording which limit fired. Baselines (the warm
+// runner's own RSS/CPU, jax included) are subtracted so the budget governs
+// only what THIS execution added.
+
+class Watchdog {
+ public:
+  Watchdog(LimitSpec spec, pid_t group_leader, std::string workspace,
+           std::vector<std::string> capture_paths, double interval_s)
+      : spec_(spec),
+        leader_(group_leader),
+        workspace_(std::move(workspace)),
+        capture_paths_(std::move(capture_paths)),
+        interval_s_(interval_s > 0 ? interval_s : 0.1) {
+    // Baseline only when a tree-watching limit is armed: an ungoverned
+    // request must not pay a /proc scan just for constructing the (inert)
+    // watchdog on its stack.
+    TreeStats base;
+    if (group_leader > 0 &&
+        (spec_.memory_bytes > 0 || spec_.nproc > 0 || spec_.cpu_seconds > 0) &&
+        sample_tree(group_leader, base)) {
+      rss_baseline_ = base.rss_bytes;
+      cpu_baseline_ = base.cpu_seconds;
+      baseline_ready_ = true;
+    }
+  }
+
+  ~Watchdog() { stop(); }
+
+  // Late leader binding for the cold-subprocess path: the child pid only
+  // exists after run_subprocess forks, while the watchdog thread must
+  // already be running (the fork happens inside a blocking call). A fresh
+  // child has no meaningful baseline — the first sample serves as one.
+  void set_leader(pid_t leader) { leader_.store(leader); }
+
+  bool watches_anything() const {
+    return spec_.memory_bytes > 0 || spec_.nproc > 0 || spec_.cpu_seconds > 0 ||
+           spec_.disk_bytes > 0 || spec_.output_bytes > 0;
+  }
+
+  void start() {
+    if (!watches_anything() || running_.load()) return;
+    running_.store(true);
+    thread_ = std::thread([this] { run(); });
+  }
+
+  void stop() {
+    running_.store(false);
+    if (thread_.joinable()) thread_.join();
+  }
+
+  // The kind that fired, or "" when the run stayed inside its box.
+  std::string violation() const {
+    const char* kind = violation_.load();
+    return kind ? std::string(kind) : std::string();
+  }
+
+ private:
+  // Lock-free on purpose: one Watchdog lives on the request-handler's
+  // stack per execute, and TSan cannot see a trivially-destructed
+  // std::mutex die — sequential requests reusing the same stack slot read
+  // as mutex misuse. Atomics + a short sleep tick sidestep the whole
+  // class of problem; stop() latency is bounded by one 10 ms tick.
+  void run() {
+    const double tick_s = 0.01;
+    double since_check = interval_s_;  // first check happens immediately
+    while (running_.load()) {
+      if (since_check + 1e-9 >= interval_s_) {
+        since_check = 0;
+        const char* kind = check_once();
+        if (kind) {
+          violation_.store(kind);
+          // A breach can land before the cold child exists (disk/output
+          // checks run leaderless pre-fork): park until the leader binds
+          // so the verdict is enforced, not just recorded — an
+          // unsupervised run labeled "violation" would be a lie.
+          while (running_.load()) {
+            pid_t leader = leader_.load();
+            if (leader > 0) {
+              kill(-leader, SIGKILL);
+              return;  // one breach is terminal; the group is dead
+            }
+            usleep(static_cast<useconds_t>(tick_s * 1e6));
+          }
+          return;
+        }
+      }
+      usleep(static_cast<useconds_t>(tick_s * 1e6));
+      since_check += tick_s;
+    }
+  }
+
+  const char* check_once() {
+    pid_t leader = leader_.load();
+    if (leader > 0 &&
+        (spec_.memory_bytes > 0 || spec_.nproc > 0 || spec_.cpu_seconds > 0)) {
+      TreeStats now;
+      if (sample_tree(leader, now)) {
+        if (!baseline_ready_) {
+          rss_baseline_ = now.rss_bytes;
+          cpu_baseline_ = now.cpu_seconds;
+          baseline_ready_ = true;
+        }
+        // Same layering as CPU: the runner's in-process rlimit window
+        // fires at the budget with a clean MemoryError; the watchdog's
+        // threshold carries slack so it only kills when user code dodged
+        // the soft layer (raised its own rlimit, native allocs, children).
+        if (spec_.memory_bytes > 0 &&
+            now.rss_bytes - rss_baseline_ >
+                spec_.memory_bytes + mem_slack(spec_.memory_bytes))
+          return kOom;
+        if (spec_.nproc > 0 && now.descendants > spec_.nproc) return kNproc;
+        // The in-process soft-CPU guard (runner.py SIGXCPU) and the cold
+        // child's RLIMIT_CPU fire first and report cleanly; the watchdog's
+        // threshold carries slack so it only acts when user code dodged
+        // them (native spin, masked signals).
+        if (spec_.cpu_seconds > 0 &&
+            now.cpu_seconds - cpu_baseline_ >
+                spec_.cpu_seconds + cpu_slack(spec_.cpu_seconds))
+          return kCpuTime;
+      }
+    }
+    if (spec_.disk_bytes > 0 && ++disk_countdown_ >= disk_check_every()) {
+      // The disk check is a full recursive walk — throttle it to ~4 Hz
+      // even when the tree-stat cadence is tighter (the post-exec scan
+      // catches anything a coarser cadence misses).
+      disk_countdown_ = 0;
+      if (dir_usage_bytes(workspace_) > spec_.disk_bytes) return kDiskQuota;
+    }
+    if (spec_.output_bytes > 0) {
+      for (const auto& path : capture_paths_) {
+        struct stat st;
+        if (stat(path.c_str(), &st) == 0 &&
+            static_cast<long long>(st.st_size) > spec_.output_bytes)
+          return kOutputCap;
+      }
+    }
+    return nullptr;
+  }
+
+  static double cpu_slack(double budget) {
+    double slack = budget * 0.5;
+    return slack > 2.0 ? slack : 2.0;
+  }
+
+  static long long mem_slack(long long budget) {
+    long long slack = budget / 2;
+    const long long floor = 32LL << 20;
+    return slack > floor ? slack : floor;
+  }
+
+  int disk_check_every() const {
+    int every = static_cast<int>(0.25 / interval_s_);
+    return every > 1 ? every : 1;
+  }
+
+  LimitSpec spec_;
+  std::atomic<pid_t> leader_;
+  std::string workspace_;
+  std::vector<std::string> capture_paths_;
+  double interval_s_;
+  long long rss_baseline_ = 0;
+  double cpu_baseline_ = 0;
+  bool baseline_ready_ = false;
+  int disk_countdown_ = 1 << 20;  // first armed check runs immediately
+  std::atomic<bool> running_{false};
+  std::atomic<const char*> violation_{nullptr};
+  std::thread thread_;
+};
+
+}  // namespace limits
+
+#endif  // EXECUTOR_LIMITS_HPP_

@@ -145,7 +145,29 @@ def _resolve_mem_budget() -> int:
         return 0
 
 
-def _apply_user_rlimits():
+class _CpuTimeExceeded(BaseException):
+    """Raised by the SIGXCPU handler when the per-request CPU budget runs
+    out: a BaseException so user-code `except Exception` blocks can't
+    swallow the limit, unwinding to _run_one which reports the typed
+    `cpu_time` violation — the warm process (and its device lease) stays
+    alive, unlike the executor watchdog's group kill."""
+
+
+def _request_limit(limits: dict, key: str, env_value: int) -> int:
+    """Effective in-process bound: request value min-clamped by the env
+    budget (operator policy may only be tightened, never raised)."""
+    try:
+        requested = int(limits.get(key) or 0)
+    except (TypeError, ValueError):
+        requested = 0
+    if requested <= 0:
+        return env_value
+    if env_value <= 0:
+        return requested
+    return min(requested, env_value)
+
+
+def _apply_user_rlimits(limits: dict | None = None):
     """Bound the user script with soft rlimits; returns a restore thunk.
 
     RLIMIT_AS soft = current VmSize + budget: an allocation bomb inside
@@ -156,40 +178,81 @@ def _apply_user_rlimits():
     future mmap including benign ones. RLIMIT_NOFILE soft comes from
     APP_MAX_OPEN_FILES (0 = inherit).
 
+    `limits` is the per-request budget the executor server forwards
+    (memory_bytes / cpu_seconds / nofile / fsize_bytes) — request values
+    only ever TIGHTEN the env policy. cpu_seconds arms a soft RLIMIT_CPU at
+    (current process CPU + budget) with a SIGXCPU handler that raises
+    _CpuTimeExceeded, and fsize_bytes arms a soft RLIMIT_FSIZE with SIGXFSZ
+    ignored so an oversized write surfaces as OSError(EFBIG) instead of the
+    default signal killing the warm process.
+
     Soft-only on purpose: the hard limits stay put so the post-run restore
     works without privilege. This is a guardrail against runaway agent
     snippets, not a security boundary (user code could raise its own soft
-    limit — same residual-risk contract as _reset's). The kubernetes
-    backend bounds memory with container resources instead; the reference
-    delegates isolation wholesale to the cluster runtime (README.md:56-57).
+    limit — the executor's watchdog is the backstop; same residual-risk
+    contract as _reset's). The kubernetes backend bounds memory with
+    container resources instead; the reference delegates isolation
+    wholesale to the cluster runtime (README.md:56-57).
     """
     import resource
+    import signal as _signal
 
+    limits = limits or {}
     restores = []
-    budget = _resolve_mem_budget()
+    signal_restores = []
+
+    def lower_soft(which, target) -> None:
+        soft, hard = resource.getrlimit(which)
+        if hard != resource.RLIM_INFINITY:
+            target = min(target, hard)
+        if soft == resource.RLIM_INFINITY or target < soft:
+            resource.setrlimit(which, (target, hard))
+            restores.append((which, (soft, hard)))
+
+    budget = _request_limit(limits, "memory_bytes", _resolve_mem_budget())
     if budget > 0:
         try:
             with open("/proc/self/statm") as f:
                 vm_bytes = int(f.read().split()[0]) * os.sysconf("SC_PAGE_SIZE")
-            soft, hard = resource.getrlimit(resource.RLIMIT_AS)
-            ceiling = vm_bytes + budget
-            if hard != resource.RLIM_INFINITY:
-                ceiling = min(ceiling, hard)
-            if soft == resource.RLIM_INFINITY or ceiling < soft:
-                resource.setrlimit(resource.RLIMIT_AS, (ceiling, hard))
-                restores.append((resource.RLIMIT_AS, (soft, hard)))
+            lower_soft(resource.RLIMIT_AS, vm_bytes + budget)
         except (OSError, ValueError):
             pass
     nofile_raw = os.environ.get("APP_MAX_OPEN_FILES", "").strip()
-    if nofile_raw.isdigit() and int(nofile_raw) > 0:
+    nofile_env = int(nofile_raw) if nofile_raw.isdigit() else 0
+    nofile = _request_limit(limits, "nofile", nofile_env)
+    if nofile > 0:
         try:
-            soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
-            target = int(nofile_raw)
-            if hard != resource.RLIM_INFINITY:
-                target = min(target, hard)
-            if target < soft:
-                resource.setrlimit(resource.RLIMIT_NOFILE, (target, hard))
-                restores.append((resource.RLIMIT_NOFILE, (soft, hard)))
+            lower_soft(resource.RLIMIT_NOFILE, nofile)
+        except (OSError, ValueError):
+            pass
+    fsize = _request_limit(limits, "fsize_bytes", 0)
+    if fsize > 0:
+        try:
+            lower_soft(resource.RLIMIT_FSIZE, fsize)
+            saved = _signal.signal(_signal.SIGXFSZ, _signal.SIG_IGN)
+            signal_restores.append((_signal.SIGXFSZ, saved))
+        except (OSError, ValueError):
+            pass
+    try:
+        cpu_budget = float(limits.get("cpu_seconds") or 0)
+    except (TypeError, ValueError):
+        cpu_budget = 0.0
+    if cpu_budget > 0:
+        try:
+            usage = resource.getrusage(resource.RUSAGE_SELF)
+            spent = usage.ru_utime + usage.ru_stime
+
+            def on_xcpu(signum, frame):
+                raise _CpuTimeExceeded(
+                    f"CPU time limit ({cpu_budget:.0f}s) exceeded"
+                )
+
+            saved = _signal.signal(_signal.SIGXCPU, on_xcpu)
+            signal_restores.append((_signal.SIGXCPU, saved))
+            # RLIMIT_CPU has whole-second granularity and counts the whole
+            # process, so the soft ceiling rides on top of what the warm
+            # runner has already spent.
+            lower_soft(resource.RLIMIT_CPU, int(spent + cpu_budget) + 1)
         except (OSError, ValueError):
             pass
 
@@ -201,6 +264,12 @@ def _apply_user_rlimits():
             try:
                 resource.setrlimit(lim, vals)
             except (OSError, ValueError):
+                pass
+        while signal_restores:
+            signum, handler = signal_restores.pop()
+            try:
+                _signal.signal(signum, handler)
+            except (ValueError, TypeError, OSError):
                 pass
 
     return restore
@@ -236,7 +305,10 @@ def _import_sibling(name: str):
         sys.path.pop(0)
 
 
-def _run_one(req: dict) -> int:
+def _run_one(req: dict) -> tuple[int, str | None]:
+    """Execute one request; returns (exit_code, violation) where violation
+    is the typed limit kind when an in-process resource guard ended the run
+    (None otherwise — including plain user errors)."""
     source_path = req["source_path"]
     run_path = source_path
     try:
@@ -268,8 +340,14 @@ def _run_one(req: dict) -> int:
     os.close(err_fd)
     saved_argv = sys.argv
     exit_code = 0
+    violation = None
+    limits = req.get("limits") or {}
+    # Is a memory budget actually armed? A MemoryError under an armed window
+    # is the oom violation caught cleanly; without one it is ordinary user
+    # code raising (or exhausting the host for real — the watchdog's case).
+    mem_limited = _request_limit(limits, "memory_bytes", _resolve_mem_budget()) > 0
     trace_dir = _start_profile() if _profile_requested(env) else None
-    restore_rlimits = _apply_user_rlimits()
+    restore_rlimits = _apply_user_rlimits(limits)
     # User code may rebind/ignore SIGINT; restore it afterwards or a single
     # tenant could permanently disable the server's cooperative timeout
     # cancellation for every later generation of this warm process.
@@ -282,9 +360,22 @@ def _run_one(req: dict) -> int:
     except SystemExit as e:
         code = e.code
         exit_code = code if isinstance(code, int) else (0 if code is None else 1)
-    except BaseException:  # noqa: BLE001 — report, don't die
+    except _CpuTimeExceeded:
+        # Restore first: the soft RLIMIT_CPU re-fires SIGXCPU every second
+        # past the ceiling, and the next one must not land mid-report.
+        restore_rlimits()
+        traceback.print_exc()
+        exit_code = 1
+        violation = "cpu_time"
+    except MemoryError:
         # Limits off first: after a window-exhausting MemoryError, the
         # traceback formatting itself needs allocation headroom.
+        restore_rlimits()
+        traceback.print_exc()
+        exit_code = 1
+        if mem_limited:
+            violation = "oom"
+    except BaseException:  # noqa: BLE001 — report, don't die
         restore_rlimits()
         traceback.print_exc()
         exit_code = 1
@@ -317,7 +408,7 @@ def _run_one(req: dict) -> int:
                 os.unlink(run_path)
             except OSError:
                 pass
-    return exit_code
+    return exit_code, violation
 
 
 def _descendant_pids() -> list[int]:
@@ -532,8 +623,11 @@ def main() -> None:
                         # workspace — off the next request's critical path.
                         gc.collect()
                 else:
-                    exit_code = _run_one(req)
-                    _reply({"exit_code": exit_code})
+                    exit_code, violation = _run_one(req)
+                    reply: dict = {"exit_code": exit_code}
+                    if violation:
+                        reply["violation"] = violation
+                    _reply(reply)
             except KeyboardInterrupt:
                 # The cancellation SIGINT raced past user code and landed in
                 # RUNNER code (dispatch, _send, _run_one's unwind after the

@@ -23,6 +23,15 @@
 // (0), APP_DEFAULT_TIMEOUT (60), APP_MAX_OUTPUT_BYTES (10485760),
 // APP_WORKSPACE_MANIFEST (1; 0 = legacy wire format: no sha256 manifest,
 // plain-string `files` arrays, no /workspace-manifest route).
+//
+// Resource governance (limits.hpp): APP_LIMIT_MEMORY_BYTES,
+// APP_LIMIT_CPU_SECONDS, APP_LIMIT_NPROC, APP_LIMIT_NOFILE,
+// APP_LIMIT_FSIZE_BYTES, APP_LIMIT_DISK_BYTES set the server's caps-and-
+// defaults (0 = off); a request's `limits` object can only tighten them.
+// APP_LIMIT_POLL_INTERVAL (0.1) is the watchdog sampling cadence. Breaches
+// kill the runner group and classify as a typed `violation` in the execute
+// response (oom / disk_quota / nproc / cpu_time / output_cap) instead of a
+// generic crash. The workspace disk quota also guards streaming PUTs (413).
 
 #include <dirent.h>
 #include <fcntl.h>
@@ -48,6 +57,7 @@
 
 #include "http.hpp"
 #include "json.hpp"
+#include "limits.hpp"
 #include "sha256.hpp"
 
 // Runner session id, mirrored for the SIGTERM handler (async-signal-safe
@@ -387,11 +397,16 @@ struct ExecOutcome {
 };
 
 // Runs argv with stdout/stderr redirected to files, cwd=workspace, its own
-// process group; kills the whole group on timeout.
+// process group; kills the whole group on timeout. `rlimits` (optional)
+// boxes the child with the setrlimit set before exec; `watchdog` (optional)
+// learns the child pid the moment it exists, so group-level RSS/CPU/nproc
+// enforcement covers the whole run.
 ExecOutcome run_subprocess(const std::vector<std::string>& argv,
                            const std::string& cwd, const std::string& stdout_path,
                            const std::string& stderr_path, double timeout_s,
-                           const minijson::Value* extra_env) {
+                           const minijson::Value* extra_env,
+                           const limits::LimitSpec* rlimits = nullptr,
+                           limits::Watchdog* watchdog = nullptr) {
   ExecOutcome out;
   pid_t parent = getpid();
   pid_t pid = fork();
@@ -405,6 +420,7 @@ ExecOutcome run_subprocess(const std::vector<std::string>& argv,
     // blocks in the waitpid loop below until this child is gone.
     prctl(PR_SET_PDEATHSIG, SIGKILL);
     if (getppid() != parent) _exit(127);
+    if (rlimits) limits::apply_child_rlimits(*rlimits);
     if (!cwd.empty()) {
       if (chdir(cwd.c_str()) != 0) _exit(127);
     }
@@ -425,6 +441,7 @@ ExecOutcome run_subprocess(const std::vector<std::string>& argv,
     execvp(cargv[0], cargv.data());
     _exit(127);
   }
+  if (watchdog) watchdog->set_leader(pid);
   // Parent: poll for exit until deadline.
   const int tick_ms = 20;
   double waited = 0;
@@ -524,6 +541,7 @@ class WarmRunner {
   }
 
   bool alive() const { return pid_ > 0 && ready_; }
+  pid_t pid() const { return pid_; }
   const std::string& backend() const { return backend_; }
   int device_count() const { return device_count_; }
 
@@ -697,6 +715,10 @@ struct ServerState {
   int num_hosts = 1;  // >1 → this sandbox is one host of a multi-host slice
   double default_timeout = 60.0;
   size_t max_output = 10 * 1024 * 1024;
+  // Resource-governance caps-and-defaults (APP_LIMIT_*; see limits.hpp) and
+  // the watchdog's sampling cadence.
+  limits::LimitSpec limit_caps;
+  double limit_poll_interval = 0.1;
   WarmRunner* runner = nullptr;
   std::mutex exec_mutex;
   std::mutex runner_mutex;
@@ -845,6 +867,29 @@ void handle_upload(const minihttp::Request& req, minihttp::Conn& conn) {
                        "{\"error\":\"open failed (confined)\"}");
     return;
   }
+  // Workspace disk quota guards the streaming path too: without it a client
+  // (or a compromised control plane) could fill the sandbox disk through
+  // PUTs that never run any code. Usage is measured once at upload start
+  // (after O_TRUNC zeroed any file being overwritten) and this body's bytes
+  // count against the remainder. With the manifest on, usage comes from the
+  // cached entry sizes (O(entries), no IO) — a full recursive walk per PUT
+  // would make an N-file sync O(N^2) stats; without it, the walk.
+  long long disk_cap =
+      prefix == "workspace" ? g_state.limit_caps.disk_bytes : 0;
+  long long usage_before = 0;
+  if (disk_cap > 0) {
+    if (manifested) {
+      // Exclude the entry for the path being overwritten: O_TRUNC above
+      // already freed those bytes, so counting the stale size would 413
+      // legitimate re-uploads of changed files (the delta-sync's normal
+      // path) on any workspace near half its quota.
+      std::lock_guard<std::mutex> lock(g_ws_manifest_mutex);
+      for (const auto& [entry_rel, entry] : g_ws_manifest)
+        if (entry_rel != rel) usage_before += entry.sig.size;
+    } else {
+      usage_before = limits::dir_usage_bytes(*base);
+    }
+  }
   // Stream-hash while writing: the manifest learns the sha at upload time,
   // so the post-execute scan never rehashes bytes the PUT already saw.
   minisha::Sha256 hasher;
@@ -854,6 +899,24 @@ void handle_upload(const minihttp::Request& req, minihttp::Conn& conn) {
     while (true) {
       chunk.clear();
       if (conn.read_body_some(chunk, 1 << 20) == 0) break;
+      if (disk_cap > 0 &&
+          usage_before + static_cast<long long>(total + chunk.size()) >
+              disk_cap) {
+        // Over quota: give the quota back (truncate what we wrote), drop
+        // the stale manifest entry, and answer with the typed violation.
+        ftruncate(fd, 0);
+        close(fd);
+        if (manifested) {
+          std::lock_guard<std::mutex> lock(g_ws_manifest_mutex);
+          g_ws_manifest.erase(rel);
+        }
+        conn.drain_body();
+        conn.send_response(
+            413, "application/json",
+            "{\"error\":\"workspace disk quota exceeded\","
+            "\"violation\":\"disk_quota\"}");
+        return;
+      }
       if (manifested) hasher.update(chunk.data(), chunk.size());
       size_t off = 0;
       while (off < chunk.size()) {
@@ -1053,16 +1116,35 @@ struct RunOutcome {
   bool ran_warm = false;
   bool restarted = false;  // warm runner kill/crash -> background rewarm
   bool multi_host_refused = false;
+  // Typed resource-limit violation ("" = none): which limit killed the run
+  // (watchdog/rlimit) or fired in-process (the runner's soft guards).
+  std::string violation;
 };
 
 // The execution core shared by /execute and /execute/stream: run the script
 // through the warm runner when available, else a cold subprocess; stdout/
 // stderr land in the given capture files (which is what makes streaming
 // possible — a tailer can follow them while this blocks).
+// The in-process guards the warm runner applies itself (runner.py): a JSON
+// object for the runner request's `limits` key. Group-level bounds (nproc,
+// disk, memory-as-RSS) are the watchdog's job and stay out.
+minijson::Value runner_limits_json(const limits::LimitSpec& lim) {
+  minijson::Object o;
+  if (lim.memory_bytes > 0)
+    o["memory_bytes"] = minijson::Value(static_cast<int64_t>(lim.memory_bytes));
+  if (lim.cpu_seconds > 0) o["cpu_seconds"] = minijson::Value(lim.cpu_seconds);
+  if (lim.nofile > 0)
+    o["nofile"] = minijson::Value(static_cast<int64_t>(lim.nofile));
+  if (lim.fsize_bytes > 0)
+    o["fsize_bytes"] = minijson::Value(static_cast<int64_t>(lim.fsize_bytes));
+  return minijson::Value(o);
+}
+
 RunOutcome run_user_code(const std::string& script_path,
                          const std::string& stdout_path,
                          const std::string& stderr_path, double timeout_s,
-                         const minijson::Value& extra_env) {
+                         const minijson::Value& extra_env,
+                         const limits::LimitSpec& lim) {
   RunOutcome out;
   bool restart_runner = false;
 
@@ -1089,14 +1171,25 @@ RunOutcome run_user_code(const std::string& script_path,
         reqo["stdout_path"] = minijson::Value(stdout_path);
         reqo["stderr_path"] = minijson::Value(stderr_path);
         if (extra_env.is_object()) reqo["env"] = extra_env;
+        if (lim.any()) reqo["limits"] = runner_limits_json(lim);
         minijson::Value resp;
+        // Layered enforcement: the runner's in-process soft guards report
+        // cleanly and keep the process (and its device lease) alive; the
+        // watchdog is the backstop that kills the whole runner group when
+        // user code dodges them (native allocs, children, masked signals).
+        limits::Watchdog wd(lim, g_state.runner->pid(), g_state.workspace,
+                            {stdout_path, stderr_path},
+                            g_state.limit_poll_interval);
+        wd.start();
         WarmRunner::ExecResult r = g_state.runner->execute(
             minijson::Value(reqo).dump(), timeout_s > 0 ? timeout_s + 0.5 : 0,
             resp, /*allow_interrupt=*/true);
+        wd.stop();
         out.ran_warm = true;
         switch (r) {
           case WarmRunner::ExecResult::kOk:
             out.exit_code = static_cast<int>(resp.get_number("exit_code", -1));
+            out.violation = resp.get_string("violation", "");
             break;
           case WarmRunner::ExecResult::kTimeout:
             out.timed_out = true;
@@ -1118,6 +1211,11 @@ RunOutcome run_user_code(const std::string& script_path,
             restart_runner = true;
             break;
         }
+        // A watchdog kill reaches the server as kDied/kTimeout (the runner
+        // group is gone mid-request); the recorded kind reclassifies that
+        // generic death as the typed violation it actually was.
+        std::string wd_kind = wd.violation();
+        if (!wd_kind.empty()) out.violation = wd_kind;
       } else {
         // Runner found already dead at request time (e.g. OOM-killed
         // between requests): without flagging a restart here, the sandbox
@@ -1148,11 +1246,24 @@ RunOutcome run_user_code(const std::string& script_path,
     }
     // launch.py wraps runpy with the same shell-syntax fallback the warm
     // runner applies (mixed Python/shell snippets — the xonsh role).
+    // The cold child gets the real setrlimit set (it is wholly the user's)
+    // plus the same watchdog backstop; the leader pid binds post-fork.
+    limits::Watchdog wd(lim, 0, g_state.workspace, {stdout_path, stderr_path},
+                        g_state.limit_poll_interval);
+    wd.start();
     ExecOutcome cold = run_subprocess(
         {g_state.python, g_state.launch_script, script_path}, g_state.workspace,
-        stdout_path, stderr_path, timeout_s, &extra_env);
+        stdout_path, stderr_path, timeout_s, &extra_env, &lim, &wd);
+    wd.stop();
     out.exit_code = cold.exit_code;
     out.timed_out = cold.timed_out;
+    out.violation = wd.violation();
+    if (out.violation.empty() && lim.cpu_seconds > 0 &&
+        cold.exit_code == 128 + SIGXCPU) {
+      // RLIMIT_CPU fired in the child (no handler there): the kernel's
+      // SIGXCPU kill IS the cpu_time violation.
+      out.violation = limits::kCpuTime;
+    }
   }
   return out;
 }
@@ -1185,6 +1296,19 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
   std::string source_file = parsed.get_string("source_file");
   double timeout_s = parsed.get_number("timeout", g_state.default_timeout);
   const minijson::Value& extra_env = parsed.get("env");
+  // Per-request resource budget, tighten-only against the APP_LIMIT_* caps.
+  // Output is special-cased: the implicit server cap (APP_MAX_OUTPUT_BYTES)
+  // keeps its historic TRUNCATE semantics; only an explicit output budget
+  // (request body / control-plane lane default) arms the output-cap KILL.
+  limits::LimitSpec req_limits = limits::from_json(parsed.get("limits"));
+  limits::LimitSpec eff_limits = limits::clamp(req_limits, g_state.limit_caps);
+  size_t output_cap = g_state.max_output;
+  if (req_limits.output_bytes > 0 &&
+      static_cast<size_t>(req_limits.output_bytes) < output_cap) {
+    output_cap = static_cast<size_t>(req_limits.output_bytes);
+  }
+  eff_limits.output_bytes =
+      req_limits.output_bytes > 0 ? static_cast<long long>(output_cap) : 0;
 
   if (source_code.empty() && source_file.empty()) {
     conn.send_response(400, "application/json",
@@ -1277,7 +1401,7 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
   RunOutcome run;
   if (!streaming) {
     run = run_user_code(script_path, stdout_path, stderr_path, timeout_s,
-                        extra_env);
+                        extra_env, eff_limits);
   } else {
     // Streaming mode: the run blocks in a worker thread while this thread
     // tails the capture files and pushes NDJSON events over a chunked
@@ -1301,15 +1425,15 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
       // the one-connection blast radius of the non-streaming path.
       try {
         run = run_user_code(script_path, stdout_path, stderr_path, timeout_s,
-                            extra_env);
+                            extra_env, eff_limits);
       } catch (const std::exception& e) {
         log_msg("streamed run_user_code threw: %s", e.what());
         run = RunOutcome{};  // exit_code -1, nothing ran warm
       }
       run_done.store(true);
     });
-    StreamTail tail_out(stdout_path, "stdout", g_state.max_output);
-    StreamTail tail_err(stderr_path, "stderr", g_state.max_output);
+    StreamTail tail_out(stdout_path, "stdout", output_cap);
+    StreamTail tail_err(stderr_path, "stderr", output_cap);
     bool client_gone = false;
     while (!run_done.load()) {
       struct timespec ts = {0, 75 * 1000 * 1000};  // 75 ms poll
@@ -1372,12 +1496,24 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
   std::map<std::string, FileSig> after;
   scan_dir(g_state.workspace, "", after);
 
+  // Post-exec quota scan: a filler fast enough to write, exit, and beat the
+  // watchdog's next tick still may not hand the next phase an over-quota
+  // workspace (the downloads it would trigger are exactly the bytes the
+  // quota exists to bound).
+  if (run.violation.empty() && eff_limits.disk_bytes > 0 &&
+      limits::dir_usage_bytes(g_state.workspace) > eff_limits.disk_bytes) {
+    run.violation = limits::kDiskQuota;
+  }
+
   bool out_trunc = false, err_trunc = false;
-  std::string out_s = read_file_capped(stdout_path, g_state.max_output, &out_trunc);
-  std::string err_s = read_file_capped(stderr_path, g_state.max_output, &err_trunc);
+  std::string out_s = read_file_capped(stdout_path, output_cap, &out_trunc);
+  std::string err_s = read_file_capped(stderr_path, output_cap, &err_trunc);
   if (out_trunc) out_s += "\n[stdout truncated]";
   if (err_trunc) err_s += "\n[stderr truncated]";
-  if (timed_out) {
+  if (!run.violation.empty()) {
+    std::string note = "Resource limit exceeded: " + run.violation;
+    err_s += err_s.empty() ? note : "\n" + note;
+  } else if (timed_out) {
     err_s += err_s.empty() ? "Execution timed out" : "\nExecution timed out";
   } else if (runner_died) {
     err_s += err_s.empty() ? "Executor runner crashed" : "\nExecutor runner crashed";
@@ -1433,6 +1569,12 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
   resp["stdout"] = minijson::Value(out_s);
   resp["stderr"] = minijson::Value(err_s);
   resp["exit_code"] = minijson::Value(exit_code);
+  // Truncation is now a first-class signal (clients previously had to
+  // pattern-match the "[stdout truncated]" text); violation carries the
+  // typed limit kind when a resource bound ended this run.
+  resp["stdout_truncated"] = minijson::Value(out_trunc);
+  resp["stderr_truncated"] = minijson::Value(err_trunc);
+  if (!run.violation.empty()) resp["violation"] = minijson::Value(run.violation);
   resp["files"] = minijson::Value(files);
   if (g_state.manifest_enabled) resp["deleted"] = minijson::Value(deleted);
   resp["duration_s"] = minijson::Value(duration);
@@ -1666,6 +1808,16 @@ int main() {
   }
   g_state.default_timeout = env_num("APP_DEFAULT_TIMEOUT", 60.0);
   g_state.max_output = static_cast<size_t>(env_num("APP_MAX_OUTPUT_BYTES", 10485760));
+  g_state.limit_caps = limits::caps_from_env();
+  g_state.limit_poll_interval = env_num("APP_LIMIT_POLL_INTERVAL", 0.1);
+  if (g_state.limit_caps.any()) {
+    log_msg(
+        "resource limits armed: mem=%lld cpu=%.0fs nproc=%lld nofile=%lld "
+        "fsize=%lld disk=%lld (0 = off)",
+        g_state.limit_caps.memory_bytes, g_state.limit_caps.cpu_seconds,
+        g_state.limit_caps.nproc, g_state.limit_caps.nofile,
+        g_state.limit_caps.fsize_bytes, g_state.limit_caps.disk_bytes);
+  }
 
   mkdir(g_state.workspace.c_str(), 0777);
   mkdir(g_state.runtime_packages.c_str(), 0777);

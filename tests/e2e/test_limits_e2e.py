@@ -1,0 +1,127 @@
+"""End-to-end resource governance: HTTP API → orchestrator → real C++
+executor (local backend), pinning ISSUE 5's acceptance criterion — a
+memory-hog, fork-bomb, and disk-filler snippet each return a typed limit
+violation (correct kind, visible in metrics and the request trace) while the
+SAME service successfully serves the immediately following request.
+"""
+
+# Optional-dep guard: a missing dependency must degrade this module to a
+# SKIP at collection, not an ERROR that interrupts the whole run.
+import pytest
+
+pytest.importorskip("httpx", reason="optional e2e dependency not installed")
+pytest.importorskip("aiohttp", reason="optional e2e dependency not installed")
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.backends.local import LocalSandboxBackend
+from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor
+from bee_code_interpreter_fs_tpu.services.custom_tool_executor import CustomToolExecutor
+from bee_code_interpreter_fs_tpu.services.http_server import create_http_app
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+MB = 1 << 20
+
+
+@pytest.fixture
+async def stack(tmp_path, monkeypatch):
+    # Tight watchdog cadence so kill-path cases resolve fast in CI.
+    monkeypatch.setenv("APP_LIMIT_POLL_INTERVAL", "0.05")
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        local_sandbox_root=str(tmp_path / "sandboxes"),
+        executor_pod_queue_target_length=1,
+        jax_compilation_cache_dir="",
+        default_execution_timeout=30.0,
+    )
+    backend = LocalSandboxBackend(config, warm_import_jax=False)
+    storage = Storage(config.file_storage_path)
+    executor = CodeExecutor(backend, storage, config)
+    tools = CustomToolExecutor(executor)
+    app = create_http_app(executor, tools, storage)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    yield client, executor
+    await client.close()
+    await executor.close()
+
+
+async def _assert_violation_then_serves(client, executor, code, limits, kind):
+    resp = await client.post(
+        "/v1/execute",
+        json={"source_code": code, "timeout": 30, "limits": limits},
+    )
+    assert resp.status == 422
+    body = await resp.json()
+    assert body["violation"] == kind
+    assert kind in body["error"]
+    # Visible in the request trace: the 422 body carries the trace id and
+    # the retained trace holds the limit.violation event.
+    trace_id = body.get("trace_id")
+    assert trace_id, "422 body should carry the trace id"
+    spans = executor.tracer.ring.trace(trace_id)
+    events = [
+        event
+        for span in spans
+        for event in span.get("events", [])
+        if event.get("name") == "limit.violation"
+    ]
+    assert events and events[0]["attributes"]["kind"] == kind
+    # Visible in metrics.
+    metrics_resp = await client.get("/metrics")
+    text = await metrics_resp.text()
+    assert f'code_interpreter_limit_violations_total{{chip_count="0",kind="{kind}"}}' in text
+    # The immediately following request is served by the same service
+    # (recycled or replacement host — the client cannot tell, nor should it).
+    follow = await client.post(
+        "/v1/execute", json={"source_code": "print('still serving')"}
+    )
+    assert follow.status == 200
+    follow_body = await follow.json()
+    assert follow_body["stdout"] == "still serving\n"
+    assert follow_body["exit_code"] == 0
+
+
+async def test_memory_hog_typed_violation_then_serves(stack):
+    client, executor = stack
+    await _assert_violation_then_serves(
+        client,
+        executor,
+        "b = []\nimport time\n"
+        "while True:\n"
+        "    b.append(bytearray(8 << 20))\n"
+        "    time.sleep(0.002)\n",
+        {"memory_bytes": 64 * MB},
+        "oom",
+    )
+
+
+async def test_fork_bomb_typed_violation_then_serves(stack):
+    client, executor = stack
+    await _assert_violation_then_serves(
+        client,
+        executor,
+        "import subprocess, time\n"
+        "procs = [subprocess.Popen(['sleep', '30']) for _ in range(20)]\n"
+        "time.sleep(30)\n",
+        {"nproc": 5},
+        "nproc",
+    )
+
+
+async def test_disk_filler_typed_violation_then_serves(stack):
+    client, executor = stack
+    await _assert_violation_then_serves(
+        client,
+        executor,
+        "import time\n"
+        "with open('junk.bin', 'wb') as f:\n"
+        "    for _ in range(200):\n"
+        "        f.write(b'x' * 262144)\n"
+        "        f.flush()\n"
+        "        time.sleep(0.01)\n"
+        "time.sleep(30)\n",
+        {"disk_bytes": 1 * MB},
+        "disk_quota",
+    )

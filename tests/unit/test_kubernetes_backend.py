@@ -86,6 +86,22 @@ def fake_kubectl(tmp_path, monkeypatch):
     return Kubectl(binary=str(binary)), state, calls
 
 
+async def _await_calls(calls, predicate, *, timeout=10.0, settle=0.2):
+    """Deadline-poll the fake-kubectl call log until `predicate(calls())` is
+    truthy, then hold one settle interval so a spurious LATE extra call
+    (e.g. a double-delete regression) still fails the caller's exact
+    asserts. Replaces the fixed 0.2s sleeps that flaked whenever a loaded
+    host ran the fire-and-forget delete subprocesses slowly (the recurring
+    F's documented in CHANGES.md)."""
+    import asyncio
+
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not predicate(calls()) and asyncio.get_running_loop().time() < deadline:
+        await asyncio.sleep(0.05)
+    await asyncio.sleep(settle)
+    return calls()
+
+
 def _backend(kubectl, **config_kwargs) -> KubernetesSandboxBackend:
     config = Config(
         tpu_node_selector={
@@ -150,10 +166,11 @@ async def test_spawn_failure_deletes_pod(fake_kubectl):
     backend = _backend(kubectl)
     with pytest.raises(SandboxSpawnError):
         await backend.spawn(chip_count=0)
-    import asyncio
-
-    await asyncio.sleep(0.2)  # fire-and-forget delete
-    assert "delete" in [c["argv"][0] for c in calls()]
+    # Fire-and-forget delete: poll with a deadline instead of a fixed sleep.
+    seen = await _await_calls(
+        calls, lambda cs: any(c["argv"][0] == "delete" for c in cs)
+    )
+    assert "delete" in [c["argv"][0] for c in seen]
 
 
 async def test_spawn_failure_includes_pod_diagnostics(fake_kubectl):
@@ -366,39 +383,35 @@ async def test_multihost_topology_selector_by_slice_size(fake_kubectl):
 
 
 async def test_multihost_delete_removes_all_pods(fake_kubectl):
-    import asyncio
-
     kubectl, state, calls = fake_kubectl
     backend = _backend(kubectl, tpu_chips_per_host=4)
     sandbox = await backend.spawn(chip_count=16)
     assert sandbox.num_hosts == 4
     await backend.delete(sandbox)
-    await asyncio.sleep(0.2)  # service delete is fire-and-tracked
-    deleted = {c["argv"][2] for c in calls() if c["argv"][0] == "delete"}
-    assert deleted == {f"{sandbox.id}-h{i}" for i in range(4)} | {sandbox.id}
+    # The headless-service delete is fire-and-tracked: poll for the full
+    # expected set (4 pods + the service) instead of a fixed sleep.
+    expected = {f"{sandbox.id}-h{i}" for i in range(4)} | {sandbox.id}
+    seen = await _await_calls(
+        calls,
+        lambda cs: {c["argv"][2] for c in cs if c["argv"][0] == "delete"}
+        >= expected,
+    )
+    deleted = {c["argv"][2] for c in seen if c["argv"][0] == "delete"}
+    assert deleted == expected
 
 
 async def test_multihost_spawn_failure_cleans_whole_group(fake_kubectl):
-    import asyncio
-
     kubectl, state, calls = fake_kubectl
     (state / "fail_wait").touch()
     backend = _backend(kubectl, tpu_chips_per_host=4)
     with pytest.raises(SandboxSpawnError):
         await backend.spawn(chip_count=8)
-    # Fire-and-forget deletes: poll with a deadline (a fixed sleep flakes
-    # when the host is loaded and the fake-kubectl subprocesses run slowly),
-    # then hold one extra grace interval so a spurious LATE extra delete
-    # (e.g. a double-delete regression) still fails the exact-count assert.
-    deadline = asyncio.get_running_loop().time() + 10.0
-    deleted: set = set()
-    while asyncio.get_running_loop().time() < deadline:
-        deleted = {c["argv"][2] for c in calls() if c["argv"][0] == "delete"}
-        if len(deleted) >= 3:
-            break
-        await asyncio.sleep(0.05)
-    await asyncio.sleep(0.2)
-    deleted = {c["argv"][2] for c in calls() if c["argv"][0] == "delete"}
+    seen = await _await_calls(
+        calls,
+        lambda cs: len({c["argv"][2] for c in cs if c["argv"][0] == "delete"})
+        >= 3,
+    )
+    deleted = {c["argv"][2] for c in seen if c["argv"][0] == "delete"}
     # both pods AND the group's headless service: no partial slices left
     assert len(deleted) == 3
 

@@ -1,0 +1,323 @@
+"""Control-plane resource governance: budget pipeline validation/clamping,
+typed LimitExceededError classification (no retry, repeat-offender disposal,
+breaker strike, session teardown), API mapping (HTTP 422 / gRPC
+RESOURCE_EXHAUSTED + x-violation), and the graceful-drain satellite.
+
+Everything here runs against in-memory fakes — the real-binary enforcement
+lives in test_executor_limits.py.
+"""
+
+import asyncio
+
+import pytest
+from fakes import FakeBackend
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.backends.faults import FaultSpec
+from bee_code_interpreter_fs_tpu.services.code_executor import (
+    CodeExecutor,
+    LimitExceededError,
+    SessionLimitError,
+)
+from bee_code_interpreter_fs_tpu.services.limits import (
+    VIOLATION_KINDS,
+    parse_limits,
+    request_limits,
+    sandbox_limit_env,
+)
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+MB = 1 << 20
+
+
+# ----------------------------------------------------------- budget pipeline
+
+
+def test_parse_limits_rejects_unknown_key():
+    with pytest.raises(ValueError, match="unknown limits key"):
+        parse_limits({"memory_bytez": 1})
+
+
+def test_parse_limits_rejects_non_positive_and_non_numeric():
+    with pytest.raises(ValueError, match="must be > 0"):
+        parse_limits({"cpu_seconds": 0})
+    with pytest.raises(ValueError, match="must be a number"):
+        parse_limits({"nproc": "many"})
+    with pytest.raises(ValueError, match="must be a number"):
+        parse_limits({"nproc": True})
+    with pytest.raises(ValueError, match="must be an object"):
+        parse_limits([1, 2])
+
+
+def test_request_limits_layering_and_clamp():
+    config = Config(
+        sandbox_default_limits={"cpu_seconds": 120, "nproc": 64},
+        sandbox_lane_limits={"4": {"cpu_seconds": 600}},
+        sandbox_limit_caps={"cpu_seconds": 300, "memory_bytes": 8 * MB},
+    )
+    # Lane 4 overrides the default cpu budget but the cap clamps it to 300;
+    # the request's memory ask is clamped by the cap too.
+    eff = request_limits(config, 4, {"memory_bytes": 64 * MB})
+    assert eff == {"cpu_seconds": 300, "nproc": 64, "memory_bytes": 8 * MB}
+    # Requests may always tighten below every configured layer.
+    eff = request_limits(config, 4, {"cpu_seconds": 5})
+    assert eff["cpu_seconds"] == 5
+
+
+def test_request_limits_kill_switch_and_empty():
+    off = Config(
+        sandbox_limits_enabled=False,
+        sandbox_default_limits={"cpu_seconds": 120},
+    )
+    assert request_limits(off, 0, {"cpu_seconds": 5}) is None
+    assert request_limits(Config(), 0, None) is None
+
+
+def test_sandbox_limit_env_exports_caps():
+    config = Config(
+        sandbox_limit_caps={
+            "memory_bytes": 8 * MB,
+            "cpu_seconds": 300,
+            "disk_bytes": 16 * MB,
+        },
+        sandbox_max_output_bytes=1234,
+    )
+    env = sandbox_limit_env(config)
+    assert env["APP_LIMIT_MEMORY_BYTES"] == str(8 * MB)
+    assert env["APP_LIMIT_CPU_SECONDS"] == "300"
+    assert env["APP_LIMIT_DISK_BYTES"] == str(16 * MB)
+    assert env["APP_MAX_OUTPUT_BYTES"] == "1234"
+    assert "APP_LIMIT_NPROC" not in env
+    # Kill switch: only the output knob remains.
+    off = sandbox_limit_env(
+        Config(sandbox_limits_enabled=False, sandbox_limit_caps={"nproc": 4})
+    )
+    assert list(off) == ["APP_MAX_OUTPUT_BYTES"]
+
+
+def test_lane_limits_keys_validated_at_boot(tmp_path):
+    # A lane key that str(lane) can never match ("lane4") would silently
+    # enforce nothing — it must refuse at executor construction, the same
+    # fail-fast as typo'd budget keys.
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        sandbox_lane_limits={"lane4": {"cpu_seconds": 600}},
+    )
+    with pytest.raises(ValueError, match="not a chip-count lane"):
+        CodeExecutor(FakeBackend(), Storage(config.file_storage_path), config)
+    bad_budget = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        sandbox_default_limits={"cpu_secs": 120},
+    )
+    with pytest.raises(ValueError, match="unknown sandbox_default_limits key"):
+        CodeExecutor(
+            FakeBackend(), Storage(bad_budget.file_storage_path), bad_budget
+        )
+
+
+def test_fault_spec_violation_grammar():
+    spec = FaultSpec.parse("violation:0.5,violation_kind:disk_quota,seed:7")
+    assert spec.violation == 0.5
+    assert spec.violation_kind == "disk_quota"
+    assert spec.active
+    with pytest.raises(ValueError, match="violation_kind"):
+        FaultSpec.parse("violation:0.5,violation_kind:oom_lol")
+    # A bare kind with no rate is inert, not "active".
+    assert not FaultSpec.parse("violation_kind:oom").active
+    assert all(
+        FaultSpec.parse(f"violation_kind:{kind}").violation_kind == kind
+        for kind in VIOLATION_KINDS
+    )
+
+
+# ------------------------------------------------- orchestrator classification
+
+
+def make_executor(tmp_path, backend=None, **config_kwargs):
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        executor_pod_queue_target_length=1,
+        executor_spawn_retry_attempts=1,
+        pool_health_sweep_interval=0.0,
+        **config_kwargs,
+    )
+    backend = backend or FakeBackend()
+    return CodeExecutor(backend, Storage(config.file_storage_path), config), backend
+
+
+def violation_body(kind, *, killed=True):
+    return {
+        "stdout": "",
+        "stderr": f"Resource limit exceeded: {kind}",
+        "exit_code": 137 if killed else 1,
+        "stdout_truncated": False,
+        "stderr_truncated": False,
+        "violation": kind,
+        "files": [],
+        "deleted": [],
+        "warm": True,
+        "runner_restarted": killed,
+    }
+
+
+def patch_execute(executor, bodies):
+    """Monkeypatch the sandbox HTTP hop: pops one scripted body per call
+    (the last body repeats). Counts calls to prove the no-retry contract."""
+    calls = {"n": 0}
+
+    async def fake_post_execute(client, base, payload, timeout, sandbox):
+        calls["n"] += 1
+        index = min(calls["n"] - 1, len(bodies) - 1)
+        return dict(bodies[index])
+
+    executor._post_execute = fake_post_execute
+    return calls
+
+
+async def _settle(executor):
+    for _ in range(200):
+        pending = list(executor._dispose_tasks) + list(executor._fill_tasks)
+        if not pending:
+            return
+        await asyncio.gather(*pending, return_exceptions=True)
+
+
+async def test_violation_raises_typed_error_and_never_retries(tmp_path):
+    executor, backend = make_executor(tmp_path)
+    calls = patch_execute(executor, [violation_body("oom")])
+    try:
+        with pytest.raises(LimitExceededError) as excinfo:
+            await executor.execute("boom")
+        assert excinfo.value.kind == "oom"
+        assert excinfo.value.lane == 0
+        assert excinfo.value.continuable is False
+        # Deterministic: exactly ONE sandbox call — the retry ladder must
+        # not have replayed the violating snippet.
+        assert calls["n"] == 1
+    finally:
+        await executor.close()
+
+
+async def test_violation_metrics_and_breaker_strike(tmp_path):
+    executor, backend = make_executor(tmp_path)
+    patch_execute(executor, [violation_body("disk_quota")])
+    try:
+        with pytest.raises(LimitExceededError):
+            await executor.execute("fill")
+        rendered = executor.metrics.registry.render()
+        assert (
+            'code_interpreter_limit_violations_total{chip_count="0",'
+            'kind="disk_quota"} 1' in rendered
+        )
+        assert (
+            'code_interpreter_executions_total{outcome="limit_violation"} 1'
+            in rendered
+        )
+        # Repeat-offender strike: the killed host fed the lane breaker.
+        assert executor.breakers.lane(0)._failures == 1
+    finally:
+        await executor.close()
+
+
+async def test_killed_host_disposed_continuable_host_recycled(tmp_path):
+    # killed=True -> the sandbox must be DISPOSED, not recycled.
+    executor, backend = make_executor(tmp_path)
+    patch_execute(executor, [violation_body("nproc", killed=True)])
+    try:
+        with pytest.raises(LimitExceededError):
+            await executor.execute("bomb")
+        await _settle(executor)
+        assert backend.resets == 0
+        assert backend.deletes >= 1
+    finally:
+        await executor.close()
+
+    # killed=False (in-process guard) -> normal recycle path, no strike.
+    executor, backend = make_executor(tmp_path)
+    patch_execute(executor, [violation_body("cpu_time", killed=False)])
+    try:
+        with pytest.raises(LimitExceededError) as excinfo:
+            await executor.execute("spin")
+        assert excinfo.value.continuable is True
+        await _settle(executor)
+        assert backend.resets >= 1
+        assert executor.breakers.lane(0)._failures == 0
+    finally:
+        await executor.close()
+
+
+async def test_violation_ends_session(tmp_path):
+    executor, backend = make_executor(tmp_path)
+    patch_execute(
+        executor,
+        [
+            {
+                "stdout": "ok\n",
+                "stderr": "",
+                "exit_code": 0,
+                "files": [],
+                "warm": True,
+            },
+            violation_body("oom"),
+        ],
+    )
+    try:
+        first = await executor.execute("x = 1", executor_id="sess")
+        assert first.session_seq == 1
+        with pytest.raises(LimitExceededError):
+            await executor.execute("hog", executor_id="sess")
+        await _settle(executor)
+        # The session is gone; the id starts fresh (seq back to 1).
+        assert "sess" not in executor._sessions
+    finally:
+        await executor.close()
+
+
+async def test_limits_payload_reaches_sandbox_and_validation_maps_400(tmp_path):
+    executor, backend = make_executor(
+        tmp_path, sandbox_default_limits={"cpu_seconds": 120}
+    )
+    seen = {}
+
+    async def fake_post_execute(client, base, payload, timeout, sandbox):
+        seen.update(payload)
+        return {"stdout": "", "stderr": "", "exit_code": 0, "files": [], "warm": True}
+
+    executor._post_execute = fake_post_execute
+    try:
+        await executor.execute("ok", limits={"memory_bytes": 4 * MB})
+        assert seen["limits"] == {"cpu_seconds": 120, "memory_bytes": 4 * MB}
+        with pytest.raises(ValueError, match="unknown limits key"):
+            await executor.execute("ok", limits={"wat": 1})
+    finally:
+        await executor.close()
+
+
+# ------------------------------------------------------------ graceful drain
+
+
+async def test_drain_sheds_new_work_and_reports_drained(tmp_path):
+    executor, backend = make_executor(tmp_path)
+    release = asyncio.Event()
+
+    async def slow_post_execute(client, base, payload, timeout, sandbox):
+        await release.wait()
+        return {"stdout": "", "stderr": "", "exit_code": 0, "files": [], "warm": True}
+
+    executor._post_execute = slow_post_execute
+    try:
+        inflight = asyncio.create_task(executor.execute("slow"))
+        while executor.inflight() == 0:
+            await asyncio.sleep(0.01)
+        executor.begin_drain()
+        # New work sheds immediately with the retryable capacity signal.
+        with pytest.raises(SessionLimitError, match="draining"):
+            await executor.execute("rejected")
+        # In-flight work survives the drain window...
+        assert not await executor.wait_drained(0.05)
+        release.set()
+        assert await executor.wait_drained(5.0)
+        result = await inflight
+        assert result.exit_code == 0
+    finally:
+        await executor.close()

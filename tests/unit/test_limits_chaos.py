@@ -1,0 +1,209 @@
+"""Chaos suite for resource governance: the orchestrator driven against the
+fault-injecting backend's seeded violation plan (ISSUE 5).
+
+Seed-parameterized via ``CHAOS_SEED`` (CI pins {7, 23, 1337}); every seed
+replays exactly, so a red leg reproduces locally with the same value.
+
+Pinned invariants:
+- every injected violation surfaces as LimitExceededError with the plan's
+  kind — never a generic infra error, never a retry;
+- violation strikes accumulate on the lane breaker and a violation storm
+  opens the lane (fail-fast) exactly at the configured threshold;
+- interleaved healthy requests still succeed, and the service keeps serving
+  after every violation (the acceptance criterion's "next request" rule).
+"""
+
+import os
+
+import pytest
+from fakes import FakeBackend
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.backends.faults import (
+    VIOLATION,
+    FaultInjectingBackend,
+    FaultSpec,
+    ViolationTransport,
+)
+from bee_code_interpreter_fs_tpu.services.circuit_breaker import BreakerBoard
+from bee_code_interpreter_fs_tpu.services.code_executor import (
+    CircuitOpenError,
+    CodeExecutor,
+    LimitExceededError,
+)
+from bee_code_interpreter_fs_tpu.services.limits import VIOLATION_KINDS
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "7"))
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+def make_stack(tmp_path, spec: FaultSpec, *, clock=None, threshold=5):
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        executor_pod_queue_target_length=1,
+        executor_reuse_sandboxes=False,
+        pool_health_sweep_interval=0.0,
+        breaker_failure_threshold=threshold,
+        breaker_cooldown=30.0,
+    )
+    faults = {"count": 0}
+    backend = FaultInjectingBackend(
+        FakeBackend(),
+        spec,
+        on_fault=lambda kind: faults.__setitem__("count", faults["count"] + 1),
+    )
+    breakers = BreakerBoard(
+        failure_threshold=threshold,
+        cooldown=30.0,
+        clock=clock or FakeClock(),
+    )
+    executor = CodeExecutor(
+        backend, Storage(config.file_storage_path), config, breakers=breakers
+    )
+    # The fake backend serves no real HTTP: route the sandbox hop through
+    # the fault plan's transport against a scripted healthy inner response.
+    transport = backend.http_transport()
+
+    async def fake_post_execute(client, base, payload, timeout, sandbox):
+        import httpx
+
+        request = httpx.Request("POST", f"{base}/execute", json=payload)
+        if isinstance(transport, ViolationTransport) and (
+            resp := await _maybe_injected(transport, request)
+        ):
+            return resp.json()
+        return {
+            "stdout": "ok\n",
+            "stderr": "",
+            "exit_code": 0,
+            "files": [],
+            "warm": True,
+        }
+
+    executor._post_execute = fake_post_execute
+    executor._chaos_transport = transport  # rate-mutable by tests
+    return executor, faults
+
+
+async def _maybe_injected(transport: ViolationTransport, request):
+    """Run ONLY the injection half of the transport (the inner transport
+    would try to reach the fake URL)."""
+    if transport.rng.random() < transport.rate:
+        if transport.on_fault is not None:
+            transport.on_fault(VIOLATION)
+        import httpx
+
+        killed = transport.kind != "cpu_time"
+        return httpx.Response(
+            200,
+            json={
+                "stdout": "",
+                "stderr": f"Resource limit exceeded: {transport.kind} (injected)",
+                "exit_code": 137 if killed else 1,
+                "violation": transport.kind,
+                "stdout_truncated": False,
+                "stderr_truncated": False,
+                "files": [],
+                "deleted": [],
+                "warm": True,
+                "runner_restarted": killed,
+            },
+            request=request,
+        )
+    return None
+
+
+@pytest.mark.parametrize("kind", list(VIOLATION_KINDS))
+async def test_injected_violations_surface_typed_and_service_keeps_serving(
+    tmp_path, kind
+):
+    spec = FaultSpec.parse(
+        f"violation:0.5,violation_kind:{kind},seed:{CHAOS_SEED}"
+    )
+    executor, faults = make_stack(tmp_path, spec, threshold=1000)
+    try:
+        outcomes = {"ok": 0, "violation": 0}
+        for _ in range(30):
+            try:
+                result = await executor.execute("print('ok')")
+                assert result.exit_code == 0
+                outcomes["ok"] += 1
+            except LimitExceededError as e:
+                assert e.kind == kind
+                outcomes["violation"] += 1
+        # The seeded 50% plan must have produced both outcomes, the counts
+        # must match the injector's own ledger, and the service served
+        # healthy work after every violation.
+        assert outcomes["violation"] == faults["count"] > 0
+        assert outcomes["ok"] > 0
+        rendered = executor.metrics.registry.render()
+        assert (
+            f'code_interpreter_limit_violations_total{{chip_count="0",'
+            f'kind="{kind}"}} {outcomes["violation"]}' in rendered
+        )
+    finally:
+        await executor.close()
+
+
+async def test_violation_storm_opens_lane_breaker_then_recovers(tmp_path):
+    clock = FakeClock()
+    spec = FaultSpec.parse(f"violation:1.0,seed:{CHAOS_SEED}")
+    executor, faults = make_stack(tmp_path, spec, clock=clock, threshold=3)
+    try:
+        # Three consecutive killed-runner violations cross the threshold.
+        for _ in range(3):
+            with pytest.raises(LimitExceededError):
+                await executor.execute("hog")
+        assert executor.breakers.is_open(0)
+        # Open lane: already-pooled (healthy) sandboxes may serve a bounded
+        # tail, but no NEW hosts spawn — within pool-depth more requests the
+        # lane fails fast with the retryable breaker signal and the
+        # violating tenant can no longer churn hosts at full request rate.
+        shed = False
+        for _ in range(5):
+            try:
+                await executor.execute("hog")
+            except LimitExceededError:
+                continue
+            except CircuitOpenError:
+                shed = True
+                break
+        assert shed
+        # After the cooldown, a half-open probe with a healthy request
+        # closes the lane again (stop injecting so the probe is clean).
+        clock.advance(31.0)
+        executor._chaos_transport.rate = 0.0
+        result = await executor.execute("print('ok')")
+        assert result.exit_code == 0
+        assert not executor.breakers.is_open(0)
+    finally:
+        await executor.close()
+
+
+async def test_cpu_time_violations_do_not_strike_the_breaker(tmp_path):
+    # cpu_time is the in-process guard: host survives, no repeat-offender
+    # strike — a storm of them must NOT open the lane.
+    spec = FaultSpec.parse(
+        f"violation:1.0,violation_kind:cpu_time,seed:{CHAOS_SEED}"
+    )
+    executor, faults = make_stack(tmp_path, spec, threshold=3)
+    try:
+        for _ in range(6):
+            with pytest.raises(LimitExceededError) as excinfo:
+                await executor.execute("spin")
+            assert excinfo.value.continuable is True
+        assert not executor.breakers.is_open(0)
+        assert executor.breakers.lane(0)._failures == 0
+    finally:
+        await executor.close()
