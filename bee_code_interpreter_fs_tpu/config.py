@@ -274,7 +274,51 @@ class Config(BaseModel):
     # Port the jax.distributed coordinator (host 0) listens on.
     coordinator_port: int = 8476
     # Persistent XLA compilation cache shared across sandbox generations.
-    jax_compilation_cache_dir: str = "/tmp/tpu-code-interpreter/jax-cache"
+    # Deliberately OUTSIDE /tmp: pod reuse wipes /tmp at generation turnover
+    # (APP_RESET_EXTRA_WIPE_DIRS), and the historic /tmp default meant every
+    # recycled pod silently threw its compiled kernels away. The executor
+    # additionally excludes this dir's subtree from reset wipes, so even an
+    # operator override under a wiped parent survives turnover.
+    jax_compilation_cache_dir: str = "/var/tmp/tpu-code-interpreter/jax-cache"
+    # -- fleet compile cache (services/compile_cache.py) ---------------------
+    # Kill switch for the fleet-wide persistent XLA compile cache: seeding
+    # sandbox cache dirs at spawn, harvesting compiled kernels back at
+    # turnover/teardown, and the pool-fill pre-warm. 0 = exact pre-cache
+    # behavior (no compile-cache HTTP anywhere; the per-sandbox
+    # JAX_COMPILATION_CACHE_DIR still works host-locally).
+    compile_cache_enabled: bool = True
+    # Where the control plane keeps the fleet hot set (content-addressed
+    # objects + a JSON index that survives restarts). Empty = a
+    # ".compile-cache" dir beside the workspace-file objects under
+    # file_storage_path (the leading dot keeps it out of OBJECT_ID_RE's
+    # namespace, like storage's ".tmp").
+    compile_cache_store_path: str = ""
+    # Hot-set bounds: seeding a fresh sandbox is O(hot set), so these cap
+    # both the seed cost and the store's disk. Past either bound, entries
+    # evict LRU-by-last-hit (an evicted-but-hot kernel costs the fleet one
+    # recompile before harvest re-admits it).
+    compile_cache_max_bytes: int = 1073741824
+    compile_cache_max_entries: int = 4096
+    # Pre-warm the store from the examples/ kernel set (distilled: matmul /
+    # elementwise / reduction) in the background after the first pool fill —
+    # never on a serving path (batch priority, skipped under backlog).
+    compile_cache_prewarm: bool = True
+    # Local backend: give each sandbox its own private cache dir (under the
+    # sandbox dir) instead of sharing one host dir. Shared-dir is faster on
+    # one machine (zero-copy across sandboxes) and stays the default; the
+    # per-sandbox mode reproduces the pod-local reality of the kubernetes
+    # backend, where the fleet store is the ONLY cross-sandbox channel
+    # (used by the compile-cache e2e suite and bench).
+    compile_cache_per_sandbox: bool = False
+    # Kubernetes: the volume SOURCE mounted at the cache dir (the pod-side
+    # path was previously just an env var pointing at the container
+    # overlay — gone with the container). Default emptyDir survives
+    # container restarts within the pod; point it at a PVC or hostPath to
+    # share compiles across pods without control-plane seeding, e.g.
+    # {"persistentVolumeClaim": {"claimName": "jax-cache"}}.
+    compile_cache_volume_source: dict = Field(
+        default_factory=lambda: {"emptyDir": {}}
+    )
     # libtpu gives one process exclusive chip access, so warm-JAX sandboxes
     # on one machine must be serialized: at most this many hold the local
     # TPU at once (local backend spawn lease; raise on multi-chip hosts

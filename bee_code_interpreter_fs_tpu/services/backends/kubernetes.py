@@ -212,8 +212,15 @@ class KubernetesSandboxBackend(SandboxBackend):
             {"name": "APP_CHIP_COUNT", "value": str(chip_count)},
             # Pod reuse (generation turnover) must wipe every container-
             # private path user code can write outside the workspace:
-            # /tmp (tempfile) and ~/.local (pip --user lands on sys.path).
-            {"name": "APP_RESET_EXTRA_WIPE_DIRS", "value": "/tmp:~/.local"},
+            # /tmp (tempfile), ~/.local (pip --user lands on sys.path), and
+            # /var/tmp — which now hosts the default compilation-cache dir,
+            # whose subtree the executor preserves THROUGH this wipe (so
+            # compiled kernels survive turnover while everything else a
+            # tenant parked in /var/tmp does not).
+            {
+                "name": "APP_RESET_EXTRA_WIPE_DIRS",
+                "value": "/tmp:~/.local:/var/tmp",
+            },
         ]
         # Resource-governance caps (APP_LIMIT_* + the output cap). Container
         # resources still bound the pod as a whole; these add the TYPED
@@ -222,6 +229,8 @@ class KubernetesSandboxBackend(SandboxBackend):
             {"name": name, "value": value}
             for name, value in sandbox_limit_env(self.config).items()
         )
+        volumes: list[dict] = []
+        volume_mounts: list[dict] = []
         if self.config.jax_compilation_cache_dir:
             env.append(
                 {
@@ -229,11 +238,39 @@ class KubernetesSandboxBackend(SandboxBackend):
                     "value": self.config.jax_compilation_cache_dir,
                 }
             )
+            env.append(
+                {
+                    "name": "APP_COMPILE_CACHE",
+                    "value": "1" if self.config.compile_cache_enabled else "0",
+                }
+            )
+            # A real volume at the cache dir, not just an env var into the
+            # container overlay: the pod-side path is guaranteed writable
+            # and survives container restarts within the pod. The source is
+            # a knob — emptyDir by default; a PVC/hostPath shares compiles
+            # across pods without any control-plane seeding.
+            volumes.append(
+                {
+                    "name": "jax-compile-cache",
+                    **deep_merge(
+                        {}, self.config.compile_cache_volume_source or
+                        {"emptyDir": {}}
+                    ),
+                }
+            )
+            volume_mounts.append(
+                {
+                    "name": "jax-compile-cache",
+                    "mountPath": self.config.jax_compilation_cache_dir,
+                }
+            )
         if self.numpy_dispatch:
             env.append({"name": "APP_NUMPY_DISPATCH", "value": "1"})
         if env_extra:
             env.extend(env_extra)
 
+        if volumes:
+            spec = deep_merge(spec, {"volumes": volumes})
         spec = deep_merge(
             {
                 "containers": [
@@ -243,6 +280,11 @@ class KubernetesSandboxBackend(SandboxBackend):
                         "ports": [{"containerPort": EXECUTOR_PORT}],
                         "env": env,
                         "resources": resources,
+                        **(
+                            {"volumeMounts": volume_mounts}
+                            if volume_mounts
+                            else {}
+                        ),
                         # The server listens immediately; warm-up (libtpu
                         # init) runs in the background and /readyz turns 200
                         # only once the runner is hot — so pod Ready still

@@ -385,7 +385,13 @@ class LocalSandboxBackend(SandboxBackend):
         runtime_packages.mkdir(parents=True)
         scratch_tmp.mkdir(parents=True)
 
+        # All local sandboxes share one host cache dir by default (zero-copy
+        # cross-sandbox XLA cache); per-sandbox mode gives each its own dir
+        # under the sandbox root — the pod-local reality the fleet
+        # compile-cache store exists for (tests/bench exercise that mode).
         cache_dir = self.config.jax_compilation_cache_dir
+        if cache_dir and self.config.compile_cache_per_sandbox:
+            cache_dir = str(sandbox_dir / "jax-cache")
         if cache_dir:
             Path(cache_dir).mkdir(parents=True, exist_ok=True)
 
@@ -423,6 +429,12 @@ class LocalSandboxBackend(SandboxBackend):
         env.update(sandbox_limit_env(self.config))
         if cache_dir:
             env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+            # The executor's compile-cache endpoints (manifest + entry
+            # PUT/GET) serve this dir; the kill switch reaches the sandbox
+            # so a disabled fleet cache leaves NO new surface behind.
+            env["APP_COMPILE_CACHE"] = (
+                "1" if self.config.compile_cache_enabled else "0"
+            )
         # sitecustomize (media/json patches + the gated numpy shim) is always
         # on the path — in the sandbox image it lives in site-packages
         # unconditionally; only the dispatch shim inside it is env-gated.

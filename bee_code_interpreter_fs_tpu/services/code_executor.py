@@ -46,6 +46,11 @@ from ..utils.validation import (
 )
 from .backends.base import Sandbox, SandboxBackend, SandboxSpawnError, num_hosts_for
 from .circuit_breaker import BreakerBoard
+from .compile_cache import (
+    PREWARM_SOURCES,
+    CompileCacheStore,
+    SandboxCacheSync,
+)
 from .errors import (  # noqa: F401 — canonical home is errors.py; re-exported
     AdmissionRejectedError,
     CapacityTimeoutError,
@@ -129,6 +134,7 @@ class CodeExecutor:
         breakers: BreakerBoard | None = None,
         scheduler: SandboxScheduler | None = None,
         tracer: Tracer | None = None,
+        compile_cache: CompileCacheStore | None = None,
     ) -> None:
         self.backend = backend
         self.storage = storage
@@ -204,6 +210,15 @@ class CodeExecutor:
         # native failure count can't get there on its own because every
         # post-violation refill spawn succeeds and resets it.
         self._violation_strikes: dict[int, int] = {}
+        # Fleet-wide persistent XLA compile cache: the hot set seeded into
+        # every sandbox's cache dir at spawn and harvested back at
+        # turnover/teardown, so the fleet compiles each kernel once
+        # (services/compile_cache.py; the kill switch makes this a no-op
+        # store that seeds and harvests nothing).
+        self.compile_cache = compile_cache or CompileCacheStore.from_config(
+            self.config
+        )
+        self._prewarm_started = False
         # One persistent client for all sandbox HTTP: connection pooling
         # keeps per-request TCP setup off the Execute path.
         self._client: httpx.AsyncClient | None = None
@@ -211,6 +226,7 @@ class CodeExecutor:
         self.metrics.bind_sessions(self._sessions)
         self.metrics.bind_breakers(self.breakers)
         self.metrics.bind_scheduler(self.scheduler)
+        self.metrics.bind_compile_cache(self.compile_cache)
 
     def _http_client(self) -> httpx.AsyncClient:
         if self._client is None or self._client.is_closed:
@@ -372,7 +388,16 @@ class CodeExecutor:
 
         async def spawn_one() -> None:
             try:
-                sandbox = await self._spawn_with_retry(chip_count)
+                # traced_seed=False: a refill task inherits whatever trace
+                # context was current when fill_pool_soon fired, and a seed
+                # span finishing after that request's trace is read would
+                # make its span set nondeterministic. (Retry EVENTS still
+                # attach while the requester's acquisition span is open —
+                # exactly when they're relevant — and are silently dropped
+                # once it has exported, the long-standing event semantics.)
+                sandbox = await self._spawn_with_retry(
+                    chip_count, traced_seed=False
+                )
                 if self._closed:
                     await self.backend.delete(sandbox)
                 else:
@@ -399,13 +424,17 @@ class CodeExecutor:
         self._fill_tasks.add(task)
         task.add_done_callback(self._fill_tasks.discard)
 
-    async def _spawn_with_retry(self, chip_count: int) -> Sandbox:
+    async def _spawn_with_retry(
+        self, chip_count: int, *, traced_seed: bool = True
+    ) -> Sandbox:
         """Spawn with the retry engine + circuit breaker: bounded, jittered
         retries on SandboxSpawnError; every attempt first consults the
         lane's breaker, so a breaker opened mid-ladder (by this spawn's own
         failures or a sibling's) aborts the remaining attempts immediately
         with a retryable CircuitOpenError instead of hammering a backend
-        that is down."""
+        that is down. `traced_seed` is True only for spawns AWAITED on a
+        request path, where the compile-cache seed span deterministically
+        finishes inside the request's trace."""
         breaker = self.breakers.lane(chip_count)
 
         async def attempt() -> Sandbox:
@@ -434,6 +463,12 @@ class CodeExecutor:
             # Feed the scheduler's spawn-latency EWMA: one input to
             # deadline-aware admission when the warm pool is empty.
             self.scheduler.observe_spawn(chip_count, elapsed)
+            # Seed the fleet's hot compile set into the fresh sandbox's
+            # cache dir BEFORE it serves: the kernels someone already
+            # compiled load from cache instead of recompiling. Best-effort
+            # and cheap (O(hot set), conditional PUTs) — never fails a
+            # spawn.
+            await self._seed_compile_cache(sandbox, traced=traced_seed)
             return sandbox
 
         def on_retry(failures: int, error: BaseException, delay: float) -> None:
@@ -878,7 +913,7 @@ class CodeExecutor:
             # process back into the pool (generation turnover via /reset),
             # or dispose it when it can't be safely reused.
             task = asyncio.get_running_loop().create_task(
-                self._release(sandbox, lane, reusable)
+                self._off_request_path(self._release(sandbox, lane, reusable))
             )
             self._dispose_tasks.add(task)
             task.add_done_callback(self._dispose_tasks.discard)
@@ -1030,6 +1065,7 @@ class CodeExecutor:
             transfer.invalidate()
         stats.emit(self.metrics)
         phases = {**timer.as_dict(), **stats.as_phases()}
+        phases.update(self._compile_cache_phases(sandbox, bodies))
         # Correlate the response with its trace: clients quote this id at
         # GET /traces/{trace_id} (it also rides the X-Trace-Id header and
         # gRPC trailing metadata). A string among the float phase values —
@@ -1053,6 +1089,58 @@ class CodeExecutor:
             ),
         )
         return result, continuable
+
+    def _compile_cache_phases(
+        self, sandbox: Sandbox, bodies: list[dict]
+    ) -> dict[str, float]:
+        """Per-request compile-cache observability: the hosts' hit/miss and
+        new-entry counters summed into Result.phases, a trace event on the
+        execute span, and the hit/miss outcome counters. A request that
+        popped a freshly seeded sandbox also reports what seeding it cost."""
+        if not self.compile_cache.enabled:
+            return {}
+        def counter(block: dict, key: str) -> int:
+            value = block.get(key)
+            return int(value) if isinstance(value, (int, float)) and value > 0 else 0
+
+        hits = misses = new_entries = new_bytes = 0
+        seen = False
+        for body in bodies:
+            block = body.get("compile_cache")
+            if not isinstance(block, dict):
+                continue
+            seen = True
+            hits += counter(block, "hits")
+            misses += counter(block, "misses")
+            new_entries += counter(block, "new_entries")
+            new_bytes += counter(block, "new_bytes")
+        phases: dict[str, float] = {}
+        if seen:
+            phases["compile_cache_hits"] = float(hits)
+            phases["compile_cache_misses"] = float(misses)
+            phases["compile_cache_new_bytes"] = float(new_bytes)
+            if hits:
+                self.metrics.compile_cache_kernels.inc(hits, outcome="hit")
+            if misses:
+                self.metrics.compile_cache_kernels.inc(misses, outcome="miss")
+            if hits or misses or new_entries:
+                tracing.add_event(
+                    "compile_cache",
+                    hits=hits,
+                    misses=misses,
+                    new_entries=new_entries,
+                    new_bytes=new_bytes,
+                )
+        sync = sandbox.meta.get("compile_cache")
+        if (
+            isinstance(sync, SandboxCacheSync)
+            and sync.pending_seed_bytes is not None
+        ):
+            phases["compile_cache_seeded_bytes"] = float(
+                sync.pending_seed_bytes
+            )
+            sync.pending_seed_bytes = None
+        return phases
 
     def _raise_on_violation(
         self, sandbox: Sandbox, hosts: list[str], bodies: list[dict]
@@ -1220,8 +1308,15 @@ class CodeExecutor:
         if session:
             self.metrics.session_executions.inc()
         for phase, seconds in result.phases.items():
-            if phase.endswith("_bytes") or not isinstance(seconds, (int, float)):
-                continue  # byte counts and the trace id ride in phases
+            if (
+                phase.endswith("_bytes")
+                or phase.startswith("compile_cache_")
+                or not isinstance(seconds, (int, float))
+            ):
+                # Byte counts, the compile-cache hit/miss COUNTS (they have
+                # their own counter family), and the trace id all ride in
+                # phases but are not latencies.
+                continue
             self.metrics.phase_seconds.observe(seconds, phase=phase)
 
     # --------------------------------------------------------------- sessions
@@ -1460,7 +1555,9 @@ class CodeExecutor:
             sandbox.id,
         )
         task = asyncio.get_running_loop().create_task(
-            self._drop_session_sandbox(session.lane, sandbox, recycle=recycle)
+            self._off_request_path(
+                self._drop_session_sandbox(session.lane, sandbox, recycle=recycle)
+            )
         )
         self._dispose_tasks.add(task)
         task.add_done_callback(self._dispose_tasks.discard)
@@ -1738,6 +1835,119 @@ class CodeExecutor:
                 f"sandbox {sandbox.id} ({base}) returned malformed JSON: {e}"
             )
 
+    def _cache_sync(self, sandbox: Sandbox) -> SandboxCacheSync:
+        """The sandbox's compile-cache sync state, riding in `meta` like the
+        transfer manifests (generation turnover preserves the cache dir, so
+        unlike those this state is never reset)."""
+        sync = sandbox.meta.get("compile_cache")
+        if not isinstance(sync, SandboxCacheSync):
+            sync = SandboxCacheSync(self.compile_cache)
+            sandbox.meta["compile_cache"] = sync
+        return sync
+
+    async def _off_request_path(self, coro):
+        """Run background pool work (refills, releases, session drops) with
+        the trace context CLEARED: asyncio tasks snapshot their creator's
+        contextvars, so a refill or post-response release created inside a
+        request would otherwise keep attaching late spans/events to that
+        request's (long-closed) trace — making its span set
+        nondeterministic. Inside these tasks, child-span factories see no
+        current span and no-op; work awaited ON a request path still
+        traces normally."""
+        tracing.current_span_var.set(None)
+        return await coro
+
+    async def _seed_compile_cache(
+        self, sandbox: Sandbox, *, traced: bool = True
+    ) -> None:
+        """Push the fleet hot set into a fresh sandbox's cache dir (spawn
+        path). Entries the host already holds move no bytes; a legacy
+        executor (404 on the manifest route) is remembered and never probed
+        again. Failures cost a recompile, never a spawn. The span is a
+        child of the requesting trace for direct (in-request) spawns;
+        background refills pass traced=False (a span finishing after its
+        request's trace was read would make the span set nondeterministic)."""
+        if not self.compile_cache.enabled:
+            return
+        sync = self._cache_sync(sandbox)
+        try:
+            with (
+                self.tracer.span(
+                    "compile_cache.seed", attributes={"sandbox": sandbox.id}
+                )
+                if traced
+                else tracing.NOOP
+            ) as span:
+                stats = await sync.seed(self._http_client(), sandbox.host_urls)
+                span.set_attribute("bytes_pushed", stats.pushed_bytes)
+                span.set_attribute("files_pushed", stats.pushed_files)
+                span.set_attribute("files_skipped", stats.skipped_files)
+        except Exception:  # noqa: BLE001 — seeding is strictly best-effort
+            logger.warning(
+                "compile-cache seed failed for %s", sandbox.id, exc_info=True
+            )
+            return
+        self.metrics.compile_cache_bytes.inc(
+            stats.pushed_bytes, direction="seed"
+        )
+        self.metrics.compile_cache_files.inc(
+            stats.pushed_files, direction="seed"
+        )
+        self.metrics.compile_cache_skipped_files.inc(
+            stats.skipped_files, direction="seed"
+        )
+        # The first request served by this sandbox reports what seeding it
+        # cost (Result.phases compile_cache_seeded_bytes).
+        sync.pending_seed_bytes = stats.pushed_bytes
+        if stats.pushed_files:
+            logger.info(
+                "seeded %d compile-cache entries (%d bytes) into %s",
+                stats.pushed_files,
+                stats.pushed_bytes,
+                sandbox.id,
+            )
+
+    async def _harvest_compile_cache(self, sandbox: Sandbox) -> None:
+        """Pull never-seen compiled kernels out of a sandbox's cache dir
+        (turnover/teardown path, off the request hot path). The manifest's
+        shas are negotiated against the store first, so a sandbox that only
+        used seeded kernels moves zero bytes."""
+        if not self.compile_cache.enabled:
+            return
+        sync = self._cache_sync(sandbox)
+        try:
+            with self.tracer.span(
+                "compile_cache.harvest", attributes={"sandbox": sandbox.id}
+            ) as span:
+                stats = await sync.harvest(
+                    self._http_client(), sandbox.host_urls
+                )
+                span.set_attribute("bytes_harvested", stats.new_bytes)
+                span.set_attribute("files_harvested", stats.new_files)
+                span.set_attribute("files_known", stats.known_files)
+        except Exception:  # noqa: BLE001 — harvest is strictly best-effort
+            logger.warning(
+                "compile-cache harvest failed for %s", sandbox.id,
+                exc_info=True,
+            )
+            return
+        self.metrics.compile_cache_bytes.inc(
+            stats.new_bytes, direction="harvest"
+        )
+        self.metrics.compile_cache_files.inc(
+            stats.new_files, direction="harvest"
+        )
+        self.metrics.compile_cache_skipped_files.inc(
+            stats.known_files, direction="harvest"
+        )
+        if stats.new_files:
+            logger.info(
+                "harvested %d new compile-cache entries (%d bytes) from %s",
+                stats.new_files,
+                stats.new_bytes,
+                sandbox.id,
+            )
+
     def _transfer_state(self, sandbox: Sandbox) -> SandboxTransfer:
         """The sandbox's per-host manifest cache, riding in `meta` so it
         follows the sandbox through pool recycles and session parking."""
@@ -2002,6 +2212,10 @@ class CodeExecutor:
         the next request pops a hot sandbox in milliseconds — else dispose
         it and refill the lane (VERDICT r2 #1)."""
         recycled: Sandbox | None = None
+        # Harvest BEFORE reset/dispose: kernels this generation compiled
+        # must reach the fleet store even when the sandbox itself is about
+        # to die. A broken/unreachable sandbox just yields an empty harvest.
+        await self._harvest_compile_cache(sandbox)
         try:
             if (
                 recyclable
@@ -2104,6 +2318,73 @@ class CodeExecutor:
             self.sweep_pool_health, interval, "pool health sweep"
         )
 
+    def start_compile_cache_prewarm(self) -> asyncio.Task | None:
+        """Pre-warm the fleet compile-cache store from the examples/ kernel
+        set (distilled: matmul/elementwise/reduction) after pool fill.
+
+        Strictly a background nicety with attach-budget hygiene (the
+        device-health roadmap discipline — a primer must never block a
+        serving path): runs at `batch` priority so interactive work always
+        outranks it, aborts the moment real work queues on the lane, and is
+        skipped entirely when the store already holds entries (a restarted
+        control plane re-loads its persisted index — re-priming would waste
+        a sandbox's time proving what the index already knows)."""
+        if not (
+            self.config.compile_cache_enabled
+            and self.config.compile_cache_prewarm
+            and self.compile_cache.enabled
+        ):
+            return None
+        if self._prewarm_started or self.compile_cache.entry_count() > 0:
+            return None
+        self._prewarm_started = True
+        task = asyncio.get_running_loop().create_task(
+            self._prewarm_compile_cache()
+        )
+        self._fill_tasks.add(task)  # cancelled/awaited by close()
+        task.add_done_callback(self._fill_tasks.discard)
+        return task
+
+    async def _prewarm_compile_cache(self) -> None:
+        lane = self.config.default_chip_count
+        warmed = 0
+        for name, source in PREWARM_SOURCES:
+            if self._closed or self._draining:
+                return
+            if self.scheduler.queued(lane) > 0:
+                # Real requests are waiting for this lane: the pre-warm
+                # yields permanently — harvest will learn these kernels
+                # from organic traffic instead.
+                logger.info(
+                    "compile-cache pre-warm stopped: lane-%d has queued work",
+                    lane,
+                )
+                return
+            try:
+                result = await self.execute(source, priority="batch")
+            except Exception as e:  # noqa: BLE001 — prewarm must never crash
+                logger.warning(
+                    "compile-cache pre-warm kernel %s failed: %r", name, e
+                )
+                return
+            if result.exit_code != 0:
+                # e.g. jax missing in the sandbox image: pointless to
+                # continue (and harmless to stop).
+                logger.info(
+                    "compile-cache pre-warm kernel %s exited %d; stopping",
+                    name,
+                    result.exit_code,
+                )
+                return
+            warmed += 1
+        logger.info(
+            "compile-cache pre-warm complete: %d kernels, store holds %d "
+            "entries (%d bytes)",
+            warmed,
+            self.compile_cache.entry_count(),
+            self.compile_cache.total_bytes(),
+        )
+
     async def close(self) -> None:
         self._closed = True
         # Cancel in-flight pool refills — a spawn can take tens of seconds
@@ -2128,6 +2409,10 @@ class CodeExecutor:
         self._sessions.clear()
         self._session_held.clear()
         await asyncio.gather(*(self._dispose(s) for s in sandboxes))
+        # The hot set survives restarts through the persisted index (the
+        # per-harvest saves make this a formality, but a clean shutdown
+        # should never depend on the last harvest having had new entries).
+        self.compile_cache.save_index()
         if self._client is not None and not self._client.is_closed:
             await self._client.aclose()
         await self.backend.close()

@@ -324,6 +324,37 @@ class ExecutorMetrics:
             ("phase",),
             buckets=byte_buckets,
         )
+        # Fleet compile-cache observability: bytes/entries moved by the
+        # seed (spawn) and harvest (turnover) halves, negotiation skips,
+        # and the per-kernel hit/miss outcome the sandboxes report. A
+        # healthy fleet shows harvest bytes ~ once per distinct kernel and
+        # hit counters dwarfing miss counters.
+        self.compile_cache_bytes = self.registry.counter(
+            "code_interpreter_compile_cache_bytes_total",
+            "Compile-cache entry bytes actually moved between the fleet "
+            "store and sandbox cache dirs, by direction (seed/harvest).",
+            ("direction",),
+        )
+        self.compile_cache_files = self.registry.counter(
+            "code_interpreter_compile_cache_files_total",
+            "Compile-cache entries actually moved, by direction "
+            "(seed/harvest).",
+            ("direction",),
+        )
+        self.compile_cache_skipped_files = self.registry.counter(
+            "code_interpreter_compile_cache_skipped_files_total",
+            "Compile-cache entries NOT moved thanks to manifest/hash "
+            "negotiation (seed: host already held them; harvest: store "
+            "already knew them).",
+            ("direction",),
+        )
+        self.compile_cache_kernels = self.registry.counter(
+            "code_interpreter_compile_cache_kernels_total",
+            "Persistent-compilation-cache lookups reported by sandbox "
+            "runners, by outcome (hit = loaded a previously compiled "
+            "kernel, miss = had to compile).",
+            ("outcome",),
+        )
         # Tracing's per-stage latency feed: every sampled span's duration,
         # labeled by span name (a bounded set — http/grpc entry, scheduler
         # wait, transfer phases, executor call, sandbox install/exec/
@@ -337,6 +368,7 @@ class ExecutorMetrics:
         )
         self.pool_depth: Gauge | None = None
         self.active_sessions: Gauge | None = None
+        self.compile_cache_store: Gauge | None = None
         self.breaker_state: Gauge | None = None
         self.scheduler_queue_depth: Gauge | None = None
         self.scheduler_queue_wait_ewma: Gauge | None = None
@@ -366,6 +398,23 @@ class ExecutorMetrics:
             "code_interpreter_active_sessions",
             "Live executor_id sessions (sandboxes parked out of the pool).",
             (),
+            callback=sample,
+        )
+
+    def bind_compile_cache(self, store) -> None:
+        """Expose the fleet compile-cache hot set's size, read at scrape
+        time (entries + bytes; both 0 with the kill switch on)."""
+
+        def sample() -> dict[tuple[str, ...], float]:
+            return {
+                ("entries",): float(store.entry_count()),
+                ("bytes",): float(store.total_bytes()),
+            }
+
+        self.compile_cache_store = self.registry.gauge(
+            "code_interpreter_compile_cache_store",
+            "Fleet compile-cache hot set size, by stat (entries/bytes).",
+            ("stat",),
             callback=sample,
         )
 

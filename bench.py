@@ -61,7 +61,11 @@ SINGLE_SHOT_RE = re.compile(r"GFLOPS_single_shot=([0-9.]+)")
 # every run recompile every fused program from scratch, which is exactly what
 # starved the int8 leg of its budget. Content-addressed, so staleness is not
 # a concern; override with BENCH_JAX_CACHE.
-_JAX_CACHE_DIR = os.environ.get("BENCH_JAX_CACHE", "/tmp/bee_bench_jax_cache")
+# Outside /tmp: the benched sandboxes' /reset wipes /tmp-resident extra
+# dirs, and the whole point of the bench cache is surviving generations.
+_JAX_CACHE_DIR = os.environ.get(
+    "BENCH_JAX_CACHE", "/var/tmp/bee_bench_jax_cache"
+)
 TFLOPS_RE = re.compile(r"TFLOPS=([0-9.]+)")
 MFU_RE = re.compile(r"MFU_vs_v5e_peak_pct=([0-9.]+)")
 

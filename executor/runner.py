@@ -39,6 +39,48 @@ import traceback
 REQ_FD = 3
 RESP_FD = 4
 
+# Persistent-compilation-cache traffic, counted via jax.monitoring events
+# (registered in _warm_import, best-effort): the per-request delta rides the
+# execute reply so the fleet compile cache's hit rate is observable per run.
+_CACHE_EVENTS = {"hits": 0, "requests": 0, "misses": 0}
+_CACHE_LISTENING = False
+
+
+def _register_cache_listener() -> None:
+    """Count compilation-cache hit/miss monitoring events. jax's public
+    surface for this moved across versions, so resolve defensively — a miss
+    just means hit/miss counts stay unreported (the server's cache-dir diff
+    still reports new entries)."""
+    global _CACHE_LISTENING
+    try:
+        from jax._src import monitoring
+    except ImportError:
+        return
+
+    def on_event(event: str, *args, **kwargs) -> None:
+        if event == "/jax/compilation_cache/cache_hits":
+            _CACHE_EVENTS["hits"] += 1
+        elif event == "/jax/compilation_cache/compile_requests_use_cache":
+            _CACHE_EVENTS["requests"] += 1
+        elif event == "/jax/compilation_cache/cache_misses":
+            _CACHE_EVENTS["misses"] += 1
+
+    try:
+        monitoring.register_event_listener(on_event)
+        _CACHE_LISTENING = True
+    except Exception:  # noqa: BLE001 — observability must not break warm-up
+        traceback.print_exc()
+
+
+def _cache_counts() -> tuple[int, int]:
+    """(hits, misses) so far. Misses prefer the explicit event; older jax
+    only emits requests+hits, where misses = requests - hits."""
+    hits = _CACHE_EVENTS["hits"]
+    misses = _CACHE_EVENTS["misses"] or max(
+        0, _CACHE_EVENTS["requests"] - hits
+    )
+    return hits, misses
+
 
 def _send(obj: dict) -> None:
     try:
@@ -85,6 +127,9 @@ def _warm_import() -> dict:
     try:
         cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
         import jax
+
+        if cache_dir:
+            _register_cache_listener()
 
         _distributed_init(jax)
         if cache_dir:
@@ -623,10 +668,15 @@ def main() -> None:
                         # workspace — off the next request's critical path.
                         gc.collect()
                 else:
+                    hits_before, misses_before = _cache_counts()
                     exit_code, violation = _run_one(req)
                     reply: dict = {"exit_code": exit_code}
                     if violation:
                         reply["violation"] = violation
+                    if _CACHE_LISTENING:
+                        hits_after, misses_after = _cache_counts()
+                        reply["cache_hits"] = hits_after - hits_before
+                        reply["cache_misses"] = misses_after - misses_before
                     _reply(reply)
             except KeyboardInterrupt:
                 # The cancellation SIGINT raced past user code and landed in

@@ -252,6 +252,14 @@ struct ManifestEntry {
 std::map<std::string, ManifestEntry> g_ws_manifest;
 std::mutex g_ws_manifest_mutex;
 
+// Second manifest over the JAX compilation-cache dir: the executor half of
+// the FLEET compile cache (control plane seeds hot entries at spawn via
+// conditional PUTs and harvests new compiles at turnover via GET). Same
+// entry/signature machinery as the workspace manifest, its own mutex (the
+// two are never nested).
+std::map<std::string, ManifestEntry> g_cc_manifest;
+std::mutex g_cc_manifest_mutex;
+
 // Hashes one workspace file through the same race-free confined open the
 // transfer routes use (user code may have planted symlinks). Returns false
 // when the file vanished or cannot be read; `sig_out` gets the fstat
@@ -279,79 +287,96 @@ bool hash_workspace_file(const std::string& workspace, const std::string& rel,
   return true;
 }
 
-// Reconciles the manifest with the workspace as it exists NOW and returns
+// Reconciles a manifest with its base dir as it exists NOW and returns
 // rel -> sha: entries whose signature still matches keep their cached sha,
 // changed/new files are rehashed, gone files are dropped. Caller must NOT
-// hold g_ws_manifest_mutex.
-std::map<std::string, std::string> manifest_snapshot(const std::string& workspace) {
+// hold `mutex`. Shared by the workspace manifest and the compile-cache
+// manifest.
+std::map<std::string, std::string> manifest_snapshot(
+    const std::string& base, std::map<std::string, ManifestEntry>& manifest,
+    std::mutex& mutex) {
   std::map<std::string, FileSig> on_disk;
-  scan_dir(workspace, "", on_disk);
+  scan_dir(base, "", on_disk);
   std::map<std::string, std::string> out;
-  std::lock_guard<std::mutex> lock(g_ws_manifest_mutex);
-  for (auto it = g_ws_manifest.begin(); it != g_ws_manifest.end();) {
+  std::lock_guard<std::mutex> lock(mutex);
+  for (auto it = manifest.begin(); it != manifest.end();) {
     if (on_disk.find(it->first) == on_disk.end()) {
-      it = g_ws_manifest.erase(it);
+      it = manifest.erase(it);
     } else {
       ++it;
     }
   }
   for (const auto& [rel, sig] : on_disk) {
-    auto it = g_ws_manifest.find(rel);
-    if (it != g_ws_manifest.end() && it->second.sig == sig) {
+    auto it = manifest.find(rel);
+    if (it != manifest.end() && it->second.sig == sig) {
       out[rel] = it->second.sha;
       continue;
     }
     std::string hex;
     FileSig fresh;
-    if (!hash_workspace_file(workspace, rel, hex, &fresh)) continue;
-    g_ws_manifest[rel] = ManifestEntry{hex, fresh};
+    if (!hash_workspace_file(base, rel, hex, &fresh)) continue;
+    manifest[rel] = ManifestEntry{hex, fresh};
     out[rel] = hex;
   }
   return out;
 }
 
 // Recursively deletes everything INSIDE dfd (the dir itself survives — it is
-// the warm runner's cwd). fd-relative with O_NOFOLLOW so user-planted
-// symlinks are unlinked, never followed.
-void wipe_dirfd_children(int dfd) {
+// the warm runner's cwd), except the subtree rooted at `preserve` (an
+// absolute path; empty = preserve nothing). fd-relative with O_NOFOLLOW so
+// user-planted symlinks are unlinked, never followed. `dir_path` is the
+// lexical absolute path of dfd, used only for the preserve comparison.
+// Returns true when every non-preserved entry was removed.
+bool wipe_dirfd_children(int dfd, const std::string& dir_path,
+                         const std::string& preserve) {
   DIR* d = fdopendir(dup(dfd));
-  if (!d) return;
+  if (!d) return false;
+  bool ok = true;
   while (dirent* e = readdir(d)) {
     std::string name = e->d_name;
     if (name == "." || name == "..") continue;
+    std::string child_path = dir_path + "/" + name;
+    if (!preserve.empty()) {
+      if (child_path == preserve) continue;  // the preserved subtree itself
+      if (preserve.rfind(child_path + "/", 0) == 0) {
+        // The preserved dir lives somewhere below this child: recurse so
+        // its siblings still wipe, but keep the ancestor chain intact.
+        int child = openat(dfd, name.c_str(),
+                           O_DIRECTORY | O_RDONLY | O_NOFOLLOW | O_CLOEXEC);
+        if (child >= 0) {
+          if (!wipe_dirfd_children(child, child_path, preserve)) ok = false;
+          close(child);
+        } else {
+          // The ancestor is not an openable real dir — user code replaced
+          // it (symlink/file). Reporting success would let the planted
+          // node survive a "complete" wipe.
+          ok = false;
+        }
+        continue;
+      }
+    }
     if (unlinkat(dfd, name.c_str(), 0) == 0) continue;
     int child = openat(dfd, name.c_str(),
                        O_DIRECTORY | O_RDONLY | O_NOFOLLOW | O_CLOEXEC);
-    if (child >= 0) {
-      wipe_dirfd_children(child);
-      close(child);
-      unlinkat(dfd, name.c_str(), AT_REMOVEDIR);
+    if (child < 0) {
+      ok = false;  // neither unlinkable nor a walkable dir: left behind
+      continue;
     }
+    if (!wipe_dirfd_children(child, child_path, std::string())) ok = false;
+    close(child);
+    if (unlinkat(dfd, name.c_str(), AT_REMOVEDIR) != 0) ok = false;
   }
   closedir(d);
+  return ok;
 }
 
-bool wipe_dir_children(const std::string& path) {
+bool wipe_dir_children(const std::string& path,
+                       const std::string& preserve = std::string()) {
   int fd = open(path.c_str(), O_DIRECTORY | O_RDONLY | O_NOFOLLOW | O_CLOEXEC);
   if (fd < 0) return false;
-  wipe_dirfd_children(fd);
-  // Empty ⇒ fully wiped (leftovers mean an unremovable entry).
-  DIR* d = fdopendir(fd);
-  if (!d) {
-    close(fd);
-    return false;
-  }
-  rewinddir(d);
-  bool empty = true;
-  while (dirent* e = readdir(d)) {
-    std::string name = e->d_name;
-    if (name != "." && name != "..") {
-      empty = false;
-      break;
-    }
-  }
-  closedir(d);
-  return empty;
+  bool ok = wipe_dirfd_children(fd, path, preserve);
+  close(fd);
+  return ok;
 }
 
 // ---------------------------------------------------------------------------
@@ -705,6 +730,16 @@ struct ServerState {
   // /workspace-manifest, If-None-Match ignored — exactly the pre-manifest
   // binary, which is also how the control plane's fallback path is tested.
   bool manifest_enabled = true;
+  // Fleet compile cache (JAX persistent compilation cache served over
+  // HTTP): the dir JAX_COMPILATION_CACHE_DIR names, exposed as
+  // GET /compile-cache-manifest + hash-negotiated PUT/GET under
+  // /compile-cache/. APP_COMPILE_CACHE=0 (or no cache dir) removes the
+  // routes entirely — what an old binary answers too. The dir's subtree is
+  // EXCLUDED from every /reset wipe: compiled kernels are exactly the
+  // cross-generation state the wipe must not destroy (the historic /tmp
+  // default made pod reuse silently discard them each turnover).
+  std::string compile_cache_dir;
+  bool compile_cache_enabled = false;
   // Extra directories whose CONTENTS are wiped on /reset (colon-separated;
   // "~/x" = HOME-relative; missing dirs are fine). Closes the cross-
   // generation channels outside workspace/runtime-packages: the sandbox's
@@ -790,7 +825,26 @@ void start_warm_async() {
 const std::string* prefix_base(const std::string& prefix) {
   if (prefix == "workspace") return &g_state.workspace;
   if (prefix == "runtime-packages") return &g_state.runtime_packages;
+  if (prefix == "compile-cache" && g_state.compile_cache_enabled)
+    return &g_state.compile_cache_dir;
   return nullptr;
+}
+
+// The manifest (map + mutex) negotiating transfers for a prefix, or
+// nullptrs for unmanifested prefixes (runtime-packages; everything when
+// the protocol is off).
+void prefix_manifest(const std::string& prefix,
+                     std::map<std::string, ManifestEntry>*& map_out,
+                     std::mutex*& mutex_out) {
+  map_out = nullptr;
+  mutex_out = nullptr;
+  if (prefix == "workspace" && g_state.manifest_enabled) {
+    map_out = &g_ws_manifest;
+    mutex_out = &g_ws_manifest_mutex;
+  } else if (prefix == "compile-cache" && g_state.compile_cache_enabled) {
+    map_out = &g_cc_manifest;
+    mutex_out = &g_cc_manifest_mutex;
+  }
 }
 
 // Splits "/workspace/a/b" → ("workspace", "a/b"). Tolerates the reference
@@ -823,7 +877,10 @@ void handle_upload(const minihttp::Request& req, minihttp::Conn& conn) {
     conn.send_response(404, "application/json", "{\"error\":\"unknown prefix\"}");
     return;
   }
-  bool manifested = g_state.manifest_enabled && prefix == "workspace";
+  std::map<std::string, ManifestEntry>* mani = nullptr;
+  std::mutex* mani_mutex = nullptr;
+  prefix_manifest(prefix, mani, mani_mutex);
+  bool manifested = mani != nullptr;
   // Conditional upload: `If-None-Match: <sha256 of the body being sent>`.
   // When the manifest says the file at `rel` already holds exactly that
   // content (and the disk signature still matches — user code may have
@@ -837,9 +894,9 @@ void handle_upload(const minihttp::Request& req, minihttp::Conn& conn) {
     bool matches = false;
     FileSig cached{0, 0};
     {
-      std::lock_guard<std::mutex> lock(g_ws_manifest_mutex);
-      auto it = g_ws_manifest.find(rel);
-      if (it != g_ws_manifest.end() && it->second.sha == cond) {
+      std::lock_guard<std::mutex> lock(*mani_mutex);
+      auto it = mani->find(rel);
+      if (it != mani->end() && it->second.sha == cond) {
         matches = true;
         cached = it->second.sig;
       }
@@ -883,8 +940,8 @@ void handle_upload(const minihttp::Request& req, minihttp::Conn& conn) {
       // already freed those bytes, so counting the stale size would 413
       // legitimate re-uploads of changed files (the delta-sync's normal
       // path) on any workspace near half its quota.
-      std::lock_guard<std::mutex> lock(g_ws_manifest_mutex);
-      for (const auto& [entry_rel, entry] : g_ws_manifest)
+      std::lock_guard<std::mutex> lock(*mani_mutex);
+      for (const auto& [entry_rel, entry] : *mani)
         if (entry_rel != rel) usage_before += entry.sig.size;
     } else {
       usage_before = limits::dir_usage_bytes(*base);
@@ -907,8 +964,8 @@ void handle_upload(const minihttp::Request& req, minihttp::Conn& conn) {
         ftruncate(fd, 0);
         close(fd);
         if (manifested) {
-          std::lock_guard<std::mutex> lock(g_ws_manifest_mutex);
-          g_ws_manifest.erase(rel);
+          std::lock_guard<std::mutex> lock(*mani_mutex);
+          mani->erase(rel);
         }
         conn.drain_body();
         conn.send_response(
@@ -948,8 +1005,8 @@ void handle_upload(const minihttp::Request& req, minihttp::Conn& conn) {
   if (manifested) {
     std::string sha = hasher.hex();
     if (have_sig) {
-      std::lock_guard<std::mutex> lock(g_ws_manifest_mutex);
-      g_ws_manifest[rel] = ManifestEntry{
+      std::lock_guard<std::mutex> lock(*mani_mutex);
+      (*mani)[rel] = ManifestEntry{
           sha,
           FileSig{st.st_mtim.tv_sec * 1000000000LL + st.st_mtim.tv_nsec,
                   st.st_size}};
@@ -969,7 +1026,40 @@ void handle_manifest(const minihttp::Request&, minihttp::Conn& conn) {
     return;
   }
   minijson::Object files;
-  for (const auto& [rel, sha] : manifest_snapshot(g_state.workspace)) {
+  for (const auto& [rel, sha] :
+       manifest_snapshot(g_state.workspace, g_ws_manifest, g_ws_manifest_mutex)) {
+    files[rel] = minijson::Value(sha);
+  }
+  minijson::Object resp;
+  resp["files"] = minijson::Value(files);
+  conn.send_response(200, "application/json", minijson::Value(resp).dump());
+}
+
+// jax keeps 8-byte "-atime" sidecars beside each cache entry (its own
+// local LRU bookkeeping, rewritten on every cache READ). They are per-host
+// state with no fleet meaning and would churn the manifest on every hit —
+// keep them out of the protocol entirely.
+bool cc_entry_ignored(const std::string& rel) {
+  static const std::string kSuffix = "-atime";
+  return rel.size() >= kSuffix.size() &&
+         rel.compare(rel.size() - kSuffix.size(), kSuffix.size(), kSuffix) == 0;
+}
+
+// GET /compile-cache-manifest — the fleet compile cache's negotiation
+// surface: rel -> sha256 of every entry in the JAX compilation-cache dir
+// (lazily rehashed, exactly like the workspace manifest). The control
+// plane seeds against it at spawn (only missing entries cross the wire)
+// and harvests against it at turnover (only never-seen entries come back).
+// 404 when the compile cache is off — what an old binary answers too.
+void handle_cc_manifest(const minihttp::Request&, minihttp::Conn& conn) {
+  if (!g_state.compile_cache_enabled) {
+    conn.send_response(404, "application/json", "{\"error\":\"no route\"}");
+    return;
+  }
+  minijson::Object files;
+  for (const auto& [rel, sha] : manifest_snapshot(
+           g_state.compile_cache_dir, g_cc_manifest, g_cc_manifest_mutex)) {
+    if (cc_entry_ignored(rel)) continue;
     files[rel] = minijson::Value(sha);
   }
   minijson::Object resp;
@@ -1119,6 +1209,11 @@ struct RunOutcome {
   // Typed resource-limit violation ("" = none): which limit killed the run
   // (watchdog/rlimit) or fired in-process (the runner's soft guards).
   std::string violation;
+  // Persistent-compilation-cache traffic observed by the warm runner's
+  // jax.monitoring listener during this run (-1 = not reported: cold
+  // subprocess, old runner, or jax without the monitoring surface).
+  long long cache_hits = -1;
+  long long cache_misses = -1;
 };
 
 // The execution core shared by /execute and /execute/stream: run the script
@@ -1190,6 +1285,10 @@ RunOutcome run_user_code(const std::string& script_path,
           case WarmRunner::ExecResult::kOk:
             out.exit_code = static_cast<int>(resp.get_number("exit_code", -1));
             out.violation = resp.get_string("violation", "");
+            out.cache_hits =
+                static_cast<long long>(resp.get_number("cache_hits", -1));
+            out.cache_misses =
+                static_cast<long long>(resp.get_number("cache_misses", -1));
             break;
           case WarmRunner::ExecResult::kTimeout:
             out.timed_out = true;
@@ -1389,6 +1488,13 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
 
   std::map<std::string, FileSig> before;
   scan_dir(g_state.workspace, "", before);
+  // Compile-cache observability: diff the cache dir across the run — new
+  // entries are kernels THIS run had to compile (persistent-cache misses
+  // made durable), which the control plane harvests and the fleet never
+  // compiles again.
+  std::map<std::string, FileSig> cc_before;
+  if (g_state.compile_cache_enabled)
+    scan_dir(g_state.compile_cache_dir, "", cc_before);
   double install_s = since_req() - install_start;
 
   std::string stdout_path = scratch + "/cap.stdout";
@@ -1577,6 +1683,28 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
   if (!run.violation.empty()) resp["violation"] = minijson::Value(run.violation);
   resp["files"] = minijson::Value(files);
   if (g_state.manifest_enabled) resp["deleted"] = minijson::Value(deleted);
+  if (g_state.compile_cache_enabled) {
+    std::map<std::string, FileSig> cc_after;
+    scan_dir(g_state.compile_cache_dir, "", cc_after);
+    long long new_entries = 0, new_bytes = 0;
+    for (const auto& [rel, sig] : cc_after) {
+      if (cc_entry_ignored(rel)) continue;  // jax's local -atime sidecars
+      auto it = cc_before.find(rel);
+      if (it == cc_before.end() || !(it->second == sig)) {
+        ++new_entries;
+        new_bytes += sig.size;
+      }
+    }
+    minijson::Object cc;
+    cc["new_entries"] = minijson::Value(static_cast<int64_t>(new_entries));
+    cc["new_bytes"] = minijson::Value(static_cast<int64_t>(new_bytes));
+    cc["entries"] = minijson::Value(static_cast<int64_t>(cc_after.size()));
+    if (run.cache_hits >= 0)
+      cc["hits"] = minijson::Value(static_cast<int64_t>(run.cache_hits));
+    if (run.cache_misses >= 0)
+      cc["misses"] = minijson::Value(static_cast<int64_t>(run.cache_misses));
+    resp["compile_cache"] = minijson::Value(cc);
+  }
   resp["duration_s"] = minijson::Value(duration);
   if (!traceparent.empty()) {
     // The control plane sent trace context: report per-phase timings so it
@@ -1701,16 +1829,27 @@ void handle_reset(const minihttp::Request&, minihttp::Conn& conn) {
   }
   // Runner scrubbed first (strays that could still write files are dead),
   // then the filesystem: workspace AND runtime-packages — a package the
-  // previous user planted must never be importable by the next one.
-  if (!wipe_dir_children(g_state.workspace) ||
-      !wipe_dir_children(g_state.runtime_packages)) {
+  // previous user planted must never be importable by the next one. The
+  // compilation-cache subtree is preserved EVERYWHERE: compiled XLA
+  // kernels are the one cross-generation state turnover must keep (they
+  // carry no user data — entries are keyed by HLO hash), and the historic
+  // layout put the cache dir under /tmp, squarely inside the k8s backend's
+  // APP_RESET_EXTRA_WIPE_DIRS.
+  // Gated on the kill switch: APP_COMPILE_CACHE=0 must restore EXACT
+  // pre-cache reset behavior — a preserved-but-unserved cache dir would
+  // keep the one cross-generation channel the switch exists to close.
+  const std::string preserve =
+      g_state.compile_cache_enabled ? g_state.compile_cache_dir
+                                    : std::string();
+  if (!wipe_dir_children(g_state.workspace, preserve) ||
+      !wipe_dir_children(g_state.runtime_packages, preserve)) {
     refuse("workspace wipe incomplete");
     return;
   }
   for (const auto& dir : g_state.extra_wipe_dirs) {
     struct stat st;
     if (stat(dir.c_str(), &st) != 0) continue;  // absent dir leaks nothing
-    if (!wipe_dir_children(dir)) {
+    if (!wipe_dir_children(dir, preserve)) {
       refuse("extra wipe dir incomplete");
       return;
     }
@@ -1737,6 +1876,8 @@ void route(const minihttp::Request& req, minihttp::Conn& conn) {
     handle_reset(req, conn);
   } else if (req.method == "GET" && req.target == "/workspace-manifest") {
     handle_manifest(req, conn);
+  } else if (req.method == "GET" && req.target == "/compile-cache-manifest") {
+    handle_cc_manifest(req, conn);
   } else if (req.method == "GET" && req.target == "/healthz") {
     handle_healthz(req, conn);
   } else if (req.method == "GET" && req.target == "/readyz") {
@@ -1781,6 +1922,27 @@ int main() {
   g_state.warm_eager = env_flag("APP_WARM_EAGER", true);
   g_state.auto_install = env_flag("APP_AUTO_INSTALL_DEPS", false);
   g_state.manifest_enabled = env_flag("APP_WORKSPACE_MANIFEST", true);
+  {
+    // The fleet compile cache serves the same dir JAX writes its
+    // persistent compilation cache to; no dir (or APP_COMPILE_CACHE=0)
+    // removes the routes AND the reset-wipe exclusion.
+    std::string cc = env_or("JAX_COMPILATION_CACHE_DIR", "");
+    while (cc.size() > 1 && cc.back() == '/') cc.pop_back();
+    g_state.compile_cache_dir = cc;
+    g_state.compile_cache_enabled =
+        !cc.empty() && env_flag("APP_COMPILE_CACHE", true);
+    if (g_state.compile_cache_enabled) {
+      // mkdir -p: the dir may be several levels deep (the default lives
+      // under /var/tmp/<service>/) and must exist before the first seed
+      // PUT or manifest GET lands.
+      std::string partial;
+      for (size_t i = 0; i <= cc.size(); ++i) {
+        char c = i < cc.size() ? cc[i] : '/';
+        if (c == '/' && !partial.empty()) mkdir(partial.c_str(), 0777);
+        partial += c;
+      }
+    }
+  }
   {
     std::string dirs = env_or("APP_RESET_EXTRA_WIPE_DIRS", "");
     std::string home = env_or("HOME", "");

@@ -1,0 +1,470 @@
+"""Fleet compile-cache tests: store lifecycle (record/evict/persist/kill
+switch), the seed/harvest protocol over an in-memory fake sandbox host
+(httpx.MockTransport via the backend's http_transport hook), the legacy
+old-binary fallback, the end-to-end control-plane flow (seed at spawn,
+harvest at turnover, Result.phases counters), and the seeded-chaos leg
+(drops mid-harvest leave no partial objects; kill switch = zero
+compile-cache HTTP).
+"""
+
+import asyncio
+import hashlib
+import random
+
+import httpx
+import pytest
+from fakes import FakeBackend
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor
+from bee_code_interpreter_fs_tpu.services.compile_cache import (
+    CompileCacheStore,
+    SandboxCacheSync,
+    valid_entry_name,
+)
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+CHAOS_SEEDS = [7, 23, 1337]
+
+
+def sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def make_store(tmp_path, **kwargs) -> CompileCacheStore:
+    kwargs.setdefault("max_bytes", 1 << 20)
+    kwargs.setdefault("max_entries", 64)
+    return CompileCacheStore(tmp_path / "cc", **kwargs)
+
+
+async def admit(store: CompileCacheStore, rel: str, data: bytes) -> str:
+    object_id = await store.storage.write(data)
+    await store.record(rel, object_id, len(data))
+    return object_id
+
+
+# --------------------------------------------------------------------- store
+
+
+async def test_store_record_and_manifest(tmp_path):
+    store = make_store(tmp_path)
+    object_id = await admit(store, "jit_f-abc-cache", b"executable-bytes")
+    assert store.manifest() == {"jit_f-abc-cache": object_id}
+    assert store.total_bytes() == len(b"executable-bytes")
+    assert store.entry_count() == 1
+
+
+async def test_store_lru_eviction_by_last_hit(tmp_path):
+    clock = [0.0]
+    store = make_store(tmp_path, max_entries=2, clock=lambda: clock[0])
+    await admit(store, "old", b"a" * 10)
+    clock[0] = 1.0
+    await admit(store, "mid", b"b" * 10)
+    clock[0] = 2.0
+    store.touch("old")  # refresh: "mid" is now the LRU entry
+    clock[0] = 3.0
+    await admit(store, "new", b"c" * 10)
+    assert set(store.manifest()) == {"old", "new"}
+    # The evicted entry's bytes are gone from the object store.
+    assert not await store.storage.exists(sha(b"b" * 10))
+
+
+async def test_store_byte_cap_eviction_keeps_shared_objects(tmp_path):
+    clock = [0.0]
+    store = make_store(tmp_path, max_bytes=25, clock=lambda: clock[0])
+    # Two entries deduping onto identical bytes: evicting one must not
+    # delete the other's object.
+    await admit(store, "first", b"x" * 10)
+    clock[0] = 1.0
+    await admit(store, "twin", b"x" * 10)
+    clock[0] = 2.0
+    await admit(store, "big", b"y" * 10)  # 30 bytes total -> evict "first"
+    assert "first" not in store.manifest()
+    assert await store.storage.exists(sha(b"x" * 10))
+
+
+async def test_store_index_persists_across_restart(tmp_path):
+    store = make_store(tmp_path)
+    object_id = await admit(store, "jit_g-def-cache", b"persisted")
+    store.save_index()
+    reloaded = make_store(tmp_path)
+    assert reloaded.manifest() == {"jit_g-def-cache": object_id}
+    assert await reloaded.storage.exists(object_id)
+
+
+async def test_store_kill_switch_is_inert(tmp_path):
+    store = make_store(tmp_path, enabled=False)
+    assert store.manifest() == {}
+    assert await store.record("x", "0" * 64, 10) == []
+    assert store.entry_count() == 0
+    # Disabled store creates nothing on disk.
+    assert not (tmp_path / "cc").exists()
+
+
+def test_entry_name_validation():
+    assert valid_entry_name("jit_f-abc-cache")
+    assert valid_entry_name("nested/ok")
+    assert not valid_entry_name("../escape")
+    assert not valid_entry_name("/abs")
+    assert not valid_entry_name("")
+    assert not valid_entry_name("a" * 513)
+
+
+# ----------------------------------------------------- fake host + protocol
+
+
+class FakeCacheHost:
+    """In-memory executor host speaking the compile-cache protocol (or a
+    legacy binary without the routes with ``legacy=True``). ``drop_gets``
+    makes entry GETs raise mid-request (the chaos lever). Also answers the
+    workspace routes CodeExecutor's request path needs."""
+
+    def __init__(self, legacy: bool = False):
+        self.legacy = legacy
+        self.cache: dict[str, bytes] = {}
+        self.requests: list[str] = []  # "<METHOD> <path>" log, cc routes only
+        self.puts: list[str] = []
+        self.conditional_hits: list[str] = []
+        self.drop_gets = False
+        self.drop_decider = None  # callable(rel) -> bool, overrides drop_gets
+        self.execute_compile_cache: dict | None = None
+
+    def _log(self, request: httpx.Request) -> None:
+        path = request.url.path
+        if "compile-cache" in path:
+            self.requests.append(f"{request.method} {path}")
+
+    async def handler(self, request: httpx.Request) -> httpx.Response:
+        path = request.url.path
+        self._log(request)
+        if path == "/compile-cache-manifest":
+            if self.legacy:
+                return httpx.Response(404, json={"error": "no route"})
+            return httpx.Response(
+                200,
+                json={"files": {rel: sha(data) for rel, data in self.cache.items()}},
+            )
+        if path.startswith("/compile-cache/"):
+            rel = path[len("/compile-cache/") :]
+            if self.legacy:
+                return httpx.Response(404, json={"error": "no route"})
+            if request.method == "PUT":
+                body = await request.aread()
+                cond = request.headers.get("If-None-Match")
+                if cond and rel in self.cache and sha(self.cache[rel]) == cond:
+                    self.conditional_hits.append(rel)
+                    return httpx.Response(304)
+                self.cache[rel] = body
+                self.puts.append(rel)
+                return httpx.Response(
+                    200, json={"path": path, "sha256": sha(body), "size": len(body)}
+                )
+            if request.method == "GET":
+                if rel not in self.cache:
+                    return httpx.Response(404, json={"error": "not found"})
+                dropper = self.drop_decider
+                if self.drop_gets or (dropper is not None and dropper(rel)):
+                    raise httpx.ReadError("connection dropped mid-entry")
+                return httpx.Response(200, content=self.cache[rel])
+        if request.method == "POST" and path == "/execute":
+            body = {
+                "stdout": "ok\n",
+                "stderr": "",
+                "exit_code": 0,
+                "files": [],
+                "deleted": [],
+                "warm": True,
+                "runner_restarted": False,
+            }
+            if self.execute_compile_cache is not None:
+                body["compile_cache"] = self.execute_compile_cache
+            return httpx.Response(200, json=body)
+        if request.method == "POST" and path == "/reset":
+            # Generation turnover never wipes the compile-cache dir.
+            return httpx.Response(200, json={"ok": True})
+        if request.method == "GET" and path == "/workspace-manifest":
+            return httpx.Response(200, json={"files": {}})
+        return httpx.Response(404, json={"error": "no route"})
+
+    def transport(self) -> httpx.MockTransport:
+        return httpx.MockTransport(self.handler)
+
+
+def make_sync(tmp_path, host, **store_kwargs):
+    store = make_store(tmp_path, **store_kwargs)
+    sync = SandboxCacheSync(store)
+    client = httpx.AsyncClient(transport=host.transport())
+    return store, sync, client
+
+
+async def test_seed_pushes_only_missing_entries(tmp_path):
+    host = FakeCacheHost()
+    host.cache["already-there"] = b"present"
+    store, sync, client = make_sync(tmp_path, host)
+    await admit(store, "already-there", b"present")
+    await admit(store, "missing", b"new-kernel")
+    stats = await sync.seed(client, ["http://host-a"])
+    assert host.puts == ["missing"]
+    assert host.cache["missing"] == b"new-kernel"
+    assert stats.pushed_files == 1
+    assert stats.pushed_bytes == len(b"new-kernel")
+    assert stats.skipped_files == 1
+    await client.aclose()
+
+
+async def test_seed_second_round_moves_nothing(tmp_path):
+    host = FakeCacheHost()
+    store, sync, client = make_sync(tmp_path, host)
+    await admit(store, "kernel", b"bytes")
+    await sync.seed(client, ["http://host-a"])
+    first_round = list(host.requests)
+    stats = await sync.seed(client, ["http://host-a"])
+    # Round 2: one manifest GET, zero PUTs — unchanged entries never cross
+    # the wire twice.
+    assert host.requests[len(first_round) :] == [
+        "GET /compile-cache-manifest"
+    ]
+    assert stats.pushed_files == 0 and stats.skipped_files == 1
+    await client.aclose()
+
+
+async def test_legacy_host_probed_exactly_once(tmp_path):
+    host = FakeCacheHost(legacy=True)
+    store, sync, client = make_sync(tmp_path, host)
+    await admit(store, "kernel", b"bytes")
+    await sync.seed(client, ["http://host-a"])
+    await sync.harvest(client, ["http://host-a"])
+    await sync.seed(client, ["http://host-a"])
+    # One manifest GET proved the host legacy; nothing afterwards.
+    assert host.requests == ["GET /compile-cache-manifest"]
+    await client.aclose()
+
+
+async def test_harvest_pulls_new_entries_and_skips_known(tmp_path):
+    host = FakeCacheHost()
+    host.cache["known"] = b"old-kernel"
+    host.cache["fresh"] = b"new-kernel"
+    store, sync, client = make_sync(tmp_path, host)
+    await admit(store, "known", b"old-kernel")
+    stats = await sync.harvest(client, ["http://host-a"])
+    assert stats.new_files == 1
+    assert stats.known_files == 1
+    assert store.manifest()["fresh"] == sha(b"new-kernel")
+    assert await store.storage.read(sha(b"new-kernel")) == b"new-kernel"
+    # Only the fresh entry was downloaded.
+    assert "GET /compile-cache/fresh" in host.requests
+    assert "GET /compile-cache/known" not in host.requests
+    await client.aclose()
+
+
+async def test_harvest_dedups_identical_bytes_under_new_name(tmp_path):
+    host = FakeCacheHost()
+    host.cache["same-bytes-new-name"] = b"shared-executable"
+    store, sync, client = make_sync(tmp_path, host)
+    await admit(store, "original-name", b"shared-executable")
+    stats = await sync.harvest(client, ["http://host-a"])
+    # The bytes were already stored: the mapping records without a GET.
+    assert stats.known_files == 2 or (
+        stats.known_files == 1 and stats.new_files == 0
+    )
+    assert "GET /compile-cache/same-bytes-new-name" not in host.requests
+    assert store.manifest()["same-bytes-new-name"] == sha(b"shared-executable")
+    await client.aclose()
+
+
+async def test_harvest_drop_leaves_no_partial_objects(tmp_path):
+    host = FakeCacheHost()
+    host.cache["doomed"] = b"never-arrives"
+    host.drop_gets = True
+    store, sync, client = make_sync(tmp_path, host)
+    stats = await sync.harvest(client, ["http://host-a"])
+    assert stats.new_files == 0
+    assert store.manifest() == {}
+    # No partial objects, no tmp leftovers.
+    objects = [
+        p
+        for p in (store.path / "objects").rglob("*")
+        if p.is_file()
+    ]
+    assert objects == []
+    await client.aclose()
+
+
+async def test_harvest_hash_mismatch_discarded(tmp_path):
+    host = FakeCacheHost()
+    host.cache["liar"] = b"promised-content"
+
+    real_handler = host.handler
+
+    async def lying_handler(request: httpx.Request) -> httpx.Response:
+        if request.method == "GET" and request.url.path.endswith("/liar"):
+            host._log(request)
+            return httpx.Response(200, content=b"DIFFERENT-content")
+        return await real_handler(request)
+
+    store = make_store(tmp_path)
+    sync = SandboxCacheSync(store)
+    client = httpx.AsyncClient(transport=httpx.MockTransport(lying_handler))
+    stats = await sync.harvest(client, ["http://host-a"])
+    assert stats.discarded == 1
+    assert stats.new_files == 0
+    assert store.manifest() == {}
+    # Neither identity survived: not the promised sha, not the actual one.
+    assert not await store.storage.exists(sha(b"promised-content"))
+    assert not await store.storage.exists(sha(b"DIFFERENT-content"))
+    await client.aclose()
+
+
+# ------------------------------------------------- CodeExecutor integration
+
+
+class CacheBackend(FakeBackend):
+    """FakeBackend whose sandbox HTTP lands on one FakeCacheHost."""
+
+    def __init__(self, host: FakeCacheHost, **kwargs):
+        super().__init__(**kwargs)
+        self.fake_host = host
+
+    def http_transport(self):
+        return self.fake_host.transport()
+
+
+def make_stack(tmp_path, legacy=False, **config_kwargs):
+    host = FakeCacheHost(legacy=legacy)
+    backend = CacheBackend(host)
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        executor_pod_queue_target_length=1,
+        **config_kwargs,
+    )
+    executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
+    return executor, host, backend
+
+
+async def settle(executor):
+    for _ in range(3):
+        await asyncio.sleep(0)
+    tasks = list(executor._dispose_tasks) + list(executor._fill_tasks)
+    if tasks:
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+
+async def test_spawn_seeds_and_turnover_harvests(tmp_path):
+    executor, host, backend = make_stack(tmp_path)
+    try:
+        await admit(executor.compile_cache, "hot-kernel", b"hot-bytes")
+        host.cache["compiled-here"] = b"organic-kernel"
+        result = await executor.execute("print('hi')")
+        assert result.exit_code == 0
+        # Seed at spawn pushed the hot set into the sandbox...
+        assert host.cache["hot-kernel"] == b"hot-bytes"
+        # ...and the seeding cost rides the first request's phases.
+        assert result.phases["compile_cache_seeded_bytes"] == float(
+            len(b"hot-bytes")
+        )
+        await settle(executor)
+        # Turnover harvested the kernel the sandbox compiled organically.
+        assert executor.compile_cache.manifest()["compiled-here"] == sha(
+            b"organic-kernel"
+        )
+    finally:
+        await executor.close()
+
+
+async def test_execute_surfaces_hit_miss_phases(tmp_path):
+    executor, host, backend = make_stack(tmp_path)
+    try:
+        host.execute_compile_cache = {
+            "hits": 3,
+            "misses": 1,
+            "new_entries": 1,
+            "new_bytes": 2048,
+        }
+        result = await executor.execute("print('hi')")
+        assert result.phases["compile_cache_hits"] == 3.0
+        assert result.phases["compile_cache_misses"] == 1.0
+        assert result.phases["compile_cache_new_bytes"] == 2048.0
+    finally:
+        await executor.close()
+
+
+async def test_kill_switch_means_zero_compile_cache_http(tmp_path):
+    executor, host, backend = make_stack(
+        tmp_path, compile_cache_enabled=False
+    )
+    try:
+        result = await executor.execute("print('hi')")
+        assert result.exit_code == 0
+        await settle(executor)
+        assert host.requests == []  # no cc routes touched, ever
+        assert "compile_cache_hits" not in result.phases
+        assert "compile_cache_seeded_bytes" not in result.phases
+    finally:
+        await executor.close()
+
+
+async def test_legacy_executor_fallback_in_full_flow(tmp_path):
+    """A fleet on an old binary (no cc endpoints) behaves exactly as before
+    the cache existed: one probe per host, requests unharmed."""
+    executor, host, backend = make_stack(tmp_path, legacy=True)
+    try:
+        await admit(executor.compile_cache, "hot-kernel", b"hot-bytes")
+        result = await executor.execute("print('hi')")
+        assert result.exit_code == 0
+        await settle(executor)
+        probes = [r for r in host.requests if r == "GET /compile-cache-manifest"]
+        assert len(probes) == 1
+        assert len(host.requests) == 1
+    finally:
+        await executor.close()
+
+
+# ------------------------------------------------------------------- chaos
+
+
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+async def test_seeded_chaos_harvest_integrity(tmp_path, seed):
+    """Seeded drops mid-harvest: whatever subset survives, every stored
+    object verifies against its content hash (no partial or mislabeled
+    objects) and the index never references bytes the store lacks."""
+    rng = random.Random(seed)
+    host = FakeCacheHost()
+    for i in range(12):
+        host.cache[f"jit_k{i}-cache"] = bytes([i]) * (50 + i)
+    host.drop_decider = lambda rel: rng.random() < 0.5
+    store = make_store(tmp_path)
+    sync = SandboxCacheSync(store)
+    client = httpx.AsyncClient(transport=host.transport())
+    for _ in range(3):  # several harvest rounds, drops resampled each time
+        await sync.harvest(client, ["http://host-a"])
+    manifest = store.manifest()
+    for rel, object_id in manifest.items():
+        data = await store.storage.read(object_id)
+        assert sha(data) == object_id, f"corrupt object for {rel}"
+        assert data == host.cache[rel]
+    # Nothing beyond the verified objects + index lives in the store dir.
+    object_files = {
+        p.name for p in (store.path / "objects").iterdir() if p.is_file()
+    }
+    assert object_files == set(manifest.values())
+    await client.aclose()
+
+
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+async def test_seeded_chaos_disabled_is_pre_cache_exact(tmp_path, seed):
+    """Cache disabled under the same chaos plan: byte-for-byte pre-cache
+    behavior — zero compile-cache requests regardless of faults."""
+    rng = random.Random(seed)
+    host = FakeCacheHost()
+    host.drop_decider = lambda rel: rng.random() < 0.5
+    executor, host2, backend = make_stack(
+        tmp_path, compile_cache_enabled=False
+    )
+    try:
+        for _ in range(3):
+            result = await executor.execute("print('x')")
+            assert result.exit_code == 0
+        await settle(executor)
+        assert host2.requests == []
+    finally:
+        await executor.close()

@@ -160,6 +160,64 @@ async def test_pod_spec_extra_merges(fake_kubectl):
     assert limits == {"memory": "2Gi", "google.com/tpu": "4"}
 
 
+def test_compile_cache_volume_mounted(fake_kubectl):
+    """The cache dir is a real volume (emptyDir by default), not an env var
+    pointing at the container overlay: the pod-side path is guaranteed
+    writable and survives container restarts within the pod."""
+    kubectl, _, _ = fake_kubectl
+    backend = _backend(kubectl)
+    manifest = backend.pod_manifest("p", 0, None)
+    cache_dir = backend.config.jax_compilation_cache_dir
+    assert manifest["spec"]["volumes"] == [
+        {"name": "jax-compile-cache", "emptyDir": {}}
+    ]
+    container = manifest["spec"]["containers"][0]
+    assert container["volumeMounts"] == [
+        {"name": "jax-compile-cache", "mountPath": cache_dir}
+    ]
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["JAX_COMPILATION_CACHE_DIR"] == cache_dir
+    assert env["APP_COMPILE_CACHE"] == "1"
+
+
+def test_compile_cache_volume_source_knob(fake_kubectl):
+    kubectl, _, _ = fake_kubectl
+    backend = _backend(
+        kubectl,
+        compile_cache_volume_source={
+            "persistentVolumeClaim": {"claimName": "fleet-jax-cache"}
+        },
+    )
+    manifest = backend.pod_manifest("p", 0, None)
+    assert manifest["spec"]["volumes"][0]["persistentVolumeClaim"] == {
+        "claimName": "fleet-jax-cache"
+    }
+
+
+def test_compile_cache_kill_switch_reaches_pod_env(fake_kubectl):
+    kubectl, _, _ = fake_kubectl
+    backend = _backend(kubectl, compile_cache_enabled=False)
+    manifest = backend.pod_manifest("p", 0, None)
+    env = {
+        e["name"]: e["value"]
+        for e in manifest["spec"]["containers"][0]["env"]
+    }
+    # The per-pod cache dir still works host-locally; only the fleet
+    # endpoints are off.
+    assert env["APP_COMPILE_CACHE"] == "0"
+
+
+def test_no_cache_dir_means_no_volume(fake_kubectl):
+    kubectl, _, _ = fake_kubectl
+    backend = _backend(kubectl, jax_compilation_cache_dir="")
+    manifest = backend.pod_manifest("p", 0, None)
+    assert "volumes" not in manifest["spec"]
+    container = manifest["spec"]["containers"][0]
+    assert "volumeMounts" not in container
+    env_names = {e["name"] for e in container["env"]}
+    assert "JAX_COMPILATION_CACHE_DIR" not in env_names
+
+
 async def test_spawn_failure_deletes_pod(fake_kubectl):
     kubectl, state, calls = fake_kubectl
     (state / "fail_wait").touch()
