@@ -305,17 +305,24 @@ class Config(BaseModel):
     compile_cache_prewarm: bool = True
     # Local backend: give each sandbox its own private cache dir (under the
     # sandbox dir) instead of sharing one host dir. Shared-dir is faster on
-    # one machine (zero-copy across sandboxes) and stays the default; the
-    # per-sandbox mode reproduces the pod-local reality of the kubernetes
-    # backend, where the fleet store is the ONLY cross-sandbox channel
-    # (used by the compile-cache e2e suite and bench).
+    # one machine (zero-copy across sandboxes, and the fleet-constant path
+    # jax's key hashing demands) and stays the default — but the shared dir
+    # is writable by every sandbox, so harvest stops control-plane-wide at
+    # the first tenant execute and the backend wipes the dir at boot for a
+    # fresh trusted epoch (see LocalSandboxBackend.compile_cache_dir_scope).
+    # The per-sandbox mode reproduces the pod-local reality of the
+    # kubernetes backend, where the fleet store is the ONLY cross-sandbox
+    # channel (used by the compile-cache e2e suite).
     compile_cache_per_sandbox: bool = False
     # Kubernetes: the volume SOURCE mounted at the cache dir (the pod-side
     # path was previously just an env var pointing at the container
     # overlay — gone with the container). Default emptyDir survives
     # container restarts within the pod; point it at a PVC or hostPath to
     # share compiles across pods without control-plane seeding, e.g.
-    # {"persistentVolumeClaim": {"claimName": "jax-cache"}}.
+    # {"persistentVolumeClaim": {"claimName": "jax-cache"}} — which also
+    # disables fleet harvest AND the pre-warm (other pods' tenants can
+    # write a shared volume, so nothing can vouch for its contents; see
+    # KubernetesSandboxBackend.compile_cache_dir_scope).
     compile_cache_volume_source: dict = Field(
         default_factory=lambda: {"emptyDir": {}}
     )

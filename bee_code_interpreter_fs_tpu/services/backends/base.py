@@ -124,6 +124,25 @@ class SandboxBackend(Protocol):
         """Tear the sandbox down (idempotent, must not raise)."""
         ...
 
+    @property
+    def compile_cache_dir_scope(self) -> str:
+        """Who can write a sandbox's JAX compilation-cache dir — the trust
+        statement the fleet compile-cache harvest gate is built on:
+
+        - ``"private"``  — each sandbox has its own dir (local per-sandbox
+          mode, kubernetes emptyDir): only that sandbox's own runs write
+          it, so per-sandbox taint vouches for its contents.
+        - ``"shared"``   — one dir shared by ALL of this control plane's
+          sandboxes (local shared-dir mode): any tenant run anywhere
+          taints it for the control plane's lifetime.
+        - ``"external"`` — writable by parties outside this control plane
+          (kubernetes PVC/hostPath volume sources): nothing can vouch for
+          it, harvest is structurally impossible.
+
+        CodeExecutor reads this with a fail-closed ``"external"`` default,
+        so a backend that does not declare a scope is never harvested."""
+        ...
+
     async def reset(self, sandbox: Sandbox) -> Sandbox | None:
         """Scrub the sandbox for a new generation, keeping its warm device
         process (TPU lease) alive: wiped workspace, reaped stray processes,

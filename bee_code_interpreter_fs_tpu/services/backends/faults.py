@@ -240,6 +240,14 @@ class FaultInjectingBackend(SandboxBackend):
         if bind is not None:
             bind(board)
 
+    @property
+    def compile_cache_dir_scope(self) -> str:
+        """The wrapper injects faults, it doesn't change who can write the
+        cache dir — delegate the trust statement to the real backend
+        (fail-closed "external" if it declares nothing)."""
+        scope = getattr(self.inner, "compile_cache_dir_scope", None)
+        return scope if scope in ("private", "shared") else "external"
+
     def _fire(self, name: str, rate: float) -> bool:
         if rate <= 0.0 or self._rngs[name].random() >= rate:
             return False

@@ -84,6 +84,20 @@ class KubernetesSandboxBackend(SandboxBackend):
         self._cleanup_tasks: set[asyncio.Task] = set()
         self._breakers = None  # BreakerBoard, bound by the executor
 
+    @property
+    def compile_cache_dir_scope(self) -> str:
+        """emptyDir (any config — sizeLimit/medium) is always pod-private,
+        so per-sandbox taint vouches for the dir. Any other volume source
+        (PVC/hostPath) can be written by OTHER pods' tenants — parties this
+        control plane never sees — so nothing can vouch for it and harvest
+        is structurally off ("external"). The shared volume itself already
+        moves compiles across pods; harvest would add a cross-tenant
+        admission channel, not coverage."""
+        source = self.config.compile_cache_volume_source
+        if not source or set(source) == {"emptyDir"}:
+            return "private"
+        return "external"
+
     def bind_breakers(self, board) -> None:
         """Give the pod-watch path direct access to the executor's per-lane
         spawn breakers: a failed `kubectl wait` / IP-assignment watch counts
@@ -244,26 +258,39 @@ class KubernetesSandboxBackend(SandboxBackend):
                     "value": "1" if self.config.compile_cache_enabled else "0",
                 }
             )
-            # A real volume at the cache dir, not just an env var into the
-            # container overlay: the pod-side path is guaranteed writable
-            # and survives container restarts within the pod. The source is
-            # a knob — emptyDir by default; a PVC/hostPath shares compiles
-            # across pods without any control-plane seeding.
-            volumes.append(
-                {
-                    "name": "jax-compile-cache",
-                    **deep_merge(
-                        {}, self.config.compile_cache_volume_source or
-                        {"emptyDir": {}}
-                    ),
-                }
-            )
-            volume_mounts.append(
-                {
-                    "name": "jax-compile-cache",
-                    "mountPath": self.config.jax_compilation_cache_dir,
-                }
-            )
+            if self.config.compile_cache_enabled:
+                # A real volume at the cache dir, not just an env var into
+                # the container overlay: the pod-side path is guaranteed
+                # writable and survives container restarts within the pod.
+                # The source is a knob — emptyDir by default; a PVC/hostPath
+                # shares compiles across pods without any control-plane
+                # seeding. A non-emptyDir source also turns fleet HARVEST
+                # off (compile_cache_dir_scope == "external"): other pods'
+                # tenants can write a shared volume, so per-sandbox
+                # provenance can't vouch for its contents.
+                # Cache DISABLED skips the mount entirely: the executor's
+                # preserve is off then, so the reset wipe would empty the
+                # mount each turnover (the wipe forgives the mount point's
+                # EBUSY, so /reset still succeeds — but an empty mount
+                # point would linger where pre-cache pods had nothing).
+                # Without the mount the cache dir is an ordinary path under
+                # /var/tmp that the wipe removes like any other residue —
+                # exact pre-cache pod spec AND turnover.
+                volumes.append(
+                    {
+                        "name": "jax-compile-cache",
+                        **deep_merge(
+                            {}, self.config.compile_cache_volume_source or
+                            {"emptyDir": {}}
+                        ),
+                    }
+                )
+                volume_mounts.append(
+                    {
+                        "name": "jax-compile-cache",
+                        "mountPath": self.config.jax_compilation_cache_dir,
+                    }
+                )
         if self.numpy_dispatch:
             env.append({"name": "APP_NUMPY_DISPATCH", "value": "1"})
         if env_extra:

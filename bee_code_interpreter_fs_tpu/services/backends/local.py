@@ -125,6 +125,41 @@ class LocalSandboxBackend(SandboxBackend):
         self._build_lock = asyncio.Lock()
         self._build_failed = False  # memo: never re-run a failed auto-build
         self._slot_holders: set[str] = set()  # sandbox/host ids holding a slot
+        self._fresh_cache_epoch()
+
+    @property
+    def compile_cache_dir_scope(self) -> str:
+        """Shared-dir mode (the default: one host dir, zero-copy across
+        sandboxes — and the fleet-constant path jax's key hashing demands
+        for cross-sandbox hits) is writable by every sandbox on this
+        control plane; per-sandbox mode gives each its own dir."""
+        return (
+            "private" if self.config.compile_cache_per_sandbox else "shared"
+        )
+
+    def _fresh_cache_epoch(self) -> None:
+        """Shared-dir mode + fleet cache on: start the shared cache dir
+        EMPTY. Its contents are harvest-vouchable only while every write
+        came from this control plane's trusted-only epoch (see
+        CodeExecutor._harvest_compile_cache) — a dir surviving a previous
+        control-plane lifetime could hold that lifetime's TENANT writes,
+        which a fresh untainted pre-warm sandbox would then present as its
+        own. The warm-start cost is bounded: the fleet store survives
+        restarts and reseeds the dir at first spawn. Kill switch off =
+        dir untouched (exact pre-cache, host-local behavior)."""
+        cache_dir = self.config.jax_compilation_cache_dir
+        if not (
+            cache_dir
+            and self.config.compile_cache_enabled
+            and not self.config.compile_cache_per_sandbox
+        ):
+            return
+        if Path(cache_dir).exists():
+            logger.info(
+                "shared JAX cache dir %s: wiping for a fresh trusted epoch",
+                cache_dir,
+            )
+            shutil.rmtree(cache_dir, ignore_errors=True)
 
     def _tpu_exclusive(self) -> bool:
         """Would a warm-JAX runner grab a real (exclusive-access) TPU?

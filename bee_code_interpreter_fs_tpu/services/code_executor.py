@@ -25,6 +25,7 @@ backend-agnostic and TPU-aware:
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import json
 import logging
 import time
@@ -72,6 +73,17 @@ from .transfer import (
 )
 
 logger = logging.getLogger(__name__)
+
+# True only inside _execute_trusted (the compile-cache pre-warm): the running
+# request's source is control-plane-authored, so it does NOT taint its
+# sandbox's compile-cache provenance. Everything else — every API-originated
+# execute, session or one-shot — is tenant code and taints the sandbox
+# forever (see SandboxCacheSync.tainted). A contextvar, not a parameter:
+# the flag must ride the request's own task through the retry/session
+# plumbing without widening every signature in between.
+_trusted_source_var: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "compile_cache_trusted_source", default=False
+)
 
 
 def _drain(pool: deque) -> list:
@@ -219,6 +231,16 @@ class CodeExecutor:
             self.config
         )
         self._prewarm_started = False
+        # Control-plane-wide taint for backends whose sandboxes SHARE one
+        # cache dir (compile_cache_dir_scope == "shared": the local
+        # backend's default mode). There, per-sandbox taint can't vouch
+        # for the dir — any tenant run on ANY sandbox writes the same
+        # path every other sandbox's harvest manifest lists — so the
+        # first tenant execute ends harvesting for this control plane's
+        # lifetime (the dir persists; the backend starts it empty, see
+        # LocalSandboxBackend._fresh_cache_epoch). Pre-warm runs before
+        # tenant load, so the store still fills in the trusted-only epoch.
+        self._shared_cache_tainted = False
         # One persistent client for all sandbox HTTP: connection pooling
         # keeps per-request TCP setup off the Execute path.
         self._client: httpx.AsyncClient | None = None
@@ -626,7 +648,7 @@ class CodeExecutor:
                         f"capacity={capacity}); retry later"
                     )
                 if granted and pool:
-                    sandbox = pool.popleft()
+                    sandbox = self._pop_pool_sandbox(pool)
                     break
                 if (
                     self.breakers.is_open(chip_count)
@@ -698,6 +720,24 @@ class CodeExecutor:
         self._in_use[chip_count] = self._in_use.get(chip_count, 0) + 1
         self.fill_pool_soon(chip_count)
         return sandbox
+
+    def _pop_pool_sandbox(self, pool: deque) -> Sandbox:
+        """Pop the next pooled sandbox for the current request. Trusted
+        (pre-warm) requests prefer an UNTAINTED one: their whole point is
+        producing harvestable artifacts, and a recycled sandbox that ever
+        ran tenant code is harvest-ineligible for life — running the
+        trusted kernels there compiles fine but admits nothing. A
+        preference, not a requirement: when every pooled sandbox is
+        tainted the leftmost is returned anyway (stalling the acquire to
+        wait for an untainted spawn could livelock a constrained lane;
+        the pre-warm pass instead detects the empty store and retries —
+        see _prewarm_compile_cache)."""
+        if self.compile_cache.enabled and _trusted_source_var.get():
+            for i, candidate in enumerate(pool):
+                if not self._cache_sync(candidate).tainted:
+                    del pool[i]
+                    return candidate
+        return pool.popleft()
 
     # --------------------------------------------------------------- execute
 
@@ -972,6 +1012,18 @@ class CodeExecutor:
         0 is the coordinator and, per JAX convention, does the singular side
         effects worth watching live."""
         client = self._http_client()
+        if self.compile_cache.enabled and not _trusted_source_var.get():
+            # Tenant code is about to run (or try to): this sandbox's cache
+            # dir is attacker-writable from here on, so its compile-cache
+            # harvest eligibility is revoked for the sandbox's lifetime —
+            # the cache dir survives /reset, so the taint must too. Set
+            # BEFORE any tenant byte runs, so a harvest racing this request
+            # can never observe untainted state after a tenant write.
+            self._cache_sync(sandbox).taint()
+            if self._compile_cache_dir_scope() == "shared":
+                # Every sandbox shares this one's cache dir: the write
+                # surface is control-plane-wide, so the taint is too.
+                self._shared_cache_tainted = True
         # A multi-host slice is one sandbox with an executor per host:
         # inputs go to every host, /execute fires on every host (the
         # hosts rendezvous via their pre-established jax.distributed
@@ -1841,7 +1893,14 @@ class CodeExecutor:
         unlike those this state is never reset)."""
         sync = sandbox.meta.get("compile_cache")
         if not isinstance(sync, SandboxCacheSync):
-            sync = SandboxCacheSync(self.compile_cache)
+            # harvest_allowed is re-evaluated INSIDE the sync at every
+            # admission: on a shared cache dir the revoking tenant run is
+            # on a different sandbox, so the revocation can land while
+            # this sandbox's harvest is mid-flight awaiting the network.
+            sync = SandboxCacheSync(
+                self.compile_cache,
+                harvest_allowed=self._harvest_still_trusted,
+            )
             sandbox.meta["compile_cache"] = sync
         return sync
 
@@ -1907,14 +1966,51 @@ class CodeExecutor:
                 sandbox.id,
             )
 
+    def _compile_cache_dir_scope(self) -> str:
+        """The backend's trust statement about who can write a sandbox's
+        cache dir (see SandboxBackend.compile_cache_dir_scope). Fail
+        closed: a backend that declares nothing (or something unknown) is
+        treated as "external" and never harvested."""
+        scope = getattr(self.backend, "compile_cache_dir_scope", None)
+        return scope if scope in ("private", "shared") else "external"
+
+    def _harvest_still_trusted(self) -> bool:
+        """Control-plane-level harvest trust AS OF NOW — the cache-dir
+        scopes a sandbox's own taint can't speak for. Handed to every
+        SandboxCacheSync so it is re-evaluated mid-harvest at each
+        admission (the revoking event — a tenant run on a DIFFERENT
+        sandbox sharing the dir — can land while a harvest is awaiting
+        the network)."""
+        scope = self._compile_cache_dir_scope()
+        if scope == "external":
+            return False
+        return not (scope == "shared" and self._shared_cache_tainted)
+
     async def _harvest_compile_cache(self, sandbox: Sandbox) -> None:
         """Pull never-seen compiled kernels out of a sandbox's cache dir
         (turnover/teardown path, off the request hot path). The manifest's
         shas are negotiated against the store first, so a sandbox that only
-        used seeded kernels moves zero bytes."""
+        used seeded kernels moves zero bytes.
+
+        Provenance-gated on the backend's cache-dir scope: with a PRIVATE
+        dir, only sandboxes that have NEVER run tenant code (untainted —
+        in practice the pre-warm runs) are harvested; with a SHARED dir
+        (local backend default — the fleet-constant path jax's key
+        hashing demands) any tenant run anywhere taints the whole dir,
+        so harvest stops control-plane-wide at the first tenant execute
+        (the backend starts the dir empty, so the trusted-only epoch is
+        airtight); an EXTERNAL dir (k8s PVC/hostPath) is writable by
+        parties this control plane never sees and is never harvested. A
+        tainted dir is attacker-writable and its artifacts are serialized
+        executables every seeded sandbox would run, so it gets no harvest
+        HTTP at all — not even the manifest probe."""
         if not self.compile_cache.enabled:
             return
+        if not self._harvest_still_trusted():
+            return
         sync = self._cache_sync(sandbox)
+        if sync.tainted:
+            return
         try:
             with self.tracer.span(
                 "compile_cache.harvest", attributes={"sandbox": sandbox.id}
@@ -1925,6 +2021,7 @@ class CodeExecutor:
                 span.set_attribute("bytes_harvested", stats.new_bytes)
                 span.set_attribute("files_harvested", stats.new_files)
                 span.set_attribute("files_known", stats.known_files)
+                span.set_attribute("conflicts", stats.conflicts)
         except Exception:  # noqa: BLE001 — harvest is strictly best-effort
             logger.warning(
                 "compile-cache harvest failed for %s", sandbox.id,
@@ -1940,6 +2037,7 @@ class CodeExecutor:
         self.metrics.compile_cache_skipped_files.inc(
             stats.known_files, direction="harvest"
         )
+        self.metrics.compile_cache_conflicts.inc(stats.conflicts)
         if stats.new_files:
             logger.info(
                 "harvested %d new compile-cache entries (%d bytes) from %s",
@@ -2325,17 +2423,38 @@ class CodeExecutor:
         Strictly a background nicety with attach-budget hygiene (the
         device-health roadmap discipline — a primer must never block a
         serving path): runs at `batch` priority so interactive work always
-        outranks it, aborts the moment real work queues on the lane, and is
-        skipped entirely when the store already holds entries (a restarted
-        control plane re-loads its persisted index — re-priming would waste
-        a sandbox's time proving what the index already knows)."""
+        outranks it, and while real work is queued on the lane it waits
+        out the backlog (30s backoff) rather than occupying a slot —
+        pre-warm is the store's only admission source, so it never gives
+        up just because the lane is busy. It runs on EVERY control-plane
+        start, warm persisted index or not:
+        pre-warm runs are the store's only admission source, so this is
+        where an evicted-but-still-prewarmed kernel gets re-admitted (one
+        trusted recompile, with fresh recency). Surviving entries are NOT
+        refreshed by the pass — they get seeded into the pre-warm sandbox,
+        and harvest deliberately ignores seeded entries' re-observation
+        (see SandboxCacheSync.harvest_host) — so on a warm store the
+        sandboxes compile nothing and the whole pass costs a few
+        batch-priority executes."""
         if not (
             self.config.compile_cache_enabled
             and self.config.compile_cache_prewarm
             and self.compile_cache.enabled
         ):
             return None
-        if self._prewarm_started or self.compile_cache.entry_count() > 0:
+        if self._compile_cache_dir_scope() == "external":
+            # Harvest is structurally off (shared PVC/hostPath volume:
+            # nothing can vouch for the dir), so no pre-warm pass could
+            # ever admit anything — running one would burn TPU time on
+            # kernels whose artifacts the store must refuse, then warn
+            # about an empty store as if something had failed.
+            logger.info(
+                "compile-cache pre-warm skipped: the backend's cache dir "
+                "is externally writable, so harvest (the store's only "
+                "admission source) is disabled"
+            )
+            return None
+        if self._prewarm_started:
             return None
         self._prewarm_started = True
         task = asyncio.get_running_loop().create_task(
@@ -2345,28 +2464,124 @@ class CodeExecutor:
         task.add_done_callback(self._fill_tasks.discard)
         return task
 
+    async def _execute_trusted(self, source_code: str, **kwargs) -> Result:
+        """Run CONTROL-PLANE-AUTHORED code through the normal execute path
+        without tainting the sandbox's compile-cache provenance — the only
+        way a sandbox stays harvest-eligible (see _run_on_sandbox). Callers
+        must pass literal, control-plane-owned source: anything derived from
+        tenant input would reopen the cache-poisoning channel the taint
+        exists to close."""
+        token = _trusted_source_var.set(True)
+        try:
+            return await self.execute(source_code, **kwargs)
+        finally:
+            _trusted_source_var.reset(token)
+
+    # Backoff between pre-warm attempts while real work is queued on the
+    # lane, and between retries of an ineffective pass. Class attribute so
+    # tests can shrink it.
+    _PREWARM_BACKOFF_SECONDS = 30.0
+    # A pass whose kernels all ran yet admitted NOTHING (store still empty)
+    # landed on tainted recycled sandboxes — under sustained load with
+    # reuse on, the pool can hold only tenant-tainted sandboxes, and a
+    # trusted run there compiles fine but is harvest-ineligible. Retrying
+    # gives the untainted-preference pool pop (_pop_pool_sandbox) fresh
+    # spawns to land on; bounded so a deployment whose only sandbox is
+    # tainted for life degrades to a loud warning, not an infinite loop.
+    _PREWARM_MAX_PASSES = 5
+
     async def _prewarm_compile_cache(self) -> None:
         lane = self.config.default_chip_count
-        warmed = 0
-        for name, source in PREWARM_SOURCES:
-            if self._closed or self._draining:
-                return
-            if self.scheduler.queued(lane) > 0:
-                # Real requests are waiting for this lane: the pre-warm
-                # yields permanently — harvest will learn these kernels
-                # from organic traffic instead.
-                logger.info(
-                    "compile-cache pre-warm stopped: lane-%d has queued work",
-                    lane,
+        for attempt in range(self._PREWARM_MAX_PASSES):
+            if attempt:
+                await asyncio.sleep(self._PREWARM_BACKOFF_SECONDS)
+                if self._closed or self._draining:
+                    return
+            if (
+                self._compile_cache_dir_scope() == "shared"
+                and self._shared_cache_tainted
+            ):
+                # Tenant code beat the pre-warm to the shared cache dir:
+                # the taint is control-plane-lifetime, so no later pass
+                # can ever admit anything — retrying would just burn
+                # sandbox time warning about it.
+                logger.warning(
+                    "compile-cache pre-warm stopped: tenant code already "
+                    "ran against the shared cache dir, so harvest is off "
+                    "for this control plane's lifetime (store has %d "
+                    "entries)",
+                    self.compile_cache.entry_count(),
                 )
                 return
+            warmed = await self._prewarm_pass(lane)
+            if warmed is None:
+                return  # shutdown, or a kernel failed: retrying won't help
+            # Harvest runs inside the release task execute() fires in its
+            # finally (off the request hot path), so the last kernel's
+            # admissions may still be in flight when the pass returns —
+            # let in-flight releases settle before judging the pass by
+            # the store's contents.
+            pending = [t for t in self._dispose_tasks if not t.done()]
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+            if self.compile_cache.entry_count() > 0:
+                logger.info(
+                    "compile-cache pre-warm complete: %d kernels, store "
+                    "holds %d entries (%d bytes)",
+                    warmed,
+                    self.compile_cache.entry_count(),
+                    self.compile_cache.total_bytes(),
+                )
+                return
+            logger.warning(
+                "compile-cache pre-warm pass %d ran %d kernels but admitted "
+                "nothing (tainted sandboxes or harvest failures); retrying",
+                attempt + 1,
+                warmed,
+            )
+        logger.warning(
+            "compile-cache pre-warm gave up after %d ineffective passes: "
+            "the fleet store is empty and has no other admission source",
+            self._PREWARM_MAX_PASSES,
+        )
+
+    async def _prewarm_pass(self, lane: int) -> int | None:
+        """One trusted run of every pre-warm kernel. Returns the number of
+        kernels that ran, or None when the pass should never be retried
+        (shutdown, or a kernel itself failed — e.g. jax missing from the
+        sandbox image)."""
+        warmed = 0
+        for name, source in PREWARM_SOURCES:
+            waiting_logged = False
+            while self.scheduler.queued(lane) > 0:
+                # Real requests are waiting for this lane: don't occupy a
+                # sandbox slot for priming — wait for a quiet moment
+                # instead of aborting forever. Pre-warm runs are the fleet
+                # store's ONLY admission source, so a control plane
+                # restarted under sustained load would otherwise serve its
+                # whole lifetime with an empty store, recompiling every
+                # kernel on every spawn. Logged once per wait, not per
+                # 30s poll — sustained load would otherwise turn this
+                # into an unbounded periodic log line.
+                if not waiting_logged:
+                    logger.info(
+                        "compile-cache pre-warm waiting: lane-%d has "
+                        "queued work",
+                        lane,
+                    )
+                    waiting_logged = True
+                await asyncio.sleep(self._PREWARM_BACKOFF_SECONDS)
+                if self._closed or self._draining:
+                    return None
+            if self._closed or self._draining:
+                return None
             try:
-                result = await self.execute(source, priority="batch")
+                result = await self._execute_trusted(source, priority="batch")
             except Exception as e:  # noqa: BLE001 — prewarm must never crash
                 logger.warning(
                     "compile-cache pre-warm kernel %s failed: %r", name, e
                 )
-                return
+                return None
             if result.exit_code != 0:
                 # e.g. jax missing in the sandbox image: pointless to
                 # continue (and harmless to stop).
@@ -2375,15 +2590,9 @@ class CodeExecutor:
                     name,
                     result.exit_code,
                 )
-                return
+                return None
             warmed += 1
-        logger.info(
-            "compile-cache pre-warm complete: %d kernels, store holds %d "
-            "entries (%d bytes)",
-            warmed,
-            self.compile_cache.entry_count(),
-            self.compile_cache.total_bytes(),
-        )
+        return warmed
 
     async def close(self) -> None:
         self._closed = True

@@ -20,15 +20,35 @@ artifacts so the fleet compiles each kernel exactly once:
   into its cache dir before serving (GET /compile-cache-manifest to learn
   what the host already holds, conditional PUT for the rest — unchanged
   entries never cross the wire twice).
-- **Harvest at turnover/teardown** — after a sandbox serves (generation
-  turnover or disposal), entries it compiled that the store has never seen
-  are pulled back (hash-negotiated: the manifest's sha is checked against
-  the store before any bytes move).
+- **Harvest at turnover/teardown, TRUSTED PROVENANCE ONLY** — after a
+  sandbox serves (generation turnover or disposal), entries it compiled
+  that the store has never seen are pulled back (hash-negotiated: the
+  manifest's sha is checked against the store before any bytes move).
+  Admission is gated on provenance: a sandbox is harvestable only while
+  every piece of code it has EVER run was control-plane-authored (the
+  pre-warm kernel set). The moment tenant code executes on a sandbox its
+  sync state is tainted for the sandbox's lifetime and harvest never
+  touches it again — user code can write arbitrary bytes into
+  ``JAX_COMPILATION_CACHE_DIR``, and a harvested artifact is a serialized
+  XLA executable that every seeded sandbox would deserialize and run
+  (cross-tenant code execution), while even a benignly compiled artifact
+  can embed tenant data through constant folding (cross-tenant data
+  leak). Tenant-compiled artifacts therefore never enter the fleet store,
+  full stop; they still serve that one sandbox locally through its
+  preserved cache dir. As a second line of defense the store is
+  first-write-wins: a harvest manifest presenting different bytes under
+  an entry name the store already maps is rejected, never admitted as a
+  replacement.
 - **Bounded hot set** — LRU by last hit with byte+entry caps, so seeding
-  stays O(hot set), not O(history). An evicted-but-actually-hot entry costs
-  the fleet exactly one recompile (some sandbox recompiles it, harvest
-  re-admits it with a fresh last_hit) — a deliberate second-chance dynamic
-  instead of trying to observe cache reads remotely.
+  stays O(hot set), not O(history). Recency moves only on evidence of a
+  real (re)compile: harvest admission, or a trusted sandbox presenting an
+  entry the control plane did NOT seed into it (seeded entries reappear
+  in every harvest manifest, so their re-observation proves nothing).
+  The hot set self-heals across control-plane restarts: pre-warm runs on
+  every start, so an evicted-but-still-prewarmed kernel is recompiled and
+  re-admitted with fresh recency (one trusted recompile), while a kernel
+  dropped from ``PREWARM_SOURCES`` is never refreshed again and ages to
+  the LRU end.
 
 A host that 404s the manifest route is remembered as legacy (old executor
 binary) and is never probed again; the kill switch
@@ -47,6 +67,7 @@ import json
 import logging
 import os
 import time
+from collections.abc import Callable
 from dataclasses import dataclass, field
 from pathlib import Path
 
@@ -99,6 +120,7 @@ class HarvestStats:
     new_bytes: int = 0
     known_files: int = 0  # manifest entries the store already had
     discarded: int = 0  # bytes arrived but hash mismatched the manifest
+    conflicts: int = 0  # entry name already mapped to DIFFERENT bytes
 
 
 @dataclass
@@ -136,6 +158,11 @@ class CompileCacheStore:
         self._clock = clock
         self.path = Path(store_path)
         self._entries: dict[str, _Entry] = {}
+        # True whenever the entry map has mutated since the last successful
+        # save — new admissions, dedup mappings AND evictions (eviction
+        # deletes storage objects, so an unsaved index would reference bytes
+        # the store no longer holds after a restart).
+        self._dirty = False
         if not enabled:
             # Kill switch: no directories created, no state, every surface
             # answers empty — exact pre-cache behavior.
@@ -207,6 +234,13 @@ class CompileCacheStore:
             os.replace(tmp, self.path / self.INDEX_NAME)
         except OSError:
             logger.warning("compile-cache index save failed", exc_info=True)
+        else:
+            self._dirty = False
+
+    @property
+    def dirty(self) -> bool:
+        """Entry map mutated since the last successful save_index()."""
+        return self._dirty
 
     # ------------------------------------------------------------- hot set
 
@@ -231,6 +265,7 @@ class CompileCacheStore:
         if entry is not None:
             entry.last_hit = self._clock()
             entry.hits += 1
+            self._dirty = True
 
     async def record(self, rel: str, sha: str, size: int) -> list[str]:
         """Admit a harvested entry (bytes already in storage under `sha`)
@@ -240,6 +275,7 @@ class CompileCacheStore:
         self._entries[rel] = _Entry(
             sha=sha, size=max(0, int(size)), last_hit=self._clock(), hits=1
         )
+        self._dirty = True
         return await self._evict_over_caps()
 
     async def _evict_over_caps(self) -> list[str]:
@@ -254,6 +290,7 @@ class CompileCacheStore:
             rel = min(self._entries, key=lambda r: self._entries[r].last_hit)
             entry = self._entries.pop(rel)
             evicted.append(rel)
+            self._dirty = True
             if not any(e.sha == entry.sha for e in self._entries.values()):
                 try:
                     await self.storage.delete(entry.sha)
@@ -281,14 +318,21 @@ class HostCacheState:
     the host legacy (an old binary without the endpoints) — after which no
     compile-cache HTTP is ever attempted again for that host."""
 
-    __slots__ = ("present", "supports")
+    __slots__ = ("present", "supports", "seeded")
 
     def __init__(self) -> None:
         self.present: dict[str, str] = {}
         self.supports: bool | None = None
+        # Entry names whose host copy the store is KNOWN to agree with —
+        # seeded into it, confirmed present at seed time, or admitted
+        # from it by an earlier harvest. Their reappearance in a harvest
+        # manifest is NOT evidence of a recompile (the cache dir outlives
+        # /reset), so harvest must not refresh their recency.
+        self.seeded: set[str] = set()
 
     def mark_legacy(self) -> None:
         self.present = {}
+        self.seeded = set()
         self.supports = False
 
 
@@ -301,13 +345,48 @@ class SandboxCacheSync:
     generations.
     """
 
-    def __init__(self, store: CompileCacheStore) -> None:
+    def __init__(
+        self,
+        store: CompileCacheStore,
+        *,
+        harvest_allowed: Callable[[], bool] | None = None,
+    ) -> None:
         self.store = store
+        # Control-plane-level trust gate, re-evaluated MID-harvest: on a
+        # shared cache dir the writer that revokes trust is a different
+        # sandbox, so the revocation can land while this sandbox's harvest
+        # is awaiting the network — every admission re-checks it (see
+        # _trust_revoked) so bytes written after the revocation can never
+        # be admitted. None = only per-sandbox taint gates.
+        self._harvest_allowed = harvest_allowed
         self._hosts: dict[str, HostCacheState] = {}
         # Surfaced into the first Result.phases after a seed (the request
         # that popped this freshly seeded sandbox reports what seeding it
         # cost) — see CodeExecutor._run_on_sandbox.
         self.pending_seed_bytes: int | None = None
+        # Provenance gate for harvest. False only while every piece of code
+        # this sandbox has ever run was control-plane-authored (pre-warm);
+        # flips True — permanently, the cache dir outlives /reset — the
+        # moment tenant code executes. A tainted sandbox's cache dir is
+        # attacker-writable, and harvested entries are serialized XLA
+        # executables the fleet would deserialize and run, so harvest
+        # refuses it outright (not even a manifest probe).
+        self.tainted = False
+
+    def taint(self) -> None:
+        self.tainted = True
+
+    def _trust_revoked(self) -> bool:
+        """Harvest trust as of RIGHT NOW. Checked at every await boundary
+        that can admit bytes, not just at harvest entry: the taint (per
+        sandbox or control-plane-wide via `harvest_allowed`) is set before
+        the tainting tenant code runs, so any cache-dir write that code
+        makes strictly follows the flag — a re-check immediately before
+        admission therefore can never admit a post-revocation write, even
+        when the revocation landed mid-harvest."""
+        if self.tainted:
+            return True
+        return self._harvest_allowed is not None and not self._harvest_allowed()
 
     def host(self, base_url: str) -> HostCacheState:
         state = self._hosts.get(base_url)
@@ -380,20 +459,24 @@ class SandboxCacheSync:
             except (StorageObjectNotFound, ValueError):
                 continue  # index ahead of storage (crash window): skip
             if remote.get(rel) == sha:
+                state.seeded.add(rel)
                 stats.skipped_files += 1
                 stats.skipped_bytes += size
                 continue
             if await self._put_entry(client, base, rel, sha):
                 state.present[rel] = sha
+                state.seeded.add(rel)
                 stats.pushed_files += 1
                 stats.pushed_bytes += size
                 # Deliberately NOT a last_hit touch: every fresh sandbox
                 # lacks everything, so a per-push refresh would flatten the
                 # LRU signal across the whole hot set on every spawn.
-                # last_hit moves only on harvest admission — kernels
-                # actually (re)compiled somewhere — so eviction tracks use,
-                # and an evicted-but-hot kernel re-enters after one
-                # recompile.
+                # last_hit moves only on evidence of a real (re)compile —
+                # harvest admission, or a trusted run presenting an entry
+                # this host was never seeded (state.seeded) — and the hot
+                # set self-heals across restarts via the per-start
+                # pre-warm (evicted-but-kept kernels re-admit; dropped
+                # kernels age to the LRU end).
         return stats
 
     async def _put_entry(
@@ -427,9 +510,16 @@ class SandboxCacheSync:
         entry) already holds moves no bytes. A body that does not hash to
         its promised sha (connection drop mid-stream surfaces as an httpx
         error; a racing rewrite as a mismatch) is discarded — no partial or
-        orphan objects, ever."""
+        orphan objects, ever.
+
+        Trust boundary: refuses tainted sandboxes entirely (see ``tainted``)
+        and is first-write-wins per entry name — a manifest presenting
+        different bytes under a name the store already maps is a conflict,
+        never a replacement (a rename-an-attack-under-a-known-identity
+        channel, and in the benign case a nondeterministic recompile the
+        fleet has no reason to prefer)."""
         stats = HarvestStats()
-        if not self.store.enabled:
+        if not self.store.enabled or self._trust_revoked():
             return stats
         state = self.host(base)
         if state.supports is False:
@@ -438,17 +528,41 @@ class SandboxCacheSync:
         if manifest is None:
             return stats
         for rel, sha in manifest.items():
+            if self._trust_revoked():
+                # Revoked while this harvest was awaiting the network (a
+                # tenant run started on a sandbox sharing this cache dir):
+                # everything not yet admitted stays out.
+                logger.info(
+                    "compile-cache harvest of %s stopped mid-flight: "
+                    "trust revoked",
+                    base,
+                )
+                break
             known_sha = self.store.sha_of(rel)
             if known_sha == sha:
+                if rel not in state.seeded:
+                    # Present on the host but NOT because we seeded it (or
+                    # harvested it earlier): a trusted run genuinely
+                    # (re)compiled this entry, so refresh its recency —
+                    # once. Known entries reappear in every later harvest
+                    # manifest of this host (the cache dir outlives
+                    # /reset), so without marking them seeded here each
+                    # re-observation would re-touch with no recompile and
+                    # flatten the LRU signal to nothing.
+                    self.store.touch(rel)
+                    state.seeded.add(rel)
                 stats.known_files += 1
+                continue
+            if known_sha is not None:
+                self._note_conflict(base, rel, stats)
                 continue
             if await self.store.storage.exists(sha):
                 # Dedup: bytes already stored (same executable under a
                 # different entry name, or a previous harvest) — record the
                 # mapping without moving anything.
                 size = await self.store.storage.size(sha)
-                await self.store.record(rel, sha, size)
-                stats.known_files += 1
+                if await self._admit(base, rel, sha, size, stats, state):
+                    stats.known_files += 1
                 continue
             got = await self._get_entry(client, base, rel)
             if got is None:
@@ -460,10 +574,71 @@ class SandboxCacheSync:
                 await self.store.drop_unverified(actual_sha)
                 stats.discarded += 1
                 continue
-            await self.store.record(rel, sha, size)
-            stats.new_files += 1
-            stats.new_bytes += size
+            if await self._admit(base, rel, sha, size, stats, state):
+                stats.new_files += 1
+                stats.new_bytes += size
         return stats
+
+    async def _admit(
+        self,
+        base: str,
+        rel: str,
+        sha: str,
+        size: int,
+        stats: HarvestStats,
+        state: HostCacheState,
+    ) -> bool:
+        """Final admission, re-checking the store IMMEDIATELY before
+        record(): harvest_host awaits the network between its first
+        conflict check and this point, and two sandboxes' turnover
+        harvests can race the same entry name (e.g. a nondeterministic
+        recompile of the same kernel on two untainted sandboxes).
+        First-write-wins must hold across that window too — without the
+        re-check the loser would silently REPLACE the winner's mapping
+        and orphan its storage object forever (no surviving entry
+        references it, so eviction's refcount check never deletes it).
+        No awaits run between the re-check and record()'s entry-map
+        mutation, so the decision cannot go stale. Returns True when
+        `rel` was recorded; on a lost race the bytes are dropped unless
+        another entry owns them, and stats are counted here.
+
+        Whenever the store ends up mapping rel -> sha (recorded here, or
+        a lost race to identical bytes), the host is marked seeded for
+        `rel`: this host's copy and the store's now agree, so its
+        reappearance in later harvest manifests of the same host proves
+        no recompile and must not re-touch recency."""
+        if self._trust_revoked():
+            # Trust revoked between the loop's check and this admission
+            # (the entry download awaited the network): the bytes may
+            # postdate the revoking tenant run, so they must not enter
+            # the store — drop them unless another entry owns them.
+            await self.store.drop_unverified(sha)
+            return False
+        current = self.store.sha_of(rel)
+        if current == sha:
+            state.seeded.add(rel)
+            stats.known_files += 1
+            return False
+        if current is not None:
+            self._note_conflict(base, rel, stats)
+            await self.store.drop_unverified(sha)
+            return False
+        await self.store.record(rel, sha, size)
+        state.seeded.add(rel)
+        return True
+
+    @staticmethod
+    def _note_conflict(base: str, rel: str, stats: HarvestStats) -> None:
+        """The single first-write-wins rejection point: both the loop's
+        pre-download check and _admit's post-download re-check land here,
+        so conflict policy/accounting cannot drift between them."""
+        logger.warning(
+            "compile-cache harvest conflict: %s offered different bytes "
+            "for %s; keeping the store's copy",
+            base,
+            rel,
+        )
+        stats.conflicts += 1
 
     async def _get_entry(
         self, client: httpx.AsyncClient, base: str, rel: str
@@ -507,6 +682,8 @@ class SandboxCacheSync:
         self, client: httpx.AsyncClient, hosts: list[str]
     ) -> HarvestStats:
         total = HarvestStats()
+        if not self.store.enabled or self._trust_revoked():
+            return total
         # Sequential across a slice group's hosts on purpose: peers of one
         # slice compiled the same kernels, so host 0's harvest makes every
         # peer's entries dedup to known_files instead of racing N identical
@@ -521,7 +698,12 @@ class SandboxCacheSync:
             total.new_bytes += result.new_bytes
             total.known_files += result.known_files
             total.discarded += result.discarded
-        if total.new_files:
+            total.conflicts += result.conflicts
+        # Persist on ANY entry-map mutation — dedup admissions (new entry
+        # name onto already-stored bytes) and evictions mutate state without
+        # moving new bytes, and an unsaved index would resurrect deleted
+        # objects / lose mappings across a control-plane restart.
+        if self.store.dirty:
             self.store.save_index()
         return total
 
@@ -532,6 +714,9 @@ class SandboxCacheSync:
 # pre-warm costs seconds, not a full benchmark run. Each snippet compiles
 # with the sandbox's persistent cache armed, so its executable lands in the
 # cache dir and the post-execute harvest admits it to the fleet store.
+# These runs are the fleet store's ONLY admission source: they execute as
+# trusted (control-plane-authored) code on untainted sandboxes, which is
+# what makes their harvest safe to seed into every tenant's sandbox.
 PREWARM_SOURCES: list[tuple[str, str]] = [
     (
         "matmul",

@@ -348,6 +348,13 @@ class ExecutorMetrics:
             "already knew them).",
             ("direction",),
         )
+        self.compile_cache_conflicts = self.registry.counter(
+            "code_interpreter_compile_cache_conflicts_total",
+            "Harvest manifests offering DIFFERENT bytes under an entry "
+            "name the store already maps (first-write-wins rejection): a "
+            "nondeterministic recompile at best, a poisoning attempt at "
+            "worst — investigate if this moves.",
+        )
         self.compile_cache_kernels = self.registry.counter(
             "code_interpreter_compile_cache_kernels_total",
             "Persistent-compilation-cache lookups reported by sandbox "

@@ -321,6 +321,56 @@ std::map<std::string, std::string> manifest_snapshot(
   return out;
 }
 
+// After a forgivable-looking rmdir failure (EBUSY/ENOTEMPTY with the
+// recursive wipe reporting success), verifies by RE-SCANNING that nothing
+// but empty mount points actually remains at/below the entry. The readdir
+// snapshot the wipe worked from is stale by the time rmdir fails: user
+// code that escaped the runner scrub (a reparented daemon) could have
+// raced a file back in, and forgiving on the stale snapshot would let it
+// cross the generation boundary through a "complete" /reset. Forgivable
+// residue is exactly: a mount-point directory (st_dev differs from its
+// parent's) that is EMPTY, or a directory containing only such residue.
+bool only_mount_residue(int dfd, const char* name) {
+  struct stat parent_st;
+  if (fstat(dfd, &parent_st) != 0) return false;
+  int fd = openat(dfd, name, O_DIRECTORY | O_RDONLY | O_NOFOLLOW | O_CLOEXEC);
+  if (fd < 0) return false;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return false;
+  }
+  bool is_mount = st.st_dev != parent_st.st_dev;
+  DIR* d = fdopendir(fd);
+  if (!d) {
+    close(fd);
+    return false;
+  }
+  bool ok = true;
+  bool has_entries = false;
+  while (dirent* e = readdir(d)) {
+    std::string entry = e->d_name;
+    if (entry == "." || entry == "..") continue;
+    has_entries = true;
+    if (is_mount || !only_mount_residue(dirfd(d), entry.c_str())) {
+      ok = false;  // a non-empty mount point, or non-mount residue below
+      break;
+    }
+  }
+  if (!is_mount && !has_entries) {
+    // An EMPTY NON-mount dir is plain removable residue, not a mount the
+    // wipe is powerless against: the recursive wipe deletes empty dirs,
+    // so one still standing here can only have been raced in after the
+    // wipe's readdir snapshot (its NAME is attacker-chosen data). Without
+    // this check the recursion forgave any empty dir — mount or not —
+    // letting such names cross the generation boundary through a
+    // "complete" /reset.
+    ok = false;
+  }
+  closedir(d);
+  return ok;
+}
+
 // Recursively deletes everything INSIDE dfd (the dir itself survives — it is
 // the warm runner's cwd), except the subtree rooted at `preserve` (an
 // absolute path; empty = preserve nothing). fd-relative with O_NOFOLLOW so
@@ -337,7 +387,25 @@ bool wipe_dirfd_children(int dfd, const std::string& dir_path,
     if (name == "." || name == "..") continue;
     std::string child_path = dir_path + "/" + name;
     if (!preserve.empty()) {
-      if (child_path == preserve) continue;  // the preserved subtree itself
+      if (child_path == preserve) {
+        // The preserved subtree itself — but only if it still IS a real
+        // directory. The comparison alone is lexical: user code that
+        // empties the cache dir, rmdirs it, and plants a symlink (or file)
+        // at the same path would get the planted node preserved through
+        // /reset, redirecting the next generation's cache writes wherever
+        // it points. Verify without following, unlink impostors, and
+        // report the wipe incomplete so the sandbox is disposed.
+        struct stat st;
+        if (fstatat(dfd, name.c_str(), &st, AT_SYMLINK_NOFOLLOW) == 0 &&
+            S_ISDIR(st.st_mode)) {
+          continue;
+        }
+        if (unlinkat(dfd, name.c_str(), 0) != 0) {
+          unlinkat(dfd, name.c_str(), AT_REMOVEDIR);
+        }
+        ok = false;
+        continue;
+      }
       if (preserve.rfind(child_path + "/", 0) == 0) {
         // The preserved dir lives somewhere below this child: recurse so
         // its siblings still wipe, but keep the ancestor chain intact.
@@ -362,9 +430,24 @@ bool wipe_dirfd_children(int dfd, const std::string& dir_path,
       ok = false;  // neither unlinkable nor a walkable dir: left behind
       continue;
     }
-    if (!wipe_dirfd_children(child, child_path, std::string())) ok = false;
+    bool child_ok = wipe_dirfd_children(child, child_path, std::string());
+    if (!child_ok) ok = false;
     close(child);
-    if (unlinkat(dfd, name.c_str(), AT_REMOVEDIR) != 0) ok = false;
+    if (unlinkat(dfd, name.c_str(), AT_REMOVEDIR) != 0) {
+      // A fully-wiped dir can still be unremovable for two forgivable
+      // reasons: it IS a mount point (EBUSY — e.g. a volume an operator
+      // mounted under an extra wipe dir), or it CONTAINS one deeper down
+      // (ENOTEMPTY — without this the forgiveness would stop at depth one
+      // and every ancestor of a nested mount would fail the wipe). Either
+      // way nothing may cross the generation boundary: child_ok is a
+      // stale readdir snapshot, so only_mount_residue re-scans and
+      // forgives only when empty mount points are truly all that remain.
+      int err = errno;
+      if (!(child_ok && (err == EBUSY || err == ENOTEMPTY) &&
+            only_mount_residue(dfd, name.c_str()))) {
+        ok = false;
+      }
+    }
   }
   closedir(d);
   return ok;
@@ -1831,10 +1914,14 @@ void handle_reset(const minihttp::Request&, minihttp::Conn& conn) {
   // then the filesystem: workspace AND runtime-packages — a package the
   // previous user planted must never be importable by the next one. The
   // compilation-cache subtree is preserved EVERYWHERE: compiled XLA
-  // kernels are the one cross-generation state turnover must keep (they
-  // carry no user data — entries are keyed by HLO hash), and the historic
-  // layout put the cache dir under /tmp, squarely inside the k8s backend's
-  // APP_RESET_EXTRA_WIPE_DIRS.
+  // kernels are the one cross-generation state turnover deliberately
+  // keeps, and the historic layout put the cache dir under /tmp, squarely
+  // inside the k8s backend's APP_RESET_EXTRA_WIPE_DIRS. Preservation is a
+  // trust decision, not a no-op: entries CAN hold tenant-influenced bytes
+  // (user code can write the dir; XLA constant-folding can bake input
+  // data into artifacts), which is why the control plane only ever
+  // harvests sandboxes that never ran tenant code — the preserved dir
+  // stays pod-local state, never fleet state.
   // Gated on the kill switch: APP_COMPILE_CACHE=0 must restore EXACT
   // pre-cache reset behavior — a preserved-but-unserved cache dir would
   // keep the one cross-generation channel the switch exists to close.

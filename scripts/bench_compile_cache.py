@@ -111,9 +111,14 @@ def wipe(cache_dir: Path) -> None:
         shutil.rmtree(cache_dir)
 
 
-async def timed_run(executor: CodeExecutor) -> dict:
+async def timed_run(executor: CodeExecutor, *, trusted: bool = False) -> dict:
     start = time.perf_counter()
-    result = await executor.execute(MATMUL)
+    # trusted=True runs through the pre-warm mechanism (control-plane-
+    # authored source, sandbox stays harvest-eligible); harvest admits
+    # nothing else, so the prime leg MUST use it — tenant executes taint
+    # their sandbox and never fill the fleet store.
+    run = executor._execute_trusted if trusted else executor.execute
+    result = await run(MATMUL)
     wall = time.perf_counter() - start
     if result.exit_code != 0:
         raise RuntimeError(f"bench execute failed: {result.stderr[:500]}")
@@ -147,14 +152,14 @@ async def run_bench(repeats: int) -> dict:
     finally:
         await executor.close()
 
-    # --- prime + seeded_cold: one sandbox compiles and is harvested at its
-    # teardown; every later sandbox starts with a wiped local cache and is
-    # seeded from the fleet store.
+    # --- prime + seeded_cold: one TRUSTED (pre-warm-style) sandbox run
+    # compiles and is harvested at its teardown; every later TENANT sandbox
+    # starts with a wiped local cache and is seeded from the fleet store.
     executor = make_executor(tmp / "fleet", cache_dir)
     seeded_runs = []
     try:
         wipe(cache_dir)
-        prime = await timed_run(executor)
+        prime = await timed_run(executor, trusted=True)
         await settle(executor)
         store_entries = executor.compile_cache.entry_count()
         store_bytes = executor.compile_cache.total_bytes()

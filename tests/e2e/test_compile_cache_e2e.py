@@ -1,11 +1,16 @@
 """End-to-end fleet compile cache through the real local backend + C++
-executor: a kernel "compiled" by one sandbox is harvested into the fleet
-store at that sandbox's teardown and seeded into a FRESH sandbox before its
-user code runs — with the first sandbox already disposed. Per-sandbox cache
-dirs + reuse off reproduce the Kubernetes pod-local reality where the fleet
-store is the ONLY cross-sandbox channel.
+executor: a kernel compiled by one TRUSTED (pre-warm-style) sandbox run is
+harvested into the fleet store at that sandbox's teardown and seeded into a
+FRESH sandbox before its user code runs — with the first sandbox already
+disposed. Per-sandbox cache dirs + reuse off reproduce the Kubernetes
+pod-local reality where the fleet store is the ONLY cross-sandbox channel.
 
-The fast legs use a synthetic cache entry (user code writing into
+Harvest is provenance-gated: only control-plane-authored runs (driven here
+via executor._execute_trusted, the pre-warm mechanism) are harvestable;
+tenant executes taint their sandbox and nothing it holds ever enters the
+fleet store — covered by its own leg below.
+
+The fast legs use a synthetic cache entry (code writing into
 $JAX_COMPILATION_CACHE_DIR stands in for XLA's cache writer — byte-for-byte
 the same protocol surface). The slow leg compiles a real jitted kernel and
 proves zero recompilation via the runner's jax.monitoring hit counter.
@@ -70,7 +75,9 @@ async def _settle(executor):
 async def test_disposed_sandboxs_kernel_reused_by_fresh_sandbox(tmp_path):
     executor, backend = make_stack(tmp_path)
     try:
-        first = await executor.execute(WRITE_ENTRY)
+        # The compiling run is control-plane-authored (the pre-warm
+        # mechanism) — the only provenance harvest admits.
+        first = await executor._execute_trusted(WRITE_ENTRY)
         assert first.exit_code == 0, first.stderr
         assert first.stdout.strip() == "miss"  # sandbox 1 had to "compile"
         await _settle(executor)
@@ -82,11 +89,34 @@ async def test_disposed_sandboxs_kernel_reused_by_fresh_sandbox(tmp_path):
 
         second = await executor.execute(WRITE_ENTRY)
         assert second.exit_code == 0, second.stderr
-        # THE acceptance criterion: the fresh sandbox found the kernel
-        # already in its cache dir — seeded at spawn from the fleet store,
-        # zero recompilation.
+        # THE acceptance criterion: the fresh TENANT sandbox found the
+        # kernel already in its cache dir — seeded at spawn from the fleet
+        # store, zero recompilation.
         assert second.stdout.strip() == "hit"
         assert second.phases["compile_cache_seeded_bytes"] > 0
+        await _settle(executor)
+    finally:
+        await executor.close()
+
+
+async def test_tenant_compiled_entry_never_reaches_other_sandboxes(tmp_path):
+    """The cache-poisoning regression: a TENANT run that writes into its
+    cache dir is never harvested — the fleet store stays empty and a fresh
+    sandbox sees a cold cache (no cross-tenant executable channel)."""
+    executor, backend = make_stack(tmp_path)
+    try:
+        first = await executor.execute(WRITE_ENTRY)
+        assert first.exit_code == 0, first.stderr
+        assert first.stdout.strip() == "miss"
+        await _settle(executor)
+        assert backend._procs == {}
+        assert executor.compile_cache.manifest() == {}
+
+        second = await executor.execute(WRITE_ENTRY)
+        assert second.exit_code == 0, second.stderr
+        # The next tenant's sandbox was NOT seeded with the first tenant's
+        # planted entry.
+        assert second.stdout.strip() == "miss"
         await _settle(executor)
     finally:
         await executor.close()
@@ -95,7 +125,8 @@ async def test_disposed_sandboxs_kernel_reused_by_fresh_sandbox(tmp_path):
 async def test_kill_switch_restores_pre_cache_behavior(tmp_path):
     executor, backend = make_stack(tmp_path, compile_cache_enabled=False)
     try:
-        first = await executor.execute(WRITE_ENTRY)
+        # Even a trusted run moves nothing with the switch off.
+        first = await executor._execute_trusted(WRITE_ENTRY)
         assert first.exit_code == 0, first.stderr
         assert first.stdout.strip() == "miss"
         await _settle(executor)
@@ -114,7 +145,7 @@ async def test_kill_switch_restores_pre_cache_behavior(tmp_path):
 async def test_harvest_and_seed_counters_move(tmp_path):
     executor, backend = make_stack(tmp_path)
     try:
-        first = await executor.execute(WRITE_ENTRY)
+        first = await executor._execute_trusted(WRITE_ENTRY)
         assert first.exit_code == 0
         # The executor reported the new cache entry on the execute itself.
         assert first.phases.get("compile_cache_new_bytes", 0) > 0
@@ -169,7 +200,9 @@ async def test_real_jit_kernel_zero_recompilation(tmp_path):
         "print('ran')\n"
     )
     try:
-        first = await executor.execute(source, timeout=300.0)
+        # The compile happens on a trusted (pre-warm-style) run — harvest
+        # only admits those.
+        first = await executor._execute_trusted(source, timeout=300.0)
         assert first.exit_code == 0, first.stderr
         assert first.phases.get("compile_cache_new_bytes", 0) > 0
         await _settle(executor)

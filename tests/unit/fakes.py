@@ -13,6 +13,12 @@ class FakeBackend:
     `resettable=False` makes every recycle attempt fail (single-use pods,
     the reference's model)."""
 
+    # Each fake sandbox is its own little world (there is no shared dir to
+    # cross-contaminate), matching the k8s emptyDir / local per-sandbox
+    # reality most orchestrator tests model. Tests exercising the shared or
+    # externally-writable cache-dir postures override per instance.
+    compile_cache_dir_scope = "private"
+
     def __init__(self, capacity=None, resettable=True):
         self.capacity = capacity
         self.resettable = resettable

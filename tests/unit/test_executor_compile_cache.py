@@ -143,6 +143,42 @@ def test_reset_wipes_extra_dir_but_preserves_cache_subtree(stack):
     assert resp.status_code == 304
 
 
+def test_reset_refuses_symlink_planted_at_preserved_cache_path(stack):
+    """The preserve check must not be purely lexical: user code that empties
+    the cache dir, rmdirs it, and plants a symlink at the same path must NOT
+    get the symlink preserved through /reset (it would redirect the next
+    generation's cache writes wherever it points). The impostor is unlinked
+    and the wipe reports incomplete, so the sandbox is disposed."""
+    client, cache, wiped = stack
+    client.put("/compile-cache/doomed-cache", content=b"bytes")
+    # The tamper: replace the (real) cache dir with a symlink to a target
+    # outside every wiped tree.
+    target = wiped.parent / "exfil-target"
+    target.mkdir()
+    for child in cache.iterdir():
+        child.unlink()
+    cache.rmdir()
+    cache.symlink_to(target)
+    resp = client.post("/reset")
+    assert resp.status_code == 409, resp.text
+    # The planted symlink did not survive, and its target was not entered.
+    assert not cache.is_symlink()
+    assert not cache.exists()
+    assert target.is_dir()
+
+
+def test_reset_preserves_only_real_dir_not_regular_file(stack):
+    """Same tamper with a regular file at the preserved path."""
+    client, cache, wiped = stack
+    for child in cache.iterdir():
+        child.unlink()
+    cache.rmdir()
+    cache.write_bytes(b"not a directory")
+    resp = client.post("/reset")
+    assert resp.status_code == 409, resp.text
+    assert not cache.exists()
+
+
 def test_execute_reports_compile_cache_block(stack):
     client, cache, _ = stack
     resp = client.post(

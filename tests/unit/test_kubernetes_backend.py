@@ -178,6 +178,9 @@ def test_compile_cache_volume_mounted(fake_kubectl):
     env = {e["name"]: e["value"] for e in container["env"]}
     assert env["JAX_COMPILATION_CACHE_DIR"] == cache_dir
     assert env["APP_COMPILE_CACHE"] == "1"
+    # emptyDir is pod-private: per-sandbox taint vouches for it, so the
+    # executor's harvest gate sees a private dir.
+    assert backend.compile_cache_dir_scope == "private"
 
 
 def test_compile_cache_volume_source_knob(fake_kubectl):
@@ -192,19 +195,31 @@ def test_compile_cache_volume_source_knob(fake_kubectl):
     assert manifest["spec"]["volumes"][0]["persistentVolumeClaim"] == {
         "claimName": "fleet-jax-cache"
     }
+    # A shared PVC is writable by other pods' tenants — parties this
+    # control plane never sees — so the harvest gate must see "external"
+    # (structurally never harvested).
+    assert backend.compile_cache_dir_scope == "external"
 
 
 def test_compile_cache_kill_switch_reaches_pod_env(fake_kubectl):
     kubectl, _, _ = fake_kubectl
     backend = _backend(kubectl, compile_cache_enabled=False)
     manifest = backend.pod_manifest("p", 0, None)
-    env = {
-        e["name"]: e["value"]
-        for e in manifest["spec"]["containers"][0]["env"]
-    }
+    container = manifest["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in container["env"]}
     # The per-pod cache dir still works host-locally; only the fleet
     # endpoints are off.
     assert env["APP_COMPILE_CACHE"] == "0"
+    # No volume at the cache dir when the cache is disabled: the executor's
+    # reset preserve is off, so a mounted-but-unpreserved cache dir under
+    # /var/tmp would survive each wipe as an empty mount point (the wipe
+    # forgives the mount's EBUSY) — skipping the mount restores the exact
+    # pre-cache pod spec and turnover instead.
+    assert "volumes" not in manifest["spec"]
+    assert "volumeMounts" not in container
+    # /var/tmp stays on the wipe list: with no mount the cache dir is
+    # ordinary residue, removed at turnover — exact pre-cache behavior.
+    assert "/var/tmp" in env["APP_RESET_EXTRA_WIPE_DIRS"]
 
 
 def test_no_cache_dir_means_no_volume(fake_kubectl):
