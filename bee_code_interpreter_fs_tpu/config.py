@@ -186,6 +186,23 @@ class Config(BaseModel):
     # uses the real tenant — only dashboards coarsen). Guards label
     # cardinality against clients minting unbounded tenant names.
     scheduler_max_metric_tenants: int = 256
+    # -- batched execution lanes (services/batcher.py) -----------------------
+    # Coalesce compatible small jobs from ONE tenant (same lane, priority,
+    # env, and limits) into a single multi-chip sandbox dispatch instead of
+    # N serial round-trips — the Podracer/Anakin pattern: throughput on a
+    # multi-chip lane comes from keeping every chip busy on batched small
+    # work. Kill switch: 0 restores the serial path byte-for-byte on the
+    # wire (every request runs exactly as before this subsystem existed).
+    batching_enabled: bool = True
+    # How long the FIRST job of a prospective batch waits for compatible
+    # partners before dispatching (the batching window). Small on purpose:
+    # the window is pure added latency for the first job, and under real
+    # load partners arrive far faster than this.
+    batch_window_ms: float = 10.0
+    # Max jobs fused into one dispatch (a full batch fires immediately,
+    # without waiting out the window). Sized to the lane's chip count in a
+    # typical deployment — one job per chip is the sweet spot.
+    batch_max_jobs: int = 8
     # Deterministic fault-injection plan for chaos runs, e.g.
     # "spawn_fail:0.3,seed:7" (grammar in services/backends/faults.py).
     # Empty = no injection. NEVER set in production.
@@ -209,6 +226,19 @@ class Config(BaseModel):
     # Append-only JSONL span export (one span per line); empty = no file
     # exporter. Write failure disables the exporter, never the request.
     tracing_jsonl_path: str = ""
+    # Tail-based sampling: traces the head-sampling coin flip REJECTED are
+    # still recorded tentatively and kept anyway when they turn out to
+    # matter — an error status, a limit.violation event, or a root span
+    # slower than tracing_tail_slow_seconds. At low head ratios this is the
+    # flight recorder that makes a batched dispatch's one bad request
+    # reconstructible after the fact. Only applies to traces STARTED here:
+    # an incoming traceparent's flag-00 (unsampled) decision is always
+    # respected, per W3C.
+    tracing_tail_enabled: bool = True
+    # Root-span duration at which an otherwise-unsampled trace is kept
+    # (the "slow-p99" keep; a fixed threshold so the decision is
+    # deterministic and testable).
+    tracing_tail_slow_seconds: float = 5.0
     # -- sandbox resource governance (services/limits.py) --------------------
     # Kill switch for the whole governance subsystem: 0 restores the
     # pre-governance behavior (no limits payload on requests, no APP_LIMIT_*

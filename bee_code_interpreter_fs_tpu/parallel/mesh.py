@@ -48,6 +48,41 @@ def shard_map(f, *, mesh=None, in_specs=None, out_specs=None, check_rep=None):
     return _legacy(f, **kwargs)
 
 
+def job_mesh(n_jobs: int | None = None, *, devices=None) -> Mesh:
+    """A 1-axis ``("jobs",)`` mesh for fused small-job dispatch: each job of
+    a coalesced batch owns one device along the axis. Unlike the model
+    meshes above there is no cross-job communication — the axis exists only
+    to place independent blocks, so no ICI-nearness ordering applies and a
+    plain device-list mesh is correct on any topology.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices) if n_jobs is None else n_jobs
+    if n < 1 or n > len(devices):
+        raise ValueError(f"job mesh needs 1..{len(devices)} devices, got {n}")
+    return Mesh(np.array(devices[:n]), ("jobs",))
+
+
+def job_device_assignment(n_jobs: int, n_devices: int | None) -> list[int | None]:
+    """Device-axis placement for a batched small-job dispatch: job i of a
+    coalesced batch runs on device ``assignment[i]`` of the lane's local
+    device list (the "jobs" axis of the batch — one independent program per
+    chip, the Anakin/Sebulba placement rather than one sharded program).
+
+    Jobs are dealt round-robin so a partial batch still spreads across the
+    whole slice (4 jobs on 8 chips use 4 DISTINCT chips, not chips 0-3 of a
+    contiguous block twice over on wrap-around). ``n_devices`` None/0 means
+    the caller doesn't know the lane's device count (chip_count=0 lanes);
+    the sandbox runner then applies the same round-robin against whatever
+    it enumerates locally.
+    """
+    if n_jobs < 1:
+        raise ValueError("n_jobs must be >= 1")
+    if not n_devices or n_devices < 1:
+        return [None] * n_jobs
+    return [i % n_devices for i in range(n_jobs)]
+
+
 @dataclass(frozen=True)
 class MeshSpec:
     """A logical mesh shape over named axes (order matters: ICI-nearest last)."""

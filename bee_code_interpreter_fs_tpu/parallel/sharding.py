@@ -17,6 +17,17 @@ def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
 
+def job_sharding(mesh: Mesh, axis: str = "jobs") -> NamedSharding:
+    """Layout for a stacked batch of independent small jobs: a
+    ``[n_jobs, ...]`` operand array split along the mesh's job axis, one
+    job's block per device. This is the fused-dispatch half of the batched
+    execution lanes — ``shard_map`` over a 1-axis job mesh runs every
+    job's block on its own chip in ONE XLA program (see the
+    ``batched_dispatch`` pre-warm kernel and ``scripts/bench_batch.py``).
+    """
+    return NamedSharding(mesh, P(axis))
+
+
 def shard_pytree(mesh: Mesh, tree, specs):
     """Device-put a pytree with a matching pytree of PartitionSpecs.
 

@@ -1,0 +1,195 @@
+"""Batched multi-chip execution lanes: the coalescing window.
+
+Before this subsystem, 8 queued one-chip-sized jobs on an 8-chip lane ran
+as 8 serial sandbox round-trips — 7/8 of the slice idle at every instant.
+"Podracer architectures for scalable RL" (PAPERS.md) shows where multi-chip
+throughput actually comes from: the Anakin/Sebulba pattern keeps every chip
+in a slice busy on *batched small work* dispatched as one program; and the
+Kubernetes GenAI-inference evaluation finds request-coalescing (not pod
+count) is what moves aggregate throughput for bursty inference-shaped
+traffic. This module is the layer between the admission-control scheduler
+and the executor that does the coalescing.
+
+Design:
+
+- **Compatibility keying** — jobs may share a dispatch only when they share
+  a :class:`BatchKey`: lane (chip count), tenant, priority class, the exact
+  env map, and the exact effective resource budget. Tenant is in the key by
+  construction, so batching NEVER crosses tenants — two tenants' code never
+  shares a sandbox generation through this path (the trust property the
+  whole sandbox model rests on).
+- **Bounded window** — the first job of a key arms a timer
+  (``APP_BATCH_WINDOW_MS``); partners joining before it fires ride along;
+  a full batch (``APP_BATCH_MAX_JOBS``) dispatches immediately. The window
+  is the ONLY latency batching ever adds, and only to the first job.
+- **Demux contract** — the dispatch callback resolves each job's future
+  individually (per-job Result, violation, or error). Any batch-level
+  fault falls back to the serial path per job, so no request ever fails
+  *because* it was batched (`code_executor._dispatch_batch` owns that
+  fallback; this module owns the grouping and the promise lifecycle).
+
+The timer is injectable (``timer``) so the window-expiry tests run on a
+fake clock with zero sleeps, like the scheduler's.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections.abc import Awaitable, Callable
+from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
+
+
+def freeze_mapping(mapping: dict | None) -> tuple:
+    """A dict as a hashable, order-insensitive key component."""
+    if not mapping:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in mapping.items()))
+
+
+@dataclass(frozen=True)
+class BatchKey:
+    """What must match for two jobs to share one dispatch. Everything here
+    is either placement (lane), isolation (tenant), scheduling class
+    (priority), or process-global state inside the fused run (env, limits,
+    timeout — one address space arms ONE rlimit set and ONE environ, and
+    the fused run has ONE deadline, so only jobs with the SAME timeout may
+    share it: a 5s job must never ride a 300s batch window)."""
+
+    lane: int
+    tenant: str
+    priority: str
+    env: tuple = ()
+    limits: tuple = ()
+    timeout: float = 0.0
+
+
+@dataclass
+class BatchJob:
+    """One coalesced request: its source, its own timeout, and the promise
+    the submitting request awaits. Trace identity rides along so the
+    dispatcher can graft per-job sandbox timings back into the ORIGINATING
+    request's trace (the demux half of observability)."""
+
+    source_code: str
+    timeout: float
+    future: asyncio.Future = field(
+        default_factory=lambda: asyncio.get_running_loop().create_future()
+    )
+    trace_id: str | None = None
+    parent_span_id: str | None = None
+
+    def resolve(self, result) -> None:
+        if not self.future.done():
+            self.future.set_result(result)
+
+    def fail(self, error: BaseException) -> None:
+        if not self.future.done():
+            self.future.set_exception(error)
+
+
+class Batcher:
+    """The coalescing window between admission and dispatch.
+
+    ``dispatch`` is an async callable ``(key, jobs) -> None`` that MUST
+    settle every job's future (the executor's `_dispatch_batch`). It runs
+    in a tracked background task — the submitting requests are all parked
+    on their futures, so nobody's context is "the" dispatch context.
+    """
+
+    def __init__(
+        self,
+        *,
+        window_s: float,
+        max_jobs: int,
+        dispatch: Callable[[BatchKey, list[BatchJob]], Awaitable[None]],
+        timer: Callable[[float, Callable[[], None]], object] | None = None,
+    ) -> None:
+        self.window_s = max(0.0, window_s)
+        self.max_jobs = max(1, max_jobs)
+        self._dispatch = dispatch
+        self._timer = timer or self._default_timer
+        self._pending: dict[BatchKey, list[BatchJob]] = {}
+        self._timers: dict[BatchKey, object] = {}
+        self._tasks: set[asyncio.Task] = set()
+        self._closed = False
+        # Dispatch stats (read by tests and the healthz detail).
+        self.dispatched_batches = 0
+        self.dispatched_jobs = 0
+
+    @staticmethod
+    def _default_timer(delay: float, callback: Callable[[], None]):
+        """Real deployments use the loop's timer; tests inject a manual one
+        (capture the callback, fire it from a fake clock)."""
+        return asyncio.get_running_loop().call_later(delay, callback)
+
+    def pending_jobs(self, key: BatchKey) -> int:
+        return len(self._pending.get(key, ()))
+
+    async def submit(self, key: BatchKey, job: BatchJob) -> None:
+        """Enqueue one job under its compatibility key. The caller awaits
+        ``job.future``; this returns as soon as the job is parked (or
+        dispatched, for the job that fills a batch)."""
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        bucket = self._pending.setdefault(key, [])
+        bucket.append(job)
+        if len(bucket) >= self.max_jobs:
+            self.flush(key)
+        elif len(bucket) == 1:
+            self._timers[key] = self._timer(
+                self.window_s, lambda: self.flush(key)
+            )
+
+    def flush(self, key: BatchKey) -> None:
+        """Close the key's window and hand its jobs to dispatch (no-op if
+        the bucket already flushed — timer/full-batch races are benign)."""
+        jobs = self._pending.pop(key, None)
+        timer = self._timers.pop(key, None)
+        if timer is not None:
+            cancel = getattr(timer, "cancel", None)
+            if cancel is not None:
+                cancel()
+        if not jobs:
+            return
+        self.dispatched_batches += 1
+        self.dispatched_jobs += len(jobs)
+        task = asyncio.get_running_loop().create_task(
+            self._run_dispatch(key, jobs)
+        )
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _run_dispatch(self, key: BatchKey, jobs: list[BatchJob]) -> None:
+        try:
+            await self._dispatch(key, jobs)
+        except BaseException as e:  # noqa: BLE001 — promises must settle
+            # The dispatcher's own contract is to settle every future
+            # (including via serial fallback); anything escaping it is a
+            # bug — fail the stragglers loudly rather than hanging their
+            # requests forever.
+            logger.exception("batch dispatch failed (lane=%d)", key.lane)
+            for job in jobs:
+                job.fail(e if isinstance(e, Exception) else RuntimeError(str(e)))
+            if not isinstance(e, Exception):
+                raise
+
+    async def close(self) -> None:
+        """Flush nothing, fail everything: shutdown semantics. In-flight
+        dispatch tasks run to completion (they own sandbox cleanup)."""
+        self._closed = True
+        for key in list(self._pending):
+            jobs = self._pending.pop(key, [])
+            timer = self._timers.pop(key, None)
+            if timer is not None:
+                cancel = getattr(timer, "cancel", None)
+                if cancel is not None:
+                    cancel()
+            for job in jobs:
+                job.fail(
+                    RuntimeError("service shutting down before dispatch")
+                )
+        if self._tasks:
+            await asyncio.gather(*list(self._tasks), return_exceptions=True)

@@ -46,6 +46,7 @@ from ..utils.validation import (
     normalize_workspace_path,
 )
 from .backends.base import Sandbox, SandboxBackend, SandboxSpawnError, num_hosts_for
+from .batcher import Batcher, BatchJob, BatchKey, freeze_mapping
 from .circuit_breaker import BreakerBoard
 from .compile_cache import (
     PREWARM_SOURCES,
@@ -231,6 +232,18 @@ class CodeExecutor:
             self.config
         )
         self._prewarm_started = False
+        # Batched multi-chip execution lanes: eligible small jobs from one
+        # tenant coalesce in a bounded window (services/batcher.py) and run
+        # as ONE fused dispatch on a single multi-chip sandbox instead of N
+        # serial round-trips. The kill switch (APP_BATCHING_ENABLED=0)
+        # leaves this None and every request takes the exact serial path.
+        self.batcher: Batcher | None = None
+        if self.config.batching_enabled:
+            self.batcher = Batcher(
+                window_s=self.config.batch_window_ms / 1000.0,
+                max_jobs=self.config.batch_max_jobs,
+                dispatch=self._dispatch_batch,
+            )
         # Control-plane-wide taint for backends whose sandboxes SHARE one
         # cache dir (compile_cache_dir_scope == "shared": the local
         # backend's default mode). There, per-sandbox taint can't vouch
@@ -542,20 +555,28 @@ class CodeExecutor:
         tenant: str | None = None,
         priority: str | None = None,
         deadline: float | None = None,
+        jobs: int = 1,
     ) -> Sandbox:
         """Acquire a sandbox slot — `_acquire_slot` inside a trace span
         carrying the admission attributes; the scheduler's enqueue/grant/
-        shed events and the breaker's rejections attach to this span."""
+        shed events and the breaker's rejections attach to this span.
+        `jobs` > 1 is a batched dispatch's multi-job token: one queue
+        position and one sandbox serving N coalesced requests."""
         with self.tracer.span(
             "scheduler.queue_wait",
             attributes={
                 "lane": chip_count,
                 "tenant": tenant or self.scheduler.default_tenant,
                 "priority": priority or "interactive",
+                "jobs": jobs,
             },
         ):
             return await self._acquire_slot(
-                chip_count, tenant=tenant, priority=priority, deadline=deadline
+                chip_count,
+                tenant=tenant,
+                priority=priority,
+                deadline=deadline,
+                jobs=jobs,
             )
 
     async def _acquire_slot(
@@ -565,6 +586,7 @@ class CodeExecutor:
         tenant: str | None = None,
         priority: str | None = None,
         deadline: float | None = None,
+        jobs: int = 1,
     ) -> Sandbox:
         """Acquire a sandbox slot through the scheduler.
 
@@ -599,6 +621,7 @@ class CodeExecutor:
             priority=priority,
             deadline=deadline,
             pool_ready=len(pool),
+            jobs=jobs,
         )
         sandbox: Sandbox | None = None
         try:
@@ -802,6 +825,16 @@ class CodeExecutor:
                     deadline=deadline,
                     limits=limits,
                 )
+            elif self._batch_eligible(source_code, files, env, deadline):
+                result = await self._execute_batched(
+                    source_code,
+                    timeout=timeout,
+                    env=env,
+                    chip_count=chip_count,
+                    tenant=tenant,
+                    priority=priority,
+                    limits=limits,
+                )
             else:
                 result = await self._execute_with_retry(
                     source_code,
@@ -903,6 +936,480 @@ class CodeExecutor:
             ),
             self._execute_retry_policy,
             on_retry=on_retry,
+        )
+
+    # ------------------------------------------------- batched execution lanes
+
+    def _batch_eligible(
+        self,
+        source_code: str | None,
+        files: dict[str, str] | None,
+        env: dict[str, str] | None,
+        deadline: float | None,
+    ) -> bool:
+        """May this request ride a coalesced dispatch? Eligible = stateless
+        inline source with no input files, no start deadline, and no
+        profiler (the JAX profiler is process-global in the warm runner —
+        two jobs cannot trace concurrently). Ineligible requests take the
+        EXACT serial path; with the kill switch off, everything does."""
+        if self.batcher is None:
+            return False
+        if source_code is None or files:
+            return False
+        if deadline is not None:
+            # Deadline admission is a per-request promise about START time;
+            # a window-parked job's start is the batch's, not its own. Keep
+            # the serial path's exact semantics for deadline traffic.
+            return False
+        if env and "APP_JAX_PROFILE" in env:
+            return False
+        return True
+
+    async def _execute_batched(
+        self,
+        source_code: str,
+        *,
+        timeout: float | None = None,
+        env: dict[str, str] | None = None,
+        chip_count: int | None = None,
+        tenant: str | None = None,
+        priority: str | None = None,
+        limits: dict | None = None,
+    ) -> Result:
+        """Park one eligible request in the batching window and await its
+        demuxed result. Compatibility keying happens HERE (tenant is part
+        of the key by construction — batching never crosses tenants); the
+        fused dispatch and per-job fan-out live in `_dispatch_batch`."""
+        lane, _files, timeout, limits_payload = self._validate_request(
+            source_code, None, None, timeout, chip_count, limits
+        )
+        if lane < 2 or num_hosts_for(lane, self.config.tpu_chips_per_host) > 1:
+            # Coalescing pays on MULTI-chip lanes (idle chips are the waste
+            # it recovers); single-chip/CPU lanes keep the serial path
+            # byte-for-byte. Multi-HOST slices also stay serial: their
+            # hosts rendezvous via jax.distributed, while the fused driver
+            # runs on one host's runner (and their jobs are not "small
+            # array jobs" anyway).
+            return await self._execute_with_retry(
+                source_code,
+                timeout=timeout,
+                env=env,
+                chip_count=lane,
+                tenant=tenant,
+                priority=priority,
+                limits=limits,
+            )
+        # Normalization (and its ValueError on bad client input) happens
+        # BEFORE keying, exactly where the serial path validates.
+        key = BatchKey(
+            lane=lane,
+            tenant=self.scheduler.normalize_tenant(tenant),
+            priority=self.scheduler.normalize_priority(priority),
+            env=freeze_mapping(env),
+            limits=tuple(
+                sorted(
+                    (str(k), float(v))
+                    for k, v in (limits_payload or {}).items()
+                )
+            ),
+            timeout=float(timeout),
+        )
+        span = tracing.current_span()
+        job = BatchJob(
+            source_code=source_code,
+            timeout=timeout,
+            trace_id=tracing.current_trace_id(),
+            parent_span_id=(
+                span.span_id if span is not None and span.recording else None
+            ),
+        )
+        tracing.add_event(
+            "batch.enqueue", lane=lane, pending=self.batcher.pending_jobs(key)
+        )
+        await self.batcher.submit(key, job)
+        return await job.future
+
+    async def _dispatch_batch(self, key: BatchKey, jobs: list[BatchJob]) -> None:
+        """One closed batching window: acquire ONE sandbox with a multi-job
+        token, run the fused dispatch, and settle every job's future. Any
+        batch-level fault (acquisition failure, wire error, old binary,
+        runner death, unattributable violation) falls back to the serial
+        path per job — no request ever fails *because* it was batched."""
+        # Dispatch runs in a batcher task that inherited SOME submitter's
+        # trace context; detach so late spans never contaminate that
+        # request's exported trace (the _off_request_path discipline).
+        tracing.current_span_var.set(None)
+        n = len(jobs)
+        self.scheduler.observe_batch(key.lane, n, self.config.batch_max_jobs)
+        if n < 2:
+            # A window that expired under-filled: nothing to fuse, no
+            # reason to leave the serial path's exact behavior.
+            await self._serial_fallback(key, jobs, reason="underfilled")
+            return
+        try:
+            sandbox = await self._acquire(
+                key.lane, tenant=key.tenant, priority=key.priority, jobs=n
+            )
+        except Exception:
+            # Breaker open / capacity timeout / shed: the serial path hits
+            # the same admission wall per job and surfaces the standard
+            # typed errors (with their standard metrics) to each caller.
+            # CancelledError (and other BaseExceptions) propagate instead:
+            # a shutdown-cancelled dispatch must fail its jobs' futures
+            # (Batcher._run_dispatch does), not restart N serial runs at
+            # exactly the moment the service is trying to stop.
+            await self._serial_fallback(key, jobs, reason="acquire_failed")
+            return
+        reusable = False
+        settled = False
+        try:
+            outcomes = await self._run_batch_on_sandbox(sandbox, key, jobs)
+            reusable = True
+            self.metrics.batch_dispatches.inc(outcome="ok")
+            self.metrics.batch_jobs.inc(n, outcome="batched")
+            for job, outcome in zip(jobs, outcomes):
+                if isinstance(outcome, BaseException):
+                    job.fail(outcome)
+                else:
+                    job.resolve(outcome)
+            settled = True
+        except LimitExceededError as e:
+            # Batch-LEVEL violation (watchdog group kill / post-exec quota):
+            # one address space means the breach cannot be pinned on one
+            # job here. Dispose-vs-recycle follows the violation's own
+            # continuable flag; the serial rerun below gives each job its
+            # individual verdict (the real violator gets its 422, its
+            # batchmates their clean results).
+            reusable = e.continuable
+            logger.warning(
+                "batched dispatch hit a batch-level %s violation; "
+                "re-running %d jobs serially",
+                e.kind,
+                n,
+            )
+            self.metrics.batch_dispatches.inc(outcome="violation_fallback")
+        except Exception:
+            logger.warning(
+                "batched dispatch failed; re-running %d jobs serially",
+                n,
+                exc_info=True,
+            )
+            self.metrics.batch_dispatches.inc(outcome="error_fallback")
+        finally:
+            task = asyncio.get_running_loop().create_task(
+                self._off_request_path(
+                    self._release(sandbox, key.lane, reusable)
+                )
+            )
+            self._dispose_tasks.add(task)
+            task.add_done_callback(self._dispose_tasks.discard)
+        if not settled:
+            await self._serial_fallback(key, jobs, reason="batch_fault")
+
+    async def _serial_fallback(
+        self, key: BatchKey, jobs: list[BatchJob], reason: str
+    ) -> None:
+        """Run each job through the ordinary serial path and settle its
+        future with whatever that path produces — success, typed violation,
+        or the standard retryable errors. This is the transparency
+        guarantee: a batch partner's fault costs its batchmates only time."""
+        if reason != "underfilled":
+            logger.info(
+                "batch fallback (lane=%d, jobs=%d, reason=%s)",
+                key.lane,
+                len(jobs),
+                reason,
+            )
+        self.metrics.batch_jobs.inc(len(jobs), outcome=f"serial_{reason}")
+        env = dict(key.env) or None
+        limits = {k: v for k, v in key.limits} or None
+
+        async def one(job: BatchJob) -> None:
+            try:
+                result = await self._execute_with_retry(
+                    job.source_code,
+                    timeout=job.timeout,
+                    env=env,
+                    chip_count=key.lane,
+                    tenant=key.tenant,
+                    priority=key.priority,
+                    limits=limits,
+                )
+            except BaseException as e:
+                job.fail(e)
+            else:
+                job.resolve(result)
+
+        await asyncio.gather(*(one(job) for job in jobs))
+
+    async def _run_batch_on_sandbox(
+        self, sandbox: Sandbox, key: BatchKey, jobs: list[BatchJob]
+    ) -> list:
+        """The fused round-trip: POST /execute-batch to the sandbox (which
+        stages one workdir per job and runs them as one dispatch spread
+        over the lane's device axis), then demux per-job stdout/stderr,
+        changed files, violations, and trace spans back to each caller.
+
+        Returns one outcome per job: a Result, or a LimitExceededError for
+        a job whose IN-PROCESS guard fired (its batchmates' results stay
+        clean). Batch-level faults raise instead — the caller falls back."""
+        client = self._http_client()
+        if self.compile_cache.enabled:
+            # Tenant code is about to run: same provenance taint as the
+            # serial path (see _run_on_sandbox).
+            self._cache_sync(sandbox).taint()
+            if self._compile_cache_dir_scope() == "shared":
+                self._shared_cache_tainted = True
+        base = sandbox.host_urls[0]
+        n = len(jobs)
+        overall_timeout = max(job.timeout for job in jobs)
+        try:
+            from ..parallel.mesh import job_device_assignment
+
+            assignment = job_device_assignment(n, sandbox.chip_count or None)
+        except Exception:  # noqa: BLE001 — placement is a hint, not a gate
+            assignment = [None] * n
+        payload: dict = {
+            "timeout": overall_timeout,
+            "jobs": [
+                {
+                    "source_code": job.source_code,
+                    **({"trace_id": job.trace_id} if job.trace_id else {}),
+                    **(
+                        {"device_index": device}
+                        if device is not None
+                        else {}
+                    ),
+                }
+                for job, device in zip(jobs, assignment)
+            ],
+        }
+        if key.env:
+            payload["env"] = dict(key.env)
+        if key.limits:
+            payload["limits"] = {k: v for k, v in key.limits}
+        exec_start_wall = time.time()
+        exec_start = time.perf_counter()
+        body = await self._post_execute_batch(
+            client, base, payload, overall_timeout, sandbox
+        )
+        exec_seconds = time.perf_counter() - exec_start
+        runner_restarted = bool(body.get("runner_restarted"))
+        batch_violation = body.get("violation")
+        if batch_violation:
+            if batch_violation not in VIOLATION_KINDS:
+                batch_violation = "unknown"
+            raise LimitExceededError(
+                f"sandbox resource limit exceeded during a batched dispatch: "
+                f"{batch_violation} (sandbox {sandbox.id})",
+                kind=batch_violation,
+                lane=sandbox.chip_count,
+                continuable=not runner_restarted,
+            )
+        if runner_restarted:
+            raise ExecutorError(
+                f"sandbox {sandbox.id} warm runner died mid-batch"
+            )
+        if body.get("timed_out"):
+            # The OVERALL batch window timed out — per-job timeouts are only
+            # enforceable on the serial path (threads cannot be killed
+            # individually), so rerun there for each job's own verdict.
+            raise ExecutorError(
+                f"sandbox {sandbox.id} batch dispatch timed out"
+            )
+        results = body.get("results")
+        if not isinstance(results, list) or len(results) != n:
+            raise ExecutorError(
+                f"sandbox {sandbox.id} returned {0 if not isinstance(results, list) else len(results)} "
+                f"batch results for {n} jobs"
+            )
+        if any(not isinstance(entry, dict) for entry in results):
+            # Malformed per-job entries are a BATCH-level fault like a short
+            # results array: raising here routes through the serial
+            # fallback (with its retries), instead of failing one caller
+            # with a hard infra error the serial path would have retried.
+            raise ExecutorError(
+                f"sandbox {sandbox.id} returned a malformed batch entry"
+            )
+        if any(entry.get("aborted") for entry in results):
+            # A job thread never finished (batch-level abort mid-run): its
+            # "result" is unusable and its batchmates' are suspect — the
+            # serial rerun owns the per-job verdicts.
+            raise ExecutorError(
+                f"sandbox {sandbox.id} aborted a batch mid-run"
+            )
+        if body.get("batch_stdout"):
+            # fd-level stdout (a subprocess, a C extension writing fd 1)
+            # bypasses the per-thread stream demux and lands in the
+            # batch-level capture — it cannot be attributed to a job, and
+            # silently dropping it would lose output the serial path
+            # returns. Rerun serially: every caller gets its exact output,
+            # batching costs this window only time.
+            raise ExecutorError(
+                f"sandbox {sandbox.id} produced un-demuxable batch-level "
+                f"stdout ({len(body['batch_stdout'])} bytes)"
+            )
+        stats = TransferStats()
+        outcomes = await asyncio.gather(
+            *(
+                self._demux_batch_job(
+                    client,
+                    base,
+                    sandbox,
+                    key,
+                    job,
+                    entry,
+                    index=i,
+                    batch_jobs=n,
+                    exec_start_wall=exec_start_wall,
+                    exec_seconds=exec_seconds,
+                    warm=bool(body.get("warm", False)),
+                    stats=stats,
+                )
+                for i, (job, entry) in enumerate(zip(jobs, results))
+            )
+        )
+        stats.emit(self.metrics)
+        # A clean fused run ends the lane's consecutive-violation streak,
+        # exactly like a clean serial run.
+        self._violation_strikes.pop(sandbox.chip_count, None)
+        return outcomes
+
+    async def _post_execute_batch(
+        self,
+        client: httpx.AsyncClient,
+        base: str,
+        payload: dict,
+        timeout: float,
+        sandbox: Sandbox,
+    ) -> dict:
+        """The /execute-batch wire hop (split out so tests can fake the
+        sandbox exactly like `_post_execute`)."""
+        try:
+            resp = await client.post(
+                f"{base}/execute-batch",
+                json=payload,
+                timeout=httpx.Timeout(timeout + 30.0),
+            )
+        except httpx.HTTPError as e:
+            raise ExecutorError(
+                f"sandbox {sandbox.id} ({base}) unreachable: {e}"
+            )
+        if resp.status_code != 200:
+            # 404 = old binary without the route, 409 = no warm runner:
+            # either way the serial path is the answer.
+            raise ExecutorError(
+                f"sandbox {sandbox.id} ({base}) /execute-batch -> "
+                f"{resp.status_code}: {resp.text[:300]}"
+            )
+        try:
+            return resp.json()
+        except ValueError as e:
+            raise ExecutorError(
+                f"sandbox {sandbox.id} ({base}) returned malformed JSON: {e}"
+            )
+
+    async def _demux_batch_job(
+        self,
+        client: httpx.AsyncClient,
+        base: str,
+        sandbox: Sandbox,
+        key: BatchKey,
+        job: BatchJob,
+        entry,
+        *,
+        index: int,
+        batch_jobs: int,
+        exec_start_wall: float,
+        exec_seconds: float,
+        warm: bool,
+        stats: TransferStats,
+    ):
+        """One job's slice of the batch response → its Result (changed
+        files downloaded from its private workdir, hash-negotiated like any
+        download) or its typed in-process violation. Also grafts the job's
+        sandbox timing into the ORIGINATING request's trace. Entries are
+        dict-validated by the caller (a malformed one is a batch-level
+        fault, not one job's)."""
+        duration = entry.get("duration_s")
+        if job.trace_id is not None and job.parent_span_id is not None:
+            offset = entry.get("start_offset_s")
+            self.tracer.record_span(
+                "sandbox.batch_job",
+                trace_id=job.trace_id,
+                parent_id=job.parent_span_id,
+                start_unix=exec_start_wall
+                + (float(offset) if isinstance(offset, (int, float)) else 0.0),
+                duration_s=(
+                    float(duration)
+                    if isinstance(duration, (int, float))
+                    else exec_seconds
+                ),
+                attributes={
+                    "host": base,
+                    "batch_index": index,
+                    "batch_jobs": batch_jobs,
+                },
+            )
+        violation = entry.get("violation")
+        if violation and isinstance(violation, str):
+            if violation not in VIOLATION_KINDS:
+                logger.warning(
+                    "sandbox %s reported unknown batch violation kind %.40r",
+                    sandbox.id,
+                    violation,
+                )
+                violation = "unknown"
+            stderr_tail = str(entry.get("stderr", ""))[-500:]
+            return LimitExceededError(
+                f"sandbox resource limit exceeded: {violation} "
+                f"(sandbox {sandbox.id}, batched); {stderr_tail}".rstrip("; "),
+                kind=violation,
+                lane=sandbox.chip_count,
+                # The in-process guard fired inside ONE job's thread; the
+                # runner (and its batchmates) survived.
+                continuable=True,
+            )
+        workdir = entry.get("workdir")
+        merged_files: dict[str, str] = {}
+        if isinstance(workdir, str) and workdir:
+            entries, _has_hashes = parse_files_field(entry.get("files", []))
+            fetched = await asyncio.gather(
+                *(
+                    self._fetch_changed(
+                        client,
+                        base,
+                        f"{workdir}/{rel}",
+                        sha if self._transfer_state(sandbox).enabled else None,
+                        stats,
+                    )
+                    for rel, sha in entries
+                )
+            )
+            for (full_rel, object_id), (rel, _sha) in zip(fetched, entries):
+                # Demux contract: the caller sees ITS files at the paths
+                # its code wrote them, not the batch's staging prefix.
+                merged_files[f"/workspace/{rel}"] = object_id
+        phases: dict[str, float | str] = {
+            "exec": (
+                float(duration)
+                if isinstance(duration, (int, float))
+                else exec_seconds
+            ),
+            "batch_jobs": float(batch_jobs),
+            "batch_index": float(index),
+        }
+        if job.trace_id is not None:
+            phases["trace_id"] = job.trace_id
+        return Result(
+            stdout=str(entry.get("stdout", "")),
+            stderr=str(entry.get("stderr", "")),
+            exit_code=int(entry.get("exit_code", -1)),
+            files=merged_files,
+            phases=phases,
+            warm=warm,
+            stdout_truncated=bool(entry.get("stdout_truncated", False)),
+            stderr_truncated=bool(entry.get("stderr_truncated", False)),
         )
 
     async def _execute_once(
@@ -1363,11 +1870,14 @@ class CodeExecutor:
             if (
                 phase.endswith("_bytes")
                 or phase.startswith("compile_cache_")
+                or phase.startswith("batch_")
                 or not isinstance(seconds, (int, float))
             ):
                 # Byte counts, the compile-cache hit/miss COUNTS (they have
-                # their own counter family), and the trace id all ride in
-                # phases but are not latencies.
+                # their own counter family), the batch demux coordinates
+                # (batch_jobs/batch_index — counted in the batch_* counter
+                # family), and the trace id all ride in phases but are not
+                # latencies.
                 continue
             self.metrics.phase_seconds.observe(seconds, phase=phase)
 
@@ -2596,6 +3106,10 @@ class CodeExecutor:
 
     async def close(self) -> None:
         self._closed = True
+        # Batching first: pending windows fail their futures (retryable),
+        # in-flight dispatch tasks finish (they own sandbox release).
+        if self.batcher is not None:
+            await self.batcher.close()
         # Cancel in-flight pool refills — a spawn can take tens of seconds
         # (TPU warm-up) and shutdown must not wait for it; the backend kills
         # half-spawned sandboxes because they register before readiness.

@@ -215,7 +215,20 @@ def create_http_app(
                 status=503,
                 headers={"Retry-After": str(retry_after)},
             )
-        return web.json_response({"status": "ok"})
+        # Operator detail: per-lane queue pressure (the scheduler's own
+        # queue-wait EWMA — the PR 3 autoscaling-hint gauge, surfaced here
+        # so "are lanes starved?" needs no Prometheus round-trip) and batch
+        # occupancy ("are batches running under-filled?").
+        body: dict = {"status": "ok"}
+        lanes = code_executor.scheduler.lane_detail()
+        if lanes:
+            body["lanes"] = lanes
+        body["batching"] = {
+            "enabled": code_executor.batcher is not None,
+            "window_ms": code_executor.config.batch_window_ms,
+            "max_jobs": code_executor.config.batch_max_jobs,
+        }
+        return web.json_response(body)
 
     @routes.get("/metrics")
     async def metrics(request: web.Request) -> web.Response:

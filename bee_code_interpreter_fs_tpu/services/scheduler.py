@@ -99,6 +99,11 @@ class Ticket:
     finish_tag: float  # WFQ virtual finish (grant order key)
     seq: int  # global FIFO tiebreak
     deadline_at: float | None = None  # absolute, scheduler clock; None = none
+    # Requests riding this ONE slot acquisition: a batched dispatch hands a
+    # single multi-job token to the lane (N coalesced jobs, one sandbox),
+    # so fairness and the wait estimators account it as N requests served
+    # by one grant.
+    jobs: int = 1
     granted: bool = False
     done: bool = False
     event: asyncio.Event = field(default_factory=asyncio.Event)
@@ -115,6 +120,7 @@ class _LaneState:
         "interactive_run",
         "queue_wait_ewma",
         "spawn_ewma",
+        "batch_occupancy_ewma",
     )
 
     def __init__(self, alpha: float) -> None:
@@ -136,6 +142,11 @@ class _LaneState:
         self.interactive_run = 0
         self.queue_wait_ewma = _Ewma(alpha)
         self.spawn_ewma = _Ewma(alpha)
+        # Jobs-per-dispatch / max-jobs for batched dispatches on this lane:
+        # ~1.0 means full batches (every chip busy), low values mean the
+        # window keeps expiring under-filled — the operator signal for
+        # whether the lane's traffic actually coalesces.
+        self.batch_occupancy_ewma = _Ewma(alpha)
 
 
 class SandboxScheduler:
@@ -250,6 +261,38 @@ class SandboxScheduler:
         """Feed the spawn-latency EWMA (called beside the spawn histogram)."""
         self._lane(lane).spawn_ewma.observe(max(0.0, seconds))
 
+    def observe_batch(self, lane: int, jobs: int, max_jobs: int) -> None:
+        """Feed the lane's batch-occupancy EWMA: one sample per batched
+        dispatch, jobs coalesced over the configured ceiling."""
+        if max_jobs > 0:
+            self._lane(lane).batch_occupancy_ewma.observe(
+                min(1.0, max(0, jobs) / max_jobs)
+            )
+
+    def batch_occupancies(self) -> dict[int, float]:
+        """Per-lane smoothed batch occupancy (0..1; 0.0 until the first
+        batched dispatch) for the healthz detail and the occupancy gauge."""
+        return {
+            lane: state.batch_occupancy_ewma.get(0.0)
+            for lane, state in self._lanes.items()
+        }
+
+    def lane_detail(self) -> dict[str, dict[str, float]]:
+        """Operator-facing per-lane snapshot for GET /healthz: queued depth,
+        the queue-wait EWMA deadline admission consults (the PR 3 gauge,
+        closed-loop here), and batch occupancy — together they answer "is
+        this lane starved, and are its batches running under-filled?"."""
+        return {
+            str(lane): {
+                "queued": float(len(state.tickets)),
+                "queue_wait_ewma_s": round(state.queue_wait_ewma.get(0.0), 6),
+                "batch_occupancy": round(
+                    state.batch_occupancy_ewma.get(0.0), 6
+                ),
+            }
+            for lane, state in self._lanes.items()
+        }
+
     def estimated_wait(self, lane: int, *, pool_ready: int = 0) -> float:
         """Expected seconds until a request submitted NOW would start:
         the queue-wait EWMA while anything is queued, plus the spawn EWMA
@@ -284,11 +327,14 @@ class SandboxScheduler:
         priority: str | None = None,
         deadline: float | None = None,
         pool_ready: int = 0,
+        jobs: int = 1,
     ) -> Ticket:
         """Admit one acquisition into the lane's queue, or shed it.
 
         `deadline` is RELATIVE seconds ("must start within D"); `pool_ready`
         is the lane's current warm-pool depth (admission estimate input).
+        `jobs` > 1 marks a batched dispatch's multi-job token: one queue
+        position, one grant, one sandbox — serving N coalesced requests.
         Raises `QueueDepthError` (tenant depth bound), `DeadlineInfeasibleError`
         (deadline < estimated wait), or `ValueError` (bad tenant/priority —
         a client error, not capacity)."""
@@ -339,6 +385,7 @@ class SandboxScheduler:
             finish_tag=finish,
             seq=next(self._seq),
             deadline_at=None if deadline is None else now + deadline,
+            jobs=max(1, jobs),
         )
         state.tickets.append(ticket)
         # submit() runs in the requesting task's context, so the event lands
@@ -349,6 +396,7 @@ class SandboxScheduler:
             tenant=tenant,
             priority=priority,
             queue_depth=len(state.tickets),
+            jobs=ticket.jobs,
         )
         # An empty-of-grants lane must always have an awake head so SOMEONE
         # evaluates pool-vs-spawn; with a granted holder already out there,
@@ -488,6 +536,7 @@ class SandboxScheduler:
                 tenant=ticket.tenant,
                 priority=ticket.priority,
                 wait_s=round(max(0.0, self.now() - ticket.enqueued_at), 6),
+                jobs=ticket.jobs,
             )
             # The aging counter moves on actual slot handoffs only: an
             # interactive acquisition while batch still waits burns one of
@@ -507,7 +556,10 @@ class SandboxScheduler:
             tenant_label = self._metric_tenant(ticket.tenant, claim=True)
             grants = getattr(self.metrics, "scheduler_grants", None)
             if grants is not None:
+                # A multi-job token counts once per request it serves: the
+                # fairness observable is requests granted, not tickets.
                 grants.inc(
+                    ticket.jobs,
                     chip_count=str(ticket.lane),
                     tenant=tenant_label,
                     priority=ticket.priority,

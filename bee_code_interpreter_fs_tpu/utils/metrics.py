@@ -262,6 +262,25 @@ class ExecutorMetrics:
             "client overruns, never retried.",
             ("chip_count", "kind"),
         )
+        # Batched execution lanes: dispatches by outcome (ok /
+        # error_fallback / violation_fallback) and jobs by how they were
+        # served (batched, or serial_<reason> when a window under-filled or
+        # a batch fault fell back). batched >> serial_* is the subsystem
+        # paying for itself; rising fallbacks are the alarm.
+        self.batch_dispatches = self.registry.counter(
+            "code_interpreter_batch_dispatches_total",
+            "Fused multi-job dispatches by outcome (ok = demuxed cleanly; "
+            "error_fallback / violation_fallback = batch-level fault, jobs "
+            "re-ran serially).",
+            ("outcome",),
+        )
+        self.batch_jobs = self.registry.counter(
+            "code_interpreter_batch_jobs_total",
+            "Batch-eligible jobs by how they were ultimately served "
+            "(batched = rode a fused dispatch; serial_* = fell back to the "
+            "serial path, by reason).",
+            ("outcome",),
+        )
         self.scheduler_queue_wait = self.registry.histogram(
             "code_interpreter_scheduler_queue_wait_seconds",
             "Seconds a request queued for a sandbox slot before its grant, "
@@ -379,6 +398,7 @@ class ExecutorMetrics:
         self.breaker_state: Gauge | None = None
         self.scheduler_queue_depth: Gauge | None = None
         self.scheduler_queue_wait_ewma: Gauge | None = None
+        self.batch_occupancy: Gauge | None = None
 
     def bind_pool(self, pools) -> None:
         """Expose warm-pool depth per chip-count lane, read at scrape time."""
@@ -457,6 +477,23 @@ class ExecutorMetrics:
             "estimator; updated on each grant).",
             ("chip_count",),
             callback=ewma_sample,
+        )
+
+        def occupancy_sample() -> dict[tuple[str, ...], float]:
+            return {
+                (str(lane),): value
+                for lane, value in scheduler.batch_occupancies().items()
+            }
+
+        # Jobs-per-dispatch over the configured batch ceiling, smoothed:
+        # ~1.0 = full batches (every chip of the lane busy per dispatch);
+        # persistently low = the window keeps expiring under-filled.
+        self.batch_occupancy = self.registry.gauge(
+            "code_interpreter_batch_occupancy",
+            "EWMA of batched-dispatch fill ratio (jobs coalesced / "
+            "APP_BATCH_MAX_JOBS), by chip-count lane.",
+            ("chip_count",),
+            callback=occupancy_sample,
         )
 
     def bind_breakers(self, board) -> None:

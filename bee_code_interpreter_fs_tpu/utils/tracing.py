@@ -338,6 +338,13 @@ class Tracer:
     into no-ops: every factory method returns :data:`NOOP` and nothing is
     ever allocated or exported."""
 
+    # Bounds on the tentative buffer: concurrent tail-candidate traces
+    # beyond the cap fall back to plain unsampled (ids propagate, nothing
+    # records), and one trace retains at most this many spans — the whole
+    # memory story for tail sampling.
+    TAIL_MAX_TRACES = 64
+    TAIL_MAX_SPANS = 512
+
     def __init__(
         self,
         *,
@@ -349,6 +356,8 @@ class Tracer:
         rng: random.Random | None = None,
         clock=time.perf_counter,
         walltime=time.time,
+        tail_enabled: bool = True,
+        tail_slow_seconds: float = 5.0,
     ) -> None:
         self.enabled = enabled
         self.sample_ratio = min(1.0, max(0.0, sample_ratio))
@@ -358,6 +367,16 @@ class Tracer:
         self._rng = rng or random.Random(os.urandom(8))
         self.clock = clock
         self.walltime = walltime
+        # Tail-based sampling: traces the head coin flip REJECTED are still
+        # recorded tentatively; when the root finishes they are kept anyway
+        # if they turned out to matter (error status anywhere, a
+        # limit.violation event, or a slow root) and dropped otherwise.
+        # This is the flight recorder that keeps a batched dispatch's one
+        # bad request reconstructible at 1% head sampling.
+        self.tail_enabled = tail_enabled
+        self.tail_slow_seconds = max(0.0, tail_slow_seconds)
+        # trace_id -> {"root": span_id, "spans": [dict, ...]}
+        self._tentative: dict[str, dict] = {}
 
     @classmethod
     def from_config(cls, config, metrics=None) -> "Tracer":
@@ -367,6 +386,8 @@ class Tracer:
             ring=TraceRing(config.tracing_ring_capacity),
             jsonl_path=config.tracing_jsonl_path,
             metrics=metrics,
+            tail_enabled=config.tracing_tail_enabled,
+            tail_slow_seconds=config.tracing_tail_slow_seconds,
         )
 
     # -------------------------------------------------------------- factories
@@ -394,6 +415,23 @@ class Tracer:
                 or self._rng.random() < self.sample_ratio
             )
         if not sampled:
+            if (
+                self.tail_enabled
+                and parsed is None
+                and len(self._tentative) < self.TAIL_MAX_TRACES
+            ):
+                # Head sampling said no, but record TENTATIVELY anyway:
+                # the root's finish decides keep-vs-drop (tail sampling).
+                # Only for traces STARTED here — an upstream flag-00
+                # decision is respected per W3C.
+                span = Span(
+                    self, name, trace_id, new_span_id(), parent_id, attributes
+                )
+                self._tentative[trace_id] = {
+                    "root": span.span_id,
+                    "spans": [],
+                }
+                return span
             # Propagate ids (flag 00) downstream, record nothing. Children
             # of a NullSpan are the NullSpan itself — same ids onward.
             return NullSpan(trace_id, parent_id or new_span_id())
@@ -456,6 +494,44 @@ class Tracer:
     # --------------------------------------------------------------- plumbing
 
     def _export(self, span: dict) -> None:
+        pending = self._tentative.get(span.get("trace_id", ""))
+        if pending is not None:
+            if span["span_id"] != pending["root"]:
+                if len(pending["spans"]) < self.TAIL_MAX_SPANS:
+                    pending["spans"].append(span)
+                return  # buffered; the root's finish decides
+            del self._tentative[span["trace_id"]]
+            if not self._tail_keep(span, pending["spans"]):
+                return  # ordinary trace, head sampling's call stands
+            # The root exports OUTSIDE the span-buffer cap: a kept trace
+            # without its root has no duration and no tree anchor.
+            for buffered in [*pending["spans"], span]:
+                buffered.setdefault("attributes", {})["sampled"] = "tail"
+                self._export_final(buffered)
+            return
+        self._export_final(span)
+
+    @staticmethod
+    def _span_interesting(span: dict) -> bool:
+        if span.get("status") == "error":
+            return True
+        return any(
+            event.get("name") == "limit.violation"
+            for event in span.get("events", ())
+        )
+
+    def _tail_keep(self, root: dict, spans: list[dict]) -> bool:
+        """Does an unsampled-by-the-head trace earn retention? Errors and
+        typed limit violations always do; so does a slow root (the
+        slow-p99 flight-recorder case). The root is checked explicitly —
+        it is no longer part of the buffered span list."""
+        if root["duration_s"] >= self.tail_slow_seconds > 0:
+            return True
+        if self._span_interesting(root):
+            return True
+        return any(self._span_interesting(s) for s in spans)
+
+    def _export_final(self, span: dict) -> None:
         self.ring.add(span)
         if self.ring is not GLOBAL_RING:
             GLOBAL_RING.add(span)

@@ -34,10 +34,36 @@ import json
 import os
 import runpy
 import sys
+import threading
+import time
 import traceback
 
 REQ_FD = 3
 RESP_FD = 4
+
+# Trace context for runner-authored log lines: the server forwards the
+# request's trace id (parsed from the control plane's traceparent) and the
+# runner prefixes its own diagnostics with it, so a demuxed batch job's
+# sandbox output is attributable to its originating request. Thread-local:
+# batch jobs run in threads, each under its own request's trace id.
+_TRACE_LOCAL = threading.local()
+
+
+def _set_trace_id(trace_id) -> None:
+    _TRACE_LOCAL.trace_id = trace_id if isinstance(trace_id, str) else None
+
+
+def _log(msg: str) -> None:
+    """Runner diagnostic line, trace-id-prefixed when the request carried
+    trace context (goes to the executor's log via inherited stderr, or to
+    the job's capture while a redirect is active — both are the places an
+    operator reconstructs a batched run from)."""
+    trace_id = getattr(_TRACE_LOCAL, "trace_id", None)
+    prefix = f"[runner trace={trace_id}] " if trace_id else "[runner] "
+    try:
+        sys.stderr.write(prefix + msg + "\n")
+    except Exception:  # noqa: BLE001 — logging must never kill the runner
+        pass
 
 # Persistent-compilation-cache traffic, counted via jax.monitoring events
 # (registered in _warm_import, best-effort): the per-request delta rides the
@@ -158,9 +184,7 @@ def _warm_import() -> dict:
             # the pod would pass its probe and hand out a slice whose mesh
             # silently doesn't exist. Exiting keeps the server from ever
             # listening (server.cpp refuses multi-host without the runner).
-            sys.stderr.write(
-                "[runner] fatal: jax init failed on a multi-host slice\n"
-            )
+            _log("fatal: jax init failed on a multi-host slice")
             os._exit(1)
         info["backend"] = "import-failed"
     return info
@@ -350,6 +374,19 @@ def _import_sibling(name: str):
         sys.path.pop(0)
 
 
+# APP_JAX_PROFILE stays out of os.environ: the warm runner profiles the
+# run itself, and leaking the var would make a sitecustomize on the path
+# double-start the profiler at first jax import. The rlimit knobs stay
+# out too: they are operator policy from the sandbox's boot env, and a
+# request-supplied override would let the very snippets the guardrail
+# targets turn it off.
+_OPERATOR_ONLY = (
+    "APP_JAX_PROFILE",
+    "APP_MAX_USER_MEMORY_BYTES",
+    "APP_MAX_OPEN_FILES",
+)
+
+
 def _run_one(req: dict) -> tuple[int, str | None]:
     """Execute one request; returns (exit_code, violation) where violation
     is the typed limit kind when an in-process resource guard ended the run
@@ -363,13 +400,6 @@ def _run_one(req: dict) -> tuple[int, str | None]:
     except Exception:  # noqa: BLE001 — fallback is best-effort
         traceback.print_exc()
     env = req.get("env") or {}
-    # APP_JAX_PROFILE stays out of os.environ: the warm runner profiles the
-    # run itself, and leaking the var would make a sitecustomize on the path
-    # double-start the profiler at first jax import. The rlimit knobs stay
-    # out too: they are operator policy from the sandbox's boot env, and a
-    # request-supplied override would let the very snippets the guardrail
-    # targets turn it off.
-    _OPERATOR_ONLY = ("APP_JAX_PROFILE", "APP_MAX_USER_MEMORY_BYTES", "APP_MAX_OPEN_FILES")
     env_to_set = {k: v for k, v in env.items() if k not in _OPERATOR_ONLY}
     saved_env = {k: os.environ.get(k) for k in env_to_set}
     os.environ.update({k: str(v) for k, v in env_to_set.items()})
@@ -456,6 +486,282 @@ def _run_one(req: dict) -> tuple[int, str | None]:
     return exit_code, violation
 
 
+# ---------------------------------------------------------------------------
+# Batched dispatch (the "op": "batch" request): N small jobs from ONE tenant
+# run concurrently in this warm process, each thread pinned to its own
+# device of the lane's local device set — the Anakin/Sebulba placement that
+# keeps every chip of a multi-chip slice busy instead of idling 7/8 of it
+# behind serial round-trips. One address space means env, rlimits, and the
+# CPU budget are BATCH-level (the control plane only coalesces jobs whose
+# env and limits are identical); stdout/stderr are demuxed per job via a
+# thread-routing stream proxy, and each job thread gets a PRIVATE cwd via
+# unshare(CLONE_FS) so relative-path file writes land in its own workdir.
+
+
+class _StreamRouter:
+    """sys.stdout/sys.stderr stand-in during a batched run: writes route to
+    the calling thread's bound per-job capture file, falling back to the
+    batch-level stream for main-thread/runner output. fd-level writes from
+    C extensions bypass Python streams and land in the batch-level capture
+    — the server surfaces batch-level stdout and the control plane then
+    reruns the batch serially, so that output is never dropped."""
+
+    def __init__(self, fallback) -> None:
+        self._fallback = fallback
+        self._local = threading.local()
+
+    def bind(self, fh) -> None:
+        self._local.fh = fh
+
+    def unbind(self) -> None:
+        self._local.fh = None
+
+    @property
+    def _target(self):
+        return getattr(self._local, "fh", None) or self._fallback
+
+    def write(self, data) -> int:
+        return self._target.write(data)
+
+    def writelines(self, lines) -> None:
+        self._target.writelines(lines)
+
+    def flush(self) -> None:
+        try:
+            self._target.flush()
+        except ValueError:  # closed underlying file
+            pass
+
+    def isatty(self) -> bool:
+        return False
+
+    @property
+    def encoding(self):
+        return getattr(self._target, "encoding", "utf-8")
+
+    def fileno(self) -> int:
+        return self._fallback.fileno()
+
+
+_CLONE_FS = 0x00000200
+
+
+def _unshare_fs() -> bool:
+    """Give the calling THREAD a private filesystem context (cwd/umask) via
+    unshare(CLONE_FS), so concurrent batch jobs each chdir into their own
+    workdir without racing. No privilege needed. False when unavailable
+    (non-Linux libc, seccomp policy) — the job then runs from the shared
+    workspace root and its relative-path writes are not demuxable."""
+    try:
+        import ctypes
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        return libc.unshare(_CLONE_FS) == 0
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _job_device_ctx(device_index, fallback_index: int):
+    """Pin the job thread's jax dispatches to one local device (the batch's
+    device-axis placement). jax config context managers are thread-local,
+    so concurrent jobs land on distinct chips. No jax / no devices / old
+    jax without default_device → a null context (CPU-only jobs run fine)."""
+    import contextlib
+
+    try:
+        jax = sys.modules.get("jax")
+        if jax is not None and hasattr(jax, "default_device"):
+            devices = jax.devices()
+            if devices:
+                index = (
+                    device_index
+                    if isinstance(device_index, int)
+                    else fallback_index
+                )
+                return jax.default_device(devices[index % len(devices)])
+    except Exception:  # noqa: BLE001 — placement is best-effort
+        pass
+    return contextlib.nullcontext()
+
+
+def _run_batch_job(index: int, job: dict, results: list, mem_limited: bool,
+                   proxies: tuple, t_base: float) -> None:
+    """One job thread: bind capture files, isolate cwd, pin the device,
+    exec the source. Never raises — the entry records the outcome (a
+    per-job MemoryError under an armed budget is THIS job's typed oom
+    violation; its batchmates never notice)."""
+    proxy_out, proxy_err = proxies
+    _set_trace_id(job.get("trace_id"))
+    start = time.monotonic()
+    entry = {
+        "exit_code": 0,
+        "start_offset_s": round(max(0.0, start - t_base), 6),
+    }
+    out = err = None
+    try:
+        out = open(job["stdout_path"], "w", buffering=1)
+        err = open(job["stderr_path"], "w", buffering=1)
+        proxy_out.bind(out)
+        proxy_err.bind(err)
+        isolated = _unshare_fs()
+        if isolated:
+            try:
+                os.chdir(job["cwd"])
+            except OSError:
+                isolated = False
+        entry["cwd_isolated"] = isolated
+        if not isolated:
+            _log(
+                "batch job %d: no per-thread cwd isolation; relative-path "
+                "writes land in the shared workspace" % index
+            )
+        source_path = job["source_path"]
+        with open(source_path) as f:
+            code = compile(f.read(), source_path, "exec")
+        with _job_device_ctx(job.get("device_index"), index):
+            exec(  # noqa: S102 — this IS the sandbox's purpose
+                code,
+                {
+                    "__name__": "__main__",
+                    "__file__": source_path,
+                    "__builtins__": __builtins__,
+                },
+            )
+    except SystemExit as e:
+        code_ = e.code
+        entry["exit_code"] = (
+            code_ if isinstance(code_, int) else (0 if code_ is None else 1)
+        )
+    except MemoryError:
+        traceback.print_exc()  # routed to this job's stderr by the proxy
+        entry["exit_code"] = 1
+        if mem_limited:
+            entry["violation"] = "oom"
+    except BaseException:  # noqa: BLE001 — report, don't die
+        traceback.print_exc()
+        entry["exit_code"] = 1
+    finally:
+        entry["duration_s"] = round(time.monotonic() - start, 6)
+        proxy_out.unbind()
+        proxy_err.unbind()
+        for fh in (out, err):
+            if fh is not None:
+                try:
+                    fh.close()
+                except OSError:
+                    pass
+        _set_trace_id(None)
+        results[index] = entry
+
+
+def _run_batch(req: dict) -> dict:
+    """Execute a coalesced batch: all jobs concurrently, one reply carrying
+    per-job results. Batch-level state (env, rlimits, SIGINT handler, the
+    fd-level redirect) is set up once around the whole run — the control
+    plane only batches jobs whose env/limits are identical, so there is
+    nothing per-job to disagree about."""
+    jobs = req.get("jobs") or []
+    if not jobs:
+        return {"exit_code": -2, "error": "empty batch"}
+    env = req.get("env") or {}
+    env_to_set = {k: v for k, v in env.items() if k not in _OPERATOR_ONLY}
+    saved_env = {k: os.environ.get(k) for k in env_to_set}
+    os.environ.update({k: str(v) for k, v in env_to_set.items()})
+    limits = req.get("limits") or {}
+    mem_limited = (
+        _request_limit(limits, "memory_bytes", _resolve_mem_budget()) > 0
+    )
+    # fd-level redirect to the batch capture (C-extension writes); Python-
+    # level streams route per job through the proxies.
+    out_fd = os.open(
+        req["stdout_path"], os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644
+    )
+    err_fd = os.open(
+        req["stderr_path"], os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644
+    )
+    saved_out, saved_err = os.dup(1), os.dup(2)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.dup2(out_fd, 1)
+    os.dup2(err_fd, 2)
+    os.close(out_fd)
+    os.close(err_fd)
+    fallback_out = os.fdopen(os.dup(1), "w", buffering=1)
+    fallback_err = os.fdopen(os.dup(2), "w", buffering=1)
+    proxy_out = _StreamRouter(fallback_out)
+    proxy_err = _StreamRouter(fallback_err)
+    prev_stdout, prev_stderr = sys.stdout, sys.stderr
+    sys.stdout, sys.stderr = proxy_out, proxy_err
+    restore_rlimits = _apply_user_rlimits(limits)
+    import signal as _signal
+
+    saved_sigint = _signal.getsignal(_signal.SIGINT)
+    results: list = [None] * len(jobs)
+    violation = None
+    t_base = time.monotonic()
+    threads = [
+        threading.Thread(
+            target=_run_batch_job,
+            args=(i, job, results, mem_limited, (proxy_out, proxy_err), t_base),
+            name=f"batch-job-{i}",
+            daemon=True,
+        )
+        for i, job in enumerate(jobs)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    except _CpuTimeExceeded:
+        # The batch's shared CPU budget ran out (the rlimit counts the
+        # whole process — signal lands here, in the joining main thread,
+        # unattributable to one job). Restore limits FIRST: the soft
+        # ceiling re-fires every second past it.
+        restore_rlimits()
+        violation = "cpu_time"
+    except MemoryError:
+        restore_rlimits()
+        if mem_limited:
+            violation = "oom"
+    except BaseException:  # noqa: BLE001 — report, don't die
+        restore_rlimits()
+        traceback.print_exc()
+    finally:
+        restore_rlimits()
+        try:
+            _signal.signal(_signal.SIGINT, saved_sigint)
+        except (ValueError, TypeError):
+            pass
+        sys.stdout, sys.stderr = prev_stdout, prev_stderr
+        for fh in (fallback_out, fallback_err):
+            try:
+                fh.close()
+            except OSError:
+                pass
+        os.dup2(saved_out, 1)
+        os.dup2(saved_err, 2)
+        os.close(saved_out)
+        os.close(saved_err)
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    aborted = violation is not None
+    for i, entry in enumerate(results):
+        if entry is None:
+            # Thread never finished (batch-level abort while it ran): its
+            # result is unusable — the control plane re-runs it serially.
+            results[i] = {"exit_code": -1, "aborted": True}
+    reply = {"jobs": results, "exit_code": 0}
+    if violation:
+        reply["violation"] = violation
+    if aborted:
+        reply["batch_aborted"] = True
+    return reply
+
+
 def _descendant_pids() -> list[int]:
     """All live descendants of this process, via one /proc scan (user code
     runs in-process, so anything it spawned is a child of the runner)."""
@@ -540,9 +846,9 @@ def _reset(snapshot: dict) -> bool:
         if t.is_alive() and t.ident not in snapshot["threads"]
     ]
     if survivors:
-        sys.stderr.write(
-            "[runner] reset refused: user thread(s) survived: "
-            f"{[t.name for t in survivors]}\n"
+        _log(
+            "reset refused: user thread(s) survived: "
+            f"{[t.name for t in survivors]}"
         )
         return False
     # A module imported from the previous generation's workspace, exec
@@ -667,16 +973,28 @@ def main() -> None:
                         # device buffers while the server wipes the
                         # workspace — off the next request's critical path.
                         gc.collect()
+                elif req.get("op") == "batch":
+                    _set_trace_id(req.get("trace_id"))
+                    hits_before, misses_before = _cache_counts()
+                    reply = _run_batch(req)
+                    if _CACHE_LISTENING:
+                        hits_after, misses_after = _cache_counts()
+                        reply["cache_hits"] = hits_after - hits_before
+                        reply["cache_misses"] = misses_after - misses_before
+                    _set_trace_id(None)
+                    _reply(reply)
                 else:
+                    _set_trace_id(req.get("trace_id"))
                     hits_before, misses_before = _cache_counts()
                     exit_code, violation = _run_one(req)
-                    reply: dict = {"exit_code": exit_code}
+                    reply = {"exit_code": exit_code}
                     if violation:
                         reply["violation"] = violation
                     if _CACHE_LISTENING:
                         hits_after, misses_after = _cache_counts()
                         reply["cache_hits"] = hits_after - hits_before
                         reply["cache_misses"] = misses_after - misses_before
+                    _set_trace_id(None)
                     _reply(reply)
             except KeyboardInterrupt:
                 # The cancellation SIGINT raced past user code and landed in

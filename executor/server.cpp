@@ -1318,11 +1318,23 @@ minijson::Value runner_limits_json(const limits::LimitSpec& lim) {
   return minijson::Value(o);
 }
 
+// The 32-hex trace id inside a W3C traceparent ("00-<trace>-<span>-<fl>"),
+// or "" — forwarded to the warm runner so its own log lines (and a batch
+// job's) are attributable to the originating request.
+std::string trace_id_of(const std::string& traceparent) {
+  size_t a = traceparent.find('-');
+  if (a == std::string::npos) return "";
+  size_t b = traceparent.find('-', a + 1);
+  if (b == std::string::npos || b - a != 33) return "";
+  return traceparent.substr(a + 1, 32);
+}
+
 RunOutcome run_user_code(const std::string& script_path,
                          const std::string& stdout_path,
                          const std::string& stderr_path, double timeout_s,
                          const minijson::Value& extra_env,
-                         const limits::LimitSpec& lim) {
+                         const limits::LimitSpec& lim,
+                         const std::string& trace_id = "") {
   RunOutcome out;
   bool restart_runner = false;
 
@@ -1348,6 +1360,7 @@ RunOutcome run_user_code(const std::string& script_path,
         reqo["source_path"] = minijson::Value(script_path);
         reqo["stdout_path"] = minijson::Value(stdout_path);
         reqo["stderr_path"] = minijson::Value(stderr_path);
+        if (!trace_id.empty()) reqo["trace_id"] = minijson::Value(trace_id);
         if (extra_env.is_object()) reqo["env"] = extra_env;
         if (lim.any()) reqo["limits"] = runner_limits_json(lim);
         minijson::Value resp;
@@ -1590,7 +1603,7 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
   RunOutcome run;
   if (!streaming) {
     run = run_user_code(script_path, stdout_path, stderr_path, timeout_s,
-                        extra_env, eff_limits);
+                        extra_env, eff_limits, trace_id_of(traceparent));
   } else {
     // Streaming mode: the run blocks in a worker thread while this thread
     // tails the capture files and pushes NDJSON events over a chunked
@@ -1614,7 +1627,7 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
       // the one-connection blast radius of the non-streaming path.
       try {
         run = run_user_code(script_path, stdout_path, stderr_path, timeout_s,
-                            extra_env, eff_limits);
+                            extra_env, eff_limits, trace_id_of(traceparent));
       } catch (const std::exception& e) {
         log_msg("streamed run_user_code threw: %s", e.what());
         run = RunOutcome{};  // exit_code -1, nothing ran warm
@@ -1841,6 +1854,393 @@ void handle_execute_stream(const minihttp::Request& req,
   handle_execute_impl(req, conn, /*streaming=*/true);
 }
 
+// Monotonic batch-staging counter: each batch's per-job workdirs live under
+// a fresh workspace-relative ".batch-<n>" root (exec_mutex serializes
+// batches, but a previous batch's dirs persist until /reset — reusing a
+// name would make its leftovers look like the new batch's output).
+std::atomic<long> g_batch_seq{0};
+
+// POST /execute-batch — the fused half of batched multi-chip execution
+// lanes: N compatible small jobs staged into per-job workdirs and run as
+// ONE warm-runner dispatch whose job threads spread over the local device
+// axis. Per-job stdout/stderr/exit/violation/files come back demuxed; any
+// refusal (no warm runner, multi-host slice, old binary's 404) tells the
+// control plane to fall back to the serial path.
+void handle_execute_batch(const minihttp::Request& req, minihttp::Conn& conn) {
+  std::string traceparent = req.header("traceparent");
+  struct timespec t_req;
+  clock_gettime(CLOCK_MONOTONIC, &t_req);
+  auto since_req = [&t_req]() {
+    struct timespec now;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    return (now.tv_sec - t_req.tv_sec) + (now.tv_nsec - t_req.tv_nsec) / 1e9;
+  };
+
+  std::string body = conn.read_body();
+  minijson::Value parsed;
+  try {
+    parsed = minijson::parse(body);
+  } catch (const std::exception&) {
+    conn.send_response(400, "application/json", "{\"error\":\"bad json\"}");
+    return;
+  }
+  const minijson::Value& jobs_v = parsed.get("jobs");
+  if (!jobs_v.is_array() || jobs_v.as_array().empty() ||
+      jobs_v.as_array().size() > 64) {
+    conn.send_response(400, "application/json",
+                       "{\"error\":\"jobs must be a non-empty array "
+                       "(max 64)\"}");
+    return;
+  }
+  const minijson::Array& jobs = jobs_v.as_array();
+  for (const auto& job : jobs) {
+    if (job.get_string("source_code").empty()) {
+      conn.send_response(400, "application/json",
+                         "{\"error\":\"every batch job needs source_code\"}");
+      return;
+    }
+  }
+  if (g_state.num_hosts > 1) {
+    // A multi-host slice's mesh spans executors; the fused driver runs on
+    // one host's runner. The control plane never sends this — refuse
+    // loudly rather than run jobs against a silently partial mesh.
+    conn.send_response(409, "application/json",
+                       "{\"error\":\"batch dispatch unsupported on a "
+                       "multi-host slice\"}");
+    return;
+  }
+  if (!g_state.warm_enabled || !g_state.runner) {
+    conn.send_response(409, "application/json",
+                       "{\"error\":\"batch dispatch requires the warm "
+                       "runner\"}");
+    return;
+  }
+  double timeout_s = parsed.get_number("timeout", g_state.default_timeout);
+  const minijson::Value& extra_env = parsed.get("env");
+  // Same output special-casing as /execute: the implicit server cap keeps
+  // TRUNCATE semantics; only an explicit output budget arms the watchdog's
+  // output-cap KILL (batch-level, like every other fused-run bound).
+  limits::LimitSpec req_limits = limits::from_json(parsed.get("limits"));
+  limits::LimitSpec eff_limits = limits::clamp(req_limits, g_state.limit_caps);
+  size_t output_cap = g_state.max_output;
+  if (req_limits.output_bytes > 0 &&
+      static_cast<size_t>(req_limits.output_bytes) < output_cap) {
+    output_cap = static_cast<size_t>(req_limits.output_bytes);
+  }
+  eff_limits.output_bytes =
+      req_limits.output_bytes > 0 ? static_cast<long long>(output_cap) : 0;
+
+  std::lock_guard<std::mutex> lock(g_state.exec_mutex);
+
+  // Scratch (scripts + capture files) and the workspace-relative staging
+  // root holding one PRIVATE workdir per job — the demux unit for changed
+  // files. Same TMPDIR fallback discipline as /execute.
+  std::string tmpdir = env_or("TMPDIR", "/tmp");
+  if (tmpdir != "/tmp" && access(tmpdir.c_str(), W_OK | X_OK) != 0) tmpdir = "/tmp";
+  std::string tmpl_s = tmpdir + "/exec-batch-XXXXXX";
+  std::vector<char> tmpl(tmpl_s.begin(), tmpl_s.end());
+  tmpl.push_back('\0');
+  if (!mkdtemp(tmpl.data())) {
+    conn.send_response(500, "application/json",
+                       "{\"error\":\"cannot create batch scratch dir\"}");
+    return;
+  }
+  std::string scratch(tmpl.data());
+  std::string batch_rel = ".batch-" + std::to_string(++g_batch_seq);
+  std::string batch_root = g_state.workspace + "/" + batch_rel;
+  std::vector<std::string> cleanup_files;
+  auto fail = [&](int status, const std::string& message) {
+    for (const auto& path : cleanup_files) unlink(path.c_str());
+    rmdir(scratch.c_str());
+    minijson::Object err;
+    err["error"] = minijson::Value(message);
+    conn.send_response(status, "application/json",
+                       minijson::Value(err).dump());
+  };
+  if (mkdir(batch_root.c_str(), 0755) != 0) {
+    fail(500, "cannot create batch staging root");
+    return;
+  }
+
+  double install_start = since_req();
+  minijson::Array runner_jobs;
+  std::vector<std::string> job_rels, job_out_paths, job_err_paths;
+  for (size_t i = 0; i < jobs.size(); ++i) {
+    std::string job_rel = batch_rel + "/job-" + std::to_string(i);
+    std::string job_dir = g_state.workspace + "/" + job_rel;
+    if (mkdir(job_dir.c_str(), 0755) != 0) {
+      fail(500, "cannot create batch job workdir");
+      return;
+    }
+    std::string script_path = scratch + "/job-" + std::to_string(i) + ".py";
+    if (!write_file(script_path, jobs[i].get_string("source_code"))) {
+      fail(500, "cannot stage batch job script");
+      return;
+    }
+    cleanup_files.push_back(script_path);
+    maybe_install_deps(script_path);
+    std::string out_path = scratch + "/job-" + std::to_string(i) + ".stdout";
+    std::string err_path = scratch + "/job-" + std::to_string(i) + ".stderr";
+    job_rels.push_back(job_rel);
+    job_out_paths.push_back(out_path);
+    job_err_paths.push_back(err_path);
+    cleanup_files.push_back(out_path);
+    cleanup_files.push_back(err_path);
+    minijson::Object rj;
+    rj["source_path"] = minijson::Value(script_path);
+    rj["stdout_path"] = minijson::Value(out_path);
+    rj["stderr_path"] = minijson::Value(err_path);
+    rj["cwd"] = minijson::Value(job_dir);
+    std::string job_trace = jobs[i].get_string("trace_id");
+    if (!job_trace.empty()) rj["trace_id"] = minijson::Value(job_trace);
+    const minijson::Value& device = jobs[i].get("device_index");
+    if (device.is_number()) rj["device_index"] = device;
+    runner_jobs.push_back(minijson::Value(rj));
+  }
+  std::map<std::string, FileSig> cc_before;
+  if (g_state.compile_cache_enabled)
+    scan_dir(g_state.compile_cache_dir, "", cc_before);
+  double install_s = since_req() - install_start;
+
+  std::string batch_out = scratch + "/batch.stdout";
+  std::string batch_err = scratch + "/batch.stderr";
+  cleanup_files.push_back(batch_out);
+  cleanup_files.push_back(batch_err);
+
+  minijson::Object reqo;
+  reqo["op"] = minijson::Value(std::string("batch"));
+  reqo["jobs"] = minijson::Value(runner_jobs);
+  reqo["stdout_path"] = minijson::Value(batch_out);
+  reqo["stderr_path"] = minijson::Value(batch_err);
+  std::string trace_id = trace_id_of(traceparent);
+  if (!trace_id.empty()) reqo["trace_id"] = minijson::Value(trace_id);
+  if (extra_env.is_object()) reqo["env"] = extra_env;
+  if (eff_limits.any()) reqo["limits"] = runner_limits_json(eff_limits);
+
+  double exec_start = since_req();
+  bool timed_out = false, runner_died = false, ran_warm = false;
+  bool restart_runner = false;
+  std::string batch_violation;
+  minijson::Value runner_resp;
+  long long cache_hits = -1, cache_misses = -1;
+  {
+    // Same warm-up wait discipline as run_user_code; but a batch NEVER
+    // falls back to a cold subprocess — there is no per-job isolation
+    // story there, and the control plane's serial fallback is strictly
+    // better.
+    {
+      std::unique_lock<std::mutex> wl(g_warm_transition_mutex);
+      g_warm_cv.wait(wl, [] {
+        return g_warm_state.load() != kWarmPending || g_ever_ready.load();
+      });
+    }
+    if (g_warm_state.load() != kWarmReady) {
+      fail(409, "warm runner not ready for batch dispatch");
+      return;
+    }
+    std::lock_guard<std::mutex> rlock(g_state.runner_mutex);
+    if (!g_state.runner->alive()) {
+      g_warm_state = kWarmFailed;
+      start_warm_async();
+      fail(409, "warm runner not alive for batch dispatch");
+      return;
+    }
+    // The watchdog watches EVERY capture file of the fused run: each job's
+    // private stdout/stderr (where the per-thread stream demux routes
+    // Python-level output) plus the batch-level pair (fd-level writes). An
+    // explicit output budget is a batch-level bound over their sum, like
+    // cpu_time — the serial rerun after an output_cap kill gives the real
+    // offender its individual verdict.
+    std::vector<std::string> capture_paths = job_out_paths;
+    capture_paths.insert(capture_paths.end(), job_err_paths.begin(),
+                         job_err_paths.end());
+    capture_paths.push_back(batch_out);
+    capture_paths.push_back(batch_err);
+    limits::Watchdog wd(eff_limits, g_state.runner->pid(), g_state.workspace,
+                        capture_paths, g_state.limit_poll_interval);
+    wd.start();
+    WarmRunner::ExecResult r = g_state.runner->execute(
+        minijson::Value(reqo).dump(), timeout_s > 0 ? timeout_s + 0.5 : 0,
+        runner_resp, /*allow_interrupt=*/true);
+    wd.stop();
+    ran_warm = true;
+    switch (r) {
+      case WarmRunner::ExecResult::kOk:
+        batch_violation = runner_resp.get_string("violation", "");
+        cache_hits =
+            static_cast<long long>(runner_resp.get_number("cache_hits", -1));
+        cache_misses =
+            static_cast<long long>(runner_resp.get_number("cache_misses", -1));
+        break;
+      case WarmRunner::ExecResult::kTimeout:
+        timed_out = true;
+        restart_runner = true;
+        break;
+      case WarmRunner::ExecResult::kInterrupted:
+        // The runner survived the SIGINT, but its job THREADS may not have
+        // unwound (signals reach only the main thread) — the next /reset
+        // will refuse on surviving threads and the control plane disposes.
+        timed_out = true;
+        break;
+      case WarmRunner::ExecResult::kDied:
+        runner_died = true;
+        restart_runner = true;
+        break;
+    }
+    std::string wd_kind = wd.violation();
+    if (!wd_kind.empty()) batch_violation = wd_kind;
+    if (restart_runner) {
+      g_warm_state = kWarmFailed;
+      start_warm_async();
+    }
+  }
+  double exec_s = since_req() - exec_start;
+
+  // Post-exec disk-quota scan over the whole workspace (the batch root is
+  // inside it), batch-level like every other group bound.
+  if (batch_violation.empty() && eff_limits.disk_bytes > 0 &&
+      limits::dir_usage_bytes(g_state.workspace) > eff_limits.disk_bytes) {
+    batch_violation = limits::kDiskQuota;
+  }
+
+  double collect_start = since_req();
+  const minijson::Value& job_results = runner_resp.get("jobs");
+  minijson::Array results;
+  minijson::Array trace_spans;
+  for (size_t i = 0; i < jobs.size(); ++i) {
+    minijson::Object entry;
+    entry["workdir"] = minijson::Value(job_rels[i]);
+    int exit_code = -1;
+    double job_duration = 0.0, job_offset = 0.0;
+    std::string job_violation;
+    bool aborted = timed_out || runner_died;
+    if (job_results.is_array() && i < job_results.as_array().size()) {
+      const minijson::Value& jr = job_results.as_array()[i];
+      exit_code = static_cast<int>(jr.get_number("exit_code", -1));
+      job_duration = jr.get_number("duration_s", 0.0);
+      job_offset = jr.get_number("start_offset_s", 0.0);
+      job_violation = jr.get_string("violation", "");
+      aborted = aborted || jr.get_bool("aborted", false);
+    }
+    bool out_trunc = false, err_trunc = false;
+    std::string out_s =
+        read_file_capped(job_out_paths[i], output_cap, &out_trunc);
+    std::string err_s =
+        read_file_capped(job_err_paths[i], output_cap, &err_trunc);
+    if (out_trunc) out_s += "\n[stdout truncated]";
+    if (err_trunc) err_s += "\n[stderr truncated]";
+    if (!job_violation.empty()) {
+      std::string note = "Resource limit exceeded: " + job_violation;
+      err_s += err_s.empty() ? note : "\n" + note;
+    }
+    entry["stdout"] = minijson::Value(out_s);
+    entry["stderr"] = minijson::Value(err_s);
+    entry["exit_code"] = minijson::Value(exit_code);
+    entry["stdout_truncated"] = minijson::Value(out_trunc);
+    entry["stderr_truncated"] = minijson::Value(err_trunc);
+    entry["duration_s"] = minijson::Value(job_duration);
+    entry["start_offset_s"] = minijson::Value(exec_start + job_offset);
+    if (!job_violation.empty())
+      entry["violation"] = minijson::Value(job_violation);
+    if (aborted) entry["aborted"] = minijson::Value(true);
+    // Changed files = everything in the job's private workdir (created
+    // fresh for this batch), reported RELATIVE to it so the control plane
+    // can demux each caller's files to the paths its code wrote.
+    minijson::Array files;
+    std::map<std::string, FileSig> job_files;
+    scan_dir(g_state.workspace + "/" + job_rels[i], "", job_files);
+    for (const auto& [rel, sig] : job_files) {
+      minijson::Object fe;
+      fe["path"] = minijson::Value(rel);
+      if (g_state.manifest_enabled) {
+        std::string full_rel = job_rels[i] + "/" + rel;
+        std::string hex;
+        FileSig hashed;
+        if (hash_workspace_file(g_state.workspace, full_rel, hex, &hashed)) {
+          std::lock_guard<std::mutex> mlock(g_ws_manifest_mutex);
+          g_ws_manifest[full_rel] = ManifestEntry{hex, hashed};
+          fe["sha256"] = minijson::Value(hex);
+        }
+      }
+      files.push_back(minijson::Value(fe));
+    }
+    entry["files"] = minijson::Value(files);
+    results.push_back(minijson::Value(entry));
+    if (!traceparent.empty()) {
+      minijson::Object s;
+      s["name"] = minijson::Value("job-" + std::to_string(i));
+      s["start_offset_s"] = minijson::Value(exec_start + job_offset);
+      s["duration_s"] = minijson::Value(job_duration);
+      trace_spans.push_back(minijson::Value(s));
+    }
+  }
+  // Read the batch-level captures BEFORE the scratch cleanup unlinks them.
+  // Batch-level STDOUT means fd-level writes (a subprocess, a C extension)
+  // bypassed the per-thread demux: surface it so the control plane can
+  // refuse the demux and rerun serially — output the serial path returns
+  // must never be silently dropped.
+  bool stray_trunc = false;
+  std::string stray_err = read_file_capped(batch_err, 64 * 1024, &stray_trunc);
+  std::string stray_out = read_file_capped(batch_out, 64 * 1024, &stray_trunc);
+  for (const auto& path : cleanup_files) unlink(path.c_str());
+  rmdir(scratch.c_str());
+
+  minijson::Object resp;
+  resp["results"] = minijson::Value(results);
+  resp["warm"] = minijson::Value(ran_warm);
+  resp["runner_restarted"] = minijson::Value(restart_runner);
+  if (timed_out) resp["timed_out"] = minijson::Value(true);
+  if (!batch_violation.empty())
+    resp["violation"] = minijson::Value(batch_violation);
+  if (!stray_err.empty()) resp["batch_stderr"] = minijson::Value(stray_err);
+  if (!stray_out.empty()) resp["batch_stdout"] = minijson::Value(stray_out);
+  if (g_state.compile_cache_enabled) {
+    std::map<std::string, FileSig> cc_after;
+    scan_dir(g_state.compile_cache_dir, "", cc_after);
+    long long new_entries = 0, new_bytes = 0;
+    for (const auto& [rel, sig] : cc_after) {
+      if (cc_entry_ignored(rel)) continue;
+      auto it = cc_before.find(rel);
+      if (it == cc_before.end() || !(it->second == sig)) {
+        ++new_entries;
+        new_bytes += sig.size;
+      }
+    }
+    minijson::Object cc;
+    cc["new_entries"] = minijson::Value(static_cast<int64_t>(new_entries));
+    cc["new_bytes"] = minijson::Value(static_cast<int64_t>(new_bytes));
+    cc["entries"] = minijson::Value(static_cast<int64_t>(cc_after.size()));
+    if (cache_hits >= 0)
+      cc["hits"] = minijson::Value(static_cast<int64_t>(cache_hits));
+    if (cache_misses >= 0)
+      cc["misses"] = minijson::Value(static_cast<int64_t>(cache_misses));
+    resp["compile_cache"] = minijson::Value(cc);
+  }
+  if (!traceparent.empty()) {
+    double collect_s = since_req() - collect_start;
+    minijson::Object trace;
+    trace["traceparent"] = minijson::Value(traceparent);
+    minijson::Object s_install;
+    s_install["name"] = minijson::Value(std::string("install"));
+    s_install["start_offset_s"] = minijson::Value(install_start);
+    s_install["duration_s"] = minijson::Value(install_s);
+    trace_spans.push_back(minijson::Value(s_install));
+    minijson::Object s_exec;
+    s_exec["name"] = minijson::Value(std::string("exec"));
+    s_exec["start_offset_s"] = minijson::Value(exec_start);
+    s_exec["duration_s"] = minijson::Value(exec_s);
+    trace_spans.push_back(minijson::Value(s_exec));
+    minijson::Object s_collect;
+    s_collect["name"] = minijson::Value(std::string("collect"));
+    s_collect["start_offset_s"] = minijson::Value(collect_start);
+    s_collect["duration_s"] = minijson::Value(collect_s);
+    trace_spans.push_back(minijson::Value(s_collect));
+    trace["spans"] = minijson::Value(trace_spans);
+    resp["trace"] = minijson::Value(trace);
+  }
+  conn.send_response(200, "application/json", minijson::Value(resp).dump());
+}
+
 minijson::Value warm_status_body() {
   minijson::Object resp;
   resp["status"] = minijson::Value("ok");
@@ -1955,6 +2355,8 @@ void handle_reset(const minihttp::Request&, minihttp::Conn& conn) {
 void route(const minihttp::Request& req, minihttp::Conn& conn) {
   if (req.method == "POST" && req.target == "/execute") {
     handle_execute(req, conn);
+  } else if (req.method == "POST" && req.target == "/execute-batch") {
+    handle_execute_batch(req, conn);
   } else if (req.method == "POST" && req.target == "/execute/stream") {
     handle_execute_stream(req, conn);
   } else if (req.method == "POST" && req.target == "/warmup") {

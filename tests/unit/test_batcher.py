@@ -1,0 +1,164 @@
+"""Unit tests for the batching window (services/batcher.py): compatibility
+keying, the bounded window (fake timer — zero sleeps), full-batch immediate
+dispatch, partial-batch expiry, and promise lifecycle on close.
+"""
+
+import asyncio
+
+import pytest
+
+from bee_code_interpreter_fs_tpu.services.batcher import (
+    Batcher,
+    BatchJob,
+    BatchKey,
+    freeze_mapping,
+)
+
+
+class ManualTimer:
+    """Injectable window timer: captures callbacks, fires on demand — the
+    fake clock for window-expiry tests."""
+
+    def __init__(self):
+        self.scheduled = []  # (delay, callback, handle)
+
+    def __call__(self, delay, callback):
+        handle = _Handle()
+        self.scheduled.append((delay, callback, handle))
+        return handle
+
+    def fire_all(self):
+        for _delay, callback, handle in list(self.scheduled):
+            if not handle.cancelled:
+                callback()
+        self.scheduled.clear()
+
+
+class _Handle:
+    def __init__(self):
+        self.cancelled = False
+
+    def cancel(self):
+        self.cancelled = True
+
+
+KEY = BatchKey(lane=8, tenant="t1", priority="interactive")
+
+
+def job(source="print(1)", timeout=30.0):
+    return BatchJob(source_code=source, timeout=timeout)
+
+
+def make(dispatched, *, max_jobs=4, window_s=0.01, timer=None):
+    async def dispatch(key, jobs):
+        dispatched.append((key, jobs))
+        for j in jobs:
+            j.resolve("ok")
+
+    return Batcher(
+        window_s=window_s, max_jobs=max_jobs, dispatch=dispatch, timer=timer
+    )
+
+
+async def test_full_batch_dispatches_immediately_without_window():
+    dispatched = []
+    timer = ManualTimer()
+    batcher = make(dispatched, max_jobs=3, timer=timer)
+    jobs = [job(f"j{i}") for i in range(3)]
+    for j in jobs:
+        await batcher.submit(KEY, j)
+    results = await asyncio.gather(*(j.future for j in jobs))
+    assert results == ["ok"] * 3
+    # ONE dispatch carried all three jobs; the armed window was cancelled.
+    assert len(dispatched) == 1
+    assert dispatched[0][1] == jobs
+    assert all(h.cancelled for _, _, h in timer.scheduled)
+
+
+async def test_window_expiry_flushes_partial_batch():
+    """Fake-clock window expiry with a partial batch: two of four slots
+    filled when the timer fires — both jobs dispatch together."""
+    dispatched = []
+    timer = ManualTimer()
+    batcher = make(dispatched, max_jobs=4, timer=timer)
+    a, b = job("a"), job("b")
+    await batcher.submit(KEY, a)
+    await batcher.submit(KEY, b)
+    assert dispatched == []  # window still open, nobody dispatched
+    assert batcher.pending_jobs(KEY) == 2
+    timer.fire_all()
+    assert await a.future == "ok"
+    assert await b.future == "ok"
+    assert len(dispatched) == 1
+    assert [j.source_code for j in dispatched[0][1]] == ["a", "b"]
+    assert batcher.pending_jobs(KEY) == 0
+
+
+async def test_incompatible_keys_never_share_a_dispatch():
+    """Tenant isolation by construction: different tenants (or lanes, or
+    env) are different keys — their jobs never ride one dispatch."""
+    dispatched = []
+    timer = ManualTimer()
+    batcher = make(dispatched, max_jobs=8, timer=timer)
+    k1 = BatchKey(lane=8, tenant="alice", priority="interactive")
+    k2 = BatchKey(lane=8, tenant="bob", priority="interactive")
+    k3 = BatchKey(
+        lane=8, tenant="alice", priority="interactive",
+        env=freeze_mapping({"X": "1"}),
+    )
+    jobs = {k: [job(), job()] for k in (k1, k2, k3)}
+    for k, js in jobs.items():
+        for j in js:
+            await batcher.submit(k, j)
+    timer.fire_all()
+    await asyncio.gather(*(j.future for js in jobs.values() for j in js))
+    assert len(dispatched) == 3
+    seen = {id(j) for _key, js in dispatched for j in js}
+    assert len(seen) == 6
+    for key, js in dispatched:
+        assert {id(j) for j in js} <= {id(j) for j in jobs[key]}
+
+
+async def test_one_timer_per_window_not_per_job():
+    timer = ManualTimer()
+    batcher = make([], max_jobs=8, timer=timer)
+    for _ in range(3):
+        await batcher.submit(KEY, job())
+    assert len(timer.scheduled) == 1  # armed by the FIRST job only
+
+
+async def test_dispatch_exception_fails_stragglers():
+    async def dispatch(key, jobs):
+        jobs[0].resolve("ok")
+        raise RuntimeError("dispatcher bug")
+
+    batcher = Batcher(window_s=0.0, max_jobs=2, dispatch=dispatch)
+    a, b = job("a"), job("b")
+    await batcher.submit(KEY, a)
+    await batcher.submit(KEY, b)
+    assert await a.future == "ok"
+    with pytest.raises(RuntimeError, match="dispatcher bug"):
+        await b.future
+
+
+async def test_close_fails_pending_and_rejects_new():
+    timer = ManualTimer()
+    batcher = make([], max_jobs=8, timer=timer)
+    parked = job()
+    await batcher.submit(KEY, parked)
+    await batcher.close()
+    with pytest.raises(RuntimeError, match="shutting down"):
+        await parked.future
+    with pytest.raises(RuntimeError, match="closed"):
+        await batcher.submit(KEY, job())
+
+
+async def test_flush_stats_count_batches_and_jobs():
+    dispatched = []
+    timer = ManualTimer()
+    batcher = make(dispatched, max_jobs=2, timer=timer)
+    for _ in range(4):
+        await batcher.submit(KEY, job())
+    await asyncio.sleep(0)
+    assert batcher.dispatched_batches == 2
+    assert batcher.dispatched_jobs == 4

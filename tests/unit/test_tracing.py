@@ -143,7 +143,10 @@ async def test_unsampled_concurrent_children_do_not_corrupt_context():
 
 
 def test_sample_ratio_zero_records_nothing():
-    tracer = make_tracer(sample_ratio=0.0)
+    # tail_enabled=False: this pins the pure HEAD-sampling contract (with
+    # tail sampling on, an unsampled root records tentatively — covered by
+    # the tail-sampling tests below).
+    tracer = make_tracer(sample_ratio=0.0, tail_enabled=False)
     with tracer.start_trace("root") as root:
         assert not root.recording
         assert root.trace_id  # ids still propagate downstream (flag 00)
@@ -154,7 +157,9 @@ def test_sample_ratio_zero_records_nothing():
 
 
 def test_sample_ratio_is_respected():
-    tracer = make_tracer(sample_ratio=0.5, rng=random.Random(42))
+    tracer = make_tracer(
+        sample_ratio=0.5, rng=random.Random(42), tail_enabled=False
+    )
     recorded = sum(
         1 for _ in range(200) if tracer.start_trace("t").recording
     )
@@ -301,3 +306,133 @@ def test_current_trace_id_inside_span():
     with tracer.start_trace("root") as root:
         assert tracing.current_trace_id() == root.trace_id
     assert tracing.current_trace_id() is None
+
+
+# ------------------------------------------------------- tail-based sampling
+# Head sampling's coin flip said NO, but the trace turned out to matter:
+# error status, a typed limit.violation event, or a slow root. Those traces
+# are kept anyway (recorded tentatively, retained at the root's finish) —
+# the flight recorder that makes a batched dispatch's one bad request
+# reconstructible at 1% head ratios. Ordinary unsampled traces still drop.
+
+
+class FakeClock:
+    """Injectable clock/walltime pair for deterministic duration tests."""
+
+    def __init__(self) -> None:
+        self.now = 1000.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+def make_tail_tracer(**kwargs):
+    kwargs.setdefault("sample_ratio", 0.0)  # head sampling always says no
+    kwargs.setdefault("tail_slow_seconds", 5.0)
+    return make_tracer(**kwargs)
+
+
+def test_tail_drops_ordinary_unsampled_traces():
+    tracer = make_tail_tracer()
+    with tracer.start_trace("root"):
+        with tracer.span("child"):
+            pass
+    assert len(tracer.ring) == 0
+    assert tracer._tentative == {}  # nothing buffered after the decision
+
+
+def test_tail_keeps_error_traces_with_all_their_spans():
+    tracer = make_tail_tracer()
+    try:
+        with tracer.start_trace("root") as root:
+            trace_id = root.trace_id
+            with tracer.span("scheduler.queue_wait"):
+                pass
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    spans = tracer.ring.trace(trace_id)
+    assert {s["name"] for s in spans} == {"root", "scheduler.queue_wait"}
+    assert all(s["attributes"]["sampled"] == "tail" for s in spans)
+    assert any(s["status"] == "error" for s in spans)
+
+
+def test_tail_keeps_violation_event_traces():
+    tracer = make_tail_tracer()
+    with tracer.start_trace("root") as root:
+        trace_id = root.trace_id
+        with tracer.span("executor.execute"):
+            tracing.add_event("limit.violation", kind="oom", lane=4)
+    spans = tracer.ring.trace(trace_id)
+    assert len(spans) == 2  # kept: the violation is exactly what to keep
+
+
+def test_tail_keeps_slow_roots():
+    clock = FakeClock()
+    tracer = make_tail_tracer(
+        clock=clock, walltime=clock, tail_slow_seconds=2.0
+    )
+    with tracer.start_trace("root") as root:
+        trace_id = root.trace_id
+        clock.advance(3.0)
+    assert len(tracer.ring.trace(trace_id)) == 1
+    # ...and a fast clean root still drops.
+    with tracer.start_trace("root2") as root2:
+        clock.advance(0.5)
+    assert tracer.ring.trace(root2.trace_id) == []
+
+
+def test_tail_respects_upstream_unsampled_flag():
+    # An incoming flag-00 traceparent is an upstream DECISION, not a local
+    # coin flip — tail sampling must not override it (W3C restart rule).
+    tracer = make_tail_tracer()
+    header = format_traceparent(TRACE_ID, SPAN_ID, False)
+    with tracer.start_trace("root", traceparent=header) as root:
+        assert not root.recording
+
+
+def test_tail_buffer_is_bounded():
+    tracer = make_tail_tracer()
+    roots = [tracer.start_trace(f"r{i}") for i in range(tracer.TAIL_MAX_TRACES + 8)]
+    tentative = sum(1 for r in roots if r.recording)
+    assert tentative == tracer.TAIL_MAX_TRACES  # overflow falls back to drop
+    for root in roots:
+        with root:
+            pass
+    assert tracer._tentative == {}
+
+
+def test_tail_disabled_restores_head_only_behavior():
+    tracer = make_tail_tracer(tail_enabled=False)
+    try:
+        with tracer.start_trace("root") as root:
+            assert not root.recording
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert len(tracer.ring) == 0
+
+
+def test_tail_keeps_root_when_span_buffer_overflows():
+    """The root exports OUTSIDE the span-buffer cap: a busy slow request
+    that accumulates > TAIL_MAX_SPANS children before its root finishes is
+    exactly the tail-keep target, and a kept trace without its root would
+    have no duration and no tree anchor (found in review)."""
+    tracer = make_tail_tracer()
+    try:
+        with tracer.start_trace("root") as root:
+            trace_id = root.trace_id
+            for i in range(tracer.TAIL_MAX_SPANS + 16):
+                with tracer.span(f"child-{i}"):
+                    pass
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    spans = tracer.ring.trace(trace_id)
+    # The cap held for children, but the root itself is among the exports
+    # (it lands last, so the bounded ring retains it).
+    assert any(s["name"] == "root" and s["status"] == "error" for s in spans)
+    assert tracer._tentative == {}
