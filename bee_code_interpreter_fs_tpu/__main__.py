@@ -64,6 +64,13 @@ async def main(ctx: ApplicationContext | None = None) -> None:
     # at batch priority behind the pool fill and yields to any real work —
     # by the first user request, the hot kernels are compile-once fleet-wide.
     ctx.code_executor.start_compile_cache_prewarm()
+    # Telemetry plane: the device-health probe daemon (healthy/busy/suspect/
+    # wedged per host, surfaced on /statusz and the device_health_state
+    # gauge) and, when APP_OTLP_ENDPOINT is set, the OTLP exporter that
+    # finally ships traces and metric snapshots out of the process.
+    ctx.device_health.start()
+    if ctx.otlp_exporter is not None:
+        ctx.otlp_exporter.start()
 
     try:
         stop_task = asyncio.create_task(stop.wait())
@@ -99,7 +106,13 @@ async def main(ctx: ApplicationContext | None = None) -> None:
             grpc_task.cancel()
             with contextlib.suppress(asyncio.CancelledError):
                 await grpc_task
+        # Probe before executor close (it walks the executor's host
+        # inventory); OTLP last so the shutdown's own spans make the final
+        # flush.
+        await ctx.device_health.stop()
         await ctx.code_executor.close()
+        if ctx.otlp_exporter is not None:
+            await ctx.otlp_exporter.close()
         await runner.cleanup()
 
 

@@ -84,6 +84,39 @@ class ApplicationContext:
         return CustomToolExecutor(self.code_executor)
 
     @cached_property
+    def device_health(self):
+        """The device-health probe daemon (services/device_health.py),
+        attached to the executor so GET /statusz can join its verdicts.
+        Construction is cheap and side-effect-free; __main__ start()s it
+        (a zero APP_DEVICE_PROBE_INTERVAL keeps it dormant)."""
+        from .services.device_health import DeviceHealthProbe
+
+        probe = DeviceHealthProbe(self.code_executor)
+        self.code_executor.device_health = probe
+        return probe
+
+    @cached_property
+    def otlp_exporter(self):
+        """OTLP/HTTP exporter (utils/otlp.py), or None — the unset
+        APP_OTLP_ENDPOINT kill switch means no exporter object exists at
+        all: zero export HTTP, no queue, no background task."""
+        if not self.config.otlp_endpoint:
+            return None
+        from .utils.otlp import OtlpExporter
+
+        exporter = OtlpExporter(
+            self.config.otlp_endpoint,
+            registry=self.metrics.registry,
+            metrics=self.metrics,
+            flush_interval=self.config.otlp_flush_interval,
+            max_queue=self.config.otlp_max_queue,
+            timeout=self.config.otlp_timeout,
+        )
+        self.tracer.add_exporter(exporter)
+        self.code_executor.otlp_exporter = exporter
+        return exporter
+
+    @cached_property
     def http_app(self):
         from .services.http_server import create_http_app
 

@@ -239,6 +239,51 @@ class Config(BaseModel):
     # (the "slow-p99" keep; a fixed threshold so the decision is
     # deterministic and testable).
     tracing_tail_slow_seconds: float = 5.0
+    # -- device-health probing (services/device_health.py) -------------------
+    # The probe daemon samples every live sandbox host's GET /device-stats
+    # on this cadence and classifies each host healthy/busy/suspect/wedged.
+    # 0 disables the daemon entirely (no probe HTTP anywhere).
+    device_probe_interval: float = 15.0
+    # Per-host HTTP budget for one probe. A host that cannot answer a
+    # trivial stats read inside this window counts a probe failure (the
+    # unreachable path of the classifier).
+    device_probe_timeout: float = 3.0
+    # How long a device attach (warm-up: jax import + libtpu init) may
+    # legitimately run before the host turns suspect. MUST exceed
+    # executor_warm_ready_timeout (600s): that timeout deliberately
+    # tolerates a first-ever TPU init of many minutes, and a probe that
+    # pages (and, once fencing lands, disposes) a host mid-legitimate-init
+    # would recreate the kill-retry spiral the generous warm timeout
+    # exists to avoid. The wedge signature is an attach STILL pending
+    # long past every legitimate budget (observed wedges block 25-76 min).
+    device_probe_attach_budget: float = 900.0
+    # Grace beyond a device op's own declared timeout before the host turns
+    # suspect: the executor kills on timeout itself, so an op outliving
+    # timeout + grace means the kill machinery is stuck too.
+    device_probe_op_grace: float = 60.0
+    # How long a host must stay past its budget (attach, op, or reachability)
+    # before suspect escalates to wedged. The wedge verdict fires
+    # device_wedge_detected_total and marks the host for the fencing layer —
+    # detection only; dispose/fence actuation is the fencing PR's job.
+    device_probe_wedge_after: float = 120.0
+    # Max distinct hosts exported with their own `host` label on
+    # device_health_state; past the cap all series collapse to lane-level
+    # (host="_overflow") so a large fleet cannot explode label cardinality.
+    device_probe_max_host_labels: int = 64
+    # -- OTLP export (utils/otlp.py) ------------------------------------------
+    # OTLP/HTTP JSON collector base URL (spans POST to <endpoint>/v1/traces,
+    # metric snapshots to <endpoint>/v1/metrics). Empty = the kill switch:
+    # no exporter is created and no export HTTP ever happens.
+    otlp_endpoint: str = ""
+    # Seconds between export flushes (each flush ships the span batch queued
+    # since the last one plus one metrics snapshot).
+    otlp_flush_interval: float = 10.0
+    # Bounded span queue between the tracer and the wire: when exports fall
+    # behind, the NEWEST spans drop and otlp_dropped_total counts them —
+    # backpressure must never grow the heap or stall the traced path.
+    otlp_max_queue: int = 4096
+    # Per-flush HTTP timeout against the collector.
+    otlp_timeout: float = 5.0
     # -- sandbox resource governance (services/limits.py) --------------------
     # Kill switch for the whole governance subsystem: 0 restores the
     # pre-governance behavior (no limits payload on requests, no APP_LIMIT_*

@@ -26,6 +26,19 @@ on in any deployment (``APP_EXECUTOR_FAULT_SPEC=spawn_fail:0.3,seed:7``):
                          disposal) — kind set by violation_kind
     violation_kind:<kind> which violation to inject (default oom; one of
                          services.limits.VIOLATION_KINDS)
+    attach_hang:<rate>   probability a HOST develops a wedged device attach
+                         (drawn once per host, at its first GET
+                         /device-stats): from then on its stats report an
+                         attach pending whose age grows in real time and a
+                         stale runner heartbeat — a HANG, not an error,
+                         which is the real wedge semantics (BENCH_r03-r05:
+                         attaches block for tens of minutes; they do not
+                         fail). Drives the probe daemon's
+                         healthy→suspect→wedged escalation deterministically.
+    attach_hang_lane:<n> restrict attach_hang to hosts of ONE chip-count
+                         lane (-1 = any lane, the default) — the chaos e2e
+                         wedges one lane while proving the other keeps
+                         serving.
     seed:<int>           the plan seed (default 0)
 
 Rates are in [0, 1]; delays are seconds. Unknown keys fail loudly — a typo'd
@@ -37,6 +50,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import time
 from collections.abc import Callable
 from dataclasses import dataclass, fields
 
@@ -53,6 +67,7 @@ RESET_FAIL = "reset_fail"
 DELETE_HANG = "delete_hang"
 EXEC_DROP = "exec_drop"
 VIOLATION = "violation"
+ATTACH_HANG = "attach_hang"
 
 
 @dataclass(frozen=True)
@@ -64,6 +79,8 @@ class FaultSpec:
     exec_drop: float = 0.0
     violation: float = 0.0
     violation_kind: str = "oom"
+    attach_hang: float = 0.0
+    attach_hang_lane: int = -1
     seed: int = 0
 
     @classmethod
@@ -84,7 +101,7 @@ class FaultSpec:
                     f"{sorted(known)} as key:value"
                 )
             try:
-                if key == "seed":
+                if key in ("seed", "attach_hang_lane"):
                     values[key] = int(raw)
                 elif key == "violation_kind":
                     values[key] = raw.strip()
@@ -95,7 +112,7 @@ class FaultSpec:
                     f"bad fault spec value for {key}: {raw!r}"
                 ) from None
         spec = cls(**values)
-        for name in (SPAWN_FAIL, RESET_FAIL, EXEC_DROP, VIOLATION):
+        for name in (SPAWN_FAIL, RESET_FAIL, EXEC_DROP, VIOLATION, ATTACH_HANG):
             rate = getattr(spec, name)
             if not 0.0 <= rate <= 1.0:
                 raise ValueError(f"fault rate {name} must be in [0,1]: {rate}")
@@ -114,7 +131,7 @@ class FaultSpec:
         return any(
             getattr(self, f.name)
             for f in fields(self)
-            if f.name not in ("seed", "violation_kind")
+            if f.name not in ("seed", "violation_kind", "attach_hang_lane")
         )
 
 
@@ -166,6 +183,94 @@ class ViolationTransport(httpx.AsyncBaseTransport):
                 "runner_restarted": killed,
             }
             return httpx.Response(200, json=body, request=request)
+        return await self.inner.handle_async_request(request)
+
+    async def aclose(self) -> None:
+        await self.inner.aclose()
+
+
+class AttachHangTransport(httpx.AsyncBaseTransport):
+    """httpx transport that gives a seeded subset of hosts a wedged device
+    attach, as seen through ``GET /device-stats``: once a host is chosen
+    (one draw at its first stats probe; optionally restricted to one lane),
+    every later probe of that host gets a synthesized body whose
+    ``attach_pending_s`` grows in REAL time from the moment the hang
+    started, with a matching stale runner heartbeat. A hang, not an error —
+    the executor's HTTP plane stays perfectly responsive while the device
+    plane silently stops, which is exactly the BENCH_r03-r05 wedge the
+    probe daemon must distinguish from ordinary busy/attaching states.
+    Everything except /device-stats passes through untouched (detection is
+    this PR's scope; the data plane keeps serving)."""
+
+    def __init__(
+        self,
+        rate: float,
+        lane: int,
+        rng: random.Random,
+        host_lanes: dict[str, int],
+        on_fault: Callable[[str], None] | None = None,
+        inner: httpx.AsyncBaseTransport | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.rate = rate
+        self.lane = lane
+        self.rng = rng
+        # "host:port" -> chip-count lane, recorded by the backend at spawn:
+        # the lane restriction must hold even though a URL alone says
+        # nothing about topology.
+        self.host_lanes = host_lanes
+        self.on_fault = on_fault
+        self.inner = inner or httpx.AsyncHTTPTransport()
+        self.clock = clock
+        # "host:port" -> hang start (clock), or None for hosts that drew a
+        # pass. One draw per host, remembered forever — a wedge does not
+        # flicker.
+        self._hangs: dict[str, float | None] = {}
+
+    def _hang_started(self, request) -> float | None:
+        key = f"{request.url.host}:{request.url.port}"
+        if key not in self._hangs:
+            lane = self.host_lanes.get(key)
+            eligible = self.lane < 0 or (lane is not None and lane == self.lane)
+            wedged = eligible and self.rng.random() < self.rate
+            self._hangs[key] = self.clock() if wedged else None
+            if wedged and self.on_fault is not None:
+                self.on_fault(ATTACH_HANG)
+        return self._hangs[key]
+
+    async def handle_async_request(self, request):
+        if (
+            request.method == "GET"
+            and request.url.path == "/device-stats"
+        ):
+            started = self._hang_started(request)
+            if started is not None:
+                age = max(0.0, self.clock() - started)
+                body = {
+                    "status": "ok",
+                    "warm": False,
+                    "warm_state": "pending",
+                    "backend": "none",
+                    "device_kind": "",
+                    "device_count": 0,
+                    "num_hosts": 1,
+                    "uptime_s": age,
+                    # THE wedge signature: an attach that has been pending
+                    # for `age` seconds and counting, no runner heartbeat.
+                    "attach_pending_s": age,
+                    "attach_seconds": -1.0,
+                    "op_in_flight": False,
+                    "op_age_s": 0.0,
+                    "op_timeout_s": 0.0,
+                    "last_device_op_age_s": -1.0,
+                    "runner_heartbeat_age_s": age,
+                    "runner_alive": False,
+                    "runner_pid": 0,
+                    "rss_bytes": -1,
+                    "runner_rss_bytes": -1,
+                    "injected": ATTACH_HANG,
+                }
+                return httpx.Response(200, json=body, request=request)
         return await self.inner.handle_async_request(request)
 
     async def aclose(self) -> None:
@@ -227,8 +332,12 @@ class FaultInjectingBackend(SandboxBackend):
                 DELETE_HANG,
                 EXEC_DROP,
                 VIOLATION,
+                ATTACH_HANG,
             )
         }
+        # "host:port" -> lane, recorded at spawn so the attach-hang
+        # transport can honor a lane restriction.
+        self._host_lanes: dict[str, int] = {}
         if spec.active:
             logger.warning("fault injection ACTIVE: %s", spec)
 
@@ -266,7 +375,12 @@ class FaultInjectingBackend(SandboxBackend):
         if self.spec.slow_ready > 0.0:
             self._fire(SLOW_READY, 1.0)  # counted, never skipped
             await asyncio.sleep(self.spec.slow_ready)
-        return await self.inner.spawn(chip_count)
+        sandbox = await self.inner.spawn(chip_count)
+        if self.spec.attach_hang > 0.0:
+            for url in sandbox.host_urls:
+                parsed = httpx.URL(url)
+                self._host_lanes[f"{parsed.host}:{parsed.port}"] = chip_count
+        return sandbox
 
     def pool_capacity(self, chip_count: int) -> int | None:
         capacity_fn = getattr(self.inner, "pool_capacity", None)
@@ -302,6 +416,15 @@ class FaultInjectingBackend(SandboxBackend):
                 self.spec.violation,
                 self.spec.violation_kind,
                 self._rngs[VIOLATION],
+                self.on_fault,
+                inner=transport,
+            )
+        if self.spec.attach_hang > 0.0:
+            transport = AttachHangTransport(
+                self.spec.attach_hang,
+                self.spec.attach_hang_lane,
+                self._rngs[ATTACH_HANG],
+                self._host_lanes,
                 self.on_fault,
                 inner=transport,
             )

@@ -206,6 +206,12 @@ class CodeExecutor:
         self._in_use: dict[int, int] = {}
         # executor_id -> live session (sandbox held out of the pool).
         self._sessions: dict[str, _Session] = {}
+        # EVERY live sandbox (pooled, in-use, session-parked), keyed by id:
+        # the device-health probe's host inventory. Registered the moment a
+        # spawn succeeds, dropped in _dispose — the in-use window is where
+        # wedges actually happen (a mid-device-op kill), so probing only
+        # the pool would miss the exact hosts that matter.
+        self._live_sandboxes: dict[str, tuple[int, Sandbox]] = {}
         # Sandboxes held by sessions, per lane: they occupy physical TPU
         # slots (capacity accounting) but are NOT due back soon, so they are
         # tracked apart from _in_use (which waiters treat as imminent supply).
@@ -254,6 +260,11 @@ class CodeExecutor:
         # LocalSandboxBackend._fresh_cache_epoch). Pre-warm runs before
         # tenant load, so the store still fills in the trusted-only epoch.
         self._shared_cache_tainted = False
+        # Telemetry-plane attachments (set by the application context): the
+        # device-health probe daemon and the OTLP exporter, surfaced through
+        # GET /statusz. Optional — the executor runs fine without either.
+        self.device_health = None
+        self.otlp_exporter = None
         # One persistent client for all sandbox HTTP: connection pooling
         # keeps per-request TCP setup off the Execute path.
         self._client: httpx.AsyncClient | None = None
@@ -434,7 +445,7 @@ class CodeExecutor:
                     chip_count, traced_seed=False
                 )
                 if self._closed:
-                    await self.backend.delete(sandbox)
+                    await self._dispose(sandbox)
                 else:
                     pool.append(sandbox)
             except SandboxSpawnError:
@@ -498,6 +509,9 @@ class CodeExecutor:
             # Feed the scheduler's spawn-latency EWMA: one input to
             # deadline-aware admission when the warm pool is empty.
             self.scheduler.observe_spawn(chip_count, elapsed)
+            # Register with the live-host inventory the probe daemon walks
+            # (dropped again in _dispose).
+            self._live_sandboxes[sandbox.id] = (chip_count, sandbox)
             # Seed the fleet's hot compile set into the fresh sandbox's
             # cache dir BEFORE it serves: the kernels someone already
             # compiled load from cache instead of recompiling. Best-effort
@@ -2865,12 +2879,81 @@ class CodeExecutor:
                 self.fill_pool_soon(lane)
 
     async def _dispose(self, sandbox: Sandbox) -> None:
+        self._live_sandboxes.pop(sandbox.id, None)
         try:
             await self.backend.delete(sandbox)
         except Exception:  # noqa: BLE001
             logger.exception("failed to delete sandbox %s", sandbox.id)
 
     # ----------------------------------------------------------------- admin
+
+    def live_hosts(self) -> list[tuple[int, Sandbox]]:
+        """Every live sandbox with its lane — the device-health probe's
+        inventory. Pooled, in-use, and session-parked sandboxes alike: the
+        in-use ones are where mid-device-op wedges actually happen."""
+        return list(self._live_sandboxes.values())
+
+    def live_sandbox(self, sandbox_id: str) -> tuple[int, Sandbox] | None:
+        """(lane, sandbox) for a live id, or None once disposed."""
+        return self._live_sandboxes.get(sandbox_id)
+
+    def statusz(self) -> dict:
+        """The consolidated operator snapshot behind GET /statusz: one JSON
+        joining what previously took a Prometheus query, a /healthz read,
+        N sandbox ssh sessions, and the onchip_watch.sh grep loop — lanes
+        (queue pressure, pool depth, occupancy, breaker), hosts with their
+        device-health verdicts, sessions, compile-cache store state, and
+        the telemetry plane's own health (probe liveness, OTLP backlog)."""
+        lanes: dict[str, dict] = {}
+        lane_ids = (
+            set(self._pools)
+            | set(self._in_use)
+            | set(self._session_held)
+            | set(self._spawning)
+        )
+        detail = self.scheduler.lane_detail()
+        lane_ids |= {int(lane) for lane in detail}
+        breaker_states = self.breakers.states()
+        for lane in sorted(lane_ids):
+            entry: dict = {
+                "pool_depth": len(self._pools.get(lane, ())),
+                "in_use": self._in_use.get(lane, 0),
+                "session_held": self._session_held.get(lane, 0),
+                "spawning": self._spawning.get(lane, 0),
+                "breaker": breaker_states.get(lane, "closed"),
+            }
+            entry.update(detail.get(str(lane), {}))
+            lanes[str(lane)] = entry
+        status = "ok"
+        if self._draining:
+            status = "draining"
+        elif self.degraded():
+            status = "degraded"
+        body: dict = {
+            "status": status,
+            "inflight": self.inflight(),
+            "lanes": lanes,
+            "sessions": self.list_sessions(),
+            "batching": {
+                "enabled": self.batcher is not None,
+                "window_ms": self.config.batch_window_ms,
+                "max_jobs": self.config.batch_max_jobs,
+            },
+            "compile_cache": {
+                "enabled": self.compile_cache.enabled,
+                "entries": self.compile_cache.entry_count(),
+                "bytes": self.compile_cache.total_bytes(),
+            },
+        }
+        if self.device_health is not None:
+            body["device_health"] = self.device_health.snapshot()
+        else:
+            body["device_health"] = {"enabled": False}
+        if self.otlp_exporter is not None:
+            body["otlp"] = {"enabled": True, **self.otlp_exporter.stats()}
+        else:
+            body["otlp"] = {"enabled": False}
+        return body
 
     async def sweep_pool_health(self) -> int:
         """Probe every pooled sandbox's /healthz and dispose the
@@ -3132,6 +3215,7 @@ class CodeExecutor:
         self._sessions.clear()
         self._session_held.clear()
         await asyncio.gather(*(self._dispose(s) for s in sandboxes))
+        self._live_sandboxes.clear()
         # The hot set survives restarts through the persisted index (the
         # per-harvest saves make this a formality, but a clean shutdown
         # should never depend on the last harvest having had new entries).

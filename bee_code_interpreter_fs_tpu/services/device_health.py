@@ -1,0 +1,470 @@
+"""Device-health probe daemon: the detection half of wedge recovery.
+
+The repo's own bench history (BENCH_r03–r05) records the production failure
+mode this module exists for: a TPU attach blocking 50–76 minutes after a
+mid-device-op SIGKILL, with ``/healthz`` answering "ok" the whole time —
+nothing distinguished *busy* from *wedged*, and the recovery story was an
+operator ssh-ing into a watcher script (``scripts/onchip_watch.sh``). The
+ROADMAP's fencing item needs observation before it can get actuation; this
+daemon is that observation layer. **Detection only**: a ``wedged`` verdict
+marks the host (``sandbox.meta["device_health"]``), fires
+``device_wedge_detected_total``, and records a transition trace — the
+drain/dispose/fence actuation belongs to the fencing PR this de-risks.
+
+Mechanics: every ``APP_DEVICE_PROBE_INTERVAL`` seconds, one cycle samples
+``GET /device-stats`` on every live sandbox host (the executor's registry —
+pooled, in-use, and session-parked sandboxes alike) and classifies each
+host into a typed state:
+
+- ``healthy`` — reachable, no device op in flight, nothing stalled.
+- ``busy``    — an attach or device op is running inside its budget.
+- ``suspect`` — something is past its budget (attach older than
+  ``APP_DEVICE_PROBE_ATTACH_BUDGET``, an op older than its own declared
+  timeout plus ``APP_DEVICE_PROBE_OP_GRACE``, or the host stopped answering
+  probes) but not yet long enough to call dead.
+- ``wedged``  — the stall has persisted ``APP_DEVICE_PROBE_WEDGE_AFTER``
+  seconds past the budget: the device plane stopped making progress and no
+  in-band mechanism is going to unstick it.
+
+Ages come from the executor server's own monotonic clock (``/device-stats``
+reports ages, not timestamps), so no cross-host clock math happens here.
+Transitions touching suspect/wedged — entering trouble or recovering from
+it; routine healthy<->busy flips stay silent — emit a
+``device_health.transition`` span into the trace ring (recorded at ANY
+sampling ratio — such transitions are rare and exactly what an operator
+pulls up after an incident; only the tracing kill switch drops them, along
+with the whole /traces surface) and the state surface feeds ``/statusz``,
+the
+``device_health_state`` gauge (host labels capped —
+``APP_DEVICE_PROBE_MAX_HOST_LABELS`` — past which series aggregate per
+lane), and the OTLP metrics export.
+
+The probe daemon is itself observable: ``device_probe_last_poll_age_seconds``
+and ``code_interpreter_device_probe_cycle_seconds`` expose a stalled or
+slow probe loop (a wedge nobody is probing for is invisible).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+
+import httpx
+
+from ..utils import tracing
+
+logger = logging.getLogger(__name__)
+
+HEALTHY = "healthy"
+BUSY = "busy"
+SUSPECT = "suspect"
+WEDGED = "wedged"
+STATES = (HEALTHY, BUSY, SUSPECT, WEDGED)
+
+# Severity order for "did this transition get worse?" decisions.
+_SEVERITY = {state: i for i, state in enumerate(STATES)}
+
+
+@dataclass
+class HostHealth:
+    """One probed host's current classification and supporting evidence."""
+
+    lane: int
+    sandbox_id: str
+    host: str
+    state: str = HEALTHY
+    since: float = 0.0  # probe clock: when `state` was entered
+    reason: str = ""  # which signal produced the state
+    stall_s: float = 0.0  # seconds past budget (suspect/wedged evidence)
+    failures: int = 0  # consecutive probe failures
+    last_success: float | None = None  # probe clock
+    first_failure: float | None = None
+    legacy: bool = False  # old executor binary: no /device-stats route
+    stats: dict = field(default_factory=dict)  # last good /device-stats body
+
+    def snapshot(self) -> dict:
+        """The /statusz row for this host."""
+        row = {
+            "lane": self.lane,
+            "sandbox": self.sandbox_id,
+            "host": self.host,
+            "state": self.state,
+            "reason": self.reason,
+            "stall_s": round(self.stall_s, 3),
+            "probe_failures": self.failures,
+        }
+        if self.legacy:
+            row["legacy"] = True
+        stats = self.stats
+        if stats:
+            row["device_count"] = stats.get("device_count")
+            row["device_kind"] = stats.get("device_kind") or stats.get(
+                "backend"
+            )
+            row["warm_state"] = stats.get("warm_state")
+            row["op_in_flight"] = bool(stats.get("op_in_flight"))
+            row["attach_seconds"] = stats.get("attach_seconds")
+            row["rss_bytes"] = stats.get("rss_bytes")
+            row["runner_rss_bytes"] = stats.get("runner_rss_bytes")
+            row["last_device_op_age_s"] = stats.get("last_device_op_age_s")
+        return row
+
+
+class DeviceHealthProbe:
+    """Samples every live sandbox host and keeps the typed state machine.
+
+    ``executor`` supplies the host inventory (``live_hosts()``) and the
+    HTTP client (which carries the chaos backend's fault transport — the
+    attach-hang injection reaches the probe exactly the way a real wedged
+    host would). ``clock`` is injectable for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        executor,
+        *,
+        config=None,
+        metrics=None,
+        tracer=None,
+        clock=time.monotonic,
+        walltime=time.time,
+    ) -> None:
+        self.executor = executor
+        self.config = config or executor.config
+        self.metrics = metrics or executor.metrics
+        self.tracer = tracer or executor.tracer
+        self.clock = clock
+        self.walltime = walltime
+        self.interval = max(0.0, self.config.device_probe_interval)
+        self.timeout = max(0.1, self.config.device_probe_timeout)
+        self.attach_budget = max(0.0, self.config.device_probe_attach_budget)
+        self.op_grace = max(0.0, self.config.device_probe_op_grace)
+        self.wedge_after = max(0.0, self.config.device_probe_wedge_after)
+        self.max_host_labels = max(1, self.config.device_probe_max_host_labels)
+        self._hosts: dict[str, HostHealth] = {}
+        self._task: asyncio.Task | None = None
+        self._closed = False
+        self._last_cycle_end: float | None = None
+        self._cycles = 0
+        self.metrics.bind_device_health(self)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> asyncio.Task | None:
+        """Run probe cycles on the configured cadence until stop().
+        interval == 0 disables the daemon (returns None, no task)."""
+        if self.interval <= 0 or self._task is not None:
+            return self._task
+
+        async def loop() -> None:
+            # Probe work must never attach spans/events to whatever request
+            # context was current when start() ran.
+            tracing.current_span_var.set(None)
+            # Probe first, then sleep: the daemon's first verdicts exist
+            # one cycle after start, not one interval later — a wedge
+            # present at boot is visible immediately.
+            while not self._closed:
+                try:
+                    await self.probe_once()
+                except Exception:  # noqa: BLE001 — keep probing
+                    logger.exception("device-health probe cycle failed")
+                await asyncio.sleep(self.interval)
+
+        self._task = asyncio.get_running_loop().create_task(loop())
+        return self._task
+
+    async def stop(self) -> None:
+        """Stop the probe loop. Restart-safe: a later start() begins a
+        fresh loop (the overhead bench toggles the daemon A/B on one live
+        stack)."""
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        self._closed = False
+
+    # ----------------------------------------------------------- probe cycle
+
+    async def probe_once(self) -> dict[str, str]:
+        """One full cycle: sample every live host, classify, prune hosts
+        that no longer exist. Returns {host_url: state} for tests."""
+        start = self.clock()
+        targets: list[tuple[int, object, str]] = []
+        seen: set[str] = set()
+        for lane, sandbox in self.executor.live_hosts():
+            for url in sandbox.host_urls:
+                if url in seen:
+                    continue  # one sandbox can be re-pooled, not re-probed
+                seen.add(url)
+                targets.append((lane, sandbox, url))
+        await asyncio.gather(
+            *(self._probe_host(lane, sandbox, url) for lane, sandbox, url in targets)
+        )
+        # A disposed sandbox's host must leave the table (and the gauge) —
+        # a wedged verdict on a host that no longer exists is stale noise.
+        for url in list(self._hosts):
+            if url not in seen:
+                del self._hosts[url]
+        elapsed = max(0.0, self.clock() - start)
+        self._last_cycle_end = self.clock()
+        self._cycles += 1
+        self.metrics.device_probe_cycle_seconds.observe(elapsed)
+        return {url: h.state for url, h in self._hosts.items()}
+
+    async def _probe_host(self, lane: int, sandbox, url: str) -> None:
+        health = self._hosts.get(url)
+        if health is None:
+            health = HostHealth(
+                lane=lane,
+                sandbox_id=getattr(sandbox, "id", ""),
+                host=url,
+                since=self.clock(),
+            )
+            self._hosts[url] = health
+        else:
+            # The same URL can be a recycled sandbox in a new role.
+            health.lane = lane
+            health.sandbox_id = getattr(sandbox, "id", health.sandbox_id)
+        stats: dict | None = None
+        legacy = False
+        try:
+            resp = await self.executor._http_client().get(
+                f"{url}/device-stats", timeout=self.timeout
+            )
+            if resp.status_code == 404:
+                legacy = True  # old binary: no stats route, but it answered
+            elif resp.status_code == 200:
+                body = resp.json()
+                if isinstance(body, dict):
+                    stats = body
+        except (httpx.HTTPError, ValueError):
+            stats = None
+        now = self.clock()
+        if stats is None and not legacy:
+            health.failures += 1
+            if health.first_failure is None:
+                health.first_failure = now
+            state, reason, stall = self._classify_unreachable(health, now)
+        else:
+            health.failures = 0
+            health.first_failure = None
+            health.last_success = now
+            health.legacy = legacy
+            if legacy:
+                # Can't see the device plane on an old binary; reachable is
+                # all the evidence there is.
+                state, reason, stall = HEALTHY, "legacy_binary", 0.0
+            else:
+                health.stats = stats
+                state, reason, stall = self._classify(stats)
+        self._apply(health, state, reason, stall, now)
+
+    # -------------------------------------------------------- classification
+
+    def _classify(self, stats: dict) -> tuple[str, str, float]:
+        """Map one /device-stats body to (state, reason, stall seconds).
+        `stall` is how far past its budget the slowest signal is — suspect
+        at 0, wedged once it persists `wedge_after`."""
+
+        def age(key: str) -> float:
+            value = stats.get(key)
+            return float(value) if isinstance(value, (int, float)) else 0.0
+
+        # Attach (warm-up: jax import + libtpu init + device enumeration)
+        # in flight: legitimate for minutes, wedged when it outlives the
+        # budget — THE historical failure signature (BENCH_r03-r05).
+        # warm_state "pending" alone counts too: an attach observed at age
+        # zero is still an attach.
+        attach_pending = age("attach_pending_s")
+        if attach_pending > 0 or stats.get("warm_state") == "pending":
+            stall = attach_pending - self.attach_budget
+            if stall >= self.wedge_after:
+                return WEDGED, "attach_stalled", stall
+            if stall >= 0:
+                return SUSPECT, "attach_over_budget", stall
+            return BUSY, "attaching", 0.0
+        # Device op in flight: budget is the op's OWN declared timeout plus
+        # grace for the executor's kill/collect machinery. An op past that
+        # means the timeout kill itself is stuck — the wedge, not the work.
+        if stats.get("op_in_flight"):
+            op_age = age("op_age_s")
+            budget = age("op_timeout_s") + self.op_grace
+            stall = op_age - budget
+            if stall >= self.wedge_after:
+                return WEDGED, "device_op_stalled", stall
+            if stall >= 0:
+                return SUSPECT, "device_op_over_budget", stall
+            return BUSY, "device_op", 0.0
+        if stats.get("warm_state") == "failed":
+            # Warm-up failed: the host serves cold (or is about to be
+            # disposed) — not wedged, but not healthy either.
+            return SUSPECT, "warm_failed", 0.0
+        if stats.get("warm_state") == "ready" and stats.get("runner_alive") is False:
+            # The warm runner died SILENTLY while idle (OOM kill between
+            # requests — the executor's waitid peek exposes the corpse):
+            # the host would serve its next request cold and lose any
+            # session state. Suspect, not wedged: the executor restarts
+            # the runner in the background at next use.
+            return SUSPECT, "runner_dead", 0.0
+        # NOTE: runner_heartbeat_age_s is deliberately NOT thresholded
+        # while the host is idle — an idle runner legitimately says
+        # nothing for hours. Its age is meaningful evidence only inside
+        # an attach or op window, where the attach/op stall rules above
+        # already bound the same silence.
+        return HEALTHY, "", 0.0
+
+    def _classify_unreachable(
+        self, health: HostHealth, now: float
+    ) -> tuple[str, str, float]:
+        """A host that stopped answering the stats probe entirely: suspect
+        immediately, wedged once it has been dark past the wedge threshold.
+        The baseline is the last successful probe (or the first failure for
+        a host that never answered)."""
+        base = (
+            health.last_success
+            if health.last_success is not None
+            else health.first_failure
+        )
+        stall = max(0.0, now - (base if base is not None else now))
+        if stall >= self.wedge_after:
+            return WEDGED, "unreachable", stall
+        return SUSPECT, "unreachable", stall
+
+    # ------------------------------------------------------------ transition
+
+    def _apply(
+        self, health: HostHealth, state: str, reason: str, stall: float, now: float
+    ) -> None:
+        health.reason = reason
+        health.stall_s = max(0.0, stall)
+        previous = health.state
+        if state == previous:
+            self._mark_sandbox(health)
+            return
+        health.state = state
+        health.since = now
+        self._mark_sandbox(health)
+        # healthy<->busy flips are NORMAL OPERATION (every probe cycle that
+        # catches a host mid-op produces one): they update state silently.
+        # Only transitions touching suspect/wedged — entering trouble or
+        # recovering from it — are incidents worth a log line and a span;
+        # anything louder floods the log and evicts real request traces
+        # from the ring under ordinary load.
+        interesting = (
+            _SEVERITY[state] >= _SEVERITY[SUSPECT]
+            or _SEVERITY[previous] >= _SEVERITY[SUSPECT]
+        )
+        if not interesting:
+            logger.debug(
+                "device health: %s (lane=%d) %s -> %s",
+                health.host,
+                health.lane,
+                previous,
+                state,
+            )
+            return
+        logger.log(
+            logging.WARNING if _SEVERITY[state] > _SEVERITY[previous] else logging.INFO,
+            "device health: %s (lane=%d, sandbox=%s) %s -> %s (%s, stall=%.1fs)",
+            health.host,
+            health.lane,
+            health.sandbox_id,
+            previous,
+            state,
+            reason or "recovered",
+            health.stall_s,
+        )
+        # Suspect/wedged transitions are rare and exactly what an incident
+        # review pulls up: record_span bypasses head sampling (a fresh
+        # trace id, zero-duration span), so they are retrievable via
+        # /traces at ANY sample ratio. Only the tracing kill switch
+        # (APP_TRACING_ENABLED=0) drops them — it disables the whole
+        # /traces surface, and the wedge stays visible through the
+        # counter, /statusz, and the log line above.
+        self.tracer.record_span(
+            "device_health.transition",
+            trace_id=tracing.new_trace_id(),
+            parent_id=None,
+            start_unix=self.walltime(),
+            duration_s=0.0,
+            attributes={
+                "lane": health.lane,
+                "host": health.host,
+                "sandbox": health.sandbox_id,
+                "from": previous,
+                "to": state,
+                "reason": reason,
+                "stall_s": round(health.stall_s, 3),
+            },
+            status="error" if state == WEDGED else "ok",
+        )
+        if state == WEDGED:
+            self.metrics.device_wedges.inc(chip_count=str(health.lane))
+
+    def _mark_sandbox(self, health: HostHealth) -> None:
+        """Stamp the verdict onto the sandbox itself — the handle the
+        fencing layer (and /statusz consumers holding a Sandbox) will read.
+        Detection only: nothing here disposes or drains."""
+        entry = self.executor.live_sandbox(health.sandbox_id)
+        if entry is not None:
+            entry[1].meta["device_health"] = health.state
+
+    # -------------------------------------------------------------- surfaces
+
+    def last_poll_age(self) -> float:
+        """Seconds since the last completed cycle (-1 = never completed) —
+        the probe daemon's own liveness gauge."""
+        if self._last_cycle_end is None:
+            return -1.0
+        return max(0.0, self.clock() - self._last_cycle_end)
+
+    def gauge_samples(self) -> dict[tuple[str, ...], float]:
+        """device_health_state{lane,host,state} feed, scrape-time. Under the
+        host-label cap: one-hot per host. Past it: every series collapses
+        to lane level (host="_overflow", value = hosts of that lane in that
+        state) — the same cardinality discipline as the scheduler's tenant
+        cap, applied to hosts."""
+        hosts = list(self._hosts.values())
+        overflow = len(hosts) > self.max_host_labels
+        samples: dict[tuple[str, ...], float] = {}
+        for health in hosts:
+            host_label = "_overflow" if overflow else health.host
+            if overflow:
+                key = (str(health.lane), host_label, health.state)
+                samples[key] = samples.get(key, 0.0) + 1.0
+            else:
+                for state in STATES:
+                    key = (str(health.lane), host_label, state)
+                    samples[key] = 1.0 if state == health.state else 0.0
+        return samples
+
+    def states(self) -> dict[str, str]:
+        return {url: h.state for url, h in self._hosts.items()}
+
+    def snapshot(self) -> dict:
+        """The /statusz device-health block: per-host rows plus a state
+        census and the probe's own liveness."""
+        hosts = [h.snapshot() for h in self._hosts.values()]
+        hosts.sort(key=lambda row: (row["lane"], row["host"]))
+        census: dict[str, int] = {state: 0 for state in STATES}
+        for health in self._hosts.values():
+            census[health.state] = census.get(health.state, 0) + 1
+        return {
+            "enabled": self.interval > 0,
+            "interval_s": self.interval,
+            "thresholds": {
+                "attach_budget_s": self.attach_budget,
+                "op_grace_s": self.op_grace,
+                "wedge_after_s": self.wedge_after,
+            },
+            "cycles": self._cycles,
+            "last_poll_age_s": round(self.last_poll_age(), 3),
+            "states": census,
+            "hosts": hosts,
+        }

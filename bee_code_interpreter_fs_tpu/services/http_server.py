@@ -25,6 +25,7 @@ from pydantic import BaseModel, Field, ValidationError
 
 from ..utils import tracing
 from ..utils.logs import new_request_id, request_id_var
+from ..utils.metrics import PROMETHEUS_CONTENT_TYPE
 from ..utils.tracing import TRACE_ID_RE, Tracer
 from ..utils.validation import OBJECT_ID_RE
 from .backends.base import SandboxSpawnError
@@ -232,35 +233,58 @@ def create_http_app(
 
     @routes.get("/metrics")
     async def metrics(request: web.Request) -> web.Response:
+        # The versioned Content-Type is part of the exposition contract
+        # (Prometheus text format 0.0.4); a bare text/plain reads as an
+        # unversioned payload to strict scrapers.
         return web.Response(
-            text=code_executor.metrics.registry.render(),
-            content_type="text/plain",
-            charset="utf-8",
+            body=code_executor.metrics.registry.render().encode("utf-8"),
+            headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
         )
+
+    def paging_params(
+        request: web.Request, *, default_limit: int, max_limit: int
+    ) -> tuple[int, int]:
+        """Shared `?limit=`/`?offset=` parsing with hard caps: the trace
+        debug surfaces page through bounded responses — a full TraceRing
+        must never become one multi-megabyte reply."""
+        try:
+            limit = int(request.query.get("limit", str(default_limit)))
+            offset = int(request.query.get("offset", "0"))
+        except ValueError:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": "limit/offset must be integers"}),
+                content_type="application/json",
+            )
+        return max(0, min(limit, max_limit)), max(0, offset)
 
     @routes.get("/traces")
     async def recent_traces(request: web.Request) -> web.Response:
         """Debug surface: newest traces still in the in-memory ring
-        (trace id, root span, span count, errors). `?limit=` caps rows."""
-        try:
-            limit = int(request.query.get("limit", "20"))
-        except ValueError:
-            return bad_request("limit must be an integer")
+        (trace id, root span, span count, errors). `?limit=`/`?offset=`
+        page the list (hard cap per response)."""
+        limit, offset = paging_params(request, default_limit=20, max_limit=200)
         return web.json_response(
             {
                 "enabled": tracer.enabled,
                 "sample_ratio": tracer.sample_ratio,
-                "traces": tracer.ring.recent(limit=max(0, min(limit, 200))),
+                "limit": limit,
+                "offset": offset,
+                "traces": tracer.ring.recent(limit=limit, offset=offset),
             }
         )
 
     @routes.get("/traces/{trace_id}")
     async def get_trace(request: web.Request) -> web.Response:
         """One trace's retained spans in start order. `?format=jsonl` gets
-        the export format (one span per line) instead of the JSON tree."""
+        the export format (one span per line) instead of the JSON tree;
+        `?limit=`/`?offset=` page the span list (a 100%-sampled trace can
+        hold thousands of spans — `total_spans` says when to page)."""
         trace_id = request.match_info["trace_id"].lower()
         if not TRACE_ID_RE.match(trace_id):
             return bad_request("invalid trace id (want 32 hex chars)")
+        limit, offset = paging_params(
+            request, default_limit=500, max_limit=2000
+        )
         spans = tracer.ring.trace(trace_id)
         if not spans:
             return web.json_response(
@@ -268,12 +292,107 @@ def create_http_app(
                           "unsampled, or never existed)"},
                 status=404,
             )
+        total = len(spans)
+        page = spans[offset : offset + limit]
         if request.query.get("format") == "jsonl":
-            return web.Response(
-                text=tracer.ring.export_jsonl(trace_id),
-                content_type="application/x-ndjson",
+            # NDJSON has no envelope for paging state, so truncation rides
+            # the headers: a consumer seeing X-Total-Spans > its line count
+            # knows to page with ?offset= — the export must never LOOK
+            # complete when it isn't.
+            text = "".join(
+                json.dumps(span, sort_keys=True) + "\n" for span in page
             )
-        return web.json_response({"trace_id": trace_id, "spans": spans})
+            return web.Response(
+                text=text,
+                content_type="application/x-ndjson",
+                headers={
+                    "X-Total-Spans": str(total),
+                    "X-Limit": str(limit),
+                    "X-Offset": str(offset),
+                },
+            )
+        return web.json_response(
+            {
+                "trace_id": trace_id,
+                "total_spans": total,
+                "limit": limit,
+                "offset": offset,
+                "spans": page,
+            }
+        )
+
+    def statusz_text(body: dict) -> str:
+        """Human-readable /statusz (`?format=text`): the at-a-glance view
+        that replaces the ssh-and-grep loop onchip_watch.sh encoded."""
+        lines = [
+            f"status: {body['status']}   inflight: {body['inflight']}",
+            "",
+            "lanes:",
+        ]
+        for lane, entry in sorted(body.get("lanes", {}).items()):
+            lines.append(
+                f"  lane {lane}: pool={entry.get('pool_depth', 0)} "
+                f"in_use={entry.get('in_use', 0)} "
+                f"sessions={entry.get('session_held', 0)} "
+                f"spawning={entry.get('spawning', 0)} "
+                f"queued={entry.get('queued', 0)} "
+                f"wait_ewma={entry.get('queue_wait_ewma_s', 0.0)}s "
+                f"batch_occ={entry.get('batch_occupancy', 0.0)} "
+                f"breaker={entry.get('breaker', 'closed')}"
+            )
+        health = body.get("device_health", {})
+        lines.append("")
+        if health.get("enabled"):
+            states = health.get("states", {})
+            lines.append(
+                "device health: "
+                + " ".join(f"{k}={v}" for k, v in states.items())
+                + f"   last_poll_age={health.get('last_poll_age_s')}s"
+            )
+            for host in health.get("hosts", ()):
+                marker = "!!" if host["state"] == "wedged" else "  "
+                lines.append(
+                    f"{marker}lane {host['lane']} {host['host']} "
+                    f"[{host['state']}]"
+                    + (f" {host['reason']}" if host.get("reason") else "")
+                    + (
+                        f" stall={host['stall_s']}s"
+                        if host.get("stall_s")
+                        else ""
+                    )
+                )
+        else:
+            lines.append("device health: probe disabled")
+        cc = body.get("compile_cache", {})
+        lines.append(
+            f"compile cache: enabled={cc.get('enabled')} "
+            f"entries={cc.get('entries')} bytes={cc.get('bytes')}"
+        )
+        otlp = body.get("otlp", {})
+        if otlp.get("enabled"):
+            lines.append(
+                f"otlp: {otlp.get('endpoint')} queued={otlp.get('queued_spans')} "
+                f"exported={otlp.get('exported_spans')} "
+                f"dropped={otlp.get('dropped_spans')} "
+                f"failures={otlp.get('export_failures')}"
+            )
+        else:
+            lines.append("otlp: disabled")
+        sessions = body.get("sessions", ())
+        lines.append(f"sessions: {len(sessions)}")
+        return "\n".join(lines) + "\n"
+
+    @routes.get("/statusz")
+    async def statusz(request: web.Request) -> web.Response:
+        """Consolidated operator status: lanes (queue pressure, pool depth,
+        batch occupancy, breaker state), every live host with its
+        device-health verdict, sessions, compile-cache store stats, and
+        the telemetry plane's own health — one endpoint for the question
+        "is this fleet OK, and if not, which host is the problem?"."""
+        body = code_executor.statusz()
+        if request.query.get("format") == "text":
+            return web.Response(text=statusz_text(body))
+        return web.json_response(body)
 
     def validate_execute(req: ExecuteRequest) -> web.Response | None:
         """Shared /v1/execute + /v1/execute/stream pre-flight checks."""

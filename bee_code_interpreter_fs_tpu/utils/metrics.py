@@ -13,6 +13,11 @@ from __future__ import annotations
 import threading
 from collections.abc import Callable, Iterable
 
+# The exposition format's REQUIRED Content-Type (Prometheus text format
+# 0.0.4). A bare "text/plain" makes strict scrapers (and conformance
+# checkers) treat the payload as unversioned; GET /metrics serves this.
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
 # Buckets tuned for the quantities this service measures: sub-100ms warm-pool
 # hits through multi-second TPU cold spawns and minute-scale user code.
 DEFAULT_BUCKETS = (
@@ -68,15 +73,18 @@ class Counter:
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
-    def render(self) -> Iterable[str]:
-        yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} counter"
+    def samples(self) -> list[tuple[dict[str, str], float]]:
+        """Structured snapshot (label dict, value) — the OTLP export feed."""
         with self._lock:
             items = sorted(self._values.items())
         if not items and not self.label_names:
             items = [((), 0.0)]
-        for key, value in items:
-            labels = dict(zip(self.label_names, key))
+        return [(dict(zip(self.label_names, key)), value) for key, value in items]
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        for labels, value in self.samples():
             yield f"{self.name}{_fmt_labels(labels)} {_fmt_value(value)}"
 
 
@@ -103,9 +111,9 @@ class Gauge:
         with self._lock:
             self._values[key] = float(value)
 
-    def render(self) -> Iterable[str]:
-        yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} gauge"
+    def samples(self) -> list[tuple[dict[str, str], float]]:
+        """Structured snapshot (label dict, value) — the OTLP export feed.
+        Callback gauges compute here, i.e. at scrape/export time."""
         if self.callback is not None:
             items = sorted(self.callback().items())
         else:
@@ -113,8 +121,12 @@ class Gauge:
                 items = sorted(self._values.items())
         if not items and not self.label_names:
             items = [((), 0.0)]
-        for key, value in items:
-            labels = dict(zip(self.label_names, key))
+        return [(dict(zip(self.label_names, key)), value) for key, value in items]
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        for labels, value in self.samples():
             yield f"{self.name}{_fmt_labels(labels)} {_fmt_value(value)}"
 
 
@@ -145,17 +157,27 @@ class Histogram:
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
 
+    def samples(self) -> list[tuple[dict[str, str], list[int], float, int]]:
+        """Structured snapshot per label set: (labels, cumulative bucket
+        counts aligned with `self.buckets`, sum, total count) — the OTLP
+        export feed (which converts cumulative to per-bucket counts)."""
+        with self._lock:
+            keys = sorted(self._counts)
+            snapshot = [
+                (
+                    dict(zip(self.label_names, key)),
+                    list(self._counts[key]),
+                    self._sums[key],
+                    self._totals[key],
+                )
+                for key in keys
+            ]
+        return snapshot
+
     def render(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} histogram"
-        with self._lock:
-            keys = sorted(self._counts)
-            snapshot = {
-                key: (list(self._counts[key]), self._sums[key], self._totals[key])
-                for key in keys
-            }
-        for key, (counts, total_sum, total) in snapshot.items():
-            labels = dict(zip(self.label_names, key))
+        for labels, counts, total_sum, total in self.samples():
             for bound, count in zip(self.buckets, counts):
                 bucket_labels = {**labels, "le": _fmt_value(bound)}
                 yield f"{self.name}_bucket{_fmt_labels(bucket_labels)} {count}"
@@ -172,6 +194,16 @@ class MetricsRegistry:
 
     def register(self, metric):
         with self._lock:
+            if any(m.name == metric.name for m in self._metrics):
+                # Two registrations under one family name would emit
+                # duplicate `# HELP`/`# TYPE` headers (forbidden by the
+                # exposition format), split the family's sample group, and
+                # — if the label sets ever collide — produce duplicate
+                # series that fail the whole scrape. Reject at the source:
+                # the caller is holding a stale binding.
+                raise ValueError(
+                    f"metric family {metric.name!r} is already registered"
+                )
             self._metrics.append(metric)
         return metric
 
@@ -197,12 +229,52 @@ class MetricsRegistry:
         return self.register(Histogram(name, help_text, label_names, buckets))
 
     def render(self) -> str:
+        """Prometheus text exposition. `# HELP`/`# TYPE` appear exactly once
+        per metric family — guaranteed structurally, since register()
+        rejects duplicate family names."""
         with self._lock:
             metrics = list(self._metrics)
         lines: list[str] = []
         for metric in metrics:
             lines.extend(metric.render())
         return "\n".join(lines) + "\n"
+
+    def collect(self) -> list[dict]:
+        """Structured snapshot of every family for the OTLP exporter:
+        [{"name", "type", "help", "samples": ...}] where counter/gauge
+        samples are (labels, value) pairs and histogram samples carry
+        (labels, cumulative bucket counts, sum, count) plus "buckets"
+        (the explicit bounds)."""
+        with self._lock:
+            metrics = list(self._metrics)
+        families: list[dict] = []
+        for metric in metrics:
+            if isinstance(metric, Histogram):
+                families.append(
+                    {
+                        "name": metric.name,
+                        "type": "histogram",
+                        "help": metric.help,
+                        "buckets": list(metric.buckets),
+                        "samples": metric.samples(),
+                    }
+                )
+            else:
+                kind = "counter" if isinstance(metric, Counter) else "gauge"
+                try:
+                    samples = metric.samples()
+                except Exception:  # noqa: BLE001 — a callback gauge must
+                    # never take the whole export down with it
+                    samples = []
+                families.append(
+                    {
+                        "name": metric.name,
+                        "type": kind,
+                        "help": metric.help,
+                        "samples": samples,
+                    }
+                )
+        return families
 
 
 class ExecutorMetrics:
@@ -392,6 +464,38 @@ class ExecutorMetrics:
             "requests only).",
             ("span",),
         )
+        # Device-health telemetry (services/device_health.py): the wedge
+        # counter is the page-an-operator signal — a host whose device plane
+        # stopped making progress past every budget. Detection only in this
+        # subsystem; the fencing layer consumes it.
+        self.device_wedges = self.registry.counter(
+            "device_wedge_detected_total",
+            "Hosts the device-health probe classified as WEDGED (attach or "
+            "device op stalled past its budget plus the wedge threshold), "
+            "by chip-count lane. Fires once per transition into wedged.",
+            ("chip_count",),
+        )
+        self.device_probe_cycle_seconds = self.registry.histogram(
+            "code_interpreter_device_probe_cycle_seconds",
+            "Wall time of one full device-health probe cycle over every "
+            "live sandbox host. A stalled probe daemon is itself visible: "
+            "this stops moving while device_probe_last_poll_age_seconds "
+            "climbs.",
+        )
+        # OTLP export observability (utils/otlp.py): drops mean the bounded
+        # queue hit backpressure (collector slow/unreachable) — telemetry
+        # degraded by design instead of growing the heap.
+        self.otlp_exports = self.registry.counter(
+            "code_interpreter_otlp_exports_total",
+            "OTLP export flushes by signal (traces/metrics) and outcome "
+            "(ok/error).",
+            ("signal", "outcome"),
+        )
+        self.otlp_dropped = self.registry.counter(
+            "code_interpreter_otlp_dropped_total",
+            "Spans dropped at the OTLP exporter's bounded queue "
+            "(backpressure): the collector is not keeping up.",
+        )
         self.pool_depth: Gauge | None = None
         self.active_sessions: Gauge | None = None
         self.compile_cache_store: Gauge | None = None
@@ -399,6 +503,8 @@ class ExecutorMetrics:
         self.scheduler_queue_depth: Gauge | None = None
         self.scheduler_queue_wait_ewma: Gauge | None = None
         self.batch_occupancy: Gauge | None = None
+        self.device_health_state: Gauge | None = None
+        self.device_probe_last_poll_age: Gauge | None = None
 
     def bind_pool(self, pools) -> None:
         """Expose warm-pool depth per chip-count lane, read at scrape time."""
@@ -494,6 +600,35 @@ class ExecutorMetrics:
             "APP_BATCH_MAX_JOBS), by chip-count lane.",
             ("chip_count",),
             callback=occupancy_sample,
+        )
+
+    def bind_device_health(self, probe) -> None:
+        """Expose the probe daemon's classification at scrape time: one-hot
+        device_health_state{lane,host,state} per tracked host (lane-level
+        host="_overflow" aggregation past the label cap — see
+        DeviceHealthProbe.gauge_samples), plus the probe's own liveness
+        (seconds since the last completed cycle; a stalled daemon is itself
+        observable)."""
+        self.device_health_state = self.registry.gauge(
+            "device_health_state",
+            "Device-health probe classification per lane/host/state "
+            "(healthy|busy|suspect|wedged): 1 on the host's current state. "
+            "Past the host-label cap, series aggregate per lane under "
+            'host="_overflow" (value = hosts in that state).',
+            ("lane", "host", "state"),
+            callback=probe.gauge_samples,
+        )
+
+        def poll_age() -> dict[tuple[str, ...], float]:
+            return {(): probe.last_poll_age()}
+
+        self.device_probe_last_poll_age = self.registry.gauge(
+            "device_probe_last_poll_age_seconds",
+            "Seconds since the device-health probe daemon last completed a "
+            "full cycle (-1 = never ran). Alert on this climbing past a few "
+            "probe intervals: a wedge nobody is probing for is invisible.",
+            (),
+            callback=poll_age,
         )
 
     def bind_breakers(self, board) -> None:

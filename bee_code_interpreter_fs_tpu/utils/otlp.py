@@ -1,0 +1,364 @@
+"""Dependency-free OTLP/HTTP JSON export for traces and metrics.
+
+Until this module, traces lived only in the in-memory ``TraceRing`` (gone
+with the process) and metrics only on ``GET /metrics`` (pull-only): nothing
+ever LEFT the control plane — the PR 4 carried follow-up. opentelemetry-sdk
+is not in this environment, so the OTLP/HTTP *JSON* encoding (the proto3
+JSON mapping of ``ExportTraceServiceRequest`` / ``ExportMetricsServiceRequest``)
+is emitted directly, the same first-party approach as ``utils/metrics.py``
+and ``utils/tracing.py``.
+
+Design:
+
+- **Kill switch** — no endpoint (``APP_OTLP_ENDPOINT`` unset) means the
+  exporter is never constructed: zero export HTTP, zero queue, zero tasks.
+- **Bounded queue, drop on backpressure** — finished spans enqueue via the
+  tracer's exporter hook (``Tracer.add_exporter``); when the collector falls
+  behind the queue cap, new spans drop and ``otlp_dropped_total`` counts
+  them. Telemetry degrades loudly; the traced path never blocks.
+- **Batched flushes** — a background task ships the queued span batch plus
+  one ``MetricsRegistry.collect()`` snapshot every ``flush_interval``
+  seconds (spans to ``<endpoint>/v1/traces``, metrics to
+  ``<endpoint>/v1/metrics``). Export failures count and retry next cycle —
+  the queue simply keeps absorbing (and, at the bound, dropping).
+- **Injectable transport/clock** — tests run a fake in-process collector
+  through an ``httpx.MockTransport`` and drive flushes explicitly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from collections import deque
+
+import httpx
+
+logger = logging.getLogger(__name__)
+
+
+def _any_value(value) -> dict:
+    """One OTLP AnyValue (proto3 JSON mapping). int64 fields are strings."""
+    if isinstance(value, bool):
+        return {"boolValue": value}
+    if isinstance(value, int):
+        return {"intValue": str(value)}
+    if isinstance(value, float):
+        return {"doubleValue": value}
+    return {"stringValue": str(value)}
+
+
+def _attributes(mapping: dict | None) -> list[dict]:
+    if not mapping:
+        return []
+    return [{"key": str(k), "value": _any_value(v)} for k, v in mapping.items()]
+
+
+def _nanos(unix_seconds: float) -> str:
+    return str(int(max(0.0, unix_seconds) * 1e9))
+
+
+def encode_spans(spans: list[dict], service_name: str) -> dict:
+    """``ExportTraceServiceRequest`` JSON from TraceRing-format span dicts
+    (the shape ``Span.to_dict`` / ``Tracer.record_span`` produce)."""
+    otlp_spans = []
+    for span in spans:
+        start = float(span.get("start_unix", 0.0))
+        duration = float(span.get("duration_s", 0.0))
+        entry = {
+            "traceId": span.get("trace_id", ""),
+            "spanId": span.get("span_id", ""),
+            "name": span.get("name", ""),
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": _nanos(start),
+            "endTimeUnixNano": _nanos(start + duration),
+            "status": {
+                "code": 2 if span.get("status") == "error" else 1
+            },
+        }
+        parent = span.get("parent_id")
+        if parent:
+            entry["parentSpanId"] = parent
+        attrs = _attributes(span.get("attributes"))
+        if attrs:
+            entry["attributes"] = attrs
+        events = [
+            {
+                "name": event.get("name", ""),
+                "timeUnixNano": _nanos(float(event.get("ts", 0.0))),
+                **(
+                    {"attributes": _attributes(event.get("attributes"))}
+                    if event.get("attributes")
+                    else {}
+                ),
+            }
+            for event in span.get("events", ())
+        ]
+        if events:
+            entry["events"] = events
+        otlp_spans.append(entry)
+    return {
+        "resourceSpans": [
+            {
+                "resource": {
+                    "attributes": _attributes({"service.name": service_name})
+                },
+                "scopeSpans": [
+                    {
+                        "scope": {"name": "bee_code_interpreter_fs_tpu"},
+                        "spans": otlp_spans,
+                    }
+                ],
+            }
+        ]
+    }
+
+
+def encode_metrics(
+    families: list[dict], service_name: str, now_unix: float
+) -> dict:
+    """``ExportMetricsServiceRequest`` JSON from a
+    ``MetricsRegistry.collect()`` snapshot. Counters map to monotonic
+    cumulative sums, gauges to gauges, histograms to cumulative histograms
+    (Prometheus-style cumulative bucket counts converted to OTLP's
+    per-bucket counts)."""
+    ts = _nanos(now_unix)
+    metrics = []
+    for family in families:
+        kind = family["type"]
+        entry: dict = {
+            "name": family["name"],
+            "description": family.get("help", ""),
+        }
+        if kind == "histogram":
+            bounds = [float(b) for b in family.get("buckets", ())]
+            points = []
+            for labels, cumulative, total_sum, count in family["samples"]:
+                # Prometheus buckets are cumulative per bound; OTLP wants
+                # per-bucket counts with one extra overflow bucket.
+                per_bucket = []
+                prev = 0
+                for c in cumulative:
+                    per_bucket.append(int(c) - prev)
+                    prev = int(c)
+                per_bucket.append(int(count) - prev)
+                points.append(
+                    {
+                        "attributes": _attributes(labels),
+                        "timeUnixNano": ts,
+                        "count": str(int(count)),
+                        "sum": float(total_sum),
+                        "bucketCounts": [str(c) for c in per_bucket],
+                        "explicitBounds": bounds,
+                    }
+                )
+            entry["histogram"] = {
+                "dataPoints": points,
+                "aggregationTemporality": 2,  # CUMULATIVE
+            }
+        else:
+            points = [
+                {
+                    "attributes": _attributes(labels),
+                    "timeUnixNano": ts,
+                    "asDouble": float(value),
+                }
+                for labels, value in family["samples"]
+            ]
+            if kind == "counter":
+                entry["sum"] = {
+                    "dataPoints": points,
+                    "aggregationTemporality": 2,
+                    "isMonotonic": True,
+                }
+            else:
+                entry["gauge"] = {"dataPoints": points}
+        metrics.append(entry)
+    return {
+        "resourceMetrics": [
+            {
+                "resource": {
+                    "attributes": _attributes({"service.name": service_name})
+                },
+                "scopeMetrics": [
+                    {
+                        "scope": {"name": "bee_code_interpreter_fs_tpu"},
+                        "metrics": metrics,
+                    }
+                ],
+            }
+        ]
+    }
+
+
+class OtlpExporter:
+    """Batches finished spans and metric snapshots to an OTLP/HTTP JSON
+    collector. Construct only with a non-empty endpoint — the absent
+    endpoint IS the kill switch (callers skip construction entirely)."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        *,
+        registry=None,
+        metrics=None,
+        flush_interval: float = 10.0,
+        max_queue: int = 4096,
+        timeout: float = 5.0,
+        service_name: str = "tpu-code-interpreter",
+        transport: httpx.AsyncBaseTransport | None = None,
+        walltime=time.time,
+    ) -> None:
+        if not endpoint:
+            raise ValueError(
+                "OtlpExporter requires an endpoint; an empty APP_OTLP_ENDPOINT "
+                "is the kill switch — do not construct the exporter at all"
+            )
+        self.endpoint = endpoint.rstrip("/")
+        self.registry = registry
+        self.metrics = metrics  # ExecutorMetrics (otlp_* counters) or None
+        self.flush_interval = max(0.1, flush_interval)
+        self.max_queue = max(1, max_queue)
+        self.timeout = timeout
+        self.service_name = service_name
+        self.walltime = walltime
+        self._transport = transport
+        self._client: httpx.AsyncClient | None = None
+        # Spans arrive from span-finish sites (event loop AND, in principle,
+        # any thread a Tracer runs on) — the little lock keeps add() safe
+        # and O(1) either way.
+        self._queue: deque[dict] = deque()
+        self._lock = threading.Lock()
+        self._task: asyncio.Task | None = None
+        self._closed = False
+        # Self-observability (also mirrored into the otlp_* counters when
+        # an ExecutorMetrics is bound).
+        self.dropped_spans = 0
+        self.exported_spans = 0
+        self.export_failures = 0
+        self.flushes = 0
+
+    # ----------------------------------------------------------- span intake
+
+    def add(self, span: dict) -> None:
+        """Tracer exporter hook: enqueue one finished span. Never blocks,
+        never raises; at the queue bound the NEW span drops (the queued
+        backlog is older and closer to shipping) and the drop is counted."""
+        if self._closed:
+            return
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                self.dropped_spans += 1
+                dropped = True
+            else:
+                self._queue.append(span)
+                dropped = False
+        if dropped and self.metrics is not None:
+            self.metrics.otlp_dropped.inc()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> asyncio.Task:
+        """Begin the periodic flush loop (idempotent)."""
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._run())
+        return self._task
+
+    async def _run(self) -> None:
+        while not self._closed:
+            await asyncio.sleep(self.flush_interval)
+            try:
+                await self.flush()
+            except Exception:  # noqa: BLE001 — export must never die
+                logger.exception("OTLP flush failed")
+
+    async def close(self) -> None:
+        """Final flush, then stop. Safe to call twice."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        try:
+            await self.flush()
+        except Exception:  # noqa: BLE001
+            logger.exception("final OTLP flush failed")
+        if self._client is not None and not self._client.is_closed:
+            await self._client.aclose()
+
+    def _http(self) -> httpx.AsyncClient:
+        if self._client is None or self._client.is_closed:
+            self._client = httpx.AsyncClient(
+                timeout=httpx.Timeout(self.timeout),
+                transport=self._transport,
+            )
+        return self._client
+
+    # ----------------------------------------------------------------- flush
+
+    async def flush(self) -> None:
+        """Ship everything queued since the last flush: one batched trace
+        POST (if any spans) and one metrics snapshot POST (if a registry is
+        bound). A failed POST counts and drops that batch — the collector
+        gets at-most-once delivery; the bounded queue is the whole story."""
+        with self._lock:
+            spans = list(self._queue)
+            self._queue.clear()
+        self.flushes += 1
+        if spans:
+            payload = encode_spans(spans, self.service_name)
+            ok = await self._post("/v1/traces", payload)
+            self._count("traces", ok)
+            if ok:
+                self.exported_spans += len(spans)
+        if self.registry is not None:
+            payload = encode_metrics(
+                self.registry.collect(), self.service_name, self.walltime()
+            )
+            ok = await self._post("/v1/metrics", payload)
+            self._count("metrics", ok)
+
+    def _count(self, signal: str, ok: bool) -> None:
+        if not ok:
+            self.export_failures += 1
+        if self.metrics is not None:
+            self.metrics.otlp_exports.inc(
+                signal=signal, outcome="ok" if ok else "error"
+            )
+
+    async def _post(self, path: str, payload: dict) -> bool:
+        try:
+            resp = await self._http().post(
+                f"{self.endpoint}{path}",
+                json=payload,
+                headers={"Content-Type": "application/json"},
+            )
+        except httpx.HTTPError as e:
+            logger.warning("OTLP export to %s failed: %s", path, e)
+            return False
+        if resp.status_code >= 300:
+            logger.warning(
+                "OTLP collector answered %d for %s", resp.status_code, path
+            )
+            return False
+        return True
+
+    # ----------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """Operator snapshot for /statusz."""
+        with self._lock:
+            queued = len(self._queue)
+        return {
+            "endpoint": self.endpoint,
+            "queued_spans": queued,
+            "exported_spans": self.exported_spans,
+            "dropped_spans": self.dropped_spans,
+            "export_failures": self.export_failures,
+            "flushes": self.flushes,
+        }

@@ -262,8 +262,10 @@ class TraceRing:
             spans = [s for s in self._spans if s.get("trace_id") == trace_id]
         return sorted(spans, key=lambda s: s.get("start_unix", 0.0))
 
-    def recent(self, limit: int = 20) -> list[dict]:
-        """Newest distinct traces (summary rows for the debug endpoint)."""
+    def recent(self, limit: int = 20, offset: int = 0) -> list[dict]:
+        """Newest distinct traces (summary rows for the debug endpoint);
+        `offset` pages past the newest rows so the whole ring stays
+        reachable through bounded responses."""
         with self._lock:
             spans = list(self._spans)
         grouped: dict[str, list[dict]] = {}
@@ -289,7 +291,8 @@ class TraceRing:
                 entry["duration_s"] = root.get("duration_s")
             summaries.append(entry)
         summaries.sort(key=lambda e: e["start_unix"], reverse=True)
-        return summaries[: max(0, limit)]
+        offset = max(0, offset)
+        return summaries[offset : offset + max(0, limit)]
 
     def export_jsonl(self, trace_id: str | None = None) -> str:
         """The retained spans (optionally one trace) as JSONL, one span per
@@ -377,6 +380,16 @@ class Tracer:
         self.tail_slow_seconds = max(0.0, tail_slow_seconds)
         # trace_id -> {"root": span_id, "spans": [dict, ...]}
         self._tentative: dict[str, dict] = {}
+        # Additional span sinks (the OTLP exporter registers here): each gets
+        # every FINAL span via .add(span_dict). Sinks must be non-blocking
+        # and never raise — they sit on the span-finish path.
+        self.extra_exporters: list = []
+
+    def add_exporter(self, exporter) -> None:
+        """Register an extra span sink (`.add(span: dict)` contract, same as
+        TraceRing/JsonlExporter). Used by the OTLP exporter so finished
+        spans finally leave the process."""
+        self.extra_exporters.append(exporter)
 
     @classmethod
     def from_config(cls, config, metrics=None) -> "Tracer":
@@ -537,6 +550,8 @@ class Tracer:
             GLOBAL_RING.add(span)
         if self.jsonl is not None:
             self.jsonl.add(span)
+        for exporter in self.extra_exporters:
+            exporter.add(span)
         histogram = getattr(self.metrics, "span_seconds", None)
         if histogram is not None:
             histogram.observe(span["duration_s"], span=span["name"])

@@ -12,7 +12,8 @@ sandbox is still sitting in the warm pool — so by the time an Execute arrives,
 Protocol: newline-delimited JSON. fd 3 = requests in, fd 4 = responses out.
 Request:  {"source_path": ..., "stdout_path": ..., "stderr_path": ..., "env": {...}}
 Response: {"exit_code": int}
-Ready line (sent once at boot): {"ready": true, "backend": ..., "device_count": n}
+Ready line (sent once at boot):
+  {"ready": true, "backend": ..., "device_count": n, "device_kind": ...}
 
 User scripts run in-process via runpy with stdout/stderr redirected at the fd
 level, fresh sys.argv, and __main__ semantics.
@@ -169,6 +170,12 @@ def _warm_import() -> dict:
         devices = jax.devices()
         info["backend"] = devices[0].platform if devices else "none"
         info["device_count"] = len(devices)  # global across the slice
+        # Device kind for the telemetry plane ("TPU v5e" etc.; CPU devices
+        # report "cpu") — surfaced via GET /device-stats so operators see
+        # what hardware a lane's hosts actually hold.
+        info["device_kind"] = (
+            str(getattr(devices[0], "device_kind", "")) if devices else ""
+        )
         if jax.process_count() > 1:
             info["process_count"] = jax.process_count()
             info["process_index"] = jax.process_index()

@@ -574,6 +574,63 @@ ExecOutcome run_subprocess(const std::vector<std::string>& argv,
 }
 
 // ---------------------------------------------------------------------------
+// Device-health telemetry (GET /device-stats). The repo's own bench history
+// (BENCH_r03-r05) shows the worst failure mode is a wedged device op: the
+// attach blocks for tens of minutes with /healthz still answering "ok",
+// because nothing distinguished "busy" from "wedged". These globals are the
+// raw signals a probe daemon needs to make that call: when the current
+// attach (warm-up) started, when the current device op started and what its
+// budget is, when the runner last produced evidence of life, and when a
+// device op last SUCCEEDED. All atomics on purpose — the /device-stats
+// handler must answer while exec_mutex/runner_mutex are held by exactly the
+// wedged operation it exists to expose.
+
+long long now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000LL + ts.tv_nsec / 1000000LL;
+}
+
+std::atomic<long long> g_boot_ms{0};
+// Warm-up (jax import + device attach) window: nonzero while one is running.
+std::atomic<long long> g_attach_start_ms{0};
+// Latency of the last SUCCESSFUL warm-up (the per-sandbox attach cost);
+// -1 until one completes.
+std::atomic<long long> g_attach_last_ms{-1};
+// Current warm-runner device op (execute/reset round-trip): start + budget.
+std::atomic<long long> g_op_start_ms{0};
+std::atomic<long long> g_op_timeout_ms{0};
+// Completion time of the last device op the runner answered successfully.
+std::atomic<long long> g_last_op_ok_ms{0};
+// Last time the runner wrote ANY bytes on its response pipe — the passive
+// heartbeat. A runner pinned inside a wedged native call writes nothing, so
+// this age grows exactly when the probe needs it to.
+std::atomic<long long> g_runner_line_ms{0};
+// Runner identity mirrors, updated only at start/kill: the stats handler
+// must not touch WarmRunner fields (they are runner_mutex-protected, and
+// that mutex is held for the whole duration of the op being diagnosed).
+std::atomic<long long> g_runner_pid_stat{0};
+std::atomic<bool> g_runner_ready_stat{false};
+std::atomic<int> g_device_count_stat{0};
+std::mutex g_device_info_mutex;  // guards the two strings below only
+std::string g_device_backend_stat = "none";
+std::string g_device_kind_stat;
+
+// Resident set size of `pid` in bytes via /proc/<pid>/statm; -1 on failure.
+long long rss_bytes_of(long long pid) {
+  if (pid <= 0) return -1;
+  char path[64];
+  snprintf(path, sizeof(path), "/proc/%lld/statm", pid);
+  FILE* f = fopen(path, "r");
+  if (!f) return -1;
+  long long pages_total = 0, pages_resident = 0;
+  int n = fscanf(f, "%lld %lld", &pages_total, &pages_resident);
+  fclose(f);
+  if (n != 2) return -1;
+  return pages_resident * static_cast<long long>(sysconf(_SC_PAGESIZE));
+}
+
+// ---------------------------------------------------------------------------
 // Warm runner: a persistent Python process that pre-imports JAX (initializing
 // the TPU) at sandbox boot and then executes scripts on demand. Protocol:
 // newline-delimited JSON over the runner's fd 3 (requests) and fd 4
@@ -635,13 +692,23 @@ class WarmRunner {
       stop();
       return false;
     }
+    std::string device_kind;
     try {
       auto msg = minijson::parse(line);
       ready_ = msg.get_bool("ready", false);
       backend_ = msg.get_string("backend", "unknown");
       device_count_ = static_cast<int>(msg.get_number("device_count", 0));
+      device_kind = msg.get_string("device_kind", "");
     } catch (...) {
       ready_ = false;
+    }
+    g_runner_pid_stat = pid_;
+    g_runner_ready_stat = ready_;
+    g_device_count_stat = device_count_;
+    {
+      std::lock_guard<std::mutex> dlock(g_device_info_mutex);
+      g_device_backend_stat = backend_;
+      g_device_kind_stat = device_kind;
     }
     log_msg("warm runner ready=%d backend=%s devices=%d", (int)ready_,
             backend_.c_str(), device_count_);
@@ -688,6 +755,24 @@ class WarmRunner {
   // verdict would misread a successful-but-slow reset as failure.
   ExecResult execute(const std::string& request_json, double timeout_s,
                      minijson::Value& response, bool allow_interrupt = false) {
+    // Every runner round-trip is a device op from the probe's perspective
+    // (execute, batch, reset): open the telemetry window so /device-stats
+    // can report how long the CURRENT op has been running against what
+    // budget, and stamp the success time when the runner actually answers.
+    g_op_timeout_ms = timeout_s > 0
+                          ? static_cast<long long>(timeout_s * 1000.0)
+                          : 0;
+    g_op_start_ms = now_ms();
+    ExecResult result = execute_inner(request_json, timeout_s, response,
+                                      allow_interrupt);
+    if (result == ExecResult::kOk || result == ExecResult::kInterrupted)
+      g_last_op_ok_ms = now_ms();
+    g_op_start_ms = 0;
+    return result;
+  }
+
+  ExecResult execute_inner(const std::string& request_json, double timeout_s,
+                           minijson::Value& response, bool allow_interrupt) {
     std::string line = request_json + "\n";
     size_t off = 0;
     while (off < line.size()) {
@@ -733,6 +818,8 @@ class WarmRunner {
 
   void kill_runner() {
     g_runner_sid = 0;
+    g_runner_pid_stat = 0;
+    g_runner_ready_stat = false;
     if (pid_ > 0) {
       kill(-pid_, SIGKILL);
       waitpid(pid_, nullptr, 0);
@@ -780,6 +867,9 @@ class WarmRunner {
         char buf[1 << 14];
         ssize_t n = read(resp_fd_, buf, sizeof(buf));
         if (n <= 0) return false;
+        // Passive heartbeat: any bytes from the runner are proof of life
+        // (a wedged native call writes nothing, so this age grows).
+        g_runner_line_ms = now_ms();
         resp_buf_.append(buf, static_cast<size_t>(n));
       }
     }
@@ -880,6 +970,7 @@ void start_warm_async() {
     if (s == kWarmPending || s == kWarmReady) return;
     if (s == kWarmFailed && g_state.num_hosts > 1) return;  // see below
     g_warm_state = kWarmPending;
+    g_attach_start_ms = now_ms();  // the attach window /device-stats reports
   }
   std::thread([] {
     bool ok;
@@ -888,6 +979,9 @@ void start_warm_async() {
       ok = g_state.runner->start();
     }
     if (ok) g_ever_ready = true;
+    long long attach_start = g_attach_start_ms.load();
+    if (ok && attach_start > 0) g_attach_last_ms = now_ms() - attach_start;
+    g_attach_start_ms = 0;
     {
       std::lock_guard<std::mutex> l(g_warm_transition_mutex);
       g_warm_state = ok ? kWarmReady : kWarmFailed;
@@ -2261,6 +2355,76 @@ void handle_healthz(const minihttp::Request&, minihttp::Conn& conn) {
   conn.send_response(200, "application/json", warm_status_body().dump());
 }
 
+// GET /device-stats — the raw device-health signals the control plane's
+// probe daemon classifies into healthy/busy/suspect/wedged. DELIBERATELY
+// lock-free (atomics + one tiny string mutex never held across I/O): it
+// must answer while exec_mutex/runner_mutex are pinned by a wedged device
+// op — the exact situation where /healthz kept saying "ok" while attaches
+// blocked 50-76 minutes (BENCH_r03-r05). Ages are computed server-side on
+// the server's own monotonic clock, so the probe never does cross-host
+// clock math.
+void handle_device_stats(const minihttp::Request&, minihttp::Conn& conn) {
+  long long now = now_ms();
+  minijson::Object resp;
+  resp["status"] = minijson::Value(std::string("ok"));
+  int state = g_warm_state.load();
+  resp["warm_state"] = minijson::Value(std::string(warm_state_name(state)));
+  resp["warm"] =
+      minijson::Value(state == kWarmReady && g_runner_ready_stat.load());
+  {
+    std::lock_guard<std::mutex> dlock(g_device_info_mutex);
+    resp["backend"] = minijson::Value(g_device_backend_stat);
+    resp["device_kind"] = minijson::Value(g_device_kind_stat);
+  }
+  resp["device_count"] = minijson::Value(g_device_count_stat.load());
+  resp["num_hosts"] = minijson::Value(g_state.num_hosts);
+  resp["uptime_s"] = minijson::Value((now - g_boot_ms.load()) / 1000.0);
+  // Attach telemetry: pending age while a warm-up (jax import + device
+  // attach) is in flight, plus the last successful attach's latency.
+  long long attach_start = g_attach_start_ms.load();
+  resp["attach_pending_s"] = minijson::Value(
+      attach_start > 0 ? (now - attach_start) / 1000.0 : 0.0);
+  long long attach_last = g_attach_last_ms.load();
+  resp["attach_seconds"] =
+      minijson::Value(attach_last >= 0 ? attach_last / 1000.0 : -1.0);
+  // Current device op (warm-runner round-trip): age + declared budget.
+  long long op_start = g_op_start_ms.load();
+  resp["op_in_flight"] = minijson::Value(op_start > 0);
+  resp["op_age_s"] =
+      minijson::Value(op_start > 0 ? (now - op_start) / 1000.0 : 0.0);
+  resp["op_timeout_s"] = minijson::Value(
+      op_start > 0 ? g_op_timeout_ms.load() / 1000.0 : 0.0);
+  long long last_ok = g_last_op_ok_ms.load();
+  resp["last_device_op_age_s"] =
+      minijson::Value(last_ok > 0 ? (now - last_ok) / 1000.0 : -1.0);
+  long long line = g_runner_line_ms.load();
+  resp["runner_heartbeat_age_s"] =
+      minijson::Value(line > 0 ? (now - line) / 1000.0 : -1.0);
+  long long runner_pid = g_runner_pid_stat.load();
+  bool runner_alive = g_runner_ready_stat.load();
+  if (runner_alive && runner_pid > 0) {
+    // The ready mirror goes stale when the runner dies SILENTLY (OOM kill
+    // between requests): nothing notices until the next execute finds the
+    // corpse. Peek at the child without reaping it (WNOWAIT — kill_runner's
+    // waitpid still collects the zombie), so the probe sees a dead-idle
+    // runner instead of an eternally "healthy" host.
+    siginfo_t info;
+    info.si_pid = 0;
+    if (waitid(P_PID, static_cast<id_t>(runner_pid), &info,
+               WEXITED | WNOHANG | WNOWAIT) == 0 &&
+        info.si_pid == static_cast<pid_t>(runner_pid)) {
+      runner_alive = false;
+    }
+  }
+  resp["runner_alive"] = minijson::Value(runner_alive);
+  resp["runner_pid"] = minijson::Value(static_cast<double>(runner_pid));
+  resp["rss_bytes"] = minijson::Value(
+      static_cast<double>(rss_bytes_of(static_cast<long long>(getpid()))));
+  resp["runner_rss_bytes"] = minijson::Value(
+      static_cast<double>(runner_pid > 0 ? rss_bytes_of(runner_pid) : -1));
+  conn.send_response(200, "application/json", minijson::Value(resp).dump());
+}
+
 void handle_readyz(const minihttp::Request&, minihttp::Conn& conn) {
   // Readiness: 503 until the sandbox can actually serve its purpose (warm
   // runner hot, or warm mode off). This is what k8s readinessProbe targets,
@@ -2369,6 +2533,8 @@ void route(const minihttp::Request& req, minihttp::Conn& conn) {
     handle_cc_manifest(req, conn);
   } else if (req.method == "GET" && req.target == "/healthz") {
     handle_healthz(req, conn);
+  } else if (req.method == "GET" && req.target == "/device-stats") {
+    handle_device_stats(req, conn);
   } else if (req.method == "GET" && req.target == "/readyz") {
     handle_readyz(req, conn);
   } else if (req.method == "PUT") {
@@ -2394,6 +2560,7 @@ std::string self_dir() {
 }  // namespace
 
 int main() {
+  g_boot_ms = now_ms();
   std::string listen_addr = env_or("APP_LISTEN_ADDR", "0.0.0.0:8000");
   g_state.workspace = env_or("APP_WORKSPACE", "/workspace");
   g_state.runtime_packages = env_or("APP_RUNTIME_PACKAGES", "/runtime-packages");

@@ -1,4 +1,15 @@
 #!/bin/bash
+# DEPRECATED as a health-watching tool (PR 8): the service now has a
+# first-party telemetry plane that covers what the probe loop below
+# encoded — per-host device-health probing (GET /device-stats on every
+# sandbox, classified healthy/busy/suspect/wedged with attach-budget and
+# op-stall thresholds), `GET /statusz` (one consolidated operator view:
+# `curl $CONTROL_PLANE/statusz?format=text` replaces the ssh-and-grep
+# loop), the `device_wedge_detected_total` / `device_health_state`
+# metrics, and OTLP export (APP_OTLP_ENDPOINT). See README "Telemetry".
+# This script remains ONLY as the standalone bench-suite runner for a
+# tunnel-attached chip with no control plane running.
+#
 # Patient TPU recovery watcher (round 5): probe until an attach succeeds,
 # then fire the full on-chip measurement suite, writing results INTO the
 # repo so the round-end auto-commit preserves them even if nobody is at

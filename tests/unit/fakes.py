@@ -19,9 +19,13 @@ class FakeBackend:
     # externally-writable cache-dir postures override per instance.
     compile_cache_dir_scope = "private"
 
-    def __init__(self, capacity=None, resettable=True):
+    def __init__(self, capacity=None, resettable=True, distinct_urls=False):
         self.capacity = capacity
         self.resettable = resettable
+        # distinct_urls gives each sandbox its own host URL (like any real
+        # backend) — the device-health probe keys its state table by host,
+        # so probe tests need hosts that are actually distinguishable.
+        self.distinct_urls = distinct_urls
         self.spawns = 0
         self.resets = 0
         self.deletes = 0
@@ -29,8 +33,11 @@ class FakeBackend:
 
     async def spawn(self, chip_count: int = 0) -> Sandbox:
         self.spawns += 1
+        url = (
+            f"http://fake-{self.spawns}" if self.distinct_urls else "http://fake"
+        )
         sandbox = Sandbox(
-            id=f"sb-{self.spawns}", url="http://fake", chip_count=chip_count
+            id=f"sb-{self.spawns}", url=url, chip_count=chip_count
         )
         self.live.add(sandbox.id)
         return sandbox

@@ -1,0 +1,427 @@
+"""Device-health probe daemon unit tests (services/device_health.py).
+
+Classification (healthy/busy/suspect/wedged from /device-stats signals),
+transition side effects (trace spans, wedge counter, sandbox marking), the
+host-label cardinality cap, the live-host registry the probe walks, and the
+probe's own observability (last-poll age, cycle histogram).
+"""
+
+import asyncio
+import json
+import tempfile
+
+import httpx
+import pytest
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.backends.base import Sandbox
+from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor
+from bee_code_interpreter_fs_tpu.services.device_health import (
+    BUSY,
+    HEALTHY,
+    SUSPECT,
+    WEDGED,
+    DeviceHealthProbe,
+)
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+from fakes import FakeBackend
+
+def _stats(**overrides) -> dict:
+    base = {
+        "status": "ok",
+        "warm": True,
+        "warm_state": "ready",
+        "backend": "cpu",
+        "device_kind": "cpu",
+        "device_count": 1,
+        "attach_pending_s": 0.0,
+        "attach_seconds": 1.5,
+        "op_in_flight": False,
+        "op_age_s": 0.0,
+        "op_timeout_s": 0.0,
+        "last_device_op_age_s": 3.0,
+        "runner_heartbeat_age_s": 0.5,
+        "runner_alive": True,
+        "rss_bytes": 1 << 20,
+        "runner_rss_bytes": 2 << 20,
+    }
+    base.update(overrides)
+    return base
+
+
+class _Stack:
+    """Executor + probe wired to a controllable fake /device-stats wire:
+    `self.responses[url]` is a stats dict, an int status code (e.g. 404
+    legacy), or an Exception to raise (unreachable)."""
+
+    def __init__(self, **config_overrides):
+        self.tmp = tempfile.mkdtemp(prefix="device-health-test-")
+        defaults = dict(
+            file_storage_path=self.tmp,
+            executor_pod_queue_target_length=1,
+            device_probe_interval=10.0,
+            device_probe_timeout=1.0,
+            device_probe_attach_budget=10.0,
+            device_probe_op_grace=5.0,
+            device_probe_wedge_after=10.0,
+        )
+        defaults.update(config_overrides)
+        self.config = Config(**defaults)
+        self.backend = FakeBackend(distinct_urls=True)
+        self.executor = CodeExecutor(
+            self.backend, Storage(self.tmp), self.config
+        )
+        self.responses: dict[str, object] = {}
+        self.clock_now = 1000.0
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            key = f"http://{request.url.host}"
+            value = self.responses.get(key)
+            if isinstance(value, Exception):
+                raise value
+            if isinstance(value, int):
+                return httpx.Response(value, json={"error": "no route"})
+            if isinstance(value, dict):
+                return httpx.Response(200, json=value)
+            return httpx.Response(200, json=_stats())
+
+        self._client = httpx.AsyncClient(
+            transport=httpx.MockTransport(handler)
+        )
+        self.executor._http_client = lambda: self._client
+        self.probe = DeviceHealthProbe(
+            self.executor, clock=lambda: self.clock_now
+        )
+
+    async def sandbox(self, lane: int = 0) -> Sandbox:
+        sandbox = await self.backend.spawn(lane)
+        self.executor._live_sandboxes[sandbox.id] = (lane, sandbox)
+        return sandbox
+
+    async def close(self):
+        await self._client.aclose()
+        await self.executor.close()
+
+
+@pytest.fixture
+async def stack():
+    s = _Stack()
+    yield s
+    await s.close()
+
+
+# ------------------------------------------------------------ classification
+
+
+async def test_classify_idle_is_healthy(stack):
+    state, reason, stall = stack.probe._classify(_stats())
+    assert (state, reason, stall) == (HEALTHY, "", 0.0)
+
+
+async def test_classify_attach_within_budget_is_busy(stack):
+    state, reason, _ = stack.probe._classify(
+        _stats(warm_state="pending", attach_pending_s=5.0)
+    )
+    assert (state, reason) == (BUSY, "attaching")
+
+
+async def test_classify_attach_over_budget_is_suspect(stack):
+    # attach_budget=10, wedge_after=10: pending 15s = 5s past budget.
+    state, reason, stall = stack.probe._classify(
+        _stats(warm_state="pending", attach_pending_s=15.0)
+    )
+    assert (state, reason) == (SUSPECT, "attach_over_budget")
+    assert stall == pytest.approx(5.0)
+
+
+async def test_classify_attach_stalled_is_wedged(stack):
+    # 35s pending = 25s past the 10s budget >= wedge_after 10.
+    state, reason, stall = stack.probe._classify(
+        _stats(warm_state="pending", attach_pending_s=35.0)
+    )
+    assert (state, reason) == (WEDGED, "attach_stalled")
+    assert stall == pytest.approx(25.0)
+
+
+async def test_classify_op_within_own_timeout_is_busy(stack):
+    state, reason, _ = stack.probe._classify(
+        _stats(op_in_flight=True, op_age_s=30.0, op_timeout_s=60.0)
+    )
+    assert (state, reason) == (BUSY, "device_op")
+
+
+async def test_classify_op_over_budget_uses_declared_timeout_plus_grace(stack):
+    # budget = op_timeout 60 + grace 5 = 65; age 70 = 5 past -> suspect.
+    state, reason, stall = stack.probe._classify(
+        _stats(op_in_flight=True, op_age_s=70.0, op_timeout_s=60.0)
+    )
+    assert (state, reason) == (SUSPECT, "device_op_over_budget")
+    assert stall == pytest.approx(5.0)
+    # 80 past budget -> wedged (>= wedge_after 10).
+    state, reason, _ = stack.probe._classify(
+        _stats(op_in_flight=True, op_age_s=145.0, op_timeout_s=60.0)
+    )
+    assert (state, reason) == (WEDGED, "device_op_stalled")
+
+
+async def test_classify_warm_failed_is_suspect(stack):
+    state, reason, _ = stack.probe._classify(_stats(warm_state="failed"))
+    assert (state, reason) == (SUSPECT, "warm_failed")
+
+
+async def test_classify_silently_dead_runner_is_suspect(stack):
+    """warm_state still says ready but the executor's waitid peek found
+    the runner's corpse (OOM-killed between requests): the host must not
+    keep classifying healthy forever."""
+    state, reason, _ = stack.probe._classify(
+        _stats(runner_alive=False)
+    )
+    assert (state, reason) == (SUSPECT, "runner_dead")
+
+
+async def test_routine_busy_flips_record_no_transition_span(stack):
+    """healthy<->busy is normal operation (every probe cycle that catches
+    a host mid-op produces one): no span, no WARNING — only transitions
+    touching suspect/wedged are incident material."""
+    sandbox = await stack.sandbox()
+    stack.responses[sandbox.url] = _stats()
+    await stack.probe.probe_once()
+    stack.responses[sandbox.url] = _stats(
+        op_in_flight=True, op_age_s=1.0, op_timeout_s=60.0
+    )
+    await stack.probe.probe_once()
+    assert stack.probe.states()[sandbox.url] == BUSY
+    stack.responses[sandbox.url] = _stats()
+    await stack.probe.probe_once()
+    assert stack.probe.states()[sandbox.url] == HEALTHY
+    assert "device_health.transition" not in (
+        stack.executor.tracer.ring.export_jsonl()
+    )
+
+
+# ------------------------------------------------------- cycle + transitions
+
+
+async def test_escalation_emits_transitions_counter_and_marks_sandbox(stack):
+    sandbox = await stack.sandbox(lane=4)
+    url = sandbox.url
+    # Cycle 1: healthy.
+    stack.responses[url] = _stats()
+    states = await stack.probe.probe_once()
+    assert states[url] == HEALTHY
+    # Cycle 2: attach pending past the budget -> suspect.
+    stack.responses[url] = _stats(warm_state="pending", attach_pending_s=15.0)
+    states = await stack.probe.probe_once()
+    assert states[url] == SUSPECT
+    # Cycle 3: still pending, stall past wedge_after -> wedged.
+    stack.responses[url] = _stats(warm_state="pending", attach_pending_s=35.0)
+    states = await stack.probe.probe_once()
+    assert states[url] == WEDGED
+    # The wedge verdict marks the host for the (future) fencing layer.
+    assert sandbox.meta["device_health"] == WEDGED
+    # device_wedge_detected_total{chip_count="4"} == 1, once per transition.
+    text = stack.executor.metrics.registry.render()
+    assert 'device_wedge_detected_total{chip_count="4"} 1' in text
+    # Same verdict again: no double count.
+    await stack.probe.probe_once()
+    text = stack.executor.metrics.registry.render()
+    assert 'device_wedge_detected_total{chip_count="4"} 1' in text
+    # Transitions are retained as spans (always recorded — incident review
+    # material), with from/to attributes walking healthy->suspect->wedged.
+    spans = [
+        s
+        for s in stack.executor.tracer.ring.export_jsonl().splitlines()
+        if "device_health.transition" in s
+    ]
+    assert len(spans) == 2
+    hops = [
+        (json.loads(s)["attributes"]["from"], json.loads(s)["attributes"]["to"])
+        for s in spans
+    ]
+    assert hops == [(HEALTHY, SUSPECT), (SUSPECT, WEDGED)]
+
+
+async def test_recovery_transitions_back(stack):
+    sandbox = await stack.sandbox()
+    stack.responses[sandbox.url] = _stats(
+        warm_state="pending", attach_pending_s=15.0
+    )
+    await stack.probe.probe_once()
+    assert stack.probe.states()[sandbox.url] == SUSPECT
+    stack.responses[sandbox.url] = _stats()
+    await stack.probe.probe_once()
+    assert stack.probe.states()[sandbox.url] == HEALTHY
+    assert sandbox.meta["device_health"] == HEALTHY
+
+
+async def test_unreachable_escalates_to_wedged_on_probe_clock(stack):
+    sandbox = await stack.sandbox()
+    stack.responses[sandbox.url] = _stats()
+    await stack.probe.probe_once()
+    # The host goes dark. First failed cycle: suspect (stall counts from
+    # the last successful probe).
+    stack.responses[sandbox.url] = httpx.ConnectError("down")
+    stack.clock_now += 5.0
+    await stack.probe.probe_once()
+    assert stack.probe.states()[sandbox.url] == SUSPECT
+    # Dark past wedge_after (10s): wedged.
+    stack.clock_now += 10.0
+    await stack.probe.probe_once()
+    assert stack.probe.states()[sandbox.url] == WEDGED
+    assert stack.probe._hosts[sandbox.url].reason == "unreachable"
+
+
+async def test_legacy_binary_404_is_healthy_not_failure(stack):
+    sandbox = await stack.sandbox()
+    stack.responses[sandbox.url] = 404
+    states = await stack.probe.probe_once()
+    assert states[sandbox.url] == HEALTHY
+    row = stack.probe._hosts[sandbox.url]
+    assert row.legacy is True
+    assert row.failures == 0
+
+
+async def test_disposed_host_pruned_from_table_and_gauge(stack):
+    sandbox = await stack.sandbox()
+    stack.responses[sandbox.url] = _stats()
+    await stack.probe.probe_once()
+    assert sandbox.url in stack.probe.states()
+    await stack.executor._dispose(sandbox)
+    await stack.probe.probe_once()
+    assert sandbox.url not in stack.probe.states()
+    assert stack.probe.gauge_samples() == {}
+
+
+# --------------------------------------------------------------- cardinality
+
+
+async def test_gauge_one_hot_under_host_cap(stack):
+    a = await stack.sandbox(lane=0)
+    b = await stack.sandbox(lane=4)
+    stack.responses[a.url] = _stats()
+    stack.responses[b.url] = _stats(warm_state="pending", attach_pending_s=15.0)
+    await stack.probe.probe_once()
+    samples = stack.probe.gauge_samples()
+    assert samples[("0", a.url, HEALTHY)] == 1.0
+    assert samples[("0", a.url, WEDGED)] == 0.0
+    assert samples[("4", b.url, SUSPECT)] == 1.0
+
+
+async def test_host_labels_drop_to_lane_level_past_cap():
+    s = _Stack(device_probe_max_host_labels=2)
+    try:
+        boxes = [await s.sandbox(lane=0) for _ in range(3)]
+        for box in boxes:
+            s.responses[box.url] = _stats()
+        await s.probe.probe_once()
+        samples = s.probe.gauge_samples()
+        # Past the cap NO host keeps its own label: everything aggregates
+        # per lane under the overflow label (same discipline as the
+        # scheduler's tenant cap).
+        assert all(key[1] == "_overflow" for key in samples)
+        assert samples[("0", "_overflow", HEALTHY)] == 3.0
+    finally:
+        await s.close()
+
+
+def test_tenant_cap_and_host_cap_share_the_overflow_discipline():
+    """ISSUE satellite: the PR 2 tenant-label cap must govern the new
+    telemetry labels too — both caps collapse past-the-bound values into
+    one `_overflow` label instead of minting unbounded series."""
+    from bee_code_interpreter_fs_tpu.services.scheduler import SandboxScheduler
+
+    config = Config(scheduler_max_metric_tenants=2)
+    scheduler = SandboxScheduler(config)
+    # Cap is max(len(initial set), config): the default tenant holds one
+    # slot; one more tenant can claim a label, the rest overflow.
+    assert scheduler._metric_tenant("tenant-a", claim=True) == "tenant-a"
+    assert scheduler._metric_tenant("tenant-b", claim=True) == "_overflow"
+    assert scheduler._metric_tenant("tenant-c", claim=True) == "_overflow"
+    # Device-health host labels: same shape (see
+    # test_host_labels_drop_to_lane_level_past_cap for the probe-level
+    # behavior) — the gauge never exports an uncapped host label set.
+
+
+# ----------------------------------------------------- probe self-observability
+
+
+async def test_last_poll_age_and_cycle_histogram(stack):
+    assert stack.probe.last_poll_age() == -1.0
+    await stack.sandbox()
+    await stack.probe.probe_once()
+    assert stack.probe.last_poll_age() == 0.0
+    stack.clock_now += 7.5
+    assert stack.probe.last_poll_age() == pytest.approx(7.5)
+    text = stack.executor.metrics.registry.render()
+    assert "device_probe_last_poll_age_seconds 7.5" in text
+    assert (
+        "code_interpreter_device_probe_cycle_seconds_count 1" in text
+    )
+
+
+async def test_start_disabled_with_zero_interval():
+    s = _Stack(device_probe_interval=0.0)
+    try:
+        assert s.probe.start() is None
+    finally:
+        await s.close()
+
+
+async def test_probe_loop_runs_on_interval():
+    s = _Stack(device_probe_interval=0.02)
+    try:
+        # Real-time loop; classification inputs are all fake.
+        s.probe.clock = __import__("time").monotonic
+        await s.sandbox()
+        task = s.probe.start()
+        assert task is not None
+        await asyncio.sleep(0.1)
+        assert s.probe._cycles >= 2
+        await s.probe.stop()
+    finally:
+        await s.close()
+
+
+# ------------------------------------------------------------- host registry
+
+
+async def test_live_host_registry_tracks_spawn_and_dispose(stack):
+    assert stack.executor.live_hosts() == []
+    await stack.executor.fill_pool(0)
+    hosts = stack.executor.live_hosts()
+    assert len(hosts) == 1
+    lane, sandbox = hosts[0]
+    assert lane == 0
+    assert stack.executor.live_sandbox(sandbox.id) == (0, sandbox)
+    await stack.executor._dispose(sandbox)
+    assert stack.executor.live_hosts() == []
+    assert stack.executor.live_sandbox(sandbox.id) is None
+
+
+# ------------------------------------------------------------------- statusz
+
+
+async def test_statusz_joins_device_health_and_lanes(stack):
+    sandbox = await stack.sandbox(lane=0)
+    stack.responses[sandbox.url] = _stats(
+        warm_state="pending", attach_pending_s=35.0
+    )
+    stack.executor.device_health = stack.probe
+    await stack.probe.probe_once()
+    body = stack.executor.statusz()
+    assert body["status"] == "ok"
+    health = body["device_health"]
+    assert health["enabled"] is True
+    assert health["states"][WEDGED] == 1
+    row = health["hosts"][0]
+    assert row["state"] == WEDGED
+    assert row["reason"] == "attach_stalled"
+    assert row["lane"] == 0
+    assert body["otlp"] == {"enabled": False}
+    assert "batching" in body and "compile_cache" in body
+
+
+async def test_statusz_without_probe_reports_disabled(stack):
+    body = stack.executor.statusz()
+    assert body["device_health"] == {"enabled": False}

@@ -1,6 +1,9 @@
 """Unit tests for the dependency-free Prometheus metrics registry."""
 
+import re
+
 from bee_code_interpreter_fs_tpu.utils.metrics import (
+    PROMETHEUS_CONTENT_TYPE,
     ExecutorMetrics,
     MetricsRegistry,
 )
@@ -89,6 +92,107 @@ def test_executor_metrics_pool_binding():
     assert 'code_interpreter_pool_depth{chip_count="4"} 2' in text
     assert 'code_interpreter_executions_total{outcome="ok"} 1' in text
     assert "code_interpreter_sandbox_spawn_seconds_count" in text
+
+
+def test_prometheus_content_type_is_versioned():
+    """The exposition contract requires the versioned media type — a bare
+    text/plain reads as unversioned to strict scrapers."""
+    assert PROMETHEUS_CONTENT_TYPE == "text/plain; version=0.0.4; charset=utf-8"
+
+
+def test_help_and_type_emitted_exactly_once_per_family():
+    """The exposition format forbids repeated # HELP/# TYPE headers and
+    split family groups — enforced at the source: a second registration
+    under an existing family name is rejected outright (a duplicate with
+    colliding label values would otherwise fail the whole scrape)."""
+    import pytest
+
+    reg = MetricsRegistry()
+    a = reg.counter("dup_total", "First.", ("which",))
+    with pytest.raises(ValueError):
+        reg.counter("dup_total", "Second.", ("which",))
+    with pytest.raises(ValueError):
+        reg.gauge("dup_total", "As a gauge.")
+    a.inc(which="a")
+    text = reg.render()
+    assert text.count("# HELP dup_total") == 1
+    assert text.count("# TYPE dup_total") == 1
+    assert 'dup_total{which="a"} 1' in text
+
+
+def _unescape_label(value: str) -> str:
+    """Prometheus label-value unescaping (the scrape side's rules)."""
+    out = []
+    i = 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+            elif nxt == '"':
+                out.append('"')
+            elif nxt == "n":
+                out.append("\n")
+            else:
+                out.append(nxt)
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def test_label_value_escaping_round_trips():
+    """Backslash, newline, and quote survive a render -> unescape round
+    trip — the exposition-compliance satellite's hard cases (a backslash
+    escaped AFTER the newline pass would corrupt '\\n' sequences)."""
+    nasty = 'back\\slash "quoted"\nnewline \\n literal'
+    reg = MetricsRegistry()
+    reg.counter("nasty_total", "Nasty.", ("val",)).inc(val=nasty)
+    text = reg.render()
+    match = re.search(r'nasty_total\{val="((?:[^"\\]|\\.)*)"\} 1', text)
+    assert match, text
+    assert _unescape_label(match.group(1)) == nasty
+    # And the escaped form itself never contains a raw newline or quote.
+    assert "\n" not in match.group(1)
+
+
+def test_registry_collect_structured_snapshot():
+    """collect() is the OTLP exporter's feed: typed families with
+    structured samples (histograms carry bounds + cumulative counts)."""
+    reg = MetricsRegistry()
+    reg.counter("c_total", "C.", ("k",)).inc(2, k="x")
+    reg.gauge("g", "G.").set(4)
+    h = reg.histogram("h_s", "H.", buckets=(1.0, 10.0))
+    h.observe(0.5)
+    h.observe(5.0)
+    fams = {f["name"]: f for f in reg.collect()}
+    assert fams["c_total"]["type"] == "counter"
+    assert fams["c_total"]["samples"] == [({"k": "x"}, 2.0)]
+    assert fams["g"]["type"] == "gauge"
+    assert fams["g"]["samples"] == [({}, 4.0)]
+    hist = fams["h_s"]
+    assert hist["type"] == "histogram"
+    assert hist["buckets"] == [1.0, 10.0]
+    labels, cumulative, total_sum, count = hist["samples"][0]
+    assert labels == {}
+    assert cumulative == [1, 2]
+    assert total_sum == 5.5
+    assert count == 2
+
+
+def test_broken_gauge_callback_does_not_break_collect():
+    reg = MetricsRegistry()
+
+    def boom():
+        raise RuntimeError("scrape-time failure")
+
+    reg.gauge("bad", "Bad.", ("k",), callback=boom)
+    reg.counter("good_total", "Good.").inc()
+    fams = {f["name"]: f for f in reg.collect()}
+    assert fams["bad"]["samples"] == []
+    assert fams["good_total"]["samples"] == [({}, 1.0)]
 
 
 def test_scheduler_queue_wait_ewma_gauge():

@@ -1,0 +1,241 @@
+"""OTLP/HTTP JSON exporter unit tests (utils/otlp.py): encoding, batching,
+the bounded-queue drop discipline, failure accounting, the tracer hook, and
+the kill switch (no endpoint -> no exporter object anywhere)."""
+
+import json
+
+import httpx
+import pytest
+
+from bee_code_interpreter_fs_tpu.utils.metrics import ExecutorMetrics, MetricsRegistry
+from bee_code_interpreter_fs_tpu.utils.otlp import (
+    OtlpExporter,
+    encode_metrics,
+    encode_spans,
+)
+from bee_code_interpreter_fs_tpu.utils.tracing import Tracer
+
+
+class _Collector:
+    """Fake in-process OTLP collector: records every request body."""
+
+    def __init__(self, status: int = 200):
+        self.status = status
+        self.requests: list[tuple[str, dict]] = []
+
+    def transport(self) -> httpx.MockTransport:
+        def handler(request: httpx.Request) -> httpx.Response:
+            self.requests.append(
+                (request.url.path, json.loads(request.content.decode()))
+            )
+            return httpx.Response(self.status)
+
+        return httpx.MockTransport(handler)
+
+    def spans(self) -> list[dict]:
+        out = []
+        for path, body in self.requests:
+            if path != "/v1/traces":
+                continue
+            for rs in body["resourceSpans"]:
+                for ss in rs["scopeSpans"]:
+                    out.extend(ss["spans"])
+        return out
+
+    def metric_names(self) -> set[str]:
+        names = set()
+        for path, body in self.requests:
+            if path != "/v1/metrics":
+                continue
+            for rm in body["resourceMetrics"]:
+                for sm in rm["scopeMetrics"]:
+                    names.update(m["name"] for m in sm["metrics"])
+        return names
+
+
+def _span(i: int = 0, **overrides) -> dict:
+    span = {
+        "name": f"stage-{i}",
+        "trace_id": f"{i:032x}",
+        "span_id": f"{i:016x}",
+        "parent_id": None,
+        "start_unix": 100.0 + i,
+        "duration_s": 0.25,
+        "status": "ok",
+        "attributes": {"lane": 0, "ratio": 0.5, "host": "h", "ok": True},
+        "events": [{"name": "retry", "ts": 100.5, "attributes": {"n": 1}}],
+    }
+    span.update(overrides)
+    return span
+
+
+def _exporter(collector: _Collector, **kwargs) -> OtlpExporter:
+    return OtlpExporter(
+        "http://collector:4318",
+        transport=collector.transport(),
+        walltime=lambda: 1234.0,
+        **kwargs,
+    )
+
+
+# ---------------------------------------------------------------- encoding
+
+
+def test_encode_spans_otlp_shape():
+    payload = encode_spans([_span(1, status="error")], "svc")
+    resource = payload["resourceSpans"][0]
+    attrs = resource["resource"]["attributes"]
+    assert {"key": "service.name", "value": {"stringValue": "svc"}} in attrs
+    span = resource["scopeSpans"][0]["spans"][0]
+    assert span["traceId"] == f"{1:032x}"
+    assert span["status"]["code"] == 2  # STATUS_CODE_ERROR
+    assert span["startTimeUnixNano"] == str(int(101.0 * 1e9))
+    assert span["endTimeUnixNano"] == str(int(101.25 * 1e9))
+    # Typed attribute mapping: bool stays bool, int -> intValue string.
+    by_key = {a["key"]: a["value"] for a in span["attributes"]}
+    assert by_key["ok"] == {"boolValue": True}
+    assert by_key["lane"] == {"intValue": "0"}
+    assert by_key["ratio"] == {"doubleValue": 0.5}
+    assert span["events"][0]["name"] == "retry"
+
+
+def test_encode_metrics_counter_gauge_histogram():
+    registry = MetricsRegistry()
+    counter = registry.counter("reqs_total", "Requests.", ("outcome",))
+    counter.inc(3, outcome="ok")
+    gauge = registry.gauge("depth", "Depth.")
+    gauge.set(7)
+    hist = registry.histogram("lat", "Latency.", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        hist.observe(v)
+    payload = encode_metrics(registry.collect(), "svc", 1000.0)
+    metrics = {
+        m["name"]: m
+        for m in payload["resourceMetrics"][0]["scopeMetrics"][0]["metrics"]
+    }
+    sum_point = metrics["reqs_total"]["sum"]
+    assert sum_point["isMonotonic"] is True
+    assert sum_point["dataPoints"][0]["asDouble"] == 3.0
+    assert metrics["depth"]["gauge"]["dataPoints"][0]["asDouble"] == 7.0
+    hist_point = metrics["lat"]["histogram"]["dataPoints"][0]
+    # Cumulative prometheus buckets {0.1: 1, 1.0: 2} over 3 observations
+    # become per-bucket counts [1, 1, 1] (incl. overflow bucket).
+    assert hist_point["bucketCounts"] == ["1", "1", "1"]
+    assert hist_point["explicitBounds"] == [0.1, 1.0]
+    assert hist_point["count"] == "3"
+    assert hist_point["sum"] == pytest.approx(5.55)
+
+
+# ------------------------------------------------------------ flush behavior
+
+
+async def test_flush_batches_spans_and_metrics_together():
+    collector = _Collector()
+    registry = MetricsRegistry()
+    registry.counter("things_total", "Things.").inc()
+    exporter = _exporter(collector, registry=registry)
+    for i in range(5):
+        exporter.add(_span(i))
+    await exporter.flush()
+    # ONE trace POST carrying all five spans, plus one metrics snapshot.
+    assert [path for path, _ in collector.requests] == [
+        "/v1/traces",
+        "/v1/metrics",
+    ]
+    assert len(collector.spans()) == 5
+    assert "things_total" in collector.metric_names()
+    assert exporter.exported_spans == 5
+    await exporter.close()
+
+
+async def test_queue_bound_drops_newest_and_counts():
+    collector = _Collector()
+    metrics = ExecutorMetrics()
+    exporter = _exporter(collector, max_queue=3, metrics=metrics)
+    for i in range(5):
+        exporter.add(_span(i))
+    assert exporter.dropped_spans == 2
+    text = metrics.registry.render()
+    assert "code_interpreter_otlp_dropped_total 2" in text
+    await exporter.flush()
+    assert len(collector.spans()) == 3  # the oldest three shipped
+    await exporter.close()
+
+
+async def test_export_failure_counts_and_next_flush_continues():
+    collector = _Collector(status=503)
+    metrics = ExecutorMetrics()
+    exporter = _exporter(collector, metrics=metrics)
+    exporter.add(_span(0))
+    await exporter.flush()
+    assert exporter.export_failures == 1
+    text = metrics.registry.render()
+    assert (
+        'code_interpreter_otlp_exports_total{outcome="error",signal="traces"} 1'
+        in text
+    )
+    # The exporter survives and keeps shipping after the collector heals.
+    collector.status = 200
+    exporter.add(_span(1))
+    await exporter.flush()
+    assert exporter.exported_spans == 1
+    await exporter.close()
+
+
+async def test_unreachable_collector_is_counted_not_raised():
+    def handler(request):
+        raise httpx.ConnectError("refused", request=request)
+
+    exporter = OtlpExporter(
+        "http://collector:4318", transport=httpx.MockTransport(handler)
+    )
+    exporter.add(_span(0))
+    await exporter.flush()  # must not raise
+    assert exporter.export_failures == 1
+    await exporter.close()
+
+
+async def test_tracer_hook_feeds_exporter():
+    collector = _Collector()
+    exporter = _exporter(collector)
+    tracer = Tracer(sample_ratio=1.0)
+    tracer.add_exporter(exporter)
+    with tracer.start_trace("unit-otlp-root"):
+        with tracer.span("child"):
+            pass
+    await exporter.flush()
+    names = {s["name"] for s in collector.spans()}
+    assert {"unit-otlp-root", "child"} <= names
+    await exporter.close()
+
+
+# ------------------------------------------------------------- kill switch
+
+
+def test_empty_endpoint_is_a_constructor_error():
+    with pytest.raises(ValueError):
+        OtlpExporter("")
+
+
+def test_application_context_kill_switch_creates_no_exporter():
+    """APP_OTLP_ENDPOINT unset -> ctx.otlp_exporter is None: no object, no
+    queue, no HTTP — the acceptance criterion's zero-export-HTTP half."""
+    from bee_code_interpreter_fs_tpu.application_context import (
+        ApplicationContext,
+    )
+    from bee_code_interpreter_fs_tpu.config import Config
+
+    ctx = ApplicationContext(Config())
+    assert ctx.otlp_exporter is None
+
+
+async def test_close_ships_final_flush():
+    collector = _Collector()
+    exporter = _exporter(collector)
+    exporter.add(_span(0))
+    await exporter.close()
+    assert len(collector.spans()) == 1
+    # Closed exporters drop silently (no queue growth after shutdown).
+    exporter.add(_span(1))
+    with exporter._lock:
+        assert len(exporter._queue) == 0
