@@ -71,6 +71,10 @@ async def main(ctx: ApplicationContext | None = None) -> None:
     ctx.device_health.start()
     if ctx.otlp_exporter is not None:
         ctx.otlp_exporter.start()
+    # Usage-ledger flush loop: per-tenant attribution journals to disk
+    # every APP_USAGE_FLUSH_INTERVAL seconds, so a crash loses at most one
+    # interval of accounting (the kill switch makes start() a no-op).
+    ctx.usage_ledger.start()
 
     try:
         stop_task = asyncio.create_task(stop.wait())
@@ -107,9 +111,12 @@ async def main(ctx: ApplicationContext | None = None) -> None:
             with contextlib.suppress(asyncio.CancelledError):
                 await grpc_task
         # Probe before executor close (it walks the executor's host
-        # inventory); OTLP last so the shutdown's own spans make the final
-        # flush.
+        # inventory); the usage flush loop stops BEFORE executor close so
+        # its final flush races nothing (executor close runs one more —
+        # idempotent — flush for the drain window's last attributions);
+        # OTLP last so the shutdown's own spans make the final flush.
         await ctx.device_health.stop()
+        await ctx.usage_ledger.stop()
         await ctx.code_executor.close()
         if ctx.otlp_exporter is not None:
             await ctx.otlp_exporter.close()

@@ -70,6 +70,16 @@ class ApplicationContext:
         return backend
 
     @cached_property
+    def usage_ledger(self):
+        """Per-tenant usage ledger (services/usage.py): loads the durable
+        journal at construction; __main__ start()s its periodic flush loop
+        (the kill switch yields a disabled ledger — no journal IO, no
+        flush task, record paths no-op)."""
+        from .services.usage import UsageLedger
+
+        return UsageLedger(self.config, metrics=self.metrics)
+
+    @cached_property
     def code_executor(self) -> CodeExecutor:
         return CodeExecutor(
             self.backend,
@@ -77,6 +87,7 @@ class ApplicationContext:
             self.config,
             metrics=self.metrics,
             tracer=self.tracer,
+            usage=self.usage_ledger,
         )
 
     @cached_property

@@ -309,6 +309,32 @@ class Config(BaseModel):
     # A request's limits.output_bytes (below this cap) upgrades truncation
     # to an output_cap violation kill.
     sandbox_max_output_bytes: int = 10485760
+    # -- per-tenant usage metering (services/usage.py) ------------------------
+    # Kill switch for the whole metering plane: 0 restores the pre-metering
+    # behavior byte-for-byte — no ledger, no journal IO, no attribution
+    # fields in Result.phases, no tenant_usage_* metric samples, and
+    # GET /usage answers 404.
+    usage_metering_enabled: bool = True
+    # Where the durable accounting ledger lives (a JSONL journal of
+    # cumulative per-tenant counter lines plus a compacted snapshot).
+    # Empty = a ".usage" dir beside the workspace-file objects under
+    # file_storage_path (the leading dot keeps it out of OBJECT_ID_RE's
+    # namespace, like storage's ".tmp" and the compile cache's dir).
+    usage_journal_path: str = ""
+    # Seconds between journal flushes: a control-plane crash loses at most
+    # this much attribution (the restart replays snapshot + journal).
+    usage_flush_interval: float = 5.0
+    # Max DISTINCT tenants the ledger tracks (and exports as metric
+    # labels); past the cap, further tenants' usage accrues to one
+    # `_overflow` row — the PR 2/PR 8 cardinality discipline, applied to
+    # the billing table (client-minted tenant names must not grow it
+    # without bound).
+    usage_max_tenants: int = 256
+    # Journal size at which a flush compacts: totals rewrite into the
+    # snapshot (tmp+rename, atomic) and the journal truncates. Cumulative
+    # latest-wins journal lines make replay-after-crash idempotent at any
+    # point in this cycle.
+    usage_journal_max_bytes: int = 1048576
     # -- shutdown ------------------------------------------------------------
     # Graceful drain budget on SIGTERM: health flips to NOT_SERVING and new
     # executes shed immediately, then shutdown waits up to this many seconds

@@ -80,6 +80,11 @@ class BatchJob:
     )
     trace_id: str | None = None
     parent_span_id: str | None = None
+    # perf_counter() at submission: lets the dispatcher report each job's
+    # REAL queue wait (batching window + scheduler wait) in its Result
+    # phases — the serial path reports queue_wait, so the fused path must
+    # too, or batched requests look instantaneous on latency dashboards.
+    submitted_at: float = 0.0
 
     def resolve(self, result) -> None:
         if not self.future.done():

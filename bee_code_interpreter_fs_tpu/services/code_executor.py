@@ -72,8 +72,21 @@ from .transfer import (
     TransferStats,
     parse_files_field,
 )
+from .usage import UsageDraft, UsageLedger
 
 logger = logging.getLogger(__name__)
+
+# The ONLY Result.phases keys the phase_seconds latency histogram may
+# observe. Structural fix for a bug class three PRs re-fixed one key at a
+# time (compile_cache_* in PR 6, batch_jobs/batch_index in PR 7, again in
+# PR 8): phases also carries byte counts, cache/demux coordinates, the
+# trace id, and now per-tenant attribution fields (chip_seconds /
+# device_op_seconds) — none of which are latencies. An ALLOWLIST means a
+# new non-latency key is excluded by default instead of poisoning the
+# histogram until someone notices; a new latency phase must be added here
+# deliberately (and the regression test in test_usage.py will catch a
+# histogram observing anything else).
+LATENCY_PHASES = frozenset({"queue_wait", "upload", "exec", "download"})
 
 # True only inside _execute_trusted (the compile-cache pre-warm): the running
 # request's source is control-plane-authored, so it does NOT taint its
@@ -148,6 +161,7 @@ class CodeExecutor:
         scheduler: SandboxScheduler | None = None,
         tracer: Tracer | None = None,
         compile_cache: CompileCacheStore | None = None,
+        usage: UsageLedger | None = None,
     ) -> None:
         self.backend = backend
         self.storage = storage
@@ -181,6 +195,16 @@ class CodeExecutor:
         self.scheduler = scheduler or SandboxScheduler(
             self.config, metrics=self.metrics
         )
+        # Per-tenant usage metering (services/usage.py): every request's
+        # chip-seconds, queue wait, transfer bytes, recompiles, violations,
+        # and request/batch-job counts attributed to its tenant, in a
+        # durable journal-backed ledger. The kill switch constructs a
+        # disabled ledger whose record paths are no-ops (pre-metering
+        # behavior byte-for-byte). Queue wait is attributed by the
+        # scheduler at grant time — only it knows tenant AND true wait.
+        self.usage = usage or UsageLedger(self.config, metrics=self.metrics)
+        if self.usage.enabled:
+            self.scheduler.usage = self.usage
         # Spawn retries mirror the reference's ladder (3 attempts, 0.5s
         # exponential base capped at 5s) with full jitter so parallel refill
         # failures don't re-synchronize into retry waves.
@@ -636,6 +660,9 @@ class CodeExecutor:
             deadline=deadline,
             pool_ready=len(pool),
             jobs=jobs,
+            # Trusted (pre-warm) acquisitions queue like anyone but bill
+            # nobody — internal warmup wait is not a tenant's queue wait.
+            metered=not _trusted_source_var.get(),
         )
         sandbox: Sandbox | None = None
         try:
@@ -822,6 +849,7 @@ class CodeExecutor:
         land on a fresh sandbox and silently drop the session's state.
         """
         env, executor_id = self._normalize_request(env, profile, executor_id)
+        usage_tenant = self._usage_tenant(tenant)
         self._check_admission_open()
         self._inflight += 1
         try:
@@ -865,22 +893,69 @@ class CodeExecutor:
         except CircuitOpenError as e:
             self.metrics.breaker_rejections.inc(chip_count=str(e.lane))
             self.metrics.executions.inc(outcome="rejected")
+            self._usage_request(usage_tenant, "rejected")
             raise
         except LimitExceededError as e:
             self._count_violation(e)
+            # The violating request is billed (its device time landed via
+            # the attempt's draft) AND counted under its violation kind —
+            # the abuse-control feed quotas will read.
+            self._usage_request(
+                usage_tenant, "limit_violation", violation=e.kind
+            )
             raise
         except SessionLimitError:
             # Capacity-cap rejections must be visible on dashboards — a
             # burst of 429s with no counter movement reads as "healthy idle".
             self.metrics.executions.inc(outcome="rejected")
+            self._usage_request(usage_tenant, "rejected")
             raise
         except (ExecutorError, SandboxSpawnError):
             self.metrics.executions.inc(outcome="infra_error")
+            self._usage_request(usage_tenant, "infra_error")
             raise
         finally:
             self._inflight -= 1
-        self._count_execution(result, session=executor_id is not None)
+        self._count_execution(
+            result, session=executor_id is not None, usage_tenant=usage_tenant
+        )
         return result
+
+    def _usage_tenant(self, tenant: str | None) -> str | None:
+        """The normalized tenant name usage accounting records under, or
+        None with the metering kill switch on (every `_usage_request` /
+        `draft` call then no-ops — pre-metering behavior byte-for-byte).
+        Also None for control-plane-authored (trusted) runs: the
+        compile-cache pre-warm's JIT compiles are internal warmup work,
+        and billing them to the default tenant would contaminate the row
+        that bills genuine header-less client requests."""
+        if not self.usage.enabled or _trusted_source_var.get():
+            return None
+        return self.scheduler.normalize_tenant(tenant)
+
+    def _usage_draft(self, tenant: str | None) -> UsageDraft | None:
+        """A per-attempt consumption accumulator, or None when this run
+        is unmetered (kill switch, or trusted control-plane source)."""
+        usage_tenant = self._usage_tenant(tenant)
+        if usage_tenant is None:
+            return None
+        return self.usage.draft(usage_tenant)
+
+    def _usage_request(
+        self,
+        usage_tenant: str | None,
+        outcome: str,
+        *,
+        violation: str | None = None,
+    ) -> None:
+        """Count one LOGICAL request against its tenant (resource usage is
+        billed per attempt by the drafts; the request itself counts exactly
+        once, here at the API surface)."""
+        if usage_tenant is None:
+            return
+        self.usage.add(
+            usage_tenant, requests=1, outcome=outcome, violation=violation
+        )
 
     def _count_violation(self, e: LimitExceededError) -> None:
         """Violation bookkeeping shared by both execute surfaces: the
@@ -968,6 +1043,13 @@ class CodeExecutor:
         EXACT serial path; with the kill switch off, everything does."""
         if self.batcher is None:
             return False
+        if _trusted_source_var.get():
+            # Control-plane-authored runs (the compile-cache pre-warm) stay
+            # serial: coalescing one with tenant jobs would taint the
+            # sandbox mid-pre-warm (harvest admits nothing), and the fused
+            # dispatch's usage billing keys on the batch's tenant — which
+            # an unmetered internal run must not be.
+            return False
         if source_code is None or files:
             return False
         if deadline is not None:
@@ -1036,6 +1118,7 @@ class CodeExecutor:
             parent_span_id=(
                 span.span_id if span is not None and span.recording else None
             ),
+            submitted_at=time.perf_counter(),
         )
         tracing.add_event(
             "batch.enqueue", lane=lane, pending=self.batcher.pending_jobs(key)
@@ -1202,12 +1285,51 @@ class CodeExecutor:
             payload["env"] = dict(key.env)
         if key.limits:
             payload["limits"] = {k: v for k, v in key.limits}
+        usage_tenant = key.tenant if self.usage.enabled else None
+        chips = max(1, sandbox.chip_count or 0)
         exec_start_wall = time.time()
         exec_start = time.perf_counter()
-        body = await self._post_execute_batch(
-            client, base, payload, overall_timeout, sandbox
-        )
+        try:
+            body = await self._post_execute_batch(
+                client, base, payload, overall_timeout, sandbox
+            )
+        except ExecutorError as e:
+            if usage_tenant is not None and getattr(
+                e, "device_may_have_run", True
+            ):
+                # Wire fault mid-dispatch: the fused run consumed (or is
+                # still consuming) real device time — bill the measured
+                # wall, like the serial fault path. The serial fallback's
+                # reruns bill their own consumption separately (the chips
+                # really do run twice). CLEAN REFUSALS are exempt: a 404
+                # (old binary) or 409 (no warm runner) answered without
+                # running anything — billing wall x chips there would
+                # systematically overbill every batch during a rolling
+                # upgrade, on top of the serial rerun's real bill.
+                wall = max(0.0, time.perf_counter() - exec_start)
+                self.usage.add(
+                    usage_tenant,
+                    chip_seconds=wall * chips,
+                    device_op_seconds=wall,
+                )
+            raise
         exec_seconds = time.perf_counter() - exec_start
+        # The fused dispatch's device-op wall, from the executor's own op
+        # window — billed to the batch's ONE tenant (tenant is in the
+        # BatchKey by construction) BEFORE the verdict checks below, so a
+        # batch that violated or aborted still bills the device time it
+        # consumed.
+        device_op = self._reported_device_op([body], fallback=exec_seconds)
+        total_chip_seconds = device_op * chips
+        if usage_tenant is not None:
+            cc_block = body.get("compile_cache")
+            self.usage.add(
+                usage_tenant,
+                chip_seconds=total_chip_seconds,
+                device_op_seconds=device_op,
+                compile_cache_recompiles=self._cc_count(cc_block, "misses"),
+                compile_cache_new_bytes=self._cc_count(cc_block, "new_bytes"),
+            )
         runner_restarted = bool(body.get("runner_restarted"))
         batch_violation = body.get("violation")
         if batch_violation:
@@ -1263,6 +1385,12 @@ class CodeExecutor:
                 f"sandbox {sandbox.id} produced un-demuxable batch-level "
                 f"stdout ({len(body['batch_stdout'])} bytes)"
             )
+        # Apportion the fused run's chip-seconds across its jobs: per-job
+        # exec spans give the weights (equal split when any are absent), so
+        # the jobs' shares sum EXACTLY to the dispatch's total — a tenant's
+        # bill is identical whether its jobs rode the fused or serial path,
+        # and per-job attribution never double-bills or loses time.
+        shares = self._batch_chip_shares(results)
         stats = TransferStats()
         outcomes = await asyncio.gather(
             *(
@@ -1276,18 +1404,57 @@ class CodeExecutor:
                     index=i,
                     batch_jobs=n,
                     exec_start_wall=exec_start_wall,
+                    exec_start_perf=exec_start,
                     exec_seconds=exec_seconds,
                     warm=bool(body.get("warm", False)),
                     stats=stats,
+                    chip_seconds_share=(
+                        total_chip_seconds * shares[i]
+                        if usage_tenant is not None
+                        else None
+                    ),
+                    device_op_share=(
+                        device_op * shares[i]
+                        if usage_tenant is not None
+                        else None
+                    ),
                 )
                 for i, (job, entry) in enumerate(zip(jobs, results))
             )
         )
         stats.emit(self.metrics)
+        if usage_tenant is not None:
+            self.usage.add(
+                usage_tenant,
+                batch_jobs=n,
+                download_bytes=stats.download_bytes,
+            )
         # A clean fused run ends the lane's consecutive-violation streak,
         # exactly like a clean serial run.
         self._violation_strikes.pop(sandbox.chip_count, None)
         return outcomes
+
+    @staticmethod
+    def _batch_chip_shares(results: list) -> list[float]:
+        """Per-job fractions of the fused dispatch's chip-seconds. Weights
+        are the per-job exec spans the demux already carries
+        (device_op_seconds / duration_s); when ANY job's span is absent the
+        whole batch falls back to an equal split — mixing measured weights
+        with invented ones would silently skew every share. Fractions sum
+        to 1.0 by construction."""
+        n = len(results)
+        weights: list[float] = []
+        for entry in results:
+            value = entry.get("device_op_seconds", entry.get("duration_s"))
+            if isinstance(value, (int, float)) and value > 0:
+                weights.append(float(value))
+            else:
+                weights = []
+                break
+        if len(weights) != n or not sum(weights):
+            return [1.0 / n] * n
+        total = sum(weights)
+        return [w / total for w in weights]
 
     async def _post_execute_batch(
         self,
@@ -1311,11 +1478,16 @@ class CodeExecutor:
             )
         if resp.status_code != 200:
             # 404 = old binary without the route, 409 = no warm runner:
-            # either way the serial path is the answer.
-            raise ExecutorError(
+            # either way the serial path is the answer. The server
+            # ANSWERED with a refusal — nothing ran on the device, so
+            # usage billing must not charge wall time for this hop
+            # (device_may_have_run gates the fault-billing path).
+            error = ExecutorError(
                 f"sandbox {sandbox.id} ({base}) /execute-batch -> "
                 f"{resp.status_code}: {resp.text[:300]}"
             )
+            error.device_may_have_run = False
+            raise error
         try:
             return resp.json()
         except ValueError as e:
@@ -1338,6 +1510,9 @@ class CodeExecutor:
         exec_seconds: float,
         warm: bool,
         stats: TransferStats,
+        exec_start_perf: float | None = None,
+        chip_seconds_share: float | None = None,
+        device_op_share: float | None = None,
     ):
         """One job's slice of the batch response → its Result (changed
         files downloaded from its private workdir, hash-negotiated like any
@@ -1413,6 +1588,20 @@ class CodeExecutor:
             "batch_jobs": float(batch_jobs),
             "batch_index": float(index),
         }
+        if exec_start_perf is not None and job.submitted_at:
+            # The job's real pre-exec wait: batching window + scheduler
+            # queue — the fused path's analogue of the serial queue_wait
+            # phase (a latency; it rides the phase_seconds histogram).
+            phases["queue_wait"] = round(
+                max(0.0, exec_start_perf - job.submitted_at), 6
+            )
+        if chip_seconds_share is not None:
+            # This job's apportioned slice of the fused dispatch's
+            # chip-seconds (per-job exec spans weight the split): summed
+            # over the batch these equal the dispatch's total exactly.
+            phases["chip_seconds"] = round(chip_seconds_share, 6)
+        if device_op_share is not None:
+            phases["device_op_seconds"] = round(device_op_share, 6)
         if job.trace_id is not None:
             phases["trace_id"] = job.trace_id
         return Result(
@@ -1445,6 +1634,10 @@ class CodeExecutor:
             source_code, source_file, files, timeout, chip_count, limits
         )
         timer = PhaseTimer()
+        # One draft per ATTEMPT (the retry ladder re-enters here): a failed
+        # attempt consumed real device time and is billed; the logical
+        # request is counted once, at the API surface.
+        usage = self._usage_draft(tenant)
 
         with timer.phase("queue_wait"):
             sandbox = await self._acquire(
@@ -1454,7 +1647,7 @@ class CodeExecutor:
         try:
             result, _continuable = await self._run_on_sandbox(
                 sandbox, source_code, source_file, files, timeout, env, timer,
-                limits=limits_payload, emit=emit,
+                limits=limits_payload, emit=emit, usage=usage,
             )
             # The request completed (user errors included). Whether the
             # sandbox is actually safe to recycle is the server's call —
@@ -1470,6 +1663,10 @@ class CodeExecutor:
             reusable = e.continuable
             raise
         finally:
+            # Attribution commits on EVERY exit — success, violation, or
+            # fault: a request that fails after consuming device time is
+            # still billed (the draft holds whatever the attempt measured).
+            self.usage.commit(usage)
             # Sandbox release off the hot path: recycle the warm device
             # process back into the pool (generation turnover via /reset),
             # or dispose it when it can't be safely reused.
@@ -1515,6 +1712,7 @@ class CodeExecutor:
         timer: PhaseTimer,
         limits: dict | None = None,
         emit=None,
+        usage: UsageDraft | None = None,
     ) -> tuple[Result, bool]:
         """The sandbox round-trip: upload inputs, fan /execute out to every
         host, download changed files. Returns (result, continuable) —
@@ -1552,6 +1750,11 @@ class CodeExecutor:
         hosts = sandbox.host_urls
         transfer = self._transfer_state(sandbox)
         stats = TransferStats()
+        if usage is not None:
+            # The chip multiplier: the sandbox's actual topology (a lane-0
+            # "whatever the sandbox has" request bills what it really
+            # held; CPU sandboxes bill device-op seconds x 1).
+            usage.chips = max(1, sandbox.chip_count or 0)
         with timer.phase("upload"):
             with self.tracer.span("transfer.upload") as upload_span:
                 try:
@@ -1585,6 +1788,9 @@ class CodeExecutor:
                 payload["source_code"] = source_code
             else:
                 payload["source_file"] = source_file
+            if usage is not None:
+                usage.upload_bytes += stats.upload_bytes
+            exec_started = time.perf_counter()
             bodies = await asyncio.gather(
                 *(
                     self._call_host(
@@ -1601,7 +1807,32 @@ class CodeExecutor:
                 (b for b in bodies if isinstance(b, BaseException)), None
             )
             if failure is not None:
+                if usage is not None and getattr(
+                    failure, "device_may_have_run", True
+                ):
+                    # Wire fault mid-exec: the executor's own op clock is
+                    # unreachable, but the device very likely ran (or is
+                    # still running) the whole window — bill the measured
+                    # exec wall, the best evidence available. A request is
+                    # never free just because it faulted. Clean refusals
+                    # (non-200: the server answered without running) are
+                    # exempt — see _post_execute.
+                    usage.device_op_seconds += max(
+                        0.0, time.perf_counter() - exec_started
+                    )
                 raise failure
+            if usage is not None:
+                # Billed from the executor's OWN op window (the
+                # device_op_seconds wire field; duration_s on an older
+                # binary) — NOT control-plane wall, which includes
+                # queueing/transfer. A multi-host slice's hosts run one op
+                # in parallel: the op wall is the slowest host's. Observed
+                # BEFORE the violation check below, so a violating request
+                # still bills the device time it consumed.
+                usage.device_op_seconds += self._reported_device_op(
+                    bodies,
+                    fallback=max(0.0, time.perf_counter() - exec_started),
+                )
             self._raise_on_violation(sandbox, hosts, bodies)
         with timer.phase("download"):
             with self.tracer.span("transfer.download") as download_span:
@@ -1639,6 +1870,19 @@ class CodeExecutor:
         stats.emit(self.metrics)
         phases = {**timer.as_dict(), **stats.as_phases()}
         phases.update(self._compile_cache_phases(sandbox, bodies))
+        if usage is not None:
+            usage.download_bytes += stats.download_bytes
+            usage.compile_cache_recompiles += float(
+                phases.get("compile_cache_misses", 0.0)
+            )
+            usage.compile_cache_new_bytes += float(
+                phases.get("compile_cache_new_bytes", 0.0)
+            )
+            # Per-request attribution fields: what THIS run cost, as
+            # billed. Not latencies — the phase_seconds allowlist keeps
+            # them out of the latency histogram by construction.
+            phases["device_op_seconds"] = round(usage.device_op_seconds, 6)
+            phases["chip_seconds"] = round(usage.chip_seconds, 6)
         # Correlate the response with its trace: clients quote this id at
         # GET /traces/{trace_id} (it also rides the X-Trace-Id header and
         # gRPC trailing metadata). A string among the float phase values —
@@ -1663,6 +1907,35 @@ class CodeExecutor:
         )
         return result, continuable
 
+    @staticmethod
+    def _reported_device_op(bodies: list, fallback: float = 0.0) -> float:
+        """The device-op wall the executor itself measured for this
+        request: `device_op_seconds` from the wire (the op window around
+        the runner round-trip / cold subprocess), `duration_s` from an
+        older binary, control-plane exec wall only when neither answered.
+        Hosts of one slice run the op in parallel — the wall is the max."""
+        values = [
+            body.get("device_op_seconds", body.get("duration_s"))
+            for body in bodies
+            if isinstance(body, dict)
+        ]
+        numbers = [
+            float(v) for v in values if isinstance(v, (int, float)) and v >= 0
+        ]
+        return max(numbers) if numbers else max(0.0, fallback)
+
+    @staticmethod
+    def _cc_count(block, key: str) -> int:
+        """One reading of the executor's `compile_cache` response block:
+        non-dict blocks and non-numeric/negative values read as 0. ONE
+        implementation for the serial and batch paths — a wire-format
+        tweak parsed differently per path would skew batch billing
+        relative to serial, breaking the bill's path-invariance."""
+        if not isinstance(block, dict):
+            return 0
+        value = block.get(key)
+        return int(value) if isinstance(value, (int, float)) and value > 0 else 0
+
     def _compile_cache_phases(
         self, sandbox: Sandbox, bodies: list[dict]
     ) -> dict[str, float]:
@@ -1672,10 +1945,6 @@ class CodeExecutor:
         popped a freshly seeded sandbox also reports what seeding it cost."""
         if not self.compile_cache.enabled:
             return {}
-        def counter(block: dict, key: str) -> int:
-            value = block.get(key)
-            return int(value) if isinstance(value, (int, float)) and value > 0 else 0
-
         hits = misses = new_entries = new_bytes = 0
         seen = False
         for body in bodies:
@@ -1683,10 +1952,10 @@ class CodeExecutor:
             if not isinstance(block, dict):
                 continue
             seen = True
-            hits += counter(block, "hits")
-            misses += counter(block, "misses")
-            new_entries += counter(block, "new_entries")
-            new_bytes += counter(block, "new_bytes")
+            hits += self._cc_count(block, "hits")
+            misses += self._cc_count(block, "misses")
+            new_entries += self._cc_count(block, "new_entries")
+            new_bytes += self._cc_count(block, "new_bytes")
         phases: dict[str, float] = {}
         if seen:
             phases["compile_cache_hits"] = float(hits)
@@ -1779,6 +2048,7 @@ class CodeExecutor:
         the error surfaces and the client decides (same policy as sessions).
         """
         env, executor_id = self._normalize_request(env, profile, executor_id)
+        usage_tenant = self._usage_tenant(tenant)
         self._check_admission_open()
         queue: asyncio.Queue = asyncio.Queue()
         done = object()
@@ -1832,15 +2102,21 @@ class CodeExecutor:
             except CircuitOpenError as e:
                 self.metrics.breaker_rejections.inc(chip_count=str(e.lane))
                 self.metrics.executions.inc(outcome="rejected")
+                self._usage_request(usage_tenant, "rejected")
                 raise
             except LimitExceededError as e:
                 self._count_violation(e)
+                self._usage_request(
+                    usage_tenant, "limit_violation", violation=e.kind
+                )
                 raise
             except SessionLimitError:
                 self.metrics.executions.inc(outcome="rejected")
+                self._usage_request(usage_tenant, "rejected")
                 raise
             except (ExecutorError, SandboxSpawnError):
                 self.metrics.executions.inc(outcome="infra_error")
+                self._usage_request(usage_tenant, "infra_error")
                 raise
         except BaseException:
             task.cancel()
@@ -1849,7 +2125,9 @@ class CodeExecutor:
             raise
         finally:
             self._inflight -= 1
-        self._count_execution(result, session=executor_id is not None)
+        self._count_execution(
+            result, session=executor_id is not None, usage_tenant=usage_tenant
+        )
         yield {"result": result}
 
     def _normalize_request(
@@ -1872,26 +2150,29 @@ class CodeExecutor:
             executor_id = None
         return env, executor_id
 
-    def _count_execution(self, result: Result, *, session: bool) -> None:
-        self.metrics.executions.inc(
-            outcome="ok" if result.exit_code == 0 else "user_error"
-        )
+    def _count_execution(
+        self,
+        result: Result,
+        *,
+        session: bool,
+        usage_tenant: str | None = None,
+    ) -> None:
+        outcome = "ok" if result.exit_code == 0 else "user_error"
+        self.metrics.executions.inc(outcome=outcome)
+        self._usage_request(usage_tenant, outcome)
         if result.warm:
             self.metrics.warm_hits.inc()
         if session:
             self.metrics.session_executions.inc()
         for phase, seconds in result.phases.items():
-            if (
-                phase.endswith("_bytes")
-                or phase.startswith("compile_cache_")
-                or phase.startswith("batch_")
-                or not isinstance(seconds, (int, float))
+            # ALLOWLIST, not exclusion: phases also carries byte counts,
+            # compile-cache/batch coordinates, the trace id, and the usage
+            # attribution fields (chip_seconds/device_op_seconds) — PRs 6,
+            # 7, and 8 each re-fixed a new non-latency key polluting this
+            # histogram; now a key must be a known latency phase to land.
+            if phase not in LATENCY_PHASES or not isinstance(
+                seconds, (int, float)
             ):
-                # Byte counts, the compile-cache hit/miss COUNTS (they have
-                # their own counter family), the batch demux coordinates
-                # (batch_jobs/batch_index — counted in the batch_* counter
-                # family), and the trace id all ride in phases but are not
-                # latencies.
                 continue
             self.metrics.phase_seconds.observe(seconds, phase=phase)
 
@@ -1928,7 +2209,57 @@ class CodeExecutor:
             source_code, source_file, files, timeout, chip_count, limits
         )
         timer = PhaseTimer()
+        # Sessions never retry, so one draft covers the whole request.
+        # The commit lives in the OUTER finally, not the loop body's: the
+        # closed-while-waiting `continue` passes through the inner finally,
+        # and committing there would mark the (still empty) draft spent —
+        # the retry iteration's real consumption would then never bill.
+        usage = self._usage_draft(tenant)
         loop = asyncio.get_running_loop()
+        try:
+            return await self._session_loop(
+                executor_id,
+                lane,
+                source_code,
+                source_file,
+                files,
+                timeout,
+                env,
+                timer,
+                limits_payload,
+                chip_count=chip_count,
+                tenant=tenant,
+                priority=priority,
+                deadline=deadline,
+                emit=emit,
+                usage=usage,
+                loop=loop,
+            )
+        finally:
+            # Attribution commits on EVERY exit — success, violation, or
+            # fault: the draft holds whatever the session run measured.
+            self.usage.commit(usage)
+
+    async def _session_loop(
+        self,
+        executor_id: str,
+        lane: int,
+        source_code,
+        source_file,
+        files,
+        timeout,
+        env,
+        timer: PhaseTimer,
+        limits_payload,
+        *,
+        chip_count,
+        tenant,
+        priority,
+        deadline,
+        emit,
+        usage,
+        loop,
+    ) -> Result:
         while True:
             with timer.phase("queue_wait"):
                 session = await self._get_session(
@@ -1960,6 +2291,7 @@ class CodeExecutor:
                         timer,
                         limits=limits_payload,
                         emit=emit,
+                        usage=usage,
                     )
                 except LimitExceededError as e:
                     # A violation breaks the session either way: the killed
@@ -2341,10 +2673,14 @@ class CodeExecutor:
                     raise ValueError(message)
                 if resp.status_code != 200:
                     text = (await resp.aread()).decode(errors="replace")
-                    raise ExecutorError(
+                    # Refusal before any run — exempt from fault billing
+                    # like _post_execute's non-200 path.
+                    error = ExecutorError(
                         f"sandbox {sandbox.id} ({base}) /execute/stream -> "
                         f"{resp.status_code}: {text[:500]}"
                     )
+                    error.device_may_have_run = False
+                    raise error
                 buffer = ""
                 async for text in resp.aiter_text():
                     buffer += text
@@ -2400,10 +2736,15 @@ class CodeExecutor:
         if resp.status_code == 403:
             raise ValueError(resp.json().get("error", "forbidden path"))
         if resp.status_code != 200:
-            raise ExecutorError(
+            # A non-200 from /execute is a refusal BEFORE any run (the
+            # executor returns 200 even for violations and timeouts):
+            # usage billing must not charge device time for it.
+            error = ExecutorError(
                 f"sandbox {sandbox.id} ({base}) /execute -> {resp.status_code}: "
                 f"{resp.text[:500]}"
             )
+            error.device_may_have_run = False
+            raise error
         try:
             return resp.json()
         except ValueError as e:
@@ -2944,6 +3285,11 @@ class CodeExecutor:
                 "entries": self.compile_cache.entry_count(),
                 "bytes": self.compile_cache.total_bytes(),
             },
+            # The metering plane's own view: per-tenant cumulative counters
+            # plus ledger health (flushes, journal lines, tenant-table
+            # occupancy). Bounded — the tenant table caps at
+            # APP_USAGE_MAX_TENANTS with an _overflow row.
+            "usage": self.usage.snapshot(),
         }
         if self.device_health is not None:
             body["device_health"] = self.device_health.snapshot()
@@ -3220,6 +3566,9 @@ class CodeExecutor:
         # per-harvest saves make this a formality, but a clean shutdown
         # should never depend on the last harvest having had new entries).
         self.compile_cache.save_index()
+        # Final ledger flush: a clean shutdown loses ZERO attribution (the
+        # flush-interval bound is for crashes only).
+        self.usage.close()
         if self._client is not None and not self._client.is_closed:
             await self._client.aclose()
         await self.backend.close()

@@ -780,4 +780,28 @@ f(x, y).block_until_ready()
 print("prewarm batched_dispatch ok", n)
 """,
     ),
+    (
+        # The batch bench's hot small-array shape (scripts/bench_batch.py:
+        # a chained 64x64 matmul — the coalesced small-job workload the
+        # batching lanes exist for), jitted so the whole chain compiles to
+        # ONE cached executable. Fleet coverage scales only with this set
+        # (pre-warm is the store's sole admission source), and a cold
+        # lane's first burst of small jobs is exactly when an XLA compile
+        # inside the batching window hurts most.
+        "small_matmul_chain",
+        """
+import jax, jax.numpy as jnp
+
+@jax.jit
+def chain(x, y):
+    for _ in range(4):
+        x = x @ y
+    return x
+
+x = jnp.ones((64, 64), dtype=jnp.float32)
+y = jnp.eye(64, dtype=jnp.float32)
+chain(x, y).block_until_ready()
+print("prewarm small_matmul_chain ok")
+""",
+    ),
 ]

@@ -151,6 +151,35 @@ class CodeInterpreterServicer:
             )
 
     @staticmethod
+    def _attach_usage_trailing(
+        context: grpc.aio.ServicerContext,
+        trailing: list[tuple[str, str]],
+        result,
+    ) -> None:
+        """Per-request usage attribution on the wire: the proto is frozen
+        (no protoc in the image), so the billed chip-seconds /
+        device-op-seconds ride trailing metadata — the same structured
+        channel x-violation uses. Absent with the metering kill switch off
+        (the phases fields don't exist then): pre-metering trailing
+        metadata, byte-for-byte."""
+        chip = result.phases.get("chip_seconds")
+        device = result.phases.get("device_op_seconds")
+        if not isinstance(chip, (int, float)) and not isinstance(
+            device, (int, float)
+        ):
+            return
+        extra = list(trailing)
+        if isinstance(chip, (int, float)):
+            extra.append(("x-usage-chip-seconds", f"{float(chip):.6f}"))
+        if isinstance(device, (int, float)):
+            extra.append(
+                ("x-usage-device-op-seconds", f"{float(device):.6f}")
+            )
+        set_trailing = getattr(context, "set_trailing_metadata", None)
+        if set_trailing is not None:
+            set_trailing(tuple(extra))
+
+    @staticmethod
     async def _abort_violation(
         context: grpc.aio.ServicerContext,
         e: LimitExceededError,
@@ -258,6 +287,7 @@ class CodeInterpreterServicer:
             except (ExecutorError, SandboxSpawnError) as e:
                 logger.exception("Execute failed [%s]", request_id)
                 await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+            self._attach_usage_trailing(context, trailing, result)
             return self._result_to_response(result)
 
     async def ExecuteStream(
@@ -293,6 +323,9 @@ class CodeInterpreterServicer:
             try:
                 async for event in events:
                     if "result" in event:
+                        self._attach_usage_trailing(
+                            context, trailing, event["result"]
+                        )
                         yield pb2.ExecuteStreamEvent(
                             result=self._result_to_response(event["result"])
                         )

@@ -89,6 +89,127 @@ class ExecuteCustomToolRequest(BaseModel):
     timeout: float | None = Field(default=None, gt=0)
 
 
+def _usage_row_text(tenant: str, row: dict) -> str:
+    """One tenant's ledger line for the text renderers (shared by
+    /statusz?format=text and /usage?format=text)."""
+    violations = row.get("violations") or {}
+    violation_text = (
+        " violations["
+        + " ".join(f"{k}={int(v)}" for k, v in sorted(violations.items()))
+        + "]"
+        if violations
+        else ""
+    )
+    return (
+        f"  {tenant}: chip_s={row.get('chip_seconds', 0.0)} "
+        f"queue_s={row.get('queue_wait_seconds', 0.0)} "
+        f"requests={int(row.get('requests', 0))} "
+        f"batch_jobs={int(row.get('batch_jobs', 0))} "
+        f"up_bytes={int(row.get('upload_bytes', 0))} "
+        f"down_bytes={int(row.get('download_bytes', 0))} "
+        f"recompiles={int(row.get('compile_cache_recompiles', 0))}"
+        + violation_text
+    )
+
+
+def usage_text(body: dict) -> str:
+    """Human-readable GET /usage (`?format=text`)."""
+    if not body.get("enabled", False):
+        return "usage metering: disabled\n"
+    lines = [
+        f"usage metering: tenants={body.get('tenant_count', 0)}"
+        f"/{body.get('max_tenants', 0)} "
+        f"flushes={body.get('flushes', 0)} "
+        f"journal_lines={body.get('journal_lines', 0)}",
+    ]
+    tenants = body.get("tenants", {})
+    if tenants:
+        for tenant, row in sorted(tenants.items()):
+            lines.append(_usage_row_text(tenant, row))
+    else:
+        lines.append("  (no usage recorded)")
+    return "\n".join(lines) + "\n"
+
+
+def statusz_text(body: dict) -> str:
+    """Human-readable /statusz (`?format=text`): the at-a-glance view
+    that replaces the ssh-and-grep loop onchip_watch.sh encoded.
+    Module-level (not a handler closure) so the renderer is directly
+    testable against edge-case bodies — empty fleet, overflow rows,
+    wedged hosts with evidence."""
+    lines = [
+        f"status: {body.get('status', 'unknown')}   "
+        f"inflight: {body.get('inflight', 0)}",
+        "",
+        "lanes:",
+    ]
+    for lane, entry in sorted(body.get("lanes", {}).items()):
+        lines.append(
+            f"  lane {lane}: pool={entry.get('pool_depth', 0)} "
+            f"in_use={entry.get('in_use', 0)} "
+            f"sessions={entry.get('session_held', 0)} "
+            f"spawning={entry.get('spawning', 0)} "
+            f"queued={entry.get('queued', 0)} "
+            f"wait_ewma={entry.get('queue_wait_ewma_s', 0.0)}s "
+            f"batch_occ={entry.get('batch_occupancy', 0.0)} "
+            f"breaker={entry.get('breaker', 'closed')}"
+        )
+    if not body.get("lanes"):
+        lines.append("  (no lanes)")
+    health = body.get("device_health", {})
+    lines.append("")
+    if health.get("enabled"):
+        states = health.get("states", {})
+        lines.append(
+            "device health: "
+            + " ".join(f"{k}={v}" for k, v in states.items())
+            + f"   last_poll_age={health.get('last_poll_age_s')}s"
+        )
+        for host in health.get("hosts", ()):
+            marker = "!!" if host.get("state") == "wedged" else "  "
+            lines.append(
+                f"{marker}lane {host.get('lane')} {host.get('host')} "
+                f"[{host.get('state')}]"
+                + (f" {host['reason']}" if host.get("reason") else "")
+                + (
+                    f" stall={host['stall_s']}s"
+                    if host.get("stall_s")
+                    else ""
+                )
+            )
+    else:
+        lines.append("device health: probe disabled")
+    cc = body.get("compile_cache", {})
+    lines.append(
+        f"compile cache: enabled={cc.get('enabled')} "
+        f"entries={cc.get('entries')} bytes={cc.get('bytes')}"
+    )
+    otlp = body.get("otlp", {})
+    if otlp.get("enabled"):
+        lines.append(
+            f"otlp: {otlp.get('endpoint')} queued={otlp.get('queued_spans')} "
+            f"exported={otlp.get('exported_spans')} "
+            f"dropped={otlp.get('dropped_spans')} "
+            f"failures={otlp.get('export_failures')}"
+        )
+    else:
+        lines.append("otlp: disabled")
+    usage = body.get("usage", {})
+    if usage.get("enabled"):
+        lines.append(
+            f"usage: tenants={usage.get('tenant_count', 0)}"
+            f"/{usage.get('max_tenants', 0)} "
+            f"flushes={usage.get('flushes', 0)}"
+        )
+        for tenant, row in sorted(usage.get("tenants", {}).items()):
+            lines.append(_usage_row_text(tenant, row))
+    else:
+        lines.append("usage: metering disabled")
+    sessions = body.get("sessions", ())
+    lines.append(f"sessions: {len(sessions)}")
+    return "\n".join(lines) + "\n"
+
+
 def create_http_app(
     code_executor: CodeExecutor,
     custom_tool_executor: CustomToolExecutor,
@@ -321,67 +442,6 @@ def create_http_app(
             }
         )
 
-    def statusz_text(body: dict) -> str:
-        """Human-readable /statusz (`?format=text`): the at-a-glance view
-        that replaces the ssh-and-grep loop onchip_watch.sh encoded."""
-        lines = [
-            f"status: {body['status']}   inflight: {body['inflight']}",
-            "",
-            "lanes:",
-        ]
-        for lane, entry in sorted(body.get("lanes", {}).items()):
-            lines.append(
-                f"  lane {lane}: pool={entry.get('pool_depth', 0)} "
-                f"in_use={entry.get('in_use', 0)} "
-                f"sessions={entry.get('session_held', 0)} "
-                f"spawning={entry.get('spawning', 0)} "
-                f"queued={entry.get('queued', 0)} "
-                f"wait_ewma={entry.get('queue_wait_ewma_s', 0.0)}s "
-                f"batch_occ={entry.get('batch_occupancy', 0.0)} "
-                f"breaker={entry.get('breaker', 'closed')}"
-            )
-        health = body.get("device_health", {})
-        lines.append("")
-        if health.get("enabled"):
-            states = health.get("states", {})
-            lines.append(
-                "device health: "
-                + " ".join(f"{k}={v}" for k, v in states.items())
-                + f"   last_poll_age={health.get('last_poll_age_s')}s"
-            )
-            for host in health.get("hosts", ()):
-                marker = "!!" if host["state"] == "wedged" else "  "
-                lines.append(
-                    f"{marker}lane {host['lane']} {host['host']} "
-                    f"[{host['state']}]"
-                    + (f" {host['reason']}" if host.get("reason") else "")
-                    + (
-                        f" stall={host['stall_s']}s"
-                        if host.get("stall_s")
-                        else ""
-                    )
-                )
-        else:
-            lines.append("device health: probe disabled")
-        cc = body.get("compile_cache", {})
-        lines.append(
-            f"compile cache: enabled={cc.get('enabled')} "
-            f"entries={cc.get('entries')} bytes={cc.get('bytes')}"
-        )
-        otlp = body.get("otlp", {})
-        if otlp.get("enabled"):
-            lines.append(
-                f"otlp: {otlp.get('endpoint')} queued={otlp.get('queued_spans')} "
-                f"exported={otlp.get('exported_spans')} "
-                f"dropped={otlp.get('dropped_spans')} "
-                f"failures={otlp.get('export_failures')}"
-            )
-        else:
-            lines.append("otlp: disabled")
-        sessions = body.get("sessions", ())
-        lines.append(f"sessions: {len(sessions)}")
-        return "\n".join(lines) + "\n"
-
     @routes.get("/statusz")
     async def statusz(request: web.Request) -> web.Response:
         """Consolidated operator status: lanes (queue pressure, pool depth,
@@ -392,6 +452,47 @@ def create_http_app(
         body = code_executor.statusz()
         if request.query.get("format") == "text":
             return web.Response(text=statusz_text(body))
+        return web.json_response(body)
+
+    @routes.get("/usage")
+    async def usage(request: web.Request) -> web.Response:
+        """Per-tenant usage accounting: every tenant's cumulative
+        chip-seconds, queue wait, transfer bytes, recompiles, violations,
+        and request/batch-job counts, straight from the durable ledger
+        (services/usage.py). `?format=text` renders the operator view.
+        With the metering kill switch off this surface answers 404 —
+        pre-metering behavior, byte-for-byte."""
+        if not code_executor.usage.enabled:
+            return web.json_response(
+                {"error": "usage metering is disabled "
+                          "(APP_USAGE_METERING_ENABLED=0)"},
+                status=404,
+            )
+        body = code_executor.usage.snapshot()
+        if request.query.get("format") == "text":
+            return web.Response(text=usage_text(body))
+        return web.json_response(body)
+
+    @routes.get("/usage/{tenant}")
+    async def usage_tenant(request: web.Request) -> web.Response:
+        """One tenant's ledger row. A tenant past the cardinality cap
+        accrues under `_overflow` — query that row for the aggregate."""
+        if not code_executor.usage.enabled:
+            return web.json_response(
+                {"error": "usage metering is disabled "
+                          "(APP_USAGE_METERING_ENABLED=0)"},
+                status=404,
+            )
+        tenant = request.match_info["tenant"]
+        row = code_executor.usage.tenant_snapshot(tenant)
+        if row is None:
+            return web.json_response(
+                {"error": f"no usage recorded for tenant {tenant!r}"},
+                status=404,
+            )
+        body = {"tenant": tenant, "usage": row}
+        if request.query.get("format") == "text":
+            return web.Response(text=_usage_row_text(tenant, row) + "\n")
         return web.json_response(body)
 
     def validate_execute(req: ExecuteRequest) -> web.Response | None:

@@ -104,6 +104,10 @@ class Ticket:
     # so fairness and the wait estimators account it as N requests served
     # by one grant.
     jobs: int = 1
+    # False for control-plane-internal acquisitions (the compile-cache
+    # pre-warm): fairness and estimators treat them like any request, but
+    # their queue wait never bills a tenant's usage ledger row.
+    metered: bool = True
     granted: bool = False
     done: bool = False
     event: asyncio.Event = field(default_factory=asyncio.Event)
@@ -166,6 +170,12 @@ class SandboxScheduler:
         self.config = config or Config()
         self.clock = clock
         self.metrics = metrics
+        # Per-tenant usage ledger (services/usage.py), bound by the
+        # executor after construction: queue wait is attributed HERE, at
+        # grant time, because only the scheduler knows both the tenant and
+        # the true wait (the executor's queue_wait phase includes session
+        # lock waits and other non-scheduler time). None = metering off.
+        self.usage = None
         self.default_tenant = self.config.scheduler_default_tenant or "shared"
         self.weights = dict(self.config.scheduler_tenant_weights)
         self.max_depth = max(1, self.config.scheduler_max_queue_depth)
@@ -328,6 +338,7 @@ class SandboxScheduler:
         deadline: float | None = None,
         pool_ready: int = 0,
         jobs: int = 1,
+        metered: bool = True,
     ) -> Ticket:
         """Admit one acquisition into the lane's queue, or shed it.
 
@@ -386,6 +397,7 @@ class SandboxScheduler:
             seq=next(self._seq),
             deadline_at=None if deadline is None else now + deadline,
             jobs=max(1, jobs),
+            metered=metered,
         )
         state.tickets.append(ticket)
         # submit() runs in the requesting task's context, so the event lands
@@ -553,6 +565,16 @@ class SandboxScheduler:
                 state.interactive_run = 0
             wait = max(0.0, self.now() - ticket.enqueued_at)
             state.queue_wait_ewma.observe(wait)
+            if self.usage is not None and ticket.metered:
+                # A multi-job batch ticket is ONE queue position serving N
+                # requests: each of those requests waited this long, so the
+                # tenant's queue-wait bill counts the wait once per request
+                # (mirroring how grants count requests, not tickets).
+                # Unmetered (control-plane-internal) tickets bill nobody.
+                self.usage.add(
+                    ticket.tenant,
+                    queue_wait_seconds=wait * max(1, ticket.jobs),
+                )
             tenant_label = self._metric_tenant(ticket.tenant, claim=True)
             grants = getattr(self.metrics, "scheduler_grants", None)
             if grants is not None:

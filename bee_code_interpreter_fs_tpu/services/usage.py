@@ -1,0 +1,572 @@
+"""Per-tenant usage metering: chip-second attribution with a durable ledger.
+
+Before this module, tenants existed only as labels on rate counters — no
+answer to "what did tenant X consume this month" survived a restart, and
+the upcoming quota/abuse-control layer had nothing to enforce against.
+This is the billing-grade half of the ROADMAP's production-multi-tenancy
+item: every request's consumption is attributed to its tenant and folded
+into monotonic counters that persist across control-plane restarts.
+
+What is metered (all cumulative, all monotonic):
+
+- ``chip_seconds`` — chip_count x device-op wall time, from the executor's
+  own op window (the ``device_op_seconds`` wire field; NOT control-plane
+  wall, which includes queueing). Batched dispatches apportion the fused
+  run's chip-seconds across the batch's jobs by their per-job exec spans
+  (equal split when absent), so a tenant's bill is identical whether its
+  jobs rode the fused or serial path. Requests that fault or violate a
+  limit AFTER consuming device time are still billed.
+- ``device_op_seconds`` — the un-multiplied op wall (chip_seconds without
+  the chip factor; useful to sanity-check the multiplier).
+- ``queue_wait_seconds`` — scheduler queue wait, attributed at grant time
+  (a multi-job batch ticket bills its wait once per request it served).
+- ``upload_bytes`` / ``download_bytes`` — transfer bytes actually MOVED
+  (the PR 3 counters' moved-vs-skipped distinction: negotiated-away bytes
+  cost nothing and bill nothing).
+- ``compile_cache_recompiles`` / ``compile_cache_new_bytes`` — kernels the
+  tenant's runs had to compile (persistent-cache misses) and the cache
+  bytes those compilations produced.
+- ``requests`` (+ per-``outcome`` counts) and ``batch_jobs``, plus typed
+  limit ``violations`` by kind.
+
+Durability: the in-memory table is the truth; every flush interval, each
+dirty tenant appends ONE cumulative JSONL line to the journal
+(latest-wins — replay is idempotent no matter where a crash landed), and
+when the journal outgrows its bound a compaction rewrites the snapshot
+(tmp+rename, atomic) and truncates the journal. A SIGKILL at any point
+loses at most one flush interval of attribution; a torn tail line is
+detected (bad JSON) and skipped.
+
+Cardinality: the tenant table is bounded (``APP_USAGE_MAX_TENANTS``); past
+the cap new tenants' usage accrues to one ``_overflow`` row — the same
+discipline as the scheduler's metric-tenant cap and the device-health
+host-label cap. The kill switch (``APP_USAGE_METERING_ENABLED=0``)
+restores pre-metering behavior byte-for-byte: no ledger object state, no
+journal IO, no metric samples, 404 on ``GET /usage``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
+
+OVERFLOW_TENANT = "_overflow"
+
+# Scalar counter fields, in render order. dict-valued counters (outcomes,
+# violations) are handled alongside but keyed by their own label.
+COUNTER_FIELDS = (
+    "chip_seconds",
+    "device_op_seconds",
+    "queue_wait_seconds",
+    "upload_bytes",
+    "download_bytes",
+    "compile_cache_recompiles",
+    "compile_cache_new_bytes",
+    "requests",
+    "batch_jobs",
+)
+
+
+@dataclass
+class TenantUsage:
+    """One tenant's cumulative counters. Monotonic: nothing here ever
+    decreases — merge-on-load takes the elementwise max, so replaying a
+    journal over a snapshot (or a stale line after a newer one) is
+    idempotent."""
+
+    chip_seconds: float = 0.0
+    device_op_seconds: float = 0.0
+    queue_wait_seconds: float = 0.0
+    upload_bytes: float = 0.0
+    download_bytes: float = 0.0
+    compile_cache_recompiles: float = 0.0
+    compile_cache_new_bytes: float = 0.0
+    requests: float = 0.0
+    batch_jobs: float = 0.0
+    outcomes: dict[str, float] = field(default_factory=dict)
+    violations: dict[str, float] = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        body: dict = {
+            name: round(getattr(self, name), 6) for name in COUNTER_FIELDS
+        }
+        body["outcomes"] = {k: v for k, v in sorted(self.outcomes.items())}
+        body["violations"] = {k: v for k, v in sorted(self.violations.items())}
+        return body
+
+    def merge_max(self, other: dict) -> None:
+        """Fold a persisted counter dict in, taking the elementwise max —
+        the idempotent merge for monotonic counters (a replayed older line
+        can never roll a newer value back)."""
+        for name in COUNTER_FIELDS:
+            value = other.get(name)
+            if isinstance(value, (int, float)):
+                setattr(self, name, max(getattr(self, name), float(value)))
+        for attr in ("outcomes", "violations"):
+            table = other.get(attr)
+            if isinstance(table, dict):
+                mine = getattr(self, attr)
+                for key, value in table.items():
+                    if isinstance(value, (int, float)):
+                        mine[str(key)] = max(
+                            mine.get(str(key), 0.0), float(value)
+                        )
+
+
+@dataclass
+class UsageDraft:
+    """One request attempt's consumption, accumulated as the pipeline
+    learns it and committed to the ledger in one call. A draft per ATTEMPT
+    (the retry ladder creates a fresh one per try): a failed attempt
+    consumed real device time and is billed; the logical request is
+    counted once, at the API surface."""
+
+    tenant: str
+    chips: int = 1
+    device_op_seconds: float = 0.0
+    upload_bytes: float = 0.0
+    download_bytes: float = 0.0
+    compile_cache_recompiles: float = 0.0
+    compile_cache_new_bytes: float = 0.0
+    batch_jobs: float = 0.0
+    committed: bool = False
+
+    @property
+    def chip_seconds(self) -> float:
+        return self.device_op_seconds * max(1, self.chips)
+
+
+class UsageLedger:
+    """The per-tenant accounting table plus its durability machinery.
+
+    Event-loop-discipline like the scheduler: all mutation happens on the
+    control plane's single loop; journal writes are small synchronous
+    appends (one line per dirty tenant per flush)."""
+
+    def __init__(
+        self,
+        config=None,
+        *,
+        metrics=None,
+        walltime=time.time,
+    ) -> None:
+        from ..config import Config
+
+        self.config = config or Config()
+        self.metrics = metrics
+        self.walltime = walltime
+        self.enabled = bool(self.config.usage_metering_enabled)
+        self.max_tenants = max(1, self.config.usage_max_tenants)
+        self.flush_interval = max(0.1, self.config.usage_flush_interval)
+        self.journal_max_bytes = max(4096, self.config.usage_journal_max_bytes)
+        self._tenants: dict[str, TenantUsage] = {}
+        self._dirty: set[str] = set()
+        self._task: asyncio.Task | None = None
+        # The in-flight worker-thread write, if any: stop() must wait it
+        # out before the final synchronous flush, or the thread's late
+        # compaction could truncate the journal using a snapshot built
+        # BEFORE the final flush's counters — erasing them from disk.
+        self._write_future: asyncio.Future | None = None
+        self._closed = False
+        self.started_at = walltime()
+        # Self-observability for /statusz.
+        self.flushes = 0
+        self.journal_lines = 0
+        self.compactions = 0
+        self.load_errors = 0
+        if not self.enabled:
+            # Kill switch: no directory, no load, no IO — the object exists
+            # only so callers can hold a reference without None checks.
+            self._dir = None
+            return
+        base = self.config.usage_journal_path or os.path.join(
+            self.config.file_storage_path, ".usage"
+        )
+        self._dir = base
+        os.makedirs(base, exist_ok=True)
+        self._load()
+
+    # --------------------------------------------------------------- recording
+
+    def _resolve(self, tenant: str) -> tuple[str, TenantUsage]:
+        """THE tenant-cap rule, in one place: the row `tenant`'s usage
+        lands on and its name (which is also the metric label — ledger
+        row and metric series can never diverge). A tenant with an
+        existing row keeps it; a new tenant past the cap lands on
+        `_overflow` — bounded table, but billing never drops
+        consumption."""
+        row = self._tenants.get(tenant)
+        if row is not None:
+            return tenant, row
+        if (
+            tenant != OVERFLOW_TENANT
+            and len(self._tenants) >= self.max_tenants
+        ):
+            return self._resolve(OVERFLOW_TENANT)
+        row = TenantUsage()
+        self._tenants[tenant] = row
+        return tenant, row
+
+    def _restore_row(self, tenant: str) -> TenantUsage:
+        """Load-path row accessor: persisted rows restore VERBATIM, never
+        re-capped. The previous process already enforced its cap when it
+        wrote them (the live table legitimately holds max_tenants real
+        rows plus `_overflow`); rerouting the last one through `_resolve`'s
+        cap on replay would max-merge a real tenant's bill into the
+        overflow row — silently destroying it on every restart. A cap
+        LOWERED between restarts keeps the old rows too (bills are never
+        dropped); only NEW tenants feel the new bound."""
+        row = self._tenants.get(tenant)
+        if row is None:
+            row = TenantUsage()
+            self._tenants[tenant] = row
+        return row
+
+    def add(
+        self,
+        tenant: str,
+        *,
+        chip_seconds: float = 0.0,
+        device_op_seconds: float = 0.0,
+        queue_wait_seconds: float = 0.0,
+        upload_bytes: float = 0.0,
+        download_bytes: float = 0.0,
+        compile_cache_recompiles: float = 0.0,
+        compile_cache_new_bytes: float = 0.0,
+        requests: float = 0.0,
+        batch_jobs: float = 0.0,
+        outcome: str | None = None,
+        violation: str | None = None,
+    ) -> None:
+        """Fold one increment set into the tenant's counters (all values
+        non-negative; negatives are clamped — monotonicity is the ledger's
+        core contract)."""
+        if not self.enabled:
+            return
+        label, row = self._resolve(tenant)
+        increments = {
+            "chip_seconds": chip_seconds,
+            "device_op_seconds": device_op_seconds,
+            "queue_wait_seconds": queue_wait_seconds,
+            "upload_bytes": upload_bytes,
+            "download_bytes": download_bytes,
+            "compile_cache_recompiles": compile_cache_recompiles,
+            "compile_cache_new_bytes": compile_cache_new_bytes,
+            "requests": requests,
+            "batch_jobs": batch_jobs,
+        }
+        for name, amount in increments.items():
+            if amount and amount > 0:
+                setattr(row, name, getattr(row, name) + float(amount))
+        if outcome:
+            row.outcomes[outcome] = row.outcomes.get(outcome, 0.0) + 1.0
+        if violation:
+            row.violations[violation] = row.violations.get(violation, 0.0) + 1.0
+        self._dirty.add(label)
+        if self.metrics is not None:
+            self.metrics.record_tenant_usage(
+                label,
+                increments,
+                outcome=outcome,
+                violation=violation,
+            )
+
+    def draft(self, tenant: str, chips: int = 1) -> UsageDraft | None:
+        """A per-attempt accumulator, or None with the kill switch on (the
+        pipeline's `if draft is not None` guards keep the disabled path
+        byte-for-byte identical to pre-metering behavior)."""
+        if not self.enabled:
+            return None
+        return UsageDraft(tenant=tenant, chips=max(1, chips))
+
+    def commit(self, draft: UsageDraft | None) -> None:
+        """Record one attempt's accumulated consumption (no request count —
+        the API surface counts the logical request exactly once).
+        Idempotent: a draft commits at most once, whatever path exits."""
+        if draft is None or not self.enabled or draft.committed:
+            return
+        draft.committed = True
+        if not (
+            draft.device_op_seconds
+            or draft.upload_bytes
+            or draft.download_bytes
+            or draft.compile_cache_recompiles
+            or draft.compile_cache_new_bytes
+            or draft.batch_jobs
+        ):
+            return
+        self.add(
+            draft.tenant,
+            chip_seconds=draft.chip_seconds,
+            device_op_seconds=draft.device_op_seconds,
+            upload_bytes=draft.upload_bytes,
+            download_bytes=draft.download_bytes,
+            compile_cache_recompiles=draft.compile_cache_recompiles,
+            compile_cache_new_bytes=draft.compile_cache_new_bytes,
+            batch_jobs=draft.batch_jobs,
+        )
+
+    # ---------------------------------------------------------------- surfaces
+
+    def tenant_snapshot(self, tenant: str) -> dict | None:
+        row = self._tenants.get(tenant)
+        return row.as_dict() if row is not None else None
+
+    def snapshot(self) -> dict:
+        """The GET /usage body (and the /statusz usage section's source):
+        every tenant row plus the ledger's own health."""
+        return {
+            "enabled": self.enabled,
+            "since_unix": round(self.started_at, 3),
+            "flush_interval_s": self.flush_interval,
+            "tenants": {
+                tenant: row.as_dict()
+                for tenant, row in sorted(self._tenants.items())
+            },
+            "tenant_count": len(self._tenants),
+            "max_tenants": self.max_tenants,
+            "flushes": self.flushes,
+            "journal_lines": self.journal_lines,
+            "compactions": self.compactions,
+        }
+
+    # -------------------------------------------------------------- durability
+
+    @property
+    def journal_path(self) -> str | None:
+        return os.path.join(self._dir, "journal.jsonl") if self._dir else None
+
+    @property
+    def snapshot_path(self) -> str | None:
+        return os.path.join(self._dir, "snapshot.json") if self._dir else None
+
+    def _load(self) -> None:
+        """Rebuild the table: snapshot first, then journal lines on top.
+        Cumulative latest-wins lines + elementwise-max merge make the
+        replay exact no matter where the previous process died."""
+        try:
+            with open(self.snapshot_path, encoding="utf-8") as f:
+                body = json.load(f)
+            tenants = body.get("tenants", {})
+            if isinstance(tenants, dict):
+                for tenant, counters in tenants.items():
+                    if isinstance(counters, dict):
+                        self._restore_row(str(tenant)).merge_max(counters)
+        except FileNotFoundError:
+            pass
+        except (json.JSONDecodeError, OSError):
+            self.load_errors += 1
+            logger.warning(
+                "usage snapshot unreadable; continuing from the journal",
+                exc_info=True,
+            )
+        try:
+            with open(self.journal_path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        entry = json.loads(line)
+                    except json.JSONDecodeError:
+                        # A torn tail line (SIGKILL mid-write): everything
+                        # before it already replayed; at most one flush
+                        # interval of attribution is gone — the documented
+                        # durability bound.
+                        self.load_errors += 1
+                        logger.warning(
+                            "skipping torn usage-journal line (%d bytes)",
+                            len(line),
+                        )
+                        continue
+                    tenant = entry.get("tenant")
+                    counters = entry.get("usage")
+                    if isinstance(tenant, str) and isinstance(counters, dict):
+                        self._restore_row(tenant).merge_max(counters)
+        except FileNotFoundError:
+            pass
+        except OSError:
+            self.load_errors += 1
+            logger.warning("usage journal unreadable", exc_info=True)
+        if self._tenants:
+            logger.info(
+                "usage ledger restored %d tenant row(s) from %s",
+                len(self._tenants),
+                self._dir,
+            )
+
+    def _prepare_flush(self) -> dict | None:
+        """ON-LOOP half of a flush: drain the dirty set and serialize the
+        rows while no other code can mutate them (single event loop), so
+        the IO half can run on a worker thread without racing `add()`.
+        The full-table snapshot rides along in case the write side decides
+        to compact. Returns None when there is nothing to write."""
+        if not self.enabled or not self._dirty:
+            return None
+        dirty = sorted(self._dirty)
+        self._dirty.clear()
+        now = self.walltime()
+        lines = [
+            json.dumps(
+                {
+                    "tenant": tenant,
+                    "usage": self._tenants[tenant].as_dict(),
+                    "ts": round(now, 3),
+                },
+                sort_keys=True,
+            )
+            for tenant in dirty
+            if tenant in self._tenants
+        ]
+        if not lines:
+            return None
+        snapshot_body = {
+            "version": 1,
+            "ts": round(now, 3),
+            "tenants": {
+                tenant: row.as_dict() for tenant, row in self._tenants.items()
+            },
+        }
+        return {"dirty": dirty, "lines": lines, "snapshot": snapshot_body}
+
+    def _write_flush(self, payload: dict) -> int:
+        """IO half of a flush (thread-safe: touches only files and
+        GIL-atomic counters/sets). Append failure re-marks the tenants
+        dirty — their lines never reached disk, so the next cycle retries.
+        Compaction failure does NOT: the appended lines are already
+        durable, and re-marking them would re-append identical lines every
+        interval while (say) ENOSPC keeps the snapshot write failing —
+        growing the journal without bound exactly when disk is short."""
+        dirty, lines = payload["dirty"], payload["lines"]
+        try:
+            with open(self.journal_path, "a", encoding="utf-8") as f:
+                f.write("\n".join(lines) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            self._dirty.update(dirty)
+            logger.warning("usage journal flush failed", exc_info=True)
+            return 0
+        self.journal_lines += len(lines)
+        self.flushes += 1
+        try:
+            if os.path.getsize(self.journal_path) > self.journal_max_bytes:
+                self._compact(payload["snapshot"])
+        except OSError:
+            logger.warning(
+                "usage journal compaction failed; journal keeps growing "
+                "until a later compaction succeeds (replay stays exact)",
+                exc_info=True,
+            )
+        return len(lines)
+
+    def flush(self) -> int:
+        """Append one cumulative line per dirty tenant; compact when the
+        journal outgrows its bound. Returns lines written. Never raises —
+        a full disk degrades durability, not serving. Synchronous (tests,
+        close()); the flush daemon uses `flush_off_loop` so fsync latency
+        never stalls the serving event loop."""
+        payload = self._prepare_flush()
+        if payload is None:
+            return 0
+        return self._write_flush(payload)
+
+    async def flush_off_loop(self) -> int:
+        """The daemon's flush: rows serialize on-loop (no concurrent
+        mutation), the write+fsync (up to 100ms+ on a throttled disk)
+        runs on a worker thread — in-flight requests never pay for
+        telemetry durability. The thread future is tracked so stop() can
+        wait it out: cancelling a task awaiting to_thread returns
+        immediately while the THREAD keeps running."""
+        payload = self._prepare_flush()
+        if payload is None:
+            return 0
+        future = asyncio.ensure_future(
+            asyncio.to_thread(self._write_flush, payload)
+        )
+        self._write_future = future
+        try:
+            return await asyncio.shield(future)
+        finally:
+            if future.done():
+                self._write_future = None
+
+    def _compact(self, snapshot_body: dict) -> None:
+        """Fold the passed table snapshot into the snapshot file (atomic
+        tmp+rename) and truncate the journal. A crash between the two
+        replays the stale journal over the fresh snapshot — idempotent by
+        the max-merge. The tmp file is removed on failure so a dead
+        partial write can't linger."""
+        tmp = self.snapshot_path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(snapshot_body, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.snapshot_path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        with open(self.journal_path, "w", encoding="utf-8") as f:
+            f.flush()
+            os.fsync(f.fileno())
+        self.compactions += 1
+
+    # -------------------------------------------------------------- flush loop
+
+    def start(self) -> asyncio.Task | None:
+        """Run periodic flushes until stop()/close() — the device_health-
+        style daemon half; __main__ owns the lifecycle. Disabled ledgers
+        return None (no task, no IO)."""
+        if not self.enabled or self._task is not None:
+            return self._task
+
+        async def loop() -> None:
+            while not self._closed:
+                await asyncio.sleep(self.flush_interval)
+                try:
+                    await self.flush_off_loop()
+                except Exception:  # noqa: BLE001 — metering must never die
+                    logger.exception("usage flush cycle failed")
+
+        self._task = asyncio.get_running_loop().create_task(loop())
+        return self._task
+
+    async def stop(self) -> None:
+        """Stop the flush loop and ship a final flush (restart-safe).
+        An in-flight worker-thread write is AWAITED first: the final
+        flush must strictly follow it, or the thread's late compaction
+        would truncate the journal with a pre-final-flush snapshot and
+        erase the drain window's attribution."""
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        write = self._write_future
+        if write is not None and not write.done():
+            await asyncio.gather(write, return_exceptions=True)
+        self._write_future = None
+        self._closed = False
+        self.flush()
+
+    def close(self) -> None:
+        """Synchronous final flush (the executor's close path — by then the
+        loop task is already stopped or was never started)."""
+        self._closed = True
+        try:
+            self.flush()
+        except Exception:  # noqa: BLE001
+            logger.exception("final usage flush failed")

@@ -496,6 +496,49 @@ class ExecutorMetrics:
             "Spans dropped at the OTLP exporter's bounded queue "
             "(backpressure): the collector is not keeping up.",
         )
+        # Per-tenant usage metering (services/usage.py): the ledger's
+        # monotonic counters mirrored as metric families so the billing
+        # signal rides the existing scrape + OTLP export paths. Tenant
+        # labels share the ledger's own bounded table (`_overflow` past
+        # the cap) — the ledger hands this registry the ALREADY-capped
+        # label, so metric cardinality can never outgrow the bill.
+        self.tenant_usage_seconds = self.registry.counter(
+            "code_interpreter_tenant_usage_seconds_total",
+            "Per-tenant accrued seconds by resource: chip (chip_count x "
+            "device-op wall — the billing signal), device_op (the "
+            "un-multiplied op wall), queue_wait (scheduler queue time).",
+            ("tenant", "resource"),
+        )
+        self.tenant_usage_bytes = self.registry.counter(
+            "code_interpreter_tenant_usage_bytes_total",
+            "Per-tenant transfer bytes actually MOVED (upload/download; "
+            "negotiated-away bytes bill nothing) plus compile-cache bytes "
+            "the tenant's recompiles produced (kind=compile_cache_new).",
+            ("tenant", "kind"),
+        )
+        self.tenant_usage_requests = self.registry.counter(
+            "code_interpreter_tenant_usage_requests_total",
+            "Per-tenant requests by outcome (ok/user_error/limit_violation/"
+            "infra_error/rejected).",
+            ("tenant", "outcome"),
+        )
+        self.tenant_usage_batch_jobs = self.registry.counter(
+            "code_interpreter_tenant_usage_batch_jobs_total",
+            "Per-tenant jobs served via a fused batched dispatch.",
+            ("tenant",),
+        )
+        self.tenant_usage_violations = self.registry.counter(
+            "code_interpreter_tenant_usage_violations_total",
+            "Per-tenant typed limit violations by kind — the abuse-control "
+            "feed the quota/shedding layer will read.",
+            ("tenant", "kind"),
+        )
+        self.tenant_usage_recompiles = self.registry.counter(
+            "code_interpreter_tenant_usage_compile_recompiles_total",
+            "Per-tenant kernels that had to compile (persistent-cache "
+            "misses) in the tenant's runs.",
+            ("tenant",),
+        )
         self.pool_depth: Gauge | None = None
         self.active_sessions: Gauge | None = None
         self.compile_cache_store: Gauge | None = None
@@ -505,6 +548,47 @@ class ExecutorMetrics:
         self.batch_occupancy: Gauge | None = None
         self.device_health_state: Gauge | None = None
         self.device_probe_last_poll_age: Gauge | None = None
+
+    def record_tenant_usage(
+        self,
+        tenant: str,
+        increments: dict[str, float],
+        *,
+        outcome: str | None = None,
+        violation: str | None = None,
+    ) -> None:
+        """One ledger increment set mirrored into the tenant_usage_*
+        families. `tenant` is the ledger's own capped label (its overflow
+        discipline IS the metric cardinality bound)."""
+
+        def amount(name: str) -> float:
+            value = increments.get(name, 0.0)
+            return float(value) if value and value > 0 else 0.0
+
+        for resource in ("chip", "device_op", "queue_wait"):
+            seconds = amount(f"{resource}_seconds")
+            if seconds:
+                self.tenant_usage_seconds.inc(
+                    seconds, tenant=tenant, resource=resource
+                )
+        for kind, name in (
+            ("upload", "upload_bytes"),
+            ("download", "download_bytes"),
+            ("compile_cache_new", "compile_cache_new_bytes"),
+        ):
+            moved = amount(name)
+            if moved:
+                self.tenant_usage_bytes.inc(moved, tenant=tenant, kind=kind)
+        recompiles = amount("compile_cache_recompiles")
+        if recompiles:
+            self.tenant_usage_recompiles.inc(recompiles, tenant=tenant)
+        batch_jobs = amount("batch_jobs")
+        if batch_jobs:
+            self.tenant_usage_batch_jobs.inc(batch_jobs, tenant=tenant)
+        if outcome:
+            self.tenant_usage_requests.inc(tenant=tenant, outcome=outcome)
+        if violation:
+            self.tenant_usage_violations.inc(tenant=tenant, kind=violation)
 
     def bind_pool(self, pools) -> None:
         """Expose warm-pool depth per chip-count lane, read at scrape time."""

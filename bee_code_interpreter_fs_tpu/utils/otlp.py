@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import socket
 import threading
 import time
 from collections import deque
@@ -36,6 +38,36 @@ from collections import deque
 import httpx
 
 logger = logging.getLogger(__name__)
+
+
+def default_resource(service_name: str) -> dict:
+    """OTLP `resource` attributes identifying THIS control-plane process.
+    A collector receiving multiple replicas (the scale-out ROADMAP item)
+    must be able to tell sources apart: `service.name` alone makes N
+    replicas indistinguishable, so the resource carries the service
+    version, the host/pod identity (`HOSTNAME` is the pod name on k8s;
+    `POD_NAME` wins when a downward-API env sets it explicitly), and a
+    per-process instance id (host:pid — two restarts on one node are
+    different instances)."""
+    try:
+        from .. import __version__ as version
+    except Exception:  # noqa: BLE001 — resource ID must never fail export
+        version = "unknown"
+    host = os.environ.get("POD_NAME") or socket.gethostname()
+    return {
+        "service.name": service_name,
+        "service.version": version,
+        "host.name": host,
+        "service.instance.id": f"{host}:{os.getpid()}",
+    }
+
+
+def _resource_attrs(resource: dict | str) -> list[dict]:
+    """The encoded `resource.attributes` list. A bare string (the pre-
+    resource call shape) still works and maps to service.name only."""
+    if isinstance(resource, str):
+        resource = {"service.name": resource}
+    return _attributes(resource)
 
 
 def _any_value(value) -> dict:
@@ -59,9 +91,11 @@ def _nanos(unix_seconds: float) -> str:
     return str(int(max(0.0, unix_seconds) * 1e9))
 
 
-def encode_spans(spans: list[dict], service_name: str) -> dict:
+def encode_spans(spans: list[dict], resource: dict | str) -> dict:
     """``ExportTraceServiceRequest`` JSON from TraceRing-format span dicts
-    (the shape ``Span.to_dict`` / ``Tracer.record_span`` produce)."""
+    (the shape ``Span.to_dict`` / ``Tracer.record_span`` produce).
+    `resource` is the process-identity attribute map (see
+    ``default_resource``); a bare service-name string is accepted too."""
     otlp_spans = []
     for span in spans:
         start = float(span.get("start_unix", 0.0))
@@ -101,9 +135,7 @@ def encode_spans(spans: list[dict], service_name: str) -> dict:
     return {
         "resourceSpans": [
             {
-                "resource": {
-                    "attributes": _attributes({"service.name": service_name})
-                },
+                "resource": {"attributes": _resource_attrs(resource)},
                 "scopeSpans": [
                     {
                         "scope": {"name": "bee_code_interpreter_fs_tpu"},
@@ -116,7 +148,7 @@ def encode_spans(spans: list[dict], service_name: str) -> dict:
 
 
 def encode_metrics(
-    families: list[dict], service_name: str, now_unix: float
+    families: list[dict], resource: dict | str, now_unix: float
 ) -> dict:
     """``ExportMetricsServiceRequest`` JSON from a
     ``MetricsRegistry.collect()`` snapshot. Counters map to monotonic
@@ -178,9 +210,7 @@ def encode_metrics(
     return {
         "resourceMetrics": [
             {
-                "resource": {
-                    "attributes": _attributes({"service.name": service_name})
-                },
+                "resource": {"attributes": _resource_attrs(resource)},
                 "scopeMetrics": [
                     {
                         "scope": {"name": "bee_code_interpreter_fs_tpu"},
@@ -222,6 +252,9 @@ class OtlpExporter:
         self.max_queue = max(1, max_queue)
         self.timeout = timeout
         self.service_name = service_name
+        # Built once: the process identity every exported payload carries
+        # (stable for the exporter's lifetime by definition).
+        self.resource = default_resource(service_name)
         self.walltime = walltime
         self._transport = transport
         self._client: httpx.AsyncClient | None = None
@@ -311,14 +344,14 @@ class OtlpExporter:
             self._queue.clear()
         self.flushes += 1
         if spans:
-            payload = encode_spans(spans, self.service_name)
+            payload = encode_spans(spans, self.resource)
             ok = await self._post("/v1/traces", payload)
             self._count("traces", ok)
             if ok:
                 self.exported_spans += len(spans)
         if self.registry is not None:
             payload = encode_metrics(
-                self.registry.collect(), self.service_name, self.walltime()
+                self.registry.collect(), self.resource, self.walltime()
             )
             ok = await self._post("/v1/metrics", payload)
             self._count("metrics", ok)

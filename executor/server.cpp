@@ -1896,6 +1896,11 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
     resp["compile_cache"] = minijson::Value(cc);
   }
   resp["duration_s"] = minijson::Value(duration);
+  // The request's device-op wall (the op window around the warm-runner
+  // round-trip / cold subprocess): the control plane's chip-second
+  // attribution source. Named explicitly so the billing contract does not
+  // lean on duration_s keeping its exact semantics forever.
+  resp["device_op_seconds"] = minijson::Value(duration);
   if (!traceparent.empty()) {
     // The control plane sent trace context: report per-phase timings so it
     // can graft them into the request's trace as child spans. Offsets are
@@ -2233,6 +2238,12 @@ void handle_execute_batch(const minihttp::Request& req, minihttp::Conn& conn) {
     entry["stdout_truncated"] = minijson::Value(out_trunc);
     entry["stderr_truncated"] = minijson::Value(err_trunc);
     entry["duration_s"] = minijson::Value(job_duration);
+    // Per-job device-op seconds: the job thread's own exec span inside the
+    // fused run — the weight the control plane apportions the dispatch's
+    // chip-seconds by (usage metering; duplicates duration_s today, named
+    // separately so the attribution contract survives if duration_s ever
+    // grows non-device phases).
+    entry["device_op_seconds"] = minijson::Value(job_duration);
     entry["start_offset_s"] = minijson::Value(exec_start + job_offset);
     if (!job_violation.empty())
       entry["violation"] = minijson::Value(job_violation);
@@ -2283,6 +2294,11 @@ void handle_execute_batch(const minihttp::Request& req, minihttp::Conn& conn) {
   resp["results"] = minijson::Value(results);
   resp["warm"] = minijson::Value(ran_warm);
   resp["runner_restarted"] = minijson::Value(restart_runner);
+  // The fused dispatch's device-op wall, from this server's own op window
+  // (the whole runner round-trip): what the batch actually held the
+  // devices for — the control plane's chip-second attribution source
+  // (per-job shares are apportioned by the entries' device_op_seconds).
+  resp["device_op_seconds"] = minijson::Value(exec_s);
   if (timed_out) resp["timed_out"] = minijson::Value(true);
   if (!batch_violation.empty())
     resp["violation"] = minijson::Value(batch_violation);

@@ -222,3 +222,56 @@ async def test_real_jit_kernel_zero_recompilation(tmp_path):
         await _settle(executor)
     finally:
         await executor.close()
+
+
+@pytest.mark.slow
+async def test_new_prewarm_kernel_harvests_in_trusted_epoch(tmp_path):
+    """The PREWARM_SOURCES growth contract (carried follow-up from PR 6:
+    fleet coverage scales only with this set): the newly added
+    small_matmul_chain kernel — the batch bench's hot small-array shape —
+    compiles on a trusted (pre-warm) run, harvests into the fleet store in
+    the trusted epoch, and a later TENANT run of the same shape hits the
+    seeded cache with zero recompilation."""
+    pytest.importorskip("jax")
+    import shutil
+
+    from bee_code_interpreter_fs_tpu.services.compile_cache import (
+        PREWARM_SOURCES,
+    )
+
+    sources = dict(PREWARM_SOURCES)
+    assert "small_matmul_chain" in sources  # the satellite's new entry
+    cache_dir = tmp_path / "pod-cache-path"
+    executor, backend = make_stack(
+        tmp_path,
+        warm_import_jax=True,
+        compile_cache_per_sandbox=False,
+        jax_compilation_cache_dir=str(cache_dir),
+    )
+    try:
+        trusted = await executor._execute_trusted(
+            sources["small_matmul_chain"], timeout=300.0
+        )
+        assert trusted.exit_code == 0, trusted.stderr
+        assert "prewarm small_matmul_chain ok" in trusted.stdout
+        # The trusted run COMPILED it (fresh store, fresh dir)...
+        assert trusted.phases.get("compile_cache_new_bytes", 0) > 0
+        await _settle(executor)
+        # ...and teardown harvested it into the fleet store while the
+        # epoch was still trusted (no tenant code has run).
+        assert backend._procs == {}
+        assert executor.compile_cache.entry_count() > 0
+
+        # The sandbox and its local cache are both gone; only the fleet
+        # store survives to seed the next spawn.
+        shutil.rmtree(cache_dir)
+        tenant = await executor.execute(
+            sources["small_matmul_chain"], timeout=300.0
+        )
+        assert tenant.exit_code == 0, tenant.stderr
+        assert tenant.phases.get("compile_cache_seeded_bytes", 0) > 0
+        assert tenant.phases.get("compile_cache_hits", 0) > 0
+        assert tenant.phases.get("compile_cache_new_bytes", 1) == 0
+        await _settle(executor)
+    finally:
+        await executor.close()

@@ -239,3 +239,64 @@ async def test_close_ships_final_flush():
     exporter.add(_span(1))
     with exporter._lock:
         assert len(exporter._queue) == 0
+
+
+# ------------------------------------------------------- resource identity
+
+
+def _resource_attr_map(resource_entry: dict) -> dict:
+    return {
+        a["key"]: a["value"]
+        for a in resource_entry["resource"]["attributes"]
+    }
+
+
+def test_default_resource_identifies_the_process():
+    from bee_code_interpreter_fs_tpu import __version__
+    from bee_code_interpreter_fs_tpu.utils.otlp import default_resource
+
+    resource = default_resource("svc")
+    assert resource["service.name"] == "svc"
+    assert resource["service.version"] == __version__
+    assert resource["host.name"]  # hostname / pod name, never empty
+    # Per-process: two restarts on one node are different instances.
+    assert resource["service.instance.id"].startswith(
+        resource["host.name"] + ":"
+    )
+
+
+async def test_exported_payloads_carry_resource_attributes():
+    """The satellite's shape assertion: a collector receiving multiple
+    control-plane replicas must be able to tell sources apart — every
+    trace AND metric payload carries service.name, service.version, and
+    host/pod identity in its OTLP `resource`."""
+    from bee_code_interpreter_fs_tpu import __version__
+
+    collector = _Collector()
+    registry = MetricsRegistry()
+    registry.counter("demo_total", "demo").inc()
+    exporter = _exporter(collector, registry=registry)
+    exporter.add(_span(0))
+    await exporter.flush()
+    trace_bodies = [b for p, b in collector.requests if p == "/v1/traces"]
+    metric_bodies = [b for p, b in collector.requests if p == "/v1/metrics"]
+    assert trace_bodies and metric_bodies
+    for entry in (
+        trace_bodies[0]["resourceSpans"][0],
+        metric_bodies[0]["resourceMetrics"][0],
+    ):
+        attrs = _resource_attr_map(entry)
+        assert attrs["service.name"] == {
+            "stringValue": "tpu-code-interpreter"
+        }
+        assert attrs["service.version"] == {"stringValue": __version__}
+        assert attrs["host.name"]["stringValue"]
+        assert ":" in attrs["service.instance.id"]["stringValue"]
+
+
+def test_encode_accepts_bare_service_name_string():
+    """Back-compat: a bare string still encodes (service.name only) —
+    callers outside the exporter need not build a resource map."""
+    payload = encode_metrics([], "bare-name", 1.0)
+    attrs = _resource_attr_map(payload["resourceMetrics"][0])
+    assert attrs == {"service.name": {"stringValue": "bare-name"}}

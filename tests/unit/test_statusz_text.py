@@ -1,0 +1,220 @@
+"""Dedicated tests for the /statusz (and /usage) text renderers — the
+satellite's edge cases: an empty fleet, `_overflow` tenant rows, a wedged
+host with its evidence fields, and the new usage section. The renderers
+are module-level pure functions over statusz/usage bodies, so every edge
+case is a dict in, a string out — no stack required (plus one end-to-end
+leg through the real HTTP route).
+"""
+
+import pytest
+
+pytest.importorskip("aiohttp")
+
+from aiohttp.test_utils import TestClient, TestServer
+from fakes import FakeBackend
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor
+from bee_code_interpreter_fs_tpu.services.custom_tool_executor import (
+    CustomToolExecutor,
+)
+from bee_code_interpreter_fs_tpu.services.http_server import (
+    create_http_app,
+    statusz_text,
+    usage_text,
+)
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+from bee_code_interpreter_fs_tpu.services.usage import OVERFLOW_TENANT
+
+
+def empty_body(**overrides):
+    body = {
+        "status": "ok",
+        "inflight": 0,
+        "lanes": {},
+        "sessions": [],
+        "batching": {"enabled": False, "window_ms": 10.0, "max_jobs": 8},
+        "compile_cache": {"enabled": False, "entries": 0, "bytes": 0},
+        "device_health": {"enabled": False},
+        "otlp": {"enabled": False},
+        "usage": {"enabled": False},
+    }
+    body.update(overrides)
+    return body
+
+
+def test_empty_fleet_renders_every_section():
+    text = statusz_text(empty_body())
+    assert "status: ok   inflight: 0" in text
+    assert "(no lanes)" in text
+    assert "device health: probe disabled" in text
+    assert "otlp: disabled" in text
+    assert "usage: metering disabled" in text
+    assert "sessions: 0" in text
+    assert text.endswith("\n")
+
+
+def test_minimal_body_never_raises():
+    """A degraded statusz() (half-initialized executor, future fields
+    removed) must render, not crash — the renderer uses .get throughout."""
+    text = statusz_text({})
+    assert "status: unknown" in text
+
+
+def test_wedged_host_row_carries_evidence():
+    body = empty_body(
+        device_health={
+            "enabled": True,
+            "last_poll_age_s": 1.2,
+            "states": {"healthy": 1, "busy": 0, "suspect": 0, "wedged": 1},
+            "hosts": [
+                {
+                    "lane": 8,
+                    "host": "http://10.0.0.7:8777",
+                    "state": "wedged",
+                    "reason": "attach_stalled",
+                    "stall_s": 301.5,
+                },
+                {
+                    "lane": 0,
+                    "host": "http://10.0.0.8:8777",
+                    "state": "healthy",
+                },
+            ],
+        }
+    )
+    text = statusz_text(body)
+    # The wedged host is flagged (!!) with its full evidence chain.
+    assert "!!lane 8 http://10.0.0.7:8777 [wedged] attach_stalled" in text
+    assert "stall=301.5s" in text
+    assert "wedged=1" in text
+    # The healthy host renders unflagged, without empty evidence fields.
+    assert "  lane 0 http://10.0.0.8:8777 [healthy]" in text
+
+
+def test_usage_section_with_overflow_tenant_rows():
+    body = empty_body(
+        usage={
+            "enabled": True,
+            "tenant_count": 3,
+            "max_tenants": 2,
+            "flushes": 12,
+            "journal_lines": 40,
+            "tenants": {
+                "acme": {
+                    "chip_seconds": 12.5,
+                    "queue_wait_seconds": 0.75,
+                    "requests": 10,
+                    "batch_jobs": 8,
+                    "upload_bytes": 2048,
+                    "download_bytes": 0,
+                    "compile_cache_recompiles": 2,
+                    "violations": {"oom": 1, "cpu_time": 2},
+                },
+                OVERFLOW_TENANT: {
+                    "chip_seconds": 3.0,
+                    "queue_wait_seconds": 0.0,
+                    "requests": 4,
+                    "batch_jobs": 0,
+                    "upload_bytes": 0,
+                    "download_bytes": 0,
+                    "compile_cache_recompiles": 0,
+                    "violations": {},
+                },
+            },
+        }
+    )
+    text = statusz_text(body)
+    assert "usage: tenants=3/2 flushes=12" in text
+    assert (
+        "  acme: chip_s=12.5 queue_s=0.75 requests=10 batch_jobs=8 "
+        "up_bytes=2048 down_bytes=0 recompiles=2 "
+        "violations[cpu_time=2 oom=1]" in text
+    )
+    # The overflow row renders like any tenant — the aggregate past the
+    # cap must stay visible, not vanish.
+    assert f"  {OVERFLOW_TENANT}: chip_s=3.0" in text
+
+
+def test_lane_rows_render_queue_pressure():
+    body = empty_body(
+        lanes={
+            "0": {
+                "pool_depth": 2,
+                "in_use": 1,
+                "session_held": 1,
+                "spawning": 0,
+                "queued": 3,
+                "queue_wait_ewma_s": 0.25,
+                "batch_occupancy": 0.9,
+                "breaker": "open",
+            }
+        }
+    )
+    text = statusz_text(body)
+    assert (
+        "lane 0: pool=2 in_use=1 sessions=1 spawning=0 queued=3 "
+        "wait_ewma=0.25s batch_occ=0.9 breaker=open" in text
+    )
+
+
+def test_usage_text_disabled_and_empty():
+    assert usage_text({"enabled": False}) == "usage metering: disabled\n"
+    text = usage_text(
+        {
+            "enabled": True,
+            "tenant_count": 0,
+            "max_tenants": 256,
+            "flushes": 0,
+            "journal_lines": 0,
+            "tenants": {},
+        }
+    )
+    assert "(no usage recorded)" in text
+
+
+async def test_statusz_and_usage_text_end_to_end(tmp_path):
+    """The real routes: a live stack's ?format=text renders both surfaces
+    (including the usage section fed by a real recorded request)."""
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        executor_pod_queue_target_length=1,
+        batching_enabled=False,
+    )
+    executor = CodeExecutor(FakeBackend(), Storage(config.file_storage_path), config)
+
+    async def fake_post(client, base, payload, timeout, sandbox):
+        return {
+            "stdout": "ok\n",
+            "stderr": "",
+            "exit_code": 0,
+            "files": [],
+            "warm": True,
+            "device_op_seconds": 0.5,
+            "duration_s": 0.5,
+        }
+
+    executor._post_execute = fake_post
+    app = create_http_app(executor, CustomToolExecutor(executor), executor.storage)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        await executor.execute("print(1)", tenant="acme")
+        resp = await client.get("/statusz", params={"format": "text"})
+        assert resp.status == 200
+        text = await resp.text()
+        assert "usage: tenants=" in text
+        assert "acme: chip_s=0.5" in text
+        resp = await client.get("/usage", params={"format": "text"})
+        assert resp.status == 200
+        text = await resp.text()
+        assert "acme: chip_s=0.5" in text
+        # Per-tenant route, both formats.
+        resp = await client.get("/usage/acme")
+        body = await resp.json()
+        assert body["usage"]["chip_seconds"] == 0.5
+        resp = await client.get("/usage/nosuch")
+        assert resp.status == 404
+    finally:
+        await client.close()
+        await executor.close()

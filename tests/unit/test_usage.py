@@ -1,0 +1,885 @@
+"""Per-tenant usage metering: ledger semantics, attribution record points
+(serial / batched / violations / faults), the phase-histogram allowlist
+(the structural fix for the bug class PRs 6-8 each re-fixed once), the
+tenant_usage_* metric families, and the kill switch's byte-for-byte
+restoration of pre-metering behavior.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+from fakes import FakeBackend
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.code_executor import (
+    LATENCY_PHASES,
+    CodeExecutor,
+    Result,
+)
+from bee_code_interpreter_fs_tpu.services.errors import (
+    ExecutorError,
+    LimitExceededError,
+)
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+from bee_code_interpreter_fs_tpu.services.usage import (
+    OVERFLOW_TENANT,
+    UsageLedger,
+)
+
+BATCH_LANE = 4  # multi-chip, single-host (tpu_chips_per_host default 4)
+
+
+def make_config(tmp_path, **kwargs):
+    kwargs.setdefault("file_storage_path", str(tmp_path / "storage"))
+    kwargs.setdefault("executor_pod_queue_target_length", 1)
+    return Config(**kwargs)
+
+
+def make_executor(tmp_path, **kwargs):
+    config = make_config(tmp_path, **kwargs)
+    return CodeExecutor(FakeBackend(), Storage(config.file_storage_path), config)
+
+
+def serial_body(device_op=0.25, **extra):
+    return {
+        "stdout": "ok\n",
+        "stderr": "",
+        "exit_code": 0,
+        "files": [],
+        "warm": True,
+        "duration_s": device_op,
+        "device_op_seconds": device_op,
+        **extra,
+    }
+
+
+def fake_serial(executor, bodies):
+    """Patch the serial wire hop: pops dicts (responses) or raises
+    exceptions from `bodies` in order; the last entry repeats."""
+    queue = list(bodies)
+
+    async def post(client, base, payload, timeout, sandbox):
+        item = queue.pop(0) if len(queue) > 1 else queue[0]
+        if isinstance(item, Exception):
+            raise item
+        return dict(item)
+
+    executor._post_execute = post
+
+
+def batch_entry(i, device_op=0.1, **extra):
+    return {
+        "workdir": f".batch-1/job-{i}",
+        "stdout": f"job {i}\n",
+        "stderr": "",
+        "exit_code": 0,
+        "files": [],
+        "duration_s": device_op,
+        "device_op_seconds": device_op,
+        "start_offset_s": 0.0,
+        **extra,
+    }
+
+
+async def drain(executor):
+    for _ in range(200):
+        pending = list(executor._dispose_tasks) + list(executor._fill_tasks)
+        if not pending:
+            return
+        await asyncio.gather(*pending, return_exceptions=True)
+
+
+def tenant_row(executor, tenant):
+    return executor.usage.snapshot()["tenants"][tenant]
+
+
+# ---------------------------------------------------------------- ledger unit
+
+
+def test_ledger_accumulates_and_counts(tmp_path):
+    ledger = UsageLedger(make_config(tmp_path))
+    ledger.add("a", chip_seconds=1.5, requests=1, outcome="ok")
+    ledger.add("a", chip_seconds=0.5, queue_wait_seconds=0.2, requests=1,
+               outcome="limit_violation", violation="oom")
+    row = ledger.snapshot()["tenants"]["a"]
+    assert row["chip_seconds"] == 2.0
+    assert row["queue_wait_seconds"] == 0.2
+    assert row["requests"] == 2
+    assert row["outcomes"] == {"limit_violation": 1.0, "ok": 1.0}
+    assert row["violations"] == {"oom": 1.0}
+
+
+def test_ledger_overflow_tenant_cap(tmp_path):
+    ledger = UsageLedger(make_config(tmp_path, usage_max_tenants=2))
+    ledger.add("a", requests=1)
+    ledger.add("b", requests=1)
+    ledger.add("c", chip_seconds=1.0, requests=1)
+    ledger.add("d", chip_seconds=2.0, requests=1)
+    tenants = ledger.snapshot()["tenants"]
+    assert set(tenants) == {"a", "b", OVERFLOW_TENANT}
+    # Usage past the cap still accrues — billing never drops consumption.
+    assert tenants[OVERFLOW_TENANT]["chip_seconds"] == 3.0
+    assert tenants[OVERFLOW_TENANT]["requests"] == 2
+
+
+def test_ledger_journal_restores_counters(tmp_path):
+    config = make_config(tmp_path)
+    ledger = UsageLedger(config)
+    ledger.add("a", chip_seconds=3.25, upload_bytes=100, requests=1,
+               outcome="ok")
+    ledger.add("b", chip_seconds=1.0, requests=1, violation="cpu_time",
+               outcome="limit_violation")
+    assert ledger.flush() == 2
+    restored = UsageLedger(config)
+    assert restored.snapshot()["tenants"] == ledger.snapshot()["tenants"]
+
+
+def test_ledger_compaction_snapshot_and_truncate(tmp_path):
+    config = make_config(tmp_path, usage_journal_max_bytes=4096)
+    ledger = UsageLedger(config)
+    # Enough flushes to outgrow the 4 KiB bound (min-clamped) repeatedly.
+    for i in range(60):
+        ledger.add("tenant-x", chip_seconds=1.0, requests=1, outcome="ok")
+        ledger.flush()
+    assert ledger.compactions > 0
+    assert os.path.getsize(ledger.journal_path) < 4096
+    with open(ledger.snapshot_path, encoding="utf-8") as f:
+        snap = json.load(f)
+    assert snap["tenants"]["tenant-x"]["chip_seconds"] > 0
+    restored = UsageLedger(config)
+    assert (
+        restored.snapshot()["tenants"]["tenant-x"]["chip_seconds"] == 60.0
+    )
+    assert restored.snapshot()["tenants"]["tenant-x"]["requests"] == 60
+
+
+def test_ledger_torn_tail_line_skipped(tmp_path):
+    config = make_config(tmp_path)
+    ledger = UsageLedger(config)
+    ledger.add("a", chip_seconds=2.0, requests=1, outcome="ok")
+    ledger.flush()
+    # A SIGKILL mid-write leaves a torn (non-JSON) tail: replay must keep
+    # everything before it and not crash.
+    with open(ledger.journal_path, "a", encoding="utf-8") as f:
+        f.write('{"tenant": "a", "usage": {"chip_sec')
+    restored = UsageLedger(config)
+    assert restored.load_errors == 1
+    assert restored.snapshot()["tenants"]["a"]["chip_seconds"] == 2.0
+
+
+def test_ledger_replay_is_idempotent_latest_wins(tmp_path):
+    """Cumulative journal lines + max-merge: replaying an OLD line after a
+    newer one (crash between snapshot write and journal truncate) can
+    never roll counters back."""
+    config = make_config(tmp_path)
+    ledger = UsageLedger(config)
+    ledger.add("a", chip_seconds=1.0, requests=1, outcome="ok")
+    ledger.flush()
+    ledger.add("a", chip_seconds=1.0, requests=1, outcome="ok")
+    ledger.flush()
+    with open(ledger.journal_path, encoding="utf-8") as f:
+        first_line = f.readline()
+    # Re-append the STALE first line after the newer one.
+    with open(ledger.journal_path, "a", encoding="utf-8") as f:
+        f.write(first_line)
+    restored = UsageLedger(config)
+    assert restored.snapshot()["tenants"]["a"]["chip_seconds"] == 2.0
+    assert restored.snapshot()["tenants"]["a"]["requests"] == 2
+
+
+def test_disabled_ledger_is_inert(tmp_path):
+    config = make_config(tmp_path, usage_metering_enabled=False)
+    ledger = UsageLedger(config)
+    ledger.add("a", chip_seconds=1.0, requests=1, outcome="ok")
+    assert ledger.flush() == 0
+    assert ledger.snapshot()["tenants"] == {}
+    assert ledger.journal_path is None
+    # No .usage dir ever materializes.
+    assert not (tmp_path / "storage" / ".usage").exists()
+
+
+# ----------------------------------------------------- serial attribution
+
+
+async def test_serial_execute_bills_executor_reported_device_op(tmp_path):
+    executor = make_executor(tmp_path, batching_enabled=False)
+    fake_serial(executor, [serial_body(device_op=0.25)])
+    try:
+        result = await executor.execute("print(1)", tenant="acme")
+        assert result.phases["device_op_seconds"] == 0.25
+        assert result.phases["chip_seconds"] == 0.25  # CPU lane: chips=1
+        row = tenant_row(executor, "acme")
+        assert row["chip_seconds"] == pytest.approx(0.25)
+        assert row["device_op_seconds"] == pytest.approx(0.25)
+        assert row["requests"] == 1
+        assert row["outcomes"] == {"ok": 1.0}
+        # Queue wait attributed by the scheduler at grant time.
+        assert row["queue_wait_seconds"] >= 0.0
+    finally:
+        await executor.close()
+
+
+async def test_chip_seconds_multiply_by_lane_chip_count(tmp_path):
+    executor = make_executor(tmp_path, batching_enabled=False)
+    fake_serial(executor, [serial_body(device_op=0.5)])
+    try:
+        result = await executor.execute(
+            "print(1)", chip_count=BATCH_LANE, tenant="acme"
+        )
+        assert result.phases["chip_seconds"] == pytest.approx(
+            0.5 * BATCH_LANE
+        )
+        assert tenant_row(executor, "acme")["chip_seconds"] == pytest.approx(
+            0.5 * BATCH_LANE
+        )
+    finally:
+        await executor.close()
+
+
+async def test_violating_request_billed_and_counted(tmp_path):
+    """The acceptance criterion's violation clause: a request killed for a
+    typed limit breach still bills the device time it consumed AND counts
+    under its violation kind."""
+    executor = make_executor(tmp_path, batching_enabled=False)
+    fake_serial(
+        executor,
+        [serial_body(device_op=0.4, violation="cpu_time", exit_code=-1)],
+    )
+    try:
+        with pytest.raises(LimitExceededError):
+            await executor.execute("while True: pass", tenant="acme")
+        row = tenant_row(executor, "acme")
+        assert row["chip_seconds"] == pytest.approx(0.4)
+        assert row["violations"] == {"cpu_time": 1.0}
+        assert row["outcomes"] == {"limit_violation": 1.0}
+        assert row["requests"] == 1
+    finally:
+        await executor.close()
+
+
+async def test_faulted_request_still_billed(tmp_path):
+    """A wire fault mid-exec consumed real device time: each retry
+    attempt bills its measured exec wall; the logical request counts once
+    as infra_error."""
+    executor = make_executor(tmp_path, batching_enabled=False)
+    fake_serial(executor, [ExecutorError("connection dropped")])
+    try:
+        with pytest.raises(ExecutorError):
+            await executor.execute("print(1)", tenant="acme")
+        row = tenant_row(executor, "acme")
+        assert row["chip_seconds"] > 0.0  # billed despite the fault
+        assert row["requests"] == 1  # counted once despite 3 attempts
+        assert row["outcomes"] == {"infra_error": 1.0}
+    finally:
+        await executor.close()
+
+
+async def test_session_requests_attributed(tmp_path):
+    executor = make_executor(tmp_path, batching_enabled=False)
+    fake_serial(executor, [serial_body(device_op=0.2)])
+    try:
+        for _ in range(2):
+            result = await executor.execute(
+                "print(1)", executor_id="sess-1", tenant="acme"
+            )
+            assert result.phases["chip_seconds"] == pytest.approx(0.2)
+        row = tenant_row(executor, "acme")
+        assert row["chip_seconds"] == pytest.approx(0.4)
+        assert row["requests"] == 2
+        await executor.close_session("sess-1")
+    finally:
+        await executor.close()
+
+
+async def test_transfer_bytes_billed_moved_not_skipped(tmp_path):
+    executor = make_executor(tmp_path, batching_enabled=False)
+    storage = executor.storage
+    async with storage.writer() as writer:
+        await writer.write(b"x" * 1000)
+    object_id = writer.hash
+
+    async def post(client, base, payload, timeout, sandbox):
+        return serial_body(device_op=0.1)
+
+    uploaded = []
+
+    async def fake_upload(client, base, rel, object_id, manifest):
+        uploaded.append(rel)
+        manifest.record_upload(rel, object_id)
+
+    executor._post_execute = post
+    executor._upload_file = fake_upload
+    try:
+        # First run moves the bytes; the manifest-skipped rerun must not
+        # re-bill them (moved, not skipped — the PR 3 distinction).
+        await executor.execute(
+            "print(1)",
+            files={"/workspace/in.bin": object_id},
+            executor_id="sess-t",
+            tenant="acme",
+        )
+        await executor.execute(
+            "print(1)",
+            files={"/workspace/in.bin": object_id},
+            executor_id="sess-t",
+            tenant="acme",
+        )
+        row = tenant_row(executor, "acme")
+        assert uploaded == ["in.bin"]  # second turn was manifest-skipped
+        assert row["upload_bytes"] == 1000  # billed exactly once
+        await executor.close_session("sess-t")
+    finally:
+        await executor.close()
+
+
+# ------------------------------------------------------ batched attribution
+
+
+def fake_batch(executor, response):
+    calls = []
+
+    async def post(client, base, payload, timeout, sandbox):
+        calls.append(payload)
+        item = response(payload) if callable(response) else response
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    executor._post_execute_batch = post
+    return calls
+
+
+async def test_batch_apportions_fused_chip_seconds_exactly(tmp_path):
+    """The no-double-billing/no-loss invariant: per-job shares (weighted
+    by per-job exec spans) sum EXACTLY to the fused dispatch's
+    chip-seconds, and the ledger bills the total once."""
+    executor = make_executor(
+        tmp_path, batch_window_ms=20.0, batch_max_jobs=4
+    )
+    fused_device_op = 0.5
+    fake_batch(
+        executor,
+        lambda payload: {
+            "results": [
+                batch_entry(i, device_op=0.1 * (i + 1))
+                for i in range(len(payload["jobs"]))
+            ],
+            "warm": True,
+            "runner_restarted": False,
+            "device_op_seconds": fused_device_op,
+        },
+    )
+    try:
+        results = await asyncio.gather(
+            *(
+                executor.execute(
+                    f"print({i})", chip_count=BATCH_LANE, tenant="acme"
+                )
+                for i in range(4)
+            )
+        )
+        assert all(r.phases["batch_jobs"] == 4.0 for r in results)
+        total = fused_device_op * BATCH_LANE
+        shares = [r.phases["chip_seconds"] for r in results]
+        assert sum(shares) == pytest.approx(total)
+        # Weighted by the per-job spans: 0.1/0.2/0.3/0.4 of the total.
+        assert sorted(shares) == pytest.approx(
+            [total * w / 1.0 for w in (0.1, 0.2, 0.3, 0.4)]
+        )
+        row = tenant_row(executor, "acme")
+        assert row["chip_seconds"] == pytest.approx(total)  # billed ONCE
+        assert row["batch_jobs"] == 4
+        assert row["requests"] == 4
+        # The fused path reports each job's real pre-exec wait.
+        assert all("queue_wait" in r.phases for r in results)
+    finally:
+        await executor.close()
+
+
+async def test_batch_equal_split_when_spans_absent(tmp_path):
+    executor = make_executor(
+        tmp_path, batch_window_ms=20.0, batch_max_jobs=4
+    )
+    fake_batch(
+        executor,
+        lambda payload: {
+            "results": [
+                {
+                    k: v
+                    for k, v in batch_entry(i).items()
+                    if k not in ("duration_s", "device_op_seconds")
+                }
+                for i in range(len(payload["jobs"]))
+            ],
+            "warm": True,
+            "runner_restarted": False,
+            "device_op_seconds": 0.8,
+        },
+    )
+    try:
+        results = await asyncio.gather(
+            *(
+                executor.execute(
+                    f"print({i})", chip_count=BATCH_LANE, tenant="acme"
+                )
+                for i in range(4)
+            )
+        )
+        total = 0.8 * BATCH_LANE
+        shares = [r.phases["chip_seconds"] for r in results]
+        assert shares == pytest.approx([total / 4] * 4)
+        assert sum(shares) == pytest.approx(total)
+    finally:
+        await executor.close()
+
+
+async def test_bill_identical_fused_vs_serial_path(tmp_path):
+    """The tentpole's equality clause: with identical executor-reported
+    device-op times, a tenant's chip-second bill is the same whether its
+    jobs rode the fused dispatch or the serial path."""
+
+    async def run(batching: bool) -> float:
+        executor = make_executor(
+            tmp_path / ("batched" if batching else "serial"),
+            batching_enabled=batching,
+            batch_window_ms=20.0,
+            batch_max_jobs=4,
+        )
+        # Fused: 4 jobs x 0.1s spans inside one 0.4s dispatch. Serial:
+        # each job is its own 0.1s op. Same device seconds either way.
+        fake_batch(
+            executor,
+            lambda payload: {
+                "results": [
+                    batch_entry(i, device_op=0.1)
+                    for i in range(len(payload["jobs"]))
+                ],
+                "warm": True,
+                "runner_restarted": False,
+                "device_op_seconds": 0.4,
+            },
+        )
+        fake_serial(executor, [serial_body(device_op=0.1)])
+        try:
+            await asyncio.gather(
+                *(
+                    executor.execute(
+                        f"print({i})", chip_count=BATCH_LANE, tenant="acme"
+                    )
+                    for i in range(4)
+                )
+            )
+            return tenant_row(executor, "acme")["chip_seconds"]
+        finally:
+            await executor.close()
+
+    assert await run(True) == pytest.approx(await run(False))
+
+
+async def test_batch_wire_fault_bills_then_serial_rerun_bills_its_own(
+    tmp_path,
+):
+    executor = make_executor(
+        tmp_path, batch_window_ms=20.0, batch_max_jobs=2
+    )
+    fake_batch(executor, ExecutorError("batch wire dropped"))
+    fake_serial(executor, [serial_body(device_op=0.1)])
+    try:
+        results = await asyncio.gather(
+            *(
+                executor.execute(
+                    f"print({i})", chip_count=BATCH_LANE, tenant="acme"
+                )
+                for i in range(2)
+            )
+        )
+        assert all(r.exit_code == 0 for r in results)
+        row = tenant_row(executor, "acme")
+        # The failed fused attempt billed its (tiny, wall-measured)
+        # consumption AND the serial reruns billed theirs: >= the serial
+        # total alone, requests still counted once each.
+        assert row["chip_seconds"] >= 0.1 * BATCH_LANE * 2
+        assert row["requests"] == 2
+        assert row["outcomes"] == {"ok": 2.0}
+    finally:
+        await executor.close()
+
+
+async def test_batch_job_violation_billed_and_counted(tmp_path):
+    executor = make_executor(
+        tmp_path, batch_window_ms=20.0, batch_max_jobs=2
+    )
+    fake_batch(
+        executor,
+        lambda payload: {
+            "results": [
+                batch_entry(0, device_op=0.1),
+                batch_entry(
+                    1, device_op=0.1, violation="oom", exit_code=-1
+                ),
+            ],
+            "warm": True,
+            "runner_restarted": False,
+            "device_op_seconds": 0.2,
+        },
+    )
+    try:
+        outcomes = await asyncio.gather(
+            *(
+                executor.execute(
+                    f"print({i})", chip_count=BATCH_LANE, tenant="acme"
+                )
+                for i in range(2)
+            ),
+            return_exceptions=True,
+        )
+        violations = [
+            o for o in outcomes if isinstance(o, LimitExceededError)
+        ]
+        assert len(violations) == 1 and violations[0].kind == "oom"
+        row = tenant_row(executor, "acme")
+        assert row["chip_seconds"] == pytest.approx(0.2 * BATCH_LANE)
+        assert row["violations"] == {"oom": 1.0}
+        assert row["outcomes"] == {"limit_violation": 1.0, "ok": 1.0}
+    finally:
+        await executor.close()
+
+
+# -------------------------------------------------- kill switch + histogram
+
+
+async def test_kill_switch_restores_pre_metering_behavior(tmp_path):
+    executor = make_executor(
+        tmp_path, batching_enabled=False, usage_metering_enabled=False
+    )
+    fake_serial(executor, [serial_body(device_op=0.25)])
+    try:
+        result = await executor.execute("print(1)", tenant="acme")
+        # No attribution fields in phases — the response is byte-for-byte
+        # what a pre-metering control plane produced.
+        assert "chip_seconds" not in result.phases
+        assert "device_op_seconds" not in result.phases
+        assert executor.usage.snapshot()["tenants"] == {}
+        assert executor.scheduler.usage is None
+        # No tenant_usage_* samples on the metrics surface.
+        render = executor.metrics.registry.render()
+        assert 'tenant_usage_seconds_total{' not in render
+        assert not (tmp_path / "storage" / ".usage").exists()
+    finally:
+        await executor.close()
+
+
+def test_phase_histogram_allowlist_blocks_non_latency_keys(tmp_path):
+    """THE regression test the satellite asks for: a NEW non-latency
+    phases key must never reach the latency histogram — the bug class
+    PRs 6, 7, and 8 each re-fixed one key at a time (compile_cache_*,
+    batch_jobs, batch_index). The usage attribution fields must pass on
+    day one."""
+    executor = make_executor(tmp_path)
+    result = Result(
+        stdout="",
+        stderr="",
+        exit_code=0,
+        files={},
+        phases={
+            # The real latency phases...
+            "queue_wait": 0.1,
+            "upload": 0.01,
+            "exec": 1.0,
+            "download": 0.02,
+            # ...the new usage attribution fields (day-one requirement)...
+            "chip_seconds": 8.0,
+            "device_op_seconds": 2.0,
+            # ...every historical offender class...
+            "compile_cache_hits": 3.0,
+            "compile_cache_new_bytes": 4096.0,
+            "batch_jobs": 8.0,
+            "batch_index": 7.0,
+            "upload_bytes": 123.0,
+            "trace_id": "a" * 32,
+            # ...and a key invented AFTER this test was written: the
+            # allowlist must exclude it BY DEFAULT.
+            "frobnicate_total": 42.0,
+        },
+    )
+    executor._count_execution(result, session=False)
+    observed = {
+        labels["phase"]
+        for labels, _counts, _sum, _total in executor.metrics.phase_seconds.samples()
+    }
+    assert observed == set(LATENCY_PHASES)
+    # And the histogram's sum is sane: had frobnicate_total/chip_seconds
+    # leaked in, the sum would jump by tens of fake "seconds".
+    total_sum = sum(
+        s for _labels, _counts, s, _total in executor.metrics.phase_seconds.samples()
+    )
+    assert total_sum == pytest.approx(0.1 + 0.01 + 1.0 + 0.02)
+
+
+# ----------------------------------------------------------- metric families
+
+
+async def test_tenant_usage_metric_families_move(tmp_path):
+    executor = make_executor(tmp_path, batching_enabled=False)
+    fake_serial(
+        executor,
+        [
+            serial_body(
+                device_op=0.5,
+                compile_cache={"hits": 1, "misses": 2, "new_entries": 2,
+                               "new_bytes": 4096},
+            )
+        ],
+    )
+    try:
+        await executor.execute("print(1)", tenant="acme")
+        render = executor.metrics.registry.render()
+        assert (
+            'code_interpreter_tenant_usage_seconds_total{resource="chip",tenant="acme"}'
+            in render
+        )
+        assert (
+            'code_interpreter_tenant_usage_requests_total{outcome="ok",tenant="acme"}'
+            in render
+        )
+        assert (
+            'code_interpreter_tenant_usage_compile_recompiles_total{tenant="acme"} 2'
+            in render
+        )
+        assert (
+            'code_interpreter_tenant_usage_bytes_total{kind="compile_cache_new",tenant="acme"} 4096'
+            in render
+        )
+        row = tenant_row(executor, "acme")
+        assert row["compile_cache_recompiles"] == 2
+        assert row["compile_cache_new_bytes"] == 4096
+    finally:
+        await executor.close()
+
+
+async def test_statusz_carries_usage_section(tmp_path):
+    executor = make_executor(tmp_path, batching_enabled=False)
+    fake_serial(executor, [serial_body()])
+    try:
+        await executor.execute("print(1)", tenant="acme")
+        body = executor.statusz()
+        assert body["usage"]["enabled"] is True
+        assert "acme" in body["usage"]["tenants"]
+    finally:
+        await executor.close()
+
+
+# ------------------------------------------------------ queue-wait attribution
+
+
+async def test_queue_wait_attributed_per_request_for_batch_tickets(tmp_path):
+    """A multi-job batch ticket's wait bills once per request it served
+    (mirroring how grants count requests, not tickets)."""
+    executor = make_executor(tmp_path, batch_window_ms=20.0, batch_max_jobs=4)
+    fake_batch(
+        executor,
+        lambda payload: {
+            "results": [
+                batch_entry(i) for i in range(len(payload["jobs"]))
+            ],
+            "warm": True,
+            "runner_restarted": False,
+            "device_op_seconds": 0.1,
+        },
+    )
+    recorded = []
+    real_add = executor.usage.add
+
+    def spy_add(tenant, **kwargs):
+        if kwargs.get("queue_wait_seconds"):
+            recorded.append(kwargs["queue_wait_seconds"])
+        return real_add(tenant, **kwargs)
+
+    executor.usage.add = spy_add
+    try:
+        await asyncio.gather(
+            *(
+                executor.execute(
+                    f"print({i})", chip_count=BATCH_LANE, tenant="acme"
+                )
+                for i in range(4)
+            )
+        )
+        # One multi-job grant -> ONE queue-wait record covering 4 requests
+        # (wait x jobs); its value is 4x the ticket's wait by construction.
+        assert len(recorded) == 1
+    finally:
+        await executor.close()
+
+
+# ------------------------------------------------- review-hardening fixes
+
+
+async def test_session_retry_iteration_still_bills(tmp_path):
+    """The closed-while-waiting `continue` must not spend the draft: when
+    the first session fetch yields a just-closed session, the retry
+    iteration's real consumption still reaches the ledger (the commit
+    lives at request exit, not per loop iteration)."""
+    from bee_code_interpreter_fs_tpu.services.code_executor import _Session
+
+    executor = make_executor(tmp_path, batching_enabled=False)
+    fake_serial(executor, [serial_body(device_op=0.3)])
+    real_get_session = executor._get_session
+    handed_closed = False
+
+    async def get_session_with_stale_first(executor_id, lane, **kwargs):
+        nonlocal handed_closed
+        if not handed_closed:
+            handed_closed = True
+            stale = _Session(lane=lane)
+            stale.closed = True  # forces the loop's `continue` path
+            return stale
+        return await real_get_session(executor_id, lane, **kwargs)
+
+    executor._get_session = get_session_with_stale_first
+    try:
+        result = await executor.execute(
+            "print(1)", executor_id="sess-r", tenant="acme"
+        )
+        assert result.exit_code == 0
+        assert handed_closed  # the stale iteration really happened
+        row = tenant_row(executor, "acme")
+        assert row["chip_seconds"] == pytest.approx(0.3)
+        await executor.close_session("sess-r")
+    finally:
+        await executor.close()
+
+
+def test_restart_restores_full_table_past_the_cap(tmp_path):
+    """Persisted rows restore VERBATIM: the live table legitimately holds
+    max_tenants real rows plus `_overflow`; replaying it through the cap
+    would max-merge the last real tenant into `_overflow` and destroy its
+    bill on every restart."""
+    config = make_config(tmp_path, usage_max_tenants=2)
+    ledger = UsageLedger(config)
+    ledger.add("a", chip_seconds=1.0, requests=1)
+    ledger.add("b", chip_seconds=2.0, requests=1)
+    ledger.add("c", chip_seconds=4.0, requests=1)  # -> _overflow
+    ledger.flush()
+    restored = UsageLedger(config)
+    assert restored.snapshot()["tenants"] == ledger.snapshot()["tenants"]
+    # Specifically: "b" (the cap-th row) kept its own bill, and the
+    # overflow row holds exactly the overflowed usage.
+    tenants = restored.snapshot()["tenants"]
+    assert tenants["b"]["chip_seconds"] == 2.0
+    assert tenants[OVERFLOW_TENANT]["chip_seconds"] == 4.0
+
+
+async def test_trusted_prewarm_runs_bill_nobody(tmp_path):
+    """Control-plane-authored runs (the compile-cache pre-warm) are
+    internal warmup work: no draft, no request count, no queue-wait
+    attribution — the default tenant's row must reflect only genuine
+    client requests."""
+    executor = make_executor(tmp_path, batching_enabled=False)
+    fake_serial(executor, [serial_body(device_op=0.5)])
+    try:
+        result = await executor._execute_trusted("print('prewarm')")
+        assert result.exit_code == 0
+        assert executor.usage.snapshot()["tenants"] == {}
+        assert "chip_seconds" not in result.phases
+        # A genuine shared-tenant request afterwards bills normally.
+        await executor.execute("print(1)")
+        tenants = executor.usage.snapshot()["tenants"]
+        assert set(tenants) == {executor.scheduler.default_tenant}
+        assert tenants[executor.scheduler.default_tenant][
+            "chip_seconds"
+        ] == pytest.approx(0.5)
+    finally:
+        await executor.close()
+
+
+async def test_batch_refusal_bills_no_phantom_chip_seconds(tmp_path):
+    """A clean refusal (404 old binary / 409 no warm runner) answered
+    WITHOUT running anything: the tenant must be billed only for the
+    serial reruns' real consumption — wall x chips for the refused hop
+    would systematically overbill every batch during a rolling upgrade."""
+    executor = make_executor(
+        tmp_path, batch_window_ms=20.0, batch_max_jobs=2
+    )
+
+    async def refusing_batch(client, base, payload, timeout, sandbox):
+        error = ExecutorError(
+            f"sandbox {sandbox.id} /execute-batch -> 404: no route"
+        )
+        error.device_may_have_run = False  # as _post_execute_batch tags it
+        raise error
+
+    executor._post_execute_batch = refusing_batch
+    fake_serial(executor, [serial_body(device_op=0.1)])
+    try:
+        results = await asyncio.gather(
+            *(
+                executor.execute(
+                    f"print({i})", chip_count=BATCH_LANE, tenant="acme"
+                )
+                for i in range(2)
+            )
+        )
+        assert all(r.exit_code == 0 for r in results)
+        row = tenant_row(executor, "acme")
+        # EXACTLY the serial reruns' reported ops — no refusal surcharge.
+        assert row["chip_seconds"] == pytest.approx(0.1 * BATCH_LANE * 2)
+        assert row["device_op_seconds"] == pytest.approx(0.1 * 2)
+    finally:
+        await executor.close()
+
+
+async def test_serial_refusal_not_billed_as_device_time(tmp_path):
+    """Same rule on the serial path: a non-200 /execute refusal never ran
+    user code — retries then a real run bill only the real run."""
+    refusal = ExecutorError("sandbox x /execute -> 409: busy")
+    refusal.device_may_have_run = False
+    executor = make_executor(tmp_path, batching_enabled=False)
+    fake_serial(executor, [refusal, serial_body(device_op=0.2)])
+    try:
+        result = await executor.execute("print(1)", tenant="acme")
+        assert result.exit_code == 0
+        row = tenant_row(executor, "acme")
+        assert row["chip_seconds"] == pytest.approx(0.2)  # real run only
+    finally:
+        await executor.close()
+
+
+async def test_stop_waits_out_inflight_thread_flush(tmp_path):
+    """stop() must await an in-flight worker-thread write before the
+    final synchronous flush: a late thread compaction would otherwise
+    truncate the journal with a pre-final-flush snapshot, erasing the
+    drain window's attribution from disk."""
+    import time as _time
+
+    ledger = UsageLedger(make_config(tmp_path, usage_flush_interval=0.2))
+    real_write = ledger._write_flush
+    in_write = asyncio.Event()
+    release = False
+
+    def slow_write(payload):
+        asyncio.get_event_loop_policy()  # no-op; runs in the worker thread
+        in_write.set()
+        while not release:
+            _time.sleep(0.01)
+        return real_write(payload)
+
+    ledger._write_flush = slow_write
+    ledger.add("a", chip_seconds=1.0, requests=1, outcome="ok")
+    ledger.start()
+    await asyncio.wait_for(in_write.wait(), timeout=5.0)
+    # The daemon's write is parked in the worker thread; the drain
+    # window's last attribution lands now.
+    ledger.add("a", chip_seconds=1.0, requests=1, outcome="ok")
+    stop_task = asyncio.create_task(ledger.stop())
+    await asyncio.sleep(0.1)
+    assert not stop_task.done()  # stop is WAITING on the thread
+    release = True
+    await asyncio.wait_for(stop_task, timeout=5.0)
+    # Both attributions are durable: the thread's line AND the final
+    # flush's line made it, in order.
+    restored = UsageLedger(ledger.config)
+    assert restored.snapshot()["tenants"]["a"]["chip_seconds"] == 2.0
+    assert restored.snapshot()["tenants"]["a"]["requests"] == 2
