@@ -60,6 +60,10 @@ async def main(ctx: ApplicationContext | None = None) -> None:
     ctx.code_executor.fill_pool_soon(ctx.config.default_chip_count)
     ctx.code_executor.start_health_sweeper(ctx.config.pool_health_sweep_interval)
     ctx.code_executor.start_session_sweeper()
+    # Warm-pool autoscaling: the sweep runs scale-down hysteresis,
+    # spawn-ahead refills, and the idle-chip reaper (scale-UP also happens
+    # inline on arrivals; the kill switch makes this a no-op).
+    ctx.code_executor.start_autoscaler()
     # Pre-warm the fleet compile cache from the examples/ kernel set: runs
     # at batch priority behind the pool fill and yields to any real work —
     # by the first user request, the hot kernels are compile-once fleet-wide.

@@ -1,0 +1,391 @@
+"""Demand-adaptive warm-pool autoscaling: queue-wait-driven lane targets.
+
+Before this subsystem every lane's warm-pool depth was one static knob
+(`executor_pod_queue_target_length = 5`): under a burst the queue grew until
+spawns caught up one acquire at a time, and off-peak an idle lane squatted
+warm chips a pressured lane on shared physical capacity could not claim.
+The ROADMAP scale-out item names the fix — close the loop on the
+`scheduler_queue_wait_ewma_seconds` gauge (PR 3) by driving warm-pool
+capacity from it — and the Kubernetes GenAI-inference evaluation (PAPERS.md,
+arxiv 2602.04900) grounds the pattern: queue-wait-driven pool scaling is
+what holds p50 under bursty serving traffic on a pod-per-request plane,
+while Podracer's lesson (arxiv 2104.06272) is the same from the chip side —
+accelerators must never idle behind static partitioning.
+
+This module owns the POLICY only; `CodeExecutor` owns the bookkeeping and
+the actuators (fill_pool for scale-up, the idle reaper for scale-down) and
+feeds the model `LaneSnapshot`s:
+
+- **Demand model** — per lane, ``raw = in_use + queued + arrival_rate x
+  spawn_latency (+ queue-wait pressure headroom)``. The arrival-rate EWMA
+  makes scale-up *spawn-ahead*: refills start when backlog x spawn-time
+  says demand will outrun supply, not when a request is already waiting.
+  The rate estimate is additionally bounded by ``1 / time-since-last-
+  arrival`` so a stale burst's rate decays the moment traffic stops.
+- **Queue-wait loop** — while the scheduler's smoothed grant wait exceeds
+  `pool_target_queue_wait`, the model adds proportional headroom: sustained
+  waiting means supply has been lagging even when the instantaneous counts
+  look covered.
+- **Asymmetric dynamics** — scale-UP applies immediately (on the arrival
+  path, before the request even queues); scale-DOWN needs demand below the
+  current target for `pool_scale_down_after` continuous seconds and then
+  steps one notch per evaluation — hysteresis, so a lull between waves
+  never flaps the pool. Spawn faults cannot oscillate the target either:
+  supply is not an input to the model, only demand is.
+- **Kill switch** — `APP_POOL_AUTOSCALE_ENABLED=0` makes `target()` return
+  the static constant for every lane, restoring pre-autoscale behavior
+  byte-for-byte. A static target of 0 ("no warm pool") is honored verbatim
+  in BOTH modes: deployments that explicitly disabled pooling must not
+  gain one because a model started running.
+
+Targets are *desired warm capacity*; the executor still clamps them under
+the backend's physical `pool_capacity` (and the session-held slots) in
+`_lane_target` — cross-lane arbitration over shared chips stays where the
+capacity truth lives.
+
+The clock is injectable, so the whole dynamics suite runs on a fake clock
+with zero sleeps (the scheduler's discipline).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from collections.abc import Callable
+from dataclasses import dataclass
+
+from ..config import Config
+from ..utils import tracing
+
+logger = logging.getLogger(__name__)
+
+SCALE_UP = "up"
+SCALE_DOWN = "down"
+SCALE_REAP = "reap"
+
+
+@dataclass
+class LaneSnapshot:
+    """One lane's supply/demand instant, assembled by the executor.
+
+    `pooled` counts only NON-WEDGED warm sandboxes: a lane of wedged pods
+    reads as empty supply, so the model keeps demanding replacements
+    (the device-health satellite; full drain/fencing stays the ROADMAP
+    actuation item)."""
+
+    queued: int = 0
+    in_use: int = 0
+    pooled: int = 0
+    spawning: int = 0
+    queue_wait_ewma: float = 0.0
+    spawn_ewma: float = 0.0
+
+
+class _LaneModel:
+    """Per-lane dynamic state: the current target plus the demand
+    estimators behind it."""
+
+    __slots__ = (
+        "target",
+        "arrival_rate",
+        "last_arrival",
+        "below_since",
+        "last_raw",
+        "scale_ups",
+        "scale_downs",
+        "reaped",
+    )
+
+    def __init__(self, target: int) -> None:
+        self.target = target
+        self.arrival_rate: float | None = None  # requests/s EWMA
+        self.last_arrival: float | None = None
+        self.below_since: float | None = None  # demand < target since (s)
+        self.last_raw = 0.0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.reaped = 0
+
+
+class PoolAutoscaler:
+    """Queue-wait-driven per-lane warm-pool targets (policy half)."""
+
+    def __init__(
+        self,
+        config: Config | None = None,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        metrics=None,
+        tracer=None,
+    ) -> None:
+        self.config = config or Config()
+        self.clock = clock
+        self.metrics = metrics
+        self.tracer = tracer
+        self.enabled = bool(self.config.pool_autoscale_enabled)
+        self.min_target = max(0, self.config.pool_min_target)
+        self.max_target = max(self.min_target, self.config.pool_max_target)
+        # EWMA smoothing shared with the scheduler's estimators: one knob,
+        # one notion of "reacts this fast".
+        self._alpha = min(max(self.config.scheduler_ewma_alpha, 0.01), 1.0)
+        self._lanes: dict[int, _LaneModel] = {}
+
+    # ------------------------------------------------------------- targets
+
+    @property
+    def static_target(self) -> int:
+        return self.config.executor_pod_queue_target_length
+
+    def _initial_target(self) -> int:
+        """A fresh lane starts at the static constant clamped into the
+        dynamic bounds: at rest, enabled-and-idle behaves exactly like the
+        static pool until demand (or the idle decay) says otherwise."""
+        return min(max(self.static_target, self.min_target), self.max_target)
+
+    def _lane(self, lane: int) -> _LaneModel:
+        model = self._lanes.get(lane)
+        if model is None:
+            model = _LaneModel(self._initial_target())
+            self._lanes[lane] = model
+        return model
+
+    def target(self, lane: int) -> int:
+        """The lane's CURRENT warm-pool target (before the executor's
+        physical-capacity clamp). Disabled, or a deployment that set the
+        static target to 0 ("no warm pool"): the static constant, verbatim."""
+        if not self.enabled or self.static_target <= 0:
+            return self.static_target
+        model = self._lanes.get(lane)
+        return model.target if model is not None else self._initial_target()
+
+    # -------------------------------------------------------------- inputs
+
+    def observe_arrival(
+        self, lane: int, snapshot: LaneSnapshot, *, jobs: int = 1
+    ) -> None:
+        """One acquisition arriving on the lane (a batched dispatch's
+        multi-job token counts as its N coalesced requests). Updates the
+        arrival-rate EWMA and applies scale-UP immediately, so the refill
+        the arriving burst triggers already sees the raised target."""
+        if not self.enabled or self.static_target <= 0:
+            return
+        model = self._lane(lane)
+        now = self.clock()
+        if model.last_arrival is not None:
+            gap = max(now - model.last_arrival, 1e-3)
+            sample = max(1, jobs) / gap
+            if model.arrival_rate is None:
+                model.arrival_rate = sample
+            else:
+                model.arrival_rate = (
+                    self._alpha * sample + (1.0 - self._alpha) * model.arrival_rate
+                )
+        model.last_arrival = now
+        # The arriving request is not in `queued` yet — count it.
+        self._maybe_scale_up(lane, model, snapshot, now, extra=max(1, jobs))
+
+    # ------------------------------------------------------------ the model
+
+    def _effective_rate(self, model: _LaneModel, now: float) -> float:
+        """The arrival-rate estimate, bounded by what the time since the
+        last arrival can still justify: an EWMA frozen at burst height
+        would otherwise keep spawn-ahead demand alive long after traffic
+        stopped."""
+        if model.arrival_rate is None or model.last_arrival is None:
+            return 0.0
+        idle = now - model.last_arrival
+        if idle <= 0:
+            return model.arrival_rate
+        return min(model.arrival_rate, 1.0 / idle)
+
+    def raw_demand(
+        self,
+        lane: int,
+        snapshot: LaneSnapshot,
+        *,
+        now: float | None = None,
+        extra: int = 0,
+    ) -> float:
+        """The lane's instantaneous demand in sandboxes: requests being
+        served + requests waiting (+ the one arriving) + the spawn-ahead
+        term (requests expected to arrive while one spawn completes) + the
+        queue-wait pressure headroom.
+
+        Spawn-ahead is weighted by the queue-wait evidence: a fast
+        SEQUENTIAL client produces a sky-high arrival rate at concurrency
+        one (each request departs before the next arrives — the
+        instantaneous counts already cover it, and its grant waits sit at
+        ~zero), so rate x spawn-time alone would over-provision every
+        busy-but-not-contended lane. Scaled by wait_ewma/wait_target
+        (capped at 1), the term only provisions ahead once recent waits
+        show supply actually lagging arrivals — which is precisely the
+        \"demand will outrun supply\" condition the ISSUE names."""
+        model = self._lane(lane)
+        if now is None:
+            now = self.clock()
+        wait_target = self.config.pool_target_queue_wait
+        evidence = 1.0
+        if wait_target > 0:
+            evidence = min(1.0, snapshot.queue_wait_ewma / wait_target)
+        spawn_ahead = (
+            self._effective_rate(model, now)
+            * max(0.0, snapshot.spawn_ewma)
+            * evidence
+        )
+        raw = float(snapshot.in_use + snapshot.queued + extra) + spawn_ahead
+        if (
+            wait_target > 0
+            and snapshot.queue_wait_ewma > wait_target
+            and (snapshot.queued + snapshot.in_use + extra) > 0
+        ):
+            # Sustained waiting: supply has been lagging demand even when
+            # the instantaneous counts look covered — add headroom
+            # proportional to how far past acceptable the wait runs.
+            raw += snapshot.queue_wait_ewma / wait_target
+        model.last_raw = raw
+        return raw
+
+    @staticmethod
+    def _whole(raw: float) -> int:
+        """Demand in whole sandboxes, round-half-up: ceil would let a
+        hair of spawn-ahead (raw 1.01) round a satisfied lane up a whole
+        sandbox on every arrival — the fractional terms must accumulate
+        to half a sandbox of real demand before they cost one."""
+        return int(math.floor(raw + 0.5))
+
+    def _maybe_scale_up(
+        self,
+        lane: int,
+        model: _LaneModel,
+        snapshot: LaneSnapshot,
+        now: float,
+        *,
+        extra: int = 0,
+    ) -> None:
+        raw = self.raw_demand(lane, snapshot, now=now, extra=extra)
+        desired = min(self._whole(raw), self.max_target)
+        if desired > model.target:
+            previous = model.target
+            model.target = desired
+            model.below_since = None
+            model.scale_ups += 1
+            self._record_event(lane, SCALE_UP, previous, desired, raw)
+        elif raw >= model.target:
+            model.below_since = None
+
+    def evaluate(self, lane: int, snapshot: LaneSnapshot) -> int:
+        """One sweep-cadence evaluation: scale up when demand outruns the
+        target, otherwise run the hysteresis clock and step the target down
+        once it expires. Returns the (possibly updated) target."""
+        if not self.enabled or self.static_target <= 0:
+            return self.static_target
+        model = self._lane(lane)
+        now = self.clock()
+        raw = self.raw_demand(lane, snapshot, now=now)
+        desired = min(self._whole(raw), self.max_target)
+        if desired > model.target:
+            previous = model.target
+            model.target = desired
+            model.below_since = None
+            model.scale_ups += 1
+            self._record_event(lane, SCALE_UP, previous, desired, raw)
+            return model.target
+        if desired >= model.target:
+            model.below_since = None
+            return model.target
+        # Demand below target: hysteresis, then one step per evaluation —
+        # gradual release, so a mid-decay burst only has to win back one
+        # notch, not the whole ramp.
+        if model.below_since is None:
+            model.below_since = now
+            return model.target
+        if now - model.below_since < self.config.pool_scale_down_after:
+            return model.target
+        floor = max(desired, self.min_target)
+        stepped = max(floor, model.target - 1)
+        if stepped < model.target:
+            previous = model.target
+            model.target = stepped
+            model.scale_downs += 1
+            self._record_event(lane, SCALE_DOWN, previous, stepped, raw)
+        return model.target
+
+    # ---------------------------------------------------------- accounting
+
+    def note_reaped(self, lane: int, count: int) -> None:
+        """The executor's idle reaper disposed `count` excess warm
+        sandboxes on the lane (bookkeeping + the reap scale-event)."""
+        if count <= 0:
+            return
+        model = self._lane(lane)
+        model.reaped += count
+        events = getattr(self.metrics, "pool_scale_events", None)
+        if events is not None:
+            events.inc(count, chip_count=str(lane), direction=SCALE_REAP)
+
+    def _record_event(
+        self, lane: int, direction: str, previous: int, target: int, raw: float
+    ) -> None:
+        logger.info(
+            "autoscale %s: lane-%d target %d -> %d (raw demand %.2f)",
+            direction,
+            lane,
+            previous,
+            target,
+            raw,
+        )
+        events = getattr(self.metrics, "pool_scale_events", None)
+        if events is not None:
+            events.inc(chip_count=str(lane), direction=direction)
+        if self.tracer is not None:
+            # Scale decisions are rare and exactly what a capacity review
+            # pulls up: record_span bypasses head sampling (fresh trace id,
+            # zero-duration span — the device-health transition
+            # discipline), retrievable via /traces at any sample ratio.
+            self.tracer.record_span(
+                "autoscale.transition",
+                trace_id=tracing.new_trace_id(),
+                parent_id=None,
+                start_unix=time.time(),
+                duration_s=0.0,
+                attributes={
+                    "lane": lane,
+                    "direction": direction,
+                    "from": previous,
+                    "to": target,
+                    "raw_demand": round(raw, 3),
+                },
+            )
+
+    # ------------------------------------------------------------- surfaces
+
+    def lanes(self) -> list[int]:
+        return list(self._lanes)
+
+    def snapshot(self) -> dict:
+        """The /statusz autoscaler section: the model's verdicts next to
+        the demand signals driving them."""
+        body: dict = {
+            "enabled": self.enabled,
+            "min_target": self.min_target,
+            "max_target": self.max_target,
+            "static_target": self.static_target,
+        }
+        if not self.enabled:
+            return body
+        now = self.clock()
+        body["lanes"] = {
+            str(lane): {
+                "target": model.target,
+                "raw_demand": round(model.last_raw, 3),
+                "arrival_rate_per_s": round(
+                    self._effective_rate(model, now), 3
+                ),
+                "scale_ups": model.scale_ups,
+                "scale_downs": model.scale_downs,
+                "reaped": model.reaped,
+            }
+            for lane, model in sorted(self._lanes.items())
+        }
+        return body

@@ -419,8 +419,18 @@ class KubernetesSandboxBackend(SandboxBackend):
 
     def pool_capacity(self, chip_count: int) -> int | None:
         """TPU lanes hold at most `tpu_warm_pool_capacity` warm pods (each
-        owns its chips while pooled); CPU lanes keep the configured target."""
-        return self.config.tpu_warm_pool_capacity if chip_count > 0 else None
+        owns its chips while pooled); CPU lanes keep the configured target.
+        `tpu_warm_pool_capacity_by_chip_count` overrides per lane — the
+        physical ceiling a cluster with N same-topology slices declares so
+        the autoscaler's dynamic targets have room to use them."""
+        if chip_count <= 0:
+            return None
+        override = self.config.tpu_warm_pool_capacity_by_chip_count.get(
+            str(chip_count)
+        )
+        if override is not None:
+            return max(0, int(override))
+        return self.config.tpu_warm_pool_capacity
 
     def _ready_wait_seconds(self) -> int:
         # Pod Ready gates on /readyz (warm runner hot), so the wait budget

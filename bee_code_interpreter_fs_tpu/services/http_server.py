@@ -145,7 +145,8 @@ def statusz_text(body: dict) -> str:
     ]
     for lane, entry in sorted(body.get("lanes", {}).items()):
         lines.append(
-            f"  lane {lane}: pool={entry.get('pool_depth', 0)} "
+            f"  lane {lane}: pool={entry.get('pool_depth', 0)}"
+            f"/{entry.get('pool_target', 0)} "
             f"in_use={entry.get('in_use', 0)} "
             f"sessions={entry.get('session_held', 0)} "
             f"spawning={entry.get('spawning', 0)} "
@@ -156,6 +157,27 @@ def statusz_text(body: dict) -> str:
         )
     if not body.get("lanes"):
         lines.append("  (no lanes)")
+    autoscaler = body.get("autoscaler", {})
+    lines.append("")
+    if autoscaler.get("enabled"):
+        lines.append(
+            f"autoscaler: bounds=[{autoscaler.get('min_target')}"
+            f"..{autoscaler.get('max_target')}] "
+            f"static={autoscaler.get('static_target')}"
+        )
+        for lane, row in sorted(autoscaler.get("lanes", {}).items()):
+            lines.append(
+                f"  lane {lane}: target={row.get('target')} "
+                f"demand={row.get('raw_demand')} "
+                f"rate={row.get('arrival_rate_per_s')}/s "
+                f"ups={row.get('scale_ups')} downs={row.get('scale_downs')} "
+                f"reaped={row.get('reaped')}"
+            )
+    else:
+        lines.append(
+            "autoscaler: disabled "
+            f"(static target {autoscaler.get('static_target', '?')})"
+        )
     health = body.get("device_health", {})
     lines.append("")
     if health.get("enabled"):
@@ -338,11 +360,15 @@ def create_http_app(
                 headers={"Retry-After": str(retry_after)},
             )
         # Operator detail: per-lane queue pressure (the scheduler's own
-        # queue-wait EWMA — the PR 3 autoscaling-hint gauge, surfaced here
-        # so "are lanes starved?" needs no Prometheus round-trip) and batch
-        # occupancy ("are batches running under-filled?").
+        # queue-wait EWMA — no longer just a hint: the warm-pool
+        # autoscaler closes the loop on it) and batch occupancy ("are
+        # batches running under-filled?"), joined with SUPPLY (the dynamic
+        # pool target and the pooled/in-use/spawning counts backing it) so
+        # demand and supply read side by side.
         body: dict = {"status": "ok"}
         lanes = code_executor.scheduler.lane_detail()
+        for lane, entry in code_executor.lane_supply().items():
+            lanes.setdefault(lane, {}).update(entry)
         if lanes:
             body["lanes"] = lanes
         body["batching"] = {

@@ -267,6 +267,18 @@ class SandboxScheduler:
             for lane, state in self._lanes.items()
         }
 
+    def queue_wait_ewma(self, lane: int) -> float:
+        """One lane's smoothed queue wait (0.0 until the first grant) —
+        the autoscaler's pressure input."""
+        state = self._lanes.get(lane)
+        return state.queue_wait_ewma.get(0.0) if state is not None else 0.0
+
+    def spawn_ewma(self, lane: int) -> float:
+        """One lane's smoothed spawn latency (0.0 until the first spawn) —
+        the autoscaler's spawn-ahead horizon."""
+        state = self._lanes.get(lane)
+        return state.spawn_ewma.get(0.0) if state is not None else 0.0
+
     def observe_spawn(self, lane: int, seconds: float) -> None:
         """Feed the spawn-latency EWMA (called beside the spawn histogram)."""
         self._lane(lane).spawn_ewma.observe(max(0.0, seconds))

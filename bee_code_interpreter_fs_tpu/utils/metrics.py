@@ -353,6 +353,17 @@ class ExecutorMetrics:
             "serial path, by reason).",
             ("outcome",),
         )
+        # Warm-pool autoscaling (services/autoscaler.py): target moves and
+        # idle reaps, by lane and direction. A healthy adaptive pool shows
+        # up/down/reap all moving with the traffic shape; up with no
+        # down/reap means targets ratchet (check the sweep is running).
+        self.pool_scale_events = self.registry.counter(
+            "code_interpreter_pool_scale_events_total",
+            "Warm-pool autoscaler events by chip-count lane and direction "
+            "(up = target raised on demand, down = hysteresis step-down, "
+            "reap = excess idle warm sandbox disposed).",
+            ("chip_count", "direction"),
+        )
         self.scheduler_queue_wait = self.registry.histogram(
             "code_interpreter_scheduler_queue_wait_seconds",
             "Seconds a request queued for a sandbox slot before its grant, "
@@ -540,6 +551,9 @@ class ExecutorMetrics:
             ("tenant",),
         )
         self.pool_depth: Gauge | None = None
+        self.pool_target: Gauge | None = None
+        self.pool_supply: Gauge | None = None
+        self.pool_desired_chips: Gauge | None = None
         self.active_sessions: Gauge | None = None
         self.compile_cache_store: Gauge | None = None
         self.breaker_state: Gauge | None = None
@@ -601,6 +615,78 @@ class ExecutorMetrics:
             "Warm sandboxes currently pooled, by chip-count lane.",
             ("chip_count",),
             callback=sample,
+        )
+
+    def bind_autoscale(self, executor) -> None:
+        """Expose the autoscaler's per-lane verdicts at scrape time:
+        pool_target (the dynamic, capacity-clamped lane target),
+        pool_supply (non-wedged pooled + in-flight spawns — what actually
+        backs the target), and pool_desired_chips (target x the lane's
+        chip count; the k8s HPA external-metric feed — `sum()` it for the
+        fleet's desired accelerator footprint). All three also ride the
+        OTLP metrics export like any family in this registry."""
+
+        def lanes() -> list[int]:
+            return sorted(executor._known_lanes())
+
+        def target_sample() -> dict[tuple[str, ...], float]:
+            return {
+                (str(lane),): float(executor._lane_target(lane))
+                for lane in lanes()
+            }
+
+        self.pool_target = self.registry.gauge(
+            "code_interpreter_pool_target",
+            "Warm-pool target per chip-count lane (the autoscaler's "
+            "demand-model verdict, clamped by backend capacity; the "
+            "static constant with APP_POOL_AUTOSCALE_ENABLED=0).",
+            ("chip_count",),
+            callback=target_sample,
+        )
+
+        def supply_sample() -> dict[tuple[str, ...], float]:
+            return {
+                (str(lane),): float(
+                    executor._pool_supply(lane)
+                    + executor._spawning.get(lane, 0)
+                )
+                for lane in lanes()
+            }
+
+        self.pool_supply = self.registry.gauge(
+            "code_interpreter_pool_supply",
+            "Warm supply backing the lane target: non-wedged pooled "
+            "sandboxes plus spawns in flight, by chip-count lane.",
+            ("chip_count",),
+            callback=supply_sample,
+        )
+
+        def desired_chips_sample() -> dict[tuple[str, ...], float]:
+            # Deliberately the UNCLAMPED model target: the whole point of
+            # an HPA external-metric feed is expressing demand BEYOND the
+            # cluster's current capacity — the clamped _lane_target can
+            # never exceed what already exists, so a feed built on it
+            # would read desired == current forever and never scale the
+            # node pool. pool_target (above) stays the clamped operational
+            # verdict the warm pool actually aims for.
+            return {
+                (str(lane),): float(
+                    executor.autoscaler.target(lane) * max(1, lane)
+                )
+                for lane in lanes()
+            }
+
+        self.pool_desired_chips = self.registry.gauge(
+            "code_interpreter_pool_desired_chips",
+            "Chips the autoscaler's demand model currently wants, by "
+            "chip-count lane (UNCLAMPED model target x chips; lane 0 "
+            "counts one chip-equivalent) — unlike pool_target this may "
+            "exceed the backend's declared capacity, which is exactly the "
+            "scale-up signal. Sum across lanes = the fleet's desired "
+            "accelerator footprint — the external-metric feed for a "
+            "Kubernetes HPA scaling the node pool.",
+            ("chip_count",),
+            callback=desired_chips_sample,
         )
 
     def bind_sessions(self, sessions) -> None:

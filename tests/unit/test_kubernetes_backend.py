@@ -579,3 +579,19 @@ async def test_fault_wrapper_passes_breakers_through(fake_kubectl):
     board = BreakerBoard()
     wrapped.bind_breakers(board)
     assert inner._breakers is board
+
+
+async def test_pool_capacity_per_lane_overrides(fake_kubectl):
+    """tpu_warm_pool_capacity_by_chip_count: the physical ceiling the
+    autoscaler's dynamic targets are clamped under, declared per lane — a
+    cluster with three 4-chip slices can pool three warm 4-chip pods while
+    bigger lanes keep the flat default."""
+    kubectl, _, _ = fake_kubectl
+    backend = _backend(
+        kubectl,
+        tpu_warm_pool_capacity=1,
+        tpu_warm_pool_capacity_by_chip_count={"4": 3},
+    )
+    assert backend.pool_capacity(0) is None  # CPU lanes stay unconstrained
+    assert backend.pool_capacity(4) == 3
+    assert backend.pool_capacity(8) == 1  # flat default

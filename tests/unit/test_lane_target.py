@@ -1,0 +1,123 @@
+"""_lane_target capacity clamping (the ISSUE's test-coverage satellite).
+
+The warm-pool target — static constant or the autoscaler's dynamic verdict
+— is always clamped under the backend's physical capacity, minus the slots
+session-parked sandboxes hold across every constrained lane, with
+`extra_free` letting a closing session's own turnover see its slot as
+available. These invariants predate autoscaling but had no direct suite;
+now that the uncapped input MOVES, they are load-bearing.
+"""
+
+import asyncio
+
+from fakes import FakeBackend
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.autoscaler import LaneSnapshot
+from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+
+def make_executor(backend, tmp_path, **config_kwargs) -> CodeExecutor:
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        compile_cache_prewarm=False,
+        **config_kwargs,
+    )
+    return CodeExecutor(backend, Storage(config.file_storage_path), config)
+
+
+async def test_unconstrained_lane_keeps_configured_target(tmp_path):
+    executor = make_executor(
+        FakeBackend(capacity=None),
+        tmp_path,
+        executor_pod_queue_target_length=5,
+    )
+    try:
+        assert executor._lane_target(0) == 5
+    finally:
+        await executor.close()
+
+
+async def test_capacity_caps_static_and_dynamic_targets(tmp_path):
+    executor = make_executor(
+        FakeBackend(capacity=2),
+        tmp_path,
+        executor_pod_queue_target_length=5,
+    )
+    try:
+        # Static 5 clamps to the backend's 2 physical slots...
+        assert executor._lane_target(4) == 2
+        # ...and so does a demand-inflated dynamic target: autoscaling
+        # raises DESIRE, never physical capacity.
+        executor.autoscaler.observe_arrival(4, LaneSnapshot(queued=9))
+        assert executor.autoscaler.target(4) > 2
+        assert executor._lane_target(4) == 2
+    finally:
+        await executor.close()
+
+
+async def test_session_held_slots_shrink_the_cap(tmp_path):
+    """Session-parked sandboxes own their chips for the session's
+    lifetime, summed ACROSS constrained lanes (shared physical substrate):
+    the pool must not demand those chips back."""
+    executor = make_executor(
+        FakeBackend(capacity=3),
+        tmp_path,
+        executor_pod_queue_target_length=5,
+    )
+    try:
+        executor._session_held[0] = 2
+        assert executor._lane_target(0) == 1
+        # A session parked in ANOTHER constrained lane gates this one too.
+        assert executor._lane_target(4) == 1
+        executor._session_held[4] = 1
+        assert executor._lane_target(0) == 0
+    finally:
+        await executor.close()
+
+
+async def test_extra_free_restores_a_closing_sessions_slot(tmp_path):
+    """extra_free: a closing session's turnover treats its own still-
+    counted slot as available for the recycle decision."""
+    executor = make_executor(
+        FakeBackend(capacity=1),
+        tmp_path,
+        executor_pod_queue_target_length=5,
+    )
+    try:
+        executor._session_held[0] = 1
+        assert executor._lane_target(0) == 0
+        assert executor._lane_target(0, extra_free=1) == 1
+    finally:
+        await executor.close()
+
+
+async def test_unconstrained_sessions_do_not_gate_targets(tmp_path):
+    """Only capacity-constrained lanes count session holds: a CPU-lane
+    session on an unconstrained backend gates nothing."""
+    executor = make_executor(
+        FakeBackend(capacity=None),
+        tmp_path,
+        executor_pod_queue_target_length=3,
+    )
+    try:
+        executor._session_held[0] = 2
+        assert executor._lane_target(0) == 3
+    finally:
+        await executor.close()
+
+
+async def test_capacity_floor_is_zero(tmp_path):
+    """More sessions than capacity (races at the cap): the target clamps
+    at zero, never negative."""
+    executor = make_executor(
+        FakeBackend(capacity=1),
+        tmp_path,
+        executor_pod_queue_target_length=5,
+    )
+    try:
+        executor._session_held[0] = 3
+        assert executor._lane_target(0) == 0
+    finally:
+        await executor.close()

@@ -141,6 +141,7 @@ def test_lane_rows_render_queue_pressure():
         lanes={
             "0": {
                 "pool_depth": 2,
+                "pool_target": 4,
                 "in_use": 1,
                 "session_held": 1,
                 "spawning": 0,
@@ -153,9 +154,40 @@ def test_lane_rows_render_queue_pressure():
     )
     text = statusz_text(body)
     assert (
-        "lane 0: pool=2 in_use=1 sessions=1 spawning=0 queued=3 "
+        "lane 0: pool=2/4 in_use=1 sessions=1 spawning=0 queued=3 "
         "wait_ewma=0.25s batch_occ=0.9 breaker=open" in text
     )
+
+
+def test_autoscaler_section_renders():
+    enabled = empty_body(
+        autoscaler={
+            "enabled": True,
+            "min_target": 1,
+            "max_target": 16,
+            "static_target": 5,
+            "lanes": {
+                "0": {
+                    "target": 7,
+                    "raw_demand": 6.4,
+                    "arrival_rate_per_s": 3.2,
+                    "scale_ups": 2,
+                    "scale_downs": 1,
+                    "reaped": 3,
+                }
+            },
+        }
+    )
+    text = statusz_text(enabled)
+    assert "autoscaler: bounds=[1..16] static=5" in text
+    assert (
+        "lane 0: target=7 demand=6.4 rate=3.2/s ups=2 downs=1 reaped=3"
+        in text
+    )
+    disabled = empty_body(
+        autoscaler={"enabled": False, "static_target": 5}
+    )
+    assert "autoscaler: disabled (static target 5)" in statusz_text(disabled)
 
 
 def test_usage_text_disabled_and_empty():
