@@ -79,6 +79,21 @@ async def main(ctx: ApplicationContext | None = None) -> None:
     # every APP_USAGE_FLUSH_INTERVAL seconds, so a crash loses at most one
     # interval of accounting (the kill switch makes start() a no-op).
     ctx.usage_ledger.start()
+    # Quota enforcement is passive (checked per admission; policy file
+    # hot-reloads lazily) — nothing to start, but its posture is exactly
+    # what an operator greps boot logs for during an abuse incident.
+    if ctx.quota_enforcer.enabled:
+        policy = ctx.quota_enforcer.default_policy
+        logger.info(
+            "quota enforcement active (default: %g chip-s / %gs window, "
+            "rate=%d, concurrent=%d, violations=%d; policy file: %s)",
+            policy.chip_seconds_per_window,
+            policy.window_seconds,
+            policy.requests_per_window,
+            policy.max_concurrent,
+            policy.violations_per_window,
+            ctx.config.quota_policy_file or "none",
+        )
 
     try:
         stop_task = asyncio.create_task(stop.wait())

@@ -80,6 +80,20 @@ class ApplicationContext:
         return UsageLedger(self.config, metrics=self.metrics)
 
     @cached_property
+    def quota_enforcer(self):
+        """Quota/abuse-control layer (services/quotas.py): reads the usage
+        ledger's counters at admission — sliding-window chip-second
+        budgets, rate/concurrency caps, repeat-offender quarantine.
+        Construction restores quota windows from the ledger journal (an
+        offender cannot reset its budget by crashing the service); the
+        kill switch yields a disabled enforcer whose gate is a no-op."""
+        from .services.quotas import QuotaEnforcer
+
+        return QuotaEnforcer(
+            self.config, usage=self.usage_ledger, metrics=self.metrics
+        )
+
+    @cached_property
     def code_executor(self) -> CodeExecutor:
         return CodeExecutor(
             self.backend,
@@ -88,6 +102,7 @@ class ApplicationContext:
             metrics=self.metrics,
             tracer=self.tracer,
             usage=self.usage_ledger,
+            quotas=self.quota_enforcer,
         )
 
     @cached_property

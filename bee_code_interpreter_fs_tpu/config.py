@@ -353,6 +353,17 @@ class Config(BaseModel):
     # A request's limits.output_bytes (below this cap) upgrades truncation
     # to an output_cap violation kill.
     sandbox_max_output_bytes: int = 10485760
+    # cgroup-v2 HARD enforcement in the executor (memory.max / pids.max
+    # from the APP_LIMIT_* caps): where the sandbox host's cgroupfs is
+    # writable (pods with a delegated cgroup namespace, root dev hosts)
+    # the executor parks its runner group and every cold child inside a
+    # kernel-enforced box, so a workload that dodges the rlimits and
+    # outruns the sampling watchdog still cannot take the pod down —
+    # the in-pod limits story matches what the quota layer promises.
+    # Detection is automatic with a clean fallback to rlimits+watchdog on
+    # read-only cgroupfs; 0 forces the fallback everywhere (the executor
+    # then behaves exactly as before this subsystem).
+    sandbox_cgroup_enforce: bool = True
     # -- per-tenant usage metering (services/usage.py) ------------------------
     # Kill switch for the whole metering plane: 0 restores the pre-metering
     # behavior byte-for-byte — no ledger, no journal IO, no attribution
@@ -379,6 +390,60 @@ class Config(BaseModel):
     # latest-wins journal lines make replay-after-crash idempotent at any
     # point in this cycle.
     usage_journal_max_bytes: int = 1048576
+    # Compaction RETAINS journal lines newer than this many seconds
+    # (bounded to half the journal size cap) instead of truncating to
+    # empty: each line is a timestamped cumulative sample, and that recent
+    # timeline is what the quota layer's sliding windows restore from
+    # after a crash — an offender must not earn a fresh budget by crashing
+    # the control plane. Set this >= your largest quota window for exact
+    # window restores; 0 restores the truncate-to-empty behavior (replay
+    # correctness is unaffected either way — retained lines are stale
+    # cumulative values the max-merge makes no-ops).
+    usage_journal_keep_seconds: float = 7200.0
+    # -- per-tenant quota enforcement (services/quotas.py) --------------------
+    # Kill switch for the whole quota/abuse-control layer: 0 restores the
+    # pre-quota behavior byte-for-byte — no admission checks, no /quotas
+    # surface, no quota fields in Result.phases, no quota_* metric samples.
+    # Enforcement reads the PR 9 usage ledger, so budgets and violation
+    # quotas are inert while APP_USAGE_METERING_ENABLED=0 (rate and
+    # concurrency caps are too: the whole layer keys off the metered
+    # tenant). The enabled default changes nothing by itself: every cap
+    # below defaults to 0 = unlimited.
+    quotas_enabled: bool = True
+    # The DEFAULT per-tenant policy (every knob 0 = that cap is off):
+    # chip-seconds a tenant may consume per sliding window...
+    quota_chip_seconds_per_window: float = 0.0
+    # ...the window those budgets slide over (also the violation-quota and
+    # request-rate window)...
+    quota_window_seconds: float = 3600.0
+    # ...admitted requests per window (a cheap pre-scheduler rate cap —
+    # the scheduler's per-tenant queue depth bounds INSTANTANEOUS backlog,
+    # this bounds sustained rate)...
+    quota_requests_per_window: int = 0
+    # ...and concurrent admitted (not yet finished) requests.
+    quota_max_concurrent: int = 0
+    # Repeat-offender shedding: typed limit violations (oom/disk_quota/
+    # nproc/cpu_time/output_cap, from the ledger's violations-by-kind
+    # counters) a tenant may accrue per window before it is QUARANTINED —
+    # shed at the door with reason=quarantined instead of burning a
+    # sandbox per violating attempt. 0 = off.
+    quota_violations_per_window: int = 0
+    # Quarantine durations grow exponentially per episode (base * 2^(n-1),
+    # capped) and the offender level decays back one step per decay
+    # interval of clean behavior after release — abusive tenants are shed
+    # harder each storm, reformed ones earn their way back.
+    quota_quarantine_base_seconds: float = 30.0
+    quota_quarantine_max_seconds: float = 3600.0
+    quota_quarantine_decay_seconds: float = 300.0
+    # Optional JSON policy file layering per-tenant overrides on the
+    # default policy above: {"default": {...}, "tenants": {"name": {...}}}
+    # with keys chip_seconds_per_window / window_seconds /
+    # requests_per_window / max_concurrent / violations_per_window /
+    # quarantine_{base,max,decay}_seconds. Hot-reloadable: the enforcer
+    # re-stats the file (at most every quota_policy_reload_seconds) and a
+    # malformed rewrite keeps the last good policy instead of failing open.
+    quota_policy_file: str = ""
+    quota_policy_reload_seconds: float = 2.0
     # -- shutdown ------------------------------------------------------------
     # Graceful drain budget on SIGTERM: health flips to NOT_SERVING and new
     # executes shed immediately, then shutdown waits up to this many seconds

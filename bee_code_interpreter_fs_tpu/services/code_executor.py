@@ -62,9 +62,11 @@ from .errors import (  # noqa: F401 — canonical home is errors.py; re-exported
     ExecutorError,
     LimitExceededError,
     QueueDepthError,
+    QuotaExceededError,
     SessionLimitError,
 )
 from .limits import VIOLATION_KINDS, request_limits, validate_config_limits
+from .quotas import QuotaEnforcer, QuotaVerdict
 from .scheduler import SandboxScheduler
 from .storage import Storage, StorageObjectNotFound
 from .transfer import (
@@ -163,6 +165,7 @@ class CodeExecutor:
         tracer: Tracer | None = None,
         compile_cache: CompileCacheStore | None = None,
         usage: UsageLedger | None = None,
+        quotas: QuotaEnforcer | None = None,
     ) -> None:
         self.backend = backend
         self.storage = storage
@@ -206,6 +209,15 @@ class CodeExecutor:
         self.usage = usage or UsageLedger(self.config, metrics=self.metrics)
         if self.usage.enabled:
             self.scheduler.usage = self.usage
+        # Quota enforcement (services/quotas.py): the admission gate that
+        # READS the ledger above — sliding-window chip-second budgets,
+        # request-rate/concurrency caps, and repeat-offender quarantine,
+        # all checked before the scheduler ever enqueues. The kill switch
+        # (APP_QUOTAS_ENABLED=0) constructs a disabled enforcer whose
+        # admit()/release() are no-ops — pre-quota behavior byte-for-byte.
+        self.quotas = quotas or QuotaEnforcer(
+            self.config, usage=self.usage, metrics=self.metrics
+        )
         # Spawn retries mirror the reference's ladder (3 attempts, 0.5s
         # exponential base capped at 5s) with full jitter so parallel refill
         # failures don't re-synchronize into retry waves.
@@ -320,6 +332,7 @@ class CodeExecutor:
         self.metrics.bind_scheduler(self.scheduler)
         self.metrics.bind_compile_cache(self.compile_cache)
         self.metrics.bind_autoscale(self)
+        self.metrics.bind_quotas(self.quotas)
 
     def _http_client(self) -> httpx.AsyncClient:
         if self._client is None or self._client.is_closed:
@@ -984,6 +997,12 @@ class CodeExecutor:
         env, executor_id = self._normalize_request(env, profile, executor_id)
         usage_tenant = self._usage_tenant(tenant)
         self._check_admission_open()
+        # Quota enforcement sits HERE — before the scheduler, the batcher,
+        # or any session machinery sees the request. A denied (or
+        # quarantined) request is never enqueued and consumes zero
+        # sandboxes; the typed QuotaExceededError maps to HTTP 429 /
+        # gRPC RESOURCE_EXHAUSTED with Retry-After + x-quota-* metadata.
+        quota = self._quota_admit(usage_tenant)
         self._inflight += 1
         try:
             if executor_id is not None:
@@ -1032,7 +1051,9 @@ class CodeExecutor:
             self._count_violation(e)
             # The violating request is billed (its device time landed via
             # the attempt's draft) AND counted under its violation kind —
-            # the abuse-control feed quotas will read.
+            # the abuse-control feed services/quotas.py reads: enough of
+            # these inside one window and the tenant's NEXT request is
+            # quarantined at the door instead of burning a sandbox here.
             self._usage_request(
                 usage_tenant, "limit_violation", violation=e.kind
             )
@@ -1049,10 +1070,41 @@ class CodeExecutor:
             raise
         finally:
             self._inflight -= 1
+            self.quotas.release(quota)
+        self._apply_quota_phases(result, quota)
         self._count_execution(
             result, session=executor_id is not None, usage_tenant=usage_tenant
         )
         return result
+
+    def _quota_admit(self, usage_tenant: str | None) -> QuotaVerdict | None:
+        """Run the quota gate and keep the rejection observable: a quota
+        denial is a rejected request on the dashboards and in the tenant's
+        ledger row (requests-by-outcome), exactly like a scheduler shed —
+        but it never touches the scheduler."""
+        try:
+            return self.quotas.admit(usage_tenant)
+        except QuotaExceededError:
+            self.metrics.executions.inc(outcome="rejected")
+            self._usage_request(usage_tenant, "rejected")
+            raise
+
+    def _apply_quota_phases(
+        self, result: Result, quota: QuotaVerdict | None
+    ) -> None:
+        """Success-path quota exposure (the pacing satellite): a `quota`
+        block in Result.phases with the POST-run remaining budget, so a
+        well-behaved agent can slow down before ever seeing a 429. Only
+        for tenants with a chip-second budget; absent otherwise (and with
+        the kill switch, byte-for-byte)."""
+        if quota is None:
+            return
+        # Refresh to the POST-run remaining (this run's bill is already in
+        # the ledger), then let the verdict render its one canonical shape.
+        self.quotas.refresh_verdict(quota)
+        block = quota.phases_block()
+        if block is not None:
+            result.phases["quota"] = block
 
     def _usage_tenant(self, tenant: str | None) -> str | None:
         """The normalized tenant name usage accounting records under, or
@@ -2173,6 +2225,9 @@ class CodeExecutor:
         env, executor_id = self._normalize_request(env, profile, executor_id)
         usage_tenant = self._usage_tenant(tenant)
         self._check_admission_open()
+        # Same quota gate as execute(): a denial surfaces before the first
+        # stream event (the HTTP layer still returns a clean 429).
+        quota = self._quota_admit(usage_tenant)
         queue: asyncio.Queue = asyncio.Queue()
         done = object()
 
@@ -2248,6 +2303,8 @@ class CodeExecutor:
             raise
         finally:
             self._inflight -= 1
+            self.quotas.release(quota)
+        self._apply_quota_phases(result, quota)
         self._count_execution(
             result, session=executor_id is not None, usage_tenant=usage_tenant
         )
@@ -3439,6 +3496,11 @@ class CodeExecutor:
             # occupancy). Bounded — the tenant table caps at
             # APP_USAGE_MAX_TENANTS with an _overflow row.
             "usage": self.usage.snapshot(),
+            # The quota layer's verdict state: per-tenant window
+            # consumption vs budget, in-flight counts, quarantine
+            # sentences, and denial totals — the "who is being shed, and
+            # why" view next to the usage it is computed from.
+            "quotas": self.quotas.snapshot(),
         }
         if self.device_health is not None:
             body["device_health"] = self.device_health.snapshot()

@@ -95,6 +95,39 @@ class DeadlineInfeasibleError(AdmissionRejectedError):
     elsewhere."""
 
 
+class QuotaExceededError(AdmissionRejectedError):
+    """The quota layer (services/quotas.py) refused the request at the door,
+    BEFORE the scheduler ever saw it: the tenant is over its sliding-window
+    chip-second budget, its request-rate or concurrent-grant cap, or is
+    quarantined as a repeat limit-violation offender. Retryable for the
+    budget/rate/concurrency reasons — HTTP 429 / gRPC RESOURCE_EXHAUSTED
+    with a Retry-After computed from the window's refill point and
+    ``x-quota-*`` metadata naming the reason and the remaining budget.
+    ``reason == "quarantined"`` is the shedding half: the same family (the
+    client's retry loop needs no new branch) with a distinct reason, and the
+    request is never enqueued — zero sandboxes, zero scheduler state, zero
+    chip-seconds burned per rejected attempt."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        tenant: str = "",
+        reason: str = "chip_seconds",
+        retry_after: float = 0.0,
+        remaining_chip_seconds: float | None = None,
+        limit_chip_seconds: float | None = None,
+        window_seconds: float | None = None,
+    ) -> None:
+        super().__init__(
+            message, lane=0, tenant=tenant, retry_after=retry_after
+        )
+        self.reason = reason
+        self.remaining_chip_seconds = remaining_chip_seconds
+        self.limit_chip_seconds = limit_chip_seconds
+        self.window_seconds = window_seconds
+
+
 class CircuitOpenError(SessionLimitError):
     """The lane's spawn circuit breaker is open: the backend failed N
     consecutive spawns and the cooldown has not elapsed, so the request

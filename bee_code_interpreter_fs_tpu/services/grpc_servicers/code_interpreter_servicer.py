@@ -24,6 +24,7 @@ from ..code_executor import (
     CodeExecutor,
     ExecutorError,
     LimitExceededError,
+    QuotaExceededError,
     SessionLimitError,
 )
 from ..custom_tool_executor import (
@@ -164,8 +165,11 @@ class CodeInterpreterServicer:
         metadata, byte-for-byte."""
         chip = result.phases.get("chip_seconds")
         device = result.phases.get("device_op_seconds")
-        if not isinstance(chip, (int, float)) and not isinstance(
-            device, (int, float)
+        quota = result.phases.get("quota")
+        if (
+            not isinstance(chip, (int, float))
+            and not isinstance(device, (int, float))
+            and not isinstance(quota, dict)
         ):
             return
         extra = list(trailing)
@@ -175,6 +179,19 @@ class CodeInterpreterServicer:
             extra.append(
                 ("x-usage-device-op-seconds", f"{float(device):.6f}")
             )
+        if isinstance(quota, dict):
+            # The pacing satellite, wire half: the remaining budget rides
+            # the SUCCESS path too, so a well-behaved agent can slow down
+            # before ever meeting RESOURCE_EXHAUSTED. Same structured
+            # channel as x-usage-* (the proto is frozen).
+            remaining = quota.get("remaining_chip_seconds")
+            if isinstance(remaining, (int, float)):
+                extra.append(
+                    (
+                        "x-quota-remaining-chip-seconds",
+                        f"{float(remaining):.6f}",
+                    )
+                )
         set_trailing = getattr(context, "set_trailing_metadata", None)
         if set_trailing is not None:
             set_trailing(tuple(extra))
@@ -196,6 +213,44 @@ class CodeInterpreterServicer:
         await context.abort(
             grpc.StatusCode.RESOURCE_EXHAUSTED,
             f"sandbox resource limit exceeded [violation={e.kind}]: {e}",
+        )
+
+    @staticmethod
+    async def _abort_quota(
+        context: grpc.aio.ServicerContext,
+        e: QuotaExceededError,
+        trailing: list[tuple[str, str]],
+    ) -> None:
+        """Quota denials map to RESOURCE_EXHAUSTED — the same retryable
+        family as every capacity shed — with `x-quota-*` trailing metadata
+        carrying the typed reason, the window-derived retry-after, and the
+        remaining budget (the proto is frozen; metadata is the structured
+        channel, as for x-violation and x-usage-*)."""
+        extra = trailing + [
+            ("x-quota-reason", e.reason),
+            ("x-quota-retry-after", f"{max(0.0, e.retry_after):.3f}"),
+        ]
+        if e.remaining_chip_seconds is not None:
+            extra.append(
+                (
+                    "x-quota-remaining-chip-seconds",
+                    f"{e.remaining_chip_seconds:.6f}",
+                )
+            )
+        if e.limit_chip_seconds is not None:
+            extra.append(
+                ("x-quota-limit-chip-seconds", f"{e.limit_chip_seconds:.6f}")
+            )
+        if e.window_seconds is not None:
+            extra.append(
+                ("x-quota-window-seconds", f"{e.window_seconds:.3f}")
+            )
+        set_trailing = getattr(context, "set_trailing_metadata", None)
+        if set_trailing is not None:
+            set_trailing(tuple(extra))
+        await context.abort(
+            grpc.StatusCode.RESOURCE_EXHAUSTED,
+            f"quota denied [reason={e.reason}]: {e}",
         )
 
     @staticmethod
@@ -274,6 +329,10 @@ class CodeInterpreterServicer:
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
             except LimitExceededError as e:
                 await self._abort_violation(context, e, trailing)
+            except QuotaExceededError as e:
+                # Before SessionLimitError (it subclasses it): the typed
+                # quota denial with x-quota-* trailing metadata.
+                await self._abort_quota(context, e, trailing)
             except CircuitOpenError as e:
                 # Degraded mode (spawn circuit open): UNAVAILABLE, mirroring
                 # the HTTP layer's 503 shed — the health service reports
@@ -340,6 +399,8 @@ class CodeInterpreterServicer:
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
             except LimitExceededError as e:
                 await self._abort_violation(context, e, trailing)
+            except QuotaExceededError as e:
+                await self._abort_quota(context, e, trailing)
             except CircuitOpenError as e:
                 await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             except SessionLimitError as e:
@@ -425,6 +486,8 @@ class CodeInterpreterServicer:
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
             except LimitExceededError as e:
                 await self._abort_violation(context, e, trailing)
+            except QuotaExceededError as e:
+                await self._abort_quota(context, e, trailing)
             except CircuitOpenError as e:
                 await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             except SessionLimitError as e:

@@ -34,6 +34,7 @@ from .code_executor import (
     CodeExecutor,
     ExecutorError,
     LimitExceededError,
+    QuotaExceededError,
     SessionLimitError,
 )
 from .custom_tool_executor import (
@@ -128,6 +129,59 @@ def usage_text(body: dict) -> str:
             lines.append(_usage_row_text(tenant, row))
     else:
         lines.append("  (no usage recorded)")
+    return "\n".join(lines) + "\n"
+
+
+def _quota_row_text(tenant: str, row: dict) -> str:
+    """One tenant's quota line for the text renderers (shared by
+    /statusz?format=text and /quotas?format=text)."""
+    policy = row.get("policy", {})
+    budget = policy.get("chip_seconds_per_window", 0)
+    parts = [f"  {tenant}:"]
+    if budget:
+        parts.append(
+            f"chip_s={row.get('used_chip_seconds_window', 0.0)}/{budget}"
+        )
+    else:
+        parts.append(
+            f"chip_s={row.get('used_chip_seconds_window', 0.0)} (no budget)"
+        )
+    parts.append(f"in_flight={row.get('in_flight', 0)}")
+    parts.append(f"denials={row.get('denials', 0)}")
+    quarantined = row.get("quarantined_for_s", 0.0)
+    if quarantined:
+        parts.append(
+            f"QUARANTINED {quarantined}s"
+            f" (level {row.get('offender_level', 0)})"
+        )
+    elif row.get("offender_level", 0):
+        parts.append(f"offender_level={row.get('offender_level', 0)}")
+    return " ".join(parts)
+
+
+def quotas_text(body: dict) -> str:
+    """Human-readable GET /quotas (`?format=text`)."""
+    if not body.get("enabled", False):
+        return "quota enforcement: disabled\n"
+    default = body.get("default_policy", {})
+    lines = [
+        "quota enforcement: "
+        f"denials={body.get('denials_total', 0)} "
+        f"policy_file={body.get('policy_file') or '(none)'} "
+        f"overrides={len(body.get('tenant_overrides', ()))}",
+        "  default: "
+        f"chip_s/window={default.get('chip_seconds_per_window', 0)} "
+        f"window={default.get('window_seconds', 0)}s "
+        f"req/window={default.get('requests_per_window', 0)} "
+        f"concurrent={default.get('max_concurrent', 0)} "
+        f"violations/window={default.get('violations_per_window', 0)}",
+    ]
+    tenants = body.get("tenants", {})
+    if tenants:
+        for tenant, row in sorted(tenants.items()):
+            lines.append(_quota_row_text(tenant, row))
+    else:
+        lines.append("  (no tenants observed)")
     return "\n".join(lines) + "\n"
 
 
@@ -227,6 +281,16 @@ def statusz_text(body: dict) -> str:
             lines.append(_usage_row_text(tenant, row))
     else:
         lines.append("usage: metering disabled")
+    quotas = body.get("quotas", {})
+    if quotas.get("enabled"):
+        lines.append(
+            f"quotas: denials={quotas.get('denials_total', 0)} "
+            f"overrides={len(quotas.get('tenant_overrides', ()))}"
+        )
+        for tenant, row in sorted(quotas.get("tenants", {}).items()):
+            lines.append(_quota_row_text(tenant, row))
+    else:
+        lines.append("quotas: enforcement disabled")
     sessions = body.get("sessions", ())
     lines.append(f"sessions: {len(sessions)}")
     return "\n".join(lines) + "\n"
@@ -521,6 +585,47 @@ def create_http_app(
             return web.Response(text=_usage_row_text(tenant, row) + "\n")
         return web.json_response(body)
 
+    @routes.get("/quotas")
+    async def quotas(request: web.Request) -> web.Response:
+        """The quota layer's verdict state: default policy, per-tenant
+        window consumption vs budget, in-flight counts, quarantine
+        sentences, and denial totals (services/quotas.py). `?format=text`
+        renders the operator view. 404 with the kill switch off —
+        pre-quota behavior, byte-for-byte."""
+        if not code_executor.quotas.enabled:
+            return web.json_response(
+                {"error": "quota enforcement is disabled "
+                          "(APP_QUOTAS_ENABLED=0, or usage metering is off)"},
+                status=404,
+            )
+        body = code_executor.quotas.snapshot()
+        if request.query.get("format") == "text":
+            return web.Response(text=quotas_text(body))
+        return web.json_response(body)
+
+    @routes.get("/quotas/{tenant}")
+    async def quotas_tenant(request: web.Request) -> web.Response:
+        """One tenant's quota view. A tenant past the ledger's cardinality
+        cap shares the `_overflow` row's budget — query that row for the
+        aggregate, exactly like /usage/{tenant}."""
+        if not code_executor.quotas.enabled:
+            return web.json_response(
+                {"error": "quota enforcement is disabled "
+                          "(APP_QUOTAS_ENABLED=0, or usage metering is off)"},
+                status=404,
+            )
+        tenant = request.match_info["tenant"]
+        row = code_executor.quotas.tenant_snapshot(tenant)
+        if row is None:
+            return web.json_response(
+                {"error": f"no quota state for tenant {tenant!r}"},
+                status=404,
+            )
+        body = {"tenant": tenant, "quota": row}
+        if request.query.get("format") == "text":
+            return web.Response(text=_quota_row_text(tenant, row) + "\n")
+        return web.json_response(body)
+
     def validate_execute(req: ExecuteRequest) -> web.Response | None:
         """Shared /v1/execute + /v1/execute/stream pre-flight checks."""
         if (req.source_code is None) == (req.source_file is None):
@@ -596,6 +701,44 @@ def create_http_app(
             with_trace_id({"error": str(e)}), status=429, headers=headers
         )
 
+    def quota_response(e: QuotaExceededError) -> web.Response:
+        """429 for quota denials — the same retryable family as every
+        capacity shed (client retry loops need no new branch), but typed:
+        the Retry-After is computed from the WINDOW's refill point (or the
+        quarantine sentence), and the X-Quota-* headers carry the reason
+        and the remaining budget so a pacing client can distinguish "slow
+        down" (chip_seconds/request_rate), "narrow down" (concurrency),
+        and "stop violating limits" (quarantined)."""
+        headers = {
+            "Retry-After": str(max(1, math.ceil(e.retry_after or 1.0))),
+            "X-Quota-Reason": e.reason,
+        }
+        body: dict = {
+            "error": str(e),
+            "quota": {"tenant": e.tenant, "reason": e.reason,
+                      "retry_after_s": round(e.retry_after, 3)},
+        }
+        if e.remaining_chip_seconds is not None:
+            headers["X-Quota-Remaining-Chip-Seconds"] = (
+                f"{e.remaining_chip_seconds:.6f}"
+            )
+            body["quota"]["remaining_chip_seconds"] = round(
+                e.remaining_chip_seconds, 6
+            )
+        if e.limit_chip_seconds is not None:
+            headers["X-Quota-Limit-Chip-Seconds"] = (
+                f"{e.limit_chip_seconds:.6f}"
+            )
+            body["quota"]["limit_chip_seconds"] = round(
+                e.limit_chip_seconds, 6
+            )
+        if e.window_seconds is not None:
+            headers["X-Quota-Window-Seconds"] = f"{e.window_seconds:.3f}"
+            body["quota"]["window_seconds"] = round(e.window_seconds, 3)
+        return web.json_response(
+            with_trace_id(body), status=429, headers=headers
+        )
+
     def add_session_fields(body: dict, result, executor_id: str | None) -> dict:
         """Session continuity, one rule for every surface: seq==1 on a
         request the client expected to land in an existing session means
@@ -645,6 +788,10 @@ def create_http_app(
             return shed(e)
         except LimitExceededError as e:
             return violation_response(e)
+        except QuotaExceededError as e:
+            # Quota denial (before SessionLimitError: it subclasses it) —
+            # 429 with the window-derived Retry-After and X-Quota-* headers.
+            return quota_response(e)
         except SessionLimitError as e:
             # Resource exhaustion, not a request defect: retryable.
             return capacity_response(e)
@@ -718,6 +865,15 @@ def create_http_app(
             await response.write(
                 (
                     json.dumps({"error": str(e), "violation": e.kind}) + "\n"
+                ).encode("utf-8")
+            )
+        except QuotaExceededError as e:
+            if not started:
+                return quota_response(e)
+            await response.write(
+                (
+                    json.dumps({"error": str(e), "quota_reason": e.reason})
+                    + "\n"
                 ).encode("utf-8")
             )
         except SessionLimitError as e:
@@ -798,6 +954,8 @@ def create_http_app(
             return shed(e)
         except LimitExceededError as e:
             return violation_response(e)
+        except QuotaExceededError as e:
+            return quota_response(e)
         except SessionLimitError as e:
             return capacity_response(e)
         except (ExecutorError, SandboxSpawnError) as e:

@@ -141,6 +141,13 @@ def sandbox_limit_env(config: Config) -> dict[str, str]:
     env = {"APP_MAX_OUTPUT_BYTES": str(int(config.sandbox_max_output_bytes))}
     if not config.sandbox_limits_enabled:
         return env
+    if not config.sandbox_cgroup_enforce:
+        # The executor auto-detects writable cgroup-v2 delegation and falls
+        # back cleanly on its own; this only forces the fallback (the
+        # operator wants rlimits+watchdog semantics even where hard caps
+        # would arm — e.g. comparing enforcement modes, or a runtime whose
+        # cgroup driver fights sibling scopes).
+        env["APP_CGROUP_ENFORCE"] = "0"
     caps = parse_limits(config.sandbox_limit_caps, source="sandbox_limit_caps")
     for key, (kind, env_name) in _LIMIT_KEYS.items():
         if env_name is None or key not in caps:

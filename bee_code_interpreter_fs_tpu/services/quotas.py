@@ -1,0 +1,973 @@
+"""Per-tenant quota enforcement and abuse control — the layer that READS
+the PR 9 usage ledger and acts on it at admission, before the scheduler
+ever enqueues a request.
+
+The metering plane (services/usage.py) made every tenant's consumption
+attributable; the scheduler (PR 2) made CONTENTION fair. Neither bounds
+what one tenant may consume in absolute terms: fair-share still lets a
+single tenant monopolize the fleet for as long as it keeps queueing, and
+a violation-storm tenant burns a sandbox (spawn, watchdog kill, dispose,
+refill) per rejected attempt. This module is the admission-control
+discipline beneath the scheduler — what "can be run as a service for
+millions of users" means once the metrics labels already have tenants in
+them:
+
+- **Sliding-window chip-second budgets** — a tenant's consumption over the
+  last ``window_seconds`` (computed from the ledger's monotonic
+  ``chip_seconds`` counter against a ring of timestamped samples) may not
+  exceed its budget. Over budget → denied at the door with a Retry-After
+  computed from the window's actual refill point (the moment enough old
+  consumption ages out), not a guess.
+- **Request-rate and concurrent-grant caps** — admitted requests per
+  window and in-flight requests, bounded per tenant before any queueing.
+- **Violation quotas with quarantine** — typed limit violations (PR 5's
+  oom/disk_quota/nproc/cpu_time/output_cap kinds, from the ledger's
+  violations-by-kind counters) over the window cross a threshold → the
+  tenant is QUARANTINED: shed at admission with a distinct reason, zero
+  sandboxes consumed per rejected attempt. Quarantine durations grow
+  exponentially per episode (base * 2^(n-1), capped) and the offender
+  level decays one step per clean decay-interval after release.
+- **Policy** — a default policy from config knobs plus per-tenant
+  overrides in an ``APP_QUOTA_POLICY_FILE`` JSON, hot-reloaded on mtime
+  change (a malformed rewrite keeps the last good policy — quota
+  enforcement must never fail open because an operator fat-fingered JSON).
+
+Restart semantics: windows restore from the ledger's own journal
+(``UsageLedger.iter_persisted``) — each journal line is a timestamped
+cumulative counter sample, so the ring rebuilds to within one flush
+interval of where a SIGKILL'd control plane left it. An offender cannot
+earn a fresh budget by crashing the service.
+
+Tenant identity: window state is keyed by the LEDGER's row label
+(``UsageLedger.peek`` — the same ``_overflow`` cap rule), so enforcement
+and billing can never disagree about where a tenant's consumption lives,
+and minting fresh tenant names past the cap lands every minted name on
+one shared ``_overflow`` budget — name-minting is a self-defeating
+evasion, and metric-label cardinality stays bounded by construction.
+
+``APP_QUOTAS_ENABLED=0`` is the kill switch: no admission checks, no
+``/quotas`` surface, no quota fields in ``Result.phases``, no ``quota_*``
+metric samples — pre-quota behavior byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import time
+from collections import deque
+from dataclasses import dataclass, field, replace
+
+from ..utils import tracing
+from .errors import QuotaExceededError
+
+logger = logging.getLogger(__name__)
+
+# Denial reasons, a closed set (they label quota_denials_total and ride the
+# wire as x-quota-reason): membership is contract for dashboards and tests.
+DENIAL_REASONS = (
+    "chip_seconds",
+    "request_rate",
+    "concurrency",
+    "quarantined",
+)
+
+# Policy keys a file override may set (mirrors the APP_QUOTA_* knobs).
+_POLICY_KEYS = (
+    "chip_seconds_per_window",
+    "window_seconds",
+    "requests_per_window",
+    "max_concurrent",
+    "violations_per_window",
+    "quarantine_base_seconds",
+    "quarantine_max_seconds",
+    "quarantine_decay_seconds",
+)
+
+# Window-sample ring bound per tenant: granularity self-adjusts (samples
+# closer together than window/_RING_MAX coalesce), so the ring covers the
+# whole window at bounded memory whatever the request rate.
+_RING_MAX = 128
+
+
+@dataclass(frozen=True)
+class QuotaPolicy:
+    """One tenant's effective policy. 0 = that cap is off (the config
+    defaults are all-zero, so an unconfigured deployment enforces
+    nothing and behaves exactly as before this subsystem)."""
+
+    chip_seconds_per_window: float = 0.0
+    window_seconds: float = 3600.0
+    requests_per_window: int = 0
+    max_concurrent: int = 0
+    violations_per_window: int = 0
+    quarantine_base_seconds: float = 30.0
+    quarantine_max_seconds: float = 3600.0
+    quarantine_decay_seconds: float = 300.0
+
+    def enforces_anything(self) -> bool:
+        return (
+            self.chip_seconds_per_window > 0
+            or self.requests_per_window > 0
+            or self.max_concurrent > 0
+            or self.violations_per_window > 0
+        )
+
+
+def _policy_from_mapping(
+    base: QuotaPolicy, raw: dict, *, source: str
+) -> QuotaPolicy:
+    """Layer a policy-file mapping over `base`. Raises ValueError on
+    malformed entries — the caller decides whether that fails boot (config
+    defaults) or keeps the last good policy (hot reload)."""
+    if not isinstance(raw, dict):
+        raise ValueError(f"{source} must be an object of policy values")
+    updates: dict[str, float | int] = {}
+    for key, value in raw.items():
+        if key not in _POLICY_KEYS:
+            raise ValueError(
+                f"unknown {source} key {key!r} (want one of "
+                f"{sorted(_POLICY_KEYS)})"
+            )
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise ValueError(f"{source}.{key} must be a number")
+        if not math.isfinite(value) or value < 0:
+            raise ValueError(f"{source}.{key} must be a finite number >= 0")
+        updates[key] = (
+            int(value)
+            if key in ("requests_per_window", "max_concurrent",
+                       "violations_per_window")
+            else float(value)
+        )
+    policy = replace(base, **updates)
+    if policy.window_seconds <= 0 and policy.enforces_anything():
+        raise ValueError(f"{source}.window_seconds must be > 0")
+    return policy
+
+
+@dataclass
+class QuotaVerdict:
+    """An ADMITTED request's quota context: what the executor needs to
+    release the concurrency slot at exit and to stamp the success-path
+    `quota` block into Result.phases (so well-behaved clients can pace
+    themselves instead of discovering the budget via 429)."""
+
+    tenant: str
+    remaining_chip_seconds: float | None = None
+    limit_chip_seconds: float | None = None
+    window_seconds: float | None = None
+    released: bool = False
+
+    def phases_block(self) -> dict | None:
+        """THE shape of the Result.phases `quota` block (the executor
+        refreshes `remaining_chip_seconds` post-run, then calls this —
+        one definition, so wire shape and admission shape cannot
+        drift)."""
+        if self.limit_chip_seconds is None:
+            return None
+        return {
+            "remaining_chip_seconds": round(
+                self.remaining_chip_seconds or 0.0, 6
+            ),
+            "limit_chip_seconds": round(self.limit_chip_seconds, 6),
+            "window_seconds": round(self.window_seconds or 0.0, 3),
+        }
+
+
+class _TenantWindow:
+    """One ledger row's sliding-window state: a bounded ring of
+    (ts, chip_seconds_cum, violations_cum) samples, admission timestamps
+    for the rate cap, the in-flight count, and the offender ladder."""
+
+    __slots__ = (
+        "samples",
+        "admits",
+        "in_flight",
+        "offender_level",
+        "quarantined_until",
+        "violation_floor",
+        "denials",
+        "quarantines",
+        "last_denial_log",
+    )
+
+    def __init__(self) -> None:
+        self.samples: deque[tuple[float, float, float]] = deque()
+        self.admits: deque[float] = deque()
+        self.in_flight = 0
+        # The exponential ladder: each quarantine episode raises the level
+        # (longer next sentence); clean time after release decays it.
+        self.offender_level = 0
+        self.quarantined_until = 0.0
+        # Violations already "spent" by a previous quarantine sentence:
+        # the window ring still holds them, but re-counting them at
+        # release would re-quarantine instantly and the sentence would
+        # degenerate to "locked out until the window drains".
+        self.violation_floor = 0.0
+        self.denials = 0
+        self.quarantines = 0
+        self.last_denial_log = 0.0
+
+    def observe(
+        self, now: float, chip_cum: float, violations_cum: float, window: float
+    ) -> None:
+        """Record a cumulative sample and prune the ring. The newest sample
+        at-or-before the window start is KEPT — it is the baseline
+        used_in_window subtracts from."""
+        granularity = max(window / _RING_MAX, 0.05)
+        if len(self.samples) >= 2 and now - self.samples[-1][0] < granularity:
+            # Never coalesce into the OLDEST sample: it is the window
+            # baseline, and folding newer consumption into it would zero
+            # the very usage the window exists to count.
+            # Coalesce: keep the OLDER timestamp with the NEWER cumulative
+            # value (conservative — consumption attributes as early as the
+            # ring can place it, so a burst can never dodge the window by
+            # landing between samples).
+            ts, _, _ = self.samples[-1]
+            self.samples[-1] = (ts, chip_cum, violations_cum)
+        else:
+            self.samples.append((now, chip_cum, violations_cum))
+        window_start = now - window
+        while (
+            len(self.samples) > 1 and self.samples[1][0] <= window_start
+        ) or len(self.samples) > _RING_MAX:
+            self.samples.popleft()
+
+    def _baseline(self, now: float, window: float) -> tuple[float, float]:
+        """Cumulative (chip, violations) at the window start: the newest
+        sample at-or-before it, else the oldest sample (the tenant's whole
+        recorded history is inside the window)."""
+        window_start = now - window
+        base = self.samples[0]
+        for sample in self.samples:
+            if sample[0] <= window_start:
+                base = sample
+            else:
+                break
+        return base[1], base[2]
+
+    def used_chip_seconds(self, now: float, window: float) -> float:
+        if not self.samples:
+            return 0.0
+        chip_base, _ = self._baseline(now, window)
+        return max(0.0, self.samples[-1][1] - chip_base)
+
+    def violations_in_window(self, now: float, window: float) -> float:
+        if not self.samples:
+            return 0.0
+        _, violation_base = self._baseline(now, window)
+        return max(
+            0.0,
+            self.samples[-1][2] - max(violation_base, self.violation_floor),
+        )
+
+    def budget_refill_at(
+        self, now: float, window: float, budget: float
+    ) -> float:
+        """The earliest time used_chip_seconds can drop to the budget: the
+        first sample whose age-out leaves consumption <= budget. The
+        Retry-After contract: a client that waits this long is not
+        structurally denied again for the same window contents."""
+        if not self.samples:
+            return now
+        chip_now = self.samples[-1][1]
+        for ts, chip_cum, _ in self.samples:
+            if chip_now - chip_cum <= budget:
+                return ts + window
+        # Even the newest sample's baseline leaves it over budget (one
+        # giant burst): the whole burst must age out.
+        return self.samples[-1][0] + window
+
+    def prune_admits(self, now: float, window: float) -> None:
+        while self.admits and self.admits[0] <= now - window:
+            self.admits.popleft()
+
+
+class QuotaEnforcer:
+    """Admission-side quota enforcement over the usage ledger.
+
+    Event-loop discipline like the scheduler and ledger: all state lives
+    on the control plane's single loop; the only IO is the (throttled)
+    policy-file stat/read and the one-time journal window restore at
+    construction. `admit()` either returns a QuotaVerdict (the caller MUST
+    `release()` it on request exit — the concurrency cap's other half) or
+    raises QuotaExceededError with the typed reason."""
+
+    def __init__(
+        self,
+        config=None,
+        *,
+        usage=None,
+        metrics=None,
+        walltime=time.time,
+    ) -> None:
+        from ..config import Config
+
+        self.config = config or Config()
+        self.usage = usage
+        self.metrics = metrics
+        self.walltime = walltime
+        self.enabled = bool(self.config.quotas_enabled) and (
+            usage is not None and usage.enabled
+        )
+        if bool(self.config.quotas_enabled) and not self.enabled:
+            # Quotas read exactly the ledger's counters; without metering
+            # there is nothing to enforce against. Loud, not silent: an
+            # operator who set budgets expects them to bite.
+            logger.warning(
+                "quota enforcement is inert: it reads the usage ledger and "
+                "APP_USAGE_METERING_ENABLED is 0 (or no ledger is wired)"
+            )
+        self.default_policy = QuotaPolicy(
+            chip_seconds_per_window=max(
+                0.0, float(self.config.quota_chip_seconds_per_window)
+            ),
+            window_seconds=max(1.0, float(self.config.quota_window_seconds)),
+            requests_per_window=max(
+                0, int(self.config.quota_requests_per_window)
+            ),
+            max_concurrent=max(0, int(self.config.quota_max_concurrent)),
+            violations_per_window=max(
+                0, int(self.config.quota_violations_per_window)
+            ),
+            quarantine_base_seconds=max(
+                1.0, float(self.config.quota_quarantine_base_seconds)
+            ),
+            quarantine_max_seconds=max(
+                1.0, float(self.config.quota_quarantine_max_seconds)
+            ),
+            quarantine_decay_seconds=max(
+                1.0, float(self.config.quota_quarantine_decay_seconds)
+            ),
+        )
+        # The IMMUTABLE config-derived baseline every policy-file load
+        # layers over. Layering over the previous load's result instead
+        # would make reloads non-idempotent: a key REMOVED from the file
+        # would keep its old value on long-running instances while
+        # restarted ones revert to config — one file, two fleet policies.
+        self._config_default_policy = self.default_policy
+        self._tenant_policies: dict[str, QuotaPolicy] = {}
+        self._windows: dict[str, _TenantWindow] = {}
+        # Policy-file hot reload state.
+        self._policy_path = self.config.quota_policy_file or ""
+        self._policy_mtime: float | None = None
+        self._policy_checked_at = 0.0
+        self.policy_loads = 0
+        self.policy_load_errors = 0
+        self.denials_total = 0
+        if not self.enabled:
+            return
+        self._load_policy_file(force=True)
+        if self.usage is not None:
+            self._restore_windows()
+            self._load_offenders()
+        # Restore precision is bounded by the ledger's journal-tail
+        # retention: a keep horizon shorter than the largest configured
+        # window means post-crash windows can under-count (tenant-
+        # favorably) — loud at boot, where the operator can still fix it.
+        keep = getattr(self.usage, "journal_keep_seconds", 0.0)
+        if 0 < keep < self._max_window():
+            logger.warning(
+                "usage_journal_keep_seconds (%gs) is shorter than the "
+                "largest quota window (%gs): quota windows restored after "
+                "a crash may under-count consumption older than the "
+                "retained journal tail",
+                keep,
+                self._max_window(),
+            )
+
+    # ---------------------------------------------------------------- policy
+
+    def _load_policy_file(self, *, force: bool = False) -> None:
+        """(Re)read APP_QUOTA_POLICY_FILE when its mtime moved, at most
+        every quota_policy_reload_seconds. A malformed or vanished file
+        keeps the LAST GOOD policy (fail closed, log loudly) — the quota
+        layer must not fail open mid-incident because a hot edit tore."""
+        if not self._policy_path:
+            return
+        now = self.walltime()
+        if (
+            not force
+            and now - self._policy_checked_at
+            < max(0.1, self.config.quota_policy_reload_seconds)
+        ):
+            return
+        self._policy_checked_at = now
+        try:
+            mtime = os.stat(self._policy_path).st_mtime
+        except OSError:
+            if self._policy_mtime is not None or force:
+                logger.warning(
+                    "quota policy file %s unreadable; keeping the last "
+                    "good policy",
+                    self._policy_path,
+                )
+            return
+        if mtime == self._policy_mtime and not force:
+            return
+        try:
+            with open(self._policy_path, encoding="utf-8") as f:
+                body = json.load(f)
+            if not isinstance(body, dict):
+                raise ValueError("policy file must be a JSON object")
+            # Every load layers over the CONFIG baseline, never over a
+            # previous load — reloads are idempotent in file content, so
+            # deleting a key from the file really reverts it.
+            default = self._config_default_policy
+            if "default" in body:
+                default = _policy_from_mapping(
+                    self._config_default_policy,
+                    body["default"],
+                    source="default",
+                )
+            tenants_raw = body.get("tenants", {})
+            if not isinstance(tenants_raw, dict):
+                raise ValueError("policy file 'tenants' must be an object")
+            tenant_policies = {
+                str(tenant): _policy_from_mapping(
+                    default, overrides, source=f"tenants[{tenant}]"
+                )
+                for tenant, overrides in tenants_raw.items()
+            }
+        except (ValueError, OSError) as e:
+            self.policy_load_errors += 1
+            logger.warning(
+                "quota policy file %s rejected (%s); keeping the last "
+                "good policy",
+                self._policy_path,
+                e,
+            )
+            return
+        self.default_policy = default
+        self._tenant_policies = tenant_policies
+        self._policy_mtime = mtime
+        self.policy_loads += 1
+        logger.info(
+            "quota policy loaded from %s (%d tenant override(s))",
+            self._policy_path,
+            len(tenant_policies),
+        )
+
+    def policy_for(self, tenant: str) -> QuotaPolicy:
+        return self._tenant_policies.get(tenant, self.default_policy)
+
+    def _effective_policy(self, tenant: str, label: str) -> QuotaPolicy:
+        """THE overflow-policy rule, in one place: a past-the-cap tenant
+        shares the overflow ROW, so it shares the overflow row's policy
+        view too — unless the operator whitelisted it BY NAME (an explicit
+        per-tenant override wins even past the cap). Used by admission,
+        the pacing read, and the surfaces, so they can never disagree."""
+        if label != tenant and tenant not in self._tenant_policies:
+            return self.policy_for(label)
+        return self.policy_for(tenant)
+
+    # --------------------------------------------------------------- restore
+
+    def _restore_windows(self) -> None:
+        """Rebuild each tenant's sample ring from the ledger's persisted
+        history — the quota layer's half of the durability story: budgets
+        survive a SIGKILL to within one flush interval, so an offender
+        cannot reset its window by crashing the control plane.
+
+        Baseline semantics per tenant: the ring's own prune keeps the
+        newest sample at-or-before the window start, so replaying EVERY
+        persisted sample in write order yields the exact pre-window
+        baseline. When the tenant's first persisted record is a journal
+        line with no snapshot row (a new tenant, never compacted), its
+        pre-line consumption is exactly ZERO — a synthetic zero baseline
+        makes even a single-line burst count in full. A snapshot row's
+        pre-history is genuinely gone (folded by compaction), so no
+        synthetic baseline is planted there: the error is bounded and
+        tenant-favorable (never over-denies)."""
+        now = self.walltime()
+        per_tenant: dict[str, list[tuple[float, dict]]] = {}
+        has_snapshot: set[str] = set()
+        for ts, tenant, counters, source in self.usage.iter_persisted():
+            if not isinstance(counters.get("chip_seconds"), (int, float)):
+                continue
+            if source == "snapshot":
+                has_snapshot.add(tenant)
+            per_tenant.setdefault(tenant, []).append((min(ts, now), counters))
+        restored = 0
+        for tenant, samples in per_tenant.items():
+            # Write order is NOT time order: compaction retains a journal
+            # tail OLDER than the snapshot's own ts — the ring needs
+            # monotonic timestamps.
+            samples.sort(key=lambda s: s[0])
+            window = self.policy_for(tenant).window_seconds
+            win = self._window(tenant)
+            if tenant not in has_snapshot:
+                win.observe(samples[0][0] - 1e-3, 0.0, 0.0, window)
+            for ts, counters in samples:
+                violations = counters.get("violations")
+                violations_total = (
+                    sum(
+                        float(v)
+                        for v in violations.values()
+                        if isinstance(v, (int, float))
+                    )
+                    if isinstance(violations, dict)
+                    else 0.0
+                )
+                win.observe(
+                    ts, float(counters["chip_seconds"]), violations_total,
+                    window,
+                )
+                restored += 1
+        if restored:
+            logger.info(
+                "quota windows restored from the usage journal "
+                "(%d sample(s), %d tenant(s))",
+                restored,
+                len(self._windows),
+            )
+
+    @property
+    def _offender_state_path(self) -> str | None:
+        """The quarantine ladder's tiny durable sidecar, beside the usage
+        journal (same lifecycle, same kill switch). The sample rings
+        restore from the journal itself; the ladder (offender level,
+        standing sentence, spent-violation floor) is enforcer-local state
+        the ledger never holds — without this file, a crash would
+        TRUNCATE a standing sentence to a fresh base one, making "crash
+        the control plane" a quarantine exploit."""
+        journal = self.usage.journal_path if self.usage is not None else None
+        if journal is None:
+            return None
+        return os.path.join(os.path.dirname(journal), "quota_state.json")
+
+    def _save_offenders(self) -> None:
+        """Persist the non-trivial ladder rows (atomic tmp+rename). Called
+        on quarantine transitions and decay writes — rare events by
+        construction, so this is never on a healthy request's path. A
+        write failure degrades durability, not serving."""
+        path = self._offender_state_path
+        if path is None:
+            return
+        rows = {
+            label: {
+                "offender_level": win.offender_level,
+                "quarantined_until": round(win.quarantined_until, 3),
+                "violation_floor": round(win.violation_floor, 6),
+            }
+            for label, win in self._windows.items()
+            if win.offender_level > 0 or win.violation_floor > 0
+        }
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"version": 1, "tenants": rows}, f, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            logger.warning("quota offender state not persisted", exc_info=True)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _load_offenders(self) -> None:
+        path = self._offender_state_path
+        if path is None:
+            return
+        try:
+            with open(path, encoding="utf-8") as f:
+                body = json.load(f)
+        except FileNotFoundError:
+            return
+        except (json.JSONDecodeError, OSError):
+            logger.warning("quota offender state unreadable", exc_info=True)
+            return
+        tenants = body.get("tenants", {})
+        if not isinstance(tenants, dict):
+            return
+        restored = 0
+        for label, row in tenants.items():
+            if not isinstance(row, dict):
+                continue
+            win = self._window(str(label))
+            level = row.get("offender_level")
+            until = row.get("quarantined_until")
+            floor = row.get("violation_floor")
+            if isinstance(level, int) and level >= 0:
+                win.offender_level = level
+            if isinstance(until, (int, float)):
+                win.quarantined_until = float(until)
+            if isinstance(floor, (int, float)):
+                win.violation_floor = float(floor)
+            restored += 1
+        if restored:
+            logger.info(
+                "quota offender ladder restored (%d tenant(s))", restored
+            )
+
+    def _max_window(self) -> float:
+        windows = [self.default_policy.window_seconds]
+        windows += [p.window_seconds for p in self._tenant_policies.values()]
+        return max(windows)
+
+    # -------------------------------------------------------------- admission
+
+    def _window(self, label: str) -> _TenantWindow:
+        win = self._windows.get(label)
+        if win is None:
+            win = _TenantWindow()
+            self._windows[label] = win
+        return win
+
+    def _observe(
+        self, label: str, win: _TenantWindow, now: float, window: float
+    ) -> None:
+        """Sample the ledger row's cumulative counters into the ring."""
+        _, row = self.usage.peek(label)
+        chip = row.chip_seconds if row is not None else 0.0
+        violations = (
+            sum(row.violations.values()) if row is not None else 0.0
+        )
+        win.observe(now, chip, violations, window)
+
+    def _deny(
+        self,
+        label: str,
+        policy: QuotaPolicy,
+        win: _TenantWindow,
+        *,
+        reason: str,
+        retry_after: float,
+        detail: str,
+        remaining: float | None = None,
+    ) -> QuotaExceededError:
+        win.denials += 1
+        self.denials_total += 1
+        if self.metrics is not None:
+            denials = getattr(self.metrics, "quota_denials", None)
+            if denials is not None:
+                denials.inc(tenant=label, reason=reason)
+        tracing.add_event(
+            "quota.denied",
+            tenant=label,
+            reason=reason,
+            retry_after_s=round(max(0.0, retry_after), 3),
+        )
+        # Rate-limited logging: a denied tenant hammering the door is the
+        # EXPECTED load pattern this layer absorbs — one warning per
+        # tenant per 10s names the incident; the counter and trace events
+        # carry the full rate.
+        now = self.walltime()
+        if now - win.last_denial_log >= 10.0:
+            win.last_denial_log = now
+            logger.warning(
+                "quota denial (tenant=%s reason=%s retry_after=%.1fs, "
+                "%d total): %s",
+                label,
+                reason,
+                retry_after,
+                win.denials,
+                detail,
+            )
+        budget = (
+            policy.chip_seconds_per_window
+            if policy.chip_seconds_per_window > 0
+            else None
+        )
+        return QuotaExceededError(
+            f"tenant {label!r} {detail}; retry in {max(0.0, retry_after):.0f}s",
+            tenant=label,
+            reason=reason,
+            retry_after=max(0.0, retry_after),
+            remaining_chip_seconds=remaining,
+            limit_chip_seconds=budget,
+            window_seconds=policy.window_seconds,
+        )
+
+    def admit(self, tenant: str | None) -> QuotaVerdict | None:
+        """The admission gate, called BEFORE any scheduler/batcher/session
+        machinery sees the request. Returns a verdict the caller must
+        `release()` on exit, or None when the layer is off / the request
+        is unmetered (trusted control-plane runs). Raises
+        QuotaExceededError with the typed reason on denial — the request
+        is never enqueued."""
+        if not self.enabled or tenant is None:
+            return None
+        self._load_policy_file()
+        now = self.walltime()
+        label, _ = self.usage.peek(tenant)
+        policy = self._effective_policy(tenant, label)
+        win = self._window(label)
+        if not policy.enforces_anything():
+            win.in_flight += 1
+            return QuotaVerdict(tenant=label)
+        window = policy.window_seconds
+        self._observe(label, win, now, window)
+
+        # 1) Quarantine: the standing sentence, checked first — a
+        # quarantined tenant's requests never reach any other math.
+        if now < win.quarantined_until:
+            raise self._deny(
+                label,
+                policy,
+                win,
+                reason="quarantined",
+                retry_after=win.quarantined_until - now,
+                detail=(
+                    "is quarantined for repeated limit violations "
+                    f"(offender level {win.offender_level})"
+                ),
+            )
+        # Lazy decay: each clean decay-interval since release steps the
+        # offender ladder back down (a reformed tenant's next storm earns
+        # the base sentence again, not the escalated one).
+        if win.offender_level > 0 and win.quarantined_until > 0:
+            decayed = int(
+                (now - win.quarantined_until)
+                / policy.quarantine_decay_seconds
+            )
+            if decayed > 0:
+                win.offender_level = max(0, win.offender_level - decayed)
+                win.quarantined_until = (
+                    now  # re-anchor so further decay needs further clean time
+                    if win.offender_level > 0
+                    else 0.0
+                )
+                # The violation floor deliberately survives full decay:
+                # it marks violations a sentence already answered, and
+                # those may still sit inside the window — resetting it
+                # here would re-quarantine a reformed tenant for old,
+                # already-punished violations.
+                self._save_offenders()
+
+        # 2) Violation quota: a fresh storm (violations in window past the
+        # floor a previous sentence already spent) earns a new sentence.
+        if policy.violations_per_window > 0:
+            violations = win.violations_in_window(now, window)
+            if violations >= policy.violations_per_window:
+                win.offender_level += 1
+                sentence = min(
+                    policy.quarantine_base_seconds
+                    * (2.0 ** (win.offender_level - 1)),
+                    policy.quarantine_max_seconds,
+                )
+                win.quarantined_until = now + sentence
+                # Spend the window's current violations: the sentence
+                # answers THIS storm; only fresh violations after release
+                # can earn the next one.
+                win.violation_floor = (
+                    win.samples[-1][2] if win.samples else 0.0
+                )
+                win.quarantines += 1
+                # Durable: a standing sentence (and the escalation ladder)
+                # must survive a control-plane crash — quarantine is the
+                # abuse response, and "crash the service" must not be the
+                # escape hatch.
+                self._save_offenders()
+                raise self._deny(
+                    label,
+                    policy,
+                    win,
+                    reason="quarantined",
+                    retry_after=sentence,
+                    detail=(
+                        f"quarantined: {violations:.0f} limit violations "
+                        f"in the last {window:.0f}s (threshold "
+                        f"{policy.violations_per_window}, sentence "
+                        f"{sentence:.0f}s, episode {win.offender_level})"
+                    ),
+                )
+
+        # 3) Chip-second budget over the sliding window.
+        remaining: float | None = None
+        if policy.chip_seconds_per_window > 0:
+            used = win.used_chip_seconds(now, window)
+            remaining = max(0.0, policy.chip_seconds_per_window - used)
+            if used >= policy.chip_seconds_per_window:
+                refill_at = win.budget_refill_at(
+                    now, window, policy.chip_seconds_per_window
+                )
+                raise self._deny(
+                    label,
+                    policy,
+                    win,
+                    reason="chip_seconds",
+                    retry_after=max(1.0, refill_at - now),
+                    detail=(
+                        f"exhausted its chip-second budget "
+                        f"({used:.3f}s used of "
+                        f"{policy.chip_seconds_per_window:.3f}s per "
+                        f"{window:.0f}s window)"
+                    ),
+                    remaining=0.0,
+                )
+
+        # 4) Request rate over the window.
+        if policy.requests_per_window > 0:
+            win.prune_admits(now, window)
+            if len(win.admits) >= policy.requests_per_window:
+                raise self._deny(
+                    label,
+                    policy,
+                    win,
+                    reason="request_rate",
+                    retry_after=max(1.0, win.admits[0] + window - now),
+                    detail=(
+                        f"exceeded its request-rate cap "
+                        f"({policy.requests_per_window} per "
+                        f"{window:.0f}s window)"
+                    ),
+                    remaining=remaining,
+                )
+
+        # 5) Concurrency.
+        if (
+            policy.max_concurrent > 0
+            and win.in_flight >= policy.max_concurrent
+        ):
+            raise self._deny(
+                label,
+                policy,
+                win,
+                reason="concurrency",
+                retry_after=1.0,
+                detail=(
+                    f"has {win.in_flight} requests in flight "
+                    f"(cap {policy.max_concurrent})"
+                ),
+                remaining=remaining,
+            )
+
+        if policy.requests_per_window > 0:
+            win.admits.append(now)
+        win.in_flight += 1
+        if policy.chip_seconds_per_window > 0:
+            return QuotaVerdict(
+                tenant=label,
+                remaining_chip_seconds=remaining,
+                limit_chip_seconds=policy.chip_seconds_per_window,
+                window_seconds=window,
+            )
+        return QuotaVerdict(tenant=label)
+
+    def release(self, verdict: QuotaVerdict | None) -> None:
+        """Give the concurrency slot back (idempotent — every exit path of
+        the executor calls this exactly like usage.commit)."""
+        if verdict is None or verdict.released:
+            return
+        verdict.released = True
+        win = self._windows.get(verdict.tenant)
+        if win is not None and win.in_flight > 0:
+            win.in_flight -= 1
+
+    def refresh_verdict(self, verdict: QuotaVerdict | None) -> None:
+        """Post-run pacing refresh (the success-path satellite): recompute
+        the verdict's remaining budget against its own ADMIT-TIME
+        limit/window, now that this run's bill is in the ledger. The
+        verdict's budget, not the label's current policy: a tenant
+        whitelisted by name past the cardinality cap is admitted under its
+        named override while its consumption accrues to the shared
+        `_overflow` row — re-resolving by label would pace it against the
+        overflow policy and report a full budget as exhausted."""
+        if (
+            not self.enabled
+            or verdict is None
+            or verdict.limit_chip_seconds is None
+            or verdict.window_seconds is None
+        ):
+            return
+        now = self.walltime()
+        win = self._window(verdict.tenant)
+        self._observe(verdict.tenant, win, now, verdict.window_seconds)
+        used = win.used_chip_seconds(now, verdict.window_seconds)
+        verdict.remaining_chip_seconds = max(
+            0.0, verdict.limit_chip_seconds - used
+        )
+
+    # --------------------------------------------------------------- surfaces
+
+    def _policy_dict(self, policy: QuotaPolicy) -> dict:
+        return {
+            "chip_seconds_per_window": policy.chip_seconds_per_window,
+            "window_seconds": policy.window_seconds,
+            "requests_per_window": policy.requests_per_window,
+            "max_concurrent": policy.max_concurrent,
+            "violations_per_window": policy.violations_per_window,
+            "quarantine_base_seconds": policy.quarantine_base_seconds,
+            "quarantine_max_seconds": policy.quarantine_max_seconds,
+            "quarantine_decay_seconds": policy.quarantine_decay_seconds,
+        }
+
+    def tenant_snapshot(self, tenant: str) -> dict | None:
+        """One tenant's quota view (GET /quotas/{tenant}); None when the
+        layer has never seen it."""
+        if not self.enabled:
+            return None
+        label, _ = self.usage.peek(tenant)
+        win = self._windows.get(label)
+        if win is None:
+            return None
+        return self._tenant_body(tenant, label, win)
+
+    def _tenant_body(
+        self, tenant: str, label: str, win: _TenantWindow
+    ) -> dict:
+        policy = self._effective_policy(tenant, label)
+        now = self.walltime()
+        window = policy.window_seconds
+        used = win.used_chip_seconds(now, window)
+        win.prune_admits(now, window)
+        body: dict = {
+            "policy": self._policy_dict(policy),
+            "used_chip_seconds_window": round(used, 6),
+            "violations_in_window": round(
+                win.violations_in_window(now, window), 6
+            ),
+            "requests_in_window": len(win.admits),
+            "in_flight": win.in_flight,
+            "offender_level": win.offender_level,
+            "quarantined_for_s": round(
+                max(0.0, win.quarantined_until - now), 3
+            ),
+            "denials": win.denials,
+            "quarantines": win.quarantines,
+        }
+        if policy.chip_seconds_per_window > 0:
+            body["remaining_chip_seconds"] = round(
+                max(0.0, policy.chip_seconds_per_window - used), 6
+            )
+        return body
+
+    def snapshot(self) -> dict:
+        """The GET /quotas body (and the /statusz quotas section)."""
+        if not self.enabled:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            "default_policy": self._policy_dict(self.default_policy),
+            "tenant_overrides": sorted(self._tenant_policies),
+            "policy_file": self._policy_path or None,
+            "policy_loads": self.policy_loads,
+            "policy_load_errors": self.policy_load_errors,
+            "denials_total": self.denials_total,
+            "tenants": {
+                label: self._tenant_body(label, label, win)
+                for label, win in sorted(self._windows.items())
+            },
+        }
+
+    def remaining_gauge_samples(self) -> dict[tuple[str, ...], float]:
+        """Scrape-time feed for the per-tenant remaining-budget gauge.
+        Only tenants WITH a chip-second budget emit a sample; labels are
+        the ledger's capped row names, so cardinality is bounded by the
+        same `_overflow` discipline as every tenant-labeled family."""
+        if not self.enabled:
+            return {}
+        now = self.walltime()
+        out: dict[tuple[str, ...], float] = {}
+        for label, win in self._windows.items():
+            policy = self.policy_for(label)
+            if policy.chip_seconds_per_window <= 0:
+                continue
+            used = win.used_chip_seconds(now, policy.window_seconds)
+            out[(label,)] = max(
+                0.0, policy.chip_seconds_per_window - used
+            )
+        return out

@@ -2,7 +2,8 @@
 
 Before this module, tenants existed only as labels on rate counters — no
 answer to "what did tenant X consume this month" survived a restart, and
-the upcoming quota/abuse-control layer had nothing to enforce against.
+the quota/abuse-control layer (services/quotas.py, which reads exactly
+these counters at admission) had nothing to enforce against.
 This is the billing-grade half of the ROADMAP's production-multi-tenancy
 item: every request's consumption is attributed to its tenant and folded
 into monotonic counters that persist across control-plane restarts.
@@ -165,6 +166,9 @@ class UsageLedger:
         self.max_tenants = max(1, self.config.usage_max_tenants)
         self.flush_interval = max(0.1, self.config.usage_flush_interval)
         self.journal_max_bytes = max(4096, self.config.usage_journal_max_bytes)
+        self.journal_keep_seconds = max(
+            0.0, self.config.usage_journal_keep_seconds
+        )
         self._tenants: dict[str, TenantUsage] = {}
         self._dirty: set[str] = set()
         self._task: asyncio.Task | None = None
@@ -227,6 +231,74 @@ class UsageLedger:
             row = TenantUsage()
             self._tenants[tenant] = row
         return row
+
+    def peek(self, tenant: str) -> tuple[str, TenantUsage | None]:
+        """Non-mutating `_resolve`: the row label `tenant`'s usage WOULD
+        land on (the same cap rule — a new tenant past the bound reads the
+        `_overflow` row) and the current row, or None when the tenant has
+        never been billed. The quota layer keys its window state by this
+        label so enforcement and billing can never disagree about which
+        row a tenant's consumption lives in — past the cap, minted tenant
+        names all share `_overflow`'s budget, which makes name-minting a
+        self-defeating evasion."""
+        row = self._tenants.get(tenant)
+        if row is not None:
+            return tenant, row
+        if (
+            tenant != OVERFLOW_TENANT
+            and len(self._tenants) >= self.max_tenants
+        ):
+            return OVERFLOW_TENANT, self._tenants.get(OVERFLOW_TENANT)
+        return tenant, None
+
+    def iter_persisted(self):
+        """Yield ``(ts, tenant, counters, source)`` time-points from the
+        snapshot (source="snapshot") and then the journal
+        (source="journal"), in write order — the quota layer's window
+        restore: each journal line is a timestamped CUMULATIVE counter
+        sample, so replaying them rebuilds a sliding window's baseline to
+        within one flush interval of where a SIGKILL'd process left it (an
+        offender cannot earn a fresh budget by crashing the control
+        plane). The source tag lets the consumer tell "this tenant's first
+        persisted record ever" (journal line, no snapshot row — its
+        pre-sample consumption is exactly zero) from "totals folded by a
+        compaction" (snapshot row — pre-snapshot history is gone).
+        Unreadable files and torn lines are skipped exactly like
+        `_load`."""
+        if self._dir is None:
+            return
+        try:
+            with open(self.snapshot_path, encoding="utf-8") as f:
+                body = json.load(f)
+            ts = body.get("ts")
+            tenants = body.get("tenants", {})
+            if isinstance(ts, (int, float)) and isinstance(tenants, dict):
+                for tenant, counters in tenants.items():
+                    if isinstance(counters, dict):
+                        yield float(ts), str(tenant), counters, "snapshot"
+        except (FileNotFoundError, json.JSONDecodeError, OSError):
+            pass
+        try:
+            with open(self.journal_path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        entry = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    ts = entry.get("ts")
+                    tenant = entry.get("tenant")
+                    counters = entry.get("usage")
+                    if (
+                        isinstance(ts, (int, float))
+                        and isinstance(tenant, str)
+                        and isinstance(counters, dict)
+                    ):
+                        yield float(ts), tenant, counters, "journal"
+        except (FileNotFoundError, OSError):
+            pass
 
     def add(
         self,
@@ -497,12 +569,46 @@ class UsageLedger:
             if future.done():
                 self._write_future = None
 
+    def _recent_journal_tail(self) -> list[str]:
+        """The journal lines compaction RETAINS: newer than
+        journal_keep_seconds, bounded to half the journal size cap (oldest
+        dropped first). These are stale cumulative values the max-merge
+        replays as no-ops — kept purely as the TIMELINE the quota layer's
+        sliding windows restore from after a crash. Unparseable lines are
+        dropped (the snapshot already holds their totals)."""
+        if self.journal_keep_seconds <= 0:
+            return []
+        cutoff = self.walltime() - self.journal_keep_seconds
+        kept: list[str] = []
+        kept_bytes = 0
+        try:
+            with open(self.journal_path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ts = json.loads(line).get("ts")
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(ts, (int, float)) and ts >= cutoff:
+                        kept.append(line)
+                        kept_bytes += len(line) + 1
+        except OSError:
+            return []
+        bound = self.journal_max_bytes // 2
+        while kept and kept_bytes > bound:
+            kept_bytes -= len(kept.pop(0)) + 1
+        return kept
+
     def _compact(self, snapshot_body: dict) -> None:
         """Fold the passed table snapshot into the snapshot file (atomic
-        tmp+rename) and truncate the journal. A crash between the two
-        replays the stale journal over the fresh snapshot — idempotent by
-        the max-merge. The tmp file is removed on failure so a dead
-        partial write can't linger."""
+        tmp+rename) and rewrite the journal down to its recent tail (the
+        timeline quota windows restore from; empty with retention off). A
+        crash between the two replays the stale journal over the fresh
+        snapshot — idempotent by the max-merge. The tmp file is removed on
+        failure so a dead partial write can't linger."""
+        tail = self._recent_journal_tail()
         tmp = self.snapshot_path + ".tmp"
         try:
             with open(tmp, "w", encoding="utf-8") as f:
@@ -516,9 +622,26 @@ class UsageLedger:
             except OSError:
                 pass
             raise
-        with open(self.journal_path, "w", encoding="utf-8") as f:
-            f.flush()
-            os.fsync(f.fileno())
+        # The journal rewrite is atomic too (tmp+rename): a SIGKILL landing
+        # mid-compaction must leave either the OLD journal (stale lines the
+        # max-merge replays as no-ops, timeline intact) or the NEW tail —
+        # never a truncated-but-unwritten journal, which would erase the
+        # window timeline the quota layer restores from exactly when the
+        # crash-resistance property is being exercised.
+        jtmp = self.journal_path + ".tmp"
+        try:
+            with open(jtmp, "w", encoding="utf-8") as f:
+                if tail:
+                    f.write("\n".join(tail) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(jtmp, self.journal_path)
+        except OSError:
+            try:
+                os.unlink(jtmp)
+            except OSError:
+                pass
+            raise
         self.compactions += 1
 
     # -------------------------------------------------------------- flush loop

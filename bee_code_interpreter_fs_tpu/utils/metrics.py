@@ -541,8 +541,23 @@ class ExecutorMetrics:
         self.tenant_usage_violations = self.registry.counter(
             "code_interpreter_tenant_usage_violations_total",
             "Per-tenant typed limit violations by kind — the abuse-control "
-            "feed the quota/shedding layer will read.",
+            "feed services/quotas.py reads for its violation quotas and "
+            "repeat-offender quarantine.",
             ("tenant", "kind"),
+        )
+        # Quota enforcement (services/quotas.py): denials at the admission
+        # door, by tenant and typed reason (chip_seconds / request_rate /
+        # concurrency / quarantined). Tenant labels are the usage ledger's
+        # own capped row names (`_overflow` past APP_USAGE_MAX_TENANTS) —
+        # enforcement keys off the same rows it bills against, so metric
+        # cardinality can never outgrow the ledger table.
+        self.quota_denials = self.registry.counter(
+            "code_interpreter_quota_denials_total",
+            "Requests denied at admission by the quota layer, by tenant "
+            "and reason (chip_seconds = sliding-window budget exhausted, "
+            "request_rate / concurrency = caps, quarantined = repeat "
+            "limit-violation offender shed at the door).",
+            ("tenant", "reason"),
         )
         self.tenant_usage_recompiles = self.registry.counter(
             "code_interpreter_tenant_usage_compile_recompiles_total",
@@ -562,6 +577,24 @@ class ExecutorMetrics:
         self.batch_occupancy: Gauge | None = None
         self.device_health_state: Gauge | None = None
         self.device_probe_last_poll_age: Gauge | None = None
+        self.quota_remaining: Gauge | None = None
+
+    def bind_quotas(self, enforcer) -> None:
+        """Per-tenant remaining chip-second budget, computed at scrape time
+        from the enforcer's sliding windows. Registered only when the quota
+        layer is live (the kill switch leaves /metrics without the family —
+        pre-quota exposition byte-for-byte). Only tenants with a configured
+        budget emit samples; labels share the ledger's `_overflow` cap."""
+        if not getattr(enforcer, "enabled", False):
+            return
+        self.quota_remaining = self.registry.gauge(
+            "code_interpreter_quota_remaining_chip_seconds",
+            "Per-tenant chip-seconds left in the current sliding quota "
+            "window (only tenants with a configured budget; 0 = denied "
+            "until the window refills).",
+            ("tenant",),
+            callback=enforcer.remaining_gauge_samples,
+        )
 
     def record_tenant_usage(
         self,

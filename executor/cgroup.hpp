@@ -1,0 +1,320 @@
+// cgroup-v2 HARD enforcement for sandbox executions: memory.max / pids.max
+// boxes around the warm-runner group and every cold subprocess, layered
+// UNDER the existing rlimits + sampling watchdog (limits.hpp).
+//
+// Why a third layer: the rlimit window and the watchdog are cooperative-ish
+// — rlimits can be dodged (native allocations, children raising their own
+// soft limits) and the watchdog SAMPLES (default 100ms): an allocation
+// burst faster than one tick, or a fork storm quicker than a /proc walk,
+// can take the pod down before either fires. A cgroup's memory.max and
+// pids.max are enforced by the KERNEL at the allocation/fork site — the
+// in-pod limits story the quota layer (services/quotas.py) promises
+// tenants actually holds even against watchdog-dodging workloads.
+//
+// Layering contract (deliberate): cgroup bounds carry HEADROOM above the
+// watchdog's thresholds, so in the common case the watchdog still fires
+// first with its clean typed report and baseline subtraction; the cgroup
+// only acts when user code outruns it — and the post-run event counters
+// (memory.events oom_kill, pids.events max) reclassify that generic death
+// as the typed oom/nproc violation it actually was.
+//
+// Detection and fallback: enforcement arms only when the cgroup-v2
+// hierarchy this process lives in is WRITABLE and delegates the memory and
+// pids controllers (pods with a delegated cgroup namespace, root dev
+// hosts). Anything else — v1/hybrid hosts, read-only cgroupfs, missing
+// controllers, APP_CGROUP_ENFORCE=0 — degrades cleanly to today's
+// rlimits+watchdog behavior, with the verdict (and the reason) surfaced on
+// /healthz so the control plane and tests can see which mode a sandbox
+// actually runs in.
+
+#ifndef EXECUTOR_CGROUP_HPP_
+#define EXECUTOR_CGROUP_HPP_
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+namespace cgroup {
+
+// One-shot whole-file write ("max", a limit, or a pid). False on any error.
+inline bool write_file(const std::string& path, const std::string& data) {
+  int fd = open(path.c_str(), O_WRONLY | O_TRUNC | O_CLOEXEC);
+  if (fd < 0) return false;
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = write(fd, data.data() + off, data.size() - off);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      close(fd);
+      return false;
+    }
+    off += static_cast<size_t>(n);
+  }
+  close(fd);
+  return true;
+}
+
+inline std::string read_file(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (!f) return "";
+  std::string out;
+  char buf[512];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof(buf), f)) > 0) out.append(buf, n);
+  fclose(f);
+  return out;
+}
+
+// "<key> <value>" line from an events file (memory.events / pids.events);
+// 0 when absent/unreadable — deltas then simply never classify.
+inline long long read_event(const std::string& path, const char* key) {
+  std::string body = read_file(path);
+  size_t pos = 0;
+  size_t keylen = strlen(key);
+  while (pos < body.size()) {
+    size_t eol = body.find('\n', pos);
+    if (eol == std::string::npos) eol = body.size();
+    if (body.compare(pos, keylen, key) == 0 && pos + keylen < eol &&
+        body[pos + keylen] == ' ') {
+      return atoll(body.c_str() + pos + keylen + 1);
+    }
+    pos = eol + 1;
+  }
+  return 0;
+}
+
+// The cgroup-v2 path THIS process lives in ("0::<path>" in /proc/self/cgroup),
+// or "" on pure-v1 hosts. The base for delegation detection: in a pod (or a
+// systemd-delegated scope) this is exactly the subtree the runtime handed us.
+inline std::string self_v2_path() {
+  std::string body = read_file("/proc/self/cgroup");
+  size_t pos = 0;
+  while (pos < body.size()) {
+    size_t eol = body.find('\n', pos);
+    if (eol == std::string::npos) eol = body.size();
+    if (body.compare(pos, 3, "0::") == 0) {
+      return body.substr(pos + 3, eol - pos - 3);
+    }
+    pos = eol + 1;
+  }
+  return "";
+}
+
+// Where the cgroup-v2 hierarchy is mounted: /sys/fs/cgroup on unified
+// hosts, but hybrid hosts park it elsewhere (commonly
+// /sys/fs/cgroup/unified) — the fstype in /proc/self/mounts is the truth.
+inline std::string v2_mount_point() {
+  std::string body = read_file("/proc/self/mounts");
+  size_t pos = 0;
+  while (pos < body.size()) {
+    size_t eol = body.find('\n', pos);
+    if (eol == std::string::npos) eol = body.size();
+    std::string line = body.substr(pos, eol - pos);
+    // "<dev> <mountpoint> <fstype> <opts> ..."
+    size_t a = line.find(' ');
+    size_t b = line.find(' ', a + 1);
+    size_t c = line.find(' ', b + 1);
+    if (a != std::string::npos && b != std::string::npos &&
+        c != std::string::npos &&
+        line.compare(b + 1, c - b - 1, "cgroup2") == 0) {
+      return line.substr(a + 1, b - a - 1);
+    }
+    pos = eol + 1;
+  }
+  return "";
+}
+
+// Boot-time verdict: where per-run cgroups may be created, or why not.
+struct Runtime {
+  bool enabled = false;
+  std::string base;    // the delegated dir new scopes are created under
+  std::string reason;  // human-readable fallback reason when !enabled
+};
+
+// Detect + prepare the delegated subtree. Steps (any failure -> clean
+// fallback with the step as the reason):
+//  1. resolve the v2 dir this process lives in (APP_CGROUP_ROOT overrides —
+//     for hosts where the operator delegated a different subtree);
+//  2. require the memory and pids controllers in cgroup.controllers;
+//  3. create a <base>/host leaf and move OUR process into it — cgroup v2's
+//     no-internal-process rule forbids enabling controllers for children
+//     while the parent still has member processes (in a pod the server is
+//     the only one; on a shared host others remain and step 4 fails EBUSY,
+//     which is the correct verdict: that subtree is not ours to partition);
+//  4. enable "+memory +pids" in <base>/cgroup.subtree_control;
+//  5. probe-create a scope and write memory.max/pids.max to prove the
+//     delegation actually extends to the limit knobs.
+inline Runtime init(bool enforce_enabled) {
+  Runtime rt;
+  if (!enforce_enabled) {
+    rt.reason = "disabled by APP_CGROUP_ENFORCE=0";
+    return rt;
+  }
+  const char* root_env = getenv("APP_CGROUP_ROOT");
+  std::string base;
+  if (root_env && *root_env) {
+    base = root_env;
+  } else {
+    std::string path = self_v2_path();
+    std::string mount = v2_mount_point();
+    if (path.empty() || mount.empty()) {
+      rt.reason = "no cgroup-v2 hierarchy (pure-v1 host)";
+      return rt;
+    }
+    base = mount;
+    if (path != "/") base += path;
+  }
+  while (base.size() > 1 && base.back() == '/') base.pop_back();
+  std::string controllers = read_file(base + "/cgroup.controllers");
+  if (controllers.empty()) {
+    rt.reason = "no cgroup.controllers at " + base;
+    return rt;
+  }
+  auto has = [&controllers](const char* name) {
+    size_t pos = controllers.find(name);
+    // token match: bounded by space/newline/start/end
+    while (pos != std::string::npos) {
+      size_t end = pos + strlen(name);
+      bool left = pos == 0 || controllers[pos - 1] == ' ';
+      bool right = end >= controllers.size() || controllers[end] == ' ' ||
+                   controllers[end] == '\n';
+      if (left && right) return true;
+      pos = controllers.find(name, pos + 1);
+    }
+    return false;
+  };
+  if (!has("memory") || !has("pids")) {
+    rt.reason = "memory/pids controllers not delegated at " + base;
+    return rt;
+  }
+  std::string host = base + "/host";
+  if (mkdir(host.c_str(), 0755) != 0 && errno != EEXIST) {
+    rt.reason = "cgroupfs not writable at " + base;
+    return rt;
+  }
+  char self_pid[32];
+  snprintf(self_pid, sizeof(self_pid), "%d", getpid());
+  if (!write_file(host + "/cgroup.procs", self_pid)) {
+    rt.reason = "cannot move self into a leaf cgroup under " + base;
+    return rt;
+  }
+  if (!write_file(base + "/cgroup.subtree_control", "+memory +pids")) {
+    // Typically EBUSY: other processes share the subtree — it is not ours
+    // to partition (shared dev host). The fallback is the correct answer.
+    rt.reason = "cannot enable memory/pids for subtrees of " + base;
+    return rt;
+  }
+  std::string probe = base + "/probe";
+  if (mkdir(probe.c_str(), 0755) != 0 && errno != EEXIST) {
+    rt.reason = "cannot create scopes under " + base;
+    return rt;
+  }
+  bool ok = write_file(probe + "/memory.max", "max") &&
+            write_file(probe + "/pids.max", "max");
+  rmdir(probe.c_str());
+  if (!ok) {
+    rt.reason = "memory.max/pids.max not writable under " + base;
+    return rt;
+  }
+  rt.enabled = true;
+  rt.base = base;
+  return rt;
+}
+
+// One enforcement scope: a child cgroup with memory.max/pids.max armed.
+// Used two ways — a long-lived "runner" scope holding the warm runner group
+// (bounded by the boot caps for the sandbox's whole life; refresh_baseline/
+// violation bracket each request), and throwaway per-cold-run scopes
+// (created armed, child self-attaches pre-exec, destroyed after).
+class Scope {
+ public:
+  Scope() = default;
+
+  static Scope create(const Runtime& rt, const std::string& name,
+                      long long memory_max_bytes, long long pids_max) {
+    Scope s;
+    if (!rt.enabled) return s;
+    std::string dir = rt.base + "/" + name;
+    if (mkdir(dir.c_str(), 0755) != 0 && errno != EEXIST) return s;
+    char buf[32];
+    bool ok = true;
+    if (memory_max_bytes > 0) {
+      snprintf(buf, sizeof(buf), "%lld", memory_max_bytes);
+      ok = ok && write_file(dir + "/memory.max", buf);
+      // Kill the whole group on OOM rather than letting the kernel pick
+      // one victim: a half-dead runner group is the worst outcome (the
+      // server would keep talking to a runner whose worker just vanished).
+      write_file(dir + "/memory.oom.group", "1");  // best-effort (4.19+)
+    }
+    if (pids_max > 0) {
+      snprintf(buf, sizeof(buf), "%lld", pids_max);
+      ok = ok && write_file(dir + "/pids.max", buf);
+    }
+    if (!ok) {
+      rmdir(dir.c_str());
+      return s;
+    }
+    s.dir_ = dir;
+    s.refresh_baseline();
+    return s;
+  }
+
+  bool active() const { return !dir_.empty(); }
+  const std::string& dir() const { return dir_; }
+  // Membership is always SELF-attach: the forked child writes "0" to this
+  // path before exec (race-free — every byte user code allocates is inside
+  // the box). Deliberately no attach-by-pid helper: parent-side attachment
+  // would race the fork it observes.
+  std::string procs_path() const { return dir_ + "/cgroup.procs"; }
+
+  // Re-read the event counters; call before a run so violation() reports
+  // only what THAT run triggered (the runner scope is long-lived).
+  void refresh_baseline() {
+    if (!active()) return;
+    oom_base_ = read_event(dir_ + "/memory.events", "oom_kill");
+    pids_base_ = read_event(dir_ + "/pids.events", "max");
+  }
+
+  // Kernel-side enforcement evidence since the last baseline:
+  // "oom" (memory.max OOM kills), "nproc" (fork/clone refused at pids.max),
+  // or nullptr. Memory wins when both moved — an OOM kill is the stronger
+  // (and rarer) signal.
+  const char* violation() const {
+    if (!active()) return nullptr;
+    if (read_event(dir_ + "/memory.events", "oom_kill") > oom_base_)
+      return "oom";
+    if (read_event(dir_ + "/pids.events", "max") > pids_base_)
+      return "nproc";
+    return nullptr;
+  }
+
+  // Kill any members, then remove. cgroup.kill (5.14+) is best-effort; the
+  // rmdir retries briefly while the kernel reaps. A scope that will not
+  // die leaks one empty cgroup dir — logged by the caller, never fatal.
+  bool destroy() {
+    if (!active()) return true;
+    write_file(dir_ + "/cgroup.kill", "1");
+    for (int i = 0; i < 50; ++i) {
+      if (rmdir(dir_.c_str()) == 0 || errno == ENOENT) {
+        dir_.clear();
+        return true;
+      }
+      usleep(10 * 1000);
+    }
+    return false;
+  }
+
+ private:
+  std::string dir_;
+  long long oom_base_ = 0;
+  long long pids_base_ = 0;
+};
+
+}  // namespace cgroup
+
+#endif  // EXECUTOR_CGROUP_HPP_

@@ -55,6 +55,7 @@
 #include <thread>
 #include <vector>
 
+#include "cgroup.hpp"
 #include "http.hpp"
 #include "json.hpp"
 #include "limits.hpp"
@@ -514,7 +515,8 @@ ExecOutcome run_subprocess(const std::vector<std::string>& argv,
                            const std::string& stderr_path, double timeout_s,
                            const minijson::Value* extra_env,
                            const limits::LimitSpec* rlimits = nullptr,
-                           limits::Watchdog* watchdog = nullptr) {
+                           limits::Watchdog* watchdog = nullptr,
+                           const std::string* cgroup_procs = nullptr) {
   ExecOutcome out;
   pid_t parent = getpid();
   pid_t pid = fork();
@@ -528,6 +530,11 @@ ExecOutcome run_subprocess(const std::vector<std::string>& argv,
     // blocks in the waitpid loop below until this child is gone.
     prctl(PR_SET_PDEATHSIG, SIGKILL);
     if (getppid() != parent) _exit(127);
+    // Self-attach to the per-run cgroup scope BEFORE exec (race-free:
+    // every byte user code ever allocates is inside the box). Failure is
+    // non-fatal — rlimits+watchdog still govern.
+    if (cgroup_procs && !cgroup_procs->empty())
+      cgroup::write_file(*cgroup_procs, "0");
     if (rlimits) limits::apply_child_rlimits(*rlimits);
     if (!cwd.empty()) {
       if (chdir(cwd.c_str()) != 0) _exit(127);
@@ -616,6 +623,20 @@ std::mutex g_device_info_mutex;  // guards the two strings below only
 std::string g_device_backend_stat = "none";
 std::string g_device_kind_stat;
 
+// cgroup-v2 hard enforcement (cgroup.hpp): the boot-time delegation verdict,
+// the long-lived scope boxing the warm runner group (bounded by the
+// APP_LIMIT_* caps for the sandbox's whole life — per-request tighten-only
+// overrides stay the watchdog's job), and the procs path a freshly forked
+// runner self-attaches to. The verdict and its fallback reason ride
+// /healthz so the control plane (and the test suite's auto-skip) can see
+// which enforcement mode this sandbox actually runs in. Scope event reads
+// happen only under exec_mutex (the execute/batch paths); the procs string
+// is written once at boot, before any fork reads it.
+cgroup::Runtime g_cgroup;
+cgroup::Scope g_runner_scope;
+std::string g_runner_cgroup_procs;
+std::atomic<long long> g_run_scope_seq{0};
+
 // Resident set size of `pid` in bytes via /proc/<pid>/statm; -1 on failure.
 long long rss_bytes_of(long long pid) {
   if (pid <= 0) return -1;
@@ -662,6 +683,12 @@ class WarmRunner {
       // request-pipe read returns EOF when the server dies and it _exits
       // immediately (runner.py main loop).
       if (getppid() != parent) _exit(127);
+      // Join the runner's cgroup scope BEFORE exec: from the first
+      // instruction of runner.py, the kernel enforces memory.max/pids.max
+      // over the whole runner group ("0" = the writing process). Failure
+      // is non-fatal — the rlimits+watchdog layers still govern.
+      if (!g_runner_cgroup_procs.empty())
+        cgroup::write_file(g_runner_cgroup_procs, "0");
       if (chdir(workspace_.c_str()) != 0) _exit(127);
       // Shuffle pipe ends to fds 3/4 via safe high fds (the pipe fds may
       // themselves be 3/4, so a direct dup2 could clobber an end).
@@ -1466,6 +1493,10 @@ RunOutcome run_user_code(const std::string& script_path,
                             {stdout_path, stderr_path},
                             g_state.limit_poll_interval);
         wd.start();
+        // Bracket the run with the runner scope's kernel event counters:
+        // a memory.max OOM kill / pids.max fork refusal DURING this run
+        // reclassifies a generic runner death below.
+        g_runner_scope.refresh_baseline();
         WarmRunner::ExecResult r = g_state.runner->execute(
             minijson::Value(reqo).dump(), timeout_s > 0 ? timeout_s + 0.5 : 0,
             resp, /*allow_interrupt=*/true);
@@ -1502,9 +1533,16 @@ RunOutcome run_user_code(const std::string& script_path,
         }
         // A watchdog kill reaches the server as kDied/kTimeout (the runner
         // group is gone mid-request); the recorded kind reclassifies that
-        // generic death as the typed violation it actually was.
+        // generic death as the typed violation it actually was. The
+        // cgroup scope's event deltas do the same for KERNEL kills the
+        // watchdog never saw coming (allocation bursts faster than one
+        // sampling tick) — watchdog verdicts win when both fired.
         std::string wd_kind = wd.violation();
         if (!wd_kind.empty()) out.violation = wd_kind;
+        if (out.violation.empty()) {
+          const char* cg_kind = g_runner_scope.violation();
+          if (cg_kind) out.violation = cg_kind;
+        }
       } else {
         // Runner found already dead at request time (e.g. OOM-killed
         // between requests): without flagging a restart here, the sandbox
@@ -1540,9 +1578,32 @@ RunOutcome run_user_code(const std::string& script_path,
     limits::Watchdog wd(lim, 0, g_state.workspace, {stdout_path, stderr_path},
                         g_state.limit_poll_interval);
     wd.start();
+    // Per-run cgroup scope (hard kernel backstop; throwaway). The memory
+    // bound carries headroom above the watchdog's own slacked threshold —
+    // the budget means "beyond baseline" and a cgroup counts from zero,
+    // so the box must absorb the cold interpreter's startup RSS too; the
+    // pids bound leaves room for the launch wrapper and interpreter
+    // threads. Normal breaches still get the watchdog's clean typed kill;
+    // the cgroup catches what outruns its sampling tick.
+    cgroup::Scope run_scope;
+    std::string run_procs;
+    if (g_cgroup.enabled && (lim.memory_bytes > 0 || lim.nproc > 0)) {
+      char scope_name[64];
+      snprintf(scope_name, sizeof(scope_name), "run-%lld",
+               static_cast<long long>(g_run_scope_seq.fetch_add(1) + 1));
+      long long mem_headroom = lim.memory_bytes > (256LL << 20)
+                                   ? lim.memory_bytes
+                                   : (256LL << 20);
+      run_scope = cgroup::Scope::create(
+          g_cgroup, scope_name,
+          lim.memory_bytes > 0 ? lim.memory_bytes + mem_headroom : 0,
+          lim.nproc > 0 ? lim.nproc + 32 : 0);
+      if (run_scope.active()) run_procs = run_scope.procs_path();
+    }
     ExecOutcome cold = run_subprocess(
         {g_state.python, g_state.launch_script, script_path}, g_state.workspace,
-        stdout_path, stderr_path, timeout_s, &extra_env, &lim, &wd);
+        stdout_path, stderr_path, timeout_s, &extra_env, &lim, &wd,
+        run_procs.empty() ? nullptr : &run_procs);
     wd.stop();
     out.exit_code = cold.exit_code;
     out.timed_out = cold.timed_out;
@@ -1552,6 +1613,18 @@ RunOutcome run_user_code(const std::string& script_path,
       // RLIMIT_CPU fired in the child (no handler there): the kernel's
       // SIGXCPU kill IS the cpu_time violation.
       out.violation = limits::kCpuTime;
+    }
+    if (out.violation.empty()) {
+      // Kernel-side enforcement evidence: an OOM kill at memory.max or a
+      // fork refused at pids.max is the typed violation the generic exit
+      // code hid.
+      const char* cg_kind = run_scope.violation();
+      if (cg_kind && (cold.exit_code != 0 || strcmp(cg_kind, limits::kOom) == 0))
+        out.violation = cg_kind;
+    }
+    if (!run_scope.destroy()) {
+      log_msg("cgroup scope %s would not die; leaking one empty dir",
+              run_scope.dir().c_str());
     }
   }
   return out;
@@ -2158,6 +2231,10 @@ void handle_execute_batch(const minihttp::Request& req, minihttp::Conn& conn) {
     limits::Watchdog wd(eff_limits, g_state.runner->pid(), g_state.workspace,
                         capture_paths, g_state.limit_poll_interval);
     wd.start();
+    // Same kernel-event bracket as the serial warm path: a cgroup OOM/
+    // fork-refusal during the fused run is a BATCH-level violation (the
+    // group is shared), reclassified below.
+    g_runner_scope.refresh_baseline();
     WarmRunner::ExecResult r = g_state.runner->execute(
         minijson::Value(reqo).dump(), timeout_s > 0 ? timeout_s + 0.5 : 0,
         runner_resp, /*allow_interrupt=*/true);
@@ -2188,6 +2265,10 @@ void handle_execute_batch(const minihttp::Request& req, minihttp::Conn& conn) {
     }
     std::string wd_kind = wd.violation();
     if (!wd_kind.empty()) batch_violation = wd_kind;
+    if (batch_violation.empty()) {
+      const char* cg_kind = g_runner_scope.violation();
+      if (cg_kind) batch_violation = cg_kind;
+    }
     if (restart_runner) {
       g_warm_state = kWarmFailed;
       start_warm_async();
@@ -2361,6 +2442,22 @@ minijson::Value warm_status_body() {
   if (warm) {
     resp["backend"] = minijson::Value(g_state.runner->backend());
     resp["device_count"] = minijson::Value(g_state.runner->device_count());
+  }
+  // Which limits-enforcement mode this sandbox ACTUALLY runs in: cgroup-v2
+  // hard caps (memory.max/pids.max armed), or the rlimits+watchdog
+  // fallback and why. The control plane, operators, and the test suite's
+  // auto-skip all read this instead of guessing at the host's cgroup
+  // posture.
+  {
+    minijson::Object cg;
+    cg["enforced"] = minijson::Value(g_cgroup.enabled);
+    if (g_cgroup.enabled) {
+      cg["base"] = minijson::Value(g_cgroup.base);
+      cg["runner_scope"] = minijson::Value(g_runner_scope.active());
+    } else {
+      cg["fallback_reason"] = minijson::Value(g_cgroup.reason);
+    }
+    resp["cgroup"] = minijson::Value(cg);
   }
   return minijson::Value(resp);
 }
@@ -2644,6 +2741,37 @@ int main() {
   g_state.max_output = static_cast<size_t>(env_num("APP_MAX_OUTPUT_BYTES", 10485760));
   g_state.limit_caps = limits::caps_from_env();
   g_state.limit_poll_interval = env_num("APP_LIMIT_POLL_INTERVAL", 0.1);
+  // cgroup-v2 hard enforcement: detect a writable, memory+pids-delegated
+  // v2 subtree (the one this process lives in, or APP_CGROUP_ROOT) and
+  // park the warm runner group in a caps-bounded scope. Every failure
+  // mode — v1/hybrid host, read-only cgroupfs, shared subtree, kill
+  // switch — falls back cleanly to the rlimits+watchdog layers alone.
+  g_cgroup = cgroup::init(env_flag("APP_CGROUP_ENFORCE", true));
+  if (g_cgroup.enabled) {
+    long long cap_mem = g_state.limit_caps.memory_bytes;
+    long long cap_nproc = g_state.limit_caps.nproc;
+    if (cap_mem > 0 || cap_nproc > 0) {
+      // The runner scope bounds the SANDBOX for its whole life with the
+      // boot caps (per-request tighten-only overrides stay the watchdog's
+      // job). memory_bytes means "beyond the warm baseline", and a cgroup
+      // counts from zero — the headroom absorbs the runner's own RSS
+      // (jax + libtpu can be GiBs on real devices; tune per deployment).
+      // The pids headroom covers the runner's interpreter/runtime threads
+      // (the pids controller counts tasks, threads included).
+      long long headroom = static_cast<long long>(
+          env_num("APP_CGROUP_RUNNER_HEADROOM_BYTES", 2147483648.0));
+      g_runner_scope = cgroup::Scope::create(
+          g_cgroup, "runner", cap_mem > 0 ? cap_mem + headroom : 0,
+          cap_nproc > 0 ? cap_nproc + 512 : 0);
+      if (g_runner_scope.active())
+        g_runner_cgroup_procs = g_runner_scope.procs_path();
+    }
+    log_msg("cgroup-v2 enforcement armed (base=%s runner_scope=%d)",
+            g_cgroup.base.c_str(), (int)g_runner_scope.active());
+  } else {
+    log_msg("cgroup-v2 enforcement unavailable (%s); rlimits+watchdog only",
+            g_cgroup.reason.c_str());
+  }
   if (g_state.limit_caps.any()) {
     log_msg(
         "resource limits armed: mem=%lld cpu=%.0fs nproc=%lld nofile=%lld "

@@ -1,0 +1,872 @@
+"""Per-tenant quota enforcement (services/quotas.py): sliding-window
+chip-second budgets over the usage ledger, request-rate/concurrency caps,
+repeat-offender quarantine with exponential decay, policy-file hot reload,
+journal window restore, the admission wiring in CodeExecutor (denial before
+any scheduler/pool machinery), the HTTP/gRPC surfaces, and the kill
+switch's byte-for-byte restoration of pre-quota behavior.
+
+Every window test runs on a FAKE wall clock (the enforcer's injectable
+walltime), so budget refills and quarantine sentences are asserted without
+a single sleep.
+"""
+
+import asyncio
+import json
+import os
+
+import grpc
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+from fakes import FakeBackend
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.proto import code_interpreter_pb2 as pb2
+from bee_code_interpreter_fs_tpu.services.code_executor import (
+    CodeExecutor,
+    QuotaExceededError,
+)
+from bee_code_interpreter_fs_tpu.services.custom_tool_executor import (
+    CustomToolExecutor,
+)
+from bee_code_interpreter_fs_tpu.services.grpc_servicers.code_interpreter_servicer import (  # noqa: E501
+    CodeInterpreterServicer,
+)
+from bee_code_interpreter_fs_tpu.services.http_server import create_http_app
+from bee_code_interpreter_fs_tpu.services.quotas import (
+    DENIAL_REASONS,
+    QuotaEnforcer,
+    QuotaPolicy,
+)
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+from bee_code_interpreter_fs_tpu.services.usage import UsageLedger
+from bee_code_interpreter_fs_tpu.utils.metrics import ExecutorMetrics
+
+
+class FakeClock:
+    def __init__(self, start: float = 1_000_000.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+def make_config(tmp_path, **kwargs):
+    kwargs.setdefault("file_storage_path", str(tmp_path / "storage"))
+    kwargs.setdefault("executor_pod_queue_target_length", 1)
+    return Config(**kwargs)
+
+
+def make_enforcer(tmp_path, clock=None, **kwargs):
+    clock = clock or FakeClock()
+    config = make_config(tmp_path, **kwargs)
+    ledger = UsageLedger(config, walltime=clock)
+    enforcer = QuotaEnforcer(config, usage=ledger, walltime=clock)
+    return enforcer, ledger, clock
+
+
+# ------------------------------------------------------------- window budgets
+
+
+def test_budget_denial_and_window_refill(tmp_path):
+    enforcer, ledger, clock = make_enforcer(
+        tmp_path,
+        quota_chip_seconds_per_window=10.0,
+        quota_window_seconds=100.0,
+    )
+    # Within budget: admitted, remaining reported.
+    v = enforcer.admit("t-a")
+    assert v is not None and v.remaining_chip_seconds == 10.0
+    enforcer.release(v)
+    ledger.add("t-a", chip_seconds=6.0)
+    clock.advance(1.0)
+    v = enforcer.admit("t-a")
+    assert v.remaining_chip_seconds == pytest.approx(4.0)
+    enforcer.release(v)
+    # Over budget: denied with the typed reason and a refill-derived
+    # Retry-After (the consumption ages out of the window, not a guess).
+    ledger.add("t-a", chip_seconds=6.0)
+    clock.advance(1.0)
+    with pytest.raises(QuotaExceededError) as e:
+        enforcer.admit("t-a")
+    assert e.value.reason == "chip_seconds"
+    assert e.value.remaining_chip_seconds == 0.0
+    assert e.value.limit_chip_seconds == 10.0
+    assert 0 < e.value.retry_after <= 100.0
+    # Waiting out the advertised Retry-After re-admits (the acceptance
+    # criterion's "re-admitted after the window refills").
+    clock.advance(e.value.retry_after + 0.1)
+    v = enforcer.admit("t-a")
+    assert v is not None
+    enforcer.release(v)
+
+
+def test_budget_isolation_between_tenants(tmp_path):
+    enforcer, ledger, clock = make_enforcer(
+        tmp_path,
+        quota_chip_seconds_per_window=5.0,
+        quota_window_seconds=60.0,
+    )
+    ledger.add("t-a", chip_seconds=50.0)
+    enforcer.admit("t-a")  # first admit seeds the baseline sample
+    ledger.add("t-a", chip_seconds=50.0)
+    clock.advance(1.0)
+    with pytest.raises(QuotaExceededError):
+        enforcer.admit("t-a")
+    # t-b is untouched by t-a's exhaustion.
+    v = enforcer.admit("t-b")
+    assert v is not None
+    enforcer.release(v)
+
+
+def test_zero_caps_enforce_nothing(tmp_path):
+    enforcer, ledger, clock = make_enforcer(tmp_path)
+    ledger.add("t-a", chip_seconds=1e9)
+    for _ in range(50):
+        v = enforcer.admit("t-a")
+        assert v is not None and v.limit_chip_seconds is None
+        enforcer.release(v)
+
+
+# ------------------------------------------------------------ rate/concurrency
+
+
+def test_request_rate_cap(tmp_path):
+    enforcer, _, clock = make_enforcer(
+        tmp_path,
+        quota_requests_per_window=3,
+        quota_window_seconds=60.0,
+    )
+    for _ in range(3):
+        enforcer.release(enforcer.admit("t-a"))
+        clock.advance(1.0)
+    with pytest.raises(QuotaExceededError) as e:
+        enforcer.admit("t-a")
+    assert e.value.reason == "request_rate"
+    # The oldest admission ages out of the window -> re-admitted.
+    clock.advance(e.value.retry_after + 0.1)
+    assert enforcer.admit("t-a") is not None
+
+
+def test_concurrency_cap_and_idempotent_release(tmp_path):
+    enforcer, _, clock = make_enforcer(tmp_path, quota_max_concurrent=2)
+    a = enforcer.admit("t-a")
+    b = enforcer.admit("t-a")
+    with pytest.raises(QuotaExceededError) as e:
+        enforcer.admit("t-a")
+    assert e.value.reason == "concurrency"
+    enforcer.release(a)
+    enforcer.release(a)  # double release must not free a second slot
+    c = enforcer.admit("t-a")
+    assert c is not None
+    with pytest.raises(QuotaExceededError):
+        enforcer.admit("t-a")
+    enforcer.release(b)
+    enforcer.release(c)
+
+
+# ------------------------------------------------------------------ quarantine
+
+
+def test_violation_storm_quarantines_and_decays(tmp_path):
+    enforcer, ledger, clock = make_enforcer(
+        tmp_path,
+        quota_violations_per_window=3,
+        quota_window_seconds=100.0,
+        quota_quarantine_base_seconds=10.0,
+        quota_quarantine_max_seconds=1000.0,
+        quota_quarantine_decay_seconds=50.0,
+    )
+    enforcer.release(enforcer.admit("t-bad"))  # baseline sample
+    for _ in range(3):
+        ledger.add("t-bad", violation="oom", requests=1,
+                   outcome="limit_violation")
+    clock.advance(1.0)
+    # Storm crosses the threshold: quarantined with the base sentence.
+    with pytest.raises(QuotaExceededError) as e1:
+        enforcer.admit("t-bad")
+    assert e1.value.reason == "quarantined"
+    assert e1.value.retry_after == pytest.approx(10.0)
+    # Still quarantined mid-sentence.
+    clock.advance(5.0)
+    with pytest.raises(QuotaExceededError) as e2:
+        enforcer.admit("t-bad")
+    assert e2.value.reason == "quarantined"
+    assert e2.value.retry_after == pytest.approx(5.0)
+    # Sentence served; the spent violations do NOT re-quarantine (the
+    # violation floor) — the tenant decays back in.
+    clock.advance(6.0)
+    v = enforcer.admit("t-bad")
+    assert v is not None
+    enforcer.release(v)
+    # A SECOND storm doubles the sentence (exponential episode ladder).
+    for _ in range(3):
+        ledger.add("t-bad", violation="nproc")
+    clock.advance(1.0)
+    with pytest.raises(QuotaExceededError) as e3:
+        enforcer.admit("t-bad")
+    assert e3.value.retry_after == pytest.approx(20.0)
+    # Long clean stretch decays the ladder: the NEXT storm is back to the
+    # base sentence.
+    clock.advance(20.0 + 2 * 50.0 + 1.0)
+    enforcer.release(enforcer.admit("t-bad"))
+    for _ in range(3):
+        ledger.add("t-bad", violation="cpu_time")
+    clock.advance(1.0)
+    with pytest.raises(QuotaExceededError) as e4:
+        enforcer.admit("t-bad")
+    assert e4.value.retry_after == pytest.approx(10.0)
+
+
+def test_quarantine_sentence_caps_at_max(tmp_path):
+    enforcer, ledger, clock = make_enforcer(
+        tmp_path,
+        quota_violations_per_window=1,
+        quota_window_seconds=100.0,
+        quota_quarantine_base_seconds=10.0,
+        quota_quarantine_max_seconds=25.0,
+        quota_quarantine_decay_seconds=10000.0,
+    )
+    enforcer.release(enforcer.admit("t-bad"))
+    sentences = []
+    for _ in range(4):
+        ledger.add("t-bad", violation="oom")
+        clock.advance(1.0)
+        with pytest.raises(QuotaExceededError) as e:
+            enforcer.admit("t-bad")
+        sentences.append(e.value.retry_after)
+        clock.advance(e.value.retry_after + 0.1)
+    assert sentences == [
+        pytest.approx(10.0),
+        pytest.approx(20.0),
+        pytest.approx(25.0),  # capped
+        pytest.approx(25.0),
+    ]
+
+
+# ----------------------------------------------------------------- policy file
+
+
+def test_policy_file_overrides_and_hot_reload(tmp_path):
+    policy_path = tmp_path / "policy.json"
+    policy_path.write_text(
+        json.dumps(
+            {
+                "default": {"chip_seconds_per_window": 100},
+                "tenants": {"vip": {"chip_seconds_per_window": 1000}},
+            }
+        )
+    )
+    enforcer, ledger, clock = make_enforcer(
+        tmp_path,
+        quota_window_seconds=60.0,
+        quota_policy_file=str(policy_path),
+        quota_policy_reload_seconds=1.0,
+    )
+    assert enforcer.default_policy.chip_seconds_per_window == 100.0
+    assert enforcer.policy_for("vip").chip_seconds_per_window == 1000.0
+    assert enforcer.policy_for("other").chip_seconds_per_window == 100.0
+    # Hot reload: rewrite, bump mtime, advance past the reload throttle.
+    policy_path.write_text(
+        json.dumps({"default": {"chip_seconds_per_window": 7}})
+    )
+    os.utime(policy_path, (clock.now + 60, clock.now + 60))
+    clock.advance(2.0)
+    enforcer.release(enforcer.admit("other"))
+    assert enforcer.default_policy.chip_seconds_per_window == 7.0
+    assert enforcer.policy_loads == 2
+
+
+def test_policy_reload_is_idempotent_in_file_content(tmp_path):
+    """Every reload layers over the CONFIG baseline, not the previous
+    load: a key REMOVED from the file reverts to the config default
+    instead of silently keeping its old value on long-running instances
+    (which would split a fleet into two policies from one file)."""
+    policy_path = tmp_path / "policy.json"
+    policy_path.write_text(
+        json.dumps(
+            {"default": {"max_concurrent": 5, "chip_seconds_per_window": 9}}
+        )
+    )
+    enforcer, _, clock = make_enforcer(
+        tmp_path,
+        quota_policy_file=str(policy_path),
+        quota_policy_reload_seconds=1.0,
+    )
+    assert enforcer.default_policy.max_concurrent == 5
+    # Rewrite WITHOUT max_concurrent: it must revert to the config
+    # default (0 = off), exactly what a restarted instance would compute.
+    policy_path.write_text(
+        json.dumps({"default": {"chip_seconds_per_window": 9}})
+    )
+    os.utime(policy_path, (clock.now + 60, clock.now + 60))
+    clock.advance(2.0)
+    enforcer.release(enforcer.admit("t"))
+    assert enforcer.default_policy.max_concurrent == 0
+    assert enforcer.default_policy.chip_seconds_per_window == 9.0
+
+
+def test_whitelisted_past_cap_tenant_paces_on_its_own_budget(tmp_path):
+    """A tenant whitelisted BY NAME past the ledger cardinality cap is
+    admitted under its named override; the post-run pacing refresh must
+    use that same budget, not re-resolve the shared `_overflow` label's
+    policy (which would report a nearly-full budget as exhausted)."""
+    policy_path = tmp_path / "policy.json"
+    policy_path.write_text(
+        json.dumps({"tenants": {"vip": {"chip_seconds_per_window": 1000}}})
+    )
+    enforcer, ledger, clock = make_enforcer(
+        tmp_path,
+        usage_max_tenants=1,
+        quota_policy_file=str(policy_path),
+        quota_window_seconds=60.0,
+    )
+    ledger.add("squatter", chip_seconds=0.1)  # fills the 1-row cap
+    verdict = enforcer.admit("vip")  # lands on _overflow's row...
+    assert verdict.tenant == "_overflow"
+    assert verdict.limit_chip_seconds == 1000.0  # ...under vip's policy
+    ledger.add("vip", chip_seconds=2.0)  # accrues to _overflow
+    clock.advance(1.0)
+    enforcer.refresh_verdict(verdict)
+    # Remaining computed against vip's OWN 1000s budget, minus the shared
+    # row's consumption — never the overflow policy's (unlimited -> None
+    # -> rendered 0.0, the "budget exhausted" lie this test pins).
+    assert verdict.remaining_chip_seconds == pytest.approx(998.0)
+    enforcer.release(verdict)
+
+
+def test_malformed_policy_file_keeps_last_good(tmp_path):
+    policy_path = tmp_path / "policy.json"
+    policy_path.write_text(
+        json.dumps({"default": {"chip_seconds_per_window": 100}})
+    )
+    enforcer, _, clock = make_enforcer(
+        tmp_path,
+        quota_policy_file=str(policy_path),
+        quota_policy_reload_seconds=1.0,
+    )
+    assert enforcer.default_policy.chip_seconds_per_window == 100.0
+    for bad in ("{not json", json.dumps({"default": {"bogus_key": 1}}),
+                json.dumps({"default": {"chip_seconds_per_window": -5}})):
+        policy_path.write_text(bad)
+        os.utime(policy_path, (clock.now + 60, clock.now + 60))
+        clock.advance(2.0)
+        enforcer.release(enforcer.admit("t"))
+        # Fail closed: the last GOOD policy stands.
+        assert enforcer.default_policy.chip_seconds_per_window == 100.0
+    assert enforcer.policy_load_errors >= 2  # unparseable + bad key/value
+
+
+def test_policy_validation_rejects_bad_values():
+    with pytest.raises(ValueError):
+        from bee_code_interpreter_fs_tpu.services.quotas import (
+            _policy_from_mapping,
+        )
+
+        _policy_from_mapping(QuotaPolicy(), {"nope": 1}, source="t")
+
+
+# ----------------------------------------------------------- overflow-cap rule
+
+
+def test_past_cap_tenants_share_overflow_budget(tmp_path):
+    enforcer, ledger, clock = make_enforcer(
+        tmp_path,
+        usage_max_tenants=2,
+        quota_chip_seconds_per_window=5.0,
+        quota_window_seconds=60.0,
+    )
+    ledger.add("a", chip_seconds=0.1)
+    ledger.add("b", chip_seconds=0.1)
+    # Past the cap: minted names land on _overflow's row AND its budget.
+    enforcer.release(enforcer.admit("minted-1"))
+    ledger.add("minted-1", chip_seconds=10.0)  # accrues to _overflow
+    clock.advance(1.0)
+    with pytest.raises(QuotaExceededError) as e:
+        enforcer.admit("minted-2")  # a DIFFERENT minted name
+    assert e.value.tenant == "_overflow"
+    assert e.value.reason == "chip_seconds"
+
+
+# -------------------------------------------------------------- journal restore
+
+
+def test_windows_restore_from_ledger_journal(tmp_path):
+    clock = FakeClock()
+    config = make_config(
+        tmp_path,
+        quota_chip_seconds_per_window=5.0,
+        quota_window_seconds=1000.0,
+    )
+    ledger = UsageLedger(config, walltime=clock)
+    enforcer = QuotaEnforcer(config, usage=ledger, walltime=clock)
+    enforcer.release(enforcer.admit("t-a"))
+    ledger.add("t-a", chip_seconds=10.0)
+    ledger.flush()  # the journal now holds the timestamped sample
+    clock.advance(1.0)
+    with pytest.raises(QuotaExceededError):
+        enforcer.admit("t-a")
+    # "Restart": fresh ledger + enforcer over the same directory. The
+    # offender must NOT get a fresh budget (the journal restores the
+    # window), even though all in-memory state is gone.
+    ledger2 = UsageLedger(config, walltime=clock)
+    enforcer2 = QuotaEnforcer(config, usage=ledger2, walltime=clock)
+    with pytest.raises(QuotaExceededError) as e:
+        enforcer2.admit("t-a")
+    assert e.value.reason == "chip_seconds"
+    # And the refill point survives too: after the window passes, admitted.
+    clock.advance(e.value.retry_after + 0.1)
+    assert enforcer2.admit("t-a") is not None
+
+
+def test_quarantine_ladder_survives_restart(tmp_path):
+    """Crashing the control plane must not truncate a standing sentence
+    (or reset the escalation ladder): the offender sidecar restores
+    level, quarantined_until, and the spent-violation floor."""
+    clock = FakeClock()
+    config = make_config(
+        tmp_path,
+        quota_violations_per_window=2,
+        quota_window_seconds=1000.0,
+        quota_quarantine_base_seconds=100.0,
+        quota_quarantine_decay_seconds=10_000.0,
+    )
+    ledger = UsageLedger(config, walltime=clock)
+    enforcer = QuotaEnforcer(config, usage=ledger, walltime=clock)
+    enforcer.release(enforcer.admit("t-bad"))
+    # Two storms: the second sentence is the escalated 200s one.
+    for sentence in (100.0, 200.0):
+        for _ in range(2):
+            ledger.add("t-bad", violation="oom")
+        clock.advance(1.0)
+        with pytest.raises(QuotaExceededError) as e:
+            enforcer.admit("t-bad")
+        assert e.value.retry_after == pytest.approx(sentence, abs=0.01)
+        if sentence == 100.0:
+            clock.advance(sentence + 0.1)
+            enforcer.release(enforcer.admit("t-bad"))
+    ledger.flush()
+    # "Restart" 50s into the 200s sentence: the fresh enforcer must
+    # continue the SAME sentence (150s remaining at level 2), not start a
+    # fresh base one — and the spent-violation floor must hold (no
+    # re-sentencing for already-punished violations after release).
+    clock.advance(50.0)
+    ledger2 = UsageLedger(config, walltime=clock)
+    enforcer2 = QuotaEnforcer(config, usage=ledger2, walltime=clock)
+    with pytest.raises(QuotaExceededError) as e:
+        enforcer2.admit("t-bad")
+    assert e.value.reason == "quarantined"
+    assert e.value.retry_after == pytest.approx(150.0, abs=1.0)
+    clock.advance(151.0)
+    assert enforcer2.admit("t-bad") is not None
+
+
+def test_restore_ignores_samples_outside_horizon(tmp_path):
+    clock = FakeClock()
+    config = make_config(
+        tmp_path,
+        quota_chip_seconds_per_window=5.0,
+        quota_window_seconds=100.0,
+    )
+    ledger = UsageLedger(config, walltime=clock)
+    ledger.add("t-a", chip_seconds=10.0)
+    ledger.flush()
+    # Far past the window: the old consumption must not deny anything.
+    clock.advance(10_000.0)
+    ledger2 = UsageLedger(config, walltime=clock)
+    enforcer2 = QuotaEnforcer(config, usage=ledger2, walltime=clock)
+    assert enforcer2.admit("t-a") is not None
+
+
+# ------------------------------------------------------------------ kill switch
+
+
+def test_kill_switch_disables_everything(tmp_path):
+    enforcer, ledger, clock = make_enforcer(
+        tmp_path,
+        quotas_enabled=False,
+        quota_chip_seconds_per_window=0.001,
+        quota_violations_per_window=1,
+    )
+    assert not enforcer.enabled
+    ledger.add("t-a", chip_seconds=1e9, violation="oom")
+    for _ in range(10):
+        assert enforcer.admit("t-a") is None  # no verdict object at all
+    assert enforcer.snapshot() == {"enabled": False}
+    assert enforcer.remaining_gauge_samples() == {}
+
+
+def test_quotas_inert_without_metering(tmp_path):
+    config = make_config(
+        tmp_path,
+        usage_metering_enabled=False,
+        quota_chip_seconds_per_window=0.001,
+    )
+    ledger = UsageLedger(config)
+    enforcer = QuotaEnforcer(config, usage=ledger)
+    assert not enforcer.enabled  # reads the ledger; nothing to read
+
+
+# ------------------------------------------------------- executor integration
+
+
+def make_executor(tmp_path, **kwargs):
+    config = make_config(tmp_path, **kwargs)
+    executor = CodeExecutor(
+        FakeBackend(), Storage(config.file_storage_path), config
+    )
+
+    async def post(client, base, payload, timeout, sandbox):
+        return {
+            "stdout": "ok\n",
+            "stderr": "",
+            "exit_code": 0,
+            "files": [],
+            "warm": True,
+            "duration_s": 0.5,
+            "device_op_seconds": 0.5,
+        }
+
+    executor._post_execute = post
+    return executor
+
+
+async def test_executor_denies_before_any_sandbox_is_consumed(tmp_path):
+    executor = make_executor(
+        tmp_path,
+        quota_chip_seconds_per_window=0.4,
+        quota_window_seconds=3600.0,
+        executor_pod_queue_target_length=0,  # no warm pool: spawns visible
+    )
+    try:
+        # First request admitted (window empty); it bills 0.5 chip-seconds
+        # against the 0.4 budget, so everything after is denied.
+        result = await executor.execute("print(1)", tenant="t-a")
+        assert result.phases["quota"]["limit_chip_seconds"] == 0.4
+        spawns_after_first = executor.backend.spawns
+        for _ in range(5):
+            with pytest.raises(QuotaExceededError) as e:
+                await executor.execute("print(1)", tenant="t-a")
+            assert e.value.reason == "chip_seconds"
+        # ZERO sandboxes (and zero scheduler tickets) consumed by the five
+        # denied attempts — the point of admission-side enforcement.
+        assert executor.backend.spawns == spawns_after_first
+        assert executor.scheduler.queued(0) == 0
+        # The denials are visible: metric family + ledger outcome counts.
+        samples = dict(
+            (tuple(labels.items()), value)
+            for labels, value in executor.metrics.quota_denials.samples()
+        )
+        assert samples[
+            (("tenant", "t-a"), ("reason", "chip_seconds"))
+        ] == 5.0
+        row = executor.usage.snapshot()["tenants"]["t-a"]
+        assert row["outcomes"]["rejected"] == 5.0
+    finally:
+        await executor.close()
+
+
+async def test_violation_storm_tenant_quarantined_at_door(tmp_path):
+    executor = make_executor(
+        tmp_path,
+        quota_violations_per_window=2,
+        quota_window_seconds=3600.0,
+        quota_quarantine_base_seconds=60.0,
+    )
+    try:
+        # Two violations land in the ledger (as the limits pipeline would
+        # record them).
+        await executor.execute("print(1)", tenant="t-bad")
+        executor.usage.add("t-bad", violation="oom", requests=1,
+                           outcome="limit_violation")
+        executor.usage.add("t-bad", violation="oom", requests=1,
+                           outcome="limit_violation")
+        spawns_before = executor.backend.spawns
+        with pytest.raises(QuotaExceededError) as e:
+            await executor.execute("print(1)", tenant="t-bad")
+        assert e.value.reason == "quarantined"
+        assert executor.backend.spawns == spawns_before
+        # Another tenant is unaffected.
+        result = await executor.execute("print(1)", tenant="t-good")
+        assert result.exit_code == 0
+    finally:
+        await executor.close()
+
+
+async def test_trusted_runs_bypass_quotas(tmp_path):
+    executor = make_executor(
+        tmp_path,
+        quota_requests_per_window=1,
+        quota_window_seconds=3600.0,
+    )
+    try:
+        # Internal (pre-warm) runs are unmetered AND unquota'd: they carry
+        # no tenant, so a tight default policy cannot starve the control
+        # plane's own warmup work.
+        for _ in range(3):
+            result = await executor._execute_trusted("print(1)")
+            assert result.exit_code == 0
+    finally:
+        await executor.close()
+
+
+async def test_quota_kill_switch_end_to_end(tmp_path):
+    executor = make_executor(
+        tmp_path,
+        quotas_enabled=False,
+        quota_chip_seconds_per_window=0.0001,
+        quota_requests_per_window=1,
+    )
+    try:
+        for _ in range(4):
+            result = await executor.execute("print(1)", tenant="t-a")
+            assert result.exit_code == 0
+            assert "quota" not in result.phases  # byte-for-byte
+        registry_text = executor.metrics.registry.render()
+        assert "quota_remaining_chip_seconds" not in registry_text
+    finally:
+        await executor.close()
+
+
+async def test_concurrency_cap_through_executor(tmp_path):
+    executor = make_executor(tmp_path, quota_max_concurrent=1)
+    release = asyncio.Event()
+
+    async def slow_post(client, base, payload, timeout, sandbox):
+        await release.wait()
+        return {
+            "stdout": "",
+            "stderr": "",
+            "exit_code": 0,
+            "files": [],
+            "warm": True,
+            "duration_s": 0.1,
+            "device_op_seconds": 0.1,
+        }
+
+    executor._post_execute = slow_post
+    try:
+        first = asyncio.create_task(
+            executor.execute("print(1)", tenant="t-a")
+        )
+        await asyncio.sleep(0.05)  # first request is in flight
+        with pytest.raises(QuotaExceededError) as e:
+            await executor.execute("print(1)", tenant="t-a")
+        assert e.value.reason == "concurrency"
+        release.set()
+        result = await first
+        assert result.exit_code == 0
+        # Slot released at exit: next request admitted.
+        result = await executor.execute("print(1)", tenant="t-a")
+        assert result.exit_code == 0
+    finally:
+        release.set()
+        await executor.close()
+
+
+# ------------------------------------------------------------------- HTTP side
+
+
+async def http_client_for(executor):
+    app = create_http_app(
+        executor, CustomToolExecutor(executor), executor.storage
+    )
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def test_http_429_with_quota_headers(tmp_path):
+    executor = make_executor(
+        tmp_path,
+        quota_chip_seconds_per_window=0.4,
+        quota_window_seconds=3600.0,
+    )
+    client = await http_client_for(executor)
+    try:
+        resp = await client.post(
+            "/v1/execute",
+            json={"source_code": "print(1)", "tenant": "t-a"},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["phases"]["quota"]["limit_chip_seconds"] == 0.4
+        resp = await client.post(
+            "/v1/execute",
+            json={"source_code": "print(1)", "tenant": "t-a"},
+        )
+        assert resp.status == 429
+        assert resp.headers["X-Quota-Reason"] == "chip_seconds"
+        assert float(resp.headers["X-Quota-Remaining-Chip-Seconds"]) == 0.0
+        assert float(resp.headers["X-Quota-Limit-Chip-Seconds"]) == 0.4
+        assert int(resp.headers["Retry-After"]) >= 1
+        body = await resp.json()
+        assert body["quota"]["reason"] == "chip_seconds"
+        # Tenant via header (gateway idiom) hits the same budget row.
+        resp = await client.post(
+            "/v1/execute",
+            json={"source_code": "print(1)"},
+            headers={"X-Tenant": "t-a"},
+        )
+        assert resp.status == 429
+    finally:
+        await client.close()
+        await executor.close()
+
+
+async def test_http_quotas_surface(tmp_path):
+    executor = make_executor(
+        tmp_path,
+        quota_chip_seconds_per_window=10.0,
+        quota_window_seconds=3600.0,
+    )
+    client = await http_client_for(executor)
+    try:
+        await executor.execute("print(1)", tenant="t-a")
+        resp = await client.get("/quotas")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["enabled"] is True
+        assert body["default_policy"]["chip_seconds_per_window"] == 10.0
+        assert "t-a" in body["tenants"]
+        assert body["tenants"]["t-a"]["remaining_chip_seconds"] <= 10.0
+        resp = await client.get("/quotas/t-a")
+        assert resp.status == 200
+        one = await resp.json()
+        assert one["quota"]["policy"]["chip_seconds_per_window"] == 10.0
+        resp = await client.get("/quotas/never-seen")
+        assert resp.status == 404
+        resp = await client.get("/quotas?format=text")
+        assert resp.status == 200
+        text = await resp.text()
+        assert "t-a" in text and "quota enforcement" in text
+        # /statusz carries the quotas section in both formats.
+        resp = await client.get("/statusz")
+        statusz = await resp.json()
+        assert statusz["quotas"]["enabled"] is True
+        resp = await client.get("/statusz?format=text")
+        assert "quotas:" in await resp.text()
+        # The remaining-budget gauge rides /metrics.
+        resp = await client.get("/metrics")
+        metrics_text = await resp.text()
+        assert "code_interpreter_quota_remaining_chip_seconds" in metrics_text
+        assert 'tenant="t-a"' in metrics_text
+    finally:
+        await client.close()
+        await executor.close()
+
+
+async def test_http_quotas_404_when_disabled(tmp_path):
+    executor = make_executor(tmp_path, quotas_enabled=False)
+    client = await http_client_for(executor)
+    try:
+        assert (await client.get("/quotas")).status == 404
+        assert (await client.get("/quotas/t-a")).status == 404
+    finally:
+        await client.close()
+        await executor.close()
+
+
+# ------------------------------------------------------------------- gRPC side
+
+
+class AbortRaised(Exception):
+    def __init__(self, code, details):
+        super().__init__(details)
+        self.code = code
+        self.details = details
+
+
+class FakeContext:
+    def __init__(self, metadata=()):
+        self.metadata = tuple(metadata)
+        self.trailing = ()
+
+    def invocation_metadata(self):
+        return self.metadata
+
+    def set_trailing_metadata(self, trailing):
+        self.trailing = tuple(trailing)
+
+    async def abort(self, code, details=""):
+        raise AbortRaised(code, details)
+
+
+async def test_grpc_quota_denial_metadata(tmp_path):
+    executor = make_executor(
+        tmp_path,
+        quota_chip_seconds_per_window=0.4,
+        quota_window_seconds=3600.0,
+    )
+    servicer = CodeInterpreterServicer(executor, CustomToolExecutor(executor))
+    try:
+        context = FakeContext(metadata=[("x-tenant", "t-a")])
+        await servicer.Execute(
+            pb2.ExecuteRequest(source_code="print(1)"), context
+        )
+        trailing = dict(context.trailing)
+        # Success-path pacing metadata (the satellite): remaining budget.
+        assert "x-quota-remaining-chip-seconds" in trailing
+        context = FakeContext(metadata=[("x-tenant", "t-a")])
+        with pytest.raises(AbortRaised) as e:
+            await servicer.Execute(
+                pb2.ExecuteRequest(source_code="print(1)"), context
+            )
+        assert e.value.code == grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert "quota denied" in e.value.details
+        trailing = dict(context.trailing)
+        assert trailing["x-quota-reason"] == "chip_seconds"
+        assert float(trailing["x-quota-retry-after"]) > 0
+        assert float(trailing["x-quota-remaining-chip-seconds"]) == 0.0
+        assert float(trailing["x-quota-limit-chip-seconds"]) == 0.4
+    finally:
+        await executor.close()
+
+
+# ----------------------------------------------------------------- invariants
+
+
+def test_denial_reasons_closed_set(tmp_path):
+    # Contract: every reason the enforcer can emit is in DENIAL_REASONS
+    # (they label quota_denials_total; an unlisted reason is a new metric
+    # series nobody dashboards).
+    assert set(DENIAL_REASONS) == {
+        "chip_seconds",
+        "request_rate",
+        "concurrency",
+        "quarantined",
+    }
+
+
+def test_gauge_samples_only_budgeted_tenants(tmp_path):
+    enforcer, ledger, clock = make_enforcer(
+        tmp_path,
+        quota_chip_seconds_per_window=10.0,
+        quota_window_seconds=60.0,
+    )
+    enforcer.release(enforcer.admit("t-a"))
+    ledger.add("t-a", chip_seconds=4.0)
+    clock.advance(1.0)
+    enforcer.release(enforcer.admit("t-a"))
+    samples = enforcer.remaining_gauge_samples()
+    assert samples[("t-a",)] == pytest.approx(6.0)
+
+
+def test_metrics_bind_quotas_registers_once(tmp_path):
+    config = make_config(
+        tmp_path, quota_chip_seconds_per_window=1.0
+    )
+    ledger = UsageLedger(config)
+    enforcer = QuotaEnforcer(config, usage=ledger)
+    metrics = ExecutorMetrics()
+    metrics.bind_quotas(enforcer)
+    assert metrics.quota_remaining is not None
+    # A disabled enforcer must not register the family at all.
+    disabled = QuotaEnforcer(
+        make_config(tmp_path, quotas_enabled=False), usage=ledger
+    )
+    metrics2 = ExecutorMetrics()
+    metrics2.bind_quotas(disabled)
+    assert metrics2.quota_remaining is None

@@ -1,0 +1,234 @@
+"""Chaos suite for quota enforcement: verdicts stay DETERMINISTIC and
+conservative under injected spawn/exec faults. Seed-parameterized via
+``CHAOS_SEED`` (CI pins {7, 23, 1337}); every seed replays exactly.
+
+Pinned invariants:
+- the admission verdict depends only on the LEDGER (what actually ran and
+  billed), never on fault noise: a denied tenant is denied because its
+  billed consumption crossed the budget, and the denial threshold is
+  exactly reproducible from the wire's own ground-truth accounting;
+- denied requests consume NOTHING — no scheduler tickets, no retry-ladder
+  attempts against the faulty wire, no sandbox spawns;
+- concurrency slots always come back, whatever exit path a faulted request
+  took (the release-in-finally discipline under 50% wire drops);
+- a violation storm under chaos still quarantines at the door, and the
+  quarantined tenant's attempts stop reaching the wire entirely;
+- the kill switch holds under fire: with APP_QUOTAS_ENABLED=0 the same
+  chaotic workload sees zero quota machinery.
+"""
+
+import asyncio
+import os
+import random
+
+import pytest
+from fakes import FakeBackend
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor
+from bee_code_interpreter_fs_tpu.services.errors import (
+    ExecutorError,
+    QuotaExceededError,
+)
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "7"))
+
+
+def make_executor(tmp_path, **kwargs):
+    kwargs.setdefault("file_storage_path", str(tmp_path / "storage"))
+    kwargs.setdefault("executor_pod_queue_target_length", 1)
+    kwargs.setdefault("batching_enabled", False)
+    config = Config(**kwargs)
+    return CodeExecutor(
+        FakeBackend(), Storage(config.file_storage_path), config
+    )
+
+
+class SeededWire:
+    """Deterministic faulty wire (the usage-chaos harness's shape): each
+    /execute draws from the seeded stream — drop (ExecutorError, retried
+    by the ladder) or answer with a drawn device-op time. Ground truth for
+    what the ledger billed."""
+
+    def __init__(self, executor, seed: int, drop_rate=0.5):
+        self.rng = random.Random(seed)
+        self.drop_rate = drop_rate
+        self.reported_device_op = 0.0
+        self.attempts = 0
+        executor._post_execute = self.post
+
+    async def post(self, client, base, payload, timeout, sandbox):
+        self.attempts += 1
+        if self.rng.random() < self.drop_rate:
+            raise ExecutorError("chaos: exec connection dropped")
+        device_op = round(self.rng.uniform(0.05, 0.3), 6)
+        self.reported_device_op += device_op
+        return {
+            "stdout": "ok\n",
+            "stderr": "",
+            "exit_code": 0,
+            "files": [],
+            "warm": True,
+            "duration_s": device_op,
+            "device_op_seconds": device_op,
+        }
+
+
+async def test_quota_verdicts_track_billing_not_fault_noise(tmp_path):
+    """Under 50% wire drops, the denial point is exactly where the LEDGER
+    crossed the budget — reproducible from the wire's own accounting, not
+    from how many attempts the retry ladder happened to burn."""
+    budget = 1.0
+    executor = make_executor(
+        tmp_path,
+        quota_chip_seconds_per_window=budget,
+        quota_window_seconds=3600.0,
+    )
+    wire = SeededWire(executor, CHAOS_SEED)
+    denied = 0
+    served = 0
+    try:
+        for i in range(30):
+            billed_before = (
+                executor.usage.snapshot()["tenants"]
+                .get("chaos-tenant", {})
+                .get("chip_seconds", 0.0)
+            )
+            try:
+                await executor.execute(
+                    f"print({i})", tenant="chaos-tenant"
+                )
+                served += 1
+            except QuotaExceededError as e:
+                denied += 1
+                # The verdict is explained ENTIRELY by billed consumption:
+                # denial iff the ledger already held >= budget.
+                assert billed_before >= budget
+                assert e.reason == "chip_seconds"
+            except ExecutorError:
+                # The ladder exhausted against the chaotic wire — billed
+                # wall time still lands; admission itself never faulted.
+                served += 1
+        # The seeded ops average ~0.175s, so the 1.0s budget exhausts and
+        # everything after is denied — deterministically for this seed.
+        assert denied > 0 and served > 0
+        row = executor.usage.snapshot()["tenants"]["chaos-tenant"]
+        assert row["chip_seconds"] >= budget
+        # Denied requests are rejected-outcome rows, never infra errors.
+        assert row["outcomes"].get("rejected", 0) == denied
+    finally:
+        await executor.close()
+
+
+async def test_denied_requests_never_touch_wire_or_scheduler(tmp_path):
+    executor = make_executor(
+        tmp_path,
+        quota_chip_seconds_per_window=0.2,
+        quota_window_seconds=3600.0,
+    )
+    wire = SeededWire(executor, CHAOS_SEED + 1, drop_rate=0.0)
+    try:
+        await executor.execute("print(0)", tenant="chaos-tenant")
+        attempts_after_first = wire.attempts
+        spawns_after_first = executor.backend.spawns
+        for i in range(10):
+            with pytest.raises(QuotaExceededError):
+                await executor.execute(f"print({i})", tenant="chaos-tenant")
+        # ZERO wire attempts, zero spawns, zero queue residue for the ten
+        # denials — the abuse-control point of admission-side shedding.
+        assert wire.attempts == attempts_after_first
+        assert executor.backend.spawns == spawns_after_first
+        assert executor.scheduler.queued(0) == 0
+    finally:
+        await executor.close()
+
+
+async def test_concurrency_slots_survive_faulted_exits(tmp_path):
+    """Every exit path — ok, retried-then-ok, ladder-exhausted infra
+    error — releases its concurrency slot; 50% drops for 40 requests at a
+    cap of 4 never wedges admission."""
+    executor = make_executor(
+        tmp_path,
+        quota_max_concurrent=4,
+    )
+    SeededWire(executor, CHAOS_SEED + 2, drop_rate=0.5)
+    try:
+        results = await asyncio.gather(
+            *(
+                executor.execute(f"print({i})", tenant="chaos-tenant")
+                for i in range(40)
+            ),
+            return_exceptions=True,
+        )
+        # Concurrency denials are possible mid-burst (the cap is the
+        # point); what must NEVER happen is a leaked slot wedging the
+        # tenant afterwards:
+        win = executor.quotas._windows.get("chaos-tenant")
+        assert win is not None and win.in_flight == 0
+        result = await executor.execute("print('after')",
+                                        tenant="chaos-tenant")
+        assert result.exit_code == 0
+        infra = [r for r in results if isinstance(r, ExecutorError)]
+        quota = [r for r in results if isinstance(r, QuotaExceededError)]
+        ok = [r for r in results if not isinstance(r, Exception)]
+        assert len(infra) + len(quota) + len(ok) == 40
+    finally:
+        await executor.close()
+
+
+async def test_violation_storm_quarantines_under_chaos(tmp_path):
+    """A violating tenant under a chaotic wire still hits quarantine at
+    the threshold, and its subsequent attempts stop reaching the wire."""
+    executor = make_executor(
+        tmp_path,
+        quota_violations_per_window=3,
+        quota_window_seconds=3600.0,
+        quota_quarantine_base_seconds=300.0,
+    )
+    wire = SeededWire(executor, CHAOS_SEED + 3, drop_rate=0.0)
+    try:
+        await executor.execute("print(0)", tenant="bad-tenant")
+        for _ in range(3):
+            executor.usage.add(
+                "bad-tenant", violation="oom", requests=1,
+                outcome="limit_violation",
+            )
+        attempts_before = wire.attempts
+        for i in range(5):
+            with pytest.raises(QuotaExceededError) as e:
+                await executor.execute(f"print({i})", tenant="bad-tenant")
+            assert e.value.reason == "quarantined"
+        assert wire.attempts == attempts_before
+        # An innocent tenant sails through the same chaotic stack.
+        result = await executor.execute("print(1)", tenant="good-tenant")
+        assert result.exit_code == 0
+    finally:
+        await executor.close()
+
+
+async def test_kill_switch_holds_under_chaos(tmp_path):
+    executor = make_executor(
+        tmp_path,
+        quotas_enabled=False,
+        quota_chip_seconds_per_window=0.0001,
+        quota_violations_per_window=1,
+        quota_max_concurrent=1,
+    )
+    SeededWire(executor, CHAOS_SEED + 4, drop_rate=0.5)
+    try:
+        executor.usage.add("chaos-tenant", violation="oom")
+        results = await asyncio.gather(
+            *(
+                executor.execute(f"print({i})", tenant="chaos-tenant")
+                for i in range(20)
+            ),
+            return_exceptions=True,
+        )
+        # No quota machinery anywhere: every failure is the wire's own.
+        assert not any(isinstance(r, QuotaExceededError) for r in results)
+        for r in results:
+            if not isinstance(r, Exception):
+                assert "quota" not in r.phases
+    finally:
+        await executor.close()
