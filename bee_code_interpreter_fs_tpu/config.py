@@ -314,6 +314,25 @@ class Config(BaseModel):
     # device_health_state; past the cap all series collapse to lane-level
     # (host="_overflow") so a large fleet cannot explode label cardinality.
     device_probe_max_host_labels: int = 64
+    # -- wedge recovery: lease fencing & actuation (services/leases.py) ------
+    # Kill switch for the ACTUATION half of wedge recovery: with 0, a
+    # wedged verdict only marks the host (detection-only, the PR 8
+    # behavior) — no lease fencing, no automatic drain/dispose/replace,
+    # no recovering state. Detection (the probe daemon) keeps its own
+    # switch (device_probe_interval=0).
+    device_fence_enabled: bool = True
+    # Consecutive CLEAN probe cycles a fenced scope's hardware (the
+    # replacement lands on the same chips) must show before its hosts
+    # re-admit to the pool; a suspect/wedged relapse resets the streak.
+    device_probe_readmit_streak: int = 3
+    # Actuation budget: at most this many fence-and-dispose actuations per
+    # lane per window. A probe false-positive storm (flapping thresholds,
+    # a broken stats route) must degrade to "stop disposing and page",
+    # never to mass-disposing a serving lane. Past the cap, wedged verdicts
+    # are counted (device_fence_total{outcome="budget_exhausted"}) but not
+    # acted on until the window slides. 0 = uncapped.
+    device_fence_max_per_window: int = 4
+    device_fence_window_seconds: float = 600.0
     # -- OTLP export (utils/otlp.py) ------------------------------------------
     # OTLP/HTTP JSON collector base URL (spans POST to <endpoint>/v1/traces,
     # metric snapshots to <endpoint>/v1/metrics). Empty = the kill switch:
@@ -422,6 +441,14 @@ class Config(BaseModel):
     quota_requests_per_window: int = 0
     # ...and concurrent admitted (not yet finished) requests.
     quota_max_concurrent: int = 0
+    # Admission-time cost PREDICTION (the PR 11 carried follow-up): deny a
+    # request whose declared chip_count x timeout cannot fit the tenant's
+    # REMAINING chip-second budget — typed 429 reason=predicted_overrun
+    # with a refill-derived Retry-After, before any scheduler state is
+    # touched — instead of admitting it and billing the overrun after the
+    # burn. 0 restores deny-after-the-burn behavior exactly. Inert unless
+    # a chip-second budget is configured.
+    quota_cost_prediction: bool = True
     # Repeat-offender shedding: typed limit violations (oom/disk_quota/
     # nproc/cpu_time/output_cap, from the ledger's violations-by-kind
     # counters) a tenant may accrue per window before it is QUARANTINED —

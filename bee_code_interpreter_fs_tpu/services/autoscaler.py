@@ -69,15 +69,22 @@ SCALE_REAP = "reap"
 class LaneSnapshot:
     """One lane's supply/demand instant, assembled by the executor.
 
-    `pooled` counts only NON-WEDGED warm sandboxes: a lane of wedged pods
-    reads as empty supply, so the model keeps demanding replacements
-    (the device-health satellite; full drain/fencing stays the ROADMAP
-    actuation item)."""
+    `pooled` counts only SERVABLE warm sandboxes: wedged, draining
+    (fenced, dispose in flight), and recovering (fenced scope, earning its
+    clean-probe re-admission streak) hosts read as empty supply, so the
+    model keeps demanding replacements for the first two. `recovering` is
+    broken out separately because it is supply-IN-TRANSIT — those hosts
+    hold their chips and re-admit shortly, so refill decisions must count
+    them (spawning past them would overshoot and, on constrained lanes,
+    deadlock on the chips they still own); `draining` is pure
+    observability (the statusz/healthz rows)."""
 
     queued: int = 0
     in_use: int = 0
     pooled: int = 0
     spawning: int = 0
+    recovering: int = 0
+    draining: int = 0
     queue_wait_ewma: float = 0.0
     spawn_ewma: float = 0.0
 

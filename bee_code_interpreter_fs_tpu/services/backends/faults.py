@@ -39,6 +39,19 @@ on in any deployment (``APP_EXECUTOR_FAULT_SPEC=spawn_fail:0.3,seed:7``):
                          lane (-1 = any lane, the default) — the chaos e2e
                          wedges one lane while proving the other keeps
                          serving.
+    attach_hang_max:<n>  at most n hosts ever wedge (0 = unlimited): with
+                         rate 1.0 this wedges exactly the FIRST n hosts a
+                         probe touches, so a recovery test can wedge one
+                         host deterministically while its dispose-and-
+                         replace successor comes up clean.
+    attach_hang_recover:<n> a wedged host's hang CLEARS after n wedged
+                         /device-stats draws (0 = never, the default):
+                         later probes pass through to the real stats.
+                         This is the chaos-testable shape of a host that
+                         relapses and then recovers — the re-admission
+                         streak (clean probes after a fence) and its
+                         suspect-relapse reset become drivable from a
+                         seeded spec instead of hand-faked responses.
     seed:<int>           the plan seed (default 0)
 
 Rates are in [0, 1]; delays are seconds. Unknown keys fail loudly — a typo'd
@@ -81,6 +94,8 @@ class FaultSpec:
     violation_kind: str = "oom"
     attach_hang: float = 0.0
     attach_hang_lane: int = -1
+    attach_hang_max: int = 0
+    attach_hang_recover: int = 0
     seed: int = 0
 
     @classmethod
@@ -101,7 +116,12 @@ class FaultSpec:
                     f"{sorted(known)} as key:value"
                 )
             try:
-                if key in ("seed", "attach_hang_lane"):
+                if key in (
+                    "seed",
+                    "attach_hang_lane",
+                    "attach_hang_max",
+                    "attach_hang_recover",
+                ):
                     values[key] = int(raw)
                 elif key == "violation_kind":
                     values[key] = raw.strip()
@@ -131,7 +151,14 @@ class FaultSpec:
         return any(
             getattr(self, f.name)
             for f in fields(self)
-            if f.name not in ("seed", "violation_kind", "attach_hang_lane")
+            if f.name
+            not in (
+                "seed",
+                "violation_kind",
+                "attach_hang_lane",
+                "attach_hang_max",
+                "attach_hang_recover",
+            )
         )
 
 
@@ -211,6 +238,8 @@ class AttachHangTransport(httpx.AsyncBaseTransport):
         on_fault: Callable[[str], None] | None = None,
         inner: httpx.AsyncBaseTransport | None = None,
         clock: Callable[[], float] = time.monotonic,
+        max_hosts: int = 0,
+        recover_draws: int = 0,
     ) -> None:
         self.rate = rate
         self.lane = lane
@@ -222,21 +251,42 @@ class AttachHangTransport(httpx.AsyncBaseTransport):
         self.on_fault = on_fault
         self.inner = inner or httpx.AsyncHTTPTransport()
         self.clock = clock
+        # At most this many hosts ever wedge (0 = unlimited): with rate 1.0
+        # the FIRST max_hosts probed hosts wedge deterministically and the
+        # dispose-and-replace successors come up clean — the recovery e2e's
+        # wedge-one-host shape.
+        self.max_hosts = max_hosts
+        # A wedged host's hang clears after this many wedged stats draws
+        # (0 = never): the chaos-testable relapse-then-recover host the
+        # re-admission streak needs.
+        self.recover_draws = recover_draws
         # "host:port" -> hang start (clock), or None for hosts that drew a
-        # pass. One draw per host, remembered forever — a wedge does not
-        # flicker.
+        # pass. One draw per host, remembered — a wedge does not flicker
+        # (with recover_draws set it can only CLEAR, once, for good).
         self._hangs: dict[str, float | None] = {}
+        self._wedged_draws: dict[str, int] = {}
 
     def _hang_started(self, request) -> float | None:
         key = f"{request.url.host}:{request.url.port}"
         if key not in self._hangs:
             lane = self.host_lanes.get(key)
             eligible = self.lane < 0 or (lane is not None and lane == self.lane)
+            if eligible and self.max_hosts > 0:
+                wedged_hosts = sum(
+                    1 for start in self._hangs.values() if start is not None
+                )
+                eligible = wedged_hosts < self.max_hosts
             wedged = eligible and self.rng.random() < self.rate
             self._hangs[key] = self.clock() if wedged else None
             if wedged and self.on_fault is not None:
                 self.on_fault(ATTACH_HANG)
-        return self._hangs[key]
+        started = self._hangs[key]
+        if started is not None and self.recover_draws > 0:
+            draws = self._wedged_draws.get(key, 0)
+            if draws >= self.recover_draws:
+                return None  # the hang cleared: real stats from here on
+            self._wedged_draws[key] = draws + 1
+        return started
 
     async def handle_async_request(self, request):
         if (
@@ -357,6 +407,13 @@ class FaultInjectingBackend(SandboxBackend):
         scope = getattr(self.inner, "compile_cache_dir_scope", None)
         return scope if scope in ("private", "shared") else "external"
 
+    @property
+    def supports_lease_push(self) -> bool:
+        """Whether this backend's sandboxes are real HTTP hosts the lease
+        token can be POSTed to — delegated (the in-memory test fake says
+        no, so chaos runs stay deterministic)."""
+        return getattr(self.inner, "supports_lease_push", True)
+
     def _fire(self, name: str, rate: float) -> bool:
         if rate <= 0.0 or self._rngs[name].random() >= rate:
             return False
@@ -427,5 +484,7 @@ class FaultInjectingBackend(SandboxBackend):
                 self._host_lanes,
                 self.on_fault,
                 inner=transport,
+                max_hosts=self.spec.attach_hang_max,
+                recover_draws=self.spec.attach_hang_recover,
             )
         return transport

@@ -64,7 +64,9 @@ from .errors import (  # noqa: F401 — canonical home is errors.py; re-exported
     QueueDepthError,
     QuotaExceededError,
     SessionLimitError,
+    StaleLeaseError,
 )
+from .leases import Lease, LeaseRegistry
 from .limits import VIOLATION_KINDS, request_limits, validate_config_limits
 from .quotas import QuotaEnforcer, QuotaVerdict
 from .scheduler import SandboxScheduler
@@ -318,6 +320,20 @@ class CodeExecutor:
         # LocalSandboxBackend._fresh_cache_epoch). Pre-warm runs before
         # tenant load, so the store still fills in the trusted-only epoch.
         self._shared_cache_tainted = False
+        # Per-chip lease fencing (services/leases.py): every spawn mints a
+        # monotonic generation token per lease scope (the physical chip-set
+        # — backend lease_scope, or the lane); a wedged verdict revokes the
+        # lease (on_host_wedged → fence_host), so a stale claim can never
+        # re-wedge a successor's chips. Fenced scopes re-admit only after
+        # the configured clean-probe streak.
+        self.leases = LeaseRegistry(
+            readmit_streak=self.config.device_probe_readmit_streak,
+            clock=self.scheduler.now,
+        )
+        # Actuation budget: fence timestamps per lane — at most
+        # device_fence_max_per_window actuations per window, so a probe
+        # false-positive storm cannot mass-dispose a serving lane.
+        self._fence_times: dict[int, deque[float]] = {}
         # Telemetry-plane attachments (set by the application context): the
         # device-health probe daemon and the OTLP exporter, surfaced through
         # GET /statusz. Optional — the executor runs fine without either.
@@ -407,18 +423,43 @@ class CodeExecutor:
     def _pool(self, chip_count: int) -> deque[Sandbox]:
         return self._pools.setdefault(chip_count, deque())
 
+    # Sandbox device-health marks that disqualify a pooled host from
+    # SERVING: wedged (device plane dead), draining (fenced, dispose in
+    # flight), recovering (on a fenced scope, still earning its clean-probe
+    # streak).
+    _UNSERVABLE_HEALTH = frozenset({"wedged", "draining", "recovering"})
+
     def _pool_supply(self, chip_count: int) -> int:
-        """Pooled sandboxes that can actually serve: hosts the device-health
-        probe marked WEDGED still sit in the deque (drain/fencing is the
-        ROADMAP actuation item) but must not count as supply — a lane of
-        wedged warm pods would otherwise read "full" and never refill."""
+        """Pooled sandboxes that can actually serve. Wedged hosts hold a
+        deque slot until the fencing actuator drains them (or, with the
+        actuation kill switch off, until an operator does); draining and
+        recovering hosts are quarantined by design — none of them count as
+        supply, or a lane of zombies would read "full" and never refill."""
         pool = self._pools.get(chip_count)
         if not pool:
             return 0
         return sum(
             1
             for sandbox in pool
-            if sandbox.meta.get("device_health") != "wedged"
+            if sandbox.meta.get("device_health") not in self._UNSERVABLE_HEALTH
+        )
+
+    def _pool_standby(self, chip_count: int) -> int:
+        """Pooled RECOVERING hosts: supply-in-transit, like an in-flight
+        spawn — they hold their physical chips and will serve once the
+        clean-probe streak re-admits them, so refills must count them
+        (spawning replacements for hosts that are about to re-admit would
+        stampede the backend and, on a constrained lane, deadlock on the
+        chips the recovering host still owns). Wedged/draining hosts are
+        NOT standby: their chips are being reclaimed, and the refill that
+        replaces them is exactly the point."""
+        pool = self._pools.get(chip_count)
+        if not pool:
+            return 0
+        return sum(
+            1
+            for sandbox in pool
+            if sandbox.meta.get("device_health") == "recovering"
         )
 
     def _known_lanes(self) -> set[int]:
@@ -447,8 +488,20 @@ class CodeExecutor:
             ),
             pooled=self._pool_supply(chip_count),
             spawning=self._spawning.get(chip_count, 0),
+            recovering=self._pool_standby(chip_count),
+            draining=self._draining_count(chip_count),
             queue_wait_ewma=self.scheduler.queue_wait_ewma(chip_count),
             spawn_ewma=self.scheduler.spawn_ewma(chip_count),
+        )
+
+    def _draining_count(self, chip_count: int) -> int:
+        """LIVE fenced hosts of the lane still being disposed (pooled or
+        not): the /healthz + snapshot observability of an in-flight
+        drain-and-replace."""
+        return sum(
+            1
+            for lane, sandbox in self._live_sandboxes.values()
+            if lane == chip_count and sandbox.meta.get("lease_fenced")
         )
 
     def _lane_capacity(self, chip_count: int) -> int | None:
@@ -537,10 +590,17 @@ class CodeExecutor:
             else 0
         )
         spawning = self._spawning.get(chip_count, 0)
-        # Supply counts only non-wedged pooled hosts: wedged ones hold the
-        # deque slot but can't serve, so the lane must keep refilling past
-        # them (their disposal is the fencing layer's job).
-        missing = target - self._pool_supply(chip_count) - spawning - in_use
+        # Supply counts only servable pooled hosts (wedged/draining zombies
+        # must be refilled past — their disposal is the fencing actuator's
+        # job), plus recovering standby (due to re-admit; spawning past
+        # them would overshoot and fight them for chips).
+        missing = (
+            target
+            - self._pool_supply(chip_count)
+            - self._pool_standby(chip_count)
+            - spawning
+            - in_use
+        )
         if missing <= 0:
             return
         # Cap CONCURRENT refill spawns per lane: a large target jump
@@ -594,6 +654,7 @@ class CodeExecutor:
             and succeeded > 0
             and not self._closed
             and self._pool_supply(chip_count)
+            + self._pool_standby(chip_count)
             + self._spawning.get(chip_count, 0)
             + (
                 self._in_use.get(chip_count, 0)
@@ -655,6 +716,16 @@ class CodeExecutor:
             # Feed the scheduler's spawn-latency EWMA: one input to
             # deadline-aware admission when the warm pool is empty.
             self.scheduler.observe_spawn(chip_count, elapsed)
+            # Per-chip lease FIRST: mint this sandbox's generation token
+            # and push it to every host's executor before the sandbox
+            # becomes visible anywhere — a stale-generation claim against
+            # these chips must be distinguishable from the host's first
+            # observable instant, not after a push races the first
+            # dispatch. If the scope is recovering (the predecessor was
+            # fenced), the replacement starts quarantined: probed, counted
+            # as standby, handed nothing until the clean-probe streak
+            # re-admits it.
+            await self._attach_lease(sandbox, chip_count)
             # Register with the live-host inventory the probe daemon walks
             # (dropped again in _dispose).
             self._live_sandboxes[sandbox.id] = (chip_count, sandbox)
@@ -707,6 +778,244 @@ class CodeExecutor:
                 chip_count,
             )
             await asyncio.gather(*(self._dispose(s) for s in evicted))
+
+    # ------------------------------------------------- lease fencing & wedge
+    # recovery: the actuation half of the device-health story. The probe
+    # daemon detects (PR 8); these methods act — lease revocation, lane
+    # drain, dispose-and-replace, and the recovering-scope quarantine.
+
+    def _lease_scope(self, chip_count: int) -> str:
+        """The lease scope a lane's sandboxes attach on: the backend's own
+        hardware naming when it has one (`lease_scope(chip_count)`), else
+        the chip-count lane — which on the local backend IS the chip-set
+        (every warm sandbox holds the same physical TPU). Scopes name
+        hardware, not sandboxes: that is what lets "the replacement on the
+        same chips must re-earn trust" be expressed at all."""
+        scope_fn = getattr(self.backend, "lease_scope", None)
+        if scope_fn is not None:
+            scope = scope_fn(chip_count)
+            if isinstance(scope, str) and scope:
+                return scope
+        return f"lane-{chip_count}"
+
+    async def _attach_lease(self, sandbox: Sandbox, chip_count: int) -> None:
+        """Mint the sandbox's generation token and record it on every host
+        executor (POST /lease). Best-effort on the wire: an old binary
+        (404) or a transient failure leaves the host without executor-side
+        enforcement — the control-plane revocation check still fences it —
+        and never fails a spawn."""
+        scope = self._lease_scope(chip_count)
+        lease = self.leases.mint(scope, sandbox.id)
+        sandbox.meta["lease"] = lease
+        if self.leases.recovering(scope):
+            sandbox.meta["device_health"] = "recovering"
+        if not self.config.device_fence_enabled:
+            return
+        # Backends whose sandboxes are not real HTTP hosts (the in-memory
+        # test fake) opt out of the wire push: minting stays (the
+        # control-plane revocation check needs no wire), and skipping the
+        # doomed POSTs keeps the seeded chaos suites' interleaving
+        # deterministic — real-socket connect failures would re-deal which
+        # request consumes which fault draw between runs.
+        if getattr(self.backend, "supports_lease_push", True) is False:
+            return
+        client = self._http_client()
+
+        async def push(url: str) -> None:
+            try:
+                await client.post(
+                    f"{url}/lease",
+                    json={"token": lease.wire_token},
+                    timeout=5.0,
+                )
+            except httpx.HTTPError:
+                logger.debug(
+                    "lease push to %s failed (control-plane fencing still "
+                    "covers it)",
+                    url,
+                )
+
+        await asyncio.gather(*(push(url) for url in sandbox.host_urls))
+
+    def _check_lease(self, sandbox: Sandbox) -> None:
+        """Refuse to dispatch against a revoked lease: the fence landed
+        while this request held (or was about to use) the sandbox. A clean
+        refusal BEFORE the wire hop — the fenced host's device plane never
+        sees the claim, the stateless retry ladder replays on a fresh
+        sandbox, and a session gets the standard typed close."""
+        lease = sandbox.meta.get("lease")
+        if isinstance(lease, Lease) and lease.revoked:
+            raise StaleLeaseError(
+                f"sandbox {sandbox.id} lease {lease.wire_token} was fenced "
+                f"({lease.revoke_reason or 'wedged'}); the request must "
+                "move to a healthy host",
+                scope=lease.scope,
+            )
+
+    def _wire_headers(self, sandbox: Sandbox) -> dict | None:
+        """Headers for a sandbox execute hop: trace propagation plus the
+        sandbox's lease token — the executor rejects a token older than
+        the one it holds with the typed 409 before taking any lock."""
+        headers = self._trace_headers() or {}
+        lease = sandbox.meta.get("lease")
+        if isinstance(lease, Lease):
+            headers["x-lease-token"] = lease.wire_token
+        return headers or None
+
+    @staticmethod
+    def _raise_if_stale_lease(resp, sandbox: Sandbox) -> None:
+        """Map the executor's typed ``409 stale_lease`` refusal to
+        StaleLeaseError (409 also means other things on other routes —
+        only the typed body counts)."""
+        if resp.status_code != 409:
+            return
+        try:
+            body = resp.json()
+        except ValueError:
+            return
+        if isinstance(body, dict) and body.get("error") == "stale_lease":
+            raise StaleLeaseError(
+                f"sandbox {sandbox.id} rejected a stale lease claim "
+                f"(held {body.get('held')!r}, offered {body.get('offered')!r})"
+            )
+
+    def _fence_budget_ok(self, lane: int) -> bool:
+        """The actuation budget: admit this fence only if the lane has
+        fenced fewer than the cap inside the sliding window. The cap is
+        what keeps a probe false-positive storm from mass-disposing a
+        serving lane — past it, verdicts defer (and re-assert each probe
+        cycle) until the window slides."""
+        cap = self.config.device_fence_max_per_window
+        if cap <= 0:
+            return True
+        window = max(1.0, self.config.device_fence_window_seconds)
+        now = self.scheduler.now()
+        times = self._fence_times.setdefault(lane, deque())
+        while times and times[0] <= now - window:
+            times.popleft()
+        if len(times) >= cap:
+            return False
+        times.append(now)
+        return True
+
+    def on_host_wedged(self, sandbox_id: str, *, reason: str = "wedged") -> None:
+        """The probe daemon's actuation hook: schedule fence-and-replace
+        for a wedged host, off the probe cycle (disposal can block on a
+        wedged process's kill). Idempotent per sandbox — the probe
+        re-asserts every cycle and this dedupes on the fence mark."""
+        if not self.config.device_fence_enabled or self._closed:
+            return
+        entry = self._live_sandboxes.get(sandbox_id)
+        if entry is None or entry[1].meta.get("lease_fenced"):
+            return
+        task = asyncio.get_running_loop().create_task(
+            self._off_request_path(self.fence_host(sandbox_id, reason=reason))
+        )
+        self._dispose_tasks.add(task)
+        task.add_done_callback(self._dispose_tasks.discard)
+
+    async def fence_host(self, sandbox_id: str, *, reason: str = "wedged") -> str:
+        """Fence one wedged host and replace it: revoke its lease (stale
+        claims die typed), drain it from the lane (pool slot freed, parked
+        sessions closed so their clients reconnect to healthy hosts,
+        in-flight requests keep the existing fault/serial-fallback
+        semantics when the dispose cuts them off), dispose it through the
+        standard path, and refill the lane. Returns the outcome (also the
+        device_fence_total label): fenced / already_fenced / gone /
+        breaker_open / budget_exhausted / disabled."""
+        if not self.config.device_fence_enabled:
+            return "disabled"
+        entry = self._live_sandboxes.get(sandbox_id)
+        if entry is None:
+            return "gone"
+        lane, sandbox = entry
+        if sandbox.meta.get("lease_fenced"):
+            return "already_fenced"
+        if self.breakers.is_open(lane):
+            # The lane cannot spawn replacements while its breaker is open:
+            # disposing supply now would deepen the outage for zero gain.
+            # The verdict stands and re-asserts after the cooldown.
+            self.metrics.device_fences.inc(
+                lane=str(lane), outcome="breaker_open"
+            )
+            return "breaker_open"
+        if not self._fence_budget_ok(lane):
+            self.metrics.device_fences.inc(
+                lane=str(lane), outcome="budget_exhausted"
+            )
+            logger.warning(
+                "wedge actuation deferred (lane=%d sandbox=%s): fence "
+                "budget exhausted (%d per %.0fs) — probe storm suspected",
+                lane,
+                sandbox_id,
+                self.config.device_fence_max_per_window,
+                self.config.device_fence_window_seconds,
+            )
+            return "budget_exhausted"
+        # Commit: mark first (the dedupe + the probe's DRAINING overlay +
+        # the turnover guard all key off this), then revoke the lease so
+        # every dispatch path refuses the host from this instant.
+        sandbox.meta["lease_fenced"] = True
+        sandbox.meta["device_health"] = "draining"
+        lease = sandbox.meta.get("lease")
+        if isinstance(lease, Lease):
+            self.leases.fence(lease, reason=reason)
+        # Drain: free the pool slot (queued work reroutes via the
+        # scheduler's kicks once the replacement lands)...
+        pool = self._pools.get(lane)
+        if pool is not None:
+            try:
+                pool.remove(sandbox)
+            except ValueError:
+                pass
+        # ...and close any session parked on this host NOW, not when the
+        # client times out: the session's next request recreates against a
+        # healthy host (session_seq=1 reports the state loss), instead of
+        # dispatching into the wedge and hanging out its timeout.
+        for executor_id, session in list(self._sessions.items()):
+            if session.sandbox is sandbox and not session.closed:
+                logger.warning(
+                    "session %s force-closed: its host %s was fenced (%s)",
+                    executor_id,
+                    sandbox.id,
+                    reason,
+                )
+                self._end_session_soon(executor_id, session, recycle=False)
+        self.metrics.device_fences.inc(lane=str(lane), outcome="fenced")
+        self.tracer.record_span(
+            "device_fence",
+            trace_id=tracing.new_trace_id(),
+            parent_id=None,
+            start_unix=time.time(),
+            duration_s=0.0,
+            attributes={
+                "lane": lane,
+                "sandbox": sandbox.id,
+                "reason": reason,
+                "scope": lease.scope if isinstance(lease, Lease) else "",
+                "generation": (
+                    lease.generation if isinstance(lease, Lease) else 0
+                ),
+            },
+            status="error",
+        )
+        logger.warning(
+            "fenced wedged host (lane=%d sandbox=%s reason=%s): lease "
+            "revoked, draining and replacing",
+            lane,
+            sandbox.id,
+            reason,
+        )
+        # Dispose-and-replace: the standard dispose path (idempotent with
+        # any in-flight release — backend.delete tolerates repeats), then
+        # the standard refill machinery. An in-flight request on this host
+        # loses its connection mid-op and surfaces through the existing
+        # fault semantics; its own release finds the sandbox unservable
+        # and no-ops.
+        await self._dispose(sandbox)
+        self._notify_lane(lane)
+        self.fill_pool_soon(lane)
+        return "fenced"
 
     async def _acquire(
         self,
@@ -845,7 +1154,14 @@ class CodeExecutor:
                     )
                 if granted and pool:
                     sandbox = self._pop_pool_sandbox(pool)
-                    break
+                    if sandbox is not None:
+                        break
+                    # Pool holds only recovering/draining quarantined hosts:
+                    # nothing servable to pop — fall through to the
+                    # spawn-vs-wait logic (which counts those hosts as
+                    # standby on constrained lanes, so the waiter parks
+                    # until re-admission kicks it rather than fighting the
+                    # quarantined host for its chips).
                 if (
                     self.breakers.is_open(chip_count)
                     and spawning == 0
@@ -863,8 +1179,17 @@ class CodeExecutor:
                     # Session-held sandboxes count ACROSS constrained lanes
                     # (shared physical substrate, as in the eviction logic):
                     # they own their chips until the session closes (the
-                    # idle sweep bounds this).
-                    can_spawn = spawning + in_use + session_held < capacity
+                    # idle sweep bounds this). Recovering standby hosts
+                    # count too: they hold their chips through the
+                    # quarantine, and the re-admission settle kicks every
+                    # lane the moment they can serve.
+                    can_spawn = (
+                        spawning
+                        + in_use
+                        + session_held
+                        + self._pool_standby(chip_count)
+                        < capacity
+                    )
                 else:
                     # Unconstrained lane: sandboxes "due back" are in-flight
                     # refills plus (with reuse on) in-use sandboxes that will
@@ -917,25 +1242,33 @@ class CodeExecutor:
         self.fill_pool_soon(chip_count)
         return sandbox
 
-    def _pop_pool_sandbox(self, pool: deque) -> Sandbox:
+    def _pop_pool_sandbox(self, pool: deque) -> Sandbox | None:
         """Pop the next pooled sandbox for the current request, skipping
         hosts the device-health probe marked WEDGED while anything
         healthier is available (handing a fresh request to a wedged device
-        buys a full acquire-budget hang; the wedged host stays pooled for
-        the fencing layer). Trusted (pre-warm) requests additionally
-        prefer an UNTAINTED one: their whole point is producing
-        harvestable artifacts, and a recycled sandbox that ever ran tenant
-        code is harvest-ineligible for life — running the trusted kernels
-        there compiles fine but admits nothing. Preferences, not
-        requirements: when every pooled sandbox is tainted/wedged the
-        leftmost fallback is returned anyway (stalling the acquire to wait
-        for a better spawn could livelock a constrained lane; the pre-warm
-        pass instead detects the empty store and retries — see
-        _prewarm_compile_cache)."""
+        buys a full acquire-budget hang). RECOVERING/DRAINING hosts are
+        never popped at all — a fenced scope's replacement must finish its
+        clean-probe streak before it serves, and that gate is only real if
+        no "last resort" hands it out early; when the pool holds nothing
+        else the method returns None and the caller falls through to its
+        spawn-vs-wait logic (bounded: the re-admission settle kicks every
+        lane). Trusted (pre-warm) requests additionally prefer an
+        UNTAINTED sandbox: a recycled sandbox that ever ran tenant code is
+        harvest-ineligible for life — running the trusted kernels there
+        compiles fine but admits nothing. Wedged-as-last-resort is kept
+        for kill-switch parity (with actuation off, a lane whose only
+        pooled hosts are wedged zombies must still hand something out
+        rather than livelock a constrained lane, the PR 8 behavior)."""
         prefer_untainted = self.compile_cache.enabled and _trusted_source_var.get()
         fallback: int | None = None
+        wedged_fallback: int | None = None
         for i, candidate in enumerate(pool):
-            if candidate.meta.get("device_health") == "wedged":
+            health = candidate.meta.get("device_health")
+            if health in ("recovering", "draining"):
+                continue
+            if health == "wedged":
+                if wedged_fallback is None:
+                    wedged_fallback = i
                 continue
             if prefer_untainted and self._cache_sync(candidate).tainted:
                 if fallback is None:
@@ -943,11 +1276,12 @@ class CodeExecutor:
                 continue
             del pool[i]
             return candidate
-        if fallback is not None:
-            candidate = pool[fallback]
-            del pool[fallback]
-            return candidate
-        return pool.popleft()
+        for index in (fallback, wedged_fallback):
+            if index is not None:
+                candidate = pool[index]
+                del pool[index]
+                return candidate
+        return None
 
     # --------------------------------------------------------------- execute
 
@@ -1002,7 +1336,10 @@ class CodeExecutor:
         # quarantined) request is never enqueued and consumes zero
         # sandboxes; the typed QuotaExceededError maps to HTTP 429 /
         # gRPC RESOURCE_EXHAUSTED with Retry-After + x-quota-* metadata.
-        quota = self._quota_admit(usage_tenant)
+        # The declared cost rides along for the predicted-overrun check.
+        quota = self._quota_admit(
+            usage_tenant, chip_count=chip_count, timeout=timeout
+        )
         self._inflight += 1
         try:
             if executor_id is not None:
@@ -1077,17 +1414,58 @@ class CodeExecutor:
         )
         return result
 
-    def _quota_admit(self, usage_tenant: str | None) -> QuotaVerdict | None:
+    def _quota_admit(
+        self,
+        usage_tenant: str | None,
+        *,
+        chip_count: int | None = None,
+        timeout: float | None = None,
+    ) -> QuotaVerdict | None:
         """Run the quota gate and keep the rejection observable: a quota
         denial is a rejected request on the dashboards and in the tenant's
         ledger row (requests-by-outcome), exactly like a scheduler shed —
-        but it never touches the scheduler."""
+        but it never touches the scheduler. The request's DECLARED cost
+        (chip_count x clamped timeout) rides along so the gate can deny a
+        predicted overrun before the burn (typed reason=predicted_overrun),
+        not after it."""
         try:
-            return self.quotas.admit(usage_tenant)
+            return self.quotas.admit(
+                usage_tenant,
+                predicted_chip_seconds=self._predicted_chip_seconds(
+                    chip_count, timeout
+                ),
+            )
         except QuotaExceededError:
             self.metrics.executions.inc(outcome="rejected")
             self._usage_request(usage_tenant, "rejected")
             raise
+
+    def _predicted_chip_seconds(
+        self, chip_count: int | None, timeout: float | None
+    ) -> float:
+        """The request's worst-case bill AS DECLARED: chips x the clamped
+        timeout the CLIENT declared. A request that declares no timeout
+        predicts 0 — the server-side default (60s) is not something the
+        client said, and gating on it would permanently deny every tenant
+        whose window budget is under chips x 60 regardless of what its
+        runs actually cost (those tenants keep the deny-after-the-burn
+        semantics). Clamps mirror _validate_request; malformed inputs
+        predict 0 (their own validation error owns them, not a quota
+        denial)."""
+        if timeout is None:
+            return 0.0
+        try:
+            lane = (
+                self.config.default_chip_count
+                if chip_count is None
+                else int(chip_count)
+            )
+            clamped = min(float(timeout), self.config.max_execution_timeout)
+        except (TypeError, ValueError):
+            return 0.0
+        if clamped <= 0:
+            return 0.0
+        return max(1, lane) * clamped
 
     def _apply_quota_phases(
         self, result: Result, quota: QuotaVerdict | None
@@ -1429,6 +1807,7 @@ class CodeExecutor:
         Returns one outcome per job: a Result, or a LimitExceededError for
         a job whose IN-PROCESS guard fired (its batchmates' results stay
         clean). Batch-level faults raise instead — the caller falls back."""
+        self._check_lease(sandbox)
         client = self._http_client()
         if self.compile_cache.enabled:
             # Tenant code is about to run: same provenance taint as the
@@ -1649,12 +2028,16 @@ class CodeExecutor:
             resp = await client.post(
                 f"{base}/execute-batch",
                 json=payload,
+                headers=self._wire_headers(sandbox),
                 timeout=httpx.Timeout(timeout + 30.0),
             )
         except httpx.HTTPError as e:
             raise ExecutorError(
                 f"sandbox {sandbox.id} ({base}) unreachable: {e}"
             )
+        # 409 on this route ALSO means "no warm runner" (serial-fallback
+        # refusal); only the typed stale_lease body raises the lease error.
+        self._raise_if_stale_lease(resp, sandbox)
         if resp.status_code != 200:
             # 404 = old binary without the route, 409 = no warm runner:
             # either way the serial path is the answer. The server
@@ -1905,6 +2288,10 @@ class CodeExecutor:
         full response body). Peers of a multi-host slice never stream — host
         0 is the coordinator and, per JAX convention, does the singular side
         effects worth watching live."""
+        # Lease gate before ANY wire traffic: a fence that landed while
+        # this request held the sandbox refuses here, cleanly, instead of
+        # dispatching into (or racing) the wedged device plane.
+        self._check_lease(sandbox)
         client = self._http_client()
         if self.compile_cache.enabled and not _trusted_source_var.get():
             # Tenant code is about to run (or try to): this sandbox's cache
@@ -2227,7 +2614,9 @@ class CodeExecutor:
         self._check_admission_open()
         # Same quota gate as execute(): a denial surfaces before the first
         # stream event (the HTTP layer still returns a clean 429).
-        quota = self._quota_admit(usage_tenant)
+        quota = self._quota_admit(
+            usage_tenant, chip_count=chip_count, timeout=timeout
+        )
         queue: asyncio.Queue = asyncio.Queue()
         done = object()
 
@@ -2838,7 +3227,7 @@ class CodeExecutor:
                 "POST",
                 f"{base}/execute/stream",
                 json=payload,
-                headers=self._trace_headers(),
+                headers=self._wire_headers(sandbox),
                 timeout=httpx.Timeout(timeout + 30.0, read=timeout + 30.0),
             ) as resp:
                 if resp.status_code == 403:
@@ -2853,6 +3242,21 @@ class CodeExecutor:
                     raise ValueError(message)
                 if resp.status_code != 200:
                     text = (await resp.aread()).decode(errors="replace")
+                    if resp.status_code == 409:
+                        # The typed stale-lease refusal, stream flavor.
+                        try:
+                            body = json.loads(text)
+                        except ValueError:
+                            body = None
+                        if (
+                            isinstance(body, dict)
+                            and body.get("error") == "stale_lease"
+                        ):
+                            raise StaleLeaseError(
+                                f"sandbox {sandbox.id} rejected a stale "
+                                f"lease claim (held {body.get('held')!r}, "
+                                f"offered {body.get('offered')!r})"
+                            )
                     # Refusal before any run — exempt from fault billing
                     # like _post_execute's non-200 path.
                     error = ExecutorError(
@@ -2908,13 +3312,17 @@ class CodeExecutor:
             resp = await client.post(
                 f"{base}/execute",
                 json=payload,
-                headers=self._trace_headers(),
+                headers=self._wire_headers(sandbox),
                 timeout=httpx.Timeout(timeout + 30.0),
             )
         except httpx.HTTPError as e:
             raise ExecutorError(f"sandbox {sandbox.id} ({base}) unreachable: {e}")
         if resp.status_code == 403:
             raise ValueError(resp.json().get("error", "forbidden path"))
+        # The executor's typed stale-lease refusal: this claim's generation
+        # was fenced and a successor holds the chips — never retried
+        # against this host (the retry ladder acquires a fresh sandbox).
+        self._raise_if_stale_lease(resp, sandbox)
         if resp.status_code != 200:
             # A non-200 from /execute is a refusal BEFORE any run (the
             # executor returns 200 even for violations and timeouts):
@@ -3377,6 +3785,11 @@ class CodeExecutor:
                 recyclable
                 and not self._closed
                 and self.config.executor_reuse_sandboxes
+                # A fenced host never recycles: its lease is revoked and
+                # its process is being (or has been) disposed — pooling it
+                # would hand requests a host whose every dispatch dies on
+                # the stale-lease check.
+                and not sandbox.meta.get("lease_fenced")
                 # Recycle only while the pool is short of SUPPLY: under a
                 # concurrency burst on an unconstrained lane, many
                 # in-flight sandboxes release at once and the surplus must
@@ -3506,6 +3919,18 @@ class CodeExecutor:
             body["device_health"] = self.device_health.snapshot()
         else:
             body["device_health"] = {"enabled": False}
+        # The wedge-recovery actuation state: lease generations per scope,
+        # in-flight re-admission streaks, fence/readmission totals, and
+        # the actuation budget — "is the detect→act loop closing, and is
+        # anything quarantined right now?".
+        body["recovery"] = {
+            "fencing_enabled": self.config.device_fence_enabled,
+            "fence_budget": {
+                "max_per_window": self.config.device_fence_max_per_window,
+                "window_seconds": self.config.device_fence_window_seconds,
+            },
+            **self.leases.snapshot(),
+        }
         if self.otlp_exporter is not None:
             body["otlp"] = {"enabled": True, **self.otlp_exporter.stats()}
         else:
@@ -3584,7 +4009,8 @@ class CodeExecutor:
                 snapshot.in_use if self.config.executor_reuse_sandboxes else 0
             )
             if (
-                snapshot.pooled + snapshot.spawning + in_use < target
+                snapshot.pooled + snapshot.spawning + snapshot.recovering
+                + in_use < target
                 and not self.breakers.is_open(lane)
             ):
                 # Spawn-ahead: the target says this lane needs more warm
@@ -3612,7 +4038,8 @@ class CodeExecutor:
             (
                 sandbox
                 for sandbox in pool
-                if sandbox.meta.get("device_health") != "wedged"
+                if sandbox.meta.get("device_health")
+                not in self._UNSERVABLE_HEALTH
                 and now - float(sandbox.meta.get("pooled_at", now))
                 >= idle_after
             ),
@@ -3659,20 +4086,36 @@ class CodeExecutor:
             self.autoscale_sweep, interval, "autoscale sweep"
         )
 
-    def lane_supply(self) -> dict[str, dict[str, float]]:
+    def lane_supply(self) -> dict[str, dict]:
         """Per-lane SUPPLY joined into GET /healthz next to the demand
         stats it already shows (queue depth / wait EWMA): the dynamic pool
         target and what currently backs it — so an operator can see supply
-        next to the signals driving it without a /statusz round-trip."""
-        return {
-            str(lane): {
+        next to the signals driving it without a /statusz round-trip.
+        With the probe daemon attached, each row also carries the lane's
+        device-health census (healthy/busy/suspect/wedged/recovering/
+        draining counts — the wedge-recovery satellite: a fenced lane's
+        quarantine is visible exactly where its queue pressure is)."""
+        census: dict[int, dict[str, int]] = {}
+        if self.device_health is not None:
+            census = self.device_health.lane_census()
+        rows: dict[str, dict] = {}
+        for lane in sorted(self._known_lanes() | set(census)):
+            row: dict = {
                 "pool_target": self._lane_target(lane),
                 "pooled": self._pool_supply(lane),
                 "in_use": self._in_use.get(lane, 0),
                 "spawning": self._spawning.get(lane, 0),
             }
-            for lane in sorted(self._known_lanes())
-        }
+            recovering = self._pool_standby(lane)
+            draining = self._draining_count(lane)
+            if recovering:
+                row["recovering"] = recovering
+            if draining:
+                row["draining"] = draining
+            if lane in census:
+                row["device_health"] = census[lane]
+            rows[str(lane)] = row
+        return rows
 
     def start_compile_cache_prewarm(self) -> asyncio.Task | None:
         """Pre-warm the fleet compile-cache store from the examples/ kernel

@@ -6,10 +6,15 @@ mid-device-op SIGKILL, with ``/healthz`` answering "ok" the whole time —
 nothing distinguished *busy* from *wedged*, and the recovery story was an
 operator ssh-ing into a watcher script (``scripts/onchip_watch.sh``). The
 ROADMAP's fencing item needs observation before it can get actuation; this
-daemon is that observation layer. **Detection only**: a ``wedged`` verdict
-marks the host (``sandbox.meta["device_health"]``), fires
-``device_wedge_detected_total``, and records a transition trace — the
-drain/dispose/fence actuation belongs to the fencing PR this de-risks.
+daemon is that observation layer. A ``wedged`` verdict marks the host
+(``sandbox.meta["device_health"]``), fires ``device_wedge_detected_total``,
+records a transition trace — and now ACTS: the verdict is handed to the
+executor's fencing actuator (``CodeExecutor.on_host_wedged`` — lease
+revocation, lane drain, dispose-and-replace; every safety bound lives
+there), and hosts on a fenced scope ride the ``recovering`` →
+re-admission state machine here (``_recovery_overlay``): probed but
+serving nothing until ``APP_DEVICE_PROBE_READMIT_STREAK`` consecutive
+clean cycles, with suspect relapse resetting the streak.
 
 Mechanics: every ``APP_DEVICE_PROBE_INTERVAL`` seconds, one cycle samples
 ``GET /device-stats`` on every live sandbox host (the executor's registry —
@@ -59,9 +64,17 @@ logger = logging.getLogger(__name__)
 
 HEALTHY = "healthy"
 BUSY = "busy"
+# The two actuation states the fencing layer added on top of PR 8's four
+# classifications. RECOVERING: the host sits on a fenced lease scope and
+# probes clean, but has not yet shown the configured consecutive-clean
+# streak — it is probed and counted, never handed a request. DRAINING: the
+# actuator fenced this host (lease revoked, drain + dispose in flight);
+# the state is terminal — the host leaves the table when disposal lands.
+RECOVERING = "recovering"
 SUSPECT = "suspect"
 WEDGED = "wedged"
-STATES = (HEALTHY, BUSY, SUSPECT, WEDGED)
+DRAINING = "draining"
+STATES = (HEALTHY, BUSY, RECOVERING, SUSPECT, WEDGED, DRAINING)
 
 # Severity order for "did this transition get worse?" decisions.
 _SEVERITY = {state: i for i, state in enumerate(STATES)}
@@ -144,6 +157,11 @@ class DeviceHealthProbe:
         self.wedge_after = max(0.0, self.config.device_probe_wedge_after)
         self.max_host_labels = max(1, self.config.device_probe_max_host_labels)
         self._hosts: dict[str, HostHealth] = {}
+        # Per-cycle recovery verdicts: lease scope -> [all_clean, lane].
+        # Aggregated across the scope's hosts and settled ONCE per cycle
+        # (note_probe per host would let a two-host scope double-count its
+        # clean streak).
+        self._scope_clean: dict[str, list] = {}
         self._task: asyncio.Task | None = None
         self._closed = False
         self._last_cycle_end: float | None = None
@@ -203,6 +221,7 @@ class DeviceHealthProbe:
                     continue  # one sandbox can be re-pooled, not re-probed
                 seen.add(url)
                 targets.append((lane, sandbox, url))
+        self._scope_clean = {}
         await asyncio.gather(
             *(self._probe_host(lane, sandbox, url) for lane, sandbox, url in targets)
         )
@@ -211,6 +230,9 @@ class DeviceHealthProbe:
         for url in list(self._hosts):
             if url not in seen:
                 del self._hosts[url]
+        # Settle recovery streaks AFTER the full cycle: one note per scope
+        # per cycle, clean only when every host on the scope probed clean.
+        self._settle_recovery()
         elapsed = max(0.0, self.clock() - start)
         self._last_cycle_end = self.clock()
         self._cycles += 1
@@ -263,7 +285,97 @@ class DeviceHealthProbe:
             else:
                 health.stats = stats
                 state, reason, stall = self._classify(stats)
+        state, reason = self._recovery_overlay(health, state, reason)
         self._apply(health, state, reason, stall, now)
+
+    # ---------------------------------------------------- recovery actuation
+
+    def _lease_state(self, health: HostHealth):
+        """(registry, lease) for the host's sandbox, or (None, None) when
+        fencing is not wired (no registry) or the sandbox is already gone."""
+        registry = getattr(self.executor, "leases", None)
+        entry = self.executor.live_sandbox(health.sandbox_id)
+        if registry is None or entry is None:
+            return None, None
+        return registry, entry[1].meta.get("lease")
+
+    def _recovery_overlay(
+        self, health: HostHealth, state: str, reason: str
+    ) -> tuple[str, str]:
+        """Layer the fencing/recovery state machine over the raw
+        classification. A fenced host reads DRAINING until its disposal
+        prunes it from the table; a host on a recovering scope reads
+        RECOVERING while it earns the clean-probe streak (its per-cycle
+        verdict is banked for `_settle_recovery`), and a suspect/wedged
+        relapse banks a reset instead."""
+        registry, lease = self._lease_state(health)
+        entry = self.executor.live_sandbox(health.sandbox_id)
+        if entry is not None and entry[1].meta.get("lease_fenced"):
+            return DRAINING, "fenced"
+        if registry is None or lease is None or not registry.recovering(
+            lease.scope
+        ):
+            return state, reason
+        verdict = self._scope_clean.setdefault(
+            lease.scope, [True, health.lane]
+        )
+        if state in (HEALTHY, BUSY):
+            streak, need = registry.recovery_progress(lease.scope)
+            return (
+                RECOVERING,
+                f"clean_streak_{min(streak + 1, need)}_of_{need}",
+            )
+        # Relapse (suspect/unreachable/wedged mid-streak): the streak
+        # resets at settle time — and the host STAYS quarantined. A
+        # suspect relapse must keep reading RECOVERING: the raw suspect
+        # state is not in the pool's unservable set, so passing it through
+        # would flip the host from standby to servable supply and hand a
+        # tenant request to hardware that just showed stall symptoms —
+        # exactly what the re-admission gate exists to prevent. Only a
+        # WEDGED relapse passes through raw: it must re-trigger actuation
+        # (budget-bounded), and wedged is unservable in its own right.
+        verdict[0] = False
+        if state == WEDGED:
+            return state, reason
+        return RECOVERING, f"relapse_{reason}" if reason else "relapse"
+
+    def _settle_recovery(self) -> None:
+        """Apply the cycle's per-scope verdicts to the lease registry and
+        act on re-admissions: the scope's hosts flip to healthy NOW (the
+        pool's supply gating reads the sandbox marks, and a woken waiter
+        must see serving supply, not last cycle's quarantine), the
+        re-admission counter fires, and every lane is kicked — waiters
+        parked behind the recovering quarantine are exactly who this
+        turnover is for."""
+        registry = getattr(self.executor, "leases", None)
+        if registry is None:
+            return
+        for scope, (clean, lane) in self._scope_clean.items():
+            if not registry.note_probe(scope, clean=clean):
+                continue
+            self.metrics.host_readmitted.inc(lane=str(lane))
+            for health in self._hosts.values():
+                if health.state != RECOVERING:
+                    continue
+                _, lease = self._lease_state(health)
+                if lease is None or lease.scope != scope:
+                    continue
+                health.state = HEALTHY
+                health.reason = "readmitted"
+                health.since = self.clock()
+                self._mark_sandbox(health)
+            self.tracer.record_span(
+                "device_health.readmitted",
+                trace_id=tracing.new_trace_id(),
+                parent_id=None,
+                start_unix=self.walltime(),
+                duration_s=0.0,
+                attributes={"lane": lane, "scope": scope},
+            )
+            kick = getattr(self.executor, "_notify_all_lanes", None)
+            if kick is not None:
+                kick()
+        self._scope_clean = {}
 
     # -------------------------------------------------------- classification
 
@@ -346,19 +458,28 @@ class DeviceHealthProbe:
         previous = health.state
         if state == previous:
             self._mark_sandbox(health)
+            if state == WEDGED:
+                # Re-assert the verdict every cycle it stands: a fence the
+                # actuator DEFERRED (budget exhausted, breaker open) gets
+                # retried once the window slides, without needing a fresh
+                # transition.
+                self._actuate_wedge(health)
             return
         health.state = state
         health.since = now
         self._mark_sandbox(health)
+        if state == WEDGED:
+            self._actuate_wedge(health)
         # healthy<->busy flips are NORMAL OPERATION (every probe cycle that
         # catches a host mid-op produces one): they update state silently.
-        # Only transitions touching suspect/wedged — entering trouble or
-        # recovering from it — are incidents worth a log line and a span;
-        # anything louder floods the log and evicts real request traces
-        # from the ring under ordinary load.
+        # Only transitions touching recovering/suspect/wedged/draining —
+        # entering trouble, recovering from it, or being fenced — are
+        # incidents worth a log line and a span; anything louder floods the
+        # log and evicts real request traces from the ring under ordinary
+        # load.
         interesting = (
-            _SEVERITY[state] >= _SEVERITY[SUSPECT]
-            or _SEVERITY[previous] >= _SEVERITY[SUSPECT]
+            _SEVERITY[state] >= _SEVERITY[RECOVERING]
+            or _SEVERITY[previous] >= _SEVERITY[RECOVERING]
         )
         if not interesting:
             logger.debug(
@@ -407,6 +528,15 @@ class DeviceHealthProbe:
         if state == WEDGED:
             self.metrics.device_wedges.inc(chip_count=str(health.lane))
 
+    def _actuate_wedge(self, health: HostHealth) -> None:
+        """Hand the wedged verdict to the executor's fencing actuator —
+        detect→act is one hop now. The actuator owns every safety bound
+        (kill switch, per-lane budget, breaker state, dedupe), so calling
+        it is always safe; absent actuator = detection-only (PR 8)."""
+        actuate = getattr(self.executor, "on_host_wedged", None)
+        if actuate is not None:
+            actuate(health.sandbox_id, reason=health.reason or "wedged")
+
     def _mark_sandbox(self, health: HostHealth) -> None:
         """Stamp the verdict onto the sandbox itself — the handle the
         fencing layer (and /statusz consumers holding a Sandbox) will read.
@@ -446,6 +576,18 @@ class DeviceHealthProbe:
 
     def states(self) -> dict[str, str]:
         return {url: h.state for url, h in self._hosts.items()}
+
+    def lane_census(self) -> dict[int, dict[str, int]]:
+        """Per-lane state counts for the /healthz lane rows (satellite: an
+        operator watching /healthz should see a lane's wedged/recovering
+        hosts next to its queue and supply numbers, without a /statusz
+        round-trip). Only states with a nonzero count appear — a healthy
+        fleet's rows stay as small as before."""
+        census: dict[int, dict[str, int]] = {}
+        for health in self._hosts.values():
+            lane = census.setdefault(health.lane, {})
+            lane[health.state] = lane.get(health.state, 0) + 1
+        return census
 
     def snapshot(self) -> dict:
         """The /statusz device-health block: per-host rows plus a state

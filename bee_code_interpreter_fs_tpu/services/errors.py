@@ -43,6 +43,35 @@ class LimitExceededError(RuntimeError):
         self.continuable = continuable
 
 
+class StaleLeaseError(ExecutorError):
+    """A dispatch carried (or would carry) a lease token its host no longer
+    honors: the control plane fenced the (host, chip-set) lease — a wedged
+    verdict bumped the generation — so this claim must never touch those
+    chips again. Raised in two places: by the control plane BEFORE the wire
+    hop when the sandbox's own lease is already revoked (a fence raced an
+    in-flight request), and on the executor's typed ``409 stale_lease``
+    refusal (a late claim reached a successor holding a newer generation).
+
+    A clean refusal: nothing ran on the device (``device_may_have_run``
+    False exempts it from fault billing), and the rejected sandbox handle
+    is disposed, never recycled. An ExecutorError subclass ON PURPOSE: the
+    stateless retry ladder may replay the request — each attempt acquires
+    a FRESH sandbox, so the retry lands on a healthy successor, never
+    against the fenced host — and sessions get the standard
+    close-session-and-surface semantics, which is exactly "end the session
+    with a typed retryable error so the client can reconnect". Maps to
+    HTTP 409 + Retry-After / gRPC ABORTED when it does surface."""
+
+    device_may_have_run = False
+
+    def __init__(
+        self, message: str, *, scope: str = "", retry_after: float = 1.0
+    ) -> None:
+        super().__init__(message)
+        self.scope = scope
+        self.retry_after = retry_after
+
+
 class SessionLimitError(RuntimeError):
     """All executor_id session slots are in use (retryable: HTTP 429 /
     gRPC RESOURCE_EXHAUSTED — not a defect in the request itself)."""

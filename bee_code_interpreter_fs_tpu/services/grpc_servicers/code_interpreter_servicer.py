@@ -26,6 +26,7 @@ from ..code_executor import (
     LimitExceededError,
     QuotaExceededError,
     SessionLimitError,
+    StaleLeaseError,
 )
 from ..custom_tool_executor import (
     CustomToolExecuteError,
@@ -343,6 +344,11 @@ class CodeInterpreterServicer:
             except SessionLimitError as e:
                 # Retryable resource exhaustion, not a defect in the request.
                 await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+            except StaleLeaseError as e:
+                # Before ExecutorError (its parent): the request's host was
+                # fenced mid-flight — ABORTED is gRPC's "safe to retry the
+                # whole transaction" signal, mirroring the HTTP 409.
+                await context.abort(grpc.StatusCode.ABORTED, str(e))
             except (ExecutorError, SandboxSpawnError) as e:
                 logger.exception("Execute failed [%s]", request_id)
                 await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
@@ -405,6 +411,10 @@ class CodeInterpreterServicer:
                 await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             except SessionLimitError as e:
                 await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+            except StaleLeaseError as e:
+                # Fenced mid-stream: ABORTED (retry-whole-call), like
+                # Execute's mapping above.
+                await context.abort(grpc.StatusCode.ABORTED, str(e))
             except (ExecutorError, SandboxSpawnError) as e:
                 logger.exception("ExecuteStream failed [%s]", request_id)
                 await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))

@@ -36,6 +36,7 @@ from .code_executor import (
     LimitExceededError,
     QuotaExceededError,
     SessionLimitError,
+    StaleLeaseError,
 )
 from .custom_tool_executor import (
     CustomToolExecuteError,
@@ -255,6 +256,24 @@ def statusz_text(body: dict) -> str:
             )
     else:
         lines.append("device health: probe disabled")
+    recovery = body.get("recovery", {})
+    if recovery.get("fencing_enabled"):
+        budget = recovery.get("fence_budget", {})
+        lines.append(
+            f"recovery: fences={recovery.get('fences_total', 0)} "
+            f"readmissions={recovery.get('readmissions_total', 0)} "
+            f"budget={budget.get('max_per_window', 0)}"
+            f"/{budget.get('window_seconds', 0)}s "
+            f"streak={recovery.get('readmit_streak', 0)}"
+        )
+        for scope, row in sorted(recovery.get("recovering", {}).items()):
+            lines.append(
+                f"  recovering {scope}: {row.get('streak')}/"
+                f"{row.get('need')} clean ({row.get('reason', '')}, "
+                f"{row.get('for_s')}s, {row.get('relapses')} relapse(s))"
+            )
+    elif recovery:
+        lines.append("recovery: fencing disabled")
     cc = body.get("compile_cache", {})
     lines.append(
         f"compile cache: enabled={cc.get('enabled')} "
@@ -739,6 +758,23 @@ def create_http_app(
             with_trace_id(body), status=429, headers=headers
         )
 
+    def stale_lease_response(e: StaleLeaseError) -> web.Response:
+        """409 for a stale-lease refusal that made it all the way to the
+        client (sessions, which never retry; the stateless path replays on
+        a fresh sandbox first): the request's host was fenced mid-flight.
+        Retryable — a fresh request lands on a healthy host — so the 409
+        carries a Retry-After, and the typed reason lets a session client
+        distinguish "reconnect" from a genuine conflict."""
+        return web.json_response(
+            with_trace_id({"error": str(e), "reason": "stale_lease"}),
+            status=409,
+            headers={
+                "Retry-After": str(
+                    max(1, math.ceil(getattr(e, "retry_after", 1.0) or 1.0))
+                )
+            },
+        )
+
     def add_session_fields(body: dict, result, executor_id: str | None) -> dict:
         """Session continuity, one rule for every surface: seq==1 on a
         request the client expected to land in an existing session means
@@ -795,6 +831,11 @@ def create_http_app(
         except SessionLimitError as e:
             # Resource exhaustion, not a request defect: retryable.
             return capacity_response(e)
+        except StaleLeaseError as e:
+            # Before ExecutorError (its parent): the host was fenced —
+            # typed 409 + Retry-After, the client reconnects to a healthy
+            # host.
+            return stale_lease_response(e)
         except (ExecutorError, SandboxSpawnError) as e:
             logger.exception("execute failed")
             return web.json_response({"error": str(e)}, status=502)
@@ -881,6 +922,16 @@ def create_http_app(
                 return capacity_response(e)
             await response.write(
                 (json.dumps({"error": str(e)}) + "\n").encode("utf-8")
+            )
+        except StaleLeaseError as e:
+            # Before ExecutorError (its parent): typed fence refusal.
+            if not started:
+                return stale_lease_response(e)
+            await response.write(
+                (
+                    json.dumps({"error": str(e), "reason": "stale_lease"})
+                    + "\n"
+                ).encode("utf-8")
             )
         except (ExecutorError, SandboxSpawnError) as e:
             logger.exception("execute stream failed")

@@ -1,0 +1,215 @@
+"""Per-chip lease fencing: generation tokens and gated re-admission.
+
+The device-health probe (PR 8) can SAY a host is wedged; nothing could
+safely ACT on that verdict, because disposal alone does not protect the
+replacement — the repo's own outage history (BENCH_r03-r05) is precisely a
+stale claim wedging a chip for the next holder: a zombie runner still
+holding libtpu, a late-arriving dispatch, a retry racing a dispose. This
+module is the fencing primitive that makes dispose-and-replace safe:
+
+- **Generation tokens** — every sandbox spawn mints a monotonic generation
+  per lease *scope* (the physical chip-set the sandbox attaches: the
+  backend's `lease_scope`, or the chip-count lane by default). The token is
+  pushed to the sandbox's executor at attach (`POST /lease`) and stamped on
+  every dispatch (`x-lease-token`); an executor holding a NEWER token
+  rejects a stale claim with a typed ``409 stale_lease`` before taking any
+  lock — a claim minted for a fenced predecessor can never reach the
+  successor's device plane, not even to queue behind it.
+- **Fencing** — a wedged verdict revokes the host's lease. The control
+  plane refuses to dispatch against a revoked lease (typed
+  ``StaleLeaseError``, a clean refusal that bills nothing), and the scope's
+  next mint is strictly newer, so the successor's executor can tell every
+  pre-fence token apart from its own.
+- **Gated re-admission** — a fenced scope enters ``recovering``: hosts on
+  it (the replacement lands on the same hardware) are probed but serve
+  nothing until ``APP_DEVICE_PROBE_READMIT_STREAK`` consecutive clean
+  probes; a suspect/wedged relapse resets the streak. Re-admission fires
+  ``host_readmitted_total`` and wakes the lanes that were waiting out the
+  quarantine.
+
+Scopes deliberately name HARDWARE, not sandboxes: on the local backend
+every warm sandbox holds the same physical TPU, so one scope per lane is
+exactly the chip-set; on Kubernetes a backend can expose finer scopes via
+``lease_scope(chip_count)``. Keying recovery by scope is what makes "the
+replacement on the same hardware must re-earn trust" expressible at all.
+
+Event-loop discipline like the scheduler: plain synchronous state driven
+from the executor's loop; the clock is injectable so every fencing test
+runs with zero sleeps.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections.abc import Callable
+from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Lease:
+    """One sandbox's claim on its scope's chips. Identity object: the
+    executor compares `wire_token` strings for equality, the control plane
+    checks `revoked` before every dispatch."""
+
+    scope: str
+    generation: int
+    sandbox_id: str = ""
+    revoked: bool = False
+    revoke_reason: str = ""
+
+    @property
+    def wire_token(self) -> str:
+        """The token as it rides the wire (`x-lease-token` header and the
+        `POST /lease` body): scope-qualified so a mis-routed dispatch is
+        diagnosable from the 409 body alone."""
+        return f"{self.scope}:{self.generation}"
+
+
+@dataclass
+class _ScopeRecovery:
+    """A fenced scope's re-admission state: how many consecutive clean
+    probes its current hardware has shown, out of how many required."""
+
+    streak: int = 0
+    need: int = 1
+    since: float = 0.0
+    relapses: int = 0
+    reason: str = ""
+
+
+class LeaseRegistry:
+    """Mints, revokes, and re-admits per-scope generation leases."""
+
+    def __init__(
+        self,
+        *,
+        readmit_streak: int = 3,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.readmit_streak = max(1, readmit_streak)
+        self.clock = clock
+        self._generations: dict[str, int] = {}
+        self._recovering: dict[str, _ScopeRecovery] = {}
+        self.fences_total = 0
+        self.readmissions_total = 0
+
+    # ---------------------------------------------------------------- leases
+
+    def mint(self, scope: str, sandbox_id: str = "") -> Lease:
+        """A fresh lease for `scope`, strictly newer than every lease the
+        scope ever issued — the monotonicity the executor-side stale check
+        rests on."""
+        generation = self._generations.get(scope, 0) + 1
+        self._generations[scope] = generation
+        return Lease(scope=scope, generation=generation, sandbox_id=sandbox_id)
+
+    def current_generation(self, scope: str) -> int:
+        return self._generations.get(scope, 0)
+
+    def fence(self, lease: Lease, *, reason: str = "wedged") -> None:
+        """Revoke the lease and put its scope into recovering. Idempotent:
+        fencing an already-revoked lease changes nothing (the probe may
+        re-report a wedge while the dispose is still in flight)."""
+        if lease.revoked:
+            return
+        lease.revoked = True
+        lease.revoke_reason = reason
+        self.fences_total += 1
+        # Burn the generation forward so even a mint racing this fence can
+        # never reissue the revoked token.
+        self._generations[lease.scope] = max(
+            self._generations.get(lease.scope, 0), lease.generation
+        )
+        self._recovering[lease.scope] = _ScopeRecovery(
+            streak=0,
+            need=self.readmit_streak,
+            since=self.clock(),
+            reason=reason,
+        )
+        logger.warning(
+            "lease fenced: scope=%s generation=%d sandbox=%s (%s); "
+            "re-admission needs %d clean probes",
+            lease.scope,
+            lease.generation,
+            lease.sandbox_id,
+            reason,
+            self.readmit_streak,
+        )
+
+    @staticmethod
+    def revoked(lease: Lease | None) -> bool:
+        return lease is not None and lease.revoked
+
+    # ------------------------------------------------------------ recovering
+
+    def recovering(self, scope: str) -> bool:
+        return scope in self._recovering
+
+    def recovery_progress(self, scope: str) -> tuple[int, int]:
+        """(clean streak so far, streak required); (0, 0) when the scope is
+        not recovering."""
+        state = self._recovering.get(scope)
+        if state is None:
+            return 0, 0
+        return state.streak, state.need
+
+    def note_probe(self, scope: str, *, clean: bool) -> bool:
+        """One probe verdict for a recovering scope's hardware. Clean
+        (healthy/busy) probes advance the streak; a suspect/wedged relapse
+        resets it — the fenced hardware must prove a CONSECUTIVE run of
+        good behavior, not a lucky sample. Returns True exactly once, when
+        the streak completes and the scope re-admits."""
+        state = self._recovering.get(scope)
+        if state is None:
+            return False
+        if not clean:
+            if state.streak:
+                logger.info(
+                    "lease scope %s relapsed mid-recovery (streak was %d/%d)",
+                    scope,
+                    state.streak,
+                    state.need,
+                )
+            state.streak = 0
+            state.relapses += 1
+            return False
+        state.streak += 1
+        if state.streak < state.need:
+            return False
+        del self._recovering[scope]
+        self.readmissions_total += 1
+        logger.info(
+            "lease scope %s re-admitted after %d clean probes "
+            "(%.1fs in recovery, %d relapse(s))",
+            scope,
+            state.need,
+            max(0.0, self.clock() - state.since),
+            state.relapses,
+        )
+        return True
+
+    # -------------------------------------------------------------- surfaces
+
+    def snapshot(self) -> dict:
+        """The /statusz recovery block's lease half: per-scope generations
+        and any in-flight re-admission streaks."""
+        now = self.clock()
+        return {
+            "readmit_streak": self.readmit_streak,
+            "fences_total": self.fences_total,
+            "readmissions_total": self.readmissions_total,
+            "generations": dict(sorted(self._generations.items())),
+            "recovering": {
+                scope: {
+                    "streak": state.streak,
+                    "need": state.need,
+                    "relapses": state.relapses,
+                    "for_s": round(max(0.0, now - state.since), 3),
+                    "reason": state.reason,
+                }
+                for scope, state in sorted(self._recovering.items())
+            },
+        }

@@ -69,6 +69,7 @@ logger = logging.getLogger(__name__)
 # wire as x-quota-reason): membership is contract for dashboards and tests.
 DENIAL_REASONS = (
     "chip_seconds",
+    "predicted_overrun",
     "request_rate",
     "concurrency",
     "quarantined",
@@ -681,13 +682,25 @@ class QuotaEnforcer:
             window_seconds=policy.window_seconds,
         )
 
-    def admit(self, tenant: str | None) -> QuotaVerdict | None:
+    def admit(
+        self,
+        tenant: str | None,
+        *,
+        predicted_chip_seconds: float | None = None,
+    ) -> QuotaVerdict | None:
         """The admission gate, called BEFORE any scheduler/batcher/session
         machinery sees the request. Returns a verdict the caller must
         `release()` on exit, or None when the layer is off / the request
         is unmetered (trusted control-plane runs). Raises
         QuotaExceededError with the typed reason on denial — the request
-        is never enqueued."""
+        is never enqueued.
+
+        `predicted_chip_seconds` is the request's DECLARED worst case
+        (chip_count x clamped timeout): with cost prediction on, a request
+        whose declaration cannot fit the remaining window budget is denied
+        NOW (reason=predicted_overrun, Retry-After from the refill point)
+        instead of admitted and billed into overrun — the PR 11 carried
+        follow-up."""
         if not self.enabled or tenant is None:
             return None
         self._load_policy_file()
@@ -797,6 +810,40 @@ class QuotaEnforcer:
                         f"{window:.0f}s window)"
                     ),
                     remaining=0.0,
+                )
+            # 3b) Admission-time cost prediction: the declared worst case
+            # (chip_count x timeout) must FIT the remaining budget, or the
+            # run would be admitted only to bill into overrun — burning
+            # chips the window then shuts everyone out of. Retry-After is
+            # the refill point at which the prediction fits; a request
+            # bigger than the WHOLE budget can never fit (the client must
+            # shrink its declaration) and backs off a full window.
+            if (
+                self.config.quota_cost_prediction
+                and predicted_chip_seconds is not None
+                and predicted_chip_seconds > 0
+                and predicted_chip_seconds > remaining
+            ):
+                budget = policy.chip_seconds_per_window
+                if predicted_chip_seconds >= budget:
+                    refill_at = now + window
+                else:
+                    refill_at = win.budget_refill_at(
+                        now, window, budget - predicted_chip_seconds
+                    )
+                raise self._deny(
+                    label,
+                    policy,
+                    win,
+                    reason="predicted_overrun",
+                    retry_after=max(1.0, refill_at - now),
+                    detail=(
+                        f"declared cost ({predicted_chip_seconds:.3f} "
+                        f"chip-seconds: chip_count x timeout) cannot fit "
+                        f"its remaining budget ({remaining:.3f}s of "
+                        f"{budget:.3f}s per {window:.0f}s window)"
+                    ),
+                    remaining=remaining,
                 )
 
         # 4) Request rate over the window.

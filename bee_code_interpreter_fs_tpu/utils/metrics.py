@@ -486,6 +486,25 @@ class ExecutorMetrics:
             "by chip-count lane. Fires once per transition into wedged.",
             ("chip_count",),
         )
+        # Wedge-recovery actuation (the fencing half): every wedged verdict
+        # the actuator saw, by lane and what it did about it. outcome=
+        # fenced is the loop closing (drain + dispose + replace started);
+        # budget_exhausted / breaker_open are the bounded-blast-radius
+        # outcomes — the verdict stood but actuation deferred.
+        self.device_fences = self.registry.counter(
+            "device_fence_total",
+            "Wedge-recovery actuations by lane and outcome (fenced = lease "
+            "revoked + host drained/disposed/replaced; budget_exhausted = "
+            "per-lane actuation cap hit, verdict deferred; breaker_open = "
+            "lane cannot spawn replacements, disposal skipped).",
+            ("lane", "outcome"),
+        )
+        self.host_readmitted = self.registry.counter(
+            "host_readmitted_total",
+            "Fenced lease scopes re-admitted to serving after the "
+            "configured consecutive clean-probe streak, by lane.",
+            ("lane",),
+        )
         self.device_probe_cycle_seconds = self.registry.histogram(
             "code_interpreter_device_probe_cycle_seconds",
             "Wall time of one full device-health probe cycle over every "
@@ -815,7 +834,8 @@ class ExecutorMetrics:
         self.device_health_state = self.registry.gauge(
             "device_health_state",
             "Device-health probe classification per lane/host/state "
-            "(healthy|busy|suspect|wedged): 1 on the host's current state. "
+            "(healthy|busy|recovering|suspect|wedged|draining): 1 on the "
+            "host's current state. "
             "Past the host-label cap, series aggregate per lane under "
             'host="_overflow" (value = hosts in that state).',
             ("lane", "host", "state"),

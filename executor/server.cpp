@@ -637,6 +637,16 @@ cgroup::Scope g_runner_scope;
 std::string g_runner_cgroup_procs;
 std::atomic<long long> g_run_scope_seq{0};
 
+// Per-chip lease fencing: the generation token the control plane minted
+// for THIS sandbox's claim on its chips, recorded at attach (POST /lease).
+// Every dispatch carries its token in `x-lease-token`; a mismatch is a
+// claim minted for a fenced predecessor on the same chips — rejected with
+// a typed 409 BEFORE any lock is taken, so a stale dispatch cannot even
+// queue behind the device plane it must never touch (the BENCH_r03-r05
+// re-wedge vector). Tiny mutex, never held across I/O.
+std::mutex g_lease_mutex;
+std::string g_lease_token;
+
 // Resident set size of `pid` in bytes via /proc/<pid>/statm; -1 on failure.
 long long rss_bytes_of(long long pid) {
   if (pid <= 0) return -1;
@@ -1630,8 +1640,90 @@ RunOutcome run_user_code(const std::string& script_path,
   return out;
 }
 
+// POST /lease — record this sandbox's lease generation token. FIRST-WRITE-
+// WINS for the process's lifetime: the control plane pushes exactly once,
+// right after spawn and BEFORE the sandbox serves anything — so the only
+// party that can ever land the first write is the control plane, and a
+// later rotation attempt (tenant code curling localhost from inside the
+// sandbox — this route is as reachable as /reset, but a forged rotation
+// here would make the control plane's REAL token read stale and convert
+// every request into an unbilled dispose-and-respawn) is refused with a
+// 409. Re-posting the SAME token is an idempotent 200 (push retries).
+void handle_lease(const minihttp::Request&, minihttp::Conn& conn) {
+  std::string body = conn.read_body();
+  std::string token;
+  try {
+    minijson::Value parsed = minijson::parse(body);
+    token = parsed.get_string("token");
+  } catch (const std::exception&) {
+    conn.send_response(400, "application/json", "{\"error\":\"bad json\"}");
+    return;
+  }
+  if (token.empty()) {
+    conn.send_response(400, "application/json",
+                       "{\"error\":\"token required\"}");
+    return;
+  }
+  std::string conflict;
+  {
+    // Decide under the lock, respond outside it (never held across I/O).
+    std::lock_guard<std::mutex> lock(g_lease_mutex);
+    if (!g_lease_token.empty() && g_lease_token != token) {
+      conflict = g_lease_token;
+    } else {
+      g_lease_token = token;
+    }
+  }
+  if (!conflict.empty()) {
+    log_msg("lease rotation refused: held=%s offered=%s", conflict.c_str(),
+            token.c_str());
+    minijson::Object err;
+    err["error"] = minijson::Value(std::string("lease_already_recorded"));
+    err["held"] = minijson::Value(conflict);
+    conn.send_response(409, "application/json", minijson::Value(err).dump());
+    return;
+  }
+  log_msg("lease token recorded: %s", token.c_str());
+  minijson::Object resp;
+  resp["ok"] = minijson::Value(true);
+  resp["token"] = minijson::Value(token);
+  conn.send_response(200, "application/json", minijson::Value(resp).dump());
+}
+
+// The fencing check: a request presenting a lease token that does not
+// match the one this server holds is a claim minted for a fenced
+// predecessor — refuse with the typed 409 and touch NOTHING (no mutex, no
+// body parse, no device plane). Requests without the header (old control
+// planes, manual curl) and servers without a recorded token (old control
+// plane never POSTed /lease) pass through: enforcement is opt-in per hop,
+// the control-plane revocation check is the backstop.
+bool reject_stale_lease(const minihttp::Request& req, minihttp::Conn& conn) {
+  std::string offered = req.header("x-lease-token");
+  if (offered.empty()) return false;
+  std::string held;
+  {
+    std::lock_guard<std::mutex> lock(g_lease_mutex);
+    held = g_lease_token;
+  }
+  if (held.empty() || offered == held) return false;
+  log_msg("stale lease claim refused: offered=%s held=%s", offered.c_str(),
+          held.c_str());
+  conn.drain_body();
+  minijson::Object err;
+  err["error"] = minijson::Value(std::string("stale_lease"));
+  err["held"] = minijson::Value(held);
+  err["offered"] = minijson::Value(offered);
+  conn.send_response(409, "application/json", minijson::Value(err).dump());
+  return true;
+}
+
 void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
                          bool streaming) {
+  // Lease fencing FIRST: a stale claim must be refused before the body is
+  // even read, and above all before exec_mutex — a wedged op may be
+  // holding that lock for minutes, and a stale dispatch queueing behind it
+  // is exactly the re-wedge this check exists to prevent.
+  if (reject_stale_lease(req, conn)) return;
   // W3C trace context from the control plane: when present, per-phase
   // timings (install/exec/collect) are stamped into a `trace` block on the
   // response so the orchestrator can graft them into the request's trace
@@ -2039,6 +2131,9 @@ std::atomic<long> g_batch_seq{0};
 // refusal (no warm runner, multi-host slice, old binary's 404) tells the
 // control plane to fall back to the serial path.
 void handle_execute_batch(const minihttp::Request& req, minihttp::Conn& conn) {
+  // Same fencing discipline as /execute: stale claims die before the body
+  // read and before exec_mutex.
+  if (reject_stale_lease(req, conn)) return;
   std::string traceparent = req.header("traceparent");
   struct timespec t_req;
   clock_gettime(CLOCK_MONOTONIC, &t_req);
@@ -2531,6 +2626,12 @@ void handle_device_stats(const minihttp::Request&, minihttp::Conn& conn) {
   }
   resp["runner_alive"] = minijson::Value(runner_alive);
   resp["runner_pid"] = minijson::Value(static_cast<double>(runner_pid));
+  {
+    // The held lease token: lets an operator (or the probe) see which
+    // generation this server will honor without sending a claim.
+    std::lock_guard<std::mutex> llock(g_lease_mutex);
+    resp["lease_token"] = minijson::Value(g_lease_token);
+  }
   resp["rss_bytes"] = minijson::Value(
       static_cast<double>(rss_bytes_of(static_cast<long long>(getpid()))));
   resp["runner_rss_bytes"] = minijson::Value(
@@ -2562,7 +2663,10 @@ void handle_warmup(const minihttp::Request&, minihttp::Conn& conn) {
 // separates the chip lease from the disposable sandbox: single-use WORKSPACE,
 // reusable DEVICE PROCESS (reference pods pay a full respawn here,
 // kubernetes_code_executor.py:263-279 — a fresh pod per request).
-void handle_reset(const minihttp::Request&, minihttp::Conn& conn) {
+void handle_reset(const minihttp::Request& req, minihttp::Conn& conn) {
+  // A /reset from a fenced predecessor's control path (a retry racing a
+  // dispose) must not wipe the successor's workspace mid-request.
+  if (reject_stale_lease(req, conn)) return;
   conn.drain_body();
   std::lock_guard<std::mutex> lock(g_state.exec_mutex);
   auto refuse = [&conn](const char* reason) {
@@ -2640,6 +2744,8 @@ void route(const minihttp::Request& req, minihttp::Conn& conn) {
     handle_warmup(req, conn);
   } else if (req.method == "POST" && req.target == "/reset") {
     handle_reset(req, conn);
+  } else if (req.method == "POST" && req.target == "/lease") {
+    handle_lease(req, conn);
   } else if (req.method == "GET" && req.target == "/workspace-manifest") {
     handle_manifest(req, conn);
   } else if (req.method == "GET" && req.target == "/compile-cache-manifest") {

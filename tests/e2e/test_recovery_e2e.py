@@ -1,0 +1,195 @@
+"""End-to-end wedge-recovery acceptance (ISSUE 13): HTTP API → orchestrator
+→ real C++ executors (local backend) with a seeded attach-hang wedging ONE
+host, and the detect→act loop closed.
+
+The acceptance criterion, verbatim: with seeded ``attach_hang`` wedging one
+host under concurrent load, the probe's wedged verdict automatically drains
+and disposes the host, a replacement spawns, the lane serves throughout
+(other hosts unaffected), a stale-generation claim against the fenced chips
+is rejected with the typed 409 and never wedges the successor, and the
+recovering host re-admits only after the configured clean-probe streak —
+all with zero manual intervention.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+pytest.importorskip("httpx", reason="optional e2e dependency not installed")
+pytest.importorskip("aiohttp", reason="optional e2e dependency not installed")
+
+import httpx
+from aiohttp.test_utils import TestClient, TestServer
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.backends.faults import (
+    FaultInjectingBackend,
+    FaultSpec,
+)
+from bee_code_interpreter_fs_tpu.services.backends.local import LocalSandboxBackend
+from bee_code_interpreter_fs_tpu.services.code_executor import CodeExecutor
+from bee_code_interpreter_fs_tpu.services.custom_tool_executor import (
+    CustomToolExecutor,
+)
+from bee_code_interpreter_fs_tpu.services.device_health import DeviceHealthProbe
+from bee_code_interpreter_fs_tpu.services.http_server import create_http_app
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+WEDGED_LANE = 2
+READMIT_STREAK = 2
+
+
+@pytest.fixture
+async def stack(tmp_path):
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        local_sandbox_root=str(tmp_path / "sandboxes"),
+        executor_pod_queue_target_length=1,
+        jax_compilation_cache_dir="",
+        default_execution_timeout=30.0,
+        # Wedge exactly ONE host of the doomed lane (rate 1.0 +
+        # attach_hang_max:1): the dispose-and-replace successor comes up
+        # clean, so re-admission is reachable in test time.
+        executor_fault_spec=(
+            f"attach_hang:1.0,attach_hang_lane:{WEDGED_LANE},"
+            f"attach_hang_max:1,seed:7"
+        ),
+        device_probe_interval=0.05,
+        device_probe_timeout=5.0,
+        device_probe_attach_budget=0.3,
+        device_probe_op_grace=5.0,
+        device_probe_wedge_after=0.3,
+        device_probe_readmit_streak=READMIT_STREAK,
+    )
+    backend = FaultInjectingBackend(
+        LocalSandboxBackend(config, warm_import_jax=False),
+        FaultSpec.parse(config.executor_fault_spec),
+    )
+    storage = Storage(config.file_storage_path)
+    executor = CodeExecutor(backend, storage, config)
+    probe = DeviceHealthProbe(executor)
+    executor.device_health = probe
+    app = create_http_app(executor, CustomToolExecutor(executor), storage)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    yield client, executor, probe
+    await probe.stop()
+    await client.close()
+    await executor.close()
+
+
+async def _execute_ok(client, lane: int, marker: str) -> dict:
+    resp = await client.post(
+        "/v1/execute",
+        json={"source_code": f"print({marker!r})", "chip_count": lane},
+    )
+    assert resp.status == 200, await resp.text()
+    body = await resp.json()
+    assert body["stdout"] == f"{marker}\n"
+    return body
+
+
+def _counter(executor, metric) -> dict:
+    return {
+        tuple(labels.values()): value for labels, value in metric.samples()
+    }
+
+
+async def test_wedge_recovery_end_to_end(stack):
+    client, executor, probe = stack
+    # Light up both lanes with real executor hosts.
+    await _execute_ok(client, 0, "healthy lane up")
+    await _execute_ok(client, WEDGED_LANE, "doomed lane up")
+    doomed = next(
+        sandbox
+        for lane, sandbox in executor.live_hosts()
+        if lane == WEDGED_LANE
+    )
+    old_lease = doomed.meta["lease"]
+    assert old_lease is not None and not old_lease.revoked
+
+    # Concurrent load on the healthy lane for the WHOLE recovery window.
+    stop_load = asyncio.Event()
+    load_results: list[int] = []
+
+    async def pump_load() -> None:
+        i = 0
+        while not stop_load.is_set():
+            resp = await client.post(
+                "/v1/execute",
+                json={"source_code": f"print({i})", "chip_count": 0},
+            )
+            load_results.append(resp.status)
+            i += 1
+            await asyncio.sleep(0.02)
+
+    load = asyncio.create_task(pump_load())
+
+    # Run the probe daemon for real: detection -> fence -> drain ->
+    # dispose -> respawn, zero manual intervention.
+    probe.start()
+    deadline = time.monotonic() + 30.0
+    replacement = None
+    while time.monotonic() < deadline:
+        if executor.live_sandbox(doomed.id) is None:
+            replacement = next(
+                (
+                    sandbox
+                    for lane, sandbox in executor.live_hosts()
+                    if lane == WEDGED_LANE
+                ),
+                None,
+            )
+            if replacement is not None:
+                break
+        await asyncio.sleep(0.05)
+    assert replacement is not None, "wedged host was not replaced in time"
+    assert old_lease.revoked, "the wedged host's lease was not fenced"
+    fences = _counter(executor, executor.metrics.device_fences)
+    assert fences.get((str(WEDGED_LANE), "fenced"), 0) >= 1
+    new_lease = replacement.meta["lease"]
+    assert new_lease.generation > old_lease.generation
+
+    # A stale-generation claim against the fenced chips: dispatched
+    # STRAIGHT at the successor's executor, it is rejected with the typed
+    # 409 before taking any lock — it can never wedge the successor.
+    async with httpx.AsyncClient() as raw:
+        resp = await raw.post(
+            f"{replacement.url}/execute",
+            json={"source_code": "print('stale claim')", "timeout": 5},
+            headers={"x-lease-token": old_lease.wire_token},
+        )
+    assert resp.status_code == 409
+    body = resp.json()
+    assert body["error"] == "stale_lease"
+    assert body["held"] == new_lease.wire_token
+
+    # Re-admission is gated on the clean-probe streak: wait for the scope
+    # to re-admit (host_readmitted_total fires), then the lane serves.
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        readmits = _counter(executor, executor.metrics.host_readmitted)
+        if readmits.get((str(WEDGED_LANE),), 0) >= 1:
+            break
+        await asyncio.sleep(0.05)
+    else:
+        pytest.fail("fenced scope never re-admitted")
+    assert not executor.leases.recovering(old_lease.scope)
+    await _execute_ok(client, WEDGED_LANE, "lane recovered")
+
+    # The healthy lane served throughout: every load request succeeded.
+    stop_load.set()
+    await load
+    assert load_results, "load pump never ran"
+    assert all(status == 200 for status in load_results)
+
+    # The operator surfaces tell the story: /statusz recovery block and
+    # /healthz lane census.
+    resp = await client.get("/statusz")
+    statusz = await resp.json()
+    assert statusz["recovery"]["fences_total"] >= 1
+    assert statusz["recovery"]["readmissions_total"] >= 1
+    resp = await client.get("/healthz")
+    healthz = await resp.json()
+    assert str(WEDGED_LANE) in healthz["lanes"]

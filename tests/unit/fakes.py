@@ -19,6 +19,12 @@ class FakeBackend:
     # externally-writable cache-dir postures override per instance.
     compile_cache_dir_scope = "private"
 
+    # Fake sandboxes are not real HTTP hosts: the executor skips the
+    # POST /lease token push (minting and the control-plane revocation
+    # check still run) — real-socket connect failures against fake URLs
+    # would make the seeded chaos suites' interleaving nondeterministic.
+    supports_lease_push = False
+
     def __init__(self, capacity=None, resettable=True, distinct_urls=False):
         self.capacity = capacity
         self.resettable = resettable

@@ -65,6 +65,10 @@ class _Stack:
             device_probe_attach_budget=10.0,
             device_probe_op_grace=5.0,
             device_probe_wedge_after=10.0,
+            # Detection-only posture (the actuation kill switch): this
+            # suite asserts classification; the fencing actuation has its
+            # own suites (test_recovery.py / test_recovery_chaos.py).
+            device_fence_enabled=False,
         )
         defaults.update(config_overrides)
         self.config = Config(**defaults)

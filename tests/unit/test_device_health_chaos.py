@@ -156,6 +156,10 @@ async def test_probe_escalates_wedge_on_hung_host_spares_healthy_one():
         ),
         device_probe_attach_budget=10.0,
         device_probe_wedge_after=10.0,
+        # Detection-only posture (the actuation kill switch): this suite
+        # asserts the PR 8 classification semantics; the fence/drain/
+        # replace loop has its own chaos suite (test_recovery_chaos.py).
+        device_fence_enabled=False,
     )
     faults = []
     backend = FaultInjectingBackend(

@@ -968,3 +968,65 @@ def test_device_stats_detects_silently_dead_runner(executor):
         time.sleep(0.1)
     else:
         pytest.fail("runner did not rewarm after silent death")
+
+
+def test_stale_lease_claim_refused_with_typed_409(executor):
+    """Per-chip lease fencing, executor side: once a lease token is
+    recorded (POST /lease), an execute dispatch presenting an OLDER token
+    is refused with the typed 409 — before the body is processed and
+    before exec_mutex, so a stale claim can never even queue behind the
+    device plane. Tokenless requests and the current token keep serving
+    (old-control-plane compatibility)."""
+    client, ws = executor
+    # No token recorded yet: any claim passes through.
+    r = client.post(
+        "/execute",
+        json={"source_code": "print('pre')"},
+        headers={"x-lease-token": "lane-0:1"},
+    )
+    assert r.status_code == 200
+    # Record generation 2 for this sandbox's chips.
+    r = client.post("/lease", json={"token": "lane-0:2"})
+    assert r.status_code == 200 and r.json()["ok"] is True
+    assert client.get("/device-stats").json()["lease_token"] == "lane-0:2"
+    # A stale (generation-1) claim is refused, typed.
+    r = client.post(
+        "/execute",
+        json={"source_code": "print('stale')"},
+        headers={"x-lease-token": "lane-0:1"},
+    )
+    assert r.status_code == 409
+    body = r.json()
+    assert body["error"] == "stale_lease"
+    assert body["held"] == "lane-0:2"
+    assert body["offered"] == "lane-0:1"
+    # /execute-batch and /reset refuse the same stale claim (a retry
+    # racing a dispose must not wipe the successor's workspace).
+    r = client.post(
+        "/execute-batch",
+        json={"jobs": [{"source_code": "print(1)"}] * 2, "timeout": 10},
+        headers={"x-lease-token": "lane-0:1"},
+    )
+    assert r.status_code == 409 and r.json()["error"] == "stale_lease"
+    r = client.post("/reset", headers={"x-lease-token": "lane-0:1"})
+    assert r.status_code == 409 and r.json()["error"] == "stale_lease"
+    # The CURRENT token serves, as does a tokenless dispatch.
+    r = client.post(
+        "/execute",
+        json={"source_code": "print('current')"},
+        headers={"x-lease-token": "lane-0:2"},
+    )
+    assert r.status_code == 200 and r.json()["stdout"] == "current\n"
+    r = client.post("/execute", json={"source_code": "print('bare')"})
+    assert r.status_code == 200 and r.json()["stdout"] == "bare\n"
+    # Bad /lease bodies are client errors, not token rotations.
+    assert client.post("/lease", json={}).status_code == 400
+    # First-write-wins: re-pushing the SAME token is an idempotent 200
+    # (control-plane push retries), but a ROTATION is refused — tenant
+    # code inside the sandbox must not be able to make the control
+    # plane's real token read stale.
+    assert client.post("/lease", json={"token": "lane-0:2"}).json()["ok"]
+    r = client.post("/lease", json={"token": "lane-0:999"})
+    assert r.status_code == 409
+    assert r.json()["error"] == "lease_already_recorded"
+    assert client.get("/device-stats").json()["lease_token"] == "lane-0:2"

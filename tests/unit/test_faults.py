@@ -187,3 +187,21 @@ async def test_dropping_transport_passes_through_below_rate():
     async with httpx.AsyncClient(transport=transport) as client:
         resp = await client.get("http://sandbox.invalid/healthz")
     assert resp.status_code == 200
+
+
+def test_parse_attach_hang_recovery_modifiers():
+    """The wedge-recovery chaos knobs: attach_hang_max bounds how many
+    hosts ever wedge, attach_hang_recover clears a host's hang after n
+    wedged stats draws. Modifiers only — neither activates the plan by
+    itself (a max with no rate injects nothing)."""
+    spec = FaultSpec.parse(
+        "attach_hang:1.0,attach_hang_lane:2,attach_hang_max:1,"
+        "attach_hang_recover:3,seed:9"
+    )
+    assert spec.attach_hang == 1.0
+    assert spec.attach_hang_max == 1
+    assert spec.attach_hang_recover == 3
+    assert spec.active
+    assert not FaultSpec.parse("attach_hang_max:2,attach_hang_recover:5").active
+    with pytest.raises(ValueError):
+        FaultSpec.parse("attach_hang_max:lots")

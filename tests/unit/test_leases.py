@@ -1,0 +1,93 @@
+"""Lease registry unit tests (services/leases.py): monotonic generation
+minting per scope, fence revocation, the recovering state machine (clean
+streak, suspect relapse reset, single re-admission), and the snapshot
+surface. All on a fake clock — zero sleeps."""
+
+from bee_code_interpreter_fs_tpu.services.leases import Lease, LeaseRegistry
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.now = 100.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def make_registry(streak: int = 3) -> LeaseRegistry:
+    return LeaseRegistry(readmit_streak=streak, clock=FakeClock())
+
+
+def test_mint_is_monotonic_per_scope():
+    registry = make_registry()
+    a = registry.mint("lane-0", "sb-1")
+    b = registry.mint("lane-0", "sb-2")
+    other = registry.mint("lane-4", "sb-3")
+    assert (a.generation, b.generation) == (1, 2)
+    assert other.generation == 1  # scopes are independent counters
+    assert a.wire_token == "lane-0:1"
+    assert b.wire_token != a.wire_token
+
+
+def test_fence_revokes_and_marks_scope_recovering():
+    registry = make_registry(streak=2)
+    lease = registry.mint("lane-0", "sb-1")
+    assert not registry.revoked(lease)
+    assert not registry.recovering("lane-0")
+    registry.fence(lease, reason="attach_stalled")
+    assert registry.revoked(lease)
+    assert lease.revoke_reason == "attach_stalled"
+    assert registry.recovering("lane-0")
+    assert registry.fences_total == 1
+    # Idempotent: re-fencing (the probe re-asserts every cycle while the
+    # dispose is in flight) changes nothing.
+    registry.fence(lease)
+    assert registry.fences_total == 1
+    # The successor's mint is strictly newer than the fenced generation.
+    successor = registry.mint("lane-0", "sb-2")
+    assert successor.generation > lease.generation
+    assert not registry.revoked(successor)
+
+
+def test_readmission_needs_consecutive_clean_probes():
+    registry = make_registry(streak=3)
+    lease = registry.mint("lane-0", "sb-1")
+    registry.fence(lease)
+    assert registry.note_probe("lane-0", clean=True) is False
+    assert registry.recovery_progress("lane-0") == (1, 3)
+    assert registry.note_probe("lane-0", clean=True) is False
+    # Relapse resets the streak — CONSECUTIVE is the contract.
+    assert registry.note_probe("lane-0", clean=False) is False
+    assert registry.recovery_progress("lane-0") == (0, 3)
+    assert registry.note_probe("lane-0", clean=True) is False
+    assert registry.note_probe("lane-0", clean=True) is False
+    # The completing probe re-admits exactly once.
+    assert registry.note_probe("lane-0", clean=True) is True
+    assert not registry.recovering("lane-0")
+    assert registry.readmissions_total == 1
+    # Further notes on a non-recovering scope are no-ops.
+    assert registry.note_probe("lane-0", clean=True) is False
+
+
+def test_revoked_handles_none_and_plain_leases():
+    registry = make_registry()
+    assert registry.revoked(None) is False
+    lease = Lease(scope="s", generation=1)
+    assert registry.revoked(lease) is False
+    lease.revoked = True
+    assert registry.revoked(lease) is True
+
+
+def test_snapshot_shape():
+    registry = make_registry(streak=2)
+    lease = registry.mint("lane-0", "sb-1")
+    registry.fence(lease, reason="device_op_stalled")
+    registry.note_probe("lane-0", clean=True)
+    snap = registry.snapshot()
+    assert snap["readmit_streak"] == 2
+    assert snap["fences_total"] == 1
+    assert snap["readmissions_total"] == 0
+    assert snap["generations"] == {"lane-0": 1}
+    row = snap["recovering"]["lane-0"]
+    assert row["streak"] == 1 and row["need"] == 2
+    assert row["reason"] == "device_op_stalled"

@@ -834,6 +834,7 @@ def test_denial_reasons_closed_set(tmp_path):
     # series nobody dashboards).
     assert set(DENIAL_REASONS) == {
         "chip_seconds",
+        "predicted_overrun",
         "request_rate",
         "concurrency",
         "quarantined",
@@ -870,3 +871,124 @@ def test_metrics_bind_quotas_registers_once(tmp_path):
     metrics2 = ExecutorMetrics()
     metrics2.bind_quotas(disabled)
     assert metrics2.quota_remaining is None
+
+
+# ---------------------------------------------- admission-time cost prediction
+
+
+def test_predicted_overrun_denies_before_the_burn(tmp_path):
+    """The PR 11 carried follow-up: a request whose DECLARED cost
+    (chip_count x timeout) cannot fit the remaining window budget is
+    denied at the door with the typed reason and a refill-derived
+    Retry-After — zero scheduler state, zero chip-seconds burned."""
+    enforcer, ledger, clock = make_enforcer(
+        tmp_path,
+        quota_chip_seconds_per_window=10.0,
+        quota_window_seconds=100.0,
+    )
+    # Fits: 4 chip-seconds declared against a full 10s budget.
+    v = enforcer.admit("t-a", predicted_chip_seconds=4.0)
+    assert v is not None
+    enforcer.release(v)
+    ledger.add("t-a", chip_seconds=8.0)
+    clock.advance(1.0)
+    # Remaining is 2.0; a declared 4.0 cannot fit — typed denial.
+    with pytest.raises(QuotaExceededError) as e:
+        enforcer.admit("t-a", predicted_chip_seconds=4.0)
+    assert e.value.reason == "predicted_overrun"
+    assert e.value.retry_after > 0
+    assert e.value.remaining_chip_seconds == pytest.approx(2.0)
+    # A smaller declaration still fits the same window.
+    v = enforcer.admit("t-a", predicted_chip_seconds=1.5)
+    assert v is not None
+    enforcer.release(v)
+
+
+def test_predicted_overrun_larger_than_whole_budget_backs_off_a_window(
+    tmp_path,
+):
+    enforcer, _ledger, _clock = make_enforcer(
+        tmp_path,
+        quota_chip_seconds_per_window=5.0,
+        quota_window_seconds=100.0,
+    )
+    # Even an empty window can never fit this declaration: denied with a
+    # full-window back-off (the client must shrink the request).
+    with pytest.raises(QuotaExceededError) as e:
+        enforcer.admit("t-a", predicted_chip_seconds=50.0)
+    assert e.value.reason == "predicted_overrun"
+    assert e.value.retry_after >= 99.0
+
+
+def test_predicted_overrun_kill_switch(tmp_path):
+    enforcer, _ledger, _clock = make_enforcer(
+        tmp_path,
+        quota_chip_seconds_per_window=5.0,
+        quota_window_seconds=100.0,
+        quota_cost_prediction=False,
+    )
+    # Prediction off: the declaration is ignored (deny-after-the-burn,
+    # the pre-satellite behavior, byte-for-byte).
+    v = enforcer.admit("t-a", predicted_chip_seconds=50.0)
+    assert v is not None
+    enforcer.release(v)
+
+
+async def test_executor_predicts_from_declared_chip_count_and_timeout(
+    tmp_path,
+):
+    """End to end through the executor: the declared chip_count x clamped
+    timeout is the prediction, and the denial happens BEFORE any sandbox
+    or scheduler state is touched."""
+    executor = make_executor(
+        tmp_path,
+        quota_cost_prediction=True,
+        quota_chip_seconds_per_window=30.0,
+        quota_window_seconds=3600.0,
+        executor_pod_queue_target_length=0,  # no warm pool: spawns visible
+    )
+    try:
+        # 1 chip x 10s = 10 fits the 30s budget.
+        result = await executor.execute(
+            "print(1)", tenant="t-a", timeout=10.0
+        )
+        assert result.exit_code == 0
+        spawns_before = executor.backend.spawns
+        # 8 chips x 10s = 80 cannot fit — denied at the door, no spawn.
+        with pytest.raises(QuotaExceededError) as e:
+            await executor.execute(
+                "print(1)", tenant="t-a", timeout=10.0, chip_count=8
+            )
+        assert e.value.reason == "predicted_overrun"
+        assert executor.backend.spawns == spawns_before
+        assert executor.scheduler.queued(8) == 0
+    finally:
+        await executor.close()
+
+
+async def test_http_predicted_overrun_429(tmp_path):
+    executor = make_executor(
+        tmp_path,
+        quota_cost_prediction=True,
+        quota_chip_seconds_per_window=5.0,
+        quota_window_seconds=3600.0,
+    )
+    client = await http_client_for(executor)
+    try:
+        resp = await client.post(
+            "/v1/execute",
+            json={
+                "source_code": "print(1)",
+                "tenant": "t-a",
+                "timeout": 10.0,
+                "chip_count": 4,
+            },
+        )
+        assert resp.status == 429
+        assert resp.headers["X-Quota-Reason"] == "predicted_overrun"
+        assert int(resp.headers["Retry-After"]) >= 1
+        body = await resp.json()
+        assert body["quota"]["reason"] == "predicted_overrun"
+    finally:
+        await client.close()
+        await executor.close()

@@ -1,0 +1,413 @@
+"""Wedge-recovery actuation unit tests: probe-driven fence_host (lease
+revocation, lane drain, dispose-and-replace), the actuation budget and
+breaker integration, the recovering-scope quarantine and gated
+re-admission, stale-lease refusals on the dispatch paths, session fencing,
+and the /healthz / /statusz surfaces.
+
+Stack: CodeExecutor over FakeBackend with a controllable /device-stats
+wire (the test_device_health pattern) and the fencing actuation ON — the
+posture the detection-only suites deliberately switch off.
+"""
+
+import asyncio
+import tempfile
+
+import httpx
+import pytest
+from fakes import FakeBackend
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.code_executor import (
+    CodeExecutor,
+    StaleLeaseError,
+)
+from bee_code_interpreter_fs_tpu.services.device_health import (
+    DRAINING,
+    HEALTHY,
+    RECOVERING,
+    WEDGED,
+    DeviceHealthProbe,
+)
+from bee_code_interpreter_fs_tpu.services.leases import Lease
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+
+def _stats(**overrides) -> dict:
+    base = {
+        "status": "ok",
+        "warm": True,
+        "warm_state": "ready",
+        "backend": "cpu",
+        "device_kind": "cpu",
+        "device_count": 1,
+        "attach_pending_s": 0.0,
+        "attach_seconds": 1.5,
+        "op_in_flight": False,
+        "op_age_s": 0.0,
+        "op_timeout_s": 0.0,
+        "last_device_op_age_s": 3.0,
+        "runner_heartbeat_age_s": 0.5,
+        "runner_alive": True,
+        "rss_bytes": 1 << 20,
+        "runner_rss_bytes": 2 << 20,
+    }
+    base.update(overrides)
+    return base
+
+
+WEDGE_STATS = dict(
+    warm_state="pending", attach_pending_s=100.0, runner_alive=False
+)
+
+
+class _Stack:
+    """Executor + probe with the fencing actuation ON and a controllable
+    /device-stats wire: `self.responses[url]` is a stats dict (default
+    healthy)."""
+
+    def __init__(self, **config_overrides):
+        self.tmp = tempfile.mkdtemp(prefix="recovery-test-")
+        defaults = dict(
+            file_storage_path=self.tmp,
+            executor_pod_queue_target_length=1,
+            compile_cache_enabled=False,
+            device_probe_interval=10.0,
+            device_probe_timeout=1.0,
+            device_probe_attach_budget=10.0,
+            device_probe_op_grace=5.0,
+            device_probe_wedge_after=10.0,
+            device_probe_readmit_streak=2,
+        )
+        defaults.update(config_overrides)
+        self.config = Config(**defaults)
+        self.backend = FakeBackend(distinct_urls=True)
+        self.executor = CodeExecutor(
+            self.backend, Storage(self.tmp), self.config
+        )
+        self.responses: dict[str, object] = {}
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            key = f"http://{request.url.host}"
+            if request.url.path == "/lease":
+                return httpx.Response(200, json={"ok": True})
+            value = self.responses.get(key)
+            if isinstance(value, dict):
+                return httpx.Response(200, json=value)
+            return httpx.Response(200, json=_stats())
+
+        self._client = httpx.AsyncClient(
+            transport=httpx.MockTransport(handler)
+        )
+        self.executor._http_client = lambda: self._client
+        self.probe = DeviceHealthProbe(self.executor)
+        self.executor.device_health = self.probe
+
+        async def post(client, base, payload, timeout, sandbox):
+            return {
+                "stdout": "ok\n",
+                "stderr": "",
+                "exit_code": 0,
+                "files": [],
+                "warm": True,
+                "duration_s": 0.01,
+            }
+
+        self.executor._post_execute = post
+
+    async def spawn_pooled(self, lane: int = 0):
+        """A properly leased sandbox parked in the lane's pool."""
+        sandbox = await self.executor._spawn_with_retry(lane)
+        self.executor._pool(lane).append(sandbox)
+        return sandbox
+
+    async def settle(self):
+        for _ in range(50):
+            pending = list(self.executor._dispose_tasks) + list(
+                self.executor._fill_tasks
+            )
+            if not pending:
+                return
+            await asyncio.gather(*pending, return_exceptions=True)
+
+    def fences(self) -> dict:
+        return {
+            (labels["lane"], labels["outcome"]): value
+            for labels, value in self.executor.metrics.device_fences.samples()
+        }
+
+    async def close(self):
+        await self._client.aclose()
+        await self.executor.close()
+
+
+@pytest.fixture
+async def stack():
+    s = _Stack()
+    yield s
+    await s.close()
+
+
+# ------------------------------------------------------------------ fencing
+
+
+async def test_spawn_mints_monotonic_lease(stack):
+    a = await stack.executor._spawn_with_retry(0)
+    b = await stack.executor._spawn_with_retry(0)
+    la, lb = a.meta["lease"], b.meta["lease"]
+    assert isinstance(la, Lease) and isinstance(lb, Lease)
+    assert la.scope == lb.scope == "lane-0"
+    assert lb.generation == la.generation + 1
+
+
+async def test_fence_host_drains_disposes_replaces(stack):
+    sandbox = await stack.spawn_pooled(0)
+    lease = sandbox.meta["lease"]
+    deletes = stack.backend.deletes
+    outcome = await stack.executor.fence_host(sandbox.id, reason="wedged")
+    assert outcome == "fenced"
+    # Lease revoked, scope recovering, host drained from the pool and
+    # disposed.
+    assert lease.revoked
+    assert stack.executor.leases.recovering("lane-0")
+    assert sandbox not in stack.executor._pool(0)
+    assert stack.backend.deletes == deletes + 1
+    assert stack.executor.live_sandbox(sandbox.id) is None
+    assert stack.fences()[("0", "fenced")] == 1
+    # The refill replaced it; the replacement holds a NEWER generation and
+    # starts quarantined (recovering) until the clean-probe streak.
+    await stack.settle()
+    pool = stack.executor._pool(0)
+    assert len(pool) == 1
+    replacement = pool[0]
+    assert replacement.meta["lease"].generation > lease.generation
+    assert replacement.meta["device_health"] == "recovering"
+    # Quarantined supply: standby, not servable.
+    assert stack.executor._pool_supply(0) == 0
+    assert stack.executor._pool_standby(0) == 1
+    # Re-fencing the disposed host is a no-op.
+    assert await stack.executor.fence_host(sandbox.id) == "gone"
+
+
+async def test_fence_budget_caps_actuations(stack):
+    stack.executor.config.device_fence_max_per_window = 1
+    a = await stack.spawn_pooled(0)
+    b = await stack.spawn_pooled(0)
+    assert await stack.executor.fence_host(a.id) == "fenced"
+    assert await stack.executor.fence_host(b.id) == "budget_exhausted"
+    # The deferred host is untouched: still live, lease intact.
+    assert stack.executor.live_sandbox(b.id) is not None
+    assert not b.meta["lease"].revoked
+    assert stack.fences()[("0", "budget_exhausted")] == 1
+
+
+async def test_fence_skipped_while_breaker_open(stack):
+    sandbox = await stack.spawn_pooled(0)
+    stack.executor.breakers.lane(0).trip("test")
+    assert await stack.executor.fence_host(sandbox.id) == "breaker_open"
+    assert stack.executor.live_sandbox(sandbox.id) is not None
+    assert stack.fences()[("0", "breaker_open")] == 1
+
+
+async def test_fence_kill_switch_restores_detection_only(stack):
+    stack.executor.config.device_fence_enabled = False
+    sandbox = await stack.spawn_pooled(0)
+    assert await stack.executor.fence_host(sandbox.id) == "disabled"
+    stack.executor.on_host_wedged(sandbox.id)
+    await stack.settle()
+    assert stack.executor.live_sandbox(sandbox.id) is not None
+    assert not stack.executor.leases.recovering("lane-0")
+
+
+async def test_probe_wedge_verdict_triggers_fence(stack):
+    sandbox = await stack.spawn_pooled(0)
+    stack.responses[sandbox.url] = _stats(**WEDGE_STATS)
+    states = await stack.probe.probe_once()
+    assert states[sandbox.url] == WEDGED
+    await stack.settle()
+    assert stack.executor.live_sandbox(sandbox.id) is None
+    assert stack.fences()[("0", "fenced")] == 1
+    # The wedged host left the table on the next cycle (disposed) and the
+    # replacement shows up recovering.
+    states = await stack.probe.probe_once()
+    assert sandbox.url not in states
+    assert RECOVERING in states.values()
+
+
+async def test_draining_overlay_until_disposed(stack):
+    """A fenced-but-not-yet-pruned host reads DRAINING, not whatever its
+    stats would classify."""
+    sandbox = await stack.spawn_pooled(0)
+    sandbox.meta["lease_fenced"] = True
+    states = await stack.probe.probe_once()
+    assert states[sandbox.url] == DRAINING
+
+
+# ------------------------------------------------------------- re-admission
+
+
+async def test_recovering_scope_readmits_after_streak(stack):
+    sandbox = await stack.spawn_pooled(0)
+    await stack.executor.fence_host(sandbox.id)
+    await stack.settle()
+    replacement = stack.executor._pool(0)[0]
+    # Cycle 1: clean, still recovering (streak 1/2).
+    states = await stack.probe.probe_once()
+    assert states[replacement.url] == RECOVERING
+    assert stack.executor._pool_supply(0) == 0
+    # Cycle 2: the streak completes — re-admitted, serving supply again.
+    states = await stack.probe.probe_once()
+    assert states[replacement.url] == HEALTHY
+    assert replacement.meta["device_health"] == "healthy"
+    assert stack.executor._pool_supply(0) == 1
+    assert not stack.executor.leases.recovering("lane-0")
+    readmits = {
+        labels["lane"]: value
+        for labels, value in stack.executor.metrics.host_readmitted.samples()
+    }
+    assert readmits["0"] == 1
+
+
+async def test_suspect_relapse_resets_the_streak(stack):
+    sandbox = await stack.spawn_pooled(0)
+    await stack.executor.fence_host(sandbox.id)
+    await stack.settle()
+    replacement = stack.executor._pool(0)[0]
+    await stack.probe.probe_once()  # clean: streak 1/2
+    # Relapse: the replacement goes suspect mid-streak. The streak resets
+    # AND the quarantine holds — the host keeps reading RECOVERING (a raw
+    # suspect would count as servable supply and be poppable, the escape
+    # the gate exists to prevent).
+    stack.responses[replacement.url] = _stats(
+        warm_state="pending", attach_pending_s=15.0
+    )
+    states = await stack.probe.probe_once()
+    assert states[replacement.url] == RECOVERING
+    assert replacement.meta["device_health"] == "recovering"
+    assert stack.executor._pool_supply(0) == 0
+    assert stack.executor._pop_pool_sandbox(stack.executor._pool(0)) is None
+    assert stack.executor.leases.recovery_progress("lane-0") == (0, 2)
+    # Two consecutive clean cycles are needed all over again.
+    stack.responses[replacement.url] = _stats()
+    await stack.probe.probe_once()
+    assert stack.executor.leases.recovering("lane-0")
+    await stack.probe.probe_once()
+    assert not stack.executor.leases.recovering("lane-0")
+
+
+async def test_pop_pool_never_hands_out_recovering_hosts(stack):
+    sandbox = await stack.spawn_pooled(0)
+    sandbox.meta["device_health"] = "recovering"
+    pool = stack.executor._pool(0)
+    assert stack.executor._pop_pool_sandbox(pool) is None
+    assert len(pool) == 1  # still parked
+    # A healthy host beside it is popped, quarantined one stays.
+    healthy = await stack.spawn_pooled(0)
+    popped = stack.executor._pop_pool_sandbox(pool)
+    assert popped is healthy
+    assert pool[0] is sandbox
+
+
+# ------------------------------------------------------------- stale leases
+
+
+async def test_check_lease_refuses_revoked(stack):
+    sandbox = await stack.executor._spawn_with_retry(0)
+    stack.executor.leases.fence(sandbox.meta["lease"])
+    with pytest.raises(StaleLeaseError):
+        stack.executor._check_lease(sandbox)
+
+
+async def test_execute_retries_off_a_fenced_host(stack):
+    """A pooled sandbox whose lease was revoked (fence raced the pop): the
+    dispatch refuses cleanly, the host is disposed, and the retry ladder
+    lands the request on a FRESH sandbox — never the fenced one."""
+    sandbox = await stack.spawn_pooled(0)
+    sandbox.meta["lease"].revoked = True
+    deletes = stack.backend.deletes
+    result = await stack.executor.execute("print('ok')")
+    assert result.exit_code == 0
+    await stack.settle()
+    assert stack.backend.deletes >= deletes + 1
+    assert stack.executor.live_sandbox(sandbox.id) is None
+
+
+async def test_stale_lease_409_parsing(stack):
+    sandbox = await stack.executor._spawn_with_retry(0)
+    typed = httpx.Response(
+        409, json={"error": "stale_lease", "held": "lane-0:2",
+                   "offered": "lane-0:1"}
+    )
+    with pytest.raises(StaleLeaseError):
+        stack.executor._raise_if_stale_lease(typed, sandbox)
+    # A 409 that is NOT the typed refusal (e.g. /reset's "runner not
+    # warm", /execute-batch's "no warm runner") passes through.
+    stack.executor._raise_if_stale_lease(
+        httpx.Response(409, json={"ok": False, "reason": "runner not warm"}),
+        sandbox,
+    )
+    stack.executor._raise_if_stale_lease(
+        httpx.Response(200, json={}), sandbox
+    )
+
+
+async def test_wire_headers_carry_lease_token(stack):
+    sandbox = await stack.executor._spawn_with_retry(0)
+    headers = stack.executor._wire_headers(sandbox)
+    assert headers["x-lease-token"] == sandbox.meta["lease"].wire_token
+
+
+# ----------------------------------------------------------------- sessions
+
+
+async def test_fence_closes_parked_session(stack):
+    result = await stack.executor.execute("print(1)", executor_id="sess-1")
+    assert result.session_seq == 1
+    session = stack.executor._sessions["sess-1"]
+    sandbox = session.sandbox
+    await stack.executor.fence_host(sandbox.id, reason="wedged")
+    await stack.settle()
+    # The session died AT FENCE TIME — not at idle expiry, not at the
+    # client's timeout.
+    assert session.closed
+    assert "sess-1" not in stack.executor._sessions
+    # The client's reconnect lands on a fresh, healthy host; seq == 1
+    # reports the state loss.
+    result = await stack.executor.execute("print(2)", executor_id="sess-1")
+    assert result.session_seq == 1
+    assert stack.executor._sessions["sess-1"].sandbox is not sandbox
+
+
+# ----------------------------------------------------------------- surfaces
+
+
+async def test_lane_supply_carries_census_and_quarantine_counts(stack):
+    # Mid-drain (fenced, dispose not yet landed): the lane row shows it.
+    draining = await stack.spawn_pooled(0)
+    draining.meta["lease_fenced"] = True
+    await stack.probe.probe_once()
+    rows = stack.executor.lane_supply()
+    assert rows["0"]["draining"] >= 1
+    assert rows["0"]["device_health"].get("draining", 0) >= 1
+    # Full cycle: wedge -> fence -> replacement in recovering quarantine.
+    stack.executor._pool(0).remove(draining)
+    await stack.executor._dispose(draining)
+    sandbox = await stack.spawn_pooled(0)
+    stack.responses[sandbox.url] = _stats(**WEDGE_STATS)
+    await stack.probe.probe_once()
+    await stack.settle()
+    await stack.probe.probe_once()
+    rows = stack.executor.lane_supply()
+    assert rows["0"].get("recovering", 0) == 1
+    assert rows["0"]["device_health"].get("recovering", 0) == 1
+    assert rows["0"]["pooled"] == 0
+
+
+async def test_statusz_recovery_section(stack):
+    sandbox = await stack.spawn_pooled(0)
+    await stack.executor.fence_host(sandbox.id)
+    body = stack.executor.statusz()
+    recovery = body["recovery"]
+    assert recovery["fencing_enabled"] is True
+    assert recovery["fences_total"] == 1
+    assert "lane-0" in recovery["recovering"]
+    assert recovery["fence_budget"]["max_per_window"] == 4

@@ -1,0 +1,310 @@
+"""Seeded wedge-recovery chaos (faults.py attach_hang* -> device_health ->
+fence_host), CHAOS_SEED-parameterized like the other chaos suites: CI pins
+the {7, 23, 1337} matrix and a red leg replays exactly with
+``CHAOS_SEED=<n> pytest tests/unit/test_recovery_chaos.py``.
+
+Legs:
+- fence under load: one lane's host wedges under concurrent traffic on
+  another lane — the wedge is fenced/disposed/replaced with zero failed
+  requests on the healthy lane, and the fenced lease refuses stale claims;
+- actuation cap under a probe storm: every host of a lane reports wedged
+  (the false-positive-storm shape) — disposals stop at the per-window
+  budget instead of mass-disposing the lane;
+- the full lifecycle on the recovering fault (attach_hang_recover):
+  wedge -> drain -> dispose -> respawn -> clean-streak -> re-admit, ending
+  with the lane serving again;
+- constrained-lane re-admission gating: while the only pooled host is
+  recovering, an acquire parks (instead of fighting it for the chip) and
+  completes the moment the streak re-admits.
+"""
+
+import asyncio
+import os
+import random
+import tempfile
+
+import httpx
+import pytest
+from fakes import FakeBackend
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.backends.faults import (
+    ATTACH_HANG,
+    AttachHangTransport,
+    FaultInjectingBackend,
+    FaultSpec,
+)
+from bee_code_interpreter_fs_tpu.services.code_executor import (
+    CodeExecutor,
+    StaleLeaseError,
+)
+from bee_code_interpreter_fs_tpu.services.device_health import (
+    HEALTHY,
+    RECOVERING,
+    WEDGED,
+    DeviceHealthProbe,
+)
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "7"))
+
+
+def _healthy_stats() -> dict:
+    return {
+        "status": "ok",
+        "warm": True,
+        "warm_state": "ready",
+        "backend": "cpu",
+        "device_kind": "cpu",
+        "device_count": 1,
+        "attach_pending_s": 0.0,
+        "attach_seconds": 1.0,
+        "op_in_flight": False,
+        "op_age_s": 0.0,
+        "op_timeout_s": 0.0,
+        "last_device_op_age_s": 1.0,
+        "runner_heartbeat_age_s": 0.1,
+        "runner_alive": True,
+        "rss_bytes": 1,
+        "runner_rss_bytes": 1,
+    }
+
+
+class _Stack:
+    """Executor + probe over the fault-injecting backend, with the seeded
+    attach-hang transport on the sandbox HTTP wire (its inner transport is
+    an always-healthy mock, so only the injected fault misbehaves) and a
+    test-driven clock for the synthesized hang ages."""
+
+    def __init__(self, spec_str: str, **config_overrides):
+        self.tmp = tempfile.mkdtemp(prefix="recovery-chaos-")
+        defaults = dict(
+            file_storage_path=self.tmp,
+            executor_pod_queue_target_length=1,
+            compile_cache_enabled=False,
+            executor_fault_spec=spec_str,
+            device_probe_attach_budget=10.0,
+            device_probe_op_grace=5.0,
+            device_probe_wedge_after=10.0,
+            device_probe_readmit_streak=2,
+        )
+        defaults.update(config_overrides)
+        self.config = Config(**defaults)
+        self.spec = FaultSpec.parse(spec_str)
+        self.faults: list[str] = []
+        self.backend = FaultInjectingBackend(
+            FakeBackend(distinct_urls=True),
+            self.spec,
+            on_fault=self.faults.append,
+        )
+        self.executor = CodeExecutor(
+            self.backend, Storage(self.tmp), self.config
+        )
+        self.now = [0.0]
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            if request.url.path == "/lease":
+                return httpx.Response(200, json={"ok": True})
+            return httpx.Response(200, json=_healthy_stats())
+
+        self.transport = AttachHangTransport(
+            self.spec.attach_hang,
+            self.spec.attach_hang_lane,
+            random.Random(f"{self.spec.seed}:{ATTACH_HANG}"),
+            self.backend._host_lanes,
+            self.faults.append,
+            inner=httpx.MockTransport(handler),
+            clock=lambda: self.now[0],
+            max_hosts=self.spec.attach_hang_max,
+            recover_draws=self.spec.attach_hang_recover,
+        )
+        self._client = httpx.AsyncClient(transport=self.transport)
+        self.executor._http_client = lambda: self._client
+
+        async def post(client, base, payload, timeout, sandbox):
+            return {
+                "stdout": "ok\n",
+                "stderr": "",
+                "exit_code": 0,
+                "files": [],
+                "warm": True,
+                "duration_s": 0.01,
+            }
+
+        self.executor._post_execute = post
+        self.probe = DeviceHealthProbe(self.executor)
+        self.executor.device_health = self.probe
+
+    async def settle(self):
+        for _ in range(80):
+            pending = list(self.executor._dispose_tasks) + list(
+                self.executor._fill_tasks
+            )
+            if not pending:
+                return
+            await asyncio.gather(*pending, return_exceptions=True)
+
+    def fences(self) -> dict:
+        return {
+            (labels["lane"], labels["outcome"]): value
+            for labels, value in self.executor.metrics.device_fences.samples()
+        }
+
+    async def close(self):
+        await self._client.aclose()
+        await self.executor.close()
+
+
+async def test_fence_under_load_spares_the_healthy_lane():
+    """One lane-2 host wedges while lane-0 serves concurrent traffic: the
+    wedge is fenced and replaced, every lane-0 request succeeds, and the
+    fenced lease refuses stale claims."""
+    stack = _Stack(
+        f"attach_hang:1.0,attach_hang_lane:2,attach_hang_max:1,"
+        f"seed:{CHAOS_SEED}"
+    )
+    try:
+        await stack.executor.execute("print(1)")  # lane 0 up
+        await stack.executor.execute("print(1)", chip_count=2)  # lane 2 up
+        await stack.settle()
+        doomed = next(
+            s for lane, s in stack.executor.live_hosts() if lane == 2
+        )
+        old_lease = doomed.meta["lease"]
+        # Concurrent lane-0 load racing the wedge escalation + fence.
+        load = asyncio.gather(
+            *(stack.executor.execute("print(2)") for _ in range(6))
+        )
+        await stack.probe.probe_once()  # hang starts (busy)
+        stack.now[0] += 100.0  # stall far past budget + wedge threshold
+        states = await stack.probe.probe_once()
+        assert states[doomed.url] == WEDGED
+        results = await load
+        assert all(r.exit_code == 0 for r in results)
+        await stack.settle()
+        # Fenced, disposed, replaced; the replacement holds a newer
+        # generation and starts in the recovering quarantine.
+        assert stack.executor.live_sandbox(doomed.id) is None
+        assert stack.fences()[("2", "fenced")] == 1
+        assert old_lease.revoked
+        replacement = stack.executor._pool(2)[0]
+        assert replacement.meta["lease"].generation > old_lease.generation
+        assert replacement.meta["device_health"] == "recovering"
+        # The stale claim dies typed, before any wire hop.
+        with pytest.raises(StaleLeaseError):
+            stack.executor._check_lease(doomed)
+        # attach_hang_max=1: the replacement came up clean — two clean
+        # cycles re-admit the scope and the lane serves again.
+        await stack.probe.probe_once()
+        states = await stack.probe.probe_once()
+        assert states[replacement.url] == HEALTHY
+        result = await stack.executor.execute("print(3)", chip_count=2)
+        assert result.exit_code == 0
+        # The healthy lane never saw a verdict worse than healthy/busy.
+        assert stack.executor.leases.recovering("lane-0") is False
+    finally:
+        await stack.close()
+
+
+async def test_probe_storm_stops_at_the_actuation_budget():
+    """Every host of the lane reports wedged (the probe-false-positive
+    storm): disposals stop at the per-window cap instead of mass-disposing
+    the lane, and the deferred verdicts are counted."""
+    stack = _Stack(
+        f"attach_hang:1.0,attach_hang_lane:0,seed:{CHAOS_SEED}",
+        device_fence_max_per_window=2,
+        device_fence_window_seconds=600.0,
+    )
+    try:
+        for _ in range(4):
+            sandbox = await stack.executor._spawn_with_retry(0)
+            stack.executor._pool(0).append(sandbox)
+        deletes_before = stack.backend.inner.deletes
+        await stack.probe.probe_once()  # hangs start
+        stack.now[0] += 100.0
+        await stack.probe.probe_once()  # every host wedged
+        await stack.settle()
+        fences = stack.fences()
+        assert fences.get(("0", "fenced"), 0) == 2
+        assert fences.get(("0", "budget_exhausted"), 0) >= 2
+        # Only the budgeted hosts were disposed; the rest are deferred,
+        # still live, waiting for the window (or an operator).
+        assert stack.backend.inner.deletes - deletes_before == 2
+        wedged_live = [
+            s
+            for _, s in stack.executor.live_hosts()
+            if s.meta.get("device_health") == "wedged"
+        ]
+        assert len(wedged_live) >= 2
+    finally:
+        await stack.close()
+
+
+async def test_full_lifecycle_on_the_recovering_fault():
+    """wedge -> drain -> dispose -> respawn -> clean-streak -> re-admit,
+    with the seeded attach_hang_recover fault: the replacement's own hang
+    clears after its draws, the streak completes, and the lane serves."""
+    stack = _Stack(
+        f"attach_hang:1.0,attach_hang_lane:0,attach_hang_recover:2,"
+        f"seed:{CHAOS_SEED}"
+    )
+    try:
+        sandbox = await stack.executor._spawn_with_retry(0)
+        stack.executor._pool(0).append(sandbox)
+        await stack.probe.probe_once()  # draw 1: hang starts (busy)
+        stack.now[0] += 100.0
+        states = await stack.probe.probe_once()  # draw 2: wedged
+        assert states[sandbox.url] == WEDGED
+        await stack.settle()
+        assert stack.executor.live_sandbox(sandbox.id) is None
+        assert stack.executor.leases.recovering("lane-0")
+        replacement = stack.executor._pool(0)[0]
+        # The replacement hangs too (rate 1.0), but its hang clears after
+        # its 2 draws — its early "attaching" probes count clean (busy),
+        # the streak completes, and the scope re-admits.
+        states = await stack.probe.probe_once()
+        assert states[replacement.url] == RECOVERING
+        states = await stack.probe.probe_once()
+        assert states[replacement.url] == HEALTHY
+        assert not stack.executor.leases.recovering("lane-0")
+        # Post-recovery the transport serves REAL stats (the hang cleared
+        # for good) and the lane serves requests again.
+        states = await stack.probe.probe_once()
+        assert states[replacement.url] == HEALTHY
+        result = await stack.executor.execute("print(1)")
+        assert result.exit_code == 0
+    finally:
+        await stack.close()
+
+
+async def test_constrained_lane_acquire_parks_until_readmission():
+    """Capacity-1 lane whose only pooled host is recovering: an acquire
+    must not spawn a competitor for the chip the quarantined host still
+    owns — it parks, and completes the moment the streak re-admits."""
+    stack = _Stack(f"attach_hang:0.0,seed:{CHAOS_SEED}")
+    stack.backend.inner.capacity = 1
+    try:
+        sandbox = await stack.executor._spawn_with_retry(0)
+        stack.executor._pool(0).append(sandbox)
+        lease = sandbox.meta["lease"]
+        # Fence the scope by hand (the probe path is covered above), then
+        # put a fresh recovering host in the pool.
+        stack.executor.leases.fence(lease)
+        stack.executor._pool(0).remove(sandbox)
+        await stack.executor._dispose(sandbox)
+        replacement = await stack.executor._spawn_with_retry(0)
+        assert replacement.meta["device_health"] == "recovering"
+        stack.executor._pool(0).append(replacement)
+        spawns_before = stack.backend.inner.spawns
+        request = asyncio.create_task(stack.executor.execute("print(1)"))
+        await asyncio.sleep(0.05)
+        assert not request.done()  # parked, not spawning a competitor
+        assert stack.backend.inner.spawns == spawns_before
+        # Two clean probe cycles re-admit the scope; the settle kicks the
+        # parked waiter, which pops the re-admitted host.
+        await stack.probe.probe_once()
+        await stack.probe.probe_once()
+        result = await asyncio.wait_for(request, timeout=5.0)
+        assert result.exit_code == 0
+    finally:
+        await stack.close()
