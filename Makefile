@@ -30,9 +30,9 @@ verify:
 test-sanitizers:
 	$(MAKE) -C executor asan tsan
 	ASAN_OPTIONS=detect_leaks=1 TEST_EXECUTOR_BINARY=$(CURDIR)/executor/build/executor-server-asan \
-		python -m pytest tests/unit/test_executor_server.py tests/unit/test_executor_limits.py tests/unit/test_executor_cgroup.py -q
+		python -m pytest tests/unit/test_executor_server.py tests/unit/test_executor_limits.py tests/unit/test_executor_cgroup.py tests/unit/test_executor_perf.py -q
 	TSAN_OPTIONS=halt_on_error=1 TEST_EXECUTOR_BINARY=$(CURDIR)/executor/build/executor-server-tsan \
-		python -m pytest tests/unit/test_executor_server.py tests/unit/test_executor_limits.py tests/unit/test_executor_cgroup.py -q
+		python -m pytest tests/unit/test_executor_server.py tests/unit/test_executor_limits.py tests/unit/test_executor_cgroup.py tests/unit/test_executor_perf.py -q
 
 bench: executor
 	python bench.py

@@ -79,6 +79,21 @@ async def main(ctx: ApplicationContext | None = None) -> None:
     # every APP_USAGE_FLUSH_INTERVAL seconds, so a crash loses at most one
     # interval of accounting (the kill switch makes start() a no-op).
     ctx.usage_ledger.start()
+    # The performance anomaly plane is passive too (windows roll lazily on
+    # the request path; no daemon): log its posture so a boot log answers
+    # "was drift detection even on?" during a latency incident.
+    perf = ctx.code_executor.perf
+    if perf.enabled:
+        logger.info(
+            "perf observer active (window=%gs, drift=p%d, bands x%g/x%g, "
+            "auto-profile=%s, store=%d entries)",
+            perf.window_s,
+            int(perf.drift_quantile * 100),
+            perf.degraded_factor,
+            perf.regressed_factor,
+            "on" if perf.auto_profile else "off",
+            perf.store.entry_count() if perf.store is not None else 0,
+        )
     # Quota enforcement is passive (checked per admission; policy file
     # hot-reloads lazily) — nothing to start, but its posture is exactly
     # what an operator greps boot logs for during an abuse incident.

@@ -333,6 +333,76 @@ class Config(BaseModel):
     # acted on until the window slides. 0 = uncapped.
     device_fence_max_per_window: int = 4
     device_fence_window_seconds: float = 600.0
+    # Strict lease-token mode (the PR 13 carried follow-up): when 1, every
+    # sandbox boots with APP_LEASE_REQUIRE_TOKEN=1 and its executor 409s
+    # any dispatch arriving WITHOUT an x-lease-token once a lease has been
+    # recorded — closing the tokenless-compatibility hole for fleets whose
+    # control planes are fully rolled onto lease stamping. Default off:
+    # old control planes (and manual curl) keep working against new
+    # binaries, the PR 13 compatibility contract.
+    lease_require_token: bool = False
+    # -- performance anomaly plane (services/perf_observer.py) ----------------
+    # Kill switch for the whole plane: 0 restores today's behavior
+    # byte-for-byte — no latency baselines, no drift verdicts, no
+    # device-memory sampling requested from sandboxes, no auto-profiling,
+    # /perf and /profiles answer 404, no perf metric families.
+    perf_observer_enabled: bool = True
+    # Drift-detection window: each (lane, phase) series' samples bucket
+    # into windows of this many seconds; a closed window with enough
+    # samples is classified normal/degraded/regressed against the EWMA
+    # baseline. Small enough that a regression flips a verdict while the
+    # incident is still live; large enough that one slow request isn't a
+    # "window".
+    perf_window_seconds: float = 30.0
+    # A window needs at least this many samples to be judged (thinner
+    # windows keep the standing verdict — no data is not a regression).
+    perf_min_window_samples: int = 8
+    # EWMA smoothing for the baseline learned from NORMAL windows (higher
+    # = adapts faster to legitimate shifts, forgives slow creep sooner).
+    perf_baseline_alpha: float = 0.3
+    # Classification bands: a window's drift quantile past
+    # baseline*degraded_factor is degraded, past baseline*regressed_factor
+    # is regressed (the transition that fires perf_regression_total, the
+    # perf.regression span, and the auto-profile trigger).
+    perf_degraded_factor: float = 1.5
+    perf_regressed_factor: float = 3.0
+    # Which window quantile drives drift classification (p95 default: tail
+    # regressions are the ones that page, and medians hide bimodal hangs).
+    perf_drift_quantile: float = 0.95
+    # Absolute slack added under every band: sub-millisecond phases jitter
+    # by whole multiples without meaning anything — a "3x regression" on a
+    # 0.2ms upload phase is scheduler noise, not an incident.
+    perf_min_band_seconds: float = 0.02
+    # Series-cardinality bounds: (lane, phase) series past the cap are not
+    # tracked; tenant series past their cap collapse into `_overflow` (the
+    # scheduler/ledger/device-health discipline).
+    perf_max_series: int = 64
+    perf_max_tenants: int = 64
+    # -- auto-triggered profiling ---------------------------------------------
+    # Arm the JAX profiler for the next eligible request on a lane whose
+    # drift verdict flipped regressed (or that landed past the cumulative
+    # p99 band). 0 keeps the baselines/verdicts but never auto-profiles.
+    perf_profile_auto: bool = True
+    # A single request slower than cumulative-p99 * this factor arms a
+    # profile capture even without a window verdict (the "one request went
+    # off a cliff" trigger).
+    perf_p99_outlier_factor: float = 2.0
+    # Throttle: after a capture is consumed on a lane, new triggers are
+    # dropped for this many seconds (a standing regression must not
+    # profile every request on the lane).
+    perf_profile_min_interval_seconds: float = 60.0
+    # Tenants that must NEVER be auto-profiled (JSON list): a profile
+    # captures kernel names and timing structure of tenant code, so
+    # consent is opt-out per tenant. Client-requested profile=True is
+    # unaffected — that is the tenant profiling itself.
+    perf_profile_tenant_opt_out: list = Field(default_factory=list)
+    # Harvested-profile store (content-addressed, LRU by last access,
+    # byte/entry-capped, index persisted across restarts — the
+    # compile-cache store discipline). Empty path = a ".profiles" dir
+    # under file_storage_path.
+    perf_profile_store_path: str = ""
+    perf_profile_store_max_bytes: int = 268435456
+    perf_profile_store_max_entries: int = 256
     # -- OTLP export (utils/otlp.py) ------------------------------------------
     # OTLP/HTTP JSON collector base URL (spans POST to <endpoint>/v1/traces,
     # metric snapshots to <endpoint>/v1/metrics). Empty = the kill switch:

@@ -52,6 +52,19 @@ on in any deployment (``APP_EXECUTOR_FAULT_SPEC=spawn_fail:0.3,seed:7``):
                          streak (clean probes after a fence) and its
                          suspect-relapse reset become drivable from a
                          seeded spec instead of hand-faked responses.
+    slow_exec:<rate>     probability an execute dispatch (/execute,
+                         /execute/stream, /execute-batch) is DELAYED by
+                         slow_exec_seconds before reaching the sandbox —
+                         a latency regression, not an error: the request
+                         succeeds, only slower. This is the perf anomaly
+                         plane's chaos signal (the drift detector must
+                         flip the affected lane's exec series to
+                         regressed while clean lanes stay normal).
+    slow_exec_seconds:<s> the injected delay (default 0.25).
+    slow_exec_lane:<n>   restrict slow_exec to hosts of ONE chip-count
+                         lane (-1 = any lane, the default) — the perf e2e
+                         regresses one lane while proving the other's
+                         baseline holds.
     seed:<int>           the plan seed (default 0)
 
 Rates are in [0, 1]; delays are seconds. Unknown keys fail loudly — a typo'd
@@ -81,6 +94,7 @@ DELETE_HANG = "delete_hang"
 EXEC_DROP = "exec_drop"
 VIOLATION = "violation"
 ATTACH_HANG = "attach_hang"
+SLOW_EXEC = "slow_exec"
 
 
 @dataclass(frozen=True)
@@ -96,6 +110,9 @@ class FaultSpec:
     attach_hang_lane: int = -1
     attach_hang_max: int = 0
     attach_hang_recover: int = 0
+    slow_exec: float = 0.0
+    slow_exec_seconds: float = 0.25
+    slow_exec_lane: int = -1
     seed: int = 0
 
     @classmethod
@@ -121,6 +138,7 @@ class FaultSpec:
                     "attach_hang_lane",
                     "attach_hang_max",
                     "attach_hang_recover",
+                    "slow_exec_lane",
                 ):
                     values[key] = int(raw)
                 elif key == "violation_kind":
@@ -132,11 +150,18 @@ class FaultSpec:
                     f"bad fault spec value for {key}: {raw!r}"
                 ) from None
         spec = cls(**values)
-        for name in (SPAWN_FAIL, RESET_FAIL, EXEC_DROP, VIOLATION, ATTACH_HANG):
+        for name in (
+            SPAWN_FAIL,
+            RESET_FAIL,
+            EXEC_DROP,
+            VIOLATION,
+            ATTACH_HANG,
+            SLOW_EXEC,
+        ):
             rate = getattr(spec, name)
             if not 0.0 <= rate <= 1.0:
                 raise ValueError(f"fault rate {name} must be in [0,1]: {rate}")
-        for name in (SLOW_READY, DELETE_HANG):
+        for name in (SLOW_READY, DELETE_HANG, "slow_exec_seconds"):
             if getattr(spec, name) < 0.0:
                 raise ValueError(f"fault delay {name} must be >= 0")
         if spec.violation_kind not in VIOLATION_KINDS:
@@ -158,6 +183,8 @@ class FaultSpec:
                 "attach_hang_lane",
                 "attach_hang_max",
                 "attach_hang_recover",
+                "slow_exec_seconds",
+                "slow_exec_lane",
             )
         )
 
@@ -327,6 +354,61 @@ class AttachHangTransport(httpx.AsyncBaseTransport):
         await self.inner.aclose()
 
 
+class SlowExecTransport(httpx.AsyncBaseTransport):
+    """httpx transport that DELAYS a seeded fraction of execute dispatches
+    (/execute, /execute/stream, /execute-batch) before they reach the
+    sandbox — a latency regression, not an error: the request succeeds,
+    only slower. Optionally restricted to one chip-count lane via the
+    backend's host→lane map, so a chaos leg can regress one lane while
+    the control plane proves the others' baselines hold. This is the perf
+    anomaly plane's chaos signal: the drift detector must flip the
+    affected (lane, exec) series to regressed within one window."""
+
+    _EXEC_PATHS = ("/execute", "/execute/stream", "/execute-batch")
+
+    def __init__(
+        self,
+        rate: float,
+        delay_s: float,
+        lane: int,
+        rng: random.Random,
+        host_lanes: dict[str, int],
+        on_fault: Callable[[str], None] | None = None,
+        inner: httpx.AsyncBaseTransport | None = None,
+    ) -> None:
+        self.rate = rate
+        self.delay_s = delay_s
+        self.lane = lane
+        self.rng = rng
+        self.host_lanes = host_lanes
+        self.on_fault = on_fault
+        self.inner = inner or httpx.AsyncHTTPTransport()
+
+    async def handle_async_request(self, request):
+        if (
+            request.method == "POST"
+            and request.url.path in self._EXEC_PATHS
+        ):
+            key = f"{request.url.host}:{request.url.port}"
+            lane = self.host_lanes.get(key)
+            eligible = self.lane < 0 or (
+                lane is not None and lane == self.lane
+            )
+            # The draw happens for EVERY dispatch (eligible or not) so the
+            # seeded stream's consumption — and therefore every other
+            # category's interleaving — does not depend on which lane a
+            # request happened to land on.
+            fired = self.rng.random() < self.rate
+            if eligible and fired:
+                if self.on_fault is not None:
+                    self.on_fault(SLOW_EXEC)
+                await asyncio.sleep(self.delay_s)
+        return await self.inner.handle_async_request(request)
+
+    async def aclose(self) -> None:
+        await self.inner.aclose()
+
+
 class DroppingTransport(httpx.AsyncBaseTransport):
     """httpx transport that raises `httpx.ConnectError` on a seeded fraction
     of requests before delegating to the real transport — the mid-execute
@@ -383,6 +465,7 @@ class FaultInjectingBackend(SandboxBackend):
                 EXEC_DROP,
                 VIOLATION,
                 ATTACH_HANG,
+                SLOW_EXEC,
             )
         }
         # "host:port" -> lane, recorded at spawn so the attach-hang
@@ -433,7 +516,9 @@ class FaultInjectingBackend(SandboxBackend):
             self._fire(SLOW_READY, 1.0)  # counted, never skipped
             await asyncio.sleep(self.spec.slow_ready)
         sandbox = await self.inner.spawn(chip_count)
-        if self.spec.attach_hang > 0.0:
+        if self.spec.attach_hang > 0.0 or self.spec.slow_exec > 0.0:
+            # Both lane-restrictable transports key off "host:port": record
+            # the lane at spawn, where topology is still known.
             for url in sandbox.host_urls:
                 parsed = httpx.URL(url)
                 self._host_lanes[f"{parsed.host}:{parsed.port}"] = chip_count
@@ -486,5 +571,15 @@ class FaultInjectingBackend(SandboxBackend):
                 inner=transport,
                 max_hosts=self.spec.attach_hang_max,
                 recover_draws=self.spec.attach_hang_recover,
+            )
+        if self.spec.slow_exec > 0.0:
+            transport = SlowExecTransport(
+                self.spec.slow_exec,
+                self.spec.slow_exec_seconds,
+                self.spec.slow_exec_lane,
+                self._rngs[SLOW_EXEC],
+                self._host_lanes,
+                self.on_fault,
+                inner=transport,
             )
         return transport

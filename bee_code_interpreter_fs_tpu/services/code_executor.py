@@ -68,6 +68,7 @@ from .errors import (  # noqa: F401 — canonical home is errors.py; re-exported
 )
 from .leases import Lease, LeaseRegistry
 from .limits import VIOLATION_KINDS, request_limits, validate_config_limits
+from .perf_observer import PerfObserver
 from .quotas import QuotaEnforcer, QuotaVerdict
 from .scheduler import SandboxScheduler
 from .storage import Storage, StorageObjectNotFound
@@ -102,6 +103,17 @@ LATENCY_PHASES = frozenset({"queue_wait", "upload", "exec", "download"})
 # plumbing without widening every signature in between.
 _trusted_source_var: contextvars.ContextVar[bool] = contextvars.ContextVar(
     "compile_cache_trusted_source", default=False
+)
+
+# The trigger reason when THIS request's profiler run was armed by the perf
+# observer (auto-triggered profiling), None otherwise. Control-plane-induced
+# work must not hit tenant ledgers (the PR 9 trusted-run rule): the harvest
+# path reads this to pull profile.zip OUT of the tenant's files/bill and
+# into the profile store. A contextvar for the same reason as
+# _trusted_source_var: the flag must ride the request's own task through
+# the session/stream plumbing without widening every signature in between.
+_auto_profile_var: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "perf_auto_profile_reason", default=None
 )
 
 
@@ -168,6 +180,7 @@ class CodeExecutor:
         compile_cache: CompileCacheStore | None = None,
         usage: UsageLedger | None = None,
         quotas: QuotaEnforcer | None = None,
+        perf: PerfObserver | None = None,
     ) -> None:
         self.backend = backend
         self.storage = storage
@@ -334,6 +347,19 @@ class CodeExecutor:
         # device_fence_max_per_window actuations per window, so a probe
         # false-positive storm cannot mass-dispose a serving lane.
         self._fence_times: dict[int, deque[float]] = {}
+        # Performance anomaly plane (services/perf_observer.py): streaming
+        # latency baselines per (lane, phase) and per tenant, EWMA-banded
+        # drift verdicts, per-request device-memory accounting, and
+        # auto-triggered profiling. The kill switch constructs a disabled
+        # observer — no recording, no device-memory wire field, no
+        # auto-profiles, no perf metric families: today's behavior
+        # byte-for-byte.
+        self.perf = perf or PerfObserver(
+            self.config,
+            metrics=self.metrics,
+            tracer=self.tracer,
+            clock=self.scheduler.now,
+        )
         # Telemetry-plane attachments (set by the application context): the
         # device-health probe daemon and the OTLP exporter, surfaced through
         # GET /statusz. Optional — the executor runs fine without either.
@@ -349,6 +375,7 @@ class CodeExecutor:
         self.metrics.bind_compile_cache(self.compile_cache)
         self.metrics.bind_autoscale(self)
         self.metrics.bind_quotas(self.quotas)
+        self.metrics.bind_perf(self.perf)
 
     def _http_client(self) -> httpx.AsyncClient:
         if self._client is None or self._client.is_closed:
@@ -1208,6 +1235,27 @@ class CodeExecutor:
                         # spawn, not fall through to the acquire deadline.
                         or now >= grace_deadline
                     )
+                if (
+                    can_spawn
+                    and self.leases.recovering(self._lease_scope(chip_count))
+                    and (self._pool_standby(chip_count) > 0 or spawning > 0)
+                ):
+                    # The lane's lease scope is mid-quarantine (a fence's
+                    # replacement is earning its clean-probe streak) and a
+                    # standby replacement already exists or is on its way:
+                    # a direct spawn would land on the SAME recovering
+                    # hardware and hand it straight to this request —
+                    # exactly the early-handout _pop_pool_sandbox refuses
+                    # for pooled hosts. Constrained lanes were already
+                    # covered by the standby capacity count; unconstrained
+                    # lanes (where nothing counted standby) slipped
+                    # through. Park in fair order instead — the
+                    # re-admission settle kicks every lane the moment the
+                    # standby can serve. (With NO standby anywhere, the
+                    # spawn below still runs: its recovering-marked result
+                    # is parked as the scope's probe target, never handed
+                    # out — see the post-spawn check.)
+                    can_spawn = False
                 if can_spawn:
                     # Count the direct spawn in _spawning: a concurrent
                     # waiter evaluating the guards mid-spawn must see it, or
@@ -1226,6 +1274,32 @@ class CodeExecutor:
                     finally:
                         self._spawning[chip_count] -= 1
                         self._notify_lane(chip_count)
+                    if sandbox.meta.get("device_health") in (
+                        "recovering",
+                        "draining",
+                    ):
+                        # The spawn landed on a quarantined lease scope
+                        # (the fence raced this spawn, or this spawn IS
+                        # the fenced scope's first replacement): the
+                        # sandbox must serve NOTHING until the clean-probe
+                        # streak re-admits it. Park it as the scope's
+                        # standby/probe target and rejoin the queue — the
+                        # standby gate above stops the next loop from
+                        # spawning again behind it.
+                        sandbox.meta["pooled_at"] = self.scheduler.now()
+                        pool.append(sandbox)
+                        sandbox = None
+                        self._notify_lane(chip_count)
+                        ticket = self.scheduler.submit(
+                            chip_count,
+                            tenant=tenant,
+                            priority=priority,
+                            deadline=deadline,
+                            pool_ready=self._pool_supply(chip_count),
+                            jobs=jobs,
+                            metered=not _trusted_source_var.get(),
+                        )
+                        continue
                     break
                 if granted:
                     # Nothing to pop and must not spawn: back to sleep in
@@ -1340,6 +1414,13 @@ class CodeExecutor:
         quota = self._quota_admit(
             usage_tenant, chip_count=chip_count, timeout=timeout
         )
+        # Auto-triggered profiling: a pending arm on this request's lane
+        # (set by the drift detector or a p99 outlier) is consumed here,
+        # AFTER admission — a denied request must not eat the arm. The
+        # profiler env rides this request, and the contextvar marks it so
+        # the pipeline harvests (and zero-bills) the artifact.
+        env, auto_profile = self._maybe_auto_profile(env, chip_count, tenant)
+        profile_token = _auto_profile_var.set(auto_profile)
         self._inflight += 1
         try:
             if executor_id is not None:
@@ -1408,11 +1489,51 @@ class CodeExecutor:
         finally:
             self._inflight -= 1
             self.quotas.release(quota)
+            _auto_profile_var.reset(profile_token)
         self._apply_quota_phases(result, quota)
         self._count_execution(
-            result, session=executor_id is not None, usage_tenant=usage_tenant
+            result,
+            session=executor_id is not None,
+            usage_tenant=usage_tenant,
+            lane=self._lane_hint(chip_count),
+            tenant=tenant,
         )
         return result
+
+    def _lane_hint(self, chip_count: int | None) -> int:
+        """The lane a request resolves to before validation (the perf
+        observer's series key and the auto-profile arm lookup)."""
+        if chip_count is None:
+            return self.config.default_chip_count
+        try:
+            return int(chip_count)
+        except (TypeError, ValueError):
+            return self.config.default_chip_count
+
+    def _maybe_auto_profile(
+        self,
+        env: dict[str, str] | None,
+        chip_count: int | None,
+        tenant: str | None,
+    ) -> tuple[dict[str, str] | None, str | None]:
+        """Consume a pending auto-profile arm for this request's lane, if
+        its tenant consents: returns (env with APP_JAX_PROFILE, trigger
+        reason) or (env unchanged, None). Client-requested profiling
+        (profile=True / explicit env) always wins — that run is the tenant
+        profiling itself and bills normally; trusted control-plane runs
+        are never auto-profiled (their latencies aren't even recorded)."""
+        if not self.perf.enabled or _trusted_source_var.get():
+            return env, None
+        if env and "APP_JAX_PROFILE" in env:
+            return env, None
+        try:
+            label = self.scheduler.normalize_tenant(tenant)
+        except ValueError:
+            return env, None  # the request's own validation owns this
+        reason = self.perf.take_profile_arm(self._lane_hint(chip_count), label)
+        if reason is None:
+            return env, None
+        return {**(env or {}), "APP_JAX_PROFILE": "1"}, reason
 
     def _quota_admit(
         self,
@@ -1839,6 +1960,9 @@ class CodeExecutor:
                 for job, device in zip(jobs, assignment)
             ],
         }
+        if self.perf.enabled:
+            # Per-job device-memory brackets, same knob as the serial path.
+            payload["device_memory"] = True
         if key.env:
             payload["env"] = dict(key.env)
         if key.limits:
@@ -1982,10 +2106,22 @@ class CodeExecutor:
         )
         stats.emit(self.metrics)
         if usage_tenant is not None:
+            # hbm-byte-seconds, fused-path flavor: each job's peak
+            # integrated over ITS device-op share, summing to the same
+            # bill the jobs would produce serially (path-invariance, the
+            # chip-second discipline).
+            hbm_byte_seconds = sum(
+                self._block_peak_bytes(entry["device_memory"])
+                * device_op
+                * share
+                for entry, share in zip(results, shares)
+                if isinstance(entry.get("device_memory"), dict)
+            )
             self.usage.add(
                 usage_tenant,
                 batch_jobs=n,
                 download_bytes=stats.download_bytes,
+                hbm_byte_seconds=hbm_byte_seconds,
             )
         # A clean fused run ends the lane's consecutive-violation streak,
         # exactly like a clean serial run.
@@ -2164,6 +2300,11 @@ class CodeExecutor:
             phases["chip_seconds"] = round(chip_seconds_share, 6)
         if device_op_share is not None:
             phases["device_op_seconds"] = round(device_op_share, 6)
+        # Per-job device-memory block (best-effort under concurrent
+        # batchmates — one address space): same phase keys as the serial
+        # path, so a client reads one shape either way.
+        mem_phases, _peak = self._device_memory_phases([entry])
+        phases.update(mem_phases)
         if job.trace_id is not None:
             phases["trace_id"] = job.trace_id
         return Result(
@@ -2342,6 +2483,13 @@ class CodeExecutor:
                 )
         with timer.phase("exec"):
             payload: dict = {"timeout": timeout}
+            if self.perf.enabled:
+                # Ask the sandbox for the device-memory bracket (live/peak
+                # buffer bytes + runner RSS around the run). Only when the
+                # perf plane is live — the kill switch keeps the wire
+                # payload, and the runner's sampling cost, byte-for-byte
+                # what it is today.
+                payload["device_memory"] = True
             if env:
                 payload["env"] = env
             if limits:
@@ -2383,18 +2531,20 @@ class CodeExecutor:
                         0.0, time.perf_counter() - exec_started
                     )
                 raise failure
+            # The executor's OWN op window (the device_op_seconds wire
+            # field; duration_s on an older binary) — NOT control-plane
+            # wall, which includes queueing/transfer. A multi-host slice's
+            # hosts run one op in parallel: the op wall is the slowest
+            # host's. Held in a local because both the chip-second bill
+            # and the hbm-byte-second integral below read it.
+            op_wall = self._reported_device_op(
+                bodies,
+                fallback=max(0.0, time.perf_counter() - exec_started),
+            )
             if usage is not None:
-                # Billed from the executor's OWN op window (the
-                # device_op_seconds wire field; duration_s on an older
-                # binary) — NOT control-plane wall, which includes
-                # queueing/transfer. A multi-host slice's hosts run one op
-                # in parallel: the op wall is the slowest host's. Observed
-                # BEFORE the violation check below, so a violating request
-                # still bills the device time it consumed.
-                usage.device_op_seconds += self._reported_device_op(
-                    bodies,
-                    fallback=max(0.0, time.perf_counter() - exec_started),
-                )
+                # Observed BEFORE the violation check below, so a violating
+                # request still bills the device time it consumed.
+                usage.device_op_seconds += op_wall
             self._raise_on_violation(sandbox, hosts, bodies)
         with timer.phase("download"):
             with self.tracer.span("transfer.download") as download_span:
@@ -2432,8 +2582,30 @@ class CodeExecutor:
         stats.emit(self.metrics)
         phases = {**timer.as_dict(), **stats.as_phases()}
         phases.update(self._compile_cache_phases(sandbox, bodies))
+        # Device-memory accounting: the hosts' wire blocks folded into
+        # phases (peak_hbm_bytes / live_buffer_bytes_delta — non-latency
+        # keys, excluded from the histogram by the allowlist) and, below,
+        # integrated over the op wall into the tenant's ledger.
+        mem_phases, peak_hbm = self._device_memory_phases(bodies)
+        phases.update(mem_phases)
+        # Auto-profile harvest: a control-plane-armed profiler run's
+        # profile.zip moves OUT of the tenant's files into the profile
+        # store — the tenant neither asked for nor receives it, and (the
+        # PR 9 trusted-run rule) must not be billed its transfer.
+        auto_profile = _auto_profile_var.get()
+        harvested_bytes = 0
+        if auto_profile is not None:
+            harvested_bytes = await self._harvest_profile(
+                merged_files,
+                sandbox,
+                auto_profile,
+                tenant=usage.tenant if usage is not None else None,
+            )
         if usage is not None:
-            usage.download_bytes += stats.download_bytes
+            usage.hbm_byte_seconds += max(0.0, peak_hbm) * op_wall
+            usage.download_bytes += max(
+                0, stats.download_bytes - harvested_bytes
+            )
             usage.compile_cache_recompiles += float(
                 phases.get("compile_cache_misses", 0.0)
             )
@@ -2485,6 +2657,126 @@ class CodeExecutor:
             float(v) for v in values if isinstance(v, (int, float)) and v >= 0
         ]
         return max(numbers) if numbers else max(0.0, fallback)
+
+    PROFILE_ARTIFACT = "/workspace/profile.zip"
+
+    @staticmethod
+    def _block_peak_bytes(block: dict) -> float:
+        """One host's per-request peak device-buffer bytes from its
+        device_memory wire block. When the allocator's process-lifetime
+        peak MOVED during the run, that new high-water IS this request's
+        peak; otherwise the request ran under an older high-water and the
+        honest per-request figure is what it actually held (the larger of
+        the live samples bracketing the run — the CPU/live_arrays path,
+        which has no allocator peak at all, always lands here). -1 wire
+        values mean "unavailable" and never poison the max."""
+
+        def num(key: str) -> float:
+            value = block.get(key)
+            return float(value) if isinstance(value, (int, float)) else -1.0
+
+        live = [
+            v
+            for v in (num("live_bytes_before"), num("live_bytes_after"))
+            if v >= 0
+        ]
+        base = max(live) if live else 0.0
+        peak_before = num("peak_bytes_before")
+        peak_after = num("peak_bytes_after")
+        if peak_after >= 0 and peak_after > peak_before >= 0:
+            return max(base, peak_after)
+        return base
+
+    def _device_memory_phases(
+        self, bodies: list[dict]
+    ) -> tuple[dict[str, float], float]:
+        """Fold the hosts' device_memory wire blocks into Result.phases
+        fields; returns (phases, peak_hbm_bytes). A multi-host slice sums
+        peaks and live deltas across hosts (the slice's total footprint)
+        and reports the largest runner RSS. Returns ({}, 0) when no host
+        reported (old binary, cold subprocess, plane disabled)."""
+        if not self.perf.enabled:
+            return {}, 0.0
+        peak = delta = 0.0
+        rss = -1.0
+        seen = False
+        for body in bodies:
+            block = body.get("device_memory")
+            if not isinstance(block, dict):
+                continue
+            seen = True
+            peak += self._block_peak_bytes(block)
+            before = block.get("live_bytes_before")
+            after = block.get("live_bytes_after")
+            if (
+                isinstance(before, (int, float))
+                and isinstance(after, (int, float))
+                and before >= 0
+                and after >= 0
+            ):
+                delta += float(after) - float(before)
+            block_rss = block.get("rss_bytes")
+            if isinstance(block_rss, (int, float)) and block_rss > rss:
+                rss = float(block_rss)
+        if not seen:
+            return {}, 0.0
+        phases: dict[str, float] = {
+            "peak_hbm_bytes": round(peak, 1),
+            "live_buffer_bytes_delta": round(delta, 1),
+        }
+        if rss >= 0:
+            phases["runner_rss_bytes"] = round(rss, 1)
+        return phases, peak
+
+    async def _harvest_profile(
+        self,
+        merged_files: dict[str, str],
+        sandbox: Sandbox,
+        reason: str,
+        *,
+        tenant: str | None,
+    ) -> int:
+        """Move an auto-captured profile.zip from the request's changed
+        files into the profile store (content-addressed, trace-id
+        cross-linked). Returns the artifact's byte size so the caller can
+        exempt the harvest from the tenant's transfer bill. Best-effort:
+        a failed harvest logs and bills nothing extra — the artifact
+        simply stays in the tenant's files like a client-requested
+        profile."""
+        object_id = merged_files.get(self.PROFILE_ARTIFACT)
+        if object_id is None:
+            return 0
+        try:
+            data = await self.storage.read(object_id)
+        except (StorageObjectNotFound, OSError):
+            logger.warning(
+                "auto-profile artifact %s unreadable; leaving it in the "
+                "request's files",
+                object_id,
+            )
+            return 0
+        profile_id = self.perf.note_profile_captured(
+            data,
+            lane=sandbox.chip_count,
+            reason=reason,
+            tenant=tenant,
+            trace_id=tracing.current_trace_id(),
+        )
+        if profile_id is None:
+            # The store couldn't make the artifact durable (full/unwritable
+            # volume): leave the ONLY copy in the request's files — billed
+            # and returned like a client-requested profile — instead of
+            # destroying the regression evidence.
+            logger.warning(
+                "auto-profile store rejected the artifact; leaving it in "
+                "the request's files (billed normally)"
+            )
+            return 0
+        del merged_files[self.PROFILE_ARTIFACT]
+        tracing.add_event(
+            "perf.profile_harvested", reason=reason, bytes=len(data)
+        )
+        return len(data)
 
     @staticmethod
     def _cc_count(block, key: str) -> int:
@@ -2617,6 +2909,11 @@ class CodeExecutor:
         quota = self._quota_admit(
             usage_tenant, chip_count=chip_count, timeout=timeout
         )
+        # Auto-profile arming, like execute() (post-admission). Set BEFORE
+        # the run task is created: create_task snapshots the contextvars,
+        # which is how the marker reaches the pipeline inside run().
+        env, auto_profile = self._maybe_auto_profile(env, chip_count, tenant)
+        profile_token = _auto_profile_var.set(auto_profile)
         queue: asyncio.Queue = asyncio.Queue()
         done = object()
 
@@ -2693,9 +2990,14 @@ class CodeExecutor:
         finally:
             self._inflight -= 1
             self.quotas.release(quota)
+            _auto_profile_var.reset(profile_token)
         self._apply_quota_phases(result, quota)
         self._count_execution(
-            result, session=executor_id is not None, usage_tenant=usage_tenant
+            result,
+            session=executor_id is not None,
+            usage_tenant=usage_tenant,
+            lane=self._lane_hint(chip_count),
+            tenant=tenant,
         )
         yield {"result": result}
 
@@ -2725,6 +3027,8 @@ class CodeExecutor:
         *,
         session: bool,
         usage_tenant: str | None = None,
+        lane: int | None = None,
+        tenant: str | None = None,
     ) -> None:
         outcome = "ok" if result.exit_code == 0 else "user_error"
         self.metrics.executions.inc(outcome=outcome)
@@ -2733,6 +3037,23 @@ class CodeExecutor:
             self.metrics.warm_hits.inc()
         if session:
             self.metrics.session_executions.inc()
+        if (
+            lane is not None
+            and self.perf.enabled
+            and not _trusted_source_var.get()
+        ):
+            # The perf plane's ONE record point: every LOGICAL request
+            # (serial, session, or batched — batch demux fills the same
+            # phase keys) feeds the lane×phase baselines and the tenant
+            # series. Trusted pre-warm runs stay out: control-plane warmup
+            # latency must not poison the baselines tenant traffic is
+            # judged against. Independent of the metering kill switch —
+            # drift detection is not billing.
+            try:
+                perf_tenant = self.scheduler.normalize_tenant(tenant)
+            except ValueError:
+                perf_tenant = None
+            self.perf.record_request(lane, result.phases, tenant=perf_tenant)
         for phase, seconds in result.phases.items():
             # ALLOWLIST, not exclusion: phases also carries byte counts,
             # compile-cache/batch coordinates, the trace id, and the usage
@@ -3914,6 +4235,12 @@ class CodeExecutor:
             # sentences, and denial totals — the "who is being shed, and
             # why" view next to the usage it is computed from.
             "quotas": self.quotas.snapshot(),
+            # The performance anomaly plane: per-(lane, phase) drift
+            # verdicts with their quantiles and baselines, tenant latency
+            # series, and the auto-profiling/profile-store state — "did
+            # anything get slower than it used to be, and is there a
+            # profile of it yet?".
+            "perf": self.perf.snapshot(),
         }
         if self.device_health is not None:
             body["device_health"] = self.device_health.snapshot()

@@ -186,6 +186,61 @@ def quotas_text(body: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def perf_text(body: dict) -> str:
+    """Human-readable GET /perf (`?format=text`)."""
+    if not body.get("enabled", False):
+        return "perf observer: disabled\n"
+    lines = [_perf_header_text(body)]
+    series = body.get("series", {})
+    if series:
+        for key, row in sorted(series.items()):
+            lines.append(_perf_series_text(key, row))
+    else:
+        lines.append("  (no latency series yet)")
+    tenants = body.get("tenants", {})
+    for tenant, row in sorted(tenants.items()):
+        lines.append(_perf_series_text(f"tenant {tenant}", row))
+    store = body.get("profile_store")
+    if store is not None:
+        lines.append(
+            f"profiles: {store.get('entries', 0)} entries "
+            f"{store.get('bytes', 0)} bytes "
+            f"(captured {body.get('auto_profile', {}).get('captured', 0)}, "
+            f"evictions {store.get('evictions', 0)})"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def _perf_header_text(body: dict) -> str:
+    bands = body.get("bands", {})
+    return (
+        f"perf observer: status={body.get('status', 'normal')} "
+        f"window={body.get('window_seconds', 0)}s "
+        f"drift_q=p{int(float(body.get('drift_quantile', 0.95)) * 100)} "
+        f"bands=x{bands.get('degraded_factor', 0)}"
+        f"/x{bands.get('regressed_factor', 0)}"
+    )
+
+
+def _perf_series_text(key: str, row: dict) -> str:
+    """One latency series' line for the text renderers (shared by
+    /statusz?format=text and /perf?format=text)."""
+    marker = "!!" if row.get("state") == "regressed" else "  "
+    baseline = row.get("baseline_s")
+    return (
+        f"{marker}{key}: [{row.get('state', 'normal')}] "
+        f"p50={row.get('p50_s', 0.0)}s p95={row.get('p95_s', 0.0)}s "
+        f"p99={row.get('p99_s', 0.0)}s "
+        f"baseline={baseline if baseline is not None else '-'}s "
+        f"n={row.get('count', 0)} windows={row.get('windows', 0)}"
+        + (
+            f" regressions={row.get('regressions', 0)}"
+            if row.get("regressions")
+            else ""
+        )
+    )
+
+
 def statusz_text(body: dict) -> str:
     """Human-readable /statusz (`?format=text`): the at-a-glance view
     that replaces the ssh-and-grep loop onchip_watch.sh encoded.
@@ -310,6 +365,21 @@ def statusz_text(body: dict) -> str:
             lines.append(_quota_row_text(tenant, row))
     else:
         lines.append("quotas: enforcement disabled")
+    perf = body.get("perf", {})
+    if perf.get("enabled"):
+        lines.append(_perf_header_text(perf))
+        for key, row in sorted(perf.get("series", {}).items()):
+            lines.append(_perf_series_text(key, row))
+        store = perf.get("profile_store")
+        if store is not None and (
+            store.get("entries") or perf.get("auto_profile", {}).get("captured")
+        ):
+            lines.append(
+                f"profiles: {store.get('entries', 0)} entries "
+                f"{store.get('bytes', 0)} bytes"
+            )
+    else:
+        lines.append("perf observer: disabled")
     sessions = body.get("sessions", ())
     lines.append(f"sessions: {len(sessions)}")
     return "\n".join(lines) + "\n"
@@ -644,6 +714,93 @@ def create_http_app(
         if request.query.get("format") == "text":
             return web.Response(text=_quota_row_text(tenant, row) + "\n")
         return web.json_response(body)
+
+    @routes.get("/perf")
+    async def perf(request: web.Request) -> web.Response:
+        """The performance anomaly plane's verdicts: per-(lane, phase)
+        latency quantiles with their EWMA baselines and drift states
+        (normal/degraded/regressed), per-tenant latency series, and the
+        auto-profiling state (services/perf_observer.py). `?format=text`
+        renders the operator view. 404 with the kill switch off —
+        today's surface set, byte-for-byte."""
+        if not code_executor.perf.enabled:
+            return web.json_response(
+                {"error": "perf observer is disabled "
+                          "(APP_PERF_OBSERVER_ENABLED=0)"},
+                status=404,
+            )
+        body = code_executor.perf.snapshot()
+        if request.query.get("format") == "text":
+            return web.Response(text=perf_text(body))
+        return web.json_response(body)
+
+    @routes.get("/profiles")
+    async def profiles(request: web.Request) -> web.Response:
+        """Auto-captured profile artifacts: id, trigger reason, lane,
+        tenant, trace-id cross-link, size, capture time — newest first.
+        `?limit=`/`?offset=` page the list, and the X-Total-* headers
+        signal truncation (the /traces jsonl discipline: a paged listing
+        must never LOOK complete when it isn't)."""
+        store = code_executor.perf.store
+        if not code_executor.perf.enabled or store is None:
+            return web.json_response(
+                {"error": "perf observer is disabled "
+                          "(APP_PERF_OBSERVER_ENABLED=0)"},
+                status=404,
+            )
+        limit, offset = paging_params(request, default_limit=50, max_limit=500)
+        rows = store.list()
+        total = len(rows)
+        return web.json_response(
+            {
+                "total": total,
+                "limit": limit,
+                "offset": offset,
+                "profiles": rows[offset : offset + limit],
+            },
+            headers={
+                "X-Total-Profiles": str(total),
+                "X-Limit": str(limit),
+                "X-Offset": str(offset),
+            },
+        )
+
+    @routes.get("/profiles/{profile_id}")
+    async def get_profile(request: web.Request) -> web.Response:
+        """One harvested profile's zip bytes (the JAX profiler trace an
+        operator feeds to TensorBoard/xprof), with its capture meta in
+        headers — X-Trace-Id links back to the triggering request's
+        /traces entry."""
+        store = code_executor.perf.store
+        if not code_executor.perf.enabled or store is None:
+            return web.json_response(
+                {"error": "perf observer is disabled "
+                          "(APP_PERF_OBSERVER_ENABLED=0)"},
+                status=404,
+            )
+        profile_id = request.match_info["profile_id"]
+        if not OBJECT_ID_RE.match(profile_id):
+            return bad_request("invalid profile id")
+        found = store.get(profile_id)
+        if found is None:
+            return web.json_response(
+                {"error": f"no profile {profile_id!r} (evicted or never "
+                          "captured)"},
+                status=404,
+            )
+        data, meta = found
+        headers = {
+            "Content-Disposition": (
+                f'attachment; filename="profile-{profile_id}.zip"'
+            ),
+        }
+        if meta.get("trace_id"):
+            headers["X-Trace-Id"] = str(meta["trace_id"])
+        if meta.get("reason"):
+            headers["X-Profile-Trigger"] = str(meta["reason"])
+        return web.Response(
+            body=data, content_type="application/zip", headers=headers
+        )
 
     def validate_execute(req: ExecuteRequest) -> web.Response | None:
         """Shared /v1/execute + /v1/execute/stream pre-flight checks."""

@@ -139,6 +139,13 @@ def sandbox_limit_env(config: Config) -> dict[str, str]:
     request the sandbox will ever see, so even a control plane that stops
     clamping cannot loosen a running sandbox's policy."""
     env = {"APP_MAX_OUTPUT_BYTES": str(int(config.sandbox_max_output_bytes))}
+    if config.lease_require_token:
+        # Strict lease-token mode rides the same boot-env channel as the
+        # limit caps (both backends apply this dict to every sandbox):
+        # once the control plane records its lease, the executor 409s any
+        # tokenless dispatch — safe only because THIS control plane stamps
+        # x-lease-token on every hop (PR 13), which opting in asserts.
+        env["APP_LEASE_REQUIRE_TOKEN"] = "1"
     if not config.sandbox_limits_enabled:
         return env
     if not config.sandbox_cgroup_enforce:

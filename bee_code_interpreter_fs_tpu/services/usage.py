@@ -65,6 +65,7 @@ COUNTER_FIELDS = (
     "chip_seconds",
     "device_op_seconds",
     "queue_wait_seconds",
+    "hbm_byte_seconds",
     "upload_bytes",
     "download_bytes",
     "compile_cache_recompiles",
@@ -84,6 +85,11 @@ class TenantUsage:
     chip_seconds: float = 0.0
     device_op_seconds: float = 0.0
     queue_wait_seconds: float = 0.0
+    # Device-memory attribution (the perf-observer plane): the request's
+    # peak HBM footprint integrated over its device-op wall — the signal
+    # that makes memory hogs attributable (and, later, quota-able) the way
+    # chip_seconds makes compute hogs attributable.
+    hbm_byte_seconds: float = 0.0
     upload_bytes: float = 0.0
     download_bytes: float = 0.0
     compile_cache_recompiles: float = 0.0
@@ -131,6 +137,7 @@ class UsageDraft:
     tenant: str
     chips: int = 1
     device_op_seconds: float = 0.0
+    hbm_byte_seconds: float = 0.0
     upload_bytes: float = 0.0
     download_bytes: float = 0.0
     compile_cache_recompiles: float = 0.0
@@ -307,6 +314,7 @@ class UsageLedger:
         chip_seconds: float = 0.0,
         device_op_seconds: float = 0.0,
         queue_wait_seconds: float = 0.0,
+        hbm_byte_seconds: float = 0.0,
         upload_bytes: float = 0.0,
         download_bytes: float = 0.0,
         compile_cache_recompiles: float = 0.0,
@@ -326,6 +334,7 @@ class UsageLedger:
             "chip_seconds": chip_seconds,
             "device_op_seconds": device_op_seconds,
             "queue_wait_seconds": queue_wait_seconds,
+            "hbm_byte_seconds": hbm_byte_seconds,
             "upload_bytes": upload_bytes,
             "download_bytes": download_bytes,
             "compile_cache_recompiles": compile_cache_recompiles,
@@ -366,6 +375,7 @@ class UsageLedger:
         draft.committed = True
         if not (
             draft.device_op_seconds
+            or draft.hbm_byte_seconds
             or draft.upload_bytes
             or draft.download_bytes
             or draft.compile_cache_recompiles
@@ -377,6 +387,7 @@ class UsageLedger:
             draft.tenant,
             chip_seconds=draft.chip_seconds,
             device_op_seconds=draft.device_op_seconds,
+            hbm_byte_seconds=draft.hbm_byte_seconds,
             upload_bytes=draft.upload_bytes,
             download_bytes=draft.download_bytes,
             compile_cache_recompiles=draft.compile_cache_recompiles,

@@ -584,6 +584,16 @@ class ExecutorMetrics:
             "misses) in the tenant's runs.",
             ("tenant",),
         )
+        # Performance anomaly plane (services/perf_observer.py): the
+        # regression counter / state gauge / profile families register in
+        # bind_perf ONLY when the observer is live — with the kill switch
+        # off, /metrics carries zero perf families (the quota-gauge
+        # exposition discipline, byte-for-byte).
+        self.perf_regressions: Counter | None = None
+        self.perf_profiles: Counter | None = None
+        self.perf_state: Gauge | None = None
+        self.perf_profile_store: Gauge | None = None
+        self.tenant_usage_hbm: Counter | None = None
         self.pool_depth: Gauge | None = None
         self.pool_target: Gauge | None = None
         self.pool_supply: Gauge | None = None
@@ -615,6 +625,56 @@ class ExecutorMetrics:
             callback=enforcer.remaining_gauge_samples,
         )
 
+    def bind_perf(self, observer) -> None:
+        """The perf observer's metric families. Registered only when the
+        plane is live (APP_PERF_OBSERVER_ENABLED=0 leaves /metrics without
+        any of them — the kill switch's zero-perf-surfaces promise)."""
+        if not getattr(observer, "enabled", False):
+            return
+        self.perf_regressions = self.registry.counter(
+            "perf_regression_total",
+            "Drift-detector windows classified REGRESSED (window drift "
+            "quantile past baseline * regressed_factor), by chip-count "
+            "lane and request phase. Fires once per transition into "
+            "regressed — the page-an-operator latency signal.",
+            ("lane", "phase"),
+        )
+        self.perf_profiles = self.registry.counter(
+            "code_interpreter_perf_profiles_captured_total",
+            "Auto-triggered JAX profile captures harvested into the "
+            "profile store, by trigger kind (regression / p99_outlier).",
+            ("trigger",),
+        )
+        self.perf_state = self.registry.gauge(
+            "code_interpreter_perf_state",
+            "One-hot drift verdict per (lane, phase) latency series "
+            "(normal / degraded / regressed).",
+            ("lane", "phase", "state"),
+            callback=observer.state_gauge_samples,
+        )
+        self.perf_profile_store = self.registry.gauge(
+            "code_interpreter_perf_profile_store",
+            "Harvested-profile store occupancy (kind=bytes/entries; "
+            "LRU-evicted under the configured caps).",
+            ("kind",),
+            callback=observer.store_gauge_samples,
+        )
+        self.tenant_usage_hbm = self.registry.counter(
+            "code_interpreter_tenant_usage_hbm_byte_seconds_total",
+            "Per-tenant peak device-memory footprint integrated over "
+            "device-op wall (peak_hbm_bytes x device_op_seconds): the "
+            "memory-hog attribution signal next to chip_seconds.",
+            ("tenant",),
+        )
+
+    def record_perf_regression(self, *, lane: str, phase: str) -> None:
+        if self.perf_regressions is not None:
+            self.perf_regressions.inc(lane=lane, phase=phase)
+
+    def record_perf_profile(self, *, reason: str) -> None:
+        if self.perf_profiles is not None:
+            self.perf_profiles.inc(trigger=reason)
+
     def record_tenant_usage(
         self,
         tenant: str,
@@ -645,6 +705,9 @@ class ExecutorMetrics:
             moved = amount(name)
             if moved:
                 self.tenant_usage_bytes.inc(moved, tenant=tenant, kind=kind)
+        hbm = amount("hbm_byte_seconds")
+        if hbm and self.tenant_usage_hbm is not None:
+            self.tenant_usage_hbm.inc(hbm, tenant=tenant)
         recompiles = amount("compile_cache_recompiles")
         if recompiles:
             self.tenant_usage_recompiles.inc(recompiles, tenant=tenant)

@@ -201,6 +201,75 @@ def _profile_requested(env: dict) -> bool:
     return str(env.get("APP_JAX_PROFILE", "")).lower() not in ("", "0", "false")
 
 
+def _device_memory_snapshot() -> tuple[int, int]:
+    """(live_bytes, peak_bytes) summed across local devices, or -1 where
+    the signal is unavailable. TPU/GPU devices report allocator stats via
+    device.memory_stats() (bytes_in_use / peak_bytes_in_use); the CPU
+    platform usually reports none, so live bytes fall back to summing
+    jax.live_arrays() (no peak tracking there — the caller brackets the
+    run and uses max(before, after) instead). Never imports jax: if the
+    warm import didn't run, there is nothing to measure."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return -1, -1
+    try:
+        live = peak = 0
+        reported = False
+        for device in jax.local_devices():
+            stats_fn = getattr(device, "memory_stats", None)
+            stats = stats_fn() if callable(stats_fn) else None
+            if not isinstance(stats, dict):
+                continue
+            in_use = stats.get("bytes_in_use")
+            if not isinstance(in_use, int):
+                continue
+            reported = True
+            live += in_use
+            peak_b = stats.get("peak_bytes_in_use")
+            peak += peak_b if isinstance(peak_b, int) else in_use
+        if reported:
+            return live, peak
+        total = 0
+        for arr in jax.live_arrays():
+            nbytes = getattr(arr, "nbytes", 0)
+            if isinstance(nbytes, int):
+                total += nbytes
+        return total, -1
+    except Exception:  # noqa: BLE001 — accounting must never kill a run
+        return -1, -1
+
+
+def _rss_bytes() -> int:
+    """This process's resident set, or -1."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return -1
+
+
+class _DeviceMemoryProbe:
+    """Brackets one run with device-memory samples and shapes the reply
+    block. Armed per request (the control plane asks via the request's
+    `device_memory` flag — the perf-observer kill switch keeps sampling,
+    and its tiny cost, entirely off the wire when the plane is off)."""
+
+    __slots__ = ("live_before", "peak_before")
+
+    def __init__(self) -> None:
+        self.live_before, self.peak_before = _device_memory_snapshot()
+
+    def finish(self) -> dict:
+        live_after, peak_after = _device_memory_snapshot()
+        return {
+            "live_bytes_before": self.live_before,
+            "live_bytes_after": live_after,
+            "peak_bytes_before": self.peak_before,
+            "peak_bytes_after": peak_after,
+            "rss_bytes": _rss_bytes(),
+        }
+
+
 def _resolve_mem_budget() -> int:
     """APP_MAX_USER_MEMORY_BYTES: extra address-space bytes user code may
     allocate beyond the warm baseline. "auto" = 80% of the host's physical
@@ -592,7 +661,8 @@ def _job_device_ctx(device_index, fallback_index: int):
 
 
 def _run_batch_job(index: int, job: dict, results: list, mem_limited: bool,
-                   proxies: tuple, t_base: float) -> None:
+                   proxies: tuple, t_base: float,
+                   want_memory: bool = False) -> None:
     """One job thread: bind capture files, isolate cwd, pin the device,
     exec the source. Never raises — the entry records the outcome (a
     per-job MemoryError under an armed budget is THIS job's typed oom
@@ -604,6 +674,11 @@ def _run_batch_job(index: int, job: dict, results: list, mem_limited: bool,
         "exit_code": 0,
         "start_offset_s": round(max(0.0, start - t_base), 6),
     }
+    # Per-job device-memory bracket. One address space means concurrent
+    # batchmates' allocations land inside each other's windows — the
+    # per-job delta is best-effort under concurrency (documented on the
+    # wire block); the batch-level peak stays exact.
+    mem_probe = _DeviceMemoryProbe() if want_memory else None
     out = err = None
     try:
         out = open(job["stdout_path"], "w", buffering=1)
@@ -649,6 +724,8 @@ def _run_batch_job(index: int, job: dict, results: list, mem_limited: bool,
         entry["exit_code"] = 1
     finally:
         entry["duration_s"] = round(time.monotonic() - start, 6)
+        if mem_probe is not None:
+            entry["device_memory"] = mem_probe.finish()
         proxy_out.unbind()
         proxy_err.unbind()
         for fh in (out, err):
@@ -706,10 +783,12 @@ def _run_batch(req: dict) -> dict:
     results: list = [None] * len(jobs)
     violation = None
     t_base = time.monotonic()
+    want_memory = bool(req.get("device_memory"))
     threads = [
         threading.Thread(
             target=_run_batch_job,
-            args=(i, job, results, mem_limited, (proxy_out, proxy_err), t_base),
+            args=(i, job, results, mem_limited, (proxy_out, proxy_err), t_base,
+                  want_memory),
             name=f"batch-job-{i}",
             daemon=True,
         )
@@ -993,8 +1072,18 @@ def main() -> None:
                 else:
                     _set_trace_id(req.get("trace_id"))
                     hits_before, misses_before = _cache_counts()
+                    # Device-memory bracket around the run, only when the
+                    # control plane asked (the perf-observer kill switch
+                    # keeps the wire — and the sampling cost — untouched).
+                    mem_probe = (
+                        _DeviceMemoryProbe()
+                        if req.get("device_memory")
+                        else None
+                    )
                     exit_code, violation = _run_one(req)
                     reply = {"exit_code": exit_code}
+                    if mem_probe is not None:
+                        reply["device_memory"] = mem_probe.finish()
                     if violation:
                         reply["violation"] = violation
                     if _CACHE_LISTENING:

@@ -964,6 +964,12 @@ struct ServerState {
   // the watchdog's sampling cadence.
   limits::LimitSpec limit_caps;
   double limit_poll_interval = 0.1;
+  // Strict lease-token mode (APP_LEASE_REQUIRE_TOKEN=1): once a lease is
+  // recorded, a dispatch WITHOUT an x-lease-token is refused with a typed
+  // 409 — for fleets whose control planes all stamp tokens (PR 13), where
+  // a tokenless dispatch can only be a stale/foreign claim. Default off:
+  // tokenless compatibility for old control planes and manual curl.
+  bool lease_require_token = false;
   WarmRunner* runner = nullptr;
   std::mutex exec_mutex;
   std::mutex runner_mutex;
@@ -1428,6 +1434,11 @@ struct RunOutcome {
   // subprocess, old runner, or jax without the monitoring surface).
   long long cache_hits = -1;
   long long cache_misses = -1;
+  // Device-memory accounting block the warm runner sampled around the run
+  // (live/peak device-buffer bytes + runner RSS) — present only when the
+  // request asked for it AND the runner could measure (warm path; the cold
+  // subprocess has no instrumented interpreter to sample).
+  minijson::Value device_memory;
 };
 
 // The execution core shared by /execute and /execute/stream: run the script
@@ -1465,7 +1476,8 @@ RunOutcome run_user_code(const std::string& script_path,
                          const std::string& stderr_path, double timeout_s,
                          const minijson::Value& extra_env,
                          const limits::LimitSpec& lim,
-                         const std::string& trace_id = "") {
+                         const std::string& trace_id = "",
+                         bool want_device_memory = false) {
   RunOutcome out;
   bool restart_runner = false;
 
@@ -1492,6 +1504,7 @@ RunOutcome run_user_code(const std::string& script_path,
         reqo["stdout_path"] = minijson::Value(stdout_path);
         reqo["stderr_path"] = minijson::Value(stderr_path);
         if (!trace_id.empty()) reqo["trace_id"] = minijson::Value(trace_id);
+        if (want_device_memory) reqo["device_memory"] = minijson::Value(true);
         if (extra_env.is_object()) reqo["env"] = extra_env;
         if (lim.any()) reqo["limits"] = runner_limits_json(lim);
         minijson::Value resp;
@@ -1520,6 +1533,7 @@ RunOutcome run_user_code(const std::string& script_path,
                 static_cast<long long>(resp.get_number("cache_hits", -1));
             out.cache_misses =
                 static_cast<long long>(resp.get_number("cache_misses", -1));
+            out.device_memory = resp.get("device_memory");
             break;
           case WarmRunner::ExecResult::kTimeout:
             out.timed_out = true;
@@ -1677,9 +1691,11 @@ void handle_lease(const minihttp::Request&, minihttp::Conn& conn) {
   if (!conflict.empty()) {
     log_msg("lease rotation refused: held=%s offered=%s", conflict.c_str(),
             token.c_str());
+    // Held token log-only, like the dispatch refusals: a tenant POSTing a
+    // bogus rotation from inside the sandbox must not be handed the real
+    // credential in the refusal body.
     minijson::Object err;
     err["error"] = minijson::Value(std::string("lease_already_recorded"));
-    err["held"] = minijson::Value(conflict);
     conn.send_response(409, "application/json", minijson::Value(err).dump());
     return;
   }
@@ -1699,11 +1715,31 @@ void handle_lease(const minihttp::Request&, minihttp::Conn& conn) {
 // the control-plane revocation check is the backstop.
 bool reject_stale_lease(const minihttp::Request& req, minihttp::Conn& conn) {
   std::string offered = req.header("x-lease-token");
-  if (offered.empty()) return false;
   std::string held;
   {
     std::lock_guard<std::mutex> lock(g_lease_mutex);
     held = g_lease_token;
+  }
+  if (offered.empty()) {
+    // Strict mode (APP_LEASE_REQUIRE_TOKEN=1): once a lease is recorded,
+    // a tokenless dispatch is refused with its own typed 409 — on a
+    // fully-rolled fleet every legitimate dispatch carries the token, so
+    // "no token" can only be an old/foreign control plane or tenant code
+    // curling the data plane from inside the sandbox. BEFORE any lease is
+    // recorded, tokenless passes even in strict mode (boot-time probes,
+    // the control plane's own pre-lease traffic).
+    if (!g_state.lease_require_token || held.empty()) return false;
+    log_msg("tokenless dispatch refused (strict lease mode; held=%s)",
+            held.c_str());
+    conn.drain_body();
+    // The held token stays OUT of the body (log-only): this refusal is
+    // exactly what tenant code curling the data plane from inside the
+    // sandbox sees, and echoing the valid token would hand it the replay
+    // credential the strict gate exists to demand.
+    minijson::Object err;
+    err["error"] = minijson::Value(std::string("lease_token_required"));
+    conn.send_response(409, "application/json", minijson::Value(err).dump());
+    return true;
   }
   if (held.empty() || offered == held) return false;
   log_msg("stale lease claim refused: offered=%s held=%s", offered.c_str(),
@@ -1711,7 +1747,10 @@ bool reject_stale_lease(const minihttp::Request& req, minihttp::Conn& conn) {
   conn.drain_body();
   minijson::Object err;
   err["error"] = minijson::Value(std::string("stale_lease"));
-  err["held"] = minijson::Value(held);
+  // `offered` is the caller's own (stale) token — safe to echo for the
+  // control plane's diagnostics. The HELD token is log-only: echoing the
+  // successor's valid credential to whoever presented a stale one would
+  // let any sandbox-internal caller harvest it with a junk claim.
   err["offered"] = minijson::Value(offered);
   conn.send_response(409, "application/json", minijson::Value(err).dump());
   return true;
@@ -1749,6 +1788,10 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
   std::string source_code = parsed.get_string("source_code");
   std::string source_file = parsed.get_string("source_file");
   double timeout_s = parsed.get_number("timeout", g_state.default_timeout);
+  // Per-request device-memory sampling (the perf-observer plane): only
+  // requests that ASK get the runner bracket and the reply block, so the
+  // control-plane kill switch keeps the wire byte-for-byte.
+  bool want_device_memory = parsed.get_bool("device_memory", false);
   const minijson::Value& extra_env = parsed.get("env");
   // Per-request resource budget, tighten-only against the APP_LIMIT_* caps.
   // Output is special-cased: the implicit server cap (APP_MAX_OUTPUT_BYTES)
@@ -1862,7 +1905,8 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
   RunOutcome run;
   if (!streaming) {
     run = run_user_code(script_path, stdout_path, stderr_path, timeout_s,
-                        extra_env, eff_limits, trace_id_of(traceparent));
+                        extra_env, eff_limits, trace_id_of(traceparent),
+                        want_device_memory);
   } else {
     // Streaming mode: the run blocks in a worker thread while this thread
     // tails the capture files and pushes NDJSON events over a chunked
@@ -1886,7 +1930,8 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
       // the one-connection blast radius of the non-streaming path.
       try {
         run = run_user_code(script_path, stdout_path, stderr_path, timeout_s,
-                            extra_env, eff_limits, trace_id_of(traceparent));
+                            extra_env, eff_limits, trace_id_of(traceparent),
+                            want_device_memory);
       } catch (const std::exception& e) {
         log_msg("streamed run_user_code threw: %s", e.what());
         run = RunOutcome{};  // exit_code -1, nothing ran warm
@@ -2066,6 +2111,11 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
   // attribution source. Named explicitly so the billing contract does not
   // lean on duration_s keeping its exact semantics forever.
   resp["device_op_seconds"] = minijson::Value(duration);
+  // Device-memory accounting (present only when requested AND the warm
+  // runner could sample): live/peak device-buffer bytes bracketing the
+  // run, plus the runner's RSS — the per-request HBM attribution feed.
+  if (run.device_memory.is_object())
+    resp["device_memory"] = run.device_memory;
   if (!traceparent.empty()) {
     // The control plane sent trace context: report per-phase timings so it
     // can graft them into the request's trace as child spans. Offsets are
@@ -2183,6 +2233,7 @@ void handle_execute_batch(const minihttp::Request& req, minihttp::Conn& conn) {
     return;
   }
   double timeout_s = parsed.get_number("timeout", g_state.default_timeout);
+  bool want_device_memory = parsed.get_bool("device_memory", false);
   const minijson::Value& extra_env = parsed.get("env");
   // Same output special-casing as /execute: the implicit server cap keeps
   // TRUNCATE semantics; only an explicit output budget arms the watchdog's
@@ -2281,6 +2332,7 @@ void handle_execute_batch(const minihttp::Request& req, minihttp::Conn& conn) {
   reqo["stderr_path"] = minijson::Value(batch_err);
   std::string trace_id = trace_id_of(traceparent);
   if (!trace_id.empty()) reqo["trace_id"] = minijson::Value(trace_id);
+  if (want_device_memory) reqo["device_memory"] = minijson::Value(true);
   if (extra_env.is_object()) reqo["env"] = extra_env;
   if (eff_limits.any()) reqo["limits"] = runner_limits_json(eff_limits);
 
@@ -2396,6 +2448,11 @@ void handle_execute_batch(const minihttp::Request& req, minihttp::Conn& conn) {
       job_offset = jr.get_number("start_offset_s", 0.0);
       job_violation = jr.get_string("violation", "");
       aborted = aborted || jr.get_bool("aborted", false);
+      // Per-job device-memory bracket (best-effort under concurrent
+      // batchmates — one address space; the wire shape matches /execute's
+      // block so the demux path parses once).
+      if (jr.get("device_memory").is_object())
+        entry["device_memory"] = jr.get("device_memory");
     }
     bool out_trunc = false, err_trunc = false;
     std::string out_s =
@@ -2626,9 +2683,12 @@ void handle_device_stats(const minihttp::Request&, minihttp::Conn& conn) {
   }
   resp["runner_alive"] = minijson::Value(runner_alive);
   resp["runner_pid"] = minijson::Value(static_cast<double>(runner_pid));
-  {
+  if (!g_state.lease_require_token) {
     // The held lease token: lets an operator (or the probe) see which
-    // generation this server will honor without sending a claim.
+    // generation this server will honor without sending a claim. REDACTED
+    // in strict mode — there, possession of the token IS the dispatch
+    // credential, and this route is as reachable from inside the sandbox
+    // as /execute (strict operators read the boot/refusal logs instead).
     std::lock_guard<std::mutex> llock(g_lease_mutex);
     resp["lease_token"] = minijson::Value(g_lease_token);
   }
@@ -2847,6 +2907,9 @@ int main() {
   g_state.max_output = static_cast<size_t>(env_num("APP_MAX_OUTPUT_BYTES", 10485760));
   g_state.limit_caps = limits::caps_from_env();
   g_state.limit_poll_interval = env_num("APP_LIMIT_POLL_INTERVAL", 0.1);
+  g_state.lease_require_token = env_flag("APP_LEASE_REQUIRE_TOKEN", false);
+  if (g_state.lease_require_token)
+    log_msg("strict lease mode: tokenless dispatches 409 once leased");
   // cgroup-v2 hard enforcement: detect a writable, memory+pids-delegated
   // v2 subtree (the one this process lives in, or APP_CGROUP_ROOT) and
   // park the warm runner group in a caps-bounded scope. Every failure

@@ -163,7 +163,9 @@ async def test_wedge_recovery_end_to_end(stack):
     assert resp.status_code == 409
     body = resp.json()
     assert body["error"] == "stale_lease"
-    assert body["held"] == new_lease.wire_token
+    # The successor's valid token is never echoed to a stale claimant
+    # (log-only) — a junk claim must not harvest the live credential.
+    assert "held" not in body
 
     # Re-admission is gated on the clean-probe streak: wait for the scope
     # to re-admit (host_readmitted_total fires), then the lane serves.

@@ -998,7 +998,11 @@ def test_stale_lease_claim_refused_with_typed_409(executor):
     assert r.status_code == 409
     body = r.json()
     assert body["error"] == "stale_lease"
-    assert body["held"] == "lane-0:2"
+    # The HELD token is log-only: echoing the successor's valid credential
+    # to whoever presented a stale one would let any sandbox-internal
+    # caller harvest it with a junk claim. The caller's own (stale) token
+    # echoes back for diagnostics.
+    assert "held" not in body
     assert body["offered"] == "lane-0:1"
     # /execute-batch and /reset refuse the same stale claim (a retry
     # racing a dispose must not wipe the successor's workspace).

@@ -1,0 +1,241 @@
+"""Seeded slow_exec chaos for the performance anomaly plane (faults.py ->
+perf_observer.py), CHAOS_SEED-parameterized like the other chaos suites:
+CI pins the {7, 23, 1337} matrix; a red leg replays exactly with
+``CHAOS_SEED=<n> pytest tests/unit/test_perf_observer_chaos.py``.
+
+The injected fault is a LATENCY REGRESSION, not an error: the affected
+dispatches succeed, only slower. The drift detector must flip the slowed
+lane's exec series to regressed within one window while the clean lane's
+baseline holds — and the whole pipeline (transport draw order, window
+verdicts, profile arming) must replay identically under one seed.
+"""
+
+import asyncio
+import os
+import tempfile
+
+import httpx
+import pytest
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.backends.faults import (
+    SLOW_EXEC,
+    FaultInjectingBackend,
+    FaultSpec,
+    SlowExecTransport,
+)
+from bee_code_interpreter_fs_tpu.services.perf_observer import (
+    NORMAL,
+    REGRESSED,
+    PerfObserver,
+)
+
+from fakes import FakeBackend
+from test_perf_observer import FakeClock
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "7"))
+
+
+# ----------------------------------------------------------- spec grammar
+
+
+def test_slow_exec_spec_parses():
+    spec = FaultSpec.parse(
+        f"slow_exec:0.5,slow_exec_seconds:0.4,slow_exec_lane:4,"
+        f"seed:{CHAOS_SEED}"
+    )
+    assert spec.slow_exec == 0.5
+    assert spec.slow_exec_seconds == 0.4
+    assert spec.slow_exec_lane == 4
+    assert spec.active
+
+
+def test_slow_exec_spec_validation_fails_loudly():
+    with pytest.raises(ValueError):
+        FaultSpec.parse("slow_exec:1.5")
+    with pytest.raises(ValueError):
+        FaultSpec.parse("slow_exec:0.5,slow_exec_seconds:-1")
+    with pytest.raises(ValueError):
+        FaultSpec.parse("slow_exec_typo:0.5")
+
+
+def test_slow_exec_seconds_alone_is_not_active():
+    # The delay magnitude without a rate injects nothing — it must not
+    # flip the "fault injection ACTIVE" posture.
+    assert not FaultSpec.parse("slow_exec_seconds:0.5").active
+
+
+def test_backend_wraps_transport_and_records_lanes():
+    backend = FaultInjectingBackend(
+        FakeBackend(),
+        FaultSpec.parse(f"slow_exec:1.0,seed:{CHAOS_SEED}"),
+    )
+    transport = backend.http_transport()
+    assert isinstance(transport, SlowExecTransport)
+
+    async def spawn():
+        sandbox = await backend.spawn(4)
+        return sandbox
+
+    sandbox = asyncio.run(spawn())
+    parsed = httpx.URL(sandbox.url)
+    assert backend._host_lanes[f"{parsed.host}:{parsed.port}"] == 4
+
+
+# ------------------------------------------------------ transport behavior
+
+
+def _transport(rate, lane, host_lanes, fired, delay=0.0):
+    import random
+
+    async def inner_handler(request):
+        return httpx.Response(200, json={"ok": True})
+
+    return SlowExecTransport(
+        rate,
+        delay,
+        lane,
+        random.Random(f"{CHAOS_SEED}:{SLOW_EXEC}"),
+        host_lanes,
+        on_fault=lambda kind: fired.append(kind),
+        inner=httpx.MockTransport(inner_handler),
+    )
+
+
+def test_transport_delays_only_the_restricted_lane():
+    async def run():
+        host_lanes = {"slow-host:8001": 4, "fast-host:8001": 0}
+        fired: list[str] = []
+        transport = _transport(1.0, 4, host_lanes, fired)
+        client = httpx.AsyncClient(transport=transport)
+        for _ in range(5):
+            await client.post("http://slow-host:8001/execute")
+            await client.post("http://fast-host:8001/execute")
+        await client.aclose()
+        # rate 1.0: every slow-host dispatch fired; no fast-host one did.
+        assert len(fired) == 5
+        return fired
+
+    asyncio.run(run())
+
+
+def test_transport_draw_sequence_is_seed_stable():
+    async def run(order):
+        host_lanes = {"a:8001": 0, "b:8001": 0}
+        fired: list[str] = []
+        transport = _transport(0.5, -1, host_lanes, fired)
+        client = httpx.AsyncClient(transport=transport)
+        outcomes = []
+        for host in order:
+            before = len(fired)
+            await client.post(f"http://{host}:8001/execute")
+            outcomes.append(len(fired) > before)
+        await client.aclose()
+        return outcomes
+
+    # The SAME dispatch sequence replays the SAME fire pattern (its own
+    # seeded stream), and non-execute routes never consume a draw.
+    first = asyncio.run(run(["a", "b", "a", "b", "a", "b", "a", "b"]))
+    second = asyncio.run(run(["a", "b", "a", "b", "a", "b", "a", "b"]))
+    assert first == second
+    assert any(first), "rate 0.5 over 8 draws should fire at least once"
+
+
+def test_non_execute_routes_never_draw():
+    async def run():
+        fired: list[str] = []
+        transport = _transport(1.0, -1, {}, fired)
+        client = httpx.AsyncClient(transport=transport)
+        await client.get("http://x:8001/healthz")
+        await client.get("http://x:8001/device-stats")
+        await client.post("http://x:8001/reset")
+        assert fired == []
+        await client.post("http://x:8001/execute")
+        assert fired == [SLOW_EXEC]
+        await client.aclose()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------- drift verdict under chaos
+
+
+def test_slowed_lane_regresses_while_clean_lane_holds():
+    """The acceptance shape, fake-clocked: one lane's exec latencies pick
+    up the injected delay, the other's stay at baseline. The detector
+    must flip ONLY the slowed lane — under every pinned seed."""
+    import random
+
+    clock = FakeClock()
+    tmp = tempfile.mkdtemp(prefix="perf-chaos-")
+    observer = PerfObserver(
+        Config(
+            file_storage_path=tmp,
+            perf_window_seconds=10.0,
+            perf_min_window_samples=5,
+            perf_min_band_seconds=0.0,
+        ),
+        clock=clock,
+    )
+    rng = random.Random(CHAOS_SEED)
+    base = lambda: 0.05 + rng.random() * 0.01  # noqa: E731
+    # Two baseline windows for both lanes.
+    for _ in range(2):
+        for _ in range(10):
+            observer.record(0, "exec", base())
+            observer.record(4, "exec", base())
+        clock.advance(10.01)
+    observer.record(0, "exec", base())
+    observer.record(4, "exec", base())
+    assert observer.lane_phase_states()["0/exec"] == NORMAL
+    assert observer.lane_phase_states()["4/exec"] == NORMAL
+    # The fault lands on lane 4: +0.4s on every dispatch (slow_exec shape).
+    for _ in range(10):
+        observer.record(0, "exec", base())
+        observer.record(4, "exec", base() + 0.4)
+    clock.advance(10.01)
+    observer.record(0, "exec", base())
+    observer.record(4, "exec", base() + 0.4)
+    states = observer.lane_phase_states()
+    assert states["4/exec"] == REGRESSED, states
+    assert states["0/exec"] == NORMAL, states
+    # The regressed lane armed an auto-profile; the clean one did not.
+    assert observer.take_profile_arm(4, None) is not None
+    assert observer.take_profile_arm(0, None) is None
+
+
+def test_partial_rate_regression_still_flips_within_one_window():
+    """At slow_exec:0.5 only half the window's dispatches are slow — the
+    p95 drift quantile still catches it (tail quantiles are exactly why
+    the detector doesn't read medians)."""
+    import random
+
+    clock = FakeClock()
+    tmp = tempfile.mkdtemp(prefix="perf-chaos-")
+    observer = PerfObserver(
+        Config(
+            file_storage_path=tmp,
+            perf_window_seconds=10.0,
+            perf_min_window_samples=5,
+            perf_min_band_seconds=0.0,
+        ),
+        clock=clock,
+    )
+    rng = random.Random(f"{CHAOS_SEED}:partial")
+    for _ in range(2):
+        for _ in range(12):
+            observer.record(0, "exec", 0.05 + rng.random() * 0.01)
+        clock.advance(10.01)
+    observer.record(0, "exec", 0.05)
+    assert observer.lane_phase_states()["0/exec"] == NORMAL
+    for _ in range(12):
+        slow = rng.random() < 0.5
+        observer.record(0, "exec", 0.05 + (0.4 if slow else 0.0))
+    # Guarantee the tail is present whatever the seed drew (rate noise
+    # must not make the LEG flaky; the detector still had to see through
+    # the mixed window).
+    observer.record(0, "exec", 0.45)
+    observer.record(0, "exec", 0.45)
+    clock.advance(10.01)
+    observer.record(0, "exec", 0.05)
+    assert observer.lane_phase_states()["0/exec"] == REGRESSED

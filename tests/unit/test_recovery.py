@@ -411,3 +411,88 @@ async def test_statusz_recovery_section(stack):
     assert recovery["fences_total"] == 1
     assert "lane-0" in recovery["recovering"]
     assert recovery["fence_budget"]["max_per_window"] == 4
+
+
+# ------------------------------------- direct-spawn quarantine gate (ISSUE 14)
+
+
+async def test_direct_spawn_never_hands_out_recovering_host():
+    """THE carried quarantine hole (PR 13 follow-up): on an UNCONSTRAINED
+    lane a direct-spawn waiter could be handed a recovering-scope
+    replacement mid-quarantine (constrained lanes parked via the standby
+    capacity count; unconstrained lanes counted nothing). Now the waiter
+    parks behind the standby host and surfaces the bounded retryable
+    timeout instead — the recovering host is NEVER handed out."""
+    from bee_code_interpreter_fs_tpu.services.code_executor import (
+        CapacityTimeoutError,
+    )
+
+    s = _Stack(executor_acquire_timeout=0.5)
+    try:
+        assert s.backend.capacity is None  # unconstrained: the hole's shape
+        sandbox = await s.spawn_pooled(0)
+        await s.executor.fence_host(sandbox.id, reason="wedged")
+        await s.settle()
+        assert s.executor.leases.recovering("lane-0")
+        # The refill machinery parked the replacement as quarantined
+        # standby supply.
+        pool = s.executor._pool(0)
+        assert pool and all(
+            sb.meta.get("device_health") == "recovering" for sb in pool
+        )
+        spawns_before = s.backend.spawns
+        with pytest.raises(CapacityTimeoutError):
+            await s.executor.execute("print(1)")
+        # The waiter parked: no direct spawn raced the standby host for
+        # the scope, and nothing recovering was handed out.
+        assert s.backend.spawns == spawns_before
+        assert all(
+            sb.meta.get("device_health") == "recovering"
+            for sb in s.executor._pool(0)
+        )
+        # Re-admission (the probe's settle shape): streak satisfied, host
+        # flipped healthy, lanes kicked — the next request serves.
+        registry = s.executor.leases
+        registry.note_probe("lane-0", clean=True)
+        assert registry.note_probe("lane-0", clean=True)
+        for sb in s.executor._pool(0):
+            sb.meta["device_health"] = "healthy"
+        s.executor._notify_all_lanes()
+        result = await s.executor.execute("print(2)")
+        assert result.exit_code == 0
+    finally:
+        await s.close()
+
+
+async def test_direct_spawn_onto_recovering_scope_parks_its_result():
+    """No standby anywhere (the replacement refill hasn't landed): the
+    direct spawn still runs — something must exist for the probe to
+    re-admit — but its recovering-marked result parks as the scope's
+    standby instead of serving, and the next loop's standby gate stops a
+    spawn stampede behind it."""
+    from bee_code_interpreter_fs_tpu.services.code_executor import (
+        CapacityTimeoutError,
+    )
+
+    s = _Stack(executor_acquire_timeout=0.5)
+    try:
+        sandbox = await s.spawn_pooled(0)
+        await s.executor.fence_host(sandbox.id, reason="wedged")
+        await s.settle()
+        # Clear the refilled standby so the scope is recovering with NO
+        # live replacement.
+        for sb in list(s.executor._pool(0)):
+            s.executor._pool(0).remove(sb)
+            await s.executor._dispose(sb)
+        assert s.executor.leases.recovering("lane-0")
+        spawns_before = s.backend.spawns
+        with pytest.raises(CapacityTimeoutError):
+            await s.executor.execute("print(1)")
+        # Exactly ONE spawn happened, and it was parked quarantined, not
+        # handed out.
+        assert s.backend.spawns == spawns_before + 1
+        parked = list(s.executor._pool(0))
+        assert len(parked) == 1
+        assert parked[0].meta.get("device_health") == "recovering"
+    finally:
+        await s.close()

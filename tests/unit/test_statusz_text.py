@@ -250,3 +250,210 @@ async def test_statusz_and_usage_text_end_to_end(tmp_path):
     finally:
         await client.close()
         await executor.close()
+
+
+# ---------------------------------------- perf + recovery text legs (ISSUE 14)
+
+
+def test_perf_section_renders_series_and_regressed_marker():
+    body = empty_body(
+        perf={
+            "enabled": True,
+            "status": "regressed",
+            "window_seconds": 30.0,
+            "drift_quantile": 0.95,
+            "bands": {"degraded_factor": 1.5, "regressed_factor": 3.0},
+            "series": {
+                "4/exec": {
+                    "state": "regressed",
+                    "p50_s": 0.12,
+                    "p95_s": 0.61,
+                    "p99_s": 0.8,
+                    "baseline_s": 0.13,
+                    "count": 412,
+                    "windows": 9,
+                    "regressions": 2,
+                },
+                "0/exec": {
+                    "state": "normal",
+                    "p50_s": 0.05,
+                    "p95_s": 0.07,
+                    "p99_s": 0.09,
+                    "baseline_s": 0.06,
+                    "count": 900,
+                    "windows": 12,
+                    "regressions": 0,
+                },
+            },
+            "auto_profile": {"enabled": True, "captured": 3},
+            "profile_store": {"entries": 3, "bytes": 120000},
+        }
+    )
+    text = statusz_text(body)
+    assert "perf observer: status=regressed window=30.0s drift_q=p95" in text
+    # The regressed series is flagged (!!) with its evidence; the healthy
+    # one renders unflagged.
+    assert (
+        "!!4/exec: [regressed] p50=0.12s p95=0.61s p99=0.8s baseline=0.13s "
+        "n=412 windows=9 regressions=2" in text
+    )
+    assert "  0/exec: [normal] p50=0.05s" in text
+    assert "profiles: 3 entries 120000 bytes" in text
+
+
+def test_perf_section_disabled_line():
+    assert "perf observer: disabled" in statusz_text(empty_body())
+    assert "perf observer: disabled" in statusz_text(
+        empty_body(perf={"enabled": False})
+    )
+
+
+def test_perf_text_renderer_standalone():
+    from bee_code_interpreter_fs_tpu.services.http_server import perf_text
+
+    assert perf_text({"enabled": False}) == "perf observer: disabled\n"
+    text = perf_text(
+        {
+            "enabled": True,
+            "status": "normal",
+            "window_seconds": 30.0,
+            "drift_quantile": 0.95,
+            "bands": {"degraded_factor": 1.5, "regressed_factor": 3.0},
+            "series": {},
+            "tenants": {
+                "acme": {
+                    "state": "normal",
+                    "p50_s": 0.1,
+                    "p95_s": 0.2,
+                    "p99_s": 0.3,
+                    "baseline_s": 0.1,
+                    "count": 4,
+                    "windows": 1,
+                }
+            },
+            "auto_profile": {"enabled": True, "captured": 0},
+            "profile_store": {"entries": 0, "bytes": 0, "evictions": 0},
+        }
+    )
+    assert "(no latency series yet)" in text
+    assert "tenant acme: [normal]" in text
+    assert "profiles: 0 entries 0 bytes" in text
+
+
+def test_recovery_block_renders_in_text():
+    """The PR 13 recovery block's ?format=text legs (previously untested
+    in text form): the standing-quarantine line with streak evidence, and
+    the fencing-disabled line."""
+    body = empty_body(
+        recovery={
+            "fencing_enabled": True,
+            "fences_total": 2,
+            "readmissions_total": 1,
+            "readmit_streak": 3,
+            "fence_budget": {"max_per_window": 4, "window_seconds": 600.0},
+            "recovering": {
+                "lane-4": {
+                    "streak": 1,
+                    "need": 3,
+                    "reason": "wedged",
+                    "for_s": 42.5,
+                    "relapses": 1,
+                }
+            },
+        }
+    )
+    text = statusz_text(body)
+    assert (
+        "recovery: fences=2 readmissions=1 budget=4/600.0s streak=3" in text
+    )
+    assert (
+        "  recovering lane-4: 1/3 clean (wedged, 42.5s, 1 relapse(s))"
+        in text
+    )
+    disabled = empty_body(recovery={"fencing_enabled": False})
+    assert "recovery: fencing disabled" in statusz_text(disabled)
+
+
+async def test_perf_and_profiles_routes_end_to_end(tmp_path):
+    """GET /perf (json + text), GET /profiles with the X-Total-* paging
+    header discipline (the PR 8 /traces rule: a paged listing must never
+    LOOK complete), GET /profiles/{id}, and the kill-switch 404s."""
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        executor_pod_queue_target_length=1,
+        batching_enabled=False,
+    )
+    executor = CodeExecutor(
+        FakeBackend(), Storage(config.file_storage_path), config
+    )
+    app = create_http_app(
+        executor, CustomToolExecutor(executor), executor.storage
+    )
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        ids = []
+        for i in range(3):
+            ids.append(
+                executor.perf.store.add(
+                    b"zip-%d" % i,
+                    {"lane": 0, "reason": "regression:exec",
+                     "trace_id": f"{i:032x}"},
+                )
+            )
+        resp = await client.get("/perf")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["enabled"] is True
+        resp = await client.get("/perf", params={"format": "text"})
+        assert "perf observer: status=" in await resp.text()
+        # Paged listing with the truncation headers.
+        resp = await client.get(
+            "/profiles", params={"limit": "1", "offset": "1"}
+        )
+        assert resp.status == 200
+        assert resp.headers["X-Total-Profiles"] == "3"
+        assert resp.headers["X-Limit"] == "1"
+        assert resp.headers["X-Offset"] == "1"
+        body = await resp.json()
+        assert body["total"] == 3 and len(body["profiles"]) == 1
+        # One artifact, bytes + cross-link headers.
+        target = body["profiles"][0]["id"]
+        resp = await client.get(f"/profiles/{target}")
+        assert resp.status == 200
+        assert resp.content_type == "application/zip"
+        assert resp.headers["X-Trace-Id"] == body["profiles"][0]["trace_id"]
+        assert (await resp.read()).startswith(b"zip-")
+        resp = await client.get("/profiles/" + "0" * 32)
+        assert resp.status == 404
+        resp = await client.get("/profiles/..evil")
+        assert resp.status == 400
+    finally:
+        await client.close()
+        await executor.close()
+
+
+async def test_perf_routes_404_with_kill_switch(tmp_path):
+    config = Config(
+        file_storage_path=str(tmp_path / "storage"),
+        perf_observer_enabled=False,
+        batching_enabled=False,
+    )
+    executor = CodeExecutor(
+        FakeBackend(), Storage(config.file_storage_path), config
+    )
+    app = create_http_app(
+        executor, CustomToolExecutor(executor), executor.storage
+    )
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        assert (await client.get("/perf")).status == 404
+        assert (await client.get("/profiles")).status == 404
+        assert (await client.get("/profiles/" + "a" * 32)).status == 404
+        # And statusz renders the disabled posture, text included.
+        resp = await client.get("/statusz", params={"format": "text"})
+        assert "perf observer: disabled" in await resp.text()
+    finally:
+        await client.close()
+        await executor.close()
