@@ -79,6 +79,26 @@ async def main(ctx: ApplicationContext | None = None) -> None:
     # every APP_USAGE_FLUSH_INTERVAL seconds, so a crash loses at most one
     # interval of accounting (the kill switch makes start() a no-op).
     ctx.usage_ledger.start()
+    # Scale-out control plane: heartbeat onto the replica ring (liveness
+    # for session affinity) when a replica set is configured, and log the
+    # posture either way — a scaling incident starts with "which replica
+    # is this, and who does it think is alive?".
+    if ctx.session_router is not None:
+        ctx.session_router.start(ctx.config.replica_heartbeat_interval)
+        logger.info(
+            "replica ring active: self=%s peers=%s proxy=%s store=%s",
+            ctx.session_router.ring.self_id,
+            sorted(ctx.session_router.ring.peers),
+            "on" if ctx.session_router.proxy_enabled else "307-redirect",
+            type(ctx.state_store).__name__,
+        )
+    elif ctx.state_store.shared:
+        logger.info(
+            "shared state store active (%s) with no replica peer set: "
+            "scheduler/breaker/lease state is fleet-shared, session "
+            "affinity is delegated to the load balancer",
+            type(ctx.state_store).__name__,
+        )
     # The performance anomaly plane is passive too (windows roll lazily on
     # the request path; no daemon): log its posture so a boot log answers
     # "was drift detection even on?" during a latency incident.
@@ -151,6 +171,10 @@ async def main(ctx: ApplicationContext | None = None) -> None:
         # OTLP last so the shutdown's own spans make the final flush.
         await ctx.device_health.stop()
         await ctx.usage_ledger.stop()
+        # Leave the ring before executor close retires the shared-state
+        # footprint: peers rehash this replica's sessions promptly.
+        if ctx.session_router is not None:
+            await ctx.session_router.close()
         await ctx.code_executor.close()
         if ctx.otlp_exporter is not None:
             await ctx.otlp_exporter.close()

@@ -70,6 +70,26 @@ class ApplicationContext:
         return backend
 
     @cached_property
+    def state_store(self):
+        """Pluggable control-plane state (services/state_store.py): the
+        one instance the executor's scheduler/breakers/leases AND the
+        replica ring share. APP_STATE_STORE unset = a private in-memory
+        store — single-replica mode, today's behavior byte-for-byte."""
+        from .services.state_store import make_state_store
+
+        return make_state_store(self.config)
+
+    @cached_property
+    def session_router(self):
+        """Consistent-hash session→replica affinity (services/replicas.py),
+        or None when no replica set is configured. __main__ starts its
+        heartbeat loop; the HTTP app and the gRPC servicer consult it on
+        session-carrying routes."""
+        from .services.replicas import make_session_router
+
+        return make_session_router(self.config, store=self.state_store)
+
+    @cached_property
     def usage_ledger(self):
         """Per-tenant usage ledger (services/usage.py): loads the durable
         journal at construction; __main__ start()s its periodic flush loop
@@ -95,7 +115,7 @@ class ApplicationContext:
 
     @cached_property
     def code_executor(self) -> CodeExecutor:
-        return CodeExecutor(
+        executor = CodeExecutor(
             self.backend,
             self.storage,
             self.config,
@@ -103,7 +123,12 @@ class ApplicationContext:
             tracer=self.tracer,
             usage=self.usage_ledger,
             quotas=self.quota_enforcer,
+            state_store=self.state_store,
         )
+        # Surface the affinity ring on /statusz (and let the gRPC
+        # servicer's ownership check find it without new plumbing).
+        executor.session_router = self.session_router
+        return executor
 
     @cached_property
     def custom_tool_executor(self) -> CustomToolExecutor:
@@ -146,7 +171,12 @@ class ApplicationContext:
     def http_app(self):
         from .services.http_server import create_http_app
 
-        return create_http_app(self.code_executor, self.custom_tool_executor, self.storage)
+        return create_http_app(
+            self.code_executor,
+            self.custom_tool_executor,
+            self.storage,
+            router=self.session_router,
+        )
 
     @cached_property
     def grpc_server(self):

@@ -541,6 +541,51 @@ class Config(BaseModel):
     # malformed rewrite keeps the last good policy instead of failing open.
     quota_policy_file: str = ""
     quota_policy_reload_seconds: float = 2.0
+    # Per-tenant HBM budget over the same sliding window (byte-seconds of
+    # peak device memory integrated over device-op wall, the ledger's
+    # `hbm_byte_seconds` counter from the perf-observer plane): a memory
+    # hog is bounded the way a compute hog is, with the same 429 +
+    # refill-derived Retry-After semantics. 0 = off. Policy-file key:
+    # `hbm_byte_seconds_per_window`.
+    quota_hbm_byte_seconds: float = 0.0
+    # Burst-credit smoothing (opt-in token bucket BESIDE the hard sliding
+    # window): a tenant holds up to `burst_credits` chip-seconds of
+    # credit, refilled at `refill_per_second` chip-seconds/s, and each
+    # run's observed chip-seconds drain it. An empty bucket denies with
+    # reason=burst_credits and a deficit-derived Retry-After — bursty
+    # tenants smooth out instead of slamming into the window edge, and
+    # the remaining credit rides the X-Quota-Burst-Credits header and the
+    # Result.phases quota block. Both knobs must be > 0 to engage; the
+    # window budget (when configured) still enforces beside it.
+    quota_burst_credits: float = 0.0
+    quota_refill_per_second: float = 0.0
+    # -- scale-out control plane (services/state_store.py, replicas.py) ------
+    # Where cross-replica scheduler/breaker/lease state lives. Empty or
+    # "memory" = a PRIVATE in-memory store: single-replica mode, every
+    # cross-replica code path skipped — today's behavior byte-for-byte.
+    # "sqlite:///path/state.db" (or a bare path) = the shared file-backed
+    # store (stdlib sqlite, WAL + advisory locking): point N replicas at
+    # one path on a shared volume and they cooperate — WFQ tags stay
+    # globally fair, a breaker tripped on one replica is open on all,
+    # a host fenced by one is never granted by another.
+    state_store: str = ""
+    # This replica's identity on the consistent-hash ring. Empty = the
+    # POD_NAME env var (k8s downward API), else the hostname.
+    replica_self: str = ""
+    # The replica set, comma-separated `id=http://host:port` (or bare
+    # host:port) entries — e.g. the pod names a k8s headless Service
+    # resolves. Empty = single-replica mode: no ring, no affinity checks,
+    # no proxying (today's behavior).
+    replica_peers: str = ""
+    # How a non-owner replica handles a session request it does not own:
+    # 1 = transparently proxy it to the owner; 0 = answer 307 with the
+    # owner's URL in Location + X-Replica-Owner (clients re-issue).
+    replica_proxy: bool = True
+    # Liveness heartbeat cadence (each replica publishes into the shared
+    # store) and the staleness TTL past which a silent peer drops off the
+    # ring — its sessions then rehash onto the survivors.
+    replica_heartbeat_interval: float = 2.0
+    replica_heartbeat_ttl: float = 10.0
     # -- shutdown ------------------------------------------------------------
     # Graceful drain budget on SIGTERM: health flips to NOT_SERVING and new
     # executes shed immediately, then shutdown waits up to this many seconds

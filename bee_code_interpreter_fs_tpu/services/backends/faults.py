@@ -497,6 +497,19 @@ class FaultInjectingBackend(SandboxBackend):
         no, so chaos runs stay deterministic)."""
         return getattr(self.inner, "supports_lease_push", True)
 
+    def lease_scope(self, chip_count: int, sandbox=None):
+        """Hardware lease-scope naming — delegated (the wrapper changes
+        fault behavior, not which chips a sandbox holds). None (falsy)
+        when the inner backend declares nothing: the executor then uses
+        its lane default."""
+        scope_fn = getattr(self.inner, "lease_scope", None)
+        if scope_fn is None:
+            return None
+        try:
+            return scope_fn(chip_count, sandbox=sandbox)
+        except TypeError:
+            return scope_fn(chip_count)
+
     def _fire(self, name: str, rate: float) -> bool:
         if rate <= 0.0 or self._rngs[name].random() >= rate:
             return False

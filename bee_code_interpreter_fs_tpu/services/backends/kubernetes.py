@@ -98,6 +98,24 @@ class KubernetesSandboxBackend(SandboxBackend):
             return "private"
         return "external"
 
+    def lease_scope(self, chip_count: int, sandbox=None) -> str:
+        """Per-NODE lease scopes (the PR 13 carried follow-up): a sandbox
+        whose pods' nodes are known leases `lane-<n>@node-a[+node-b...]`,
+        so fencing a wedged host quarantines exactly that node's (or
+        slice's node-set's) chips — replacements elsewhere in the lane
+        keep serving, instead of the whole chip-count lane re-earning its
+        clean-probe streak for one bad node. Callers without a sandbox
+        (the executor's lane-level recovering gate) — and pods whose node
+        the API never reported — get the coarse lane scope; the registry
+        and wire format take any string, so no other layer changes."""
+        if sandbox is not None:
+            nodes = sandbox.meta.get("node_names")
+            if isinstance(nodes, list):
+                named = sorted(str(n) for n in nodes if n)
+                if named:
+                    return f"lane-{chip_count}@" + "+".join(named)
+        return f"lane-{chip_count}"
+
     def bind_breakers(self, board) -> None:
         """Give the pod-watch path direct access to the executor's per-lane
         spawn breakers: a failed `kubectl wait` / IP-assignment watch counts
@@ -494,7 +512,10 @@ class KubernetesSandboxBackend(SandboxBackend):
 
     async def _wait_ready_ip(
         self, name: str, lane: int = 0, *, record: bool = False
-    ) -> str:
+    ) -> tuple[str, str]:
+        """(podIP, nodeName) once the pod is Ready. The node name feeds
+        `lease_scope`: fencing quarantines the NODE's chips, not the whole
+        chip-count lane."""
         try:
             await self.kubectl.wait(
                 "pod",
@@ -506,7 +527,7 @@ class KubernetesSandboxBackend(SandboxBackend):
             pod_ip = pod["status"].get("podIP")
             if not pod_ip:
                 raise SandboxSpawnError(f"pod {name} Ready but has no podIP")
-            return pod_ip
+            return pod_ip, str(pod.get("spec", {}).get("nodeName") or "")
         except KubectlError as e:
             # Group spawns record a lane strike PER failed host watch, the
             # moment it happens — N dead pods of one slice are N independent
@@ -560,7 +581,7 @@ class KubernetesSandboxBackend(SandboxBackend):
         owner = await self._owner_reference()
         await self._create_pod(self.pod_manifest(name, chip_count, owner))
         try:
-            pod_ip = await self._wait_ready_ip(name)
+            pod_ip, node_name = await self._wait_ready_ip(name)
         except (SandboxSpawnError, asyncio.CancelledError):
             # Failed or cancelled spawn must not leak a pod (reference
             # :257-261; cancellation happens on service shutdown).
@@ -570,7 +591,10 @@ class KubernetesSandboxBackend(SandboxBackend):
             id=name,
             url=f"http://{pod_ip}:{EXECUTOR_PORT}",
             chip_count=chip_count,
-            meta={"pod_ip": pod_ip},
+            meta={
+                "pod_ip": pod_ip,
+                "node_names": [node_name] if node_name else [],
+            },
         )
         self._live[name] = sandbox
         logger.info("spawned executor pod %s (%d chips) at %s", name, chip_count, pod_ip)
@@ -637,26 +661,32 @@ class KubernetesSandboxBackend(SandboxBackend):
                 return_exceptions=True,
             )
             _raise_first(created, group)
-            ips = await asyncio.gather(
+            ready = await asyncio.gather(
                 *(
                     self._wait_ready_ip(n, chip_count, record=True)
                     for n in names
                 ),
                 return_exceptions=True,
             )
-            _raise_first(ips, group)
+            _raise_first(ready, group)
         except (SandboxSpawnError, asyncio.CancelledError):
             for name in names:  # no partial slices
                 self._delete_soon(name)
             self._delete_service_soon(group)
             raise
+        ips = [ip for ip, _ in ready]
+        node_names = sorted({node for _, node in ready if node})
         urls = [f"http://{ip}:{EXECUTOR_PORT}" for ip in ips]
         sandbox = Sandbox(
             id=group,
             url=urls[0],
             chip_count=chip_count,
             host_urls=urls,
-            meta={"pods": names, "coordinator_ip": coordinator_ip},
+            meta={
+                "pods": names,
+                "coordinator_ip": coordinator_ip,
+                "node_names": node_names,
+            },
         )
         self._live[group] = sandbox
         logger.info(

@@ -53,21 +53,80 @@ class CircuitBreaker:
         cooldown: float = 30.0,
         clock: Callable[[], float] = time.monotonic,
         name: str = "",
+        store=None,
+        walltime: Callable[[], float] = time.time,
     ) -> None:
         self.failure_threshold = max(1, failure_threshold)
         self.cooldown = cooldown
         self.clock = clock
         self.name = name
+        self.walltime = walltime
+        # Shared-state seam (services/state_store.py): with a SHARED store
+        # wired, open verdicts publish as {until_wall, failures} under
+        # ns="breaker" and every replica's state read merges the remote
+        # verdict in — a lane tripped on replica A fails fast on replica B
+        # too, instead of B burning its own failure ladder against the
+        # same dead backend. A private store (the default) leaves every
+        # path below byte-for-byte as before.
+        self._store = store if store is not None and store.shared and name else None
+        # Remote reads are one KV get; bound even that on scrape-heavy
+        # paths with a tiny freshness window (wall clock).
+        self._remote_cache: tuple[float, float | None] = (0.0, None)
         self._failures = 0
         self._opened_at: float | None = None
 
     # ------------------------------------------------------------------ state
 
+    def _remote_open_until(self) -> float | None:
+        """The shared store's open-until wall time for this lane, or None.
+        A record whose window has passed is treated as absent (half-open
+        probes flow on every replica once the cooldown elapses)."""
+        if self._store is None:
+            return None
+        now = self.walltime()
+        expires, cached = self._remote_cache
+        if now < expires:
+            until = cached
+        else:
+            record = self._store.get("breaker", self.name)
+            until = record.get("until_wall") if isinstance(record, dict) else None
+            if not isinstance(until, (int, float)):
+                until = None
+            self._remote_cache = (now + 0.25, until)
+        if until is not None and until > now:
+            return float(until)
+        return None
+
+    def _publish_open(self) -> None:
+        if self._store is None:
+            return
+        until = self.walltime() + self.cooldown
+        self._store.put(
+            "breaker",
+            self.name,
+            {"until_wall": until, "failures": self._failures},
+        )
+        self._remote_cache = (0.0, None)
+
+    def _clear_shared(self) -> None:
+        if self._store is None:
+            return
+        self._store.delete("breaker", self.name)
+        self._remote_cache = (0.0, None)
+
     @property
     def state(self) -> str:
         if self._opened_at is None:
+            if self._remote_open_until() is not None:
+                # Another replica's verdict: hard-open there, hard-open
+                # here — there is one physical backend behind the lane.
+                return OPEN
             return CLOSED
         if self.clock() - self._opened_at >= self.cooldown:
+            if self._remote_open_until() is not None:
+                # A peer re-opened the lane after this replica's cooldown
+                # started: its fresher verdict rules.
+                return OPEN
             return HALF_OPEN
         return OPEN
 
@@ -79,9 +138,13 @@ class CircuitBreaker:
 
     def retry_after(self) -> float:
         """Seconds until the next probe is allowed (0 when traffic flows)."""
-        if self._opened_at is None:
-            return 0.0
-        return max(0.0, self.cooldown - (self.clock() - self._opened_at))
+        local = 0.0
+        if self._opened_at is not None:
+            local = max(0.0, self.cooldown - (self.clock() - self._opened_at))
+        remote_until = self._remote_open_until()
+        if remote_until is not None:
+            return max(local, remote_until - self.walltime())
+        return local
 
     # ----------------------------------------------------------------- events
 
@@ -116,6 +179,10 @@ class CircuitBreaker:
             logger.info(
                 "circuit breaker %s closed (probe succeeded)", self.name
             )
+            # The probe proved the backend back: clear the shared verdict
+            # so every replica's traffic flows again (only a transition
+            # writes — the hot success path touches no store).
+            self._clear_shared()
         self._failures = 0
         self._opened_at = None
 
@@ -129,6 +196,7 @@ class CircuitBreaker:
         self._failures = max(self._failures, self.failure_threshold)
         already_open = self.state == OPEN
         self._opened_at = self.clock()
+        self._publish_open()
         if not already_open:
             logger.warning(
                 "circuit breaker %s tripped open%s (cooldown %.1fs)",
@@ -144,6 +212,7 @@ class CircuitBreaker:
             # Half-open probe failure re-opens with a FRESH cooldown; a
             # closed lane crossing the threshold opens for the first time.
             self._opened_at = self.clock()
+            self._publish_open()
             if was != OPEN:
                 logger.warning(
                     "circuit breaker %s opened (%d consecutive failures; "
@@ -165,10 +234,14 @@ class BreakerBoard:
         failure_threshold: int = 5,
         cooldown: float = 30.0,
         clock: Callable[[], float] = time.monotonic,
+        store=None,
+        walltime: Callable[[], float] = time.time,
     ) -> None:
         self.failure_threshold = failure_threshold
         self.cooldown = cooldown
         self.clock = clock
+        self.walltime = walltime
+        self._store = store if store is not None and store.shared else None
         self._lanes: dict[int, CircuitBreaker] = {}
 
     def lane(self, chip_count: int) -> CircuitBreaker:
@@ -179,17 +252,30 @@ class BreakerBoard:
                 cooldown=self.cooldown,
                 clock=self.clock,
                 name=str(chip_count),
+                store=self._store,
+                walltime=self.walltime,
             )
             self._lanes[chip_count] = breaker
         return breaker
 
     def is_open(self, chip_count: int) -> bool:
         breaker = self._lanes.get(chip_count)
-        return breaker.is_open if breaker is not None else False
+        if breaker is None:
+            if self._store is None:
+                return False
+            # Shared mode: a lane this replica never touched can still be
+            # open fleet-wide (a peer tripped it) — the lazily created
+            # breaker reads the shared verdict.
+            breaker = self.lane(chip_count)
+        return breaker.is_open
 
     def retry_after(self, chip_count: int) -> float:
         breaker = self._lanes.get(chip_count)
-        return breaker.retry_after() if breaker is not None else 0.0
+        if breaker is None:
+            if self._store is None:
+                return 0.0
+            breaker = self.lane(chip_count)
+        return breaker.retry_after()
 
     def states(self) -> dict[int, str]:
         return {lane: breaker.state for lane, breaker in self._lanes.items()}

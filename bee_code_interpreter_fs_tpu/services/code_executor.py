@@ -71,6 +71,7 @@ from .limits import VIOLATION_KINDS, request_limits, validate_config_limits
 from .perf_observer import PerfObserver
 from .quotas import QuotaEnforcer, QuotaVerdict
 from .scheduler import SandboxScheduler
+from .state_store import StateStore, make_state_store, resolve_replica_id
 from .storage import Storage, StorageObjectNotFound
 from .transfer import (
     HostManifest,
@@ -181,6 +182,7 @@ class CodeExecutor:
         usage: UsageLedger | None = None,
         quotas: QuotaEnforcer | None = None,
         perf: PerfObserver | None = None,
+        state_store: StateStore | None = None,
     ) -> None:
         self.backend = backend
         self.storage = storage
@@ -189,6 +191,31 @@ class CodeExecutor:
         # not per request as a spurious client 400.
         validate_config_limits(self.config)
         self.metrics = metrics or ExecutorMetrics()
+        # Pluggable control-plane state (services/state_store.py): the
+        # scheduler's WFQ tags, breaker verdicts, lease generations/fence
+        # floors, and lane-occupancy gauges route through this seam. The
+        # default is a PRIVATE in-memory store — every component then
+        # skips its cross-replica path and runs today's single-process
+        # behavior byte-for-byte. A SHARED store (APP_STATE_STORE=sqlite
+        # path, or one in-memory instance handed to several in-process
+        # executors) is what lets N replicas cooperate instead of
+        # double-granting lanes or double-fencing hosts.
+        self.state_store = state_store or make_state_store(self.config)
+        self._store_shared = bool(self.state_store.shared)
+        self.replica_id = (
+            resolve_replica_id(self.config) or self.config.replica_self or ""
+        )
+        if self._store_shared and not self.replica_id:
+            # A shared store handed in directly (tests, the bench) still
+            # needs a distinct identity per executor instance.
+            self.replica_id = f"replica-{id(self) & 0xFFFF:04x}"
+        # Session→replica affinity router (services/replicas.py), attached
+        # by the application context when a replica set is configured;
+        # surfaced through /statusz. None in single-replica mode.
+        self.session_router = None
+        # Short-lived cache over the peer-occupancy store scan (the
+        # breaker's remote-read discipline): lane -> (expires_wall, busy).
+        self._peer_busy_cache: dict[int, tuple[float, int]] = {}
         # Request-scoped tracing: the executor owns the tracer so both API
         # servers (which create the root spans) and the pipeline stages here
         # (which create children) share one sampling decision and one ring.
@@ -200,6 +227,7 @@ class CodeExecutor:
         self.breakers = breakers or BreakerBoard(
             failure_threshold=self.config.breaker_failure_threshold,
             cooldown=self.config.breaker_cooldown,
+            store=self.state_store,
         )
         # Backends with long-running watch paths (kubernetes pod-watch) feed
         # the same lane breakers directly, so a watch failure counts without
@@ -212,7 +240,7 @@ class CodeExecutor:
         # priority classes, deadline-aware admission, bounded per-tenant
         # depth. _acquire is a thin client of its grant tokens.
         self.scheduler = scheduler or SandboxScheduler(
-            self.config, metrics=self.metrics
+            self.config, metrics=self.metrics, store=self.state_store
         )
         # Per-tenant usage metering (services/usage.py): every request's
         # chip-seconds, queue wait, transfer bytes, recompiles, violations,
@@ -342,6 +370,7 @@ class CodeExecutor:
         self.leases = LeaseRegistry(
             readmit_streak=self.config.device_probe_readmit_streak,
             clock=self.scheduler.now,
+            store=self.state_store,
         )
         # Actuation budget: fence timestamps per lane — at most
         # device_fence_max_per_window actuations per window, so a probe
@@ -533,11 +562,93 @@ class CodeExecutor:
 
     def _lane_capacity(self, chip_count: int) -> int | None:
         capacity_fn = getattr(self.backend, "pool_capacity", None)
-        return capacity_fn(chip_count) if capacity_fn is not None else None
+        capacity = capacity_fn(chip_count) if capacity_fn is not None else None
+        if (
+            capacity is not None
+            and self._store_shared
+            # Backends whose capacity names REPLICA-LOCAL hardware (each
+            # replica brought its own node pool) opt out: peers' holds
+            # don't contend for these chips.
+            and getattr(self.backend, "capacity_shared_across_replicas", True)
+        ):
+            # N replicas share one physical substrate (the k8s cluster's
+            # chips, or one machine's TPU): subtract what PEERS currently
+            # hold so their spawn-vs-wait decisions cooperate. The
+            # cooperation is BOUNDED-STALENESS (gauges publish at the
+            # spawn claim, reads cache 0.25s), not an atomic reservation:
+            # two replicas racing the last slot inside one freshness
+            # window both spawn, and the overshoot degrades to what the
+            # physical backend arbitrates anyway — a queued/failed spawn
+            # — never to corruption. Stale gauges (dead replica) age out
+            # on the heartbeat TTL so a crashed peer's holds stop gating
+            # the survivors.
+            capacity = max(0, capacity - self._peer_busy(chip_count))
+        return capacity
+
+    # ------------------------------------------------- cross-replica state
+
+    def _publish_occupancy(self, lane: int) -> None:
+        """Publish this replica's physical holds on the lane (in-use +
+        session-held + in-flight spawns) into the shared store — the other
+        half of `_lane_capacity`'s peer subtraction. No-op in
+        single-replica mode."""
+        if not self._store_shared:
+            return
+        busy = (
+            self._in_use.get(lane, 0)
+            + self._session_held.get(lane, 0)
+            + self._spawning.get(lane, 0)
+        )
+        try:
+            self.state_store.put(
+                "occupancy",
+                f"{lane}/{self.replica_id}",
+                {"busy": busy, "ts": time.time()},
+            )
+        except Exception:  # noqa: BLE001 — a gauge write must not fail serving
+            logger.warning("occupancy publish failed", exc_info=True)
+
+    def _peer_busy(self, lane: int) -> int:
+        """Sum of PEER replicas' fresh occupancy gauges for the lane.
+        The store scan is bounded by a short freshness window (the
+        breaker's _remote_cache discipline): _lane_capacity sits on the
+        hot acquire path, and occupancy staleness of a quarter second is
+        already inside the sweep-kick staleness bound."""
+        now = time.time()
+        expires, cached = self._peer_busy_cache.get(lane, (0.0, 0))
+        if now < expires:
+            return cached
+        ttl = max(1.0, self.config.replica_heartbeat_ttl)
+        total = 0
+        try:
+            rows = self.state_store.items("occupancy")
+        except Exception:  # noqa: BLE001 — degraded store reads as empty
+            # Cache the failure verdict too: a degraded store must not be
+            # re-scanned (up to the sqlite busy timeout, on the event
+            # loop) by every capacity check.
+            self._peer_busy_cache[lane] = (now + 0.25, 0)
+            return 0
+        for key, record in rows.items():
+            row_lane, _, rid = key.partition("/")
+            if row_lane != str(lane) or rid == self.replica_id:
+                continue
+            if not isinstance(record, dict):
+                continue
+            ts = record.get("ts")
+            busy = record.get("busy")
+            if (
+                isinstance(ts, (int, float))
+                and now - ts <= ttl
+                and isinstance(busy, (int, float))
+            ):
+                total += max(0, int(busy))
+        self._peer_busy_cache[lane] = (now + 0.25, total)
+        return total
 
     def _notify_lane(self, chip_count: int) -> None:
         """Capacity turnover on the lane: the scheduler wakes the next
         waiter in fair order (an explicit grant, not a broadcast)."""
+        self._publish_occupancy(chip_count)
         self.scheduler.kick(chip_count)
 
     def _notify_all_lanes(self) -> None:
@@ -641,6 +752,7 @@ class CodeExecutor:
             if missing <= 0:
                 return
         self._spawning[chip_count] = self._spawning.get(chip_count, 0) + missing
+        self._publish_occupancy(chip_count)
         succeeded = 0
 
         async def spawn_one() -> None:
@@ -811,16 +923,27 @@ class CodeExecutor:
     # daemon detects (PR 8); these methods act — lease revocation, lane
     # drain, dispose-and-replace, and the recovering-scope quarantine.
 
-    def _lease_scope(self, chip_count: int) -> str:
+    def _lease_scope(self, chip_count: int, sandbox: Sandbox | None = None) -> str:
         """The lease scope a lane's sandboxes attach on: the backend's own
         hardware naming when it has one (`lease_scope(chip_count)`), else
         the chip-count lane — which on the local backend IS the chip-set
         (every warm sandbox holds the same physical TPU). Scopes name
         hardware, not sandboxes: that is what lets "the replacement on the
-        same chips must re-earn trust" be expressed at all."""
+        same chips must re-earn trust" be expressed at all.
+
+        Backends that can name PER-HOST hardware (kubernetes: the node/
+        slice a pod landed on) take the sandbox too — fencing then
+        quarantines exactly the wedged node's chips instead of the whole
+        chip-count lane (the PR 13 carried follow-up). Callers without a
+        sandbox in hand (the lane-level recovering gate) get the lane
+        default, which such backends treat as the coarse parent scope."""
         scope_fn = getattr(self.backend, "lease_scope", None)
         if scope_fn is not None:
-            scope = scope_fn(chip_count)
+            try:
+                scope = scope_fn(chip_count, sandbox=sandbox)
+            except TypeError:
+                # Older single-arg backends (and wrappers) keep working.
+                scope = scope_fn(chip_count)
             if isinstance(scope, str) and scope:
                 return scope
         return f"lane-{chip_count}"
@@ -831,11 +954,29 @@ class CodeExecutor:
         (404) or a transient failure leaves the host without executor-side
         enforcement — the control-plane revocation check still fences it —
         and never fails a spawn."""
-        scope = self._lease_scope(chip_count)
+        scope = self._lease_scope(chip_count, sandbox)
         lease = self.leases.mint(scope, sandbox.id)
         sandbox.meta["lease"] = lease
         if self.leases.recovering(scope):
             sandbox.meta["device_health"] = "recovering"
+        if self._store_shared:
+            # Fleet host registry: which replica owns which host, on what
+            # scope/generation — the shared-store view a peer (or an
+            # operator reading any replica's /statusz) can join against.
+            try:
+                self.state_store.put(
+                    "hosts",
+                    sandbox.id,
+                    {
+                        "replica": self.replica_id,
+                        "lane": chip_count,
+                        "scope": scope,
+                        "generation": lease.generation,
+                        "ts": time.time(),
+                    },
+                )
+            except Exception:  # noqa: BLE001
+                logger.warning("host registry publish failed", exc_info=True)
         if not self.config.device_fence_enabled:
             return
         # Backends whose sandboxes are not real HTTP hosts (the in-memory
@@ -871,10 +1012,13 @@ class CodeExecutor:
         sees the claim, the stateless retry ladder replays on a fresh
         sandbox, and a session gets the standard typed close."""
         lease = sandbox.meta.get("lease")
-        if isinstance(lease, Lease) and lease.revoked:
+        if isinstance(lease, Lease) and self.leases.stale(lease):
+            # Locally revoked (this replica fenced it), or at-or-below the
+            # scope's shared fence floor (a PEER replica fenced the
+            # hardware) — either way the claim must never reach the chips.
             raise StaleLeaseError(
                 f"sandbox {sandbox.id} lease {lease.wire_token} was fenced "
-                f"({lease.revoke_reason or 'wedged'}); the request must "
+                f"({lease.revoke_reason or 'fenced'}); the request must "
                 "move to a healthy host",
                 scope=lease.scope,
             )
@@ -1264,6 +1408,10 @@ class CodeExecutor:
                     self._spawning[chip_count] = (
                         self._spawning.get(chip_count, 0) + 1
                     )
+                    # Publish the claim BEFORE the spawn starts (peers'
+                    # capacity subtraction sees it at the earliest
+                    # possible instant, not after the grant settles).
+                    self._publish_occupancy(chip_count)
                     # Leave the queue BEFORE spawning: this waiter now owns
                     # its own supply, so the grant passes to the next waiter,
                     # which re-evaluates against the bumped spawn count.
@@ -1313,6 +1461,7 @@ class CodeExecutor:
         if ticket is not None:
             self.scheduler.complete(ticket)
         self._in_use[chip_count] = self._in_use.get(chip_count, 0) + 1
+        self._publish_occupancy(chip_count)
         self.fill_pool_soon(chip_count)
         return sandbox
 
@@ -1333,6 +1482,36 @@ class CodeExecutor:
         for kill-switch parity (with actuation off, a lane whose only
         pooled hosts are wedged zombies must still hand something out
         rather than livelock a constrained lane, the PR 8 behavior)."""
+        if self._store_shared:
+            # Shared-fence gate: a pooled host whose lease sits at-or-below
+            # its scope's published fence floor was fenced by a PEER
+            # replica — it must never be granted here ("a host fenced by A
+            # is never granted by B"). Drain it through the standard
+            # dispose path (lease-fenced turnover) so the lane refills with
+            # a fresh-generation host instead of carrying a zombie slot.
+            for candidate in [
+                c
+                for c in pool
+                if not c.meta.get("lease_fenced")
+                and isinstance(c.meta.get("lease"), Lease)
+                and self.leases.stale(c.meta["lease"])
+            ]:
+                try:
+                    pool.remove(candidate)
+                except ValueError:
+                    continue
+                candidate.meta["lease_fenced"] = True
+                candidate.meta["device_health"] = "draining"
+                logger.warning(
+                    "pooled host %s drained: its lease scope was fenced by "
+                    "a peer replica",
+                    candidate.id,
+                )
+                task = asyncio.get_running_loop().create_task(
+                    self._off_request_path(self._dispose(candidate))
+                )
+                self._dispose_tasks.add(task)
+                task.add_done_callback(self._dispose_tasks.discard)
         prefer_untainted = self.compile_cache.enabled and _trusted_source_var.get()
         fallback: int | None = None
         wedged_fallback: int | None = None
@@ -4152,6 +4331,11 @@ class CodeExecutor:
 
     async def _dispose(self, sandbox: Sandbox) -> None:
         self._live_sandboxes.pop(sandbox.id, None)
+        if self._store_shared:
+            try:
+                self.state_store.delete("hosts", sandbox.id)
+            except Exception:  # noqa: BLE001
+                logger.warning("host registry drop failed", exc_info=True)
         try:
             await self.backend.delete(sandbox)
         except Exception:  # noqa: BLE001
@@ -4262,6 +4446,16 @@ class CodeExecutor:
             body["otlp"] = {"enabled": True, **self.otlp_exporter.stats()}
         else:
             body["otlp"] = {"enabled": False}
+        # The scale-out view: which replica this is, who is on the ring,
+        # and how much traffic was proxied/redirected to session owners.
+        if self.session_router is not None:
+            body["replicas"] = {"enabled": True, **self.session_router.snapshot()}
+        elif self._store_shared:
+            body["replicas"] = {
+                "enabled": True,
+                "self": self.replica_id,
+                "store": type(self.state_store).__name__,
+            }
         return body
 
     async def sweep_pool_health(self) -> int:
@@ -4325,7 +4519,19 @@ class CodeExecutor:
         hysteresis, start spawn-ahead refills where demand says supply will
         lag, and reap excess idle warm sandboxes so shared chip capacity
         migrates to pressured lanes. Returns the number reaped."""
-        if not self.autoscaler.enabled or self._closed:
+        if self._closed:
+            return 0
+        if self._store_shared:
+            # Cross-replica supply is invisible to the event-driven kicks
+            # (a PEER's release frees capacity this replica's waiters are
+            # parked on): the sweep doubles as the bounded-staleness
+            # refresh — republish our occupancy gauges and wake every
+            # lane's head so it re-evaluates against the peers' current
+            # holds.
+            for lane in self._known_lanes():
+                self._publish_occupancy(lane)
+            self.scheduler.kick_all()
+        if not self.autoscaler.enabled:
             return 0
         reaped = 0
         for lane in self._known_lanes():
@@ -4404,8 +4610,11 @@ class CodeExecutor:
     def start_autoscaler(self, interval: float | None = None) -> asyncio.Task | None:
         """Run autoscale_sweep periodically until close(). None (no loop)
         with the kill switch on or a zero interval — targets then only
-        ever move UP, on arrivals, and nothing is reaped."""
-        if not self.autoscaler.enabled:
+        ever move UP, on arrivals, and nothing is reaped. With a SHARED
+        state store the loop still runs (even autoscale-disabled): it is
+        the bounded-staleness refresh that re-publishes occupancy and
+        wakes waiters parked behind a peer's since-released capacity."""
+        if not self.autoscaler.enabled and not self._store_shared:
             return None
         if interval is None:
             interval = self.config.pool_autoscale_interval
@@ -4661,3 +4870,18 @@ class CodeExecutor:
         if self._client is not None and not self._client.is_closed:
             await self._client.aclose()
         await self.backend.close()
+        # Retire this replica's shared-state footprint: peers must not
+        # keep subtracting a dead replica's occupancy until the TTL ages
+        # it out when the shutdown was orderly.
+        if self._store_shared:
+            try:
+                for lane in list(
+                    set(self._in_use) | set(self._session_held) | set(self._spawning)
+                ):
+                    self.state_store.delete(
+                        "occupancy", f"{lane}/{self.replica_id}"
+                    )
+                self.state_store.delete("replicas", self.replica_id)
+            except Exception:  # noqa: BLE001
+                logger.warning("shared-state retirement failed", exc_info=True)
+        self.state_store.close()

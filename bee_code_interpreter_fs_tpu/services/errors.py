@@ -147,6 +147,9 @@ class QuotaExceededError(AdmissionRejectedError):
         remaining_chip_seconds: float | None = None,
         limit_chip_seconds: float | None = None,
         window_seconds: float | None = None,
+        remaining_hbm_byte_seconds: float | None = None,
+        limit_hbm_byte_seconds: float | None = None,
+        burst_credits_remaining: float | None = None,
     ) -> None:
         super().__init__(
             message, lane=0, tenant=tenant, retry_after=retry_after
@@ -155,6 +158,13 @@ class QuotaExceededError(AdmissionRejectedError):
         self.remaining_chip_seconds = remaining_chip_seconds
         self.limit_chip_seconds = limit_chip_seconds
         self.window_seconds = window_seconds
+        # HBM budget denials (reason="hbm_byte_seconds") carry the memory
+        # window's remaining/limit; burst-mode denials
+        # (reason="burst_credits") carry the bucket level — each rides its
+        # own X-Quota-* header so pacing clients can tell the budgets apart.
+        self.remaining_hbm_byte_seconds = remaining_hbm_byte_seconds
+        self.limit_hbm_byte_seconds = limit_hbm_byte_seconds
+        self.burst_credits_remaining = burst_credits_remaining
 
 
 class CircuitOpenError(SessionLimitError):

@@ -246,6 +246,24 @@ class CodeInterpreterServicer:
             extra.append(
                 ("x-quota-window-seconds", f"{e.window_seconds:.3f}")
             )
+        if getattr(e, "remaining_hbm_byte_seconds", None) is not None:
+            extra.append(
+                (
+                    "x-quota-remaining-hbm-byte-seconds",
+                    f"{e.remaining_hbm_byte_seconds:.3f}",
+                )
+            )
+        if getattr(e, "limit_hbm_byte_seconds", None) is not None:
+            extra.append(
+                (
+                    "x-quota-limit-hbm-byte-seconds",
+                    f"{e.limit_hbm_byte_seconds:.3f}",
+                )
+            )
+        if getattr(e, "burst_credits_remaining", None) is not None:
+            extra.append(
+                ("x-quota-burst-credits", f"{e.burst_credits_remaining:.6f}")
+            )
         set_trailing = getattr(context, "set_trailing_metadata", None)
         if set_trailing is not None:
             set_trailing(tuple(extra))
@@ -283,6 +301,41 @@ class CodeInterpreterServicer:
                 )
         return has_code, has_file
 
+    async def _check_session_owner(
+        self,
+        context: grpc.aio.ServicerContext,
+        executor_id: str | None,
+        metadata: dict,
+        trailing: list[tuple[str, str]] | None = None,
+    ) -> None:
+        """Session→replica affinity on the gRPC edge: a session request
+        this replica does not own aborts UNAVAILABLE with the owner's
+        identity (and address, when known) in trailing metadata
+        (`x-replica-owner` / `x-replica-owner-url`) — the transport-level
+        analogue of the HTTP 307 + X-Replica-Owner contract (gRPC has no
+        transparent-proxy story without a full client channel per peer;
+        clients re-resolve against the named owner). Stateless RPCs and
+        single-replica mode pass through untouched."""
+        router = self.code_executor.session_router
+        if router is None or not executor_id:
+            return
+        tenant = metadata.get("x-tenant")
+        if router.owns(tenant, executor_id):
+            return
+        owner = router.owner_of(tenant, executor_id)
+        extra = list(trailing or []) + [("x-replica-owner", owner)]
+        url = router.ring.url_of(owner)
+        if url:
+            extra.append(("x-replica-owner-url", url))
+        set_trailing = getattr(context, "set_trailing_metadata", None)
+        if set_trailing is not None:
+            set_trailing(tuple(extra))
+        await context.abort(
+            grpc.StatusCode.UNAVAILABLE,
+            f"session {executor_id!r} is owned by replica {owner!r}; "
+            "re-issue against it (x-replica-owner metadata)",
+        )
+
     @staticmethod
     def _result_to_response(result) -> pb2.ExecuteResponse:
         response = pb2.ExecuteResponse(
@@ -307,6 +360,9 @@ class CodeInterpreterServicer:
         with span:
             has_code, has_file = await self._validate_execute_request(
                 request, context
+            )
+            await self._check_session_owner(
+                context, request.executor_id or None, metadata, trailing
             )
             admission = await self._admission_from_metadata(context, metadata)
             limits = await self._limits_from_metadata(context, metadata)
@@ -371,6 +427,9 @@ class CodeInterpreterServicer:
             has_code, has_file = await self._validate_execute_request(
                 request, context
             )
+            await self._check_session_owner(
+                context, request.executor_id or None, metadata, trailing
+            )
             admission = await self._admission_from_metadata(context, metadata)
             limits = await self._limits_from_metadata(context, metadata)
             events = self.code_executor.execute_stream(
@@ -428,6 +487,9 @@ class CodeInterpreterServicer:
                 grpc.StatusCode.INVALID_ARGUMENT,
                 "invalid executor_id (want ^[0-9a-zA-Z_-]{1,255}$)",
             )
+        await self._check_session_owner(
+            context, request.executor_id, self._metadata_dict(context)
+        )
         closed = await self.code_executor.close_session(request.executor_id)
         return pb2.CloseExecutorResponse(closed=closed)
 
@@ -461,6 +523,9 @@ class CodeInterpreterServicer:
                 await context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT, "timeout must be >= 0"
                 )
+            await self._check_session_owner(
+                context, request.executor_id or None, metadata, trailing
+            )
             try:
                 tool_input = json.loads(request.tool_input_json)
             except json.JSONDecodeError:

@@ -89,6 +89,12 @@ class ExecuteCustomToolRequest(BaseModel):
     # sharing an id see each other's workspace files.
     executor_id: str | None = None
     timeout: float | None = Field(default=None, gt=0)
+    # The session-affinity key's tenant half (body-then-X-Tenant-header,
+    # the same resolution as /v1/execute): a session created with a body
+    # tenant must hash to the SAME replica from every route that can
+    # touch it. Routing-only on this surface — custom-tool admission
+    # itself runs under the shared tenant, as before.
+    tenant: str | None = None
 
 
 def _usage_row_text(tenant: str, row: dict) -> str:
@@ -344,6 +350,19 @@ def statusz_text(body: dict) -> str:
         )
     else:
         lines.append("otlp: disabled")
+    replicas = body.get("replicas", {})
+    if replicas.get("enabled"):
+        live = replicas.get("live")
+        lines.append(
+            f"replicas: self={replicas.get('self')} "
+            + (
+                f"live={'/'.join(live)} "
+                f"proxied={replicas.get('proxied_total', 0)} "
+                f"redirected={replicas.get('redirected_total', 0)}"
+                if live is not None
+                else f"store={replicas.get('store', '?')} (no peer ring)"
+            )
+        )
     usage = body.get("usage", {})
     if usage.get("enabled"):
         lines.append(
@@ -390,8 +409,54 @@ def create_http_app(
     custom_tool_executor: CustomToolExecutor,
     storage: Storage,
     tracer: Tracer | None = None,
+    router=None,
 ) -> web.Application:
     tracer = tracer or code_executor.tracer
+    # Session→replica affinity (services/replicas.py): with a replica set
+    # configured, session requests this replica does not own are proxied
+    # (or 307-redirected) to the owner. None = single-replica mode: zero
+    # routing code on any path.
+    router = router if router is not None else code_executor.session_router
+
+    async def route_session(
+        request: web.Request, tenant: str | None, executor_id: str | None
+    ):
+        """Affinity gate for session-carrying routes: None = serve locally
+        (stateless request, we own the key, or single-replica mode); a
+        Response = the owner's answer (transparent proxy) or the 307
+        redirect contract. A dead owner drops off the ring inside
+        `forward`, so the loop re-evaluates against the survivors — the
+        failover path: the key rehashes (usually to us) and serving
+        continues after lease-fenced turnover of the dead owner's hosts.
+        NOTE: the proxied NDJSON stream is relayed buffered — incremental
+        events coalesce; the final body is identical."""
+        if router is None or not executor_id:
+            return None
+        if router.peer_forwarded(request.headers.get("X-Replica-Forwarded-By")):
+            # Forwarded by a PEER (the header carries the fleet's
+            # shared-store secret — a client-spoofed value fails the
+            # check and routes normally): serve HERE regardless of what
+            # this replica's ring says. Ring views can diverge for up to
+            # one TTL (per-replica proxy suspicions), and without this
+            # guard a disagreement becomes an unbounded A→B→C→A proxy
+            # cycle — one hop of disagreement costs at most one misplaced
+            # session, never a loop.
+            return None
+        for _ in range(1 + len(router.ring.peers)):
+            owner = router.owner_of(tenant, executor_id)
+            if owner == router.ring.self_id:
+                return None
+            response = await router.forward(request, owner)
+            if response is not None:
+                return response
+        return None
+
+    def session_tenant(request: web.Request, req=None) -> str | None:
+        """The tenant half of the affinity key — the SAME body-then-header
+        resolution the scheduler sees, so routing and admission can never
+        hash a session to different tenants."""
+        body_tenant = getattr(req, "tenant", None) if req is not None else None
+        return body_tenant or request.headers.get("X-Tenant")
 
     @web.middleware
     async def request_context_middleware(request: web.Request, handler):
@@ -911,6 +976,27 @@ def create_http_app(
         if e.window_seconds is not None:
             headers["X-Quota-Window-Seconds"] = f"{e.window_seconds:.3f}"
             body["quota"]["window_seconds"] = round(e.window_seconds, 3)
+        if getattr(e, "remaining_hbm_byte_seconds", None) is not None:
+            headers["X-Quota-Remaining-Hbm-Byte-Seconds"] = (
+                f"{e.remaining_hbm_byte_seconds:.3f}"
+            )
+            body["quota"]["remaining_hbm_byte_seconds"] = round(
+                e.remaining_hbm_byte_seconds, 3
+            )
+        if getattr(e, "limit_hbm_byte_seconds", None) is not None:
+            headers["X-Quota-Limit-Hbm-Byte-Seconds"] = (
+                f"{e.limit_hbm_byte_seconds:.3f}"
+            )
+            body["quota"]["limit_hbm_byte_seconds"] = round(
+                e.limit_hbm_byte_seconds, 3
+            )
+        if getattr(e, "burst_credits_remaining", None) is not None:
+            headers["X-Quota-Burst-Credits"] = (
+                f"{e.burst_credits_remaining:.6f}"
+            )
+            body["quota"]["burst_credits_remaining"] = round(
+                e.burst_credits_remaining, 6
+            )
         return web.json_response(
             with_trace_id(body), status=429, headers=headers
         )
@@ -962,6 +1048,11 @@ def create_http_app(
         req = await parse_model(request, ExecuteRequest)
         if (error := validate_execute(req)) is not None:
             return error
+        routed = await route_session(
+            request, session_tenant(request, req), req.executor_id
+        )
+        if routed is not None:
+            return routed
         try:
             result = await code_executor.execute(
                 req.source_code,
@@ -1008,6 +1099,11 @@ def create_http_app(
         req = await parse_model(request, ExecuteRequest)
         if (error := validate_execute(req)) is not None:
             return error
+        routed = await route_session(
+            request, session_tenant(request, req), req.executor_id
+        )
+        if routed is not None:
+            return routed
         events = code_executor.execute_stream(
             req.source_code,
             source_file=req.source_file,
@@ -1110,13 +1206,31 @@ def create_http_app(
     async def close_executor_session(request: web.Request) -> web.Response:
         """End an executor_id session: waits out an in-flight request, then
         releases the sandbox (its workspace is discarded; files already
-        round-tripped through /v1/files or Execute responses survive)."""
+        round-tripped through /v1/files or Execute responses survive).
+
+        Replicated deployments: DELETE has no body, so the affinity key's
+        tenant half comes from X-Tenant ALONE — a session created with a
+        body tenant must pass the same tenant as X-Tenant here, or the
+        key hashes to the wrong replica (the 404 body reminds; the idle
+        sweeper bounds the cost of a missed close either way)."""
         executor_id = request.match_info["executor_id"]
         if not OBJECT_ID_RE.match(executor_id):
             return bad_request("invalid executor_id")
+        routed = await route_session(
+            request, session_tenant(request), executor_id
+        )
+        if routed is not None:
+            return routed
         if await code_executor.close_session(executor_id):
             return web.json_response({"closed": executor_id})
-        return web.json_response({"error": "no such session"}, status=404)
+        body = {"error": "no such session"}
+        if router is not None and len(router.ring.peers) > 1:
+            body["hint"] = (
+                "replicated deployment: a session created with a body "
+                "tenant routes by that tenant — pass it as X-Tenant on "
+                "DELETE (idle sweep reclaims missed closes)"
+            )
+        return web.json_response(body, status=404)
 
     @routes.post("/v1/parse-custom-tool")
     async def parse_custom_tool(request: web.Request) -> web.Response:
@@ -1136,6 +1250,11 @@ def create_http_app(
     @routes.post("/v1/execute-custom-tool")
     async def execute_custom_tool(request: web.Request) -> web.Response:
         req = await parse_model(request, ExecuteCustomToolRequest)
+        routed = await route_session(
+            request, session_tenant(request, req), req.executor_id
+        )
+        if routed is not None:
+            return routed
         try:
             tool_input = json.loads(req.tool_input_json)
         except json.JSONDecodeError:

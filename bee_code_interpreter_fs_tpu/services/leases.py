@@ -88,9 +88,21 @@ class LeaseRegistry:
         *,
         readmit_streak: int = 3,
         clock: Callable[[], float] = time.monotonic,
+        store=None,
+        walltime: Callable[[], float] = time.time,
     ) -> None:
         self.readmit_streak = max(1, readmit_streak)
         self.clock = clock
+        self.walltime = walltime
+        # Shared-state seam (services/state_store.py): with a SHARED store
+        # wired, generations mint from one fleet-wide counter per scope
+        # (ns="lease_gen") and fences publish a generation FLOOR per scope
+        # (ns="lease_fence") — every replica's leases at-or-below the
+        # floor are stale, so a host fenced by replica A is refused by
+        # replica B's dispatch and pool-pop paths even though B never saw
+        # the fence happen. A private store (the default) leaves every
+        # path byte-for-byte as before.
+        self._store = store if store is not None and store.shared else None
         self._generations: dict[str, int] = {}
         self._recovering: dict[str, _ScopeRecovery] = {}
         self.fences_total = 0
@@ -101,9 +113,16 @@ class LeaseRegistry:
     def mint(self, scope: str, sandbox_id: str = "") -> Lease:
         """A fresh lease for `scope`, strictly newer than every lease the
         scope ever issued — the monotonicity the executor-side stale check
-        rests on."""
-        generation = self._generations.get(scope, 0) + 1
-        self._generations[scope] = generation
+        rests on. In shared mode the generation comes from the fleet-wide
+        counter, so replicas can never mint the same generation twice."""
+        if self._store is not None:
+            generation = int(self._store.incr("lease_gen", scope))
+            self._generations[scope] = max(
+                self._generations.get(scope, 0), generation
+            )
+        else:
+            generation = self._generations.get(scope, 0) + 1
+            self._generations[scope] = generation
         return Lease(scope=scope, generation=generation, sandbox_id=sandbox_id)
 
     def current_generation(self, scope: str) -> int:
@@ -129,6 +148,36 @@ class LeaseRegistry:
             since=self.clock(),
             reason=reason,
         )
+        if self._store is not None:
+            # Publish the generation FLOOR and the recovering record
+            # SEPARATELY: the floor is permanent (every lease at-or-below
+            # it is stale forever — a peer's pooled host that idled
+            # through the whole recovery window must still be refused
+            # after re-admission, because its process sat through the
+            # wedge), while the recovering record lives only until the
+            # clean-probe streak completes (whichever replica's probes
+            # complete it).
+            def _raise_floor(current):
+                floor = lease.generation
+                if isinstance(current, (int, float)):
+                    floor = max(floor, int(current))
+                return floor, None
+
+            self._store.mutate("lease_floor", lease.scope, _raise_floor)
+
+            def _fence_record(current):
+                return (
+                    {
+                        "reason": reason,
+                        "since_wall": self.walltime(),
+                        "streak": 0,
+                        "need": self.readmit_streak,
+                        "relapses": 0,
+                    },
+                    None,
+                )
+
+            self._store.mutate("lease_fence", lease.scope, _fence_record)
         logger.warning(
             "lease fenced: scope=%s generation=%d sandbox=%s (%s); "
             "re-admission needs %d clean probes",
@@ -143,9 +192,44 @@ class LeaseRegistry:
     def revoked(lease: Lease | None) -> bool:
         return lease is not None and lease.revoked
 
+    def stale(self, lease: Lease | None) -> bool:
+        """Is this lease no longer honorable? Locally revoked, or (shared
+        mode) at-or-below the scope's published fence floor — the check
+        that makes "a host fenced by replica A is never granted by
+        replica B" true: B's pool-pop and dispatch paths consult this
+        even though B never observed A's fence."""
+        if lease is None:
+            return False
+        if lease.revoked:
+            return True
+        if self._store is not None:
+            # Deliberately UNCACHED (unlike the breaker's 0.25s remote
+            # cache): this read is the only thing standing between a
+            # peer's fence and this replica granting the fenced host — a
+            # freshness window here would be a grant-a-wedged-host window.
+            # WAL readers never block on writers, so the cost is one
+            # ~tens-of-µs point read per dispatch/pool-candidate.
+            floor = self._store.get("lease_floor", lease.scope)
+            if isinstance(floor, (int, float)) and lease.generation <= floor:
+                # The floor survives re-admission on purpose: the scope's
+                # HARDWARE re-earned trust, but a pre-fence lease names a
+                # sandbox process that sat through the wedge — only
+                # post-fence generations serve.
+                return True
+        return False
+
     # ------------------------------------------------------------ recovering
 
     def recovering(self, scope: str) -> bool:
+        if self._store is not None:
+            # Shared mode: the store is authoritative. A local mirror
+            # whose shared record is gone means a PEER's probes completed
+            # the streak — drop the mirror so this replica's gates open
+            # too (its lanes re-evaluate on the next sweep kick).
+            if self._store.get("lease_fence", scope) is not None:
+                return True
+            self._recovering.pop(scope, None)
+            return False
         return scope in self._recovering
 
     def recovery_progress(self, scope: str) -> tuple[int, int]:
@@ -163,6 +247,79 @@ class LeaseRegistry:
         good behavior, not a lucky sample. Returns True exactly once, when
         the streak completes and the scope re-admits."""
         state = self._recovering.get(scope)
+        if self._store is not None:
+            # Shared mode: the store's record is AUTHORITATIVE, and the
+            # whole read-advance-write runs inside ONE store mutation —
+            # both replicas' probes advance a single streak over the same
+            # hardware, and a peer's concurrent relapse can never be lost
+            # to a get-then-write interleave (the scope must prove a
+            # CONSECUTIVE clean run, fleet-wide).
+            def step(current):
+                if current is None:
+                    return None, ("absent", None)
+                record = dict(current) if isinstance(current, dict) else {}
+                if not clean:
+                    record["streak"] = 0
+                    record["relapses"] = int(record.get("relapses", 0) or 0) + 1
+                    return record, ("relapse", record)
+                streak = int(record.get("streak", 0) or 0) + 1
+                need = int(record.get("need", self.readmit_streak) or 1)
+                if streak >= need:
+                    return None, ("readmit", record)
+                record["streak"] = streak
+                return record, ("advance", record)
+
+            verdict, record = self._store.mutate("lease_fence", scope, step)
+            if verdict == "absent":
+                if state is not None:
+                    # A peer's probe completed the streak: mirror the
+                    # re-admission here so this replica settles its lanes.
+                    del self._recovering[scope]
+                    self.readmissions_total += 1
+                    logger.info(
+                        "lease scope %s re-admitted (completed by a peer "
+                        "replica's probes)",
+                        scope,
+                    )
+                    return True
+                return False
+            # Mirror the post-step record locally (statusz/progress reads).
+            if state is None:
+                state = _ScopeRecovery(
+                    since=self.clock(),
+                    reason=str(record.get("reason", "") or ""),
+                )
+                self._recovering[scope] = state
+            state.need = int(record.get("need", self.readmit_streak) or 1)
+            state.relapses = int(record.get("relapses", 0) or 0)
+            if verdict == "relapse":
+                if state.streak:
+                    logger.info(
+                        "lease scope %s relapsed mid-recovery "
+                        "(streak was %d/%d)",
+                        scope,
+                        state.streak,
+                        state.need,
+                    )
+                state.streak = 0
+                return False
+            if verdict == "advance":
+                state.streak = int(record.get("streak", 0) or 0)
+                return False
+            # verdict == "readmit": the mutation already deleted the
+            # shared record — finish locally.
+            del self._recovering[scope]
+            self.readmissions_total += 1
+            logger.info(
+                "lease scope %s re-admitted after %d clean probes "
+                "(%.1fs in recovery, %d relapse(s))",
+                scope,
+                state.need,
+                max(0.0, self.clock() - state.since),
+                state.relapses,
+            )
+            return True
+        # Private-store path from here: today's single-process semantics.
         if state is None:
             return False
         if not clean:
@@ -197,19 +354,41 @@ class LeaseRegistry:
         """The /statusz recovery block's lease half: per-scope generations
         and any in-flight re-admission streaks."""
         now = self.clock()
+        recovering = {
+            scope: {
+                "streak": state.streak,
+                "need": state.need,
+                "relapses": state.relapses,
+                "for_s": round(max(0.0, now - state.since), 3),
+                "reason": state.reason,
+            }
+            for scope, state in sorted(self._recovering.items())
+        }
+        if self._store is not None:
+            # Peers' standing fences surface here too: an operator reading
+            # ANY replica's /statusz sees every scope the fleet is
+            # quarantining, not just the ones this process fenced.
+            wall = self.walltime()
+            for scope, record in sorted(self._store.items("lease_fence").items()):
+                if scope in recovering or not isinstance(record, dict):
+                    continue
+                since = record.get("since_wall")
+                recovering[scope] = {
+                    "streak": int(record.get("streak", 0) or 0),
+                    "need": int(record.get("need", self.readmit_streak) or 1),
+                    "relapses": int(record.get("relapses", 0) or 0),
+                    "for_s": round(
+                        max(0.0, wall - since)
+                        if isinstance(since, (int, float))
+                        else 0.0,
+                        3,
+                    ),
+                    "reason": str(record.get("reason", "") or ""),
+                }
         return {
             "readmit_streak": self.readmit_streak,
             "fences_total": self.fences_total,
             "readmissions_total": self.readmissions_total,
             "generations": dict(sorted(self._generations.items())),
-            "recovering": {
-                scope: {
-                    "streak": state.streak,
-                    "need": state.need,
-                    "relapses": state.relapses,
-                    "for_s": round(max(0.0, now - state.since), 3),
-                    "reason": state.reason,
-                }
-                for scope, state in sorted(self._recovering.items())
-            },
+            "recovering": recovering,
         }

@@ -49,6 +49,7 @@ import logging
 import math
 import os
 import time
+import uuid
 from dataclasses import dataclass, field
 
 from ..utils import tracing
@@ -227,7 +228,34 @@ class ProfileStore:
                 self._entries[str(profile_id)] = meta
 
     def _persist_index(self) -> None:
-        tmp = self.index_path + ".tmp"
+        # Multi-writer safety (two control-plane replicas sharing one
+        # store volume): a whole-file rewrite would last-writer-wins a
+        # concurrent peer's entries out of the index, stranding its zips
+        # as unlisted orphans. Merge the on-disk index first — rows we
+        # don't know, whose bytes exist, are a peer's live captures and
+        # are adopted (both into the write and into this process's view,
+        # so GET /profiles on any replica lists the fleet's captures).
+        # The object files themselves are content-addressed tmp+rename
+        # writes, so concurrent writers can never tear them.
+        try:
+            with open(self.index_path, encoding="utf-8") as f:
+                disk = json.load(f).get("entries")
+            if isinstance(disk, dict):
+                for profile_id, meta in disk.items():
+                    if (
+                        str(profile_id) not in self._entries
+                        and isinstance(meta, dict)
+                        and os.path.exists(self._object_path(str(profile_id)))
+                    ):
+                        self._entries[str(profile_id)] = meta
+        except (FileNotFoundError, json.JSONDecodeError, OSError):
+            pass
+        # UNIQUE tmp name per write: two processes sharing one tmp path
+        # could truncate each other mid-write and rename a torn file into
+        # place. A PID suffix is NOT unique across pods (containerized
+        # replicas on a shared volume are typically all PID 1) — use a
+        # random token.
+        tmp = f"{self.index_path}.{uuid.uuid4().hex[:12]}.tmp"
         try:
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump({"version": 1, "entries": self._entries}, f,

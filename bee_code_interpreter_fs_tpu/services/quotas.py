@@ -69,6 +69,8 @@ logger = logging.getLogger(__name__)
 # wire as x-quota-reason): membership is contract for dashboards and tests.
 DENIAL_REASONS = (
     "chip_seconds",
+    "hbm_byte_seconds",
+    "burst_credits",
     "predicted_overrun",
     "request_rate",
     "concurrency",
@@ -78,6 +80,9 @@ DENIAL_REASONS = (
 # Policy keys a file override may set (mirrors the APP_QUOTA_* knobs).
 _POLICY_KEYS = (
     "chip_seconds_per_window",
+    "hbm_byte_seconds_per_window",
+    "burst_credits",
+    "refill_per_second",
     "window_seconds",
     "requests_per_window",
     "max_concurrent",
@@ -100,6 +105,16 @@ class QuotaPolicy:
     nothing and behaves exactly as before this subsystem)."""
 
     chip_seconds_per_window: float = 0.0
+    # Device-memory budget over the same window: byte-seconds of peak HBM
+    # integrated over device-op wall (the ledger's hbm_byte_seconds
+    # counter, PR 14) — a memory hog is bounded like a compute hog.
+    hbm_byte_seconds_per_window: float = 0.0
+    # Burst-credit smoothing (opt-in, BOTH knobs > 0 to engage): a token
+    # bucket of chip-seconds beside the hard window — bursty tenants draw
+    # down credit and smooth out at refill_per_second instead of slamming
+    # into the window edge.
+    burst_credits: float = 0.0
+    refill_per_second: float = 0.0
     window_seconds: float = 3600.0
     requests_per_window: int = 0
     max_concurrent: int = 0
@@ -108,9 +123,14 @@ class QuotaPolicy:
     quarantine_max_seconds: float = 3600.0
     quarantine_decay_seconds: float = 300.0
 
+    def burst_mode(self) -> bool:
+        return self.burst_credits > 0 and self.refill_per_second > 0
+
     def enforces_anything(self) -> bool:
         return (
             self.chip_seconds_per_window > 0
+            or self.hbm_byte_seconds_per_window > 0
+            or self.burst_mode()
             or self.requests_per_window > 0
             or self.max_concurrent > 0
             or self.violations_per_window > 0
@@ -159,6 +179,7 @@ class QuotaVerdict:
     remaining_chip_seconds: float | None = None
     limit_chip_seconds: float | None = None
     window_seconds: float | None = None
+    burst_credits_remaining: float | None = None
     released: bool = False
 
     def phases_block(self) -> dict | None:
@@ -166,21 +187,34 @@ class QuotaVerdict:
         refreshes `remaining_chip_seconds` post-run, then calls this —
         one definition, so wire shape and admission shape cannot
         drift)."""
-        if self.limit_chip_seconds is None:
+        if self.limit_chip_seconds is None and self.burst_credits_remaining is None:
             return None
-        return {
-            "remaining_chip_seconds": round(
-                self.remaining_chip_seconds or 0.0, 6
-            ),
-            "limit_chip_seconds": round(self.limit_chip_seconds, 6),
-            "window_seconds": round(self.window_seconds or 0.0, 3),
-        }
+        block: dict = {}
+        if self.limit_chip_seconds is not None:
+            block = {
+                "remaining_chip_seconds": round(
+                    self.remaining_chip_seconds or 0.0, 6
+                ),
+                "limit_chip_seconds": round(self.limit_chip_seconds, 6),
+                "window_seconds": round(self.window_seconds or 0.0, 3),
+            }
+        if self.burst_credits_remaining is not None:
+            block["burst_credits_remaining"] = round(
+                max(0.0, self.burst_credits_remaining), 6
+            )
+        return block
 
 
 class _TenantWindow:
     """One ledger row's sliding-window state: a bounded ring of
-    (ts, chip_seconds_cum, violations_cum) samples, admission timestamps
-    for the rate cap, the in-flight count, and the offender ladder."""
+    (ts, chip_seconds_cum, violations_cum, hbm_byte_seconds_cum) samples,
+    admission timestamps for the rate cap, the in-flight count, the
+    burst-credit bucket, and the offender ladder."""
+
+    # Sample-tuple value indexes (budget_refill_at generalizes over them).
+    CHIP = 1
+    VIOLATIONS = 2
+    HBM = 3
 
     __slots__ = (
         "samples",
@@ -192,10 +226,13 @@ class _TenantWindow:
         "denials",
         "quarantines",
         "last_denial_log",
+        "burst_level",
+        "burst_refill_ts",
+        "burst_anchor",
     )
 
     def __init__(self) -> None:
-        self.samples: deque[tuple[float, float, float]] = deque()
+        self.samples: deque[tuple[float, float, float, float]] = deque()
         self.admits: deque[float] = deque()
         self.in_flight = 0
         # The exponential ladder: each quarantine episode raises the level
@@ -210,9 +247,20 @@ class _TenantWindow:
         self.denials = 0
         self.quarantines = 0
         self.last_denial_log = 0.0
+        # Burst-credit bucket (None until the burst policy first touches
+        # this tenant): current credit level, the last refill instant, and
+        # the cumulative chip-second value the bucket last drained to.
+        self.burst_level: float | None = None
+        self.burst_refill_ts = 0.0
+        self.burst_anchor = 0.0
 
     def observe(
-        self, now: float, chip_cum: float, violations_cum: float, window: float
+        self,
+        now: float,
+        chip_cum: float,
+        violations_cum: float,
+        window: float,
+        hbm_cum: float = 0.0,
     ) -> None:
         """Record a cumulative sample and prune the ring. The newest sample
         at-or-before the window start is KEPT — it is the baseline
@@ -226,20 +274,20 @@ class _TenantWindow:
             # value (conservative — consumption attributes as early as the
             # ring can place it, so a burst can never dodge the window by
             # landing between samples).
-            ts, _, _ = self.samples[-1]
-            self.samples[-1] = (ts, chip_cum, violations_cum)
+            ts = self.samples[-1][0]
+            self.samples[-1] = (ts, chip_cum, violations_cum, hbm_cum)
         else:
-            self.samples.append((now, chip_cum, violations_cum))
+            self.samples.append((now, chip_cum, violations_cum, hbm_cum))
         window_start = now - window
         while (
             len(self.samples) > 1 and self.samples[1][0] <= window_start
         ) or len(self.samples) > _RING_MAX:
             self.samples.popleft()
 
-    def _baseline(self, now: float, window: float) -> tuple[float, float]:
-        """Cumulative (chip, violations) at the window start: the newest
-        sample at-or-before it, else the oldest sample (the tenant's whole
-        recorded history is inside the window)."""
+    def _baseline(self, now: float, window: float) -> tuple[float, float, float]:
+        """Cumulative (chip, violations, hbm) at the window start: the
+        newest sample at-or-before it, else the oldest sample (the
+        tenant's whole recorded history is inside the window)."""
         window_start = now - window
         base = self.samples[0]
         for sample in self.samples:
@@ -247,36 +295,45 @@ class _TenantWindow:
                 base = sample
             else:
                 break
-        return base[1], base[2]
+        return base[self.CHIP], base[self.VIOLATIONS], base[self.HBM]
 
     def used_chip_seconds(self, now: float, window: float) -> float:
         if not self.samples:
             return 0.0
-        chip_base, _ = self._baseline(now, window)
-        return max(0.0, self.samples[-1][1] - chip_base)
+        chip_base, _, _ = self._baseline(now, window)
+        return max(0.0, self.samples[-1][self.CHIP] - chip_base)
+
+    def used_hbm_byte_seconds(self, now: float, window: float) -> float:
+        if not self.samples:
+            return 0.0
+        _, _, hbm_base = self._baseline(now, window)
+        return max(0.0, self.samples[-1][self.HBM] - hbm_base)
 
     def violations_in_window(self, now: float, window: float) -> float:
         if not self.samples:
             return 0.0
-        _, violation_base = self._baseline(now, window)
+        _, violation_base, _ = self._baseline(now, window)
         return max(
             0.0,
-            self.samples[-1][2] - max(violation_base, self.violation_floor),
+            self.samples[-1][self.VIOLATIONS]
+            - max(violation_base, self.violation_floor),
         )
 
     def budget_refill_at(
-        self, now: float, window: float, budget: float
+        self, now: float, window: float, budget: float, index: int = CHIP
     ) -> float:
-        """The earliest time used_chip_seconds can drop to the budget: the
-        first sample whose age-out leaves consumption <= budget. The
-        Retry-After contract: a client that waits this long is not
-        structurally denied again for the same window contents."""
+        """The earliest time the windowed consumption of sample value
+        `index` (chip-seconds by default, HBM byte-seconds for the memory
+        budget) can drop to the budget: the first sample whose age-out
+        leaves consumption <= budget. The Retry-After contract: a client
+        that waits this long is not structurally denied again for the
+        same window contents."""
         if not self.samples:
             return now
-        chip_now = self.samples[-1][1]
-        for ts, chip_cum, _ in self.samples:
-            if chip_now - chip_cum <= budget:
-                return ts + window
+        value_now = self.samples[-1][index]
+        for sample in self.samples:
+            if value_now - sample[index] <= budget:
+                return sample[0] + window
         # Even the newest sample's baseline leaves it over budget (one
         # giant burst): the whole burst must age out.
         return self.samples[-1][0] + window
@@ -324,6 +381,13 @@ class QuotaEnforcer:
         self.default_policy = QuotaPolicy(
             chip_seconds_per_window=max(
                 0.0, float(self.config.quota_chip_seconds_per_window)
+            ),
+            hbm_byte_seconds_per_window=max(
+                0.0, float(self.config.quota_hbm_byte_seconds)
+            ),
+            burst_credits=max(0.0, float(self.config.quota_burst_credits)),
+            refill_per_second=max(
+                0.0, float(self.config.quota_refill_per_second)
             ),
             window_seconds=max(1.0, float(self.config.quota_window_seconds)),
             requests_per_window=max(
@@ -512,9 +576,11 @@ class QuotaEnforcer:
                     if isinstance(violations, dict)
                     else 0.0
                 )
+                hbm = counters.get("hbm_byte_seconds")
                 win.observe(
                     ts, float(counters["chip_seconds"]), violations_total,
                     window,
+                    hbm_cum=float(hbm) if isinstance(hbm, (int, float)) else 0.0,
                 )
                 restored += 1
         if restored:
@@ -537,7 +603,12 @@ class QuotaEnforcer:
         journal = self.usage.journal_path if self.usage is not None else None
         if journal is None:
             return None
-        return os.path.join(os.path.dirname(journal), "quota_state.json")
+        # Per-replica shard like the journal itself (one writer per file):
+        # two replicas' enforcers rewriting one sidecar would last-writer-
+        # wins each other's offender ladders.
+        replica = getattr(self.usage, "replica_id", "") or ""
+        name = f"quota_state-{replica}.json" if replica else "quota_state.json"
+        return os.path.join(os.path.dirname(journal), name)
 
     def _save_offenders(self) -> None:
         """Persist the non-trivial ladder rows (atomic tmp+rename). Called
@@ -572,32 +643,53 @@ class QuotaEnforcer:
         path = self._offender_state_path
         if path is None:
             return
-        try:
-            with open(path, encoding="utf-8") as f:
-                body = json.load(f)
-        except FileNotFoundError:
-            return
-        except (json.JSONDecodeError, OSError):
-            logger.warning("quota offender state unreadable", exc_info=True)
-            return
-        tenants = body.get("tenants", {})
-        if not isinstance(tenants, dict):
-            return
+        paths = [path]
+        # Turning replication ON must not truncate standing sentences:
+        # the ledger's designated legacy inheritor also restores the
+        # pre-replication quota_state.json (max-merged under its own
+        # shard — the sterner record wins), exactly like the journal.
+        if (
+            getattr(self.usage, "replica_id", "")
+            and getattr(self.usage, "_inherit_legacy", False)
+        ):
+            legacy = os.path.join(
+                os.path.dirname(path), "quota_state.json"
+            )
+            if legacy != path:
+                paths.insert(0, legacy)
         restored = 0
-        for label, row in tenants.items():
-            if not isinstance(row, dict):
+        for source in paths:
+            try:
+                with open(source, encoding="utf-8") as f:
+                    body = json.load(f)
+            except FileNotFoundError:
                 continue
-            win = self._window(str(label))
-            level = row.get("offender_level")
-            until = row.get("quarantined_until")
-            floor = row.get("violation_floor")
-            if isinstance(level, int) and level >= 0:
-                win.offender_level = level
-            if isinstance(until, (int, float)):
-                win.quarantined_until = float(until)
-            if isinstance(floor, (int, float)):
-                win.violation_floor = float(floor)
-            restored += 1
+            except (json.JSONDecodeError, OSError):
+                logger.warning(
+                    "quota offender state unreadable", exc_info=True
+                )
+                continue
+            tenants = body.get("tenants", {})
+            if not isinstance(tenants, dict):
+                continue
+            for label, row in tenants.items():
+                if not isinstance(row, dict):
+                    continue
+                win = self._window(str(label))
+                level = row.get("offender_level")
+                until = row.get("quarantined_until")
+                floor = row.get("violation_floor")
+                if isinstance(level, int) and level >= 0:
+                    win.offender_level = max(win.offender_level, level)
+                if isinstance(until, (int, float)):
+                    win.quarantined_until = max(
+                        win.quarantined_until, float(until)
+                    )
+                if isinstance(floor, (int, float)):
+                    win.violation_floor = max(
+                        win.violation_floor, float(floor)
+                    )
+                restored += 1
         if restored:
             logger.info(
                 "quota offender ladder restored (%d tenant(s))", restored
@@ -623,10 +715,11 @@ class QuotaEnforcer:
         """Sample the ledger row's cumulative counters into the ring."""
         _, row = self.usage.peek(label)
         chip = row.chip_seconds if row is not None else 0.0
+        hbm = row.hbm_byte_seconds if row is not None else 0.0
         violations = (
             sum(row.violations.values()) if row is not None else 0.0
         )
-        win.observe(now, chip, violations, window)
+        win.observe(now, chip, violations, window, hbm_cum=hbm)
 
     def _deny(
         self,
@@ -638,6 +731,7 @@ class QuotaEnforcer:
         retry_after: float,
         detail: str,
         remaining: float | None = None,
+        **error_fields,
     ) -> QuotaExceededError:
         win.denials += 1
         self.denials_total += 1
@@ -680,6 +774,7 @@ class QuotaEnforcer:
             remaining_chip_seconds=remaining,
             limit_chip_seconds=budget,
             window_seconds=policy.window_seconds,
+            **error_fields,
         )
 
     def admit(
@@ -846,6 +941,82 @@ class QuotaEnforcer:
                     remaining=remaining,
                 )
 
+        # 3c) Burst-credit smoothing (opt-in token bucket beside the hard
+        # window): the bucket refills continuously at refill_per_second up
+        # to burst_credits, and drains by the chip-seconds the ledger has
+        # observed since the last admit. An overdrawn bucket denies with a
+        # deficit-derived Retry-After — a bursty tenant smooths to the
+        # refill rate instead of burning its whole window budget at once
+        # and slamming into the window edge for the rest of the hour.
+        burst_remaining: float | None = None
+        if policy.burst_mode():
+            chip_now = win.samples[-1][win.CHIP] if win.samples else 0.0
+            if win.burst_level is None:
+                # First touch: a full bucket anchored at the tenant's
+                # current cumulative consumption (history predating the
+                # bucket is the window budget's business, not the bucket's).
+                win.burst_level = policy.burst_credits
+                win.burst_refill_ts = now
+                win.burst_anchor = chip_now
+            win.burst_level = min(
+                policy.burst_credits,
+                win.burst_level
+                + max(0.0, now - win.burst_refill_ts)
+                * policy.refill_per_second,
+            )
+            win.burst_refill_ts = now
+            drained = max(0.0, chip_now - win.burst_anchor)
+            win.burst_anchor = chip_now
+            win.burst_level -= drained
+            burst_remaining = max(0.0, win.burst_level)
+            if win.burst_level <= 0:
+                deficit = -win.burst_level
+                raise self._deny(
+                    label,
+                    policy,
+                    win,
+                    reason="burst_credits",
+                    retry_after=max(
+                        1.0, deficit / policy.refill_per_second
+                    ),
+                    detail=(
+                        f"overdrew its burst credits "
+                        f"({deficit:.3f} chip-seconds over; bucket "
+                        f"{policy.burst_credits:.3f}, refill "
+                        f"{policy.refill_per_second:.3f}/s)"
+                    ),
+                    remaining=remaining,
+                    burst_credits_remaining=0.0,
+                )
+
+        # 3d) Device-memory budget over the sliding window: HBM
+        # byte-seconds (peak footprint x device-op wall, the PR 14 ledger
+        # counter) — the same refill-point Retry-After semantics as
+        # chip-seconds, so a memory hog backs off exactly as long as it
+        # takes for its own footprint to age out.
+        if policy.hbm_byte_seconds_per_window > 0:
+            used_hbm = win.used_hbm_byte_seconds(now, window)
+            hbm_budget = policy.hbm_byte_seconds_per_window
+            if used_hbm >= hbm_budget:
+                refill_at = win.budget_refill_at(
+                    now, window, hbm_budget, index=win.HBM
+                )
+                raise self._deny(
+                    label,
+                    policy,
+                    win,
+                    reason="hbm_byte_seconds",
+                    retry_after=max(1.0, refill_at - now),
+                    detail=(
+                        f"exhausted its device-memory budget "
+                        f"({used_hbm:.0f} HBM byte-seconds used of "
+                        f"{hbm_budget:.0f} per {window:.0f}s window)"
+                    ),
+                    remaining=remaining,
+                    remaining_hbm_byte_seconds=0.0,
+                    limit_hbm_byte_seconds=hbm_budget,
+                )
+
         # 4) Request rate over the window.
         if policy.requests_per_window > 0:
             win.prune_admits(now, window)
@@ -891,8 +1062,11 @@ class QuotaEnforcer:
                 remaining_chip_seconds=remaining,
                 limit_chip_seconds=policy.chip_seconds_per_window,
                 window_seconds=window,
+                burst_credits_remaining=burst_remaining,
             )
-        return QuotaVerdict(tenant=label)
+        return QuotaVerdict(
+            tenant=label, burst_credits_remaining=burst_remaining
+        )
 
     def release(self, verdict: QuotaVerdict | None) -> None:
         """Give the concurrency slot back (idempotent — every exit path of
@@ -933,6 +1107,9 @@ class QuotaEnforcer:
     def _policy_dict(self, policy: QuotaPolicy) -> dict:
         return {
             "chip_seconds_per_window": policy.chip_seconds_per_window,
+            "hbm_byte_seconds_per_window": policy.hbm_byte_seconds_per_window,
+            "burst_credits": policy.burst_credits,
+            "refill_per_second": policy.refill_per_second,
             "window_seconds": policy.window_seconds,
             "requests_per_window": policy.requests_per_window,
             "max_concurrent": policy.max_concurrent,
@@ -979,6 +1156,16 @@ class QuotaEnforcer:
         if policy.chip_seconds_per_window > 0:
             body["remaining_chip_seconds"] = round(
                 max(0.0, policy.chip_seconds_per_window - used), 6
+            )
+        if policy.hbm_byte_seconds_per_window > 0:
+            used_hbm = win.used_hbm_byte_seconds(now, window)
+            body["used_hbm_byte_seconds_window"] = round(used_hbm, 3)
+            body["remaining_hbm_byte_seconds"] = round(
+                max(0.0, policy.hbm_byte_seconds_per_window - used_hbm), 3
+            )
+        if policy.burst_mode() and win.burst_level is not None:
+            body["burst_credits_remaining"] = round(
+                max(0.0, win.burst_level), 6
             )
         return body
 

@@ -1,0 +1,453 @@
+"""Consistent-hash session→replica affinity for the scale-out control plane.
+
+With N stateless replicas behind one Service, stateless requests may land
+anywhere — but a SESSION (``executor_id``) parks a live sandbox on the
+replica that created it, and in-flight grants belong to one scheduler. The
+edge therefore hashes ``(tenant, executor_id)`` onto a consistent-hash ring
+over the replica set: the owner serves locally; every other replica either
+transparently proxies the request to the owner or answers a 307 redirect
+carrying ``X-Replica-Owner`` (``APP_REPLICA_PROXY=0``), so session-parked
+sandboxes and their grants stay single-owner while stateless traffic
+load-balances freely.
+
+Membership: the static peer list (``APP_REPLICA_PEERS``, e.g. the pod names
+a k8s headless Service resolves) intersected with LIVENESS — each replica
+heartbeats into the shared state store, and a peer whose heartbeat goes
+stale past the TTL drops off the ring, so its sessions REHASH onto the
+survivors (the failover story: a killed replica's sessions are served by
+whoever now owns their hash, after lease-fenced turnover of the dead
+owner's hosts). A proxy-level connection failure marks the peer dead
+immediately (a crashed process stops answering before its heartbeat
+expires) for one TTL.
+
+Consistent hashing (vnodes on a sha256 ring) keeps the reshuffle minimal:
+a replica joining or leaving moves ~1/N of the session keys, not all of
+them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import time
+from bisect import bisect_right
+from collections.abc import Callable
+
+logger = logging.getLogger(__name__)
+
+_VNODES = 64
+
+
+def _hash(value: str) -> int:
+    return int.from_bytes(
+        hashlib.sha256(value.encode("utf-8")).digest()[:8], "big"
+    )
+
+
+def parse_peers(spec: str) -> dict[str, str]:
+    """``APP_REPLICA_PEERS`` grammar: comma-separated peers, each either
+    ``id=http://host:port`` or ``host:port`` (the id then defaults to the
+    host:port string). Returns {replica_id: base_url}."""
+    peers: dict[str, str] = {}
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "=" in entry:
+            rid, _, addr = entry.partition("=")
+            rid = rid.strip()
+            addr = addr.strip()
+        else:
+            rid, addr = entry, entry
+        if not addr.startswith(("http://", "https://")):
+            addr = f"http://{addr}"
+        peers[rid] = addr.rstrip("/")
+    return peers
+
+
+class ReplicaRing:
+    """The hash ring over live replicas.
+
+    ``self_id`` must be one of the peers (or the ring degrades to
+    single-replica mode: everything is owned locally). Liveness comes from
+    the shared store's heartbeat table when one is wired; without a shared
+    store the static peer list IS the membership (the in-process test
+    harness drives liveness by hand)."""
+
+    def __init__(
+        self,
+        self_id: str,
+        peers: dict[str, str],
+        *,
+        store=None,
+        heartbeat_ttl: float = 10.0,
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        self.self_id = self_id
+        self.peers = dict(peers)
+        if self_id and self_id not in self.peers:
+            self.peers[self_id] = ""
+        self.store = store if store is not None and store.shared else None
+        self.heartbeat_ttl = max(1.0, heartbeat_ttl)
+        self.clock = clock
+        # Peers a proxy attempt found dead before their heartbeat expired:
+        # rid -> (suspected_at, until). Excluded until `until` passes OR a
+        # heartbeat NEWER than the suspicion lands (the peer is provably
+        # back — one transient connection failure must not split session
+        # ownership for a whole TTL).
+        self._suspects: dict[str, tuple[float, float]] = {}
+        self._forward_token: str = ""
+        self._ring_cache: tuple[tuple[str, ...], list[int], list[str]] | None = None
+
+    # ------------------------------------------------------------- liveness
+
+    def heartbeat(self) -> None:
+        """Publish this replica's liveness (and its address, so peers can
+        proxy to it without static config)."""
+        if self.store is None or not self.self_id:
+            return
+        self.store.put(
+            "replicas",
+            self.self_id,
+            {"ts": self.clock(), "url": self.peers.get(self.self_id, "")},
+        )
+
+    def live_ids(self) -> list[str]:
+        """The replica ids currently on the ring: every configured peer
+        whose heartbeat is fresh (shared store) minus proxy-suspected
+        peers. Self is always a member — a replica that cannot see the
+        store must keep serving what it owns. Falls back to the full
+        static list when no shared store is wired."""
+        now = self.clock()
+        suspected = {
+            rid: since
+            for rid, (since, until) in self._suspects.items()
+            if until > now
+        }
+        if self.store is None:
+            ids = [rid for rid in self.peers if rid not in suspected]
+        else:
+            beats = self.store.items("replicas")
+            ids = []
+            for rid in self.peers:
+                if rid == self.self_id:
+                    ids.append(rid)
+                    continue
+                beat = beats.get(rid)
+                ts = beat.get("ts") if isinstance(beat, dict) else None
+                fresh = (
+                    isinstance(ts, (int, float))
+                    and now - ts <= self.heartbeat_ttl
+                )
+                since = suspected.get(rid)
+                if since is not None:
+                    # A heartbeat NEWER than the suspicion proves the peer
+                    # back: clear it. Otherwise stay excluded.
+                    if fresh and ts > since:
+                        self._suspects.pop(rid, None)
+                    else:
+                        continue
+                if fresh:
+                    ids.append(rid)
+        if self.self_id and self.self_id not in ids:
+            ids.append(self.self_id)
+        return sorted(ids)
+
+    def mark_dead(self, replica_id: str) -> None:
+        """A proxy attempt could not reach the peer: drop it from the ring
+        for one TTL so its keys rehash NOW instead of after the heartbeat
+        ages out."""
+        if replica_id == self.self_id:
+            return
+        now = self.clock()
+        self._suspects[replica_id] = (now, now + self.heartbeat_ttl)
+        logger.warning(
+            "replica %s unreachable; excluding it from the ring for %.0fs "
+            "(its sessions rehash to the survivors)",
+            replica_id,
+            self.heartbeat_ttl,
+        )
+
+    def forward_token(self) -> str:
+        """The fleet's forwarding secret: minted once into the shared
+        store (create-if-absent under the store's lock), readable only by
+        replicas. Stamped on proxied requests so the receiving edge can
+        tell a PEER's forward (honor the loop guard) from a client
+        spoofing the header (ignore it — otherwise any tenant could
+        bypass session affinity and split a session across replicas).
+        Without a shared store there is no secret channel; returns "" and
+        the guard falls back to refusing client-supplied values outright.
+        """
+        if self.store is None:
+            return ""
+        token = self._forward_token
+        if token:
+            return token
+
+        def mint(current):
+            if isinstance(current, str) and current:
+                return current, current
+            import secrets
+
+            fresh = secrets.token_hex(16)
+            return fresh, fresh
+
+        token = self.store.mutate("replicas", "_forward_token", mint)
+        self._forward_token = token
+        return token
+
+    def url_of(self, replica_id: str) -> str:
+        url = self.peers.get(replica_id, "")
+        if not url and self.store is not None:
+            beat = self.store.get("replicas", replica_id)
+            if isinstance(beat, dict) and isinstance(beat.get("url"), str):
+                url = beat["url"]
+        return url
+
+    # ----------------------------------------------------------------- ring
+
+    def _ring(self) -> tuple[list[int], list[str]]:
+        members = tuple(self.live_ids())
+        cached = self._ring_cache
+        if cached is not None and cached[0] == members:
+            return cached[1], cached[2]
+        points: list[tuple[int, str]] = []
+        for rid in members:
+            for i in range(_VNODES):
+                points.append((_hash(f"{rid}#{i}"), rid))
+        points.sort()
+        hashes = [p[0] for p in points]
+        owners = [p[1] for p in points]
+        self._ring_cache = (members, hashes, owners)
+        return hashes, owners
+
+    def owner(self, key: str) -> str:
+        """The replica id owning ``key`` — the first vnode clockwise from
+        the key's hash. Single-member (or empty) rings own everything
+        locally."""
+        hashes, owners = self._ring()
+        if not hashes:
+            return self.self_id
+        index = bisect_right(hashes, _hash(key)) % len(hashes)
+        return owners[index]
+
+
+class SessionRouter:
+    """The edge-side half: decide own-vs-forward for session requests and
+    carry out the forwarding (transparent HTTP proxy, or the 307 redirect
+    contract when proxying is disabled)."""
+
+    def __init__(
+        self,
+        ring: ReplicaRing,
+        *,
+        default_tenant: str = "shared",
+        proxy: bool = True,
+        proxy_timeout: float = 330.0,
+    ) -> None:
+        self.ring = ring
+        self.default_tenant = default_tenant
+        self.proxy_enabled = proxy
+        self.proxy_timeout = proxy_timeout
+        self._client = None
+        self._task: asyncio.Task | None = None
+        self.proxied_total = 0
+        self.redirected_total = 0
+
+    def route_key(self, tenant: str | None, executor_id: str) -> str:
+        return f"{tenant or self.default_tenant}/{executor_id}"
+
+    def owner_of(self, tenant: str | None, executor_id: str) -> str:
+        return self.ring.owner(self.route_key(tenant, executor_id))
+
+    def peer_forwarded(self, header_value: str | None) -> bool:
+        """Did a PEER replica forward this request (vs a client spoofing
+        the header)? Only a value carrying the fleet's shared-store
+        secret counts; anything else — including a bare replica id — is
+        treated as client noise and the affinity check runs normally."""
+        if not header_value:
+            return False
+        token = self.ring.forward_token()
+        if not token:
+            return False
+        _, _, offered = header_value.partition(":")
+        return bool(offered) and offered == token
+
+    def owns(self, tenant: str | None, executor_id: str | None) -> bool:
+        """True when this replica should serve the request locally:
+        stateless requests always; session requests when the hash ring
+        says so (or when no ring peer set is configured at all)."""
+        if not executor_id or len(self.ring.peers) <= 1:
+            return True
+        return self.owner_of(tenant, executor_id) == self.ring.self_id
+
+    # ---------------------------------------------------------- HTTP proxy
+
+    def _http_client(self):
+        import httpx
+
+        if self._client is None or self._client.is_closed:
+            self._client = httpx.AsyncClient(
+                timeout=httpx.Timeout(self.proxy_timeout)
+            )
+        return self._client
+
+    async def forward(self, request, owner: str):
+        """Proxy an aiohttp request to the owner replica (or answer the
+        307 redirect when proxying is off). On a connection failure the
+        owner is marked dead, the key rehashes, and — when it now lands
+        here — the caller serves locally (returns None)."""
+        from aiohttp import web
+
+        url = self.ring.url_of(owner)
+        if not url:
+            # No address for the owner (e.g. membership raced a restart):
+            # serve locally rather than fail the request.
+            return None
+        target = f"{url}{request.path_qs}"
+        if not self.proxy_enabled:
+            self.redirected_total += 1
+            return web.Response(
+                status=307,
+                headers={
+                    "Location": target,
+                    "X-Replica-Owner": owner,
+                },
+            )
+        import httpx
+
+        body = await request.read()
+        headers = {
+            k: v
+            for k, v in request.headers.items()
+            if k.lower() not in ("host", "content-length", "transfer-encoding")
+        }
+        token = self.ring.forward_token()
+        headers["X-Replica-Forwarded-By"] = (
+            f"{self.ring.self_id}:{token}" if token else self.ring.self_id
+        )
+        try:
+            client = self._http_client()
+            upstream = await client.request(
+                request.method, target, content=body, headers=headers
+            )
+        except (httpx.ConnectError, httpx.ConnectTimeout):
+            # The owner is GONE (nothing listening): drop it from the
+            # ring so the key rehashes immediately, and hand control back
+            # to the caller — it re-evaluates ownership against the
+            # shrunken ring (usually: this replica now owns the key and
+            # serves it locally).
+            self.ring.mark_dead(owner)
+            logger.warning(
+                "proxy to replica %s failed to connect; ring now %s",
+                owner,
+                self.ring.live_ids(),
+            )
+            return None
+        except httpx.HTTPError as e:
+            # The owner is ALIVE but slow (read timeout mid-request) or
+            # the wire broke mid-stream: it may still be RUNNING the
+            # request, so neither mark it dead (its live sessions would
+            # rehash and split) nor serve locally (the tenant's code
+            # would execute twice). Surface the failure; the client
+            # retries against a still-owned session.
+            logger.warning("proxy to replica %s failed mid-request: %s", owner, e)
+            return web.json_response(
+                {
+                    "error": f"session owner replica {owner!r} did not "
+                    f"answer the proxied request ({type(e).__name__}); "
+                    "retry",
+                },
+                status=504,
+                headers={"X-Replica-Owner": owner, "Retry-After": "2"},
+            )
+        self.proxied_total += 1
+        passthrough = {
+            k: v
+            for k, v in upstream.headers.items()
+            if k.lower()
+            not in ("content-length", "transfer-encoding", "connection")
+        }
+        passthrough["X-Replica-Owner"] = owner
+        return web.Response(
+            status=upstream.status_code,
+            body=upstream.content,
+            headers=passthrough,
+        )
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self, interval: float = 2.0) -> asyncio.Task | None:
+        """Heartbeat loop (shared-store mode only): publish liveness every
+        ``interval`` seconds so peers keep this replica on their rings."""
+        if self.ring.store is None or self._task is not None:
+            return self._task
+        self.ring.heartbeat()  # first beat before anyone asks
+
+        async def loop() -> None:
+            while True:
+                await asyncio.sleep(interval)
+                try:
+                    self.ring.heartbeat()
+                except Exception:  # noqa: BLE001 — liveness must not die
+                    logger.exception("replica heartbeat failed")
+
+        self._task = asyncio.get_running_loop().create_task(loop())
+        return self._task
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if self._client is not None and not self._client.is_closed:
+            await self._client.aclose()
+
+    def snapshot(self) -> dict:
+        """The /statusz replicas block."""
+        return {
+            "self": self.ring.self_id,
+            "peers": sorted(self.ring.peers),
+            "live": self.ring.live_ids(),
+            "proxy": self.proxy_enabled,
+            "proxied_total": self.proxied_total,
+            "redirected_total": self.redirected_total,
+        }
+
+
+def make_session_router(config, store=None) -> SessionRouter | None:
+    """Build the router from config, or None when no replica set is
+    configured (single-replica mode: zero new code on any path)."""
+    peers = parse_peers(getattr(config, "replica_peers", "") or "")
+    if not peers:
+        return None
+    self_id = getattr(config, "replica_self", "") or ""
+    if not self_id:
+        import os
+        import socket
+
+        self_id = os.environ.get("POD_NAME") or socket.gethostname()
+    if self_id not in peers:
+        # Identify self by matching the listen port against a peer addr
+        # would be guesswork; be explicit instead.
+        logger.warning(
+            "APP_REPLICA_SELF=%r is not in APP_REPLICA_PEERS; this replica "
+            "will own only keys that hash to it by name",
+            self_id,
+        )
+    ring = ReplicaRing(
+        self_id,
+        peers,
+        store=store,
+        heartbeat_ttl=getattr(config, "replica_heartbeat_ttl", 10.0),
+    )
+    return SessionRouter(
+        ring,
+        default_tenant=getattr(config, "scheduler_default_tenant", "shared")
+        or "shared",
+        proxy=bool(getattr(config, "replica_proxy", True)),
+    )

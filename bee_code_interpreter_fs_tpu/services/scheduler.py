@@ -166,10 +166,21 @@ class SandboxScheduler:
         *,
         clock: Callable[[], float] = time.monotonic,
         metrics=None,
+        store=None,
     ) -> None:
         self.config = config or Config()
         self.clock = clock
         self.metrics = metrics
+        # Shared-state seam (services/state_store.py): with a SHARED store
+        # wired, WFQ start/finish tags draw from one fleet-wide per-lane
+        # tag table (ns="wfq") instead of this process's private one, so
+        # interleaved requests from one tenant keep a single fair order
+        # across N replicas — replica B's next request continues the flow
+        # where replica A's left it, and a heavy tenant's fair share is
+        # fleet-global, not per-replica. Grant ordering itself stays local
+        # (each replica grants only its own sandboxes). A private store
+        # (the default) leaves every path byte-for-byte as before.
+        self._store = store if store is not None and store.shared else None
         # Per-tenant usage ledger (services/usage.py), bound by the
         # executor after construction: queue wait is attributed HERE, at
         # grant time, because only the scheduler knows both the tenant and
@@ -396,9 +407,17 @@ class SandboxScheduler:
                 )
         weight = max(float(self.weights.get(tenant, 1.0)), 1e-3)
         key = (tenant, priority)
-        start = max(state.vtime, state.last_finish.get(key, 0.0))
-        finish = start + 1.0 / weight
-        state.last_finish[key] = finish
+        if self._store is not None:
+            start, finish = self._shared_tags(lane, tenant, priority, weight)
+            # Mirror into the local table too: local grant ordering and
+            # the shared table must agree about this flow's last tag.
+            state.last_finish[key] = max(
+                finish, state.last_finish.get(key, 0.0)
+            )
+        else:
+            start = max(state.vtime, state.last_finish.get(key, 0.0))
+            finish = start + 1.0 / weight
+            state.last_finish[key] = finish
         ticket = Ticket(
             lane=lane,
             tenant=tenant,
@@ -453,6 +472,118 @@ class SandboxScheduler:
                 reason=reason,
             )
 
+    # ------------------------------------------------------- shared WFQ tags
+
+    def _shared_tags(
+        self, lane: int, tenant: str, priority: str, weight: float
+    ) -> tuple[float, float]:
+        """Assign this flow's next (start, finish) tag pair from the
+        fleet-wide per-lane tag table, atomically (the whole read-modify-
+        write holds the store's lock — two replicas can never hand one
+        flow the same tag). Flow entries idle longer than ten minutes
+        prune inside the same mutation, so the shared table's size is
+        bounded by the busy set, not by every tenant ever seen."""
+        flow = f"{tenant}/{priority}"
+        wall = time.time()
+
+        def assign(current):
+            table = current if isinstance(current, dict) else {}
+            # Staleness backstop: a replica that CRASHED holding tickets
+            # leaks its share of `active` forever (its _finish never
+            # runs), which would pin the busy-period reset unreachable.
+            # A record untouched for 10 minutes can only be such a leak —
+            # no live ticket waits that long without submits/finishes
+            # touching the table — so the next submit starts fresh.
+            touched = table.get("touched")
+            if (
+                current is not None
+                and isinstance(touched, (int, float))
+                and wall - touched > 600.0
+            ):
+                table = {}
+            flows = table.get("flows")
+            if not isinstance(flows, dict):
+                flows = {}
+            vtime = table.get("vtime")
+            vtime = float(vtime) if isinstance(vtime, (int, float)) else 0.0
+            active = table.get("active")
+            active = int(active) if isinstance(active, (int, float)) else 0
+            entry = flows.get(flow)
+            last_tag = (
+                float(entry[0])
+                if isinstance(entry, list) and entry
+                and isinstance(entry[0], (int, float))
+                else 0.0
+            )
+            start = max(vtime, last_tag)
+            finish = start + 1.0 / weight
+            flows[flow] = [finish, wall]
+            stale = [
+                name
+                for name, row in flows.items()
+                if name != flow
+                and (
+                    not isinstance(row, list)
+                    or len(row) < 2
+                    or not isinstance(row[1], (int, float))
+                    or wall - row[1] > 600.0
+                )
+            ]
+            for name in stale:
+                del flows[name]
+            return (
+                {
+                    "vtime": vtime,
+                    "flows": flows,
+                    "active": active + 1,
+                    "touched": wall,
+                },
+                (start, finish),
+            )
+
+        return self._store.mutate("wfq", str(lane), assign)
+
+    def _shared_ticket_done(self, lane: int) -> None:
+        """One shared-mode ticket left the lane's queue (completed or
+        abandoned, on any replica): decrement the fleet-wide active count,
+        and when it reaches zero reset the lane's tag table — the SAME
+        busy-period reset the private path performs when its local queue
+        empties, so the shared table can neither accumulate one entry per
+        tenant ever seen nor diverge from single-process tag sequences."""
+
+        def finish_one(current):
+            table = dict(current) if isinstance(current, dict) else {}
+            active = table.get("active")
+            active = int(active) if isinstance(active, (int, float)) else 0
+            if active <= 1:
+                return None, None  # fleet-wide busy period over: reset
+            table["active"] = active - 1
+            table["touched"] = time.time()
+            return table, None
+
+        self._store.mutate("wfq", str(lane), finish_one)
+
+    def _push_shared_vtime(self, lane: int, start_tag: float) -> None:
+        """Advance the fleet-wide virtual clock to a granted ticket's
+        start tag (the other half of start-time fair queueing: an idle
+        flow's first tag anchors at the CURRENT virtual time, fleet-wide,
+        so it is never penalized for service it didn't use)."""
+
+        def push(current):
+            table = dict(current) if isinstance(current, dict) else {}
+            vtime = table.get("vtime")
+            vtime = float(vtime) if isinstance(vtime, (int, float)) else 0.0
+            if start_tag <= vtime:
+                return current, None
+            # Update vtime IN PLACE: the record also carries the flow tags
+            # and the fleet-wide active-ticket count — rebuilding it here
+            # would zero `active` and let the next completion reset the
+            # tag table mid-busy-period.
+            table["vtime"] = start_tag
+            return table, None
+
+        self._store.mutate("wfq", str(lane), push)
+
     # ---------------------------------------------------------------- grants
 
     def _select(self, state: _LaneState) -> Ticket | None:
@@ -477,6 +608,8 @@ class SandboxScheduler:
         ticket.granted = True
         ticket.event.set()
         state.vtime = max(state.vtime, ticket.start_tag)
+        if self._store is not None:
+            self._push_shared_vtime(ticket.lane, ticket.start_tag)
         return True
 
     def kick(self, lane: int) -> None:
@@ -545,6 +678,8 @@ class SandboxScheduler:
         if ticket.done:
             return
         ticket.done = True
+        if self._store is not None:
+            self._shared_ticket_done(ticket.lane)
         state = self._lane(ticket.lane)
         try:
             state.tickets.remove(ticket)

@@ -1,0 +1,301 @@
+"""Pluggable shared control-plane state: the seam that lets N stateless
+replicas cooperate behind one Service.
+
+Every hot path below the control plane already scales (delta transfer,
+compile cache, fused batch lanes, demand-adaptive pools) — the remaining
+throughput ceiling is the control plane being ONE asyncio process, because
+four kinds of state pin it there: scheduler WFQ tags, circuit-breaker
+verdicts, lease generations/fence floors, and host/occupancy bookkeeping.
+This module extracts that state behind one tiny interface with two
+implementations:
+
+- ``InMemoryStateStore`` — plain dicts under a lock. The default. With a
+  private (non-shared) instance the components skip every cross-replica
+  path, so a single replica with ``APP_STATE_STORE`` unset runs today's
+  behavior byte-for-byte. A single instance can also be handed to several
+  in-process control planes (``shared=True``) — the deterministic harness
+  the replica e2e tests and the bench run on.
+- ``SQLiteStateStore`` — a file-backed store (stdlib ``sqlite3``, WAL mode)
+  whose writes ride ``BEGIN IMMEDIATE`` transactions: advisory locking and
+  compare-and-swap across PROCESSES with zero external service
+  dependencies. N replicas point ``APP_STATE_STORE`` at one path on a
+  shared volume and cooperate instead of double-granting lanes or
+  double-fencing hosts. SINGLE-NODE by construction: WAL coordinates
+  readers/writers through a shared-memory file, which does not work
+  across hosts on network filesystems — replicas sharing this store must
+  share a node (k8s/replicas.yaml pins them with podAffinity); a
+  multi-node control plane needs a network-store adapter behind this
+  same interface.
+
+The interface is deliberately small — namespaced get/put/delete/items plus
+two atomic primitives (``incr`` for monotonic generations, ``mutate`` for
+read-modify-write like WFQ tag assignment) — so a Redis/etcd impl later is
+a ~100-line adapter, not a redesign.
+
+Values are JSON-serializable objects. Keys and namespaces are strings.
+All operations are synchronous and fast (dict ops, or single-row SQLite
+statements measured in tens of microseconds); they are called from the
+event loop exactly like the scheduler state they replace.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sqlite3
+import threading
+import time
+from collections.abc import Callable
+
+logger = logging.getLogger(__name__)
+
+
+class StateStore:
+    """Abstract namespaced KV with atomic increment and read-modify-write.
+
+    ``shared`` is the wiring contract: components consult the store on
+    their cross-replica paths ONLY when it is True. A private in-memory
+    store (the default) leaves every hot path exactly as it was before
+    this interface existed.
+    """
+
+    shared: bool = False
+
+    def get(self, ns: str, key: str):
+        raise NotImplementedError
+
+    def put(self, ns: str, key: str, value) -> None:
+        raise NotImplementedError
+
+    def delete(self, ns: str, key: str) -> None:
+        raise NotImplementedError
+
+    def items(self, ns: str) -> dict:
+        raise NotImplementedError
+
+    def incr(self, ns: str, key: str, delta: float = 1.0) -> float:
+        raise NotImplementedError
+
+    def mutate(self, ns: str, key: str, fn: Callable):
+        """Atomically apply ``fn(current_value_or_None)`` which returns
+        ``(new_value, result)``; the new value is stored (or the key
+        deleted when new_value is None) and ``result`` returned. The
+        whole read-modify-write holds the store's write lock — two
+        replicas can never interleave inside it."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryStateStore(StateStore):
+    """Dict-backed store. Private by default (``shared=False``): a single
+    replica's components then bypass every cross-replica code path. Pass
+    ``shared=True`` when one instance is deliberately handed to several
+    in-process control planes (tests, the replica bench)."""
+
+    def __init__(self, *, shared: bool = False) -> None:
+        self.shared = shared
+        self._data: dict[str, dict[str, object]] = {}
+        self._lock = threading.RLock()
+
+    def _ns(self, ns: str) -> dict:
+        return self._data.setdefault(ns, {})
+
+    def get(self, ns: str, key: str):
+        with self._lock:
+            return self._ns(ns).get(key)
+
+    def put(self, ns: str, key: str, value) -> None:
+        with self._lock:
+            self._ns(ns)[key] = value
+
+    def delete(self, ns: str, key: str) -> None:
+        with self._lock:
+            self._ns(ns).pop(key, None)
+
+    def items(self, ns: str) -> dict:
+        with self._lock:
+            return dict(self._ns(ns))
+
+    def incr(self, ns: str, key: str, delta: float = 1.0) -> float:
+        with self._lock:
+            table = self._ns(ns)
+            current = table.get(key)
+            value = (float(current) if isinstance(current, (int, float)) else 0.0) + delta
+            table[key] = value
+            return value
+
+    def mutate(self, ns: str, key: str, fn: Callable):
+        with self._lock:
+            new_value, result = fn(self._ns(ns).get(key))
+            if new_value is None:
+                self._ns(ns).pop(key, None)
+            else:
+                self._ns(ns)[key] = new_value
+            return result
+
+
+class SQLiteStateStore(StateStore):
+    """File-backed shared store: one SQLite database on a volume every
+    replica mounts. WAL mode keeps readers off the writers' lock;
+    ``BEGIN IMMEDIATE`` gives ``incr``/``mutate`` cross-process atomicity
+    (SQLite's own file locking is the advisory lock — no lockfile
+    protocol to get wrong). Connections are per-thread (sqlite3 objects
+    are not thread-safe; the bench drives replicas from worker threads).
+
+    Busy handling: a writer that finds the database locked retries inside
+    sqlite's busy timeout (5s) — under control-plane write rates (tag
+    assignments, breaker transitions, occupancy gauges) contention is
+    microseconds, not seconds."""
+
+    shared = True
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._local = threading.local()
+        # Create the schema once, eagerly, so a malformed path fails at
+        # boot (where the operator can see it), not mid-request.
+        conn = self._conn()
+        with conn:  # implicit transaction
+            conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv ("
+                "  ns TEXT NOT NULL, key TEXT NOT NULL, value TEXT NOT NULL,"
+                "  PRIMARY KEY (ns, key))"
+            )
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.path, timeout=5.0)
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = conn
+        return conn
+
+    def get(self, ns: str, key: str):
+        row = self._conn().execute(
+            "SELECT value FROM kv WHERE ns=? AND key=?", (ns, key)
+        ).fetchone()
+        return json.loads(row[0]) if row is not None else None
+
+    def put(self, ns: str, key: str, value) -> None:
+        conn = self._conn()
+        with conn:
+            conn.execute(
+                "INSERT INTO kv (ns, key, value) VALUES (?, ?, ?) "
+                "ON CONFLICT (ns, key) DO UPDATE SET value=excluded.value",
+                (ns, key, json.dumps(value)),
+            )
+
+    def delete(self, ns: str, key: str) -> None:
+        conn = self._conn()
+        with conn:
+            conn.execute("DELETE FROM kv WHERE ns=? AND key=?", (ns, key))
+
+    def items(self, ns: str) -> dict:
+        rows = self._conn().execute(
+            "SELECT key, value FROM kv WHERE ns=?", (ns,)
+        ).fetchall()
+        return {key: json.loads(value) for key, value in rows}
+
+    def incr(self, ns: str, key: str, delta: float = 1.0) -> float:
+        conn = self._conn()
+        try:
+            conn.execute("BEGIN IMMEDIATE")
+            row = conn.execute(
+                "SELECT value FROM kv WHERE ns=? AND key=?", (ns, key)
+            ).fetchone()
+            current = 0.0
+            if row is not None:
+                try:
+                    loaded = json.loads(row[0])
+                    if isinstance(loaded, (int, float)):
+                        current = float(loaded)
+                except ValueError:
+                    pass
+            value = current + delta
+            conn.execute(
+                "INSERT INTO kv (ns, key, value) VALUES (?, ?, ?) "
+                "ON CONFLICT (ns, key) DO UPDATE SET value=excluded.value",
+                (ns, key, json.dumps(value)),
+            )
+            conn.commit()
+            return value
+        except BaseException:
+            conn.rollback()
+            raise
+
+    def mutate(self, ns: str, key: str, fn: Callable):
+        conn = self._conn()
+        try:
+            conn.execute("BEGIN IMMEDIATE")
+            row = conn.execute(
+                "SELECT value FROM kv WHERE ns=? AND key=?", (ns, key)
+            ).fetchone()
+            current = json.loads(row[0]) if row is not None else None
+            new_value, result = fn(current)
+            if new_value is None:
+                conn.execute(
+                    "DELETE FROM kv WHERE ns=? AND key=?", (ns, key)
+                )
+            else:
+                conn.execute(
+                    "INSERT INTO kv (ns, key, value) VALUES (?, ?, ?) "
+                    "ON CONFLICT (ns, key) DO UPDATE SET value=excluded.value",
+                    (ns, key, json.dumps(new_value)),
+                )
+            conn.commit()
+            return result
+        except BaseException:
+            conn.rollback()
+            raise
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+
+def resolve_replica_id(config) -> str:
+    """This process's replica identity for multi-writer sharding and the
+    affinity ring: ``APP_REPLICA_SELF``, else POD_NAME (k8s downward API),
+    else the hostname — but ONLY when the deployment is actually
+    replicated (a replica peer set or a shared store is configured).
+    Single-replica deployments return "" and keep every legacy file name
+    byte-for-byte."""
+    replicated = bool(getattr(config, "replica_peers", "")) or (
+        (getattr(config, "state_store", "") or "").strip() not in ("", "memory")
+    )
+    if not replicated:
+        return ""
+    explicit = getattr(config, "replica_self", "") or ""
+    if explicit:
+        return explicit
+    import os
+    import socket
+
+    return os.environ.get("POD_NAME") or socket.gethostname()
+
+
+def make_state_store(config) -> StateStore:
+    """Build the configured store. ``APP_STATE_STORE`` grammar:
+
+    - empty / ``"memory"`` — a PRIVATE InMemoryStateStore: single-replica
+      mode, every cross-replica path skipped (today's behavior).
+    - ``"sqlite:///path/to/state.db"`` (or a bare filesystem path) — the
+      shared SQLite store; point every replica at the same file.
+    """
+    spec = (getattr(config, "state_store", "") or "").strip()
+    if spec in ("", "memory"):
+        return InMemoryStateStore()
+    if spec.startswith("sqlite://"):
+        spec = spec[len("sqlite://"):]
+        # sqlite:///abs/path leaves /abs/path; sqlite://rel leaves rel.
+    try:
+        return SQLiteStateStore(spec)
+    except sqlite3.Error as e:
+        raise ValueError(
+            f"APP_STATE_STORE={spec!r} is not a usable sqlite path: {e}"
+        ) from e

@@ -163,12 +163,44 @@ class UsageLedger:
         *,
         metrics=None,
         walltime=time.time,
+        replica_id: str | None = None,
     ) -> None:
         from ..config import Config
+        from .state_store import resolve_replica_id
 
         self.config = config or Config()
         self.metrics = metrics
         self.walltime = walltime
+        # Multi-writer sharding: in a replicated deployment every replica
+        # journals to its OWN shard (journal-<replica>.jsonl /
+        # snapshot-<replica>.json) — one writer per file, so concurrent
+        # replicas on a shared volume can never tear or interleave each
+        # other's lines (a multi-line flush exceeds PIPE_BUF, so two
+        # appenders on ONE file WOULD interleave). Single-replica
+        # deployments resolve to "" and keep the legacy file names
+        # byte-for-byte; a replica also READS the legacy files at load so
+        # turning replication on inherits the existing ledger.
+        self.replica_id = (
+            replica_id if replica_id is not None
+            else resolve_replica_id(self.config)
+        )
+        # Exactly ONE replica inherits the legacy unsharded files (the
+        # lexicographically-first configured peer — deterministic, no
+        # coordination needed): if every replica folded the legacy totals
+        # into its own shard, pre-migration history would be counted N
+        # times across the fleet. A replicated posture WITHOUT a peer
+        # list (shared store behind a plain load balancer) has nothing to
+        # elect against, so NOBODY inherits — the legacy files stay on
+        # disk untouched for the operator to fold in deliberately;
+        # over-counting a fleet's bills silently is the worse failure.
+        self._inherit_legacy = True
+        if self.replica_id:
+            from .replicas import parse_peers
+
+            peers = sorted(
+                parse_peers(getattr(self.config, "replica_peers", "") or "")
+            )
+            self._inherit_legacy = bool(peers) and self.replica_id == peers[0]
         self.enabled = bool(self.config.usage_metering_enabled)
         self.max_tenants = max(1, self.config.usage_max_tenants)
         self.flush_interval = max(0.1, self.config.usage_flush_interval)
@@ -274,38 +306,40 @@ class UsageLedger:
         `_load`."""
         if self._dir is None:
             return
-        try:
-            with open(self.snapshot_path, encoding="utf-8") as f:
-                body = json.load(f)
-            ts = body.get("ts")
-            tenants = body.get("tenants", {})
-            if isinstance(ts, (int, float)) and isinstance(tenants, dict):
-                for tenant, counters in tenants.items():
-                    if isinstance(counters, dict):
-                        yield float(ts), str(tenant), counters, "snapshot"
-        except (FileNotFoundError, json.JSONDecodeError, OSError):
-            pass
-        try:
-            with open(self.journal_path, encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        entry = json.loads(line)
-                    except json.JSONDecodeError:
-                        continue
-                    ts = entry.get("ts")
-                    tenant = entry.get("tenant")
-                    counters = entry.get("usage")
-                    if (
-                        isinstance(ts, (int, float))
-                        and isinstance(tenant, str)
-                        and isinstance(counters, dict)
-                    ):
-                        yield float(ts), tenant, counters, "journal"
-        except (FileNotFoundError, OSError):
-            pass
+        for path in self._read_paths(self.snapshot_path, "snapshot.json"):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    body = json.load(f)
+                ts = body.get("ts")
+                tenants = body.get("tenants", {})
+                if isinstance(ts, (int, float)) and isinstance(tenants, dict):
+                    for tenant, counters in tenants.items():
+                        if isinstance(counters, dict):
+                            yield float(ts), str(tenant), counters, "snapshot"
+            except (FileNotFoundError, json.JSONDecodeError, OSError):
+                pass
+        for path in self._read_paths(self.journal_path, "journal.jsonl"):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            entry = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        ts = entry.get("ts")
+                        tenant = entry.get("tenant")
+                        counters = entry.get("usage")
+                        if (
+                            isinstance(ts, (int, float))
+                            and isinstance(tenant, str)
+                            and isinstance(counters, dict)
+                        ):
+                            yield float(ts), tenant, counters, "journal"
+            except (FileNotFoundError, OSError):
+                pass
 
     def add(
         self,
@@ -423,60 +457,93 @@ class UsageLedger:
 
     @property
     def journal_path(self) -> str | None:
-        return os.path.join(self._dir, "journal.jsonl") if self._dir else None
+        if self._dir is None:
+            return None
+        name = (
+            f"journal-{self.replica_id}.jsonl"
+            if self.replica_id
+            else "journal.jsonl"
+        )
+        return os.path.join(self._dir, name)
 
     @property
     def snapshot_path(self) -> str | None:
-        return os.path.join(self._dir, "snapshot.json") if self._dir else None
+        if self._dir is None:
+            return None
+        name = (
+            f"snapshot-{self.replica_id}.json"
+            if self.replica_id
+            else "snapshot.json"
+        )
+        return os.path.join(self._dir, name)
+
+    def _read_paths(self, own: str | None, legacy_name: str) -> list[str]:
+        """Load-order file list: the legacy unsharded file first (only on
+        the one DESIGNATED inheritor — see _inherit_legacy), then this
+        replica's own shard. Peers' shards are deliberately NOT read —
+        each replica's table is its own attribution (merging a peer's
+        totals into this table would double-count them the moment both
+        replicas flush)."""
+        if own is None:
+            return []
+        paths = []
+        if self.replica_id and self._inherit_legacy:
+            legacy = os.path.join(self._dir, legacy_name)
+            if legacy != own:
+                paths.append(legacy)
+        paths.append(own)
+        return paths
 
     def _load(self) -> None:
         """Rebuild the table: snapshot first, then journal lines on top.
         Cumulative latest-wins lines + elementwise-max merge make the
         replay exact no matter where the previous process died."""
-        try:
-            with open(self.snapshot_path, encoding="utf-8") as f:
-                body = json.load(f)
-            tenants = body.get("tenants", {})
-            if isinstance(tenants, dict):
-                for tenant, counters in tenants.items():
-                    if isinstance(counters, dict):
-                        self._restore_row(str(tenant)).merge_max(counters)
-        except FileNotFoundError:
-            pass
-        except (json.JSONDecodeError, OSError):
-            self.load_errors += 1
-            logger.warning(
-                "usage snapshot unreadable; continuing from the journal",
-                exc_info=True,
-            )
-        try:
-            with open(self.journal_path, encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        entry = json.loads(line)
-                    except json.JSONDecodeError:
-                        # A torn tail line (SIGKILL mid-write): everything
-                        # before it already replayed; at most one flush
-                        # interval of attribution is gone — the documented
-                        # durability bound.
-                        self.load_errors += 1
-                        logger.warning(
-                            "skipping torn usage-journal line (%d bytes)",
-                            len(line),
-                        )
-                        continue
-                    tenant = entry.get("tenant")
-                    counters = entry.get("usage")
-                    if isinstance(tenant, str) and isinstance(counters, dict):
-                        self._restore_row(tenant).merge_max(counters)
-        except FileNotFoundError:
-            pass
-        except OSError:
-            self.load_errors += 1
-            logger.warning("usage journal unreadable", exc_info=True)
+        for path in self._read_paths(self.snapshot_path, "snapshot.json"):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    body = json.load(f)
+                tenants = body.get("tenants", {})
+                if isinstance(tenants, dict):
+                    for tenant, counters in tenants.items():
+                        if isinstance(counters, dict):
+                            self._restore_row(str(tenant)).merge_max(counters)
+            except FileNotFoundError:
+                pass
+            except (json.JSONDecodeError, OSError):
+                self.load_errors += 1
+                logger.warning(
+                    "usage snapshot unreadable; continuing from the journal",
+                    exc_info=True,
+                )
+        for path in self._read_paths(self.journal_path, "journal.jsonl"):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            entry = json.loads(line)
+                        except json.JSONDecodeError:
+                            # A torn tail line (SIGKILL mid-write):
+                            # everything before it already replayed; at
+                            # most one flush interval of attribution is
+                            # gone — the documented durability bound.
+                            self.load_errors += 1
+                            logger.warning(
+                                "skipping torn usage-journal line (%d bytes)",
+                                len(line),
+                            )
+                            continue
+                        tenant = entry.get("tenant")
+                        counters = entry.get("usage")
+                        if isinstance(tenant, str) and isinstance(counters, dict):
+                            self._restore_row(tenant).merge_max(counters)
+            except FileNotFoundError:
+                pass
+            except OSError:
+                self.load_errors += 1
+                logger.warning("usage journal unreadable", exc_info=True)
         if self._tenants:
             logger.info(
                 "usage ledger restored %d tenant row(s) from %s",

@@ -1,0 +1,324 @@
+"""Scale-out control-plane bench + CI smoke gate (ISSUE 15 tentpole).
+
+Two measurements, two gates:
+
+1. **Shared-store overhead** (single stack, interleaved A/B): the PR 3/8
+   unchanged session turn — the most overhead-sensitive real turn the
+   service has — through ONE executor whose state-store wiring is toggled
+   per turn between the DEFAULT private in-memory store (APP_STATE_STORE
+   unset: every cross-replica path is skipped, the exact pre-PR code
+   path) and a SHARED SQLite store (every cross-replica path live:
+   shared WFQ tags, breaker reads, occupancy publishes). Gate, the
+   established overhead discipline:
+
+       shared-store p50 <= default p50 * 1.05 + 5ms
+
+   The default leg IS the pre-PR path (store never consulted), so the
+   "single replica with APP_STATE_STORE unset stays within 5%+5ms of
+   pre-PR p50" claim is gated by construction — the stricter statement
+   (even the SHARED path fits the budget) is what this gate measures.
+
+2. **Two-replica aggregate throughput**: a saturating small-exec
+   workload (8 concurrent clients, latency-bound execs) against ONE
+   replica whose backend grants it a fixed sandbox budget, then against
+   TWO in-process replicas (each with the same per-replica budget,
+   replica-local sandbox roots) cooperating over one shared SQLite
+   store. Each replica's budget models the per-pod management capacity a
+   real deployment scales out BY; the gate proves the shared
+   scheduler/lease/occupancy coordination does not serialize the second
+   replica away:
+
+       two-replica aggregate throughput >= 1.6x single-replica
+
+Usage:
+    python scripts/bench_replicas.py [--repeats 30] [--turns 10]
+        [--out BENCH_replicas.json] [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import secrets
+import statistics
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from bee_code_interpreter_fs_tpu.config import Config  # noqa: E402
+from bee_code_interpreter_fs_tpu.services.backends.local import (  # noqa: E402
+    LocalSandboxBackend,
+)
+from bee_code_interpreter_fs_tpu.services.code_executor import (  # noqa: E402
+    CodeExecutor,
+)
+from bee_code_interpreter_fs_tpu.services.state_store import (  # noqa: E402
+    InMemoryStateStore,
+    SQLiteStateStore,
+)
+from bee_code_interpreter_fs_tpu.services.storage import Storage  # noqa: E402
+
+
+def _trimmed_p50(samples: list[float]) -> float:
+    """Median of the fastest two-thirds (the transfer bench's estimator)."""
+    fast = sorted(samples)[: max(1, (2 * len(samples) + 2) // 3)]
+    return statistics.median(fast)
+
+
+class ReplicaCappedBackend(LocalSandboxBackend):
+    """Local backend with a per-REPLICA warm-sandbox budget: each control
+    plane may manage at most `cap` concurrent sandboxes — the per-pod
+    management capacity scale-out multiplies. The budget names
+    replica-local processes (each replica has its own sandbox root), so
+    peers' holds do not contend for it."""
+
+    capacity_shared_across_replicas = False
+
+    def __init__(self, config, cap: int):
+        super().__init__(config, warm_import_jax=False)
+        self._cap = cap
+
+    def pool_capacity(self, chip_count: int):
+        return self._cap
+
+
+def _config(tmp: str, name: str, **overrides) -> Config:
+    defaults = dict(
+        file_storage_path=f"{tmp}/{name}/storage",
+        local_sandbox_root=f"{tmp}/{name}/sandboxes",
+        usage_journal_path=f"{tmp}/{name}/usage",
+        executor_pod_queue_target_length=1,
+        jax_compilation_cache_dir="",
+        compile_cache_prewarm=False,
+        compile_cache_enabled=False,
+        default_execution_timeout=120.0,
+        replica_self=name,
+    )
+    defaults.update(overrides)
+    return Config(**defaults)
+
+
+def _swap_store(executor: CodeExecutor, store) -> None:
+    """Re-point the executor's state-store seam (scheduler WFQ tags,
+    breaker verdicts, lease generations, occupancy gauges) at `store`.
+    None/private restores the exact default path (no component consults
+    any store)."""
+    shared = store is not None and store.shared
+    executor.state_store = store or InMemoryStateStore()
+    executor._store_shared = shared
+    live = store if shared else None
+    executor.scheduler._store = live
+    executor.leases._store = live
+    executor.breakers._store = live
+    for breaker in executor.breakers._lanes.values():
+        breaker._store = live
+        breaker._remote_cache = (0.0, None)
+
+
+async def bench_overhead(tmp: str, repeats: int) -> dict:
+    """Leg 1: default-vs-shared-store unchanged-turn p50, one stack."""
+    config = _config(tmp, "overhead")
+    backend = LocalSandboxBackend(config, warm_import_jax=False)
+    executor = CodeExecutor(backend, Storage(config.file_storage_path), config)
+    sqlite_store = SQLiteStateStore(f"{tmp}/overhead-state.db")
+    files = {}
+    for i in range(8):
+        object_id = await executor.storage.write(secrets.token_bytes(4096))
+        files[f"/workspace/input-{i:03d}.bin"] = object_id
+    default_samples: list[float] = []
+    shared_samples: list[float] = []
+    try:
+        async def turn() -> float:
+            start = time.perf_counter()
+            result = await executor.execute(
+                "import glob; print(len(glob.glob('input-*.bin')))",
+                files=files,
+                executor_id="bench-replicas",
+                tenant="bench-tenant",
+            )
+            if result.exit_code != 0:
+                raise RuntimeError(f"turn failed: {result.stderr[:400]}")
+            return time.perf_counter() - start
+
+        for _ in range(3):  # settle: spawn + cold sync
+            await turn()
+        for _ in range(repeats):
+            _swap_store(executor, None)
+            default_samples.append(await turn())
+            _swap_store(executor, sqlite_store)
+            shared_samples.append(await turn())
+    finally:
+        _swap_store(executor, None)
+        await executor.close()
+        sqlite_store.close()
+    default_p50 = _trimmed_p50(default_samples)
+    shared_p50 = _trimmed_p50(shared_samples)
+    budget = default_p50 * 1.05 + 0.005
+    return {
+        "default_store_p50_s": round(default_p50, 6),
+        "shared_sqlite_p50_s": round(shared_p50, 6),
+        "overhead_s": round(shared_p50 - default_p50, 6),
+        "gate": {
+            "rule": "shared_sqlite_p50 <= default_p50 * 1.05 + 5ms "
+                    "(default leg IS the pre-PR path: store never consulted)",
+            "budget_s": round(budget, 6),
+            "pass": bool(shared_p50 <= budget),
+        },
+    }
+
+
+# Latency-bound small exec: saturates each replica's sandbox budget
+# without pinning CI cores, so aggregate throughput tracks how many
+# sandboxes the CONTROL PLANES can keep in flight — the quantity replicas
+# multiply.
+EXEC_SOURCE = "import time; time.sleep(0.2); print('ok')"
+WORKERS = 8
+PER_REPLICA_CAP = 2
+
+
+async def _drive(executors: list[CodeExecutor], turns_per_worker: int) -> dict:
+    """8 concurrent clients, round-robin across the replica set; returns
+    aggregate throughput."""
+    completed = 0
+
+    async def worker(index: int) -> None:
+        nonlocal completed
+        executor = executors[index % len(executors)]
+        for _ in range(turns_per_worker):
+            result = await executor.execute(
+                EXEC_SOURCE, tenant=f"client-{index % 2}"
+            )
+            if result.exit_code != 0:
+                raise RuntimeError(f"exec failed: {result.stderr[:400]}")
+            completed += 1
+
+    start = time.perf_counter()
+    await asyncio.gather(*(worker(i) for i in range(WORKERS)))
+    wall = time.perf_counter() - start
+    return {
+        "turns": completed,
+        "wall_s": round(wall, 3),
+        "throughput_rps": round(completed / wall, 3),
+    }
+
+
+async def bench_throughput(tmp: str, turns_per_worker: int) -> dict:
+    """Leg 2: single replica vs two replicas over one shared store."""
+
+    def make_replica(name: str, store) -> CodeExecutor:
+        # Static pool target == the sandbox budget (autoscale off): both
+        # of a replica's sandboxes recycle into its pool between turns —
+        # the measured quantity is steady-state serving, not spawn churn.
+        config = _config(
+            tmp,
+            name,
+            executor_pod_queue_target_length=PER_REPLICA_CAP,
+            pool_autoscale_enabled=False,
+        )
+        backend = ReplicaCappedBackend(config, PER_REPLICA_CAP)
+        return CodeExecutor(
+            backend,
+            Storage(config.file_storage_path),
+            config,
+            state_store=store,
+        )
+
+    async def settle(replicas: list[CodeExecutor]) -> None:
+        # Warm every replica's FULL budget before measuring.
+        await asyncio.gather(
+            *(
+                replica.execute(EXEC_SOURCE)
+                for replica in replicas
+                for _ in range(PER_REPLICA_CAP)
+            )
+        )
+
+    # Single replica, default private store — the one-process baseline.
+    single = make_replica("single", None)
+    try:
+        await settle([single])
+        single_result = await _drive([single], turns_per_worker)
+    finally:
+        await single.close()
+
+    # Two replicas sharing one SQLite store: shared WFQ tags, breaker
+    # verdicts, lease generations, occupancy gauges — all live.
+    store = SQLiteStateStore(f"{tmp}/fleet-state.db")
+    replica_a = make_replica("replica-a", store)
+    replica_b = make_replica("replica-b", store)
+    try:
+        await settle([replica_a, replica_b])
+        pair_result = await _drive([replica_a, replica_b], turns_per_worker)
+    finally:
+        await replica_a.close()
+        await replica_b.close()
+        store.close()
+
+    speedup = (
+        pair_result["throughput_rps"] / single_result["throughput_rps"]
+        if single_result["throughput_rps"] > 0
+        else 0.0
+    )
+    return {
+        "workload": {
+            "exec": EXEC_SOURCE,
+            "workers": WORKERS,
+            "turns_per_worker": turns_per_worker,
+            "per_replica_sandbox_budget": PER_REPLICA_CAP,
+        },
+        "single_replica": single_result,
+        "two_replicas_shared_store": pair_result,
+        "speedup": round(speedup, 3),
+        "gate": {
+            "rule": "two-replica aggregate throughput >= 1.6x single-replica",
+            "pass": bool(speedup >= 1.6),
+        },
+    }
+
+
+async def run_bench(repeats: int, turns_per_worker: int) -> dict:
+    tmp = tempfile.mkdtemp(prefix="bench-replicas-")
+    overhead = await bench_overhead(tmp, repeats)
+    throughput = await bench_throughput(tmp, turns_per_worker)
+    return {
+        "overhead": overhead,
+        "throughput": throughput,
+        "gates_pass": bool(
+            overhead["gate"]["pass"] and throughput["gate"]["pass"]
+        ),
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--repeats", type=int, default=30)
+    parser.add_argument("--turns", type=int, default=10)
+    parser.add_argument("--out", default="BENCH_replicas.json")
+    parser.add_argument(
+        "--smoke",
+        action="store_true",
+        help="CI profile: fewer repeats/turns, same gates",
+    )
+    args = parser.parse_args()
+    repeats = 12 if args.smoke else args.repeats
+    turns = 6 if args.smoke else args.turns
+    result = asyncio.run(run_bench(repeats, turns))
+    Path(args.out).write_text(json.dumps(result, indent=2) + "\n")
+    print(json.dumps(result, indent=2))
+    if not result["gates_pass"]:
+        print(
+            "GATE FAILED: replica scale-out (overhead or throughput)",
+            file=sys.stderr,
+        )
+        return 1
+    print("gates MET")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

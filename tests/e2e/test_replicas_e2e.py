@@ -1,0 +1,473 @@
+"""Scale-out control-plane e2e: TWO in-process replicas over ONE shared
+state store.
+
+The ROADMAP acceptance criterion, verbatim: two control-plane replicas
+serve one tenant's session stream with fair-share and breaker semantics
+preserved — interleaved same-tenant sessions keep WFQ ordering, a breaker
+tripped via replica A is observed open by replica B, and a host fenced by
+A is never granted by B. Plus the failover satellite: kill one of two
+replicas mid-session; its sessions rehash to the survivor, which serves
+them after lease-fenced turnover instead of wedging on the dead owner's
+grants.
+
+Stack: CodeExecutor x2 over in-memory fake backends (distinct per
+replica, as two k8s pods would have) sharing one InMemoryStateStore
+(shared=True — the deterministic stand-in for the sqlite file store; the
+store contract itself is covered in test_state_store.py), full aiohttp
+apps with SessionRouter for the failover leg."""
+
+import pytest
+
+pytest.importorskip("httpx", reason="optional e2e dependency not installed")
+pytest.importorskip("aiohttp", reason="optional e2e dependency not installed")
+
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from bee_code_interpreter_fs_tpu.config import Config
+from bee_code_interpreter_fs_tpu.services.backends.base import Sandbox
+from bee_code_interpreter_fs_tpu.services.code_executor import (
+    CircuitOpenError,
+    CodeExecutor,
+)
+from bee_code_interpreter_fs_tpu.services.custom_tool_executor import (
+    CustomToolExecutor,
+)
+from bee_code_interpreter_fs_tpu.services.http_server import create_http_app
+from bee_code_interpreter_fs_tpu.services.replicas import (
+    ReplicaRing,
+    SessionRouter,
+)
+from bee_code_interpreter_fs_tpu.services.state_store import InMemoryStateStore
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+
+class ReplicaFakeBackend:
+    """In-memory backend, one per replica (two pods own different
+    sandboxes over the same physical substrate). `spawn_delay` keeps
+    concurrent acquisitions inside one scheduler busy period so WFQ tags
+    are comparable across replicas."""
+
+    compile_cache_dir_scope = "private"
+    supports_lease_push = False
+
+    def __init__(self, name: str, spawn_delay: float = 0.0):
+        self.name = name
+        self.spawn_delay = spawn_delay
+        self.spawns = 0
+        self.live = set()
+
+    async def spawn(self, chip_count: int = 0) -> Sandbox:
+        if self.spawn_delay:
+            await asyncio.sleep(self.spawn_delay)
+        self.spawns += 1
+        sid = f"{self.name}-sb-{self.spawns}"
+        sandbox = Sandbox(
+            id=sid, url=f"http://{sid}", chip_count=chip_count
+        )
+        self.live.add(sid)
+        return sandbox
+
+    def pool_capacity(self, chip_count: int):
+        return None
+
+    async def reset(self, sandbox: Sandbox):
+        if sandbox.id not in self.live:
+            return None
+        return sandbox
+
+    async def delete(self, sandbox: Sandbox) -> None:
+        self.live.discard(sandbox.id)
+
+    async def close(self) -> None:
+        self.live.clear()
+
+
+def patch_sandbox_wire(executor: CodeExecutor) -> list:
+    """Replace the HTTP hop to the (fake) sandbox; returns the served-by
+    log."""
+    served = []
+
+    async def fake_post_execute(client, base, payload, timeout, sandbox):
+        served.append(sandbox.id)
+        return {
+            "stdout": "ok\n",
+            "stderr": "",
+            "exit_code": 0,
+            "files": [],
+            "warm": True,
+        }
+
+    executor._post_execute = fake_post_execute
+    return served
+
+
+def make_replica(name, store, tmp_path, spawn_delay=0.0, **config_kwargs):
+    defaults = dict(
+        file_storage_path=str(tmp_path / name / "storage"),
+        usage_journal_path=str(tmp_path / name / "usage"),
+        executor_pod_queue_target_length=0,
+        compile_cache_enabled=False,
+        replica_self=name,
+    )
+    defaults.update(config_kwargs)
+    config = Config(**defaults)
+    backend = ReplicaFakeBackend(name, spawn_delay=spawn_delay)
+    executor = CodeExecutor(
+        backend,
+        Storage(config.file_storage_path),
+        config,
+        state_store=store,
+    )
+    served = patch_sandbox_wire(executor)
+    return executor, backend, served
+
+
+async def settle(executor):
+    for _ in range(3):
+        await asyncio.sleep(0)
+    tasks = list(executor._dispose_tasks) + list(executor._fill_tasks)
+    if tasks:
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+
+async def close_all(*executors):
+    for executor in executors:
+        await executor.close()
+
+
+# ------------------------------------------------------------- acceptance
+
+
+async def test_interleaved_sessions_keep_wfq_ordering(tmp_path):
+    """One tenant's sessions, interleaved across both replicas, draw WFQ
+    tags from ONE fleet-wide flow sequence: in submission order the tags
+    are strictly increasing within the busy period — exactly what a
+    single control plane would have assigned."""
+    store = InMemoryStateStore(shared=True)
+    exec_a, _, _ = make_replica("ra", store, tmp_path, spawn_delay=0.05)
+    exec_b, _, _ = make_replica("rb", store, tmp_path, spawn_delay=0.05)
+    tags = []
+    for name, executor in (("ra", exec_a), ("rb", exec_b)):
+        sched = executor.scheduler
+        original = sched.submit
+
+        def wrapped(lane, *, _orig=original, _name=name, **kwargs):
+            ticket = _orig(lane, **kwargs)
+            if kwargs.get("tenant") == "alice":
+                tags.append((_name, ticket.start_tag, ticket.finish_tag))
+            return ticket
+
+        sched.submit = wrapped
+    try:
+        # 6 concurrent session creations, alternating replicas, one
+        # tenant: the spawn delay holds them all inside one busy period.
+        await asyncio.gather(
+            *(
+                (exec_a if i % 2 == 0 else exec_b).execute(
+                    "print('hi')",
+                    executor_id=f"sess-{i}",
+                    tenant="alice",
+                )
+                for i in range(6)
+            )
+        )
+        assert len(tags) == 6
+        assert {name for name, _, _ in tags} == {"ra", "rb"}
+        finishes = [finish for _, _, finish in tags]
+        # One global flow sequence: tags never go backwards in submission
+        # order (two private tag tables would restart per replica), and a
+        # repeated tag can only be a fresh busy period's HEAD (the same
+        # per-busy-period reset one scheduler performs when its lane
+        # empties) — never two replicas handing one flow the same slot
+        # mid-period.
+        assert finishes == sorted(finishes)
+        head = 1.0  # weight-1 flow: first tag of a fresh table
+        duplicates = {f for f in finishes if finishes.count(f) > 1}
+        assert duplicates <= {head}
+        # Direct cross-replica continuation: some adjacent submissions on
+        # DIFFERENT replicas chain start == previous finish — replica B
+        # continued the flow exactly where replica A left it.
+        assert any(
+            name_b != name_a and start_b == pytest.approx(finish_a)
+            for (name_a, _, finish_a), (name_b, start_b, _) in zip(
+                tags, tags[1:]
+            )
+        )
+    finally:
+        await settle(exec_a)
+        await settle(exec_b)
+        await close_all(exec_a, exec_b)
+
+
+async def test_breaker_tripped_on_a_open_on_b(tmp_path):
+    store = InMemoryStateStore(shared=True)
+    exec_a, _, _ = make_replica("ra", store, tmp_path)
+    exec_b, _, _ = make_replica("rb", store, tmp_path)
+    try:
+        # Replica A trips its default-lane breaker (violation storm /
+        # consecutive spawn failures); replica B observes it OPEN: its
+        # health degrades and its executes fail fast — no burning the
+        # acquire budget against the same dead backend.
+        exec_a.breakers.lane(0).trip("storm on replica A")
+        assert exec_b.degraded()
+        assert exec_b.breakers.retry_after(0) > 0
+        with pytest.raises(CircuitOpenError):
+            await exec_b.execute("print('nope')")
+    finally:
+        await close_all(exec_a, exec_b)
+
+
+async def test_host_fenced_by_a_never_granted_by_b(tmp_path):
+    store = InMemoryStateStore(shared=True)
+    exec_a, _, served_a = make_replica(
+        "ra",
+        store,
+        tmp_path,
+        device_probe_readmit_streak=1,
+        executor_pod_queue_target_length=1,
+        pool_autoscale_enabled=False,
+    )
+    exec_b, backend_b, served_b = make_replica(
+        "rb",
+        store,
+        tmp_path,
+        device_probe_readmit_streak=1,
+        executor_pod_queue_target_length=1,
+        pool_autoscale_enabled=False,
+    )
+    try:
+        # B warms a sandbox on the shared hardware scope (lane-0)...
+        await exec_b.execute("print('warm b')")
+        await settle(exec_b)
+        pool_b = exec_b._pool(0)
+        assert pool_b  # recycled into B's pool
+        stale_host = pool_b[0]
+        gen_b = stale_host.meta["lease"].generation
+        # ...then A mints a newer lease on the same scope and its host
+        # wedges: A fences it.
+        await exec_a.execute("print('warm a')")
+        await settle(exec_a)
+        sandbox_id_a = next(iter(exec_a._live_sandboxes))
+        assert await exec_a.fence_host(sandbox_id_a, reason="wedged") == "fenced"
+        # THE criterion: B's pooled host (an older generation on the
+        # fenced scope) is never granted — the pop path drains it through
+        # lease-fenced turnover instead.
+        assert exec_b.leases.stale(stale_host.meta["lease"])
+        assert exec_b._pop_pool_sandbox(pool_b) is None
+        assert stale_host.meta["device_health"] == "draining"
+        assert stale_host not in pool_b
+        await settle(exec_b)
+        assert stale_host.id not in backend_b.live  # disposed, not parked
+        # The scope re-admits after the clean-probe streak (streak=1 here;
+        # either replica's probes may complete it)...
+        assert exec_b.leases.note_probe("lane-0", clean=True) is True
+        assert not exec_a.leases.recovering("lane-0")
+        # ...and B then serves on a FRESH generation above the fence floor.
+        await exec_b.execute("print('post-fence')")
+        assert served_b[-1] != stale_host.id
+        floor = store.get("lease_fence", "lane-0")
+        assert floor is None  # re-admitted
+        assert gen_b < exec_b.leases.current_generation("lane-0")
+    finally:
+        await settle(exec_a)
+        await settle(exec_b)
+        await close_all(exec_a, exec_b)
+
+
+# --------------------------------------------------------------- failover
+
+
+class ManualClock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+async def test_replica_failover_sessions_rehash_to_survivor(tmp_path):
+    """Kill one of two replicas mid-session: the survivor detects the
+    dead owner at proxy time, drops it from the ring, and serves the
+    rehashed session itself (session_seq=1 reports the state loss) —
+    instead of wedging on the dead owner's grants."""
+    store = InMemoryStateStore(shared=True)
+    exec_a, _, served_a = make_replica("ra", store, tmp_path)
+    exec_b, _, served_b = make_replica("rb", store, tmp_path)
+    clock = ManualClock()
+    # Peer addresses are patched in once the test servers pick their
+    # ephemeral ports (ring.peers is read live by url_of).
+    peers = {"ra": "", "rb": ""}
+    router_a = SessionRouter(
+        ReplicaRing("ra", peers, store=store, heartbeat_ttl=30.0, clock=clock)
+    )
+    router_b = SessionRouter(
+        ReplicaRing("rb", peers, store=store, heartbeat_ttl=30.0, clock=clock)
+    )
+    exec_a.session_router = router_a
+    exec_b.session_router = router_b
+    app_a = create_http_app(
+        exec_a,
+        CustomToolExecutor(exec_a),
+        Storage(str(tmp_path / "ra" / "storage")),
+        router=router_a,
+    )
+    app_b = create_http_app(
+        exec_b,
+        CustomToolExecutor(exec_b),
+        Storage(str(tmp_path / "rb" / "storage")),
+        router=router_b,
+    )
+    client_a = TestClient(TestServer(app_a))
+    client_b = TestClient(TestServer(app_b))
+    await client_a.start_server()
+    await client_b.start_server()
+    a_dead = False
+    try:
+        for ring in (router_a.ring, router_b.ring):
+            ring.peers["ra"] = str(client_a.make_url("")).rstrip("/")
+            ring.peers["rb"] = str(client_b.make_url("")).rstrip("/")
+        router_a.ring.heartbeat()
+        router_b.ring.heartbeat()
+        # A session OWNED by replica A, created through replica B: the
+        # edge transparently proxies it to the owner.
+        session = next(
+            f"sess-{i}"
+            for i in range(256)
+            if router_b.owner_of("alice", f"sess-{i}") == "ra"
+        )
+        resp = await client_b.post(
+            "/v1/execute",
+            json={
+                "source_code": "print('turn 1')",
+                "executor_id": session,
+                "tenant": "alice",
+            },
+        )
+        assert resp.status == 200
+        assert resp.headers.get("X-Replica-Owner") == "ra"
+        assert (await resp.json())["session_seq"] == 1
+        assert served_a and not served_b  # A's sandbox served it
+        # Turn 2 through B again: still proxied, session state lives on A.
+        resp = await client_b.post(
+            "/v1/execute",
+            json={
+                "source_code": "print('turn 2')",
+                "executor_id": session,
+                "tenant": "alice",
+            },
+        )
+        assert (await resp.json())["session_seq"] == 2
+        # KILL replica A mid-session (server down, executor gone).
+        await client_a.close()
+        await exec_a.close()
+        a_dead = True
+        # Turn 3 through B: the proxy fails, A drops off B's ring, the
+        # session rehashes to B — which serves it FRESH (seq=1: the dead
+        # owner's state is gone, reported honestly) on its own healthy
+        # sandbox instead of wedging on the dead owner's grants.
+        resp = await client_b.post(
+            "/v1/execute",
+            json={
+                "source_code": "print('turn 3')",
+                "executor_id": session,
+                "tenant": "alice",
+            },
+        )
+        assert resp.status == 200
+        assert (await resp.json())["session_seq"] == 1
+        assert served_b  # the survivor's own sandbox served it
+        assert router_b.ring.live_ids() == ["rb"]
+        assert router_b.owns("alice", session)
+    finally:
+        await router_a.close()
+        await router_b.close()
+        await client_b.close()
+        await settle(exec_b)
+        await exec_b.close()
+        if not a_dead:
+            await client_a.close()
+            await exec_a.close()
+
+
+# --------------------------------------------------------------- gRPC edge
+
+
+class AbortRaised(Exception):
+    def __init__(self, code, details):
+        super().__init__(details)
+        self.code = code
+        self.details = details
+
+
+class FakeGrpcContext:
+    def __init__(self, metadata=()):
+        self.metadata = tuple(metadata)
+        self.trailing = ()
+
+    def invocation_metadata(self):
+        return self.metadata
+
+    def set_trailing_metadata(self, trailing):
+        self.trailing = tuple(trailing)
+
+    async def abort(self, code, details=""):
+        raise AbortRaised(code, details)
+
+
+async def test_grpc_non_owner_aborts_with_owner_metadata(tmp_path):
+    """The gRPC edge's half of affinity: a session RPC on a non-owner
+    replica aborts UNAVAILABLE with the owner's identity (and address)
+    in trailing metadata — the transport-level analogue of the HTTP
+    307 + X-Replica-Owner contract."""
+    grpc = pytest.importorskip("grpc")
+    from bee_code_interpreter_fs_tpu.proto import code_interpreter_pb2 as pb2
+    from bee_code_interpreter_fs_tpu.services.grpc_servicers.code_interpreter_servicer import (  # noqa: E501
+        CodeInterpreterServicer,
+    )
+
+    store = InMemoryStateStore(shared=True)
+    exec_b, _, served_b = make_replica("rb", store, tmp_path)
+    router_b = SessionRouter(
+        ReplicaRing("rb", {"ra": "http://replica-a:8000", "rb": ""})
+    )
+    exec_b.session_router = router_b
+    servicer = CodeInterpreterServicer(exec_b, CustomToolExecutor(exec_b))
+    try:
+        ra_session = next(
+            f"sess-{i}"
+            for i in range(256)
+            if router_b.owner_of("alice", f"sess-{i}") == "ra"
+        )
+        context = FakeGrpcContext(metadata=[("x-tenant", "alice")])
+        with pytest.raises(AbortRaised) as exc:
+            await servicer.Execute(
+                pb2.ExecuteRequest(
+                    source_code="print(1)", executor_id=ra_session
+                ),
+                context,
+            )
+        assert exc.value.code == grpc.StatusCode.UNAVAILABLE
+        trailing = dict(context.trailing)
+        assert trailing["x-replica-owner"] == "ra"
+        assert trailing["x-replica-owner-url"] == "http://replica-a:8000"
+        assert not served_b  # nothing ran locally
+        # A session rb OWNS serves normally.
+        rb_session = next(
+            f"own-{i}"
+            for i in range(256)
+            if router_b.owner_of("alice", f"own-{i}") == "rb"
+        )
+        context = FakeGrpcContext(metadata=[("x-tenant", "alice")])
+        response = await servicer.Execute(
+            pb2.ExecuteRequest(
+                source_code="print(1)", executor_id=rb_session
+            ),
+            context,
+        )
+        assert response.session_seq == 1
+        assert served_b
+    finally:
+        await settle(exec_b)
+        await exec_b.close()

@@ -834,6 +834,8 @@ def test_denial_reasons_closed_set(tmp_path):
     # series nobody dashboards).
     assert set(DENIAL_REASONS) == {
         "chip_seconds",
+        "hbm_byte_seconds",
+        "burst_credits",
         "predicted_overrun",
         "request_rate",
         "concurrency",
@@ -992,3 +994,201 @@ async def test_http_predicted_overrun_429(tmp_path):
     finally:
         await client.close()
         await executor.close()
+
+
+# ------------------------------------------- HBM budget (device memory)
+
+
+def test_hbm_budget_denies_and_refills(tmp_path):
+    enforcer, ledger, clock = make_enforcer(
+        tmp_path,
+        quota_hbm_byte_seconds=1000.0,
+        quota_window_seconds=60.0,
+    )
+    enforcer.release(enforcer.admit("t-mem"))
+    ledger.add("t-mem", chip_seconds=1.0, hbm_byte_seconds=600.0)
+    clock.advance(10.0)
+    enforcer.release(enforcer.admit("t-mem"))  # under budget: admitted
+    ledger.add("t-mem", chip_seconds=1.0, hbm_byte_seconds=500.0)
+    clock.advance(1.0)
+    with pytest.raises(QuotaExceededError) as exc:
+        enforcer.admit("t-mem")
+    assert exc.value.reason == "hbm_byte_seconds"
+    assert exc.value.remaining_hbm_byte_seconds == 0.0
+    assert exc.value.limit_hbm_byte_seconds == 1000.0
+    assert exc.value.retry_after > 0
+    # The first burst ages out of the window at its refill point: the
+    # Retry-After contract (waiting it out re-admits).
+    clock.advance(exc.value.retry_after + 0.1)
+    verdict = enforcer.admit("t-mem")
+    assert verdict is not None
+    enforcer.release(verdict)
+
+
+def test_hbm_budget_policy_file_override(tmp_path):
+    policy_path = tmp_path / "policy.json"
+    policy_path.write_text(json.dumps(
+        {"tenants": {"vip": {"hbm_byte_seconds_per_window": 5000}}}
+    ))
+    enforcer, ledger, clock = make_enforcer(
+        tmp_path,
+        quota_hbm_byte_seconds=100.0,
+        quota_window_seconds=60.0,
+        quota_policy_file=str(policy_path),
+    )
+    enforcer.release(enforcer.admit("vip"))
+    enforcer.release(enforcer.admit("pleb"))
+    ledger.add("vip", chip_seconds=1.0, hbm_byte_seconds=200.0)
+    ledger.add("pleb", chip_seconds=1.0, hbm_byte_seconds=200.0)
+    clock.advance(1.0)
+    enforcer.release(enforcer.admit("vip"))  # 200 < 5000: fine
+    with pytest.raises(QuotaExceededError) as exc:
+        enforcer.admit("pleb")  # 200 >= 100: denied
+    assert exc.value.reason == "hbm_byte_seconds"
+
+
+def test_hbm_surfaces_in_snapshot(tmp_path):
+    enforcer, ledger, clock = make_enforcer(
+        tmp_path,
+        quota_hbm_byte_seconds=1000.0,
+        quota_window_seconds=60.0,
+    )
+    enforcer.release(enforcer.admit("t-mem"))
+    ledger.add("t-mem", chip_seconds=2.0, hbm_byte_seconds=300.0)
+    clock.advance(1.0)
+    enforcer.release(enforcer.admit("t-mem"))
+    row = enforcer.tenant_snapshot("t-mem")
+    assert row["used_hbm_byte_seconds_window"] == pytest.approx(300.0)
+    assert row["remaining_hbm_byte_seconds"] == pytest.approx(700.0)
+    assert row["policy"]["hbm_byte_seconds_per_window"] == 1000.0
+
+
+# ------------------------------------------- burst-credit smoothing
+
+
+def test_burst_credits_drain_and_refill(tmp_path):
+    enforcer, ledger, clock = make_enforcer(
+        tmp_path,
+        quota_burst_credits=10.0,
+        quota_refill_per_second=1.0,
+        quota_window_seconds=3600.0,
+    )
+    verdict = enforcer.admit("t-burst")
+    assert verdict.burst_credits_remaining == pytest.approx(10.0)
+    enforcer.release(verdict)
+    # Burn 12 chip-seconds in one go: the bucket overdraws.
+    ledger.add("t-burst", chip_seconds=12.0)
+    clock.advance(1.0)  # refill is capped at the full bucket (10)
+    with pytest.raises(QuotaExceededError) as exc:
+        enforcer.admit("t-burst")
+    assert exc.value.reason == "burst_credits"
+    assert exc.value.burst_credits_remaining == 0.0
+    # Deficit is 12 - 10 = 2 credits; at 1/s the Retry-After covers it.
+    assert exc.value.retry_after == pytest.approx(2.0, abs=0.2)
+    clock.advance(exc.value.retry_after + 1.0)
+    verdict = enforcer.admit("t-burst")
+    assert verdict is not None
+    assert verdict.burst_credits_remaining > 0
+    enforcer.release(verdict)
+
+
+def test_burst_credits_cap_at_bucket_size(tmp_path):
+    enforcer, ledger, clock = make_enforcer(
+        tmp_path,
+        quota_burst_credits=5.0,
+        quota_refill_per_second=100.0,
+        quota_window_seconds=3600.0,
+    )
+    enforcer.release(enforcer.admit("t"))
+    clock.advance(3600.0)  # hours of refill never exceed the bucket
+    verdict = enforcer.admit("t")
+    assert verdict.burst_credits_remaining == pytest.approx(5.0)
+    enforcer.release(verdict)
+
+
+def test_burst_mode_off_without_both_knobs(tmp_path):
+    # Opt-in means BOTH knobs: credits without a refill rate (or vice
+    # versa) keeps the bucket out of the verdict entirely.
+    for kwargs in (
+        dict(quota_burst_credits=10.0),
+        dict(quota_refill_per_second=1.0),
+    ):
+        enforcer, ledger, clock = make_enforcer(tmp_path, **kwargs)
+        assert not enforcer.default_policy.burst_mode()
+        verdict = enforcer.admit("t")
+        assert verdict is None or verdict.burst_credits_remaining is None
+
+
+def test_burst_beside_hard_window(tmp_path):
+    """The bucket smooths WITHIN the window budget: a tenant with both
+    configured can be denied by either — the bucket on a fast burst, the
+    window on sustained consumption."""
+    enforcer, ledger, clock = make_enforcer(
+        tmp_path,
+        quota_chip_seconds_per_window=20.0,
+        quota_burst_credits=50.0,
+        quota_refill_per_second=100.0,
+        quota_window_seconds=60.0,
+    )
+    enforcer.release(enforcer.admit("t"))
+    ledger.add("t", chip_seconds=21.0)  # bucket fine (50), window blown (20)
+    clock.advance(0.1)
+    with pytest.raises(QuotaExceededError) as exc:
+        enforcer.admit("t")
+    assert exc.value.reason == "chip_seconds"
+
+
+def test_burst_credits_http_headers(tmp_path):
+    async def scenario():
+        clock = FakeClock()
+        config = make_config(
+            tmp_path,
+            quota_burst_credits=5.0,
+            quota_refill_per_second=0.5,
+            quota_window_seconds=3600.0,
+        )
+        ledger = UsageLedger(config, walltime=clock)
+        enforcer = QuotaEnforcer(config, usage=ledger, walltime=clock)
+        backend = FakeBackend()
+        executor = CodeExecutor(
+            backend, Storage(config.file_storage_path), config,
+            usage=ledger, quotas=enforcer,
+        )
+
+        async def fake_post_execute(client, base, payload, timeout, sandbox):
+            return {"stdout": "", "stderr": "", "exit_code": 0,
+                    "files": [], "warm": True}
+
+        executor._post_execute = fake_post_execute
+        app = create_http_app(
+            executor, CustomToolExecutor(executor),
+            Storage(config.file_storage_path),
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            # First request anchors the bucket (history predating it is
+            # the window budget's business, not the bucket's)...
+            resp = await client.post(
+                "/v1/execute",
+                json={"source_code": "print(1)", "tenant": "t-h"},
+            )
+            assert resp.status == 200
+            # ...then a 9 chip-second burn overdraws the 5-credit bucket.
+            ledger.add("t-h", chip_seconds=9.0)
+            clock.advance(0.1)
+            resp = await client.post(
+                "/v1/execute",
+                json={"source_code": "print(1)", "tenant": "t-h"},
+            )
+            assert resp.status == 429
+            assert resp.headers["X-Quota-Reason"] == "burst_credits"
+            assert float(resp.headers["X-Quota-Burst-Credits"]) == 0.0
+            assert "Retry-After" in resp.headers
+            body = await resp.json()
+            assert body["quota"]["burst_credits_remaining"] == 0.0
+        finally:
+            await client.close()
+            await executor.close()
+
+    asyncio.run(scenario())
