@@ -678,6 +678,24 @@ class Config(BaseModel):
     compile_cache_volume_source: dict = Field(
         default_factory=lambda: {"emptyDir": {}}
     )
+    # -- deterministic result memoization (services/result_memo.py) ----------
+    # Kill switch for the content-addressed pure-run result cache. 0 = exact
+    # pre-memo behavior byte-for-byte: no memo HTTP headers, no phases keys,
+    # no Storage/StateStore IO on any path.
+    result_memo_enabled: bool = True
+    # Where record blobs live (content-addressed objects in their own
+    # Storage — NOT the workspace-file store, since memo eviction deletes
+    # objects). Empty = a ".result-memo" dir beside the workspace-file
+    # objects under file_storage_path (dot-prefixed, outside OBJECT_ID_RE's
+    # namespace like storage's ".tmp" and the compile cache).
+    result_memo_store_path: str = ""
+    # Record-store bounds; past either, entries evict LRU-by-last-hit.
+    result_memo_max_bytes: int = 268435456
+    result_memo_max_entries: int = 8192
+    # Provenance-gated cross-tenant sharing: when on, control-plane-authored
+    # (trusted) pure runs record into a shared scope every tenant's lookups
+    # may hit. Tenant-authored runs always stay per-tenant keyed.
+    result_memo_shared: bool = False
     # libtpu gives one process exclusive chip access, so warm-JAX sandboxes
     # on one machine must be serialized: at most this many hold the local
     # TPU at once (local backend spawn lease; raise on multi-chip hosts

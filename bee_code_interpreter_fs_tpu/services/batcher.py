@@ -85,6 +85,12 @@ class BatchJob:
     # phases — the serial path reports queue_wait, so the fused path must
     # too, or batched requests look instantaneous on latency dashboards.
     submitted_at: float = 0.0
+    # The submitter declared purity (result-memo miss in flight): the
+    # dispatcher forwards the declaration per job so the executor echoes a
+    # hashed result block, and the serial fallback re-asserts it in each
+    # job's own task context. Carried on the job because the batcher's
+    # dispatch task does NOT inherit the submitter's contextvars.
+    pure: bool = False
 
     def resolve(self, result) -> None:
         if not self.future.done():

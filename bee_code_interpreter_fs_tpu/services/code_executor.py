@@ -70,6 +70,13 @@ from .leases import Lease, LeaseRegistry
 from .limits import VIOLATION_KINDS, request_limits, validate_config_limits
 from .perf_observer import PerfObserver
 from .quotas import QuotaEnforcer, QuotaVerdict
+from .result_memo import (
+    SHARED_SCOPE,
+    ResultMemoStore,
+    binary_key_of,
+    derive_key,
+    result_content_sha,
+)
 from .scheduler import SandboxScheduler
 from .state_store import StateStore, make_state_store, resolve_replica_id
 from .storage import Storage, StorageObjectNotFound
@@ -117,6 +124,16 @@ _auto_profile_var: contextvars.ContextVar[str | None] = contextvars.ContextVar(
     "perf_auto_profile_reason", default=None
 )
 
+# True while the running request DECLARED purity (no net, no randomness, no
+# wall-clock reads — the client's promise): _run_on_sandbox forwards the
+# declaration to the executor, which echoes it with a hashed result block
+# the memo-record path verifies end-to-end. A contextvar for the same
+# reason as the two above: the flag must ride the request's own task
+# through retry/batch/stream plumbing without widening every signature.
+_pure_run_var: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "result_memo_pure_run", default=False
+)
+
 
 def _drain(pool: deque) -> list:
     drained = []
@@ -146,6 +163,12 @@ class Result:
     # session (runner timeout-kill/crash); the next request starts fresh.
     session_seq: int = 0
     session_ended: bool = False
+    # Executor-verified purity echo (declared-pure memo-miss runs only):
+    # the result hash the executor computed over its response, re-derived
+    # and matched by the control plane from the same wire fields. None when
+    # the run didn't declare purity, an old binary didn't echo, or the
+    # hashes disagreed — nothing is recorded then (services/result_memo.py).
+    pure_echo: str | None = None
 
 
 @dataclass
@@ -394,9 +417,35 @@ class CodeExecutor:
         # GET /statusz. Optional — the executor runs fine without either.
         self.device_health = None
         self.otlp_exporter = None
+        # Deterministic result memoization (services/result_memo.py): a
+        # declared-pure run that completed limit-clean is recorded keyed on
+        # everything that could change its output, and a later identical
+        # request serves from the record at admission — no scheduler ticket,
+        # no sandbox round-trip, no chip-second billed. The index rides the
+        # state store above (coherent across replicas); the kill switch
+        # constructs a disabled store and every path is pre-memo
+        # byte-for-byte.
+        self.result_memo = ResultMemoStore.from_config(
+            self.config, self.state_store, self.storage, metrics=self.metrics
+        )
+        # The executor-binary component of every memo key, computed once: a
+        # binary upgrade changes the key and old records miss.
+        self._memo_binary_key = (
+            binary_key_of(
+                str(getattr(self.backend, "binary", "") or "")
+                or self.config.executor_binary,
+                self.config.executor_image,
+            )
+            if self.result_memo.enabled
+            else ""
+        )
         # One persistent client for all sandbox HTTP: connection pooling
         # keeps per-request TCP setup off the Execute path.
         self._client: httpx.AsyncClient | None = None
+        # Keep-alive reuse proof for the pooled client: ids of network
+        # streams already seen on a response — a repeat id is a dispatch
+        # that skipped TCP (+TLS) setup entirely.
+        self._seen_streams: set[int] = set()
         self.metrics.bind_pool(self._pools)
         self.metrics.bind_sessions(self._sessions)
         self.metrics.bind_breakers(self.breakers)
@@ -405,6 +454,27 @@ class CodeExecutor:
         self.metrics.bind_autoscale(self)
         self.metrics.bind_quotas(self.quotas)
         self.metrics.bind_perf(self.perf)
+        self.metrics.bind_result_memo(self.result_memo)
+
+    async def _count_stream_reuse(self, response) -> None:
+        """Response event hook on the shared client: count dispatches that
+        rode an already-established keep-alive connection. httpcore exposes
+        the underlying socket as the identity-stable `network_stream`
+        extension — a repeat id is a request that paid zero TCP setup.
+        Mock/fault transports lack the extension; the hook no-ops there."""
+        stream = response.extensions.get("network_stream")
+        if stream is None:
+            return
+        key = id(stream)
+        if key in self._seen_streams:
+            self.metrics.executor_connections_reused.inc()
+        else:
+            self._seen_streams.add(key)
+            # Bound the id set: a long-lived control plane churns sockets
+            # (pool expiry, sandbox turnover) and ids recycle with them.
+            if len(self._seen_streams) > 4096:
+                self._seen_streams.clear()
+                self._seen_streams.add(key)
 
     def _http_client(self) -> httpx.AsyncClient:
         if self._client is None or self._client.is_closed:
@@ -413,8 +483,24 @@ class CodeExecutor:
             # mid-execute connection-loss path); real backends supply none.
             transport_fn = getattr(self.backend, "http_transport", None)
             transport = transport_fn() if transport_fn is not None else None
+            # Explicit keep-alive pooling, tuned for the fleet shape: each
+            # sandbox host gets a persistent connection (the C++ server
+            # runs an HTTP/1.1 keep-alive loop), and the expiry comfortably
+            # outlives a pool-idle gap so sequential dispatches to one host
+            # reuse one TCP connection instead of re-handshaking
+            # (executor_connections_reused_total proves it).
+            limits = httpx.Limits(
+                max_connections=max(
+                    64, 4 * self.config.executor_pod_queue_target_length
+                ),
+                max_keepalive_connections=64,
+                keepalive_expiry=30.0,
+            )
             self._client = httpx.AsyncClient(
-                timeout=httpx.Timeout(30.0), transport=transport
+                timeout=httpx.Timeout(30.0),
+                transport=transport,
+                limits=limits,
+                event_hooks={"response": [self._count_stream_reuse]},
             )
         return self._client
 
@@ -1553,8 +1639,18 @@ class CodeExecutor:
         priority: str | None = None,
         deadline: float | None = None,
         limits: dict | None = None,
+        pure: bool = False,
     ) -> Result:
         """Run user code in a sandbox; returns output + changed files.
+
+        `pure=True` is the client's purity declaration — this run reads no
+        network, no randomness, no wall clock: its output is a function of
+        its inputs. Declared-pure runs ride the result memo
+        (services/result_memo.py): an identical earlier run serves from its
+        record at admission with zero sandbox HTTP and zero chip-seconds
+        billed; a miss executes normally and records for the next caller.
+        The declaration is a promise, not a sandbox restriction — a false
+        one only risks the declarer's own (tenant-scoped) repeat results.
 
         Exactly one of `source_code` (inline) / `source_file` (an absolute
         workspace path that must appear in `files`) is required. With
@@ -1593,6 +1689,33 @@ class CodeExecutor:
         quota = self._quota_admit(
             usage_tenant, chip_count=chip_count, timeout=timeout
         )
+        # Result-memo admission check: AFTER the quota gate (hits are still
+        # request-rate-governed — free answers are not unmetered answers)
+        # and BEFORE the auto-profile arm below (a served-from-record
+        # request must not eat the lane's one profiling arm).
+        memo_key, memo_state = self._memo_admission(
+            pure,
+            executor_id=executor_id,
+            profile=profile,
+            source_code=source_code,
+            source_file=source_file,
+            files=files,
+            env=env,
+            chip_count=chip_count,
+            tenant=tenant,
+            limits=limits,
+        )
+        if memo_state == "lookup":
+            record = await self.result_memo.lookup(memo_key)
+            if record is not None:
+                try:
+                    result = self._memo_hit_result(record)
+                    self._apply_quota_phases(result, quota)
+                    self._count_memo_hit(result, usage_tenant)
+                    return result
+                finally:
+                    self.quotas.release(quota)
+            memo_state = "miss"
         # Auto-triggered profiling: a pending arm on this request's lane
         # (set by the drift detector or a p99 outlier) is consumed here,
         # AFTER admission — a denied request must not eat the arm. The
@@ -1600,6 +1723,10 @@ class CodeExecutor:
         # the pipeline harvests (and zero-bills) the artifact.
         env, auto_profile = self._maybe_auto_profile(env, chip_count, tenant)
         profile_token = _auto_profile_var.set(auto_profile)
+        # The purity declaration rides the request's task tree only while a
+        # record could come of it (a miss): _run_on_sandbox forwards it to
+        # the executor for the hashed echo.
+        pure_token = _pure_run_var.set(memo_state == "miss")
         self._inflight += 1
         try:
             if executor_id is not None:
@@ -1669,6 +1796,8 @@ class CodeExecutor:
             self._inflight -= 1
             self.quotas.release(quota)
             _auto_profile_var.reset(profile_token)
+            _pure_run_var.reset(pure_token)
+        await self._memo_finish(memo_key, memo_state, result, auto_profile)
         self._apply_quota_phases(result, quota)
         self._count_execution(
             result,
@@ -1678,6 +1807,202 @@ class CodeExecutor:
             tenant=tenant,
         )
         return result
+
+    # ------------------------------------------------------ result memoization
+
+    def _memo_admission(
+        self,
+        pure: bool,
+        *,
+        executor_id: str | None,
+        profile: bool,
+        source_code: str | None,
+        source_file: str | None,
+        files: dict[str, str] | None,
+        env: dict[str, str] | None,
+        chip_count: int | None,
+        tenant: str | None,
+        limits: dict | None,
+    ) -> tuple:
+        """Classify one request for the memo check. Returns (key, state):
+        state None = memo not in play (purity undeclared, or the kill
+        switch — no phases keys, no header, no IO, byte-for-byte pre-memo);
+        "bypass" = declared pure but ineligible; "lookup" = eligible.
+
+        Sessions bypass (their whole point is state accumulating across
+        requests — the workspace is an input the key can't see); profiler
+        runs bypass (the artifact is a side effect keyed outside the
+        inputs). Key-derivation failures bypass too: the request's own
+        validation owns malformed inputs, never a memo error."""
+        if not pure or not self.result_memo.enabled:
+            return None, None
+        if executor_id is not None or profile or (
+            env and "APP_JAX_PROFILE" in env
+        ):
+            return None, "bypass"
+        try:
+            lane = self._lane_hint(chip_count)
+            # The EFFECTIVE limit box (defaults -> lane -> clamped request
+            # override), not the raw override: two requests whose limits
+            # resolve identically share output-determining state.
+            limits_payload = request_limits(self.config, lane, limits)
+            scope = (
+                SHARED_SCOPE
+                if self.result_memo.shared and _trusted_source_var.get()
+                else self.scheduler.normalize_tenant(tenant)
+            )
+            key = derive_key(
+                scope=scope,
+                source_code=source_code,
+                source_file=source_file,
+                files=files,
+                env=env,
+                limits=limits_payload,
+                lane=lane,
+                binary_key=self._memo_binary_key,
+            )
+        except (ValueError, TypeError):
+            return None, "bypass"
+        return key, "lookup"
+
+    def _memo_hit_result(self, record: dict) -> Result:
+        """Build this request's Result from a memo record. The request's
+        OWN attribution is zero (no device ran for it); what the recorded
+        run measured rides inside the memo block for clients comparing
+        cached-vs-live cost."""
+        phases: dict[str, float | str] = {
+            "chip_seconds": 0.0,
+            "device_op_seconds": 0.0,
+        }
+        trace_id = tracing.current_trace_id()
+        if trace_id is not None:
+            phases["trace_id"] = trace_id
+        memo_block: dict = {"state": "hit"}
+        recorded_phases = record.get("phases")
+        if isinstance(recorded_phases, dict):
+            memo_block["recorded"] = recorded_phases
+        phases["memo"] = memo_block
+        files = record.get("files")
+        return Result(
+            stdout=str(record.get("stdout", "")),
+            stderr=str(record.get("stderr", "")),
+            exit_code=int(record.get("exit_code", 0)),
+            files=(
+                {str(k): str(v) for k, v in files.items()}
+                if isinstance(files, dict)
+                else {}
+            ),
+            phases=phases,
+            warm=bool(record.get("warm", True)),
+            stdout_truncated=bool(record.get("stdout_truncated", False)),
+            stderr_truncated=bool(record.get("stderr_truncated", False)),
+        )
+
+    def _count_memo_hit(self, result: Result, usage_tenant: str | None) -> None:
+        """A memo hit is a LOGICAL request on every surface that counts
+        requests — and on none that counts device time: zero chip-seconds
+        on the ledger, no perf-baseline sample (nothing was measured; a
+        flood of 0-latency hits would poison the drift bands live traffic
+        is judged against), no latency-histogram phases."""
+        self.result_memo.hits += 1
+        self.metrics.result_memo_requests.inc(outcome="hit")
+        outcome = "ok" if result.exit_code == 0 else "user_error"
+        self.metrics.executions.inc(outcome=outcome)
+        self._usage_request(usage_tenant, outcome)
+
+    async def _memo_finish(
+        self,
+        memo_key,
+        memo_state: str | None,
+        result: Result,
+        auto_profile: str | None,
+    ) -> None:
+        """Post-run half of the memo protocol: record an eligible miss and
+        stamp the request's phases block. Never on the failure path —
+        violations and infra faults raised past this point, and a record
+        error degrades to an un-memoized success."""
+        if memo_state is None:
+            return
+        recorded = None
+        if memo_state == "miss":
+            self.result_memo.misses += 1
+            if auto_profile is not None:
+                # The run grew a control-plane profiler env mid-flight: its
+                # key no longer describes what executed.
+                recorded = "skipped_profile"
+            else:
+                recorded = await self._memo_record(memo_key, result)
+        block: dict = {"state": memo_state}
+        if recorded is not None:
+            block["recorded"] = recorded
+        result.phases["memo"] = block
+        self.metrics.result_memo_requests.inc(outcome=memo_state)
+
+    async def _memo_record(self, memo_key, result: Result) -> str:
+        """Admit one completed declared-pure run, when it proved eligible:
+        every host echoed the purity declaration and the executor's result
+        hash re-derived from the wire fields (result.pure_echo), with
+        nothing truncated (a truncation boundary is a limit artifact, not
+        program output). Returns the admit outcome string."""
+        if memo_key is None:
+            return "skipped"
+        if result.pure_echo is None:
+            return "skipped_echo"
+        if result.stdout_truncated or result.stderr_truncated:
+            return "skipped_truncated"
+        recorded_phases = {
+            k: round(float(v), 6)
+            for k, v in result.phases.items()
+            if isinstance(v, (int, float))
+        }
+        record = {
+            "stdout": result.stdout,
+            "stderr": result.stderr,
+            "exit_code": result.exit_code,
+            "files": dict(result.files),
+            "stdout_truncated": result.stdout_truncated,
+            "stderr_truncated": result.stderr_truncated,
+            "warm": result.warm,
+            "phases": recorded_phases,
+            # First-write-wins compares THIS: the canonical hash over the
+            # merged result (file values are content-addressed object ids,
+            # so file bytes are covered transitively).
+            "result_sha": result_content_sha(
+                result.stdout,
+                result.stderr,
+                result.exit_code,
+                sorted(result.files.values()),
+            ),
+        }
+        try:
+            return await self.result_memo.record(memo_key, record)
+        except Exception:  # noqa: BLE001 — recording never fails the request
+            logger.warning("result memo record failed", exc_info=True)
+            return "error"
+
+    @staticmethod
+    def _verified_pure_echo(bodies: list) -> str | None:
+        """End-to-end check of the executor's purity echo: every host
+        acknowledged the declaration, and the primary host's result hash
+        re-derives from the very wire fields the Result is built from.
+        None — record nothing — on any disagreement, including old
+        binaries that don't echo and manifests without content hashes."""
+        if not bodies or not all(body.get("pure") is True for body in bodies):
+            return None
+        primary = bodies[0]
+        wire_sha = primary.get("result_sha256")
+        if not isinstance(wire_sha, str):
+            return None
+        entries, has_hashes = parse_files_field(primary.get("files", []))
+        if entries and not has_hashes:
+            return None
+        expected = result_content_sha(
+            str(primary.get("stdout", "")),
+            str(primary.get("stderr", "")),
+            int(primary.get("exit_code", -1)),
+            [sha for _rel, sha in entries],
+        )
+        return wire_sha if wire_sha == expected else None
 
     def _lane_hint(self, chip_count: int | None) -> int:
         """The lane a request resolves to before validation (the perf
@@ -1982,6 +2307,9 @@ class CodeExecutor:
                 span.span_id if span is not None and span.recording else None
             ),
             submitted_at=time.perf_counter(),
+            # The dispatcher's task doesn't inherit this request's
+            # contextvars — the purity declaration rides the job.
+            pure=_pure_run_var.get(),
         )
         tracing.add_event(
             "batch.enqueue", lane=lane, pending=self.batcher.pending_jobs(key)
@@ -2079,6 +2407,10 @@ class CodeExecutor:
         limits = {k: v for k, v in key.limits} or None
 
         async def one(job: BatchJob) -> None:
+            # gather() wraps each coroutine in its own task (own context
+            # copy), so re-asserting the submitter's purity declaration
+            # here is job-isolated.
+            token = _pure_run_var.set(job.pure)
             try:
                 result = await self._execute_with_retry(
                     job.source_code,
@@ -2093,6 +2425,8 @@ class CodeExecutor:
                 job.fail(e)
             else:
                 job.resolve(result)
+            finally:
+                _pure_run_var.reset(token)
 
         await asyncio.gather(*(one(job) for job in jobs))
 
@@ -2130,6 +2464,7 @@ class CodeExecutor:
                 {
                     "source_code": job.source_code,
                     **({"trace_id": job.trace_id} if job.trace_id else {}),
+                    **({"pure": True} if job.pure else {}),
                     **(
                         {"device_index": device}
                         if device is not None
@@ -2495,6 +2830,12 @@ class CodeExecutor:
             warm=warm,
             stdout_truncated=bool(entry.get("stdout_truncated", False)),
             stderr_truncated=bool(entry.get("stderr_truncated", False)),
+            # Per-job purity echo: the entry hashes ITS OWN demuxed
+            # streams/files, so a batchmate's output can never leak into a
+            # recorded result unnoticed.
+            pure_echo=(
+                self._verified_pure_echo([entry]) if job.pure else None
+            ),
         )
 
     async def _execute_once(
@@ -2669,6 +3010,11 @@ class CodeExecutor:
                 # payload, and the runner's sampling cost, byte-for-byte
                 # what it is today.
                 payload["device_memory"] = True
+            if _pure_run_var.get():
+                # Purity declaration (result-memo miss in flight): the
+                # executor echoes it with a result hash the record path
+                # verifies end-to-end (see _verified_pure_echo).
+                payload["pure"] = True
             if env:
                 payload["env"] = env
             if limits:
@@ -2816,6 +3162,11 @@ class CodeExecutor:
             stdout_truncated=bool(primary.get("stdout_truncated", False)),
             stderr_truncated=any(
                 bool(b.get("stderr_truncated", False)) for b in bodies
+            ),
+            pure_echo=(
+                self._verified_pure_echo(bodies)
+                if _pure_run_var.get()
+                else None
             ),
         )
         return result, continuable
@@ -3071,6 +3422,7 @@ class CodeExecutor:
         priority: str | None = None,
         deadline: float | None = None,
         limits: dict | None = None,
+        pure: bool = False,
     ):
         """Streaming variant of execute(): an async generator yielding
         ``{"stream": "stdout"|"stderr", "data": str}`` events while the code
@@ -3079,6 +3431,11 @@ class CodeExecutor:
         Infra failures are NOT retried — output already streamed to the
         client cannot be un-streamed, so a silent retry would duplicate it;
         the error surfaces and the client decides (same policy as sessions).
+
+        A declared-pure (`pure=True`) hit serves the final result event
+        directly — the full stdout/stderr ride it, exactly as a live
+        stream's final event carries them; there is simply nothing to
+        stream incrementally because nothing runs.
         """
         env, executor_id = self._normalize_request(env, profile, executor_id)
         usage_tenant = self._usage_tenant(tenant)
@@ -3088,11 +3445,38 @@ class CodeExecutor:
         quota = self._quota_admit(
             usage_tenant, chip_count=chip_count, timeout=timeout
         )
+        # Result-memo admission, like execute(): after the quota gate,
+        # before the profile arm.
+        memo_key, memo_state = self._memo_admission(
+            pure,
+            executor_id=executor_id,
+            profile=profile,
+            source_code=source_code,
+            source_file=source_file,
+            files=files,
+            env=env,
+            chip_count=chip_count,
+            tenant=tenant,
+            limits=limits,
+        )
+        if memo_state == "lookup":
+            record = await self.result_memo.lookup(memo_key)
+            if record is not None:
+                try:
+                    result = self._memo_hit_result(record)
+                    self._apply_quota_phases(result, quota)
+                    self._count_memo_hit(result, usage_tenant)
+                finally:
+                    self.quotas.release(quota)
+                yield {"result": result}
+                return
+            memo_state = "miss"
         # Auto-profile arming, like execute() (post-admission). Set BEFORE
         # the run task is created: create_task snapshots the contextvars,
         # which is how the marker reaches the pipeline inside run().
         env, auto_profile = self._maybe_auto_profile(env, chip_count, tenant)
         profile_token = _auto_profile_var.set(auto_profile)
+        pure_token = _pure_run_var.set(memo_state == "miss")
         queue: asyncio.Queue = asyncio.Queue()
         done = object()
 
@@ -3170,6 +3554,8 @@ class CodeExecutor:
             self._inflight -= 1
             self.quotas.release(quota)
             _auto_profile_var.reset(profile_token)
+            _pure_run_var.reset(pure_token)
+        await self._memo_finish(memo_key, memo_state, result, auto_profile)
         self._apply_quota_phases(result, quota)
         self._count_execution(
             result,
@@ -4232,6 +4618,10 @@ class CodeExecutor:
     async def _download_file(
         self, client: httpx.AsyncClient, base: str, rel: str
     ) -> tuple[str, str, int]:
+        # Chunk-wise all the way: the executor serves the body via
+        # sendfile(2) (never buffering the file in ITS memory) and the
+        # control plane hashes it into Storage in bounded 1 MiB reads —
+        # a multi-GB artifact never materializes whole on either side.
         try:
             async with self.storage.writer() as writer:
                 async with client.stream("GET", f"{base}/workspace/{rel}") as resp:
@@ -4239,7 +4629,7 @@ class CodeExecutor:
                         raise ExecutorError(
                             f"download of {rel} failed: {resp.status_code}"
                         )
-                    async for chunk in resp.aiter_bytes():
+                    async for chunk in resp.aiter_bytes(1 << 20):
                         await writer.write(chunk)
         except httpx.HTTPError as e:
             raise ExecutorError(f"download of {rel} failed: {e}")

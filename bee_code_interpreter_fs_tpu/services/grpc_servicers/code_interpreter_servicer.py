@@ -132,6 +132,19 @@ class CodeInterpreterServicer:
         }
 
     @staticmethod
+    def _pure_from_metadata(metadata: dict) -> bool:
+        """Purity declaration for the result-memo path, carried as `x-pure`
+        invocation metadata — the transport-level analogue of the HTTP
+        surface's `pure` request field (the proto is frozen, so the flag
+        rides metadata like tenant/priority/limits do). Opt-in: anything
+        but an explicit true-ish value means the default, un-memoized
+        path."""
+        raw = metadata.get("x-pure")
+        if raw is None:
+            return False
+        return str(raw).strip().lower() in ("1", "true", "yes", "on")
+
+    @staticmethod
     async def _limits_from_metadata(
         context: grpc.aio.ServicerContext, metadata: dict
     ) -> dict | None:
@@ -167,13 +180,20 @@ class CodeInterpreterServicer:
         chip = result.phases.get("chip_seconds")
         device = result.phases.get("device_op_seconds")
         quota = result.phases.get("quota")
+        memo = result.phases.get("memo")
         if (
             not isinstance(chip, (int, float))
             and not isinstance(device, (int, float))
             and not isinstance(quota, dict)
+            and not isinstance(memo, dict)
         ):
             return
         extra = list(trailing)
+        if isinstance(memo, dict) and isinstance(memo.get("state"), str):
+            # Result-memo disposition (hit|miss|bypass) — the transport
+            # analogue of the HTTP X-Memo header. Absent entirely for
+            # non-pure requests and with the memo kill switch off.
+            extra.append(("x-memo", memo["state"]))
         if isinstance(chip, (int, float)):
             extra.append(("x-usage-chip-seconds", f"{float(chip):.6f}"))
         if isinstance(device, (int, float)):
@@ -380,6 +400,7 @@ class CodeInterpreterServicer:
                     profile=request.profile,
                     executor_id=request.executor_id or None,
                     limits=limits,
+                    pure=self._pure_from_metadata(metadata),
                     **admission,
                 )
             except ValueError as e:
@@ -442,6 +463,7 @@ class CodeInterpreterServicer:
                 profile=request.profile,
                 executor_id=request.executor_id or None,
                 limits=limits,
+                pure=self._pure_from_metadata(metadata),
                 **admission,
             )
             try:

@@ -43,6 +43,7 @@ from .custom_tool_executor import (
     CustomToolExecutor,
     CustomToolParseError,
 )
+from .perf_observer import summarize_profile
 from .storage import Storage, StorageObjectNotFound
 
 logger = logging.getLogger(__name__)
@@ -76,6 +77,12 @@ class ExecuteRequest(BaseModel):
     # fallback: X-Sandbox-Limits (a JSON object). Breaches return 422 with
     # the typed violation kind.
     limits: dict[str, float] | None = None
+    # Purity declaration (result memoization): this run reads no network,
+    # no randomness, no wall clock — its output is a function of its
+    # inputs. Declared-pure runs ride the content-addressed result memo:
+    # an identical earlier run answers from its record (X-Memo: hit) with
+    # zero chip-seconds billed. A promise, not a sandbox restriction.
+    pure: bool = False
 
 
 class ParseCustomToolRequest(BaseModel):
@@ -867,6 +874,37 @@ def create_http_app(
             body=data, content_type="application/zip", headers=headers
         )
 
+    @routes.get("/profiles/{profile_id}/summary")
+    async def get_profile_summary(request: web.Request) -> web.Response:
+        """An xprof verdict instead of a raw zip: top device ops, device-op
+        wall share, and the largest idle gaps, parsed from the profile's
+        trace-event JSON (services/perf_observer.py:summarize_profile).
+        Artifacts without a parseable trace degrade to a member listing."""
+        store = code_executor.perf.store
+        if not code_executor.perf.enabled or store is None:
+            return web.json_response(
+                {"error": "perf observer is disabled "
+                          "(APP_PERF_OBSERVER_ENABLED=0)"},
+                status=404,
+            )
+        profile_id = request.match_info["profile_id"]
+        if not OBJECT_ID_RE.match(profile_id):
+            return bad_request("invalid profile id")
+        found = store.get(profile_id)
+        if found is None:
+            return web.json_response(
+                {"error": f"no profile {profile_id!r} (evicted or never "
+                          "captured)"},
+                status=404,
+            )
+        data, meta = found
+        summary = summarize_profile(data)
+        body = {"id": profile_id, "meta": meta, **summary}
+        headers = {}
+        if meta.get("trace_id"):
+            headers["X-Trace-Id"] = str(meta["trace_id"])
+        return web.json_response(body, headers=headers)
+
     def validate_execute(req: ExecuteRequest) -> web.Response | None:
         """Shared /v1/execute + /v1/execute/stream pre-flight checks."""
         if (req.source_code is None) == (req.source_file is None):
@@ -1043,6 +1081,16 @@ def create_http_app(
         }
         return add_session_fields(body, result, req.executor_id)
 
+    def memo_header(result) -> dict[str, str]:
+        """The X-Memo response header: the memo verdict for declared-pure
+        requests (hit|miss|bypass, from the phases block the executor
+        stamped). No header when the run didn't declare purity or the memo
+        kill switch is off — pre-memo responses byte-for-byte."""
+        memo = result.phases.get("memo")
+        if isinstance(memo, dict) and isinstance(memo.get("state"), str):
+            return {"X-Memo": memo["state"]}
+        return {}
+
     @routes.post("/v1/execute")
     async def execute(request: web.Request) -> web.Response:
         req = await parse_model(request, ExecuteRequest)
@@ -1064,6 +1112,7 @@ def create_http_app(
                 profile=req.profile,
                 executor_id=req.executor_id,
                 limits=limits_param(request, req),
+                pure=req.pure,
                 **admission_params(request, req),
             )
         except ValueError as e:
@@ -1087,7 +1136,9 @@ def create_http_app(
         except (ExecutorError, SandboxSpawnError) as e:
             logger.exception("execute failed")
             return web.json_response({"error": str(e)}, status=502)
-        return web.json_response(result_body(result, req))
+        return web.json_response(
+            result_body(result, req), headers=memo_header(result)
+        )
 
     @routes.post("/v1/execute/stream")
     async def execute_stream(request: web.Request) -> web.StreamResponse:
@@ -1114,6 +1165,7 @@ def create_http_app(
             profile=req.profile,
             executor_id=req.executor_id,
             limits=limits_param(request, req),
+            pure=req.pure,
             **admission_params(request, req),
         )
         # Correlation headers must land BEFORE prepare() on a stream (the

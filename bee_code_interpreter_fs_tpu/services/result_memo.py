@@ -1,0 +1,438 @@
+"""Content-addressed deterministic result memoization.
+
+Tutorial and benchmark traffic at consumer scale is massively repetitive:
+the same snippet, the same input files, the same limits — re-executed on a
+chip that produces byte-identical output every time. A run that DECLARES
+purity (no net, no randomness, no wall-clock reads — the client's promise,
+echoed by the executor) and completed limit-clean is recorded here keyed by
+everything that could change its output:
+
+    (source sha256, input workspace-manifest sha, env key, limits key,
+     chip-count lane, executor binary key)
+
+and a later identical request is served from the record — no scheduler
+ticket, no sandbox round-trip, no chip-second billed. This is the only
+path to answers *faster* than the hardware.
+
+Discipline is the fleet compile cache's (services/compile_cache.py),
+applied verbatim:
+
+- **Bytes are content-addressed** in a dedicated ``Storage`` (NOT the
+  workspace-file store: eviction deletes objects, and sharing a store
+  would let a memo eviction delete a workspace file's bytes out from
+  under it). A record's output *files* stay in the workspace store —
+  already content-addressed — and the record holds their object ids; a
+  hit re-validates every referenced object before serving and demotes
+  itself to a miss if any byte is gone.
+- **The index rides ``StateStore``** (services/state_store.py), so memo
+  hits are coherent across scale-out replicas exactly like scheduler
+  grants and breaker verdicts: N replicas sharing one store share one
+  memo. The in-memory default keeps single-replica behavior self-contained.
+- **Per-tenant keying by default.** A tenant's recorded results serve only
+  that tenant. Cross-tenant sharing exists but is provenance-gated: only
+  control-plane-authored (trusted) runs may record into the shared scope,
+  and only when ``APP_RESULT_MEMO_SHARED=1`` opted in — the compile
+  cache's prewarm trust model.
+- **First-write-wins with conflict accounting.** Two concurrent misses on
+  one key admit the first record; a second record offering DIFFERENT
+  result bytes under the same key is rejected and counted — a
+  nondeterministic "pure" run at best, a poisoning attempt at worst.
+  ``result_memo_conflicts_total`` moving is an investigate signal.
+- **Kill switch** (``APP_RESULT_MEMO_ENABLED=0``): a disabled store does
+  no IO, creates no directories, serves nothing, records nothing, and the
+  executor stamps no phases keys — pre-memo behavior byte-for-byte.
+- **Admission-order durability**: the record blob is made durable in
+  Storage BEFORE the index entry is admitted, so a crash or wire drop
+  mid-store can never leave an index entry pointing at partial bytes —
+  the entry either serves a complete record or does not exist.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import time
+from dataclasses import dataclass
+from pathlib import Path
+
+from .storage import Storage, StorageObjectNotFound
+
+logger = logging.getLogger(__name__)
+
+# StateStore namespace the index rides (shared across PR 15 replicas).
+MEMO_NS = "result_memo"
+
+# Scope name for provenance-gated cross-tenant entries (never a valid
+# tenant name: the scheduler's tenant charset forbids the leading dot).
+SHARED_SCOPE = ".shared"
+
+# Record wire/blob format version: bump on any change to the record blob
+# or key derivation so stale entries miss instead of deserializing wrong.
+RECORD_VERSION = 1
+
+# Phases keys never recorded: per-request correlation/attribution state
+# that must be THIS request's, not the recorded run's.
+_EPHEMERAL_PHASES = frozenset({"trace_id", "quota", "memo"})
+
+
+def _sha(parts: list[str]) -> str:
+    h = hashlib.sha256()
+    for part in parts:
+        h.update(part.encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def manifest_sha(files: dict[str, str] | None) -> str:
+    """The input workspace-manifest sha: order-independent over
+    (path, object id). Storage object ids ARE content sha256es (PR 3),
+    so this keys the full input byte content without reading a byte."""
+    entries = sorted((files or {}).items())
+    return _sha([f"{path}={object_id}" for path, object_id in entries])
+
+
+def mapping_sha(mapping: dict | None) -> str:
+    """Order-independent key over a flat str->scalar mapping (env, limits)."""
+    entries = sorted((mapping or {}).items())
+    return _sha([f"{k}={v}" for k, v in entries])
+
+
+def result_content_sha(
+    stdout: str, stderr: str, exit_code: int, file_shas: list[str]
+) -> str:
+    """The canonical result hash — the same derivation the C++ executor
+    computes for its `result_sha256` echo (executor/server.cpp), so the
+    control plane can verify the wire block end-to-end before recording:
+    sha256 over stdout, stderr, the decimal exit code, and the sorted
+    changed-file content hashes, NUL-separated."""
+    return _sha([stdout, stderr, str(int(exit_code)), *sorted(file_shas)])
+
+
+def binary_key_of(executor_binary: str, executor_image: str) -> str:
+    """The executor-binary component of every memo key: the content sha of
+    the deployed binary when it is a readable local file (the local
+    backend), else the image reference (kubernetes — the tag names the
+    binary). Computed once per control-plane process; a binary upgrade
+    changes the key and every old entry misses, which is the point."""
+    path = executor_binary.strip()
+    if path:
+        try:
+            h = hashlib.sha256()
+            with open(path, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            return f"bin:{h.hexdigest()}"
+        except OSError:
+            pass
+    return f"img:{executor_image}"
+
+
+@dataclass(frozen=True)
+class MemoKey:
+    """One request's memo identity: `scope` partitions tenants (trust),
+    `digest` folds every output-determining input together."""
+
+    scope: str
+    digest: str
+
+    @property
+    def index_key(self) -> str:
+        return f"{self.scope}/{self.digest}"
+
+
+def derive_key(
+    *,
+    scope: str,
+    source_code: str | None,
+    source_file: str | None,
+    files: dict[str, str] | None,
+    env: dict[str, str] | None,
+    limits: dict | None,
+    lane: int,
+    binary_key: str,
+) -> MemoKey:
+    source = (
+        "code:" + hashlib.sha256((source_code or "").encode()).hexdigest()
+        if source_code is not None
+        else "file:" + (source_file or "")
+    )
+    digest = _sha(
+        [
+            f"v{RECORD_VERSION}",
+            source,
+            manifest_sha(files),
+            mapping_sha(env),
+            mapping_sha(limits),
+            f"lane:{int(lane)}",
+            binary_key,
+        ]
+    )
+    return MemoKey(scope=scope, digest=digest)
+
+
+class ResultMemoStore:
+    """The memo itself: a StateStore-indexed, Storage-backed record set.
+
+    Synchronous index bookkeeping (StateStore ops are dict/single-row
+    SQLite statements), async byte movement — the compile-cache split.
+    """
+
+    def __init__(
+        self,
+        store_path: str | os.PathLike,
+        state_store,
+        workspace_storage: Storage | None,
+        *,
+        enabled: bool = True,
+        shared: bool = False,
+        max_bytes: int = 256 << 20,
+        max_entries: int = 8192,
+        clock=time.time,
+        metrics=None,
+    ) -> None:
+        self.enabled = enabled
+        self.shared = shared
+        self.max_bytes = max(0, int(max_bytes))
+        self.max_entries = max(0, int(max_entries))
+        self.state = state_store
+        self.workspace_storage = workspace_storage
+        self._clock = clock
+        self.metrics = metrics
+        self.conflicts = 0
+        self.hits = 0
+        self.misses = 0
+        if not enabled:
+            # Kill switch: no directories, no state, every surface answers
+            # empty — pre-memo behavior byte-for-byte.
+            self.storage = None
+            return
+        self.path = Path(store_path)
+        self.path.mkdir(parents=True, exist_ok=True)
+        # Records live in their own Storage (NOT the workspace-file store):
+        # memo eviction deletes objects, and sharing a store would let an
+        # eviction delete a workspace file's bytes out from under it.
+        self.storage = Storage(self.path / "objects")
+
+    @classmethod
+    def from_config(
+        cls, config, state_store, workspace_storage, *, metrics=None
+    ) -> "ResultMemoStore":
+        path = config.result_memo_store_path or os.path.join(
+            config.file_storage_path, ".result-memo"
+        )
+        return cls(
+            path,
+            state_store,
+            workspace_storage,
+            enabled=config.result_memo_enabled,
+            shared=config.result_memo_shared,
+            max_bytes=config.result_memo_max_bytes,
+            max_entries=config.result_memo_max_entries,
+            metrics=metrics,
+        )
+
+    # ------------------------------------------------------------------ index
+
+    def entry_count(self) -> int:
+        if not self.enabled:
+            return 0
+        return len(self.state.items(MEMO_NS))
+
+    def total_bytes(self) -> int:
+        if not self.enabled:
+            return 0
+        return sum(
+            int(entry.get("size", 0))
+            for entry in self.state.items(MEMO_NS).values()
+            if isinstance(entry, dict)
+        )
+
+    def scopes_for(self, tenant_scope: str) -> list[str]:
+        """Lookup order: the tenant's own scope first, then (when sharing
+        is opted in) the provenance-gated shared scope."""
+        scopes = [tenant_scope]
+        if self.shared and tenant_scope != SHARED_SCOPE:
+            scopes.append(SHARED_SCOPE)
+        return scopes
+
+    # ----------------------------------------------------------------- lookup
+
+    async def lookup(self, key: MemoKey) -> dict | None:
+        """The admission-path check: index entry -> record blob -> file
+        validation. Any missing byte demotes to a miss and self-heals the
+        index (the ProfileStore's stale-pointer rule). Never raises."""
+        if not self.enabled:
+            return None
+        for scope in self.scopes_for(key.scope):
+            index_key = f"{scope}/{key.digest}"
+            entry = self.state.get(MEMO_NS, index_key)
+            if not isinstance(entry, dict):
+                continue
+            record = await self._load_record(index_key, entry)
+            if record is not None:
+                self._touch(index_key)
+                return record
+        return None
+
+    async def _load_record(self, index_key: str, entry: dict) -> dict | None:
+        object_id = entry.get("record")
+        if not isinstance(object_id, str):
+            self.state.delete(MEMO_NS, index_key)
+            return None
+        try:
+            blob = await self.storage.read(object_id)
+            record = json.loads(blob)
+        except (StorageObjectNotFound, OSError, ValueError):
+            # Stale pointer (evicted/corrupt bytes under a live index row,
+            # e.g. a replica's eviction racing this lookup): self-heal.
+            self.state.delete(MEMO_NS, index_key)
+            return None
+        if (
+            not isinstance(record, dict)
+            or record.get("version") != RECORD_VERSION
+        ):
+            self.state.delete(MEMO_NS, index_key)
+            return None
+        # Output files live in the workspace store; a hit must never hand
+        # the client object ids whose bytes are gone.
+        files = record.get("files")
+        if isinstance(files, dict) and self.workspace_storage is not None:
+            for object_id in files.values():
+                try:
+                    if not await self.workspace_storage.exists(str(object_id)):
+                        self.state.delete(MEMO_NS, index_key)
+                        return None
+                except (OSError, ValueError):
+                    self.state.delete(MEMO_NS, index_key)
+                    return None
+        return record
+
+    def _touch(self, index_key: str) -> None:
+        now = self._clock()
+
+        def bump(entry):
+            if not isinstance(entry, dict):
+                return entry, None
+            entry = dict(entry)
+            entry["hits"] = int(entry.get("hits", 0)) + 1
+            entry["last_hit"] = round(now, 3)
+            return entry, None
+
+        try:
+            self.state.mutate(MEMO_NS, index_key, bump)
+        except Exception:  # noqa: BLE001 — recency is advisory
+            logger.debug("memo touch failed", exc_info=True)
+
+    # ----------------------------------------------------------------- record
+
+    async def record(self, key: MemoKey, record: dict) -> str:
+        """Admit one limit-clean pure run. Returns the outcome:
+        ``admitted`` | ``exists`` (identical bytes already mapped) |
+        ``conflict`` (different bytes under the key — first write wins) |
+        ``error`` (bytes could not be made durable; nothing admitted).
+
+        Durability order is the chaos-leg invariant: the record blob is
+        written content-addressed (tmp + fsync + rename inside Storage)
+        BEFORE the index mutate — a wire drop or crash mid-store leaves
+        at worst an orphan object, never an index entry serving partial
+        results."""
+        if not self.enabled:
+            return "error"
+        record = dict(record)
+        record["version"] = RECORD_VERSION
+        record["created"] = round(self._clock(), 3)
+        result_sha = record.get("result_sha", "")
+        try:
+            blob = json.dumps(record, sort_keys=True).encode()
+            object_id = await self.storage.write(blob)
+        except (OSError, ValueError):
+            logger.warning("result memo record write failed", exc_info=True)
+            return "error"
+
+        index_key = key.index_key
+        size = len(blob)
+        now = round(self._clock(), 3)
+
+        def admit(existing):
+            if isinstance(existing, dict):
+                if existing.get("result_sha") == result_sha:
+                    return existing, "exists"
+                # First-write-wins: the key already maps DIFFERENT bytes.
+                return existing, "conflict"
+            entry = {
+                "record": object_id,
+                "result_sha": result_sha,
+                "size": size,
+                "created": now,
+                "last_hit": now,
+                "hits": 0,
+            }
+            return entry, "admitted"
+
+        try:
+            outcome = self.state.mutate(MEMO_NS, index_key, admit)
+        except Exception:  # noqa: BLE001
+            logger.warning("result memo index admit failed", exc_info=True)
+            return "error"
+        if outcome == "conflict":
+            self.conflicts += 1
+            if self.metrics is not None:
+                self.metrics.result_memo_conflicts.inc()
+            logger.warning(
+                "result memo conflict on %s: a declared-pure run produced "
+                "different bytes than the recorded first write "
+                "(nondeterministic source, or poisoning) — keeping the "
+                "first record",
+                index_key,
+            )
+        if outcome == "admitted":
+            await self._evict()
+        return outcome
+
+    async def _evict(self) -> None:
+        """LRU-by-last-hit eviction under both caps (compile-cache rule).
+        Index first, bytes second: a concurrent replica's lookup either
+        sees the entry (and may win the read race against the delete —
+        content-addressed objects are immutable, so it serves correctly)
+        or misses cleanly."""
+        if not self.enabled or (not self.max_bytes and not self.max_entries):
+            return
+        while True:
+            items = {
+                k: v
+                for k, v in self.state.items(MEMO_NS).items()
+                if isinstance(v, dict)
+            }
+            over_entries = self.max_entries and len(items) > self.max_entries
+            over_bytes = self.max_bytes and (
+                sum(int(v.get("size", 0)) for v in items.values())
+                > self.max_bytes
+            )
+            if not items or not (over_entries or over_bytes):
+                return
+            victim = min(
+                items, key=lambda k: items[k].get("last_hit", 0.0)
+            )
+            object_id = items[victim].get("record")
+            self.state.delete(MEMO_NS, victim)
+            if isinstance(object_id, str):
+                try:
+                    await self.storage.delete(object_id)
+                except (StorageObjectNotFound, OSError):
+                    pass
+
+    def snapshot(self) -> dict:
+        """Operator view (GET /statusz companion data)."""
+        if not self.enabled:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            "shared": self.shared,
+            "entries": self.entry_count(),
+            "bytes": self.total_bytes(),
+            "hits": self.hits,
+            "misses": self.misses,
+            "conflicts": self.conflicts,
+        }

@@ -464,6 +464,33 @@ class ExecutorMetrics:
             "kernel, miss = had to compile).",
             ("outcome",),
         )
+        # Result-memo observability (services/result_memo.py): request
+        # outcomes on the memo admission check (hit = served without a
+        # sandbox round-trip, miss = executed then recorded, bypass =
+        # ineligible), plus the compile-cache-style first-write-wins
+        # conflict counter and the keep-alive reuse proof for the shared
+        # executor HTTP client.
+        self.result_memo_requests = self.registry.counter(
+            "code_interpreter_result_memo_requests_total",
+            "Pure-declared execute requests by memo outcome (hit = served "
+            "from the record with zero sandbox HTTP and zero chip-seconds; "
+            "miss = executed and recorded; bypass = declared pure but "
+            "ineligible, e.g. session or profiling runs).",
+            ("outcome",),
+        )
+        self.result_memo_conflicts = self.registry.counter(
+            "code_interpreter_result_memo_conflicts_total",
+            "Declared-pure runs offering DIFFERENT result bytes under a "
+            "memo key the store already maps (first-write-wins rejection): "
+            "a nondeterministic 'pure' run at best, a poisoning attempt at "
+            "worst — investigate if this moves.",
+        )
+        self.executor_connections_reused = self.registry.counter(
+            "executor_connections_reused_total",
+            "Executor HTTP dispatches served over an already-established "
+            "keep-alive connection in the shared client pool (vs opening "
+            "a fresh TCP connection).",
+        )
         # Tracing's per-stage latency feed: every sampled span's duration,
         # labeled by span name (a bounded set — http/grpc entry, scheduler
         # wait, transfer phases, executor call, sandbox install/exec/
@@ -832,6 +859,23 @@ class ExecutorMetrics:
         self.compile_cache_store = self.registry.gauge(
             "code_interpreter_compile_cache_store",
             "Fleet compile-cache hot set size, by stat (entries/bytes).",
+            ("stat",),
+            callback=sample,
+        )
+
+    def bind_result_memo(self, store) -> None:
+        """Expose the result-memo record set's size, read at scrape time
+        (entries + bytes; both 0 with the kill switch on)."""
+
+        def sample() -> dict[tuple[str, ...], float]:
+            return {
+                ("entries",): float(store.entry_count()),
+                ("bytes",): float(store.total_bytes()),
+            }
+
+        self.result_memo_store = self.registry.gauge(
+            "code_interpreter_result_memo_store",
+            "Result-memo record set size, by stat (entries/bytes).",
             ("stat",),
             callback=sample,
         )

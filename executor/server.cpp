@@ -43,6 +43,7 @@
 #include <sys/wait.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdarg>
@@ -1756,6 +1757,28 @@ bool reject_stale_lease(const minihttp::Request& req, minihttp::Conn& conn) {
   return true;
 }
 
+// The canonical result hash for declared-pure runs: sha256 over stdout,
+// stderr, the decimal exit code, and the SORTED changed-file content
+// hashes, each part NUL-terminated. The control plane re-derives this from
+// the very wire fields it received (result_content_sha in
+// services/result_memo.py) and records nothing on a mismatch — the memo's
+// end-to-end integrity check.
+std::string pure_result_sha256(const std::string& out_s,
+                               const std::string& err_s, int exit_code,
+                               std::vector<std::string> file_shas) {
+  std::sort(file_shas.begin(), file_shas.end());
+  minisha::Sha256 h;
+  auto part = [&h](const std::string& s) {
+    h.update(s.data(), s.size());
+    h.update("\0", 1);
+  };
+  part(out_s);
+  part(err_s);
+  part(std::to_string(exit_code));
+  for (const auto& sha : file_shas) part(sha);
+  return h.hex();
+}
+
 void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
                          bool streaming) {
   // Lease fencing FIRST: a stale claim must be refused before the body is
@@ -1792,6 +1815,10 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
   // requests that ASK get the runner bracket and the reply block, so the
   // control-plane kill switch keeps the wire byte-for-byte.
   bool want_device_memory = parsed.get_bool("device_memory", false);
+  // Purity declaration (the control plane's result memo): echoed back with
+  // a hashed result block so a record is verifiable end-to-end. Absent
+  // unless declared — the memo kill switch keeps the wire byte-for-byte.
+  bool declared_pure = parsed.get_bool("pure", false);
   const minijson::Value& extra_env = parsed.get("env");
   // Per-request resource budget, tighten-only against the APP_LIMIT_* caps.
   // Output is special-cased: the implicit server cap (APP_MAX_OUTPUT_BYTES)
@@ -2033,6 +2060,7 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
 
   minijson::Array files;
   minijson::Array deleted;
+  std::vector<std::string> changed_file_shas;
   if (g_state.manifest_enabled) {
     // Changed files carry their content sha so the control plane can skip
     // downloading bytes its content-addressed storage already holds. The
@@ -2056,6 +2084,7 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
       if (hash_workspace_file(g_state.workspace, rel, hex, &sig)) {
         g_ws_manifest[rel] = ManifestEntry{hex, sig};
         entry["sha256"] = minijson::Value(hex);
+        changed_file_shas.push_back(hex);
       }
       // Hash failure = the file vanished between scan and hash; the entry
       // still reports the path (sans sha) and the download path surfaces
@@ -2145,6 +2174,11 @@ void handle_execute_impl(const minihttp::Request& req, minihttp::Conn& conn,
   // control plane uses this to end executor_id sessions, whose contract is
   // that the process persists across requests.
   resp["runner_restarted"] = minijson::Value(restart_runner);
+  if (declared_pure) {
+    resp["pure"] = minijson::Value(true);
+    resp["result_sha256"] = minijson::Value(
+        pure_result_sha256(out_s, err_s, exit_code, changed_file_shas));
+  }
   if (!streaming) {
     conn.send_response(200, "application/json", minijson::Value(resp).dump());
   } else {
@@ -2485,6 +2519,7 @@ void handle_execute_batch(const minihttp::Request& req, minihttp::Conn& conn) {
     // fresh for this batch), reported RELATIVE to it so the control plane
     // can demux each caller's files to the paths its code wrote.
     minijson::Array files;
+    std::vector<std::string> job_file_shas;
     std::map<std::string, FileSig> job_files;
     scan_dir(g_state.workspace + "/" + job_rels[i], "", job_files);
     for (const auto& [rel, sig] : job_files) {
@@ -2498,11 +2533,20 @@ void handle_execute_batch(const minihttp::Request& req, minihttp::Conn& conn) {
           std::lock_guard<std::mutex> mlock(g_ws_manifest_mutex);
           g_ws_manifest[full_rel] = ManifestEntry{hex, hashed};
           fe["sha256"] = minijson::Value(hex);
+          job_file_shas.push_back(hex);
         }
       }
       files.push_back(minijson::Value(fe));
     }
     entry["files"] = minijson::Value(files);
+    if (jobs[i].get_bool("pure", false)) {
+      // Per-job purity echo, hashed over THIS entry's demuxed streams and
+      // files — a batchmate's output can never slip into a recorded
+      // result unnoticed.
+      entry["pure"] = minijson::Value(true);
+      entry["result_sha256"] = minijson::Value(
+          pure_result_sha256(out_s, err_s, exit_code, job_file_shas));
+    }
     results.push_back(minijson::Value(entry));
     if (!traceparent.empty()) {
       minijson::Object s;

@@ -596,3 +596,75 @@ def test_failed_store_write_keeps_artifact_in_tenant_files():
         assert row["download_bytes"] == float(len(profile_bytes))
 
     asyncio.run(run())
+
+
+# ------------------------------------------------------- xprof summarization
+
+
+def _trace_zip(events, member="plugins/profile/run/host.trace.json.gz"):
+    import gzip
+    import io
+    import json
+    import zipfile
+
+    payload = json.dumps({"traceEvents": events}).encode()
+    if member.endswith(".gz"):
+        payload = gzip.compress(payload)
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as archive:
+        archive.writestr(member, payload)
+    return buf.getvalue()
+
+
+def test_summarize_profile_verdict_top_ops_share_and_gaps():
+    from bee_code_interpreter_fs_tpu.services.perf_observer import (
+        summarize_profile,
+    )
+
+    events = [
+        # Process metadata: pid 1 is the device, pid 2 the host runtime.
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "process_name", "pid": 2,
+         "args": {"name": "python host"}},
+        # Device ops: 0-4000us busy, a 2000us idle gap, 6000-10000us busy.
+        {"ph": "X", "pid": 1, "name": "fusion.3", "ts": 0, "dur": 4000},
+        {"ph": "X", "pid": 1, "name": "copy.1", "ts": 6000, "dur": 1000},
+        {"ph": "X", "pid": 1, "name": "fusion.3", "ts": 7000, "dur": 3000},
+        # Host-side event: never counted as device time.
+        {"ph": "X", "pid": 2, "name": "python busywork", "ts": 0,
+         "dur": 10000},
+    ]
+    summary = summarize_profile(_trace_zip(events))
+    assert summary["span_ms"] == 10.0
+    assert summary["device_busy_ms"] == 8.0
+    assert summary["device_op_wall_share"] == 0.8
+    # Top op by total device time, with its share of op time.
+    assert summary["top_ops"][0]["name"] == "fusion.3"
+    assert summary["top_ops"][0]["total_ms"] == 7.0
+    assert summary["top_ops"][0]["count"] == 2
+    assert "python busywork" not in [op["name"] for op in summary["top_ops"]]
+    # The idle gap between the two busy stretches.
+    assert summary["idle_gaps"] == [
+        {"offset_ms": 4.0, "duration_ms": 2.0}
+    ]
+    assert "device busy 80%" in summary["verdict"]
+    assert "fusion.3" in summary["verdict"]
+
+
+def test_summarize_profile_degrades_without_a_trace_member():
+    import io
+    import zipfile
+
+    from bee_code_interpreter_fs_tpu.services.perf_observer import (
+        summarize_profile,
+    )
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as archive:
+        archive.writestr("plugins/profile/run/host.xplane.pb", b"\x00\x01")
+    summary = summarize_profile(buf.getvalue())
+    assert summary["verdict"] == "unparseable"
+    assert "host.xplane.pb" in summary["members"][0]
+    # And a corrupt artifact is a verdict, never an exception.
+    assert summarize_profile(b"not a zip")["verdict"] == "unparseable"

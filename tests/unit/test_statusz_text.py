@@ -428,6 +428,45 @@ async def test_perf_and_profiles_routes_end_to_end(tmp_path):
         assert resp.status == 404
         resp = await client.get("/profiles/..evil")
         assert resp.status == 400
+        # The xprof summary verdict route: a real trace-event member gets
+        # parsed; the zip-less artifacts above degrade to "unparseable".
+        import gzip
+        import io
+        import json
+        import zipfile
+
+        payload = json.dumps(
+            {
+                "traceEvents": [
+                    {"ph": "M", "name": "process_name", "pid": 1,
+                     "args": {"name": "/device:TPU:0"}},
+                    {"ph": "X", "pid": 1, "name": "fusion.1",
+                     "ts": 0, "dur": 500},
+                ]
+            }
+        ).encode()
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as archive:
+            archive.writestr(
+                "plugins/profile/r/h.trace.json.gz", gzip.compress(payload)
+            )
+        traced = executor.perf.store.add(
+            buf.getvalue(), {"lane": 0, "reason": "p99_outlier:exec",
+                             "trace_id": "f" * 32}
+        )
+        resp = await client.get(f"/profiles/{traced}/summary")
+        assert resp.status == 200
+        assert resp.headers["X-Trace-Id"] == "f" * 32
+        body = await resp.json()
+        assert body["id"] == traced
+        assert body["top_ops"][0]["name"] == "fusion.1"
+        assert body["device_op_wall_share"] == 1.0
+        assert body["meta"]["reason"] == "p99_outlier:exec"
+        resp = await client.get(f"/profiles/{target}/summary")
+        assert resp.status == 200
+        assert (await resp.json())["verdict"] == "unparseable"
+        assert (await client.get("/profiles/" + "0" * 32 + "/summary")).status == 404
+        assert (await client.get("/profiles/..evil/summary")).status == 400
     finally:
         await client.close()
         await executor.close()
@@ -451,6 +490,9 @@ async def test_perf_routes_404_with_kill_switch(tmp_path):
         assert (await client.get("/perf")).status == 404
         assert (await client.get("/profiles")).status == 404
         assert (await client.get("/profiles/" + "a" * 32)).status == 404
+        assert (
+            await client.get("/profiles/" + "a" * 32 + "/summary")
+        ).status == 404
         # And statusz renders the disabled posture, text included.
         resp = await client.get("/statusz", params={"format": "text"})
         assert "perf observer: disabled" in await resp.text()
