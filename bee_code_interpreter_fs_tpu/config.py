@@ -696,6 +696,37 @@ class Config(BaseModel):
     # (trusted) pure runs record into a shared scope every tenant's lookups
     # may hit. Tenant-authored runs always stay per-tenant keyed.
     result_memo_shared: bool = False
+    # -- session durability (services/session_store.py) ----------------------
+    # Kill switch for the session checkpoint/hibernate/restore/migrate
+    # plane. 0 = today's pin-forever session semantics byte-for-byte: no
+    # hibernate timer, no snapshot ops on any path, no store directories,
+    # fence/idle-expiry destroy session state exactly as before.
+    session_durability_enabled: bool = True
+    # A parked session idle longer than this is HIBERNATED: interpreter
+    # state + workspace manifest checkpointed to the session store, the
+    # sandbox disposed, the chip released back through _session_held
+    # accounting (the autoscaler sees reclaimed supply). The session's next
+    # turn restores lazily onto a fresh sandbox (phases.restore reports the
+    # cost). Kept below executor_session_idle_timeout on purpose — with
+    # durability on, idle expiry hibernates instead of destroying. 0
+    # disables the timer (sessions still migrate off fenced hosts).
+    session_hibernate_idle_seconds: float = 45.0
+    # Where interpreter-state blobs live (content-addressed objects in
+    # their own Storage — NOT the workspace-file store, since record
+    # eviction deletes objects). Empty = a ".session-store" dir under
+    # file_storage_path (dot-prefixed, outside OBJECT_ID_RE's namespace).
+    session_store_path: str = ""
+    # A checkpoint nobody restored within this window is dropped (the
+    # client is gone; holding its state forever is a leak, not a feature).
+    session_record_ttl: float = 3600.0
+    # Record-index bound; past it, oldest-saved records evict first.
+    session_store_max_entries: int = 4096
+    # Ceiling on one serialized interpreter state (the runner refuses
+    # larger snapshots; the session then stays live until idle close —
+    # honest degradation, never a truncated checkpoint).
+    session_snapshot_max_bytes: int = 67108864
+    # Runner round-trip budget for the snapshot/restore ops themselves.
+    session_snapshot_timeout: float = 30.0
     # libtpu gives one process exclusive chip access, so warm-JAX sandboxes
     # on one machine must be serialized: at most this many hold the local
     # TPU at once (local backend spawn lease; raise on multi-chip hosts

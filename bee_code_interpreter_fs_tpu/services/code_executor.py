@@ -64,6 +64,7 @@ from .errors import (  # noqa: F401 — canonical home is errors.py; re-exported
     QueueDepthError,
     QuotaExceededError,
     SessionLimitError,
+    SessionRestoringError,
     StaleLeaseError,
 )
 from .leases import Lease, LeaseRegistry
@@ -78,6 +79,7 @@ from .result_memo import (
     result_content_sha,
 )
 from .scheduler import SandboxScheduler
+from .session_store import SessionStore
 from .state_store import StateStore, make_state_store, resolve_replica_id
 from .storage import Storage, StorageObjectNotFound
 from .transfer import (
@@ -100,7 +102,9 @@ logger = logging.getLogger(__name__)
 # histogram until someone notices; a new latency phase must be added here
 # deliberately (and the regression test in test_usage.py will catch a
 # histogram observing anything else).
-LATENCY_PHASES = frozenset({"queue_wait", "upload", "exec", "download"})
+LATENCY_PHASES = frozenset(
+    {"queue_wait", "upload", "exec", "download", "restore"}
+)
 
 # True only inside _execute_trusted (the compile-cache pre-warm): the running
 # request's source is control-plane-authored, so it does NOT taint its
@@ -189,6 +193,16 @@ class _Session:
     last_used: float = 0.0
     closed: bool = False
     seq: int = 0  # requests served (exposed as Result.session_seq)
+    # Session durability plane (services/session_store.py): the tenant the
+    # session was opened under (checkpoint key scope), the durable record
+    # awaiting lazy restore on the first turn after a wake (None once
+    # applied), whether that restore is in flight RIGHT NOW (a second turn
+    # then sheds with the typed 409 instead of racing a double-restore),
+    # and the sweep's idle-chip-seconds accounting watermark.
+    tenant: str | None = None
+    pending_restore: dict | None = None
+    restoring: bool = False
+    idle_accounted: float = 0.0
 
 
 class CodeExecutor:
@@ -428,6 +442,23 @@ class CodeExecutor:
         self.result_memo = ResultMemoStore.from_config(
             self.config, self.state_store, self.storage, metrics=self.metrics
         )
+        # Session durability plane (services/session_store.py): idle
+        # sessions checkpoint (interpreter state + workspace manifest) into
+        # this store, dispose their sandbox, and release the chip through
+        # _session_held — the autoscaler sees reclaimed supply — then
+        # restore lazily on their next turn, session_seq continuous. The
+        # same path migrates live sessions off fenced hosts. The index
+        # rides the state store (a session hibernated behind replica A
+        # restores behind replica B); the kill switch constructs a
+        # disabled store and every session path is pre-durability
+        # byte-for-byte (pin-forever semantics).
+        self.session_store = SessionStore.from_config(
+            self.config, self.state_store, self.storage, metrics=self.metrics
+        )
+        # Satellite observability: cumulative parked-idle chip-seconds the
+        # sweeper has accounted (the reclaimed-supply justification metric,
+        # also a statusz field).
+        self._idle_chip_seconds = 0.0
         # The executor-binary component of every memo key, computed once: a
         # binary upgrade changes the key and old records miss.
         self._memo_binary_key = (
@@ -1225,19 +1256,53 @@ class CodeExecutor:
                 pool.remove(sandbox)
             except ValueError:
                 pass
-        # ...and close any session parked on this host NOW, not when the
-        # client times out: the session's next request recreates against a
-        # healthy host (session_seq=1 reports the state loss), instead of
-        # dispatching into the wedge and hanging out its timeout.
+        # ...and get every session parked on this host OFF it NOW, not when
+        # the client times out. With the durability plane live, each
+        # session is MIGRATED: snapshot-then-restore-elsewhere — awaited
+        # INLINE, before the dispose below kills the host — so its next
+        # turn restores behind any replica with session_seq continuous and
+        # zero client-visible state loss. A migration that cannot complete
+        # (snapshot refused, lock held past the budget, durability off)
+        # falls back to the pre-durability force-close: the session's next
+        # request recreates against a healthy host (session_seq=1 reports
+        # the state loss), instead of dispatching into the wedge and
+        # hanging out its timeout. Snapshot traffic against the fenced
+        # host is fine: the server-side lease token is still the one it
+        # holds — only NEW claims at a successor die typed.
         for executor_id, session in list(self._sessions.items()):
-            if session.sandbox is sandbox and not session.closed:
+            if session.sandbox is not sandbox or session.closed:
+                continue
+            migrated = False
+            if self.session_store.enabled:
+                try:
+                    migrated = await self._migrate_session(
+                        executor_id, session, reason
+                    )
+                except Exception:  # noqa: BLE001 — fall back to force-close
+                    logger.warning(
+                        "session %s migration off fenced host %s failed",
+                        executor_id,
+                        sandbox.id,
+                        exc_info=True,
+                    )
+            if migrated:
                 logger.warning(
-                    "session %s force-closed: its host %s was fenced (%s)",
+                    "session %s migrated off fenced host %s (%s): state "
+                    "checkpointed, restores on next turn",
                     executor_id,
                     sandbox.id,
                     reason,
                 )
-                self._end_session_soon(executor_id, session, recycle=False)
+                continue
+            if session.closed:
+                continue
+            logger.warning(
+                "session %s force-closed: its host %s was fenced (%s)",
+                executor_id,
+                sandbox.id,
+                reason,
+            )
+            self._end_session_soon(executor_id, session, recycle=False)
         self.metrics.device_fences.inc(lane=str(lane), outcome="fenced")
         self.tracer.record_span(
             "device_fence",
@@ -3735,6 +3800,32 @@ class CodeExecutor:
                     )
                 assert session.sandbox is not None
                 session.last_used = loop.time()
+                if session.pending_restore is not None:
+                    # First turn after a hibernate/migrate: rehydrate the
+                    # fresh sandbox from the durable checkpoint before the
+                    # user code runs. A wire failure mid-restore raises
+                    # ExecutorError below — the session closes and the
+                    # RECORD SURVIVES (blob intact), so the retry restores
+                    # again; a half-restored sandbox is never served.
+                    try:
+                        with timer.phase("restore"):
+                            restored = await self._restore_session(
+                                executor_id, session
+                            )
+                    except (ExecutorError, SandboxSpawnError):
+                        self._end_session_soon(executor_id, session, recycle=False)
+                        raise
+                    except asyncio.CancelledError:
+                        self._end_session_soon(executor_id, session, recycle=False)
+                        raise
+                    if not restored:
+                        # Clean refusal (version skew / corrupt state): the
+                        # record is already evicted — close this sandbox
+                        # (its workspace may hold the partial upload) and
+                        # recreate GENUINELY fresh: the turn still succeeds,
+                        # with an honest session_seq=1 reporting state loss.
+                        await self._end_session(executor_id, session, recycle=True)
+                        continue
                 try:
                     result, continuable = await self._run_on_sandbox(
                         session.sandbox,
@@ -3799,6 +3890,18 @@ class CodeExecutor:
         while True:
             session = self._sessions.get(executor_id)
             if session is not None:
+                if session.restoring:
+                    # The session is mid-restore from its checkpoint: one
+                    # turn owns the restore; a second admitted now would
+                    # race a double-restore into the same sandbox. Typed,
+                    # retryable, NOT session-ending — HTTP 409 +
+                    # Retry-After / gRPC UNAVAILABLE + x-session-restoring.
+                    raise SessionRestoringError(
+                        f"session {executor_id} is restoring from its "
+                        "durable checkpoint; retry shortly",
+                        executor_id=executor_id,
+                        retry_after=1.0,
+                    )
                 if session.sandbox is None and not session.closed:
                     await asyncio.shield(session.ready)
                 if session.closed:
@@ -3813,7 +3916,21 @@ class CodeExecutor:
                     f"({active}/{self.config.executor_session_max}); retry "
                     "later or close one via DELETE /v1/executors/{id}"
                 )
+            # A hibernated checkpoint wakes here: the durable record
+            # (replica-coherent — a peer may have written it) pins the
+            # session's lane and starting seq, and the record itself rides
+            # the new session as pending_restore, applied lazily under the
+            # session lock on this first turn (phases.restore reports the
+            # cost). A corrupt/expired record loads as None and the
+            # session recreates fresh with an honest seq reset.
+            record = await self.session_store.load(tenant, executor_id)
+            if record is not None:
+                lane = int(record.get("lane", lane))
             session = _Session(lane=lane, last_used=asyncio.get_running_loop().time())
+            session.tenant = tenant
+            if record is not None:
+                session.pending_restore = record
+                session.seq = int(record.get("seq", 0))
             self._sessions[executor_id] = session
             try:
                 sandbox = await self._acquire(
@@ -3925,6 +4042,297 @@ class CodeExecutor:
         self._dispose_tasks.add(task)
         task.add_done_callback(self._dispose_tasks.discard)
 
+    # ------------------------------------------------- session durability
+
+    async def _restore_session(self, executor_id: str, session: _Session) -> bool:
+        """Rehydrate a fresh sandbox from the session's durable checkpoint
+        (caller holds the session lock). Workspace bytes ride the existing
+        delta upload path — a fresh sandbox's manifest is empty so every
+        file moves, but conditional PUTs and the content-addressed store
+        keep the movement to what the sandbox does not already hold — then
+        POST /restore ships the interpreter state to every host of the
+        slice (host 0's state is the checkpoint; per JAX convention host 0
+        owns the singular side effects, and module-level state must agree
+        across the SPMD group).
+
+        Returns True when the checkpoint applied (seq continues from the
+        record) and False on a CLEAN refusal (bad_state_version /
+        corrupt_state): the runner decodes every blob before mutating
+        anything, so a refusal leaves it untouched — but the workspace
+        upload may have landed, so the caller must still recreate the
+        session on a fresh sandbox. The record is evicted here either way
+        on refusal. A wire failure raises ExecutorError and KEEPS the
+        record: the blob is intact, the next attempt restores again."""
+        record = session.pending_restore
+        assert record is not None and session.sandbox is not None
+        sandbox = session.sandbox
+        session.restoring = True
+        try:
+            self._check_lease(sandbox)
+            client = self._http_client()
+            hosts = sandbox.host_urls
+            workspace = record.get("workspace") or {}
+            files = {
+                f"/workspace/{rel}": object_id
+                for rel, object_id in workspace.items()
+            }
+            if files:
+                await self._upload_inputs(
+                    client,
+                    hosts,
+                    self._transfer_state(sandbox),
+                    files,
+                    TransferStats(),
+                )
+            payload = {
+                "state": record.get("interp") or {},
+                "timeout": self.config.session_snapshot_timeout,
+            }
+            replies = await asyncio.gather(
+                *(
+                    self._post_snapshot_op(client, base, "restore", payload, sandbox)
+                    for base in hosts
+                )
+            )
+            if all(reply.get("ok") for reply in replies):
+                session.pending_restore = None
+                session.seq = int(record.get("seq", session.seq))
+                self.session_store.restores += 1
+                self.metrics.session_restores.inc(outcome="restored")
+                logger.info(
+                    "session %s restored from checkpoint (seq=%d, files=%d)",
+                    executor_id,
+                    session.seq,
+                    len(files),
+                )
+                return True
+            reason = next(
+                (
+                    str(reply.get("reason") or "refused")
+                    for reply in replies
+                    if not reply.get("ok")
+                ),
+                "refused",
+            )
+            await self.session_store.delete(session.tenant, executor_id)
+            session.pending_restore = None
+            self.metrics.session_restores.inc(outcome="fresh")
+            logger.warning(
+                "session %s checkpoint refused by runner (%s): record "
+                "evicted, recreating fresh",
+                executor_id,
+                reason,
+            )
+            return False
+        finally:
+            session.restoring = False
+
+    async def _post_snapshot_op(
+        self,
+        client: httpx.AsyncClient,
+        base: str,
+        op: str,
+        payload: dict,
+        sandbox: Sandbox,
+    ) -> dict:
+        """One host's /snapshot or /restore round-trip: lease-headered like
+        every dispatch, typed-409-aware, and strict about the reply shape —
+        any wire or protocol failure is an ExecutorError (the caller's
+        session close / record-keep semantics key off that type)."""
+        timeout = float(payload.get("timeout", 30.0)) + 10.0
+        try:
+            resp = await client.post(
+                f"{base}/{op}",
+                json=payload,
+                timeout=timeout,
+                headers=self._wire_headers(sandbox),
+            )
+        except httpx.HTTPError as e:
+            raise ExecutorError(f"session {op} to {base} failed: {e}")
+        self._raise_if_stale_lease(resp, sandbox)
+        if resp.status_code != 200:
+            raise ExecutorError(
+                f"session {op} to {base} failed: {resp.status_code} "
+                f"{resp.text[:200]}"
+            )
+        try:
+            body = resp.json()
+        except ValueError:
+            raise ExecutorError(f"session {op} to {base} returned a bad body")
+        if not isinstance(body, dict):
+            raise ExecutorError(f"session {op} to {base} returned a bad body")
+        return body
+
+    async def _snapshot_interp(self, sandbox: Sandbox) -> dict:
+        """Capture host 0's interpreter state (env deltas, cwd, workspace
+        modules' plain-data globals, installed packages) via the runner's
+        snapshot op. Raises ExecutorError when the runner refuses (e.g.
+        state_too_large) — the hibernate caller degrades gracefully by
+        leaving the session parked."""
+        client = self._http_client()
+        body = await self._post_snapshot_op(
+            client,
+            sandbox.host_urls[0],
+            "snapshot",
+            {
+                "timeout": self.config.session_snapshot_timeout,
+                "max_bytes": self.config.session_snapshot_max_bytes,
+            },
+            sandbox,
+        )
+        if not body.get("ok") or not isinstance(body.get("state"), dict):
+            raise ExecutorError(
+                "session snapshot refused: "
+                f"{body.get('reason', 'no state returned')}"
+            )
+        return body["state"]
+
+    async def _capture_workspace(self, sandbox: Sandbox) -> dict[str, str]:
+        """Fold host 0's workspace into content-addressed storage and return
+        {rel: object id}. Manifest-sha-negotiated: a file whose sha already
+        exists() in storage records the mapping and moves ZERO bytes — the
+        common hibernate (unchanged workspace since the last download
+        phase) is pure bookkeeping. A legacy executor (no manifest route)
+        fails the hibernate instead of checkpointing blind."""
+        client = self._http_client()
+        base = sandbox.host_urls[0]
+        try:
+            resp = await client.get(f"{base}/workspace-manifest")
+        except httpx.HTTPError as e:
+            raise ExecutorError(f"workspace manifest fetch failed: {e}")
+        if resp.status_code != 200:
+            raise ExecutorError(
+                f"workspace manifest fetch failed: {resp.status_code} "
+                "(legacy executor binaries cannot hibernate)"
+            )
+        try:
+            entries = resp.json().get("files", {})
+        except ValueError:
+            raise ExecutorError("workspace manifest fetch returned a bad body")
+        if not isinstance(entries, dict):
+            raise ExecutorError("workspace manifest fetch returned a bad body")
+
+        async def capture(rel: str, sha) -> tuple[str, str]:
+            if isinstance(sha, str) and SHA256_HEX_RE.match(sha):
+                if await self.storage.exists(sha):
+                    return rel, sha
+            _, object_id, _ = await self._download_file(client, base, rel)
+            return rel, object_id
+
+        captured = await asyncio.gather(
+            *(capture(rel, sha) for rel, sha in sorted(entries.items()))
+        )
+        return dict(captured)
+
+    async def _hibernate_session(
+        self, executor_id: str, session: _Session, *, reason: str = "hibernate"
+    ) -> bool:
+        """Checkpoint THIS session into the durable store and release its
+        chip (caller holds the session lock). Returns True when the session
+        ended with its state durable — the sweep's hibernate leg and the
+        fence path's migrate leg both ride this. A session that never woke
+        from its previous checkpoint (pending_restore still set) just ends:
+        the admitted record IS its state, byte-for-byte."""
+        sandbox = session.sandbox
+        if sandbox is None or session.closed:
+            return False
+        if session.pending_restore is not None:
+            # Parked-but-never-woken: nothing ran since the checkpoint was
+            # admitted, so the record already holds the exact state.
+            await self._end_session(executor_id, session, recycle=True)
+            self.metrics.session_hibernates.inc(outcome=reason)
+            return True
+        try:
+            interp_state = await self._snapshot_interp(sandbox)
+            workspace = await self._capture_workspace(sandbox)
+        except (ExecutorError, SandboxSpawnError) as e:
+            self.metrics.session_hibernates.inc(outcome="failed")
+            logger.warning(
+                "session %s %s checkpoint failed (%s); leaving it parked",
+                executor_id,
+                reason,
+                e,
+            )
+            return False
+        outcome = await self.session_store.save(
+            session.tenant,
+            executor_id,
+            lane=session.lane,
+            seq=session.seq,
+            interp_state=interp_state,
+            workspace=workspace,
+            reason=reason,
+        )
+        if outcome != "admitted":
+            self.metrics.session_hibernates.inc(outcome="failed")
+            logger.warning(
+                "session %s %s checkpoint not admitted (%s); leaving it "
+                "parked",
+                executor_id,
+                reason,
+                outcome,
+            )
+            return False
+        await self._end_session(executor_id, session, recycle=True)
+        self.metrics.session_hibernates.inc(outcome=reason)
+        logger.info(
+            "session %s hibernated (%s): seq=%d, %d workspace files, chip "
+            "released to lane %d",
+            executor_id,
+            reason,
+            session.seq,
+            len(workspace),
+            session.lane,
+        )
+        return True
+
+    async def _migrate_session(
+        self, executor_id: str, session: _Session, reason: str
+    ) -> bool:
+        """Live-migrate one session off a host being fenced: bounded lock
+        wait (an in-flight request finishes its turn first), then the
+        hibernate path with reason="migrate" — the durable record restores
+        the session behind ANY replica on its next turn, session_seq
+        continuous, zero client-visible state loss. Returns False when the
+        snapshot cannot be taken in time; the caller falls back to the
+        pre-durability force-close."""
+        try:
+            await asyncio.wait_for(
+                session.lock.acquire(),
+                timeout=self.config.session_snapshot_timeout,
+            )
+        except asyncio.TimeoutError:
+            return False
+        try:
+            if session.closed or self._sessions.get(executor_id) is not session:
+                return True  # already gone — nothing to lose
+            ok = await self._hibernate_session(
+                executor_id, session, reason="migrate"
+            )
+            self.metrics.session_migrations.inc(
+                outcome="saved" if ok else "forced"
+            )
+            return ok
+        finally:
+            session.lock.release()
+
+    def _account_idle(self, session: _Session, now: float) -> None:
+        """Fold this session's parked-idle time since the last sweep into
+        the idle-chip-seconds counter (satellite: make the cost hibernation
+        kills VISIBLE). Busy sessions reset the watermark — time under the
+        lock is work, not waste."""
+        if session.lock.locked() or session.sandbox is None:
+            session.idle_accounted = now
+            return
+        since = max(session.last_used, session.idle_accounted)
+        delta = max(0.0, now - since)
+        if delta <= 0.0:
+            return
+        chips = max(1, session.lane or 1)
+        self._idle_chip_seconds += delta * chips
+        self.metrics.session_idle_chip_seconds.inc(delta * chips)
+        session.idle_accounted = now
+
     def list_sessions(self) -> list[dict]:
         """Live sessions for GET /v1/executors: id, lane, idle seconds,
         whether a request is in flight, and requests served. Sessions still
@@ -3945,13 +4353,20 @@ class CodeExecutor:
             if not session.closed
         ]
 
-    async def close_session(self, executor_id: str) -> bool:
+    async def close_session(
+        self, executor_id: str, *, tenant: str | None = None
+    ) -> bool:
         """Explicitly end a session (DELETE /v1/executors/{id}). Waits for an
         in-flight request on the session to finish first. Returns False if no
-        such session exists."""
+        such session exists. The durable checkpoint (if any) is evicted too:
+        an explicit close means the client is done — the record must not
+        resurrect the session on an id reuse."""
         session = self._sessions.get(executor_id)
         if session is None or session.closed:
-            return False
+            # No live session — but a HIBERNATED one may exist as a record
+            # only. Deleting it IS the close; report it as one.
+            return await self.session_store.delete(tenant, executor_id)
+        await self.session_store.delete(session.tenant or tenant, executor_id)
         if session.sandbox is None:
             try:
                 await asyncio.shield(session.ready)
@@ -3969,16 +4384,45 @@ class CodeExecutor:
     async def sweep_sessions(self) -> int:
         """Close sessions idle past the configured timeout. An idle session
         parks a sandbox (on TPU lanes: physical chips) indefinitely; the
-        sweep bounds that at executor_session_idle_timeout."""
+        sweep bounds that at executor_session_idle_timeout.
+
+        With the durability plane live, a cheaper bound fires FIRST: a
+        session idle past session_hibernate_idle_seconds is checkpointed
+        and its chip released (the autoscaler sees the reclaimed supply),
+        instead of waiting for the hard expiry. A failed hibernate leaves
+        the session parked — the plain idle close still bounds it. The
+        sweep also folds parked-idle time into the idle-chip-seconds
+        counter, and TTL-prunes durable records nobody woke."""
         loop = asyncio.get_running_loop()
         idle_cutoff = self.config.executor_session_idle_timeout
+        hibernate_after = (
+            self.config.session_hibernate_idle_seconds
+            if self.session_store.enabled
+            else 0.0
+        )
         closed = 0
         for executor_id, session in list(self._sessions.items()):
             if session.closed or session.sandbox is None:
                 continue
+            self._account_idle(session, loop.time())
             if session.lock.locked():  # request in flight
                 continue
-            if loop.time() - session.last_used < idle_cutoff:
+            idle = loop.time() - session.last_used
+            if hibernate_after > 0 and idle >= hibernate_after:
+                async with session.lock:
+                    # Re-check under the lock: a request may have slipped in.
+                    if (
+                        self._sessions.get(executor_id) is session
+                        and not session.closed
+                        and loop.time() - session.last_used >= hibernate_after
+                    ):
+                        if await self._hibernate_session(executor_id, session):
+                            closed += 1
+                            continue
+                if self._sessions.get(executor_id) is not session or session.closed:
+                    continue
+                idle = loop.time() - session.last_used
+            if idle < idle_cutoff:
                 continue
             async with session.lock:
                 # Re-check under the lock: a request may have slipped in.
@@ -3989,15 +4433,29 @@ class CodeExecutor:
                     if await self._end_session(executor_id, session, recycle=True):
                         logger.info("session %s expired (idle)", executor_id)
                         closed += 1
+        try:
+            self.session_store.sweep_expired()
+        except Exception:  # noqa: BLE001 — pruning must not break the sweep
+            logger.warning("session record TTL sweep failed", exc_info=True)
         return closed
 
     def start_session_sweeper(self, interval: float | None = None) -> asyncio.Task | None:
         """Run sweep_sessions periodically until close(). Default cadence:
-        a quarter of the idle timeout, so expiry lands within ~125% of it."""
+        a quarter of the idle timeout, so expiry lands within ~125% of it —
+        tightened to half the hibernate threshold when the durability plane
+        is live, so a hibernation lands within ~150% of its own bound too."""
         if self.config.executor_session_max <= 0:
             return None
         if interval is None:
             interval = max(1.0, self.config.executor_session_idle_timeout / 4)
+            if (
+                self.session_store.enabled
+                and self.config.session_hibernate_idle_seconds > 0
+            ):
+                interval = min(
+                    interval,
+                    max(1.0, self.config.session_hibernate_idle_seconds / 2),
+                )
         return self._start_sweeper(self.sweep_sessions, interval, "session sweep")
 
     def _start_sweeper(self, sweep, interval: float, label: str) -> asyncio.Task | None:
@@ -4785,6 +5243,14 @@ class CodeExecutor:
             "inflight": self.inflight(),
             "lanes": lanes,
             "sessions": self.list_sessions(),
+            # The durability plane: hibernated-session count (records a
+            # next turn would restore), checkpoint admit/restore/conflict
+            # totals, and the idle cost the plane exists to kill —
+            # cumulative chip-seconds spent parked-idle across sessions.
+            "session_durability": {
+                **self.session_store.snapshot(),
+                "idle_chip_seconds_total": round(self._idle_chip_seconds, 3),
+            },
             "batching": {
                 "enabled": self.batcher is not None,
                 "window_ms": self.config.batch_window_ms,

@@ -72,6 +72,24 @@ class StaleLeaseError(ExecutorError):
         self.retry_after = retry_after
 
 
+class SessionRestoringError(ExecutorError):
+    """The session is mid-restore from its durable checkpoint (session
+    durability plane, services/session_store.py): one turn already owns the
+    restore — a second turn admitted now would race a double-restore into
+    the same sandbox. A typed, retryable refusal, NOT a session-ending
+    fault: the session stays live and the restore finishes without the
+    loser. Maps to HTTP 409 + Retry-After (the stale-lease family — the
+    client's existing 409 retry loop needs no new branch) and gRPC
+    UNAVAILABLE with ``x-session-restoring`` trailing metadata."""
+
+    def __init__(
+        self, message: str, *, executor_id: str = "", retry_after: float = 1.0
+    ) -> None:
+        super().__init__(message)
+        self.executor_id = executor_id
+        self.retry_after = retry_after
+
+
 class SessionLimitError(RuntimeError):
     """All executor_id session slots are in use (retryable: HTTP 429 /
     gRPC RESOURCE_EXHAUSTED — not a defect in the request itself)."""

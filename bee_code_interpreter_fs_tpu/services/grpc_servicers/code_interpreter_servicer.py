@@ -26,6 +26,7 @@ from ..code_executor import (
     LimitExceededError,
     QuotaExceededError,
     SessionLimitError,
+    SessionRestoringError,
     StaleLeaseError,
 )
 from ..custom_tool_executor import (
@@ -237,6 +238,29 @@ class CodeInterpreterServicer:
         )
 
     @staticmethod
+    async def _abort_restoring(
+        context: grpc.aio.ServicerContext,
+        e: SessionRestoringError,
+        trailing: list[tuple[str, str]],
+    ) -> None:
+        """Restore-in-flight refusals map to UNAVAILABLE — transient by
+        construction, the restore completes without the loser — with
+        `x-session-restoring` trailing metadata carrying the retry-after
+        (the proto is frozen; metadata is the structured channel, as for
+        x-violation and x-quota-*)."""
+        extra = trailing + [
+            ("x-session-restoring", "1"),
+            (
+                "x-session-restoring-retry-after",
+                f"{max(0.0, getattr(e, 'retry_after', 1.0)):.3f}",
+            ),
+        ]
+        set_trailing = getattr(context, "set_trailing_metadata", None)
+        if set_trailing is not None:
+            set_trailing(tuple(extra))
+        await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+
+    @staticmethod
     async def _abort_quota(
         context: grpc.aio.ServicerContext,
         e: QuotaExceededError,
@@ -364,6 +388,8 @@ class CodeInterpreterServicer:
             exit_code=result.exit_code,
             session_seq=result.session_seq,
             session_ended=result.session_ended,
+            stdout_truncated=result.stdout_truncated,
+            stderr_truncated=result.stderr_truncated,
         )
         for path, object_id in result.files.items():
             response.files[path] = object_id
@@ -421,6 +447,11 @@ class CodeInterpreterServicer:
             except SessionLimitError as e:
                 # Retryable resource exhaustion, not a defect in the request.
                 await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+            except SessionRestoringError as e:
+                # Before ExecutorError (its parent): a concurrent turn owns
+                # the session's restore — UNAVAILABLE with
+                # x-session-restoring metadata, mirroring the HTTP 409.
+                await self._abort_restoring(context, e, trailing)
             except StaleLeaseError as e:
                 # Before ExecutorError (its parent): the request's host was
                 # fenced mid-flight — ABORTED is gRPC's "safe to retry the
@@ -492,6 +523,10 @@ class CodeInterpreterServicer:
                 await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             except SessionLimitError as e:
                 await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+            except SessionRestoringError as e:
+                # Restore-in-flight: UNAVAILABLE + x-session-restoring, like
+                # Execute's mapping above.
+                await self._abort_restoring(context, e, trailing)
             except StaleLeaseError as e:
                 # Fenced mid-stream: ABORTED (retry-whole-call), like
                 # Execute's mapping above.
@@ -509,10 +544,13 @@ class CodeInterpreterServicer:
                 grpc.StatusCode.INVALID_ARGUMENT,
                 "invalid executor_id (want ^[0-9a-zA-Z_-]{1,255}$)",
             )
+        metadata = self._metadata_dict(context)
         await self._check_session_owner(
-            context, request.executor_id, self._metadata_dict(context)
+            context, request.executor_id, metadata
         )
-        closed = await self.code_executor.close_session(request.executor_id)
+        closed = await self.code_executor.close_session(
+            request.executor_id, tenant=metadata.get("x-tenant")
+        )
         return pb2.CloseExecutorResponse(closed=closed)
 
     async def ParseCustomTool(

@@ -36,6 +36,7 @@ from .code_executor import (
     LimitExceededError,
     QuotaExceededError,
     SessionLimitError,
+    SessionRestoringError,
     StaleLeaseError,
 )
 from .custom_tool_executor import (
@@ -407,7 +408,24 @@ def statusz_text(body: dict) -> str:
     else:
         lines.append("perf observer: disabled")
     sessions = body.get("sessions", ())
-    lines.append(f"sessions: {len(sessions)}")
+    durability = body.get("session_durability", {})
+    if durability.get("enabled"):
+        lines.append(
+            f"sessions: {len(sessions)} live, "
+            f"{durability.get('hibernated', 0)} hibernated "
+            f"(saves={durability.get('saves', 0)} "
+            f"restores={durability.get('restores', 0)} "
+            f"conflicts={durability.get('conflicts', 0)} "
+            f"idle_chip_s={durability.get('idle_chip_seconds_total', 0.0)})"
+        )
+    else:
+        lines.append(f"sessions: {len(sessions)}")
+    for row in sessions:
+        lines.append(
+            f"  {row.get('executor_id')}: lane={row.get('chip_count')} "
+            f"idle={row.get('idle_s')}s busy={row.get('busy')} "
+            f"requests={row.get('requests')} [{row.get('status')}]"
+        )
     return "\n".join(lines) + "\n"
 
 
@@ -1056,6 +1074,22 @@ def create_http_app(
             },
         )
 
+    def session_restoring_response(e: SessionRestoringError) -> web.Response:
+        """409 for a turn that raced a restore-in-flight: another turn is
+        rehydrating this session from its durable checkpoint right now.
+        The stale-lease 409 family on purpose — typed reason + Retry-After,
+        so a session client's existing 409 retry loop needs no new branch
+        and the retry lands after the restore completes."""
+        return web.json_response(
+            with_trace_id({"error": str(e), "reason": "session_restoring"}),
+            status=409,
+            headers={
+                "Retry-After": str(
+                    max(1, math.ceil(getattr(e, "retry_after", 1.0) or 1.0))
+                )
+            },
+        )
+
     def add_session_fields(body: dict, result, executor_id: str | None) -> dict:
         """Session continuity, one rule for every surface: seq==1 on a
         request the client expected to land in an existing session means
@@ -1128,6 +1162,11 @@ def create_http_app(
         except SessionLimitError as e:
             # Resource exhaustion, not a request defect: retryable.
             return capacity_response(e)
+        except SessionRestoringError as e:
+            # Before ExecutorError (its parent): a concurrent turn owns the
+            # session's restore — typed 409 + Retry-After, retry lands
+            # after the restore completes.
+            return session_restoring_response(e)
         except StaleLeaseError as e:
             # Before ExecutorError (its parent): the host was fenced —
             # typed 409 + Retry-After, the client reconnects to a healthy
@@ -1228,6 +1267,16 @@ def create_http_app(
             await response.write(
                 (json.dumps({"error": str(e)}) + "\n").encode("utf-8")
             )
+        except SessionRestoringError as e:
+            # Before ExecutorError (its parent): restore-in-flight refusal.
+            if not started:
+                return session_restoring_response(e)
+            await response.write(
+                (
+                    json.dumps({"error": str(e), "reason": "session_restoring"})
+                    + "\n"
+                ).encode("utf-8")
+            )
         except StaleLeaseError as e:
             # Before ExecutorError (its parent): typed fence refusal.
             if not started:
@@ -1273,7 +1322,9 @@ def create_http_app(
         )
         if routed is not None:
             return routed
-        if await code_executor.close_session(executor_id):
+        if await code_executor.close_session(
+            executor_id, tenant=session_tenant(request)
+        ):
             return web.json_response({"closed": executor_id})
         body = {"error": "no such session"}
         if router is not None and len(router.ring.peers) > 1:

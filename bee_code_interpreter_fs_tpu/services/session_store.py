@@ -1,0 +1,363 @@
+"""Durable session checkpoints: the session-durability plane's record set.
+
+A parked executor_id session pins a warm chip for its whole lifetime —
+`_session_held` gates lane capacity in code_executor.py — and at the
+ROADMAP's millions-of-users scale, idle sessions are the dominant cost.
+This store turns "session = pinned hardware" into "session = cheap durable
+object": an idle session is **checkpointed** (workspace manifest + the
+runner's serialized interpreter state), its sandbox disposed and the chip
+returned to the pool, and the session **restored** lazily onto a fresh
+sandbox on its next turn — `session_seq` continuous, variables and files
+byte-identical. The same checkpoint path **migrates** live sessions off
+fenced hosts instead of destroying their state (PR 13 semantics).
+
+Discipline follows services/result_memo.py (PR 16) verbatim:
+
+- **Workspace bytes are content-addressed** in the EXISTING workspace
+  Storage (PR 3 object ids ARE content sha256es), so a checkpoint of an
+  unchanged workspace moves zero bytes — the record holds `{path: object
+  id}` and a restore re-validates every referenced object before serving.
+- **Interpreter-state blobs** live in the store's OWN Storage (eviction
+  deletes objects; sharing the workspace store would let a session-record
+  eviction delete a workspace file's bytes out from under a live session).
+- **The index rides StateStore** (services/state_store.py): N replicas
+  sharing one store share one session record set, so a session hibernated
+  behind replica A restores behind replica B after a rehash (PR 15).
+- **Per-tenant key scope.** A record saved under tenant T restores only
+  for tenant T — the executor-id namespace is already per-tenant
+  (PR 6/16 trust model); the store enforces it again at the key.
+- **Monotonic-seq first-write-wins.** A save carrying a `seq` not newer
+  than the admitted record is rejected and counted — that is a stale
+  writer (a fenced replica's late snapshot racing the new owner), never
+  a legitimate newer checkpoint.
+- **Admission-order durability**: the interpreter-state blob is made
+  durable in Storage BEFORE the index mutate, so a wire drop or crash
+  mid-checkpoint leaves at worst an orphan object — never an index entry
+  pointing at partial bytes (the chaos-leg invariant).
+- **Self-verifying load**: version mismatch, missing/corrupt blob, or a
+  missing workspace object evicts the record and returns None — the
+  caller recreates the session FRESH (honest `session_seq` reset) rather
+  than half-restoring.
+- **Kill switch** (``APP_SESSION_DURABILITY_ENABLED=0``): a disabled
+  store does no IO, creates no directories, serves nothing — today's
+  pin-forever session semantics byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from pathlib import Path
+
+from .storage import Storage, StorageObjectNotFound
+
+logger = logging.getLogger(__name__)
+
+# StateStore namespace the record index rides (replica-coherent per PR 15).
+SESSION_NS = "session_durable"
+
+# Record blob format version: bump on any change to the record layout or
+# the runner's interpreter-state wire format so stale records evict
+# (recreate-fresh) instead of deserializing wrong.
+RECORD_VERSION = 1
+
+# Tenant scope for requests that carry no tenant (mirrors the scheduler's
+# default-tenant posture; never collides with a real tenant name because
+# the leading dot is outside the tenant charset).
+ANON_SCOPE = ".anon"
+
+
+def session_key(tenant: str | None, executor_id: str) -> str:
+    """Per-tenant record identity: tenant scope first, so one tenant's
+    executor_id can never resolve another tenant's checkpoint."""
+    return f"{tenant or ANON_SCOPE}/{executor_id}"
+
+
+class SessionStore:
+    """StateStore-indexed, Storage-backed session checkpoints.
+
+    Synchronous index bookkeeping (StateStore ops are dict/single-row
+    SQLite statements), async byte movement — the result-memo split.
+    """
+
+    def __init__(
+        self,
+        store_path: str | os.PathLike,
+        state_store,
+        workspace_storage: Storage | None,
+        *,
+        enabled: bool = True,
+        record_ttl: float = 3600.0,
+        max_entries: int = 4096,
+        clock=time.time,
+        metrics=None,
+    ) -> None:
+        self.enabled = enabled
+        self.record_ttl = max(0.0, float(record_ttl))
+        self.max_entries = max(0, int(max_entries))
+        self.state = state_store
+        self.workspace_storage = workspace_storage
+        self._clock = clock
+        self.metrics = metrics
+        self.saves = 0
+        self.restores = 0
+        self.conflicts = 0
+        self.evictions = 0
+        if not enabled:
+            # Kill switch: no directories, no state, every surface answers
+            # empty — pre-durability behavior byte-for-byte.
+            self.storage = None
+            return
+        self.path = Path(store_path)
+        self.path.mkdir(parents=True, exist_ok=True)
+        self.storage = Storage(self.path / "objects")
+
+    @classmethod
+    def from_config(
+        cls, config, state_store, workspace_storage, *, metrics=None
+    ) -> "SessionStore":
+        path = config.session_store_path or os.path.join(
+            config.file_storage_path, ".session-store"
+        )
+        return cls(
+            path,
+            state_store,
+            workspace_storage,
+            enabled=config.session_durability_enabled,
+            record_ttl=config.session_record_ttl,
+            max_entries=config.session_store_max_entries,
+            metrics=metrics,
+        )
+
+    # ------------------------------------------------------------------ index
+
+    def entry_count(self) -> int:
+        if not self.enabled:
+            return 0
+        return len(self.state.items(SESSION_NS))
+
+    def record_keys(self) -> list[str]:
+        if not self.enabled:
+            return []
+        return sorted(self.state.items(SESSION_NS))
+
+    # ------------------------------------------------------------------- save
+
+    async def save(
+        self,
+        tenant: str | None,
+        executor_id: str,
+        *,
+        lane: int,
+        seq: int,
+        interp_state: dict,
+        workspace: dict[str, str],
+        reason: str = "hibernate",
+    ) -> str:
+        """Admit one checkpoint. Returns ``admitted`` | ``stale`` (the
+        index already holds a record with seq >= this one — first write
+        wins, the late writer loses) | ``error`` (bytes could not be made
+        durable; nothing admitted).
+
+        Durability order is the chaos invariant: the interpreter-state
+        blob is written content-addressed (tmp + fsync + rename inside
+        Storage) BEFORE the index mutate — a drop mid-checkpoint leaves
+        at worst an orphan object, never a partial record."""
+        if not self.enabled:
+            return "error"
+        record = {
+            "version": RECORD_VERSION,
+            "tenant": tenant or "",
+            "executor_id": executor_id,
+            "lane": int(lane),
+            "seq": int(seq),
+            "interp": interp_state,
+            "workspace": dict(workspace),
+            "reason": reason,
+            "created": round(self._clock(), 3),
+        }
+        try:
+            blob = json.dumps(record, sort_keys=True).encode()
+            object_id = await self.storage.write(blob)
+        except (OSError, ValueError, TypeError):
+            logger.warning("session checkpoint write failed", exc_info=True)
+            return "error"
+
+        index_key = session_key(tenant, executor_id)
+        now = round(self._clock(), 3)
+        size = len(blob)
+
+        def admit(existing):
+            if isinstance(existing, dict) and int(existing.get("seq", -1)) >= int(
+                seq
+            ):
+                # Monotonic-seq first-write-wins: a checkpoint that is not
+                # NEWER than the admitted one is a stale writer (a fenced
+                # replica's late snapshot racing the new owner's).
+                return existing, "stale"
+            entry = {
+                "record": object_id,
+                "seq": int(seq),
+                "lane": int(lane),
+                "size": size,
+                "saved": now,
+            }
+            return entry, "admitted"
+
+        try:
+            outcome = self.state.mutate(SESSION_NS, index_key, admit)
+        except Exception:  # noqa: BLE001
+            logger.warning("session record admit failed", exc_info=True)
+            return "error"
+        if outcome == "stale":
+            self.conflicts += 1
+            logger.warning(
+                "stale session checkpoint rejected for %s (seq %d not newer "
+                "than admitted record) — keeping the first write",
+                index_key,
+                seq,
+            )
+        if outcome == "admitted":
+            self.saves += 1
+            self._evict()
+        return outcome
+
+    # ------------------------------------------------------------------- load
+
+    async def load(self, tenant: str | None, executor_id: str) -> dict | None:
+        """The restore-path check: index entry -> record blob -> workspace
+        object validation. Any missing byte evicts the record and returns
+        None — the session recreates FRESH (honest seq reset), never
+        half-restores. Never raises."""
+        if not self.enabled:
+            return None
+        index_key = session_key(tenant, executor_id)
+        entry = self.state.get(SESSION_NS, index_key)
+        if not isinstance(entry, dict):
+            return None
+        if self.record_ttl and (
+            self._clock() - float(entry.get("saved", 0.0)) > self.record_ttl
+        ):
+            await self._drop(index_key, entry)
+            return None
+        object_id = entry.get("record")
+        if not isinstance(object_id, str):
+            await self._drop(index_key, entry)
+            return None
+        try:
+            record = json.loads(await self.storage.read(object_id))
+        except (StorageObjectNotFound, OSError, ValueError):
+            await self._drop(index_key, entry)
+            return None
+        if (
+            not isinstance(record, dict)
+            or record.get("version") != RECORD_VERSION
+            or record.get("executor_id") != executor_id
+            or (record.get("tenant") or "") != (tenant or "")
+        ):
+            await self._drop(index_key, entry)
+            return None
+        # Workspace bytes live in the shared workspace store; a restore
+        # must never hand a sandbox object ids whose bytes are gone.
+        files = record.get("workspace")
+        if isinstance(files, dict) and self.workspace_storage is not None:
+            for ws_object in files.values():
+                try:
+                    if not await self.workspace_storage.exists(str(ws_object)):
+                        await self._drop(index_key, entry)
+                        return None
+                except (OSError, ValueError):
+                    await self._drop(index_key, entry)
+                    return None
+        return record
+
+    async def delete(self, tenant: str | None, executor_id: str) -> bool:
+        """Explicit close: the client said it is done with the session —
+        the checkpoint must not resurrect it. Returns True when a record
+        existed (a hibernated session WAS closed by this delete)."""
+        if not self.enabled:
+            return False
+        index_key = session_key(tenant, executor_id)
+        entry = self.state.get(SESSION_NS, index_key)
+        if entry is None:
+            return False
+        await self._drop(index_key, entry if isinstance(entry, dict) else {})
+        return True
+
+    async def _drop(self, index_key: str, entry: dict) -> None:
+        self.state.delete(SESSION_NS, index_key)
+        self.evictions += 1
+        object_id = entry.get("record")
+        if isinstance(object_id, str):
+            try:
+                await self.storage.delete(object_id)
+            except (StorageObjectNotFound, OSError):
+                pass
+
+    def _evict(self) -> None:
+        """Oldest-saved eviction under the entry cap. Index first, bytes
+        second (the memo rule): a concurrent replica's load either sees
+        the entry — content-addressed blobs are immutable, so a won read
+        race still serves correctly — or misses cleanly and recreates
+        fresh."""
+        if not self.enabled or not self.max_entries:
+            return
+        while True:
+            items = {
+                k: v
+                for k, v in self.state.items(SESSION_NS).items()
+                if isinstance(v, dict)
+            }
+            if len(items) <= self.max_entries:
+                return
+            victim = min(items, key=lambda k: items[k].get("saved", 0.0))
+            object_id = items[victim].get("record")
+            self.state.delete(SESSION_NS, victim)
+            self.evictions += 1
+            if isinstance(object_id, str):
+                try:
+                    # Sync path (called from save): the blob delete is
+                    # best-effort; orphan objects are harmless and the
+                    # next save of the same bytes dedups onto them.
+                    os.unlink(self.storage.path / object_id)
+                except OSError:
+                    pass
+
+    def sweep_expired(self) -> int:
+        """TTL pruning for records nobody came back for (sweeper-driven).
+        Returns the number of records dropped."""
+        if not self.enabled or not self.record_ttl:
+            return 0
+        now = self._clock()
+        dropped = 0
+        for key, entry in list(self.state.items(SESSION_NS).items()):
+            if not isinstance(entry, dict):
+                self.state.delete(SESSION_NS, key)
+                dropped += 1
+                continue
+            if now - float(entry.get("saved", 0.0)) > self.record_ttl:
+                self.state.delete(SESSION_NS, key)
+                self.evictions += 1
+                dropped += 1
+                object_id = entry.get("record")
+                if isinstance(object_id, str):
+                    try:
+                        os.unlink(self.storage.path / object_id)
+                    except OSError:
+                        pass
+        return dropped
+
+    def snapshot(self) -> dict:
+        """Operator view (GET /statusz companion data)."""
+        if not self.enabled:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            "hibernated": self.entry_count(),
+            "saves": self.saves,
+            "restores": self.restores,
+            "conflicts": self.conflicts,
+            "evictions": self.evictions,
+        }

@@ -485,6 +485,41 @@ class ExecutorMetrics:
             "a nondeterministic 'pure' run at best, a poisoning attempt at "
             "worst — investigate if this moves.",
         )
+        # Session-durability plane (services/session_store.py): hibernate /
+        # restore / migrate outcomes, plus the cost signal the plane exists
+        # to kill — chip-seconds spent parked under an idle session. A
+        # rising idle counter next to zero hibernates means the idle
+        # threshold is mis-tuned (or the kill switch is off on purpose).
+        self.session_hibernates = self.registry.counter(
+            "code_interpreter_session_hibernates_total",
+            "Sessions checkpointed to the durable store with their chip "
+            "released, by outcome (hibernate = idle-timer driven; migrate "
+            "= fence-driven live migration; failed = snapshot refused or "
+            "not admitted — session left parked).",
+            ("outcome",),
+        )
+        self.session_restores = self.registry.counter(
+            "code_interpreter_session_restores_total",
+            "Hibernated-session wakes by outcome (restored = checkpoint "
+            "applied, session_seq continuous; fresh = record refused by "
+            "the runner and evicted — session recreated with an honest "
+            "seq reset).",
+            ("outcome",),
+        )
+        self.session_migrations = self.registry.counter(
+            "code_interpreter_session_migrations_total",
+            "Sessions on a host being fenced, by what happened to their "
+            "state (saved = live-migrated via snapshot-then-restore-"
+            "elsewhere; forced = checkpoint impossible in time, "
+            "pre-durability force-close).",
+            ("outcome",),
+        )
+        self.session_idle_chip_seconds = self.registry.counter(
+            "code_interpreter_session_idle_chip_seconds_total",
+            "Cumulative chip-seconds spent parked under idle executor_id "
+            "sessions (chips held, no request in flight) — the cost "
+            "hibernation reclaims.",
+        )
         self.executor_connections_reused = self.registry.counter(
             "executor_connections_reused_total",
             "Executor HTTP dispatches served over an already-established "

@@ -967,6 +967,214 @@ def _reset(snapshot: dict) -> bool:
     return True
 
 
+# Interpreter-state serialization (session durability): the cross-turn
+# state this runner actually carries. Per-turn globals do NOT persist
+# (each turn runs under runpy with a fresh namespace), so what survives —
+# and what a snapshot must capture — is exactly: env-var mutations made by
+# user code, the working directory, and workspace-origin modules whose
+# module-level globals user turns import and mutate. Device buffers are
+# deliberately NOT captured: they re-materialize on first touch after a
+# restore (recompute/reload is the contract, same as a process restart).
+_STATE_VERSION = 1
+
+# Values are pickled by ALLOWLIST, not by "whatever pickles": only plain
+# data (scalars + containers thereof) rides a snapshot. Anything else —
+# open files, sockets, threads, jax arrays, live objects of workspace
+# classes — is skipped and honestly reported, never half-captured.
+_PICKLE_SCALARS = (type(None), bool, int, float, complex, str, bytes)
+
+
+def _plain_data(value: object, depth: int = 0) -> bool:
+    if depth > 8:
+        return False
+    if isinstance(value, _PICKLE_SCALARS):
+        return True
+    if isinstance(value, (list, tuple, set, frozenset)):
+        return all(_plain_data(v, depth + 1) for v in value)
+    if isinstance(value, dict):
+        return all(
+            _plain_data(k, depth + 1) and _plain_data(v, depth + 1)
+            for k, v in value.items()
+        )
+    return False
+
+
+class _PlainUnpickler:
+    """Restricted loads(): refuses any global lookup, so a corrupted or
+    adversarial snapshot blob cannot instantiate arbitrary classes — plain
+    data needs no globals at all."""
+
+    def __init__(self) -> None:
+        import io
+        import pickle
+
+        class Unpickler(pickle.Unpickler):
+            def find_class(self, module, name):  # noqa: ARG002
+                raise pickle.UnpicklingError(
+                    f"snapshot state may not reference {module}.{name}"
+                )
+
+        self._io = io
+        self._cls = Unpickler
+
+    def loads(self, data: bytes) -> object:
+        return self._cls(self._io.BytesIO(data)).load()
+
+
+def _workspace_module_prefixes(snapshot: dict) -> list[str]:
+    """Same selection rule _reset uses to scrub: a module is session state
+    (not interpreter infrastructure) iff its file lives under the
+    workspace, exec scratch, or auto-installed runtime-packages."""
+    import tempfile
+
+    workspace = snapshot["cwd"]
+    prefixes = [workspace + os.sep, os.path.join(tempfile.gettempdir(), "exec-")]
+    runtime_packages = os.environ.get("APP_RUNTIME_PACKAGES")
+    if runtime_packages:
+        prefixes.append(runtime_packages.rstrip(os.sep) + os.sep)
+    return prefixes
+
+
+def _installed_packages() -> list[str]:
+    """Top-level names under the auto-install dir — recorded in the
+    snapshot for honesty/observability (restore does NOT reinstall; the
+    package FILES ride the workspace manifest like any other files)."""
+    runtime_packages = os.environ.get("APP_RUNTIME_PACKAGES")
+    if not runtime_packages:
+        return []
+    try:
+        return sorted(os.listdir(runtime_packages))
+    except OSError:
+        return []
+
+
+def _snapshot_state(snapshot: dict, req: dict) -> dict:
+    """Serialize this runner's cross-turn interpreter state into a JSON
+    document (op "snapshot"). Never raises on a weird value — skipped
+    names are reported, the rest is captured."""
+    import base64
+    import pickle
+
+    boot_env = snapshot["environ"]
+    env_set = {
+        k: v
+        for k, v in os.environ.items()
+        if boot_env.get(k) != v
+    }
+    env_del = sorted(k for k in boot_env if k not in os.environ)
+    try:
+        cwd = os.getcwd()
+    except OSError:
+        cwd = snapshot["cwd"]
+
+    prefixes = _workspace_module_prefixes(snapshot)
+    modules = []
+    skipped: list[str] = []
+    for name, mod in sorted(sys.modules.items()):
+        origin = getattr(mod, "__file__", None) or ""
+        if not any(origin.startswith(p) for p in prefixes):
+            continue
+        values = {}
+        for attr, value in vars(mod).items():
+            if attr.startswith("__"):
+                continue
+            if not _plain_data(value):
+                skipped.append(f"{name}.{attr}")
+                continue
+            try:
+                blob = pickle.dumps(value, protocol=2)
+            except Exception:  # noqa: BLE001
+                skipped.append(f"{name}.{attr}")
+                continue
+            values[attr] = base64.b64encode(blob).decode("ascii")
+        modules.append({"name": name, "values": values})
+
+    state = {
+        "version": _STATE_VERSION,
+        "env_set": env_set,
+        "env_del": env_del,
+        "cwd": cwd,
+        "modules": modules,
+        "packages": _installed_packages(),
+        "skipped": sorted(skipped),
+    }
+    max_bytes = int(req.get("max_bytes") or 0)
+    if max_bytes and len(json.dumps(state)) > max_bytes:
+        return {"ok": False, "reason": "state_too_large"}
+    return {"ok": True, "state": state}
+
+
+def _restore_state(snapshot: dict, req: dict) -> dict:
+    """Rehydrate a snapshot (op "restore") into this warm runner. The
+    workspace files are ALREADY in place (they ride the manifest-delta
+    upload path before this op fires); this re-imports workspace modules
+    and overlays their captured globals, then replays env/cwd deltas.
+    All-or-nothing per the trust model: a malformed state document is
+    refused up front rather than half-applied."""
+    import base64
+    import importlib
+
+    state = req.get("state")
+    if not isinstance(state, dict) or state.get("version") != _STATE_VERSION:
+        return {"ok": False, "reason": "bad_state_version"}
+
+    loader = _PlainUnpickler()
+    # Decode every blob BEFORE touching interpreter state: a corrupt pickle
+    # refuses the whole restore instead of leaving a half-written session.
+    decoded = []
+    try:
+        for entry in state.get("modules") or []:
+            values = {
+                attr: loader.loads(base64.b64decode(blob))
+                for attr, blob in (entry.get("values") or {}).items()
+            }
+            decoded.append((entry["name"], values))
+        env_set = dict(state.get("env_set") or {})
+        env_del = list(state.get("env_del") or [])
+        cwd = state.get("cwd")
+    except Exception:  # noqa: BLE001
+        traceback.print_exc()
+        return {"ok": False, "reason": "corrupt_state"}
+
+    for k, v in env_set.items():
+        os.environ[str(k)] = str(v)
+    for k in env_del:
+        os.environ.pop(k, None)
+    if isinstance(cwd, str) and cwd:
+        try:
+            os.chdir(cwd)
+        except OSError:
+            pass
+
+    # During a turn, workspace imports resolve however the user arranged
+    # them (sys.path insert, cwd-relative tricks); between turns none of
+    # that holds — pin the workspace root for the re-import pass only.
+    workspace = snapshot["cwd"]
+    added = workspace not in sys.path
+    if added:
+        sys.path.insert(0, workspace)
+    skipped: list[str] = []
+    try:
+        for name, values in decoded:
+            try:
+                mod = importlib.import_module(name)
+            except Exception:  # noqa: BLE001
+                skipped.append(name)
+                continue
+            for attr, value in values.items():
+                try:
+                    setattr(mod, attr, value)
+                except Exception:  # noqa: BLE001
+                    skipped.append(f"{name}.{attr}")
+    finally:
+        if added:
+            try:
+                sys.path.remove(workspace)
+            except ValueError:
+                pass
+    return {"ok": True, "skipped": sorted(skipped)}
+
+
 def _start_server_watchdog() -> None:
     """Die the instant the executor server does — even while the main thread
     is blocked in jax init / jax.distributed rendezvous (where it cannot see
@@ -1042,7 +1250,8 @@ def main() -> None:
             def _reply_error():
                 if replied:
                     return
-                if isinstance(req, dict) and req.get("op") == "reset":
+                op = req.get("op") if isinstance(req, dict) else None
+                if op in ("reset", "snapshot", "restore"):
                     _reply({"ok": False})
                 else:
                     _reply({"exit_code": -2})
@@ -1059,6 +1268,10 @@ def main() -> None:
                         # device buffers while the server wipes the
                         # workspace — off the next request's critical path.
                         gc.collect()
+                elif req.get("op") == "snapshot":
+                    _reply(_snapshot_state(snapshot, req))
+                elif req.get("op") == "restore":
+                    _reply(_restore_state(snapshot, req))
                 elif req.get("op") == "batch":
                     _set_trace_id(req.get("trace_id"))
                     hits_before, misses_before = _cache_counts()

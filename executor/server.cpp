@@ -2837,6 +2837,80 @@ void handle_reset(const minihttp::Request& req, minihttp::Conn& conn) {
   conn.send_response(200, "application/json", status.dump());
 }
 
+// POST /snapshot and POST /restore — session durability: relay an
+// interpreter-state op over the warm-runner pipe. The workspace BYTES never
+// ride these routes (they ride the existing manifest-negotiated PUT/GET
+// paths, so an unchanged workspace moves zero bytes); this is only the
+// serialized interpreter state (env deltas, cwd, workspace-module globals).
+// 409 ⇒ no warm runner to snapshot/restore (cold, mid-rewarm, or the op
+// failed and killed it); the control plane treats that as "recreate fresh",
+// never as a half-restored session.
+void handle_snapshot_op(const minihttp::Request& req, minihttp::Conn& conn,
+                        bool is_restore) {
+  // Same fencing discipline as /reset: a fenced predecessor's control path
+  // must not snapshot (or worse, restore into) the successor's runner.
+  if (reject_stale_lease(req, conn)) return;
+  std::string body = conn.read_body();
+  minijson::Value parsed;
+  if (!body.empty()) {
+    try {
+      parsed = minijson::parse(body);
+    } catch (const std::exception&) {
+      conn.send_response(400, "application/json", "{\"error\":\"bad json\"}");
+      return;
+    }
+  }
+  double timeout_s = parsed.get_number("timeout", 30.0);
+  std::lock_guard<std::mutex> lock(g_state.exec_mutex);
+  auto refuse = [&conn](const char* reason) {
+    minijson::Object resp;
+    resp["ok"] = minijson::Value(false);
+    resp["reason"] = minijson::Value(std::string(reason));
+    conn.send_response(409, "application/json", minijson::Value(resp).dump());
+  };
+  if (!g_state.warm_enabled || !g_state.runner) {
+    refuse("no warm runner");
+    return;
+  }
+  if (g_warm_state.load() != kWarmReady) {
+    refuse("runner not warm");
+    return;
+  }
+  minijson::Object op;
+  if (is_restore) {
+    op["op"] = minijson::Value(std::string("restore"));
+    op["state"] = parsed.get("state");
+  } else {
+    op["op"] = minijson::Value(std::string("snapshot"));
+    double max_bytes = parsed.get_number("max_bytes", 0.0);
+    if (max_bytes > 0) op["max_bytes"] = minijson::Value(max_bytes);
+  }
+  std::lock_guard<std::mutex> rlock(g_state.runner_mutex);
+  minijson::Value response;
+  if (!g_state.runner->alive() ||
+      g_state.runner->execute(minijson::Value(op).dump(), timeout_s,
+                              response) != WarmRunner::ExecResult::kOk) {
+    // The op killed the runner (timeout/death): same state machine as a
+    // failed reset — this sandbox can no longer be trusted warm.
+    {
+      std::lock_guard<std::mutex> l(g_warm_transition_mutex);
+      g_warm_state = kWarmFailed;
+    }
+    g_warm_cv.notify_all();
+    refuse(is_restore ? "runner restore failed" : "runner snapshot failed");
+    return;
+  }
+  conn.send_response(200, "application/json", response.dump());
+}
+
+void handle_snapshot(const minihttp::Request& req, minihttp::Conn& conn) {
+  handle_snapshot_op(req, conn, /*is_restore=*/false);
+}
+
+void handle_restore(const minihttp::Request& req, minihttp::Conn& conn) {
+  handle_snapshot_op(req, conn, /*is_restore=*/true);
+}
+
 void route(const minihttp::Request& req, minihttp::Conn& conn) {
   if (req.method == "POST" && req.target == "/execute") {
     handle_execute(req, conn);
@@ -2848,6 +2922,10 @@ void route(const minihttp::Request& req, minihttp::Conn& conn) {
     handle_warmup(req, conn);
   } else if (req.method == "POST" && req.target == "/reset") {
     handle_reset(req, conn);
+  } else if (req.method == "POST" && req.target == "/snapshot") {
+    handle_snapshot(req, conn);
+  } else if (req.method == "POST" && req.target == "/restore") {
+    handle_restore(req, conn);
   } else if (req.method == "POST" && req.target == "/lease") {
     handle_lease(req, conn);
   } else if (req.method == "GET" && req.target == "/workspace-manifest") {

@@ -1,7 +1,17 @@
 #!/usr/bin/env bash
 # Regenerates the checked-in protobuf modules from /proto.
+#
+# Prefers real protoc when present; otherwise falls back to the in-repo
+# descriptor compiler (scripts/genproto_fallback.py), which covers the
+# proto3 subset the vendored contract uses and emits identical descriptors
+# (tests/unit/test_proto_pin.py holds the pin either way).
 set -euo pipefail
 cd "$(dirname "$0")/.."
-protoc --python_out=bee_code_interpreter_fs_tpu/proto -I proto \
-  proto/code_interpreter.proto proto/health.proto proto/reflection.proto
-echo "regenerated bee_code_interpreter_fs_tpu/proto/*_pb2.py"
+if command -v protoc >/dev/null 2>&1; then
+  protoc --python_out=bee_code_interpreter_fs_tpu/proto -I proto \
+    proto/code_interpreter.proto proto/health.proto proto/reflection.proto
+  echo "regenerated bee_code_interpreter_fs_tpu/proto/*_pb2.py (protoc)"
+else
+  python scripts/genproto_fallback.py
+fi
+python scripts/genproto_fallback.py --check

@@ -1,0 +1,411 @@
+#!/usr/bin/env python3
+"""protoc-free regeneration of the checked-in ``*_pb2.py`` modules.
+
+The proto contract is vendored in-repo (/proto) and the generated modules
+are checked in (bee_code_interpreter_fs_tpu/proto). Regenerating them
+needs protoc — which the runtime image does not ship (the PR 5 follow-up
+that kept the proto frozen). This script closes that gap: it compiles the
+repo's protos with the ``google.protobuf`` runtime that IS in the image —
+a small proto3 front-end producing a ``FileDescriptorProto`` and emitting
+the same ``AddSerializedFile``-style module protoc's python plugin writes.
+
+Scope is deliberately the subset the vendored contract uses: proto3,
+messages (nested), enums, oneofs, map fields, repeated/scalar/message
+fields, and services with unary/streaming methods. No imports, no
+extensions, no custom options — adding any of those to /proto means
+extending this script (or regenerating with real protoc; the emitted
+descriptors are identical, byte-escaping style aside).
+
+Usage:
+    python scripts/genproto_fallback.py           # regenerate all modules
+    python scripts/genproto_fallback.py --check   # drift gate (CI/test):
+        fail when a .proto and its checked-in _pb2.py descriptor disagree
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from pathlib import Path
+
+from google.protobuf import descriptor_pb2
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PROTO_DIR = REPO_ROOT / "proto"
+OUT_DIR = REPO_ROOT / "bee_code_interpreter_fs_tpu" / "proto"
+
+SCALARS = {
+    "double": descriptor_pb2.FieldDescriptorProto.TYPE_DOUBLE,
+    "float": descriptor_pb2.FieldDescriptorProto.TYPE_FLOAT,
+    "int64": descriptor_pb2.FieldDescriptorProto.TYPE_INT64,
+    "uint64": descriptor_pb2.FieldDescriptorProto.TYPE_UINT64,
+    "int32": descriptor_pb2.FieldDescriptorProto.TYPE_INT32,
+    "fixed64": descriptor_pb2.FieldDescriptorProto.TYPE_FIXED64,
+    "fixed32": descriptor_pb2.FieldDescriptorProto.TYPE_FIXED32,
+    "bool": descriptor_pb2.FieldDescriptorProto.TYPE_BOOL,
+    "string": descriptor_pb2.FieldDescriptorProto.TYPE_STRING,
+    "bytes": descriptor_pb2.FieldDescriptorProto.TYPE_BYTES,
+    "uint32": descriptor_pb2.FieldDescriptorProto.TYPE_UINT32,
+    "sfixed32": descriptor_pb2.FieldDescriptorProto.TYPE_SFIXED32,
+    "sfixed64": descriptor_pb2.FieldDescriptorProto.TYPE_SFIXED64,
+    "sint32": descriptor_pb2.FieldDescriptorProto.TYPE_SINT32,
+    "sint64": descriptor_pb2.FieldDescriptorProto.TYPE_SINT64,
+}
+
+_TOKEN = re.compile(
+    r'"(?:[^"\\]|\\.)*"'  # string literal
+    r"|[A-Za-z_][A-Za-z0-9_.]*"  # identifier (possibly dotted)
+    r"|\d+"  # integer
+    r"|[{}();=<>,]"  # punctuation
+)
+
+
+def tokenize(text: str) -> list[str]:
+    text = re.sub(r"//[^\n]*", "", text)
+    text = re.sub(r"/\*.*?\*/", "", text, flags=re.S)
+    return _TOKEN.findall(text)
+
+
+def camel_entry(field_name: str) -> str:
+    """protoc's map-entry message naming: snake_case -> CamelCase + Entry."""
+    return "".join(p.capitalize() for p in field_name.split("_")) + "Entry"
+
+
+class Parser:
+    def __init__(self, tokens: list[str], filename: str):
+        self.tokens = tokens
+        self.pos = 0
+        self.fd = descriptor_pb2.FileDescriptorProto(name=filename)
+        # full name -> True when enum (drives TYPE_ENUM vs TYPE_MESSAGE).
+        self.declared: dict[str, bool] = {}
+
+    # ------------------------------------------------------------- tokens
+    def next(self) -> str:
+        tok = self.tokens[self.pos]
+        self.pos += 1
+        return tok
+
+    def peek(self) -> str:
+        return self.tokens[self.pos]
+
+    def expect(self, tok: str) -> None:
+        got = self.next()
+        if got != tok:
+            raise SyntaxError(f"expected {tok!r}, got {got!r} at {self.pos}")
+
+    # -------------------------------------------------------------- parse
+    def parse(self) -> descriptor_pb2.FileDescriptorProto:
+        while self.pos < len(self.tokens):
+            kw = self.next()
+            if kw == "syntax":
+                self.expect("=")
+                syntax = self.next().strip('"')
+                self.expect(";")
+                self.fd.syntax = syntax
+            elif kw == "package":
+                self.fd.package = self.next()
+                self.expect(";")
+            elif kw == "message":
+                self.fd.message_type.append(self.parse_message([]))
+            elif kw == "enum":
+                self.fd.enum_type.append(self.parse_enum([]))
+            elif kw == "service":
+                self.fd.service.append(self.parse_service())
+            else:
+                raise SyntaxError(f"unsupported top-level {kw!r}")
+        self.resolve()
+        return self.fd
+
+    def parse_message(self, scope: list[str]) -> descriptor_pb2.DescriptorProto:
+        name = self.next()
+        msg = descriptor_pb2.DescriptorProto(name=name)
+        inner_scope = scope + [name]
+        self.declared[self.full_name(inner_scope)] = False
+        self.expect("{")
+        # Map-entry messages are appended AFTER declared nested types, in
+        # field order — protoc's layout.
+        map_entries: list[descriptor_pb2.DescriptorProto] = []
+        while self.peek() != "}":
+            kw = self.next()
+            if kw == "message":
+                msg.nested_type.append(self.parse_message(inner_scope))
+            elif kw == "enum":
+                msg.enum_type.append(self.parse_enum(inner_scope))
+            elif kw == "oneof":
+                oneof_name = self.next()
+                oneof_index = len(msg.oneof_decl)
+                msg.oneof_decl.add(name=oneof_name)
+                self.expect("{")
+                while self.peek() != "}":
+                    field = self.parse_field(self.next(), inner_scope)
+                    field.oneof_index = oneof_index
+                    msg.field.append(field)
+                self.expect("}")
+            elif kw == "map":
+                field, entry = self.parse_map_field(inner_scope)
+                msg.field.append(field)
+                map_entries.append(entry)
+            else:
+                msg.field.append(self.parse_field(kw, inner_scope))
+        self.expect("}")
+        msg.nested_type.extend(map_entries)
+        return msg
+
+    def parse_field(
+        self, first: str, scope: list[str]
+    ) -> descriptor_pb2.FieldDescriptorProto:
+        field = descriptor_pb2.FieldDescriptorProto(
+            label=descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+        )
+        if first == "repeated":
+            field.label = descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED
+            first = self.next()
+        self.set_type(field, first, scope)
+        field.name = self.next()
+        self.expect("=")
+        field.number = int(self.next())
+        self.expect(";")
+        return field
+
+    def parse_map_field(self, scope: list[str]):
+        self.expect("<")
+        key_type = self.next()
+        self.expect(",")
+        value_type = self.next()
+        self.expect(">")
+        name = self.next()
+        self.expect("=")
+        number = int(self.next())
+        self.expect(";")
+        entry = descriptor_pb2.DescriptorProto(name=camel_entry(name))
+        entry.options.map_entry = True
+        key = entry.field.add(
+            name="key",
+            number=1,
+            label=descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL,
+        )
+        self.set_type(key, key_type, scope)
+        value = entry.field.add(
+            name="value",
+            number=2,
+            label=descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL,
+        )
+        self.set_type(value, value_type, scope)
+        field = descriptor_pb2.FieldDescriptorProto(
+            name=name,
+            number=number,
+            label=descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED,
+            type=descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE,
+            type_name="." + self.full_name(scope + [entry.name]),
+        )
+        return field, entry
+
+    def parse_enum(self, scope: list[str]) -> descriptor_pb2.EnumDescriptorProto:
+        name = self.next()
+        enum = descriptor_pb2.EnumDescriptorProto(name=name)
+        self.declared[self.full_name(scope + [name])] = True
+        self.expect("{")
+        while self.peek() != "}":
+            value_name = self.next()
+            self.expect("=")
+            enum.value.add(name=value_name, number=int(self.next()))
+            self.expect(";")
+        self.expect("}")
+        return enum
+
+    def parse_service(self) -> descriptor_pb2.ServiceDescriptorProto:
+        service = descriptor_pb2.ServiceDescriptorProto(name=self.next())
+        self.expect("{")
+        while self.peek() != "}":
+            self.expect("rpc")
+            method = service.method.add(name=self.next())
+            self.expect("(")
+            if self.peek() == "stream":
+                self.next()
+                method.client_streaming = True
+            method.input_type = self.qualify(self.next())
+            self.expect(")")
+            self.expect("returns")
+            self.expect("(")
+            if self.peek() == "stream":
+                self.next()
+                method.server_streaming = True
+            method.output_type = self.qualify(self.next())
+            self.expect(")")
+            self.expect(";")
+        self.expect("}")
+        return service
+
+    # ------------------------------------------------------------ resolve
+    def full_name(self, path: list[str]) -> str:
+        return ".".join(([self.fd.package] if self.fd.package else []) + path)
+
+    def qualify(self, name: str) -> str:
+        """Service method types: all local to this file's package."""
+        return "." + self.full_name([name])
+
+    def set_type(self, field, type_name: str, scope: list[str]) -> None:
+        if type_name in SCALARS:
+            field.type = SCALARS[type_name]
+        else:
+            # Proto containers copy on append, so a deferred fixup can't
+            # hold a reference to the field — stash the raw name (no
+            # leading dot = unresolved marker) and the scope in json_name
+            # for the resolve pass, which walks the finished tree.
+            field.type_name = type_name
+            field.json_name = "/".join(scope)
+
+    def resolve(self) -> None:
+        def fix(msg) -> None:
+            for field in msg.field:
+                if field.type_name and not field.type_name.startswith("."):
+                    raw, scope = field.type_name, field.json_name.split("/")
+                    # Innermost scope outward — the subset of protobuf
+                    # scoping the vendored contract needs (file-local).
+                    for depth in range(len(scope), -1, -1):
+                        candidate = self.full_name(
+                            scope[:depth] + raw.split(".")
+                        )
+                        if candidate in self.declared:
+                            field.type_name = "." + candidate
+                            field.type = (
+                                descriptor_pb2.FieldDescriptorProto.TYPE_ENUM
+                                if self.declared[candidate]
+                                else descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE
+                            )
+                            field.ClearField("json_name")
+                            break
+                    else:
+                        raise SyntaxError(f"unresolved type {raw!r} in {scope}")
+            for nested in msg.nested_type:
+                fix(nested)
+
+        for msg in self.fd.message_type:
+            fix(msg)
+
+
+def compile_proto(path: Path) -> descriptor_pb2.FileDescriptorProto:
+    return Parser(tokenize(path.read_text()), path.name).parse()
+
+
+# ------------------------------------------------------------------- emit
+
+HEADER = '''# -*- coding: utf-8 -*-
+# Generated by the protocol buffer compiler.  DO NOT EDIT!
+# source: {source}
+"""Generated protocol buffer code."""
+from google.protobuf.internal import builder as _builder
+from google.protobuf import descriptor as _descriptor
+from google.protobuf import descriptor_pool as _descriptor_pool
+from google.protobuf import symbol_database as _symbol_database
+# @@protoc_insertion_point(imports)
+
+_sym_db = _symbol_database.Default()
+
+
+
+
+DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile({blob!r})
+
+_builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
+_builder.BuildTopDescriptorsAndMessages(DESCRIPTOR, {module!r}, globals())
+if _descriptor._USE_C_DESCRIPTORS == False:
+
+  DESCRIPTOR._options = None
+'''
+
+
+def mangle(path: list[str]) -> str:
+    return "_" + "_".join(p.upper() for p in path)
+
+
+def walk_messages(fd):
+    def rec(msg, path):
+        path = path + [msg.name]
+        yield path, msg
+        for nested in msg.nested_type:
+            yield from rec(nested, path)
+
+    for msg in fd.message_type:
+        yield from rec(msg, [])
+
+
+def emit_pb2(fd: descriptor_pb2.FileDescriptorProto, module: str) -> str:
+    blob = fd.SerializeToString()
+    options_lines: list[str] = []
+    offset_lines: list[str] = []
+
+    def offsets(path: list[str], sub) -> None:
+        serialized = sub.SerializeToString()
+        start = blob.find(serialized)
+        name = mangle(path)
+        offset_lines.append(f"  {name}._serialized_start={start}")
+        offset_lines.append(f"  {name}._serialized_end={start + len(serialized)}")
+
+    for path, msg in walk_messages(fd):
+        if msg.options.map_entry:
+            name = mangle(path)
+            options_lines.append(f"  {name}._options = None")
+            options_lines.append(
+                f"  {name}._serialized_options = b'8\\001'"
+            )
+    for path, msg in walk_messages(fd):
+        offsets(path, msg)
+        for enum in msg.enum_type:
+            offsets(path + [enum.name], enum)
+    for enum in fd.enum_type:
+        offsets([enum.name], enum)
+    for service in fd.service:
+        offsets([service.name], service)
+
+    return (
+        HEADER.format(source=fd.name, blob=blob, module=module)
+        + "\n".join(options_lines + offset_lines)
+        + "\n# @@protoc_insertion_point(module_scope)\n"
+    )
+
+
+# ------------------------------------------------------------------- main
+
+
+def checked_in_descriptor(stem: str) -> descriptor_pb2.FileDescriptorProto:
+    """Pull the serialized descriptor out of the checked-in module without
+    importing it (imports would collide in the default descriptor pool)."""
+    text = (OUT_DIR / f"{stem}_pb2.py").read_text()
+    match = re.search(r"AddSerializedFile\((b'(?:[^'\\]|\\.)*')\)", text)
+    if match is None:
+        raise RuntimeError(f"no AddSerializedFile literal in {stem}_pb2.py")
+    fd = descriptor_pb2.FileDescriptorProto()
+    fd.MergeFromString(eval(match.group(1)))  # noqa: S307 — repo-owned literal
+    return fd
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="verify the checked-in modules match /proto (drift gate)",
+    )
+    args = parser.parse_args()
+    drift = False
+    for proto in sorted(PROTO_DIR.glob("*.proto")):
+        stem = proto.stem
+        fd = compile_proto(proto)
+        if args.check:
+            pinned = checked_in_descriptor(stem)
+            if fd != pinned:
+                drift = True
+                print(f"DRIFT: {proto.name} != {stem}_pb2.py", file=sys.stderr)
+            else:
+                print(f"ok: {proto.name}")
+        else:
+            out = OUT_DIR / f"{stem}_pb2.py"
+            out.write_text(emit_pb2(fd, f"{stem}_pb2"))
+            print(f"wrote {out.relative_to(REPO_ROOT)}")
+    return 1 if drift else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

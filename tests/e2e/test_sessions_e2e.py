@@ -142,3 +142,56 @@ async def test_session_timeout_kill_ends_session(stack):
     )
     assert fresh.exit_code == 0, fresh.stderr
     assert fresh.stdout.strip() == "False"
+
+
+async def test_session_hibernate_restore_round_trip(stack, tmp_path):
+    """The durability plane end-to-end: a session that mutated interpreter
+    state (env var) and its workspace is hibernated (sandbox disposed, chip
+    released), then lazily restored onto a FRESH sandbox — env and file
+    byte-exact, session_seq continuous, restore phase reported."""
+    executor, backend = stack
+    executor.config.session_hibernate_idle_seconds = 0.05
+
+    first = await executor.execute(
+        "import os\n"
+        "os.environ['DURABLE_E2E'] = 'survives'\n"
+        "open('notes.txt', 'w').write('hibernated bytes')\n"
+        "print(os.getpid())\n",
+        executor_id="sess-hib",
+    )
+    assert first.exit_code == 0, first.stderr
+
+    await asyncio.sleep(0.2)
+    assert await executor.sweep_sessions() == 1
+    await _settle(executor)
+    assert "sess-hib" not in executor._sessions
+    assert sum(executor._session_held.values()) == 0
+    assert executor.session_store.entry_count() == 1
+
+    # The disposed sandbox went through /reset (env + workspace wiped)
+    # before returning to the pool, so seeing the state back proves it
+    # rode the checkpoint — whichever warm process serves the restore.
+    back = await executor.execute(
+        "import os\n"
+        "print(os.environ.get('DURABLE_E2E'))\n"
+        "print(open('notes.txt').read())\n",
+        executor_id="sess-hib",
+    )
+    assert back.exit_code == 0, back.stderr
+    lines = back.stdout.splitlines()
+    assert lines[0] == "survives"
+    assert lines[1] == "hibernated bytes"
+    assert back.session_seq == 2
+    assert "restore" in back.phases
+
+    # Close wipes the live session AND the checkpoint: the id restarts
+    # honestly from scratch.
+    assert await executor.close_session("sess-hib") is True
+    await _settle(executor)
+    assert executor.session_store.entry_count() == 0
+    fresh = await executor.execute(
+        "import os; print(os.path.exists('notes.txt'))",
+        executor_id="sess-hib",
+    )
+    assert fresh.session_seq == 1
+    assert fresh.stdout.strip() == "False"

@@ -1034,3 +1034,95 @@ def test_stale_lease_claim_refused_with_typed_409(executor):
     assert r.status_code == 409
     assert r.json()["error"] == "lease_already_recorded"
     assert client.get("/device-stats").json()["lease_token"] == "lane-0:2"
+
+
+def test_snapshot_restore_round_trips_interpreter_state(executor):
+    """The session-durability wire protocol against the real binary: a turn
+    mutates the interpreter (env var + workspace-module global), /snapshot
+    captures it, /reset wipes it, and /restore on a re-uploaded workspace
+    brings it back byte-for-byte. This is exactly the hibernate -> evict ->
+    lazy-restore path the control plane drives."""
+    client, ws = executor
+    client.post("/reset")
+    assert client.put("/workspace/durmod.py", content=b"counter = 0\n").status_code == 200
+    # Workspace-module imports resolve however user code arranges them —
+    # here the usual cwd insert (cwd IS the workspace in the warm runner).
+    result = execute(
+        client,
+        "import os, sys\nsys.path.insert(0, os.getcwd())\nimport durmod\n"
+        "os.environ['DURABLE_PROBE'] = '42'\ndurmod.counter = 7\n",
+    )
+    assert result["exit_code"] == 0, result
+
+    snap = client.post("/snapshot", json={})
+    assert snap.status_code == 200, snap.text
+    body = snap.json()
+    assert body["ok"] is True
+    state = body["state"]
+    assert state["env_set"]["DURABLE_PROBE"] == "42"
+    assert "durmod" in [m["name"] for m in state["modules"]]
+
+    # Reset = the hibernate dispose: env gone, workspace gone, modules gone.
+    assert client.post("/reset").json()["ok"] is True
+    wiped = execute(client, "import os; print(os.environ.get('DURABLE_PROBE'))")
+    assert wiped["stdout"] == "None\n"
+    assert not (ws / "durmod.py").exists()
+
+    # Restore = what _restore_session does: workspace files first, then the
+    # interpreter overlay.
+    client.put("/workspace/durmod.py", content=b"counter = 0\n")
+    rest = client.post("/restore", json={"state": state})
+    assert rest.status_code == 200, rest.text
+    assert rest.json()["ok"] is True
+    back = execute(
+        client,
+        "import os, sys\nsys.path.insert(0, os.getcwd())\nimport durmod\n"
+        "print(os.environ['DURABLE_PROBE'], durmod.counter)",
+    )
+    assert back["stdout"] == "42 7\n"
+    client.post("/reset")
+
+
+def test_restore_refusals_leave_runner_untouched(executor):
+    """Corrupt or version-skewed state is refused typed BEFORE any mutation
+    lands — the never-half-restored invariant at the runner boundary. The
+    runner must keep serving normally afterwards."""
+    client, _ = executor
+    client.post("/reset")
+    execute(client, "import os; os.environ['CANARY'] = 'intact'")
+    r = client.post("/restore", json={"state": {"version": 99}})
+    assert r.status_code == 200
+    assert r.json() == {"ok": False, "reason": "bad_state_version"}
+    r = client.post(
+        "/restore",
+        json={
+            "state": {
+                "version": 1,
+                "env_set": {},
+                "env_del": [],
+                "cwd": ".",
+                "modules": [{"name": "x", "values": {"v": "!!!not-base64!!!"}}],
+            }
+        },
+    )
+    assert r.status_code == 200
+    assert r.json() == {"ok": False, "reason": "corrupt_state"}
+    # Neither refusal disturbed the live interpreter.
+    result = execute(client, "import os; print(os.environ['CANARY'])")
+    assert result["stdout"] == "intact\n"
+    client.post("/reset")
+
+
+def test_snapshot_respects_max_bytes_budget(executor):
+    """An oversized interpreter refuses to snapshot (state_too_large) rather
+    than shipping an unbounded blob to the control plane; the session then
+    just stays resident instead of hibernating."""
+    client, _ = executor
+    client.post("/reset")
+    execute(client, "import os; os.environ['BIG'] = 'x' * 4096")
+    r = client.post("/snapshot", json={"max_bytes": 1})
+    assert r.status_code == 200
+    assert r.json() == {"ok": False, "reason": "state_too_large"}
+    # An adequate budget still snapshots the same interpreter.
+    assert client.post("/snapshot", json={}).json()["ok"] is True
+    client.post("/reset")

@@ -1,0 +1,63 @@
+"""The proto pin: the vendored /proto contract and the checked-in
+``*_pb2.py`` modules must describe the same wire format. The carried PR 5
+follow-up ("proto frozen — no protoc in the image") is closed by
+scripts/genproto_fallback.py, an in-image descriptor compiler; this gate
+keeps the pair from drifting either way — edit a .proto without
+regenerating (or hand-edit a pb2) and this fails.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+SCRIPTS = REPO_ROOT / "scripts"
+
+sys.path.insert(0, str(SCRIPTS))
+
+from genproto_fallback import (  # noqa: E402
+    PROTO_DIR,
+    checked_in_descriptor,
+    compile_proto,
+)
+
+PROTOS = sorted(p.stem for p in PROTO_DIR.glob("*.proto"))
+
+
+@pytest.mark.parametrize("stem", PROTOS)
+def test_checked_in_pb2_matches_proto(stem):
+    assert compile_proto(PROTO_DIR / f"{stem}.proto") == checked_in_descriptor(
+        stem
+    ), (
+        f"{stem}.proto and {stem}_pb2.py disagree — run "
+        "scripts/genproto.sh to regenerate"
+    )
+
+
+def test_genproto_check_mode_passes():
+    """The same gate via the script's own CLI (what genproto.sh runs)."""
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPTS / "genproto_fallback.py"), "--check"],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_truncation_flags_ride_the_wire():
+    """The PR 5 carried fields are real wire surface: serialized by one
+    side, parsed by the other, distinct tags from their neighbors."""
+    from bee_code_interpreter_fs_tpu.proto import code_interpreter_pb2 as pb2
+
+    response = pb2.ExecuteResponse(
+        stdout="partial", stdout_truncated=True, session_seq=4
+    )
+    back = pb2.ExecuteResponse.FromString(response.SerializeToString())
+    assert back.stdout_truncated is True
+    assert back.stderr_truncated is False
+    assert back.session_seq == 4
+    fields = pb2.ExecuteResponse.DESCRIPTOR.fields_by_name
+    assert fields["stdout_truncated"].number == 7
+    assert fields["stderr_truncated"].number == 8

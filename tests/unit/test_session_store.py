@@ -1,0 +1,221 @@
+"""SessionStore unit tests (services/session_store.py): the durable record
+set behind hibernate/restore/migrate. The trust-model invariants live here —
+blob-durable-before-index-mutate, monotonic-seq first-write-wins,
+self-verifying load (any missing byte evicts and returns None), per-tenant
+key scope, and the kill switch's no-IO posture.
+"""
+
+import json
+import os
+
+import pytest
+
+from bee_code_interpreter_fs_tpu.services.session_store import (
+    ANON_SCOPE,
+    SESSION_NS,
+    RECORD_VERSION,
+    SessionStore,
+    session_key,
+)
+from bee_code_interpreter_fs_tpu.services.state_store import InMemoryStateStore
+from bee_code_interpreter_fs_tpu.services.storage import Storage
+
+
+class Clock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+def make_store(tmp_path, **kwargs):
+    state = kwargs.pop("state", None) or InMemoryStateStore()
+    workspace = kwargs.pop("workspace", None)
+    if workspace is None:
+        workspace = Storage(tmp_path / "workspace-objects")
+    clock = kwargs.pop("clock", None) or Clock()
+    store = SessionStore(
+        tmp_path / "session-store",
+        state,
+        workspace,
+        clock=clock,
+        **kwargs,
+    )
+    return store, state, workspace, clock
+
+
+INTERP = {"version": 1, "env_set": {"X": "1"}, "env_del": [], "cwd": "/w"}
+
+
+async def save_one(store, workspace, *, tenant="t1", seq=3, files=None):
+    files = files if files is not None else {"a.txt": None}
+    ws = {}
+    for rel in files:
+        ws[rel] = files[rel] or await workspace.write(f"bytes:{rel}".encode())
+    outcome = await store.save(
+        tenant,
+        "sess-a",
+        lane=4,
+        seq=seq,
+        interp_state=INTERP,
+        workspace=ws,
+    )
+    return outcome, ws
+
+
+async def test_save_load_round_trip(tmp_path):
+    store, state, workspace, _ = make_store(tmp_path)
+    outcome, ws = await save_one(store, workspace)
+    assert outcome == "admitted"
+    record = await store.load("t1", "sess-a")
+    assert record is not None
+    assert record["seq"] == 3
+    assert record["lane"] == 4
+    assert record["interp"] == INTERP
+    assert record["workspace"] == ws
+    assert record["version"] == RECORD_VERSION
+    assert store.snapshot() == {
+        "enabled": True,
+        "hibernated": 1,
+        "saves": 1,
+        "restores": 0,
+        "conflicts": 0,
+        "evictions": 0,
+    }
+
+
+async def test_kill_switch_no_dirs_no_records(tmp_path):
+    store, state, workspace, _ = make_store(tmp_path, enabled=False)
+    outcome, _ = await save_one(store, workspace)
+    assert outcome == "error"
+    assert await store.load("t1", "sess-a") is None
+    assert await store.delete("t1", "sess-a") is False
+    assert store.sweep_expired() == 0
+    assert store.entry_count() == 0
+    assert store.snapshot() == {"enabled": False}
+    # The no-IO posture: the store directory was never created.
+    assert not (tmp_path / "session-store").exists()
+    assert state.items(SESSION_NS) == {}
+
+
+async def test_tenant_scope_isolates_records(tmp_path):
+    store, _, workspace, _ = make_store(tmp_path)
+    await save_one(store, workspace, tenant="t1")
+    # Another tenant's identical executor_id resolves NOTHING.
+    assert await store.load("t2", "sess-a") is None
+    assert await store.load(None, "sess-a") is None
+    assert await store.load("t1", "sess-a") is not None
+    assert session_key(None, "x") == f"{ANON_SCOPE}/x"
+
+
+async def test_stale_seq_rejected_first_write_wins(tmp_path):
+    store, _, workspace, _ = make_store(tmp_path)
+    outcome, _ = await save_one(store, workspace, seq=5)
+    assert outcome == "admitted"
+    # Same seq: not NEWER — a late writer racing the admitted checkpoint.
+    outcome, _ = await save_one(store, workspace, seq=5)
+    assert outcome == "stale"
+    outcome, _ = await save_one(store, workspace, seq=4)
+    assert outcome == "stale"
+    assert store.conflicts == 2
+    # A genuinely newer checkpoint replaces the record.
+    outcome, _ = await save_one(store, workspace, seq=6)
+    assert outcome == "admitted"
+    record = await store.load("t1", "sess-a")
+    assert record["seq"] == 6
+
+
+async def test_blob_durable_before_index(tmp_path):
+    """The chaos-leg ordering invariant, asserted structurally: every index
+    entry's record object must already exist with parseable content — a
+    drop between blob write and index mutate leaves an orphan object,
+    never an entry pointing at missing bytes."""
+    store, state, workspace, _ = make_store(tmp_path)
+    await save_one(store, workspace)
+    for entry in state.items(SESSION_NS).values():
+        blob = await store.storage.read(entry["record"])
+        assert json.loads(blob)["executor_id"] == "sess-a"
+
+
+async def test_corrupt_blob_evicts_on_load(tmp_path):
+    store, state, workspace, _ = make_store(tmp_path)
+    await save_one(store, workspace)
+    entry = state.get(SESSION_NS, session_key("t1", "sess-a"))
+    (store.storage.path / entry["record"]).write_bytes(b"not json{{{")
+    assert await store.load("t1", "sess-a") is None
+    # Evicted, not retried forever: the index entry is gone.
+    assert state.get(SESSION_NS, session_key("t1", "sess-a")) is None
+    assert store.evictions == 1
+
+
+async def test_missing_blob_evicts_on_load(tmp_path):
+    store, state, workspace, _ = make_store(tmp_path)
+    await save_one(store, workspace)
+    entry = state.get(SESSION_NS, session_key("t1", "sess-a"))
+    os.unlink(store.storage.path / entry["record"])
+    assert await store.load("t1", "sess-a") is None
+    assert store.entry_count() == 0
+
+
+async def test_missing_workspace_object_evicts_on_load(tmp_path):
+    """A restore must never hand a sandbox object ids whose bytes are gone
+    from the shared workspace store."""
+    store, state, workspace, _ = make_store(tmp_path)
+    _, ws = await save_one(store, workspace)
+    await workspace.delete(next(iter(ws.values())))
+    assert await store.load("t1", "sess-a") is None
+    assert store.entry_count() == 0
+
+
+async def test_version_mismatch_evicts(tmp_path):
+    store, state, workspace, _ = make_store(tmp_path)
+    await save_one(store, workspace)
+    key = session_key("t1", "sess-a")
+    entry = state.get(SESSION_NS, key)
+    record = json.loads(await store.storage.read(entry["record"]))
+    record["version"] = RECORD_VERSION + 1
+    blob = json.dumps(record, sort_keys=True).encode()
+    object_id = await store.storage.write(blob)
+    entry["record"] = object_id
+    state.put(SESSION_NS, key, entry)
+    assert await store.load("t1", "sess-a") is None
+    assert store.entry_count() == 0
+
+
+async def test_ttl_expiry_on_load_and_sweep(tmp_path):
+    store, state, workspace, clock = make_store(tmp_path, record_ttl=60.0)
+    await save_one(store, workspace)
+    clock.now += 61.0
+    assert await store.load("t1", "sess-a") is None
+    assert store.entry_count() == 0
+    # Sweep-driven pruning for records nobody ever loads.
+    await save_one(store, workspace, seq=9)
+    clock.now += 61.0
+    assert store.sweep_expired() == 1
+    assert store.entry_count() == 0
+
+
+async def test_entry_cap_evicts_oldest(tmp_path):
+    store, state, workspace, clock = make_store(tmp_path, max_entries=2)
+    for i, executor_id in enumerate(["s1", "s2", "s3"]):
+        clock.now += 1.0
+        ws = {"f": await workspace.write(f"b{i}".encode())}
+        assert (
+            await store.save(
+                "t", executor_id, lane=0, seq=1, interp_state={}, workspace=ws
+            )
+            == "admitted"
+        )
+    assert store.entry_count() == 2
+    # Oldest-saved victim: s1 is gone, the newer two survive.
+    assert state.get(SESSION_NS, session_key("t", "s1")) is None
+    assert await store.load("t", "s3") is not None
+
+
+async def test_delete_reports_whether_record_existed(tmp_path):
+    store, _, workspace, _ = make_store(tmp_path)
+    await save_one(store, workspace)
+    assert await store.delete("t1", "sess-a") is True
+    assert await store.delete("t1", "sess-a") is False
+    assert await store.load("t1", "sess-a") is None

@@ -1,0 +1,224 @@
+"""Chaos-seeded session-durability tests: wire drops mid-snapshot and
+mid-restore must never admit a partial record nor serve a half-restored
+session. Runs under the CI chaos matrix (CHAOS_SEED in {7, 23, 1337}) —
+every seed drives a different fault schedule against the SAME invariants:
+
+- **Index integrity**: every index entry in the store points at a blob
+  that exists, decodes, and matches the entry's seq (blob-durable-before-
+  index-mutate means a drop leaves at worst an orphan object).
+- **Seq honesty**: every turn a client sees succeeds with session_seq
+  exactly previous+1 (continuity through hibernate/restore) or exactly 1
+  (an honest fresh start after a refused/evicted record) — never a value
+  that silently pretends state survived when it did not.
+"""
+
+import json
+import os
+import random
+
+from fakes import FakeBackend
+from test_session_durability import (
+    age_session,
+    make_executor,
+    settle,
+)
+
+from bee_code_interpreter_fs_tpu.services.code_executor import ExecutorError
+from bee_code_interpreter_fs_tpu.services.session_store import (
+    RECORD_VERSION,
+    SESSION_NS,
+)
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "7"))
+
+
+async def assert_index_integrity(store):
+    """The chaos invariant, checked structurally after every fault: no
+    index entry may ever point at missing or partial bytes."""
+    for key, entry in store.state.items(SESSION_NS).items():
+        assert isinstance(entry, dict), f"non-dict index entry at {key}"
+        blob = await store.storage.read(entry["record"])
+        record = json.loads(blob)
+        assert record["version"] == RECORD_VERSION
+        assert record["seq"] == entry["seq"]
+        assert record["executor_id"] == key.rsplit("/", 1)[1]
+
+
+async def test_save_storm_with_wire_drops_never_admits_partial(tmp_path):
+    """Seeded faults at BOTH durability steps — the blob write (drop
+    mid-snapshot upload) and the index mutate (drop between blob and
+    admit) — across a randomized save/load/delete storm. A failed save
+    reports `error` and leaves the previously admitted record fully
+    servable; a won save is fully durable."""
+    rng = random.Random(CHAOS_SEED)
+    backend = FakeBackend()
+    executor, _, _ = make_executor(backend, tmp_path)
+    store = executor.session_store
+    try:
+        real_write = store.storage.write
+        real_mutate = store.state.mutate
+
+        async def chaos_write(blob):
+            if rng.random() < 0.3:
+                raise OSError("chaos: connection dropped mid-checkpoint")
+            return await real_write(blob)
+
+        def chaos_mutate(ns, key, fn):
+            if rng.random() < 0.3:
+                raise RuntimeError("chaos: index store dropped the admit")
+            return real_mutate(ns, key, fn)
+
+        store.storage.write = chaos_write
+        store.state.mutate = chaos_mutate
+
+        admitted: dict[tuple, int] = {}
+        for step in range(120):
+            tenant = rng.choice(["t1", "t2", None])
+            executor_id = rng.choice(["s1", "s2", "s3"])
+            ident = (tenant, executor_id)
+            roll = rng.random()
+            if roll < 0.6:
+                seq = rng.randint(1, 12)
+                outcome = await store.save(
+                    tenant,
+                    executor_id,
+                    lane=rng.randint(0, 3),
+                    seq=seq,
+                    interp_state={"version": 1, "step": step},
+                    workspace={},
+                )
+                if outcome == "admitted":
+                    # First-write-wins demands the admitted seq was newer.
+                    assert seq > admitted.get(ident, 0)
+                    admitted[ident] = seq
+                elif outcome == "stale":
+                    assert seq <= admitted.get(ident, 0)
+                else:
+                    assert outcome == "error"
+            elif roll < 0.85:
+                record = await store.load(tenant, executor_id)
+                if record is not None:
+                    assert record["seq"] == admitted[ident]
+                    assert record["interp"]["version"] == 1
+            else:
+                if await store.delete(tenant, executor_id):
+                    admitted.pop(ident, None)
+            await assert_index_integrity(store)
+
+        # Post-storm: with faults off, every surviving record loads whole.
+        store.storage.write = real_write
+        store.state.mutate = real_mutate
+        for (tenant, executor_id), seq in list(admitted.items()):
+            record = await store.load(tenant, executor_id)
+            assert record is not None and record["seq"] == seq
+    finally:
+        await executor.close()
+
+
+async def test_session_lifecycle_survives_checkpoint_faults(tmp_path):
+    """Seeded wire drops around the full hibernate/restore lifecycle at
+    the orchestrator level: snapshot drops leave the session parked (no
+    record, chip still held), restore wire drops keep the record for a
+    byte-exact retry, corrupt-state refusals recreate fresh — and through
+    all of it every successful turn's seq is previous+1 or an honest 1."""
+    rng = random.Random(CHAOS_SEED)
+    backend = FakeBackend(capacity=4)
+    executor, server, plane = make_executor(backend, tmp_path)
+    sessions = ["chaos-a", "chaos-b", "chaos-c"]
+    last_seq = {sid: 0 for sid in sessions}
+    try:
+        for _ in range(40):
+            sid = rng.choice(sessions)
+            # Arm at most one fault; an unconsumed fault stays armed and
+            # fires at whatever checkpoint op comes next — exactly how
+            # real wire trouble arrives.
+            if rng.random() < 0.35:
+                fault = rng.choice(["snapshot", "restore", "corrupt"])
+                if fault == "snapshot":
+                    plane.snapshot_error = ExecutorError(
+                        "chaos: dropped mid-snapshot"
+                    )
+                elif fault == "restore":
+                    plane.restore_error = ExecutorError(
+                        "chaos: dropped mid-restore"
+                    )
+                else:
+                    plane.restore_reply = {
+                        "ok": False,
+                        "reason": "corrupt_state",
+                    }
+            if rng.random() < 0.6:
+                try:
+                    result = await executor.execute("x", executor_id=sid)
+                except ExecutorError:
+                    # A wire drop mid-restore fails the turn; the record
+                    # must survive for the retry (asserted structurally
+                    # below and by later seq continuity).
+                    await settle(executor)
+                else:
+                    seq = result.session_seq
+                    assert seq in (last_seq[sid] + 1, 1), (
+                        f"{sid}: seq {seq} after {last_seq[sid]} — a "
+                        "half-restored session leaked through"
+                    )
+                    last_seq[sid] = seq
+            elif sid in executor._sessions:
+                age_session(
+                    executor,
+                    sid,
+                    executor.config.session_hibernate_idle_seconds + 1.0,
+                )
+                await executor.sweep_sessions()
+                await settle(executor)
+            await assert_index_integrity(executor.session_store)
+
+        # Quiesce: faults off, every session must serve a coherent next
+        # turn (continuity where a record survived, honest 1 where not).
+        plane.snapshot_error = None
+        plane.restore_error = None
+        plane.restore_reply = None
+        for sid in sessions:
+            result = await executor.execute("x", executor_id=sid)
+            assert result.session_seq in (last_seq[sid] + 1, 1)
+            last_seq[sid] = result.session_seq
+        await assert_index_integrity(executor.session_store)
+    finally:
+        await executor.close()
+
+
+async def test_restore_retry_after_drop_is_byte_exact(tmp_path):
+    """A focused loop on the nastiest interleave: hibernate, drop the
+    restore mid-wire N times, then let it through — the state that finally
+    lands must be byte-identical to what the snapshot captured, however
+    many drops preceded it."""
+    rng = random.Random(CHAOS_SEED)
+    backend = FakeBackend()
+    executor, server, plane = make_executor(backend, tmp_path)
+    try:
+        await executor.execute("x", executor_id="sess-exact")
+        age_session(
+            executor,
+            "sess-exact",
+            executor.config.session_hibernate_idle_seconds + 1.0,
+        )
+        await executor.sweep_sessions()
+        await settle(executor)
+        assert executor.session_store.entry_count() == 1
+
+        drops = rng.randint(1, 4)
+        for _ in range(drops):
+            plane.restore_error = ExecutorError("chaos: dropped mid-restore")
+            try:
+                await executor.execute("x", executor_id="sess-exact")
+            except ExecutorError:
+                pass
+            await settle(executor)
+            # The record survives every drop, fully servable.
+            await assert_index_integrity(executor.session_store)
+            assert executor.session_store.entry_count() == 1
+
+        result = await executor.execute("x", executor_id="sess-exact")
+        assert result.session_seq == 2
+        assert plane.restored == [dict(plane.STATE)]
+    finally:
+        await executor.close()

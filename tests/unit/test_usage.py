@@ -589,6 +589,7 @@ def test_phase_histogram_allowlist_blocks_non_latency_keys(tmp_path):
             "upload": 0.01,
             "exec": 1.0,
             "download": 0.02,
+            "restore": 0.03,
             # ...the new usage attribution fields (day-one requirement)...
             "chip_seconds": 8.0,
             "device_op_seconds": 2.0,
@@ -615,7 +616,7 @@ def test_phase_histogram_allowlist_blocks_non_latency_keys(tmp_path):
     total_sum = sum(
         s for _labels, _counts, s, _total in executor.metrics.phase_seconds.samples()
     )
-    assert total_sum == pytest.approx(0.1 + 0.01 + 1.0 + 0.02)
+    assert total_sum == pytest.approx(0.1 + 0.01 + 1.0 + 0.02 + 0.03)
 
 
 # ----------------------------------------------------------- metric families
