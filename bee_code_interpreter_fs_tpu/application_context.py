@@ -77,7 +77,15 @@ class ApplicationContext:
         store — single-replica mode, today's behavior byte-for-byte."""
         from .services.state_store import make_state_store
 
-        return make_state_store(self.config)
+        store = make_state_store(self.config)
+        # The resilient wrapper's degraded-path events feed the
+        # store_degraded_ops counter (outage / degraded_op / replay) —
+        # any movement outside a chaos drill is a page.
+        if hasattr(store, "_on_event"):
+            store._on_event = lambda event: self.metrics.store_degraded_ops.inc(
+                event=event
+            )
+        return store
 
     @cached_property
     def session_router(self):
@@ -110,7 +118,13 @@ class ApplicationContext:
         from .services.quotas import QuotaEnforcer
 
         return QuotaEnforcer(
-            self.config, usage=self.usage_ledger, metrics=self.metrics
+            self.config,
+            usage=self.usage_ledger,
+            metrics=self.metrics,
+            # With a SHARED store the enforcer publishes accrual into the
+            # fleet-window buckets and admits on max(local, fleet); the
+            # private default leaves admission purely local.
+            store=self.state_store,
         )
 
     @cached_property

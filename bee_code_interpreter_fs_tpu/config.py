@@ -247,6 +247,14 @@ class Config(BaseModel):
     # re-arms until the target is met. 0 = uncapped (the historic
     # behavior).
     pool_spawn_burst: int = 4
+    # Weight of HIBERNATED-session demand in the autoscale model: each
+    # hibernated session whose wake would land in this lane contributes
+    # this many warm sandboxes' worth of expected demand (services/
+    # session_store.py surfaces the per-lane count). 0.0 (default) keeps
+    # the signal visible in /statusz but out of the targets — hibernated
+    # supply stays silently-freed capacity, today's behavior. ~0.1 means
+    # ten parked sessions justify one warm sandbox held for their wakes.
+    pool_hibernated_wake_weight: float = 0.0
     # Deterministic fault-injection plan for chaos runs, e.g.
     # "spawn_fail:0.3,seed:7" (grammar in services/backends/faults.py).
     # Empty = no injection. NEVER set in production.
@@ -568,7 +576,38 @@ class Config(BaseModel):
     # one path on a shared volume and they cooperate — WFQ tags stay
     # globally fair, a breaker tripped on one replica is open on all,
     # a host fenced by one is never granted by another.
+    # "redis://host:port[/db]" = the dependency-free RESP adapter: replicas
+    # on DIFFERENT nodes share one Redis-compatible server (or the in-repo
+    # services/resp_stub.py), taking the control plane past the single-node
+    # SQLite boundary.
     state_store: str = ""
+    # Wrap SHARED stores in the degraded-mode layer (ResilientStateStore):
+    # a store-health breaker plus the per-namespace fail-open/fail-closed
+    # policy that keeps the fleet serving through a store outage. The
+    # private in-memory default is never wrapped — single-replica wiring
+    # stays byte-for-byte. Disable only in tests that want raw store
+    # errors to surface.
+    state_store_resilient: bool = True
+    # Per-op budget for the RESP store (connect, command round-trip, and
+    # the bound on one advisory-lock acquisition loop).
+    state_store_timeout: float = 2.0
+    # Store-health breaker shape: consecutive failed ops before the store
+    # is declared down (every op from then on serves degraded without
+    # touching the network), and the cooldown before a half-open probe
+    # rides the next op through.
+    state_store_failure_threshold: int = 3
+    state_store_probe_cooldown: float = 5.0
+    # Seeded store fault plan (services/backends/faults.py StoreFaultSpec):
+    # "drop:0.05,seed:7" or "outage_after:100,outage_ops:50,seed:23".
+    # Empty = no injection. Chaos/CI only.
+    state_store_fault_spec: str = ""
+    # Fleet-coherent quota windows (services/quotas.py): with a shared
+    # store, per-tenant chip-second/HBM/request accrual publishes into
+    # bucketed fleet counters and admission checks max(local, fleet) —
+    # closing the documented N× multi-replica bound. Store loss fails
+    # OPEN to replica-local enforcement (the PR 15 bound) with the
+    # missed accrual journaled and replayed on reconnect.
+    quota_fleet_windows: bool = True
     # This replica's identity on the consistent-hash ring. Empty = the
     # POD_NAME env var (k8s downward API), else the hostname.
     replica_self: str = ""

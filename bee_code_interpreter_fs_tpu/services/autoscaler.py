@@ -87,6 +87,12 @@ class LaneSnapshot:
     draining: int = 0
     queue_wait_ewma: float = 0.0
     spawn_ewma: float = 0.0
+    # Hibernated sessions whose wake would land on this lane (the session
+    # store's per-lane index count): supply the durability plane RECLAIMED
+    # that may come asking for a chip back. An explicit demand signal —
+    # weighted into raw_demand by pool_hibernated_wake_weight (default 0:
+    # visible in statusz, absent from the targets).
+    hibernated: int = 0
 
 
 class _LaneModel:
@@ -99,6 +105,7 @@ class _LaneModel:
         "last_arrival",
         "below_since",
         "last_raw",
+        "last_hibernated",
         "scale_ups",
         "scale_downs",
         "reaped",
@@ -110,6 +117,7 @@ class _LaneModel:
         self.last_arrival: float | None = None
         self.below_since: float | None = None  # demand < target since (s)
         self.last_raw = 0.0
+        self.last_hibernated = 0
         self.scale_ups = 0
         self.scale_downs = 0
         self.reaped = 0
@@ -241,6 +249,16 @@ class PoolAutoscaler:
             * evidence
         )
         raw = float(snapshot.in_use + snapshot.queued + extra) + spawn_ahead
+        # Hibernated-wake term: each parked session whose wake lands here
+        # contributes a configurable fraction of a warm sandbox. Off by
+        # default (weight 0.0) — hibernated supply then stays silently
+        # freed capacity, exactly the pre-signal behavior.
+        wake_weight = float(
+            getattr(self.config, "pool_hibernated_wake_weight", 0.0)
+        )
+        if wake_weight > 0 and snapshot.hibernated > 0:
+            raw += wake_weight * snapshot.hibernated
+        model.last_hibernated = snapshot.hibernated
         if (
             wait_target > 0
             and snapshot.queue_wait_ewma > wait_target
@@ -386,6 +404,7 @@ class PoolAutoscaler:
             str(lane): {
                 "target": model.target,
                 "raw_demand": round(model.last_raw, 3),
+                "hibernated": model.last_hibernated,
                 "arrival_rate_per_s": round(
                     self._effective_rate(model, now), 3
                 ),

@@ -76,6 +76,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import threading
 import time
 from collections.abc import Callable
 from dataclasses import dataclass, fields
@@ -438,6 +439,183 @@ class DroppingTransport(httpx.AsyncBaseTransport):
 
     async def aclose(self) -> None:
         await self.inner.aclose()
+
+
+STORE_DROP = "store_drop"
+STORE_OUTAGE = "store_outage"
+
+
+@dataclass(frozen=True)
+class StoreFaultSpec:
+    """Seeded fault plan for the shared StateStore, configured via
+    ``APP_STATE_STORE_FAULT_SPEC`` with the same ``key:value,...`` grammar
+    as the backend plan:
+
+        drop:<rate>       probability any single store op raises
+                          StateStoreUnavailableError (flaky network)
+        outage_after:<n>  after n successful ops, the store goes HARD
+                          down (every op fails) — 0 disables
+        outage_ops:<n>    the outage clears after n failed ops (0 = it
+                          never clears): the deterministic
+                          outage-then-reconnect shape the degraded-mode
+                          tests replay
+        seed:<int>        the plan seed (default 0)
+
+    A PARTITION (one replica loses the store while peers keep it) is
+    staged by wrapping only that replica's store handle — the injector
+    wraps a handle, not the server.
+    """
+
+    drop: float = 0.0
+    outage_after: int = 0
+    outage_ops: int = 0
+    seed: int = 0
+
+    @classmethod
+    def parse(cls, text: str) -> "StoreFaultSpec":
+        values: dict[str, float | int] = {}
+        known = {f.name for f in fields(cls)}
+        for item in text.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            key, sep, raw = item.partition(":")
+            key = key.strip()
+            if not sep or key not in known:
+                raise ValueError(
+                    f"bad store fault spec item {item!r}: want one of "
+                    f"{sorted(known)} as key:value"
+                )
+            try:
+                values[key] = (
+                    float(raw) if key == "drop" else int(raw)
+                )
+            except ValueError:
+                raise ValueError(
+                    f"bad store fault spec value for {key}: {raw!r}"
+                ) from None
+        spec = cls(**values)
+        if not 0.0 <= spec.drop <= 1.0:
+            raise ValueError(f"store drop rate must be in [0,1]: {spec.drop}")
+        if spec.outage_after < 0 or spec.outage_ops < 0:
+            raise ValueError("store outage counters must be >= 0")
+        return spec
+
+    @property
+    def active(self) -> bool:
+        return self.drop > 0.0 or self.outage_after > 0
+
+
+class FaultInjectingStateStore:
+    """Wraps any StateStore with the seeded StoreFaultSpec: per-op drop
+    rolls from a dedicated stream plus a deterministic hard-outage window
+    (``outage_after`` successes, then ``outage_ops`` failures, then
+    healthy again). Duck-types the StateStore interface — components only
+    call the ops, and ``make_state_store`` layers ResilientStateStore
+    OUTSIDE this wrapper so degraded-mode policy sees the injected
+    failures exactly as it would see real ones."""
+
+    def __init__(
+        self,
+        inner,
+        spec: StoreFaultSpec,
+        *,
+        on_fault: Callable[[str], None] | None = None,
+    ) -> None:
+        self.inner = inner
+        self.spec = spec
+        self.on_fault = on_fault
+        self._rng = random.Random(f"{spec.seed}:store")
+        self._lock = threading.Lock()
+        self._ops = 0
+        self._outage_left = 0
+        self._in_outage = False
+        if spec.active:
+            logger.warning("state-store fault injection ACTIVE: %s", spec)
+
+    @property
+    def shared(self) -> bool:
+        return self.inner.shared
+
+    def _gate(self) -> None:
+        # Imported here (not top-level) to keep the module import-light for
+        # backend-only users; state_store imports THIS module lazily for
+        # the same reason.
+        from ..state_store import StateStoreUnavailableError
+
+        with self._lock:
+            if self._in_outage:
+                if self.spec.outage_ops > 0:
+                    self._outage_left -= 1
+                    if self._outage_left <= 0:
+                        # The outage clears AFTER this last failed op; the
+                        # success counter restarts so a later window can
+                        # re-trip deterministically.
+                        self._in_outage = False
+                        self._ops = 0
+                if self.on_fault is not None:
+                    self.on_fault(STORE_OUTAGE)
+                raise StateStoreUnavailableError(
+                    f"injected store outage (seed={self.spec.seed})"
+                )
+            if self.spec.outage_after > 0:
+                self._ops += 1
+                if self._ops > self.spec.outage_after:
+                    self._in_outage = True
+                    self._outage_left = self.spec.outage_ops
+                    if self.on_fault is not None:
+                        self.on_fault(STORE_OUTAGE)
+                    raise StateStoreUnavailableError(
+                        f"injected store outage (seed={self.spec.seed})"
+                    )
+            if self.spec.drop > 0.0 and self._rng.random() < self.spec.drop:
+                if self.on_fault is not None:
+                    self.on_fault(STORE_DROP)
+                raise StateStoreUnavailableError(
+                    f"injected store drop (seed={self.spec.seed})"
+                )
+
+    def get(self, ns, key):
+        self._gate()
+        return self.inner.get(ns, key)
+
+    def put(self, ns, key, value):
+        self._gate()
+        return self.inner.put(ns, key, value)
+
+    def delete(self, ns, key):
+        self._gate()
+        return self.inner.delete(ns, key)
+
+    def items(self, ns):
+        self._gate()
+        return self.inner.items(ns)
+
+    def incr(self, ns, key, delta=1.0):
+        self._gate()
+        return self.inner.incr(ns, key, delta)
+
+    def mutate(self, ns, key, fn):
+        self._gate()
+        return self.inner.mutate(ns, key, fn)
+
+    # TTL-lease helpers ride the gated primitives via the base-class
+    # implementations on the INNER store — but they must go through OUR
+    # gate, so delegate explicitly.
+    def put_ttl(self, ns, key, value, ttl_seconds, *, now=None):
+        self._gate()
+        return self.inner.put_ttl(ns, key, value, ttl_seconds, now=now)
+
+    def get_live(self, ns, key, *, now=None):
+        self._gate()
+        return self.inner.get_live(ns, key, now=now)
+
+    def acquire_lease(self, ns, key, owner, ttl_seconds, *, now=None):
+        self._gate()
+        return self.inner.acquire_lease(ns, key, owner, ttl_seconds, now=now)
+
+    def close(self):
+        self.inner.close()
 
 
 class FaultInjectingBackend(SandboxBackend):

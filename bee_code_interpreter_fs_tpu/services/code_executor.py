@@ -66,6 +66,7 @@ from .errors import (  # noqa: F401 — canonical home is errors.py; re-exported
     SessionLimitError,
     SessionRestoringError,
     StaleLeaseError,
+    StateStoreDegradedError,
 )
 from .leases import Lease, LeaseRegistry
 from .limits import VIOLATION_KINDS, request_limits, validate_config_limits
@@ -296,7 +297,10 @@ class CodeExecutor:
         # (APP_QUOTAS_ENABLED=0) constructs a disabled enforcer whose
         # admit()/release() are no-ops — pre-quota behavior byte-for-byte.
         self.quotas = quotas or QuotaEnforcer(
-            self.config, usage=self.usage, metrics=self.metrics
+            self.config,
+            usage=self.usage,
+            metrics=self.metrics,
+            store=self.state_store,
         )
         # Spawn retries mirror the reference's ladder (3 attempts, 0.5s
         # exponential base capped at 5s) with full jitter so parallel refill
@@ -665,6 +669,12 @@ class CodeExecutor:
             draining=self._draining_count(chip_count),
             queue_wait_ewma=self.scheduler.queue_wait_ewma(chip_count),
             spawn_ewma=self.scheduler.spawn_ewma(chip_count),
+            # Explicit hibernated-wake supply signal (session durability
+            # plane): parked sessions whose wake would land on this lane.
+            # Cached inside the session store; {} when durability is off.
+            hibernated=self.session_store.hibernated_by_lane().get(
+                chip_count, 0
+            ),
         )
 
     def _draining_count(self, chip_count: int) -> int:
@@ -900,6 +910,14 @@ class CodeExecutor:
                 # a sibling spawn crossed the threshold): stop quietly — the
                 # lane refills on the first request after a successful probe.
                 logger.warning("pool prefill stopped (lane=%d): %s", chip_count, e)
+            except StateStoreDegradedError as e:
+                # Lease mints fail closed while the shared store is down:
+                # background refills stop quietly (the lane refills on the
+                # first acquire after the store heals) instead of escaping
+                # the gather.
+                logger.warning(
+                    "pool prefill paused (lane=%d): %s", chip_count, e
+                )
             finally:
                 self._spawning[chip_count] -= 1
                 self._notify_lane(chip_count)
@@ -981,7 +999,17 @@ class CodeExecutor:
             # fenced), the replacement starts quarantined: probed, counted
             # as standby, handed nothing until the clean-probe streak
             # re-admits it.
-            await self._attach_lease(sandbox, chip_count)
+            try:
+                await self._attach_lease(sandbox, chip_count)
+            except StateStoreDegradedError:
+                # Mint failed closed (shared store down) AFTER the backend
+                # spawn succeeded: the sandbox exists but can never be
+                # granted — dispose it rather than leak a live host with
+                # no lease, and surface the typed refusal (NOT a
+                # SandboxSpawnError: retrying inside the same outage
+                # window just burns spawns).
+                await self._dispose(sandbox)
+                raise
             # Register with the live-host inventory the probe daemon walks
             # (dropped again in _dispose).
             self._live_sandboxes[sandbox.id] = (chip_count, sandbox)
@@ -5312,6 +5340,12 @@ class CodeExecutor:
                 "self": self.replica_id,
                 "store": type(self.state_store).__name__,
             }
+        # The store-loss plane: the resilient wrapper's breaker verdict,
+        # outage/degraded-op counters, and quota-journal backlog — "are we
+        # serving from the shared store or from replica-local fallbacks?".
+        store_health = getattr(self.state_store, "health", None)
+        if callable(store_health):
+            body["state_store"] = store_health()
         return body
 
     async def sweep_pool_health(self) -> int:

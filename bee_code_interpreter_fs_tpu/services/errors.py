@@ -185,6 +185,29 @@ class QuotaExceededError(AdmissionRejectedError):
         self.burst_credits_remaining = burst_credits_remaining
 
 
+class StateStoreDegradedError(RuntimeError):
+    """The shared control-plane StateStore is unreachable and the subsystem
+    that needed it FAILS CLOSED (services/state_store.py degraded-mode
+    policy): lease mints (a partitioned replica granting chips off a stale
+    generation counter could double-grant hardware a peer already granted
+    or fenced) and session hibernate/restore (restoring blind against an
+    unreadable checkpoint index would fork session state). Deliberately NOT
+    an ExecutorError: the retry ladder must not replay inside the same
+    outage window — the client backs off on the carried ``retry_after``
+    (the store health breaker's next probe point) instead. Maps to HTTP 503
+    + Retry-After with a typed body, and gRPC UNAVAILABLE with
+    ``x-store-degraded`` trailing metadata. Fail-OPEN subsystems (scheduler
+    WFQ, breaker verdicts, quota accrual) never raise this — they fall back
+    to replica-local shadow state and reconcile on reconnect."""
+
+    def __init__(
+        self, message: str, *, subsystem: str = "", retry_after: float = 5.0
+    ) -> None:
+        super().__init__(message)
+        self.subsystem = subsystem
+        self.retry_after = retry_after
+
+
 class CircuitOpenError(SessionLimitError):
     """The lane's spawn circuit breaker is open: the backend failed N
     consecutive spawns and the cooldown has not elapsed, so the request

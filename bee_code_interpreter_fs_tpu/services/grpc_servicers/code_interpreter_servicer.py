@@ -28,6 +28,7 @@ from ..code_executor import (
     SessionLimitError,
     SessionRestoringError,
     StaleLeaseError,
+    StateStoreDegradedError,
 )
 from ..custom_tool_executor import (
     CustomToolExecuteError,
@@ -261,6 +262,29 @@ class CodeInterpreterServicer:
         await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
 
     @staticmethod
+    async def _abort_store_degraded(
+        context: grpc.aio.ServicerContext,
+        e: StateStoreDegradedError,
+        trailing: list[tuple[str, str]],
+    ) -> None:
+        """Fail-closed store-outage refusals (lease mint, session restore)
+        map to UNAVAILABLE — transient; the store heals and the retry
+        succeeds — with `x-store-degraded` trailing metadata carrying the
+        subsystem and retry-after (the proto is frozen; metadata is the
+        structured channel, as for x-session-restoring)."""
+        extra = trailing + [
+            ("x-store-degraded", getattr(e, "subsystem", "") or "1"),
+            (
+                "x-store-degraded-retry-after",
+                f"{max(0.0, getattr(e, 'retry_after', 5.0) or 5.0):.3f}",
+            ),
+        ]
+        set_trailing = getattr(context, "set_trailing_metadata", None)
+        if set_trailing is not None:
+            set_trailing(tuple(extra))
+        await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+
+    @staticmethod
     async def _abort_quota(
         context: grpc.aio.ServicerContext,
         e: QuotaExceededError,
@@ -457,6 +481,11 @@ class CodeInterpreterServicer:
                 # fenced mid-flight — ABORTED is gRPC's "safe to retry the
                 # whole transaction" signal, mirroring the HTTP 409.
                 await context.abort(grpc.StatusCode.ABORTED, str(e))
+            except StateStoreDegradedError as e:
+                # Before ExecutorError: fail-closed store outage —
+                # UNAVAILABLE with x-store-degraded metadata, mirroring
+                # the HTTP 503 + Retry-After.
+                await self._abort_store_degraded(context, e, trailing)
             except (ExecutorError, SandboxSpawnError) as e:
                 logger.exception("Execute failed [%s]", request_id)
                 await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
@@ -531,6 +560,10 @@ class CodeInterpreterServicer:
                 # Fenced mid-stream: ABORTED (retry-whole-call), like
                 # Execute's mapping above.
                 await context.abort(grpc.StatusCode.ABORTED, str(e))
+            except StateStoreDegradedError as e:
+                # Fail-closed store outage: UNAVAILABLE + x-store-degraded,
+                # like Execute's mapping above.
+                await self._abort_store_degraded(context, e, trailing)
             except (ExecutorError, SandboxSpawnError) as e:
                 logger.exception("ExecuteStream failed [%s]", request_id)
                 await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
@@ -627,6 +660,10 @@ class CodeInterpreterServicer:
                 await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             except SessionLimitError as e:
                 await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+            except StateStoreDegradedError as e:
+                # Fail-closed store outage: UNAVAILABLE + x-store-degraded,
+                # like Execute's mapping above.
+                await self._abort_store_degraded(context, e, trailing)
             except (ExecutorError, SandboxSpawnError) as e:
                 logger.exception("ExecuteCustomTool failed [%s]", request_id)
                 await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))

@@ -38,6 +38,7 @@ from .code_executor import (
     SessionLimitError,
     SessionRestoringError,
     StaleLeaseError,
+    StateStoreDegradedError,
 )
 from .custom_tool_executor import (
     CustomToolExecuteError,
@@ -1090,6 +1091,32 @@ def create_http_app(
             },
         )
 
+    def store_degraded_response(e: StateStoreDegradedError) -> web.Response:
+        """503 for a request refused because the shared control-plane store
+        is unreachable and the touched subsystem fails CLOSED (lease mints,
+        session hibernate/restore). Deliberately NOT a 502: nothing is
+        wrong with the request or the sandbox fleet — the store outage is
+        transient, so the typed reason + Retry-After tells clients to back
+        off and retry rather than fail over or alert."""
+        return web.json_response(
+            with_trace_id(
+                {
+                    "error": str(e),
+                    "reason": "store_degraded",
+                    "subsystem": getattr(e, "subsystem", "") or "",
+                    "retry_after_s": round(
+                        float(getattr(e, "retry_after", 5.0) or 5.0), 3
+                    ),
+                }
+            ),
+            status=503,
+            headers={
+                "Retry-After": str(
+                    max(1, math.ceil(getattr(e, "retry_after", 5.0) or 5.0))
+                )
+            },
+        )
+
     def add_session_fields(body: dict, result, executor_id: str | None) -> dict:
         """Session continuity, one rule for every surface: seq==1 on a
         request the client expected to land in an existing session means
@@ -1172,6 +1199,11 @@ def create_http_app(
             # typed 409 + Retry-After, the client reconnects to a healthy
             # host.
             return stale_lease_response(e)
+        except StateStoreDegradedError as e:
+            # The shared store is down and this request needed a
+            # fail-closed subsystem (lease mint, session restore) —
+            # typed 503 + Retry-After, retry lands after the store heals.
+            return store_degraded_response(e)
         except (ExecutorError, SandboxSpawnError) as e:
             logger.exception("execute failed")
             return web.json_response({"error": str(e)}, status=502)
@@ -1287,6 +1319,17 @@ def create_http_app(
                     + "\n"
                 ).encode("utf-8")
             )
+        except StateStoreDegradedError as e:
+            # Fail-closed store refusal: typed 503 pre-stream, final
+            # typed event once headers are gone.
+            if not started:
+                return store_degraded_response(e)
+            await response.write(
+                (
+                    json.dumps({"error": str(e), "reason": "store_degraded"})
+                    + "\n"
+                ).encode("utf-8")
+            )
         except (ExecutorError, SandboxSpawnError) as e:
             logger.exception("execute stream failed")
             if not started:
@@ -1322,9 +1365,16 @@ def create_http_app(
         )
         if routed is not None:
             return routed
-        if await code_executor.close_session(
-            executor_id, tenant=session_tenant(request)
-        ):
+        try:
+            closed = await code_executor.close_session(
+                executor_id, tenant=session_tenant(request)
+            )
+        except StateStoreDegradedError as e:
+            # A hibernated session's record lives in the shared store; with
+            # the store down the close cannot prove (or destroy) it — the
+            # typed 503 beats silently reporting "no such session".
+            return store_degraded_response(e)
+        if closed:
             return web.json_response({"closed": executor_id})
         body = {"error": "no such session"}
         if router is not None and len(router.ring.peers) > 1:
@@ -1388,6 +1438,8 @@ def create_http_app(
             return quota_response(e)
         except SessionLimitError as e:
             return capacity_response(e)
+        except StateStoreDegradedError as e:
+            return store_degraded_response(e)
         except (ExecutorError, SandboxSpawnError) as e:
             logger.exception("custom tool execute failed")
             return web.json_response({"error": str(e)}, status=502)

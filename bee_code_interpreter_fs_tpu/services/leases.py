@@ -45,7 +45,16 @@ import time
 from collections.abc import Callable
 from dataclasses import dataclass, field
 
+from .errors import StateStoreDegradedError
+from .state_store import STORE_UNAVAILABLE_ERRORS
+
 logger = logging.getLogger(__name__)
+
+# What a shared-store op can throw when the store is gone: the raw
+# transport/file errors (registry wired with a bare store) plus the typed
+# degraded refusal (registry wired with the ResilientStateStore wrapper,
+# whose FENCED policy fails lease writes closed).
+_STORE_DOWN = (StateStoreDegradedError, *STORE_UNAVAILABLE_ERRORS)
 
 
 @dataclass
@@ -105,8 +114,17 @@ class LeaseRegistry:
         self._store = store if store is not None and store.shared else None
         self._generations: dict[str, int] = {}
         self._recovering: dict[str, _ScopeRecovery] = {}
+        # Degraded-mode state (shared store unreachable): last-seen fence
+        # floors from successful reads (floors only rise, so a stale value
+        # can only under-refuse — and mints fail closed, so nothing new is
+        # granted off it), plus floor publishes a fence performed during
+        # the outage still owes the fleet (max-merged in, so replay in any
+        # order against any peer's concurrent raise is safe).
+        self._floor_cache: dict[str, int] = {}
+        self._pending_floors: dict[str, int] = {}
         self.fences_total = 0
         self.readmissions_total = 0
+        self.degraded_mint_refusals = 0
 
     # ---------------------------------------------------------------- leases
 
@@ -116,7 +134,24 @@ class LeaseRegistry:
         rests on. In shared mode the generation comes from the fleet-wide
         counter, so replicas can never mint the same generation twice."""
         if self._store is not None:
-            generation = int(self._store.incr("lease_gen", scope))
+            try:
+                generation = int(self._store.incr("lease_gen", scope))
+            except StateStoreDegradedError:
+                self.degraded_mint_refusals += 1
+                raise
+            except STORE_UNAVAILABLE_ERRORS as e:
+                # FAIL CLOSED, always — even when the registry holds a bare
+                # store with no resilience wrapper. A partitioned replica
+                # minting off its last-seen counter could reissue a
+                # generation a peer already granted (or fenced): the one
+                # degraded behavior this module can never allow.
+                self.degraded_mint_refusals += 1
+                raise StateStoreDegradedError(
+                    f"lease mint for scope {scope!r} refused: shared "
+                    f"generation counter unreachable ({e})",
+                    subsystem="leases",
+                ) from e
+            self._flush_pending_floors()
             self._generations[scope] = max(
                 self._generations.get(scope, 0), generation
             )
@@ -163,8 +198,6 @@ class LeaseRegistry:
                     floor = max(floor, int(current))
                 return floor, None
 
-            self._store.mutate("lease_floor", lease.scope, _raise_floor)
-
             def _fence_record(current):
                 return (
                     {
@@ -177,7 +210,30 @@ class LeaseRegistry:
                     None,
                 )
 
-            self._store.mutate("lease_fence", lease.scope, _fence_record)
+            try:
+                self._store.mutate("lease_floor", lease.scope, _raise_floor)
+                self._store.mutate("lease_fence", lease.scope, _fence_record)
+            except _STORE_DOWN as e:
+                # The LOCAL half already happened (revocation, generation
+                # burn, recovering record) — this replica refuses the host
+                # either way. What the outage withheld is the FLEET's view:
+                # queue the floor raise and replay it on the next healthy
+                # store op (floors max-merge, so late replay against a
+                # peer's newer floor is a no-op). Until then a peer may
+                # keep serving this scope off pre-fence leases — the same
+                # exposure as the fence simply racing the outage.
+                self._pending_floors[lease.scope] = max(
+                    self._pending_floors.get(lease.scope, 0),
+                    lease.generation,
+                )
+                logger.warning(
+                    "lease fence for scope=%s could not publish to the "
+                    "shared store (%s): floor %d queued for replay on "
+                    "reconnect",
+                    lease.scope,
+                    e,
+                    lease.generation,
+                )
         logger.warning(
             "lease fenced: scope=%s generation=%d sandbox=%s (%s); "
             "re-admission needs %d clean probes",
@@ -203,13 +259,32 @@ class LeaseRegistry:
         if lease.revoked:
             return True
         if self._store is not None:
+            # A fence this replica performed during an outage refuses its
+            # scope immediately, before the floor ever lands remotely.
+            pending = self._pending_floors.get(lease.scope)
+            if pending is not None and lease.generation <= pending:
+                return True
             # Deliberately UNCACHED (unlike the breaker's 0.25s remote
             # cache): this read is the only thing standing between a
             # peer's fence and this replica granting the fenced host — a
             # freshness window here would be a grant-a-wedged-host window.
             # WAL readers never block on writers, so the cost is one
             # ~tens-of-µs point read per dispatch/pool-candidate.
-            floor = self._store.get("lease_floor", lease.scope)
+            try:
+                floor = self._store.get("lease_floor", lease.scope)
+            except _STORE_DOWN:
+                # Store gone: serve off the last floor a healthy read saw.
+                # Floors only rise, so the cache can only UNDER-refuse —
+                # and the thing it could miss (a peer's fence during the
+                # outage) cannot strand a wedge on THIS replica: mints are
+                # refused store-down, so no new local lease lands on the
+                # scope, and existing leases predate the peer's fence by
+                # construction.
+                floor = self._floor_cache.get(lease.scope)
+            else:
+                if isinstance(floor, (int, float)):
+                    self._floor_cache[lease.scope] = int(floor)
+                self._flush_pending_floors()
             if isinstance(floor, (int, float)) and lease.generation <= floor:
                 # The floor survives re-admission on purpose: the scope's
                 # HARDWARE re-earned trust, but a pre-fence lease names a
@@ -217,6 +292,34 @@ class LeaseRegistry:
                 # post-fence generations serve.
                 return True
         return False
+
+    def _flush_pending_floors(self) -> None:
+        """Replay floor raises a store-down fence left owing, on the first
+        healthy store op that notices them. Max-merge makes replay order
+        irrelevant; a relapse mid-flush just leaves the remainder queued."""
+        if not self._pending_floors:
+            return
+        for scope, generation in list(self._pending_floors.items()):
+
+            def _raise_floor(current, generation=generation):
+                floor = generation
+                if isinstance(current, (int, float)):
+                    floor = max(floor, int(current))
+                return floor, None
+
+            try:
+                self._store.mutate("lease_floor", scope, _raise_floor)
+            except _STORE_DOWN:
+                return
+            self._pending_floors.pop(scope, None)
+            self._floor_cache[scope] = max(
+                self._floor_cache.get(scope, 0), generation
+            )
+            logger.info(
+                "replayed queued fence floor: scope=%s floor=%d",
+                scope,
+                generation,
+            )
 
     # ------------------------------------------------------------ recovering
 
@@ -226,8 +329,18 @@ class LeaseRegistry:
             # whose shared record is gone means a PEER's probes completed
             # the streak — drop the mirror so this replica's gates open
             # too (its lanes re-evaluate on the next sweep kick).
-            if self._store.get("lease_fence", scope) is not None:
+            try:
+                record = self._store.get("lease_fence", scope)
+            except _STORE_DOWN:
+                return scope in self._recovering
+            if record is not None:
                 return True
+            if getattr(self._store, "degraded", False):
+                # A degraded wrapper answers reads from its last-known
+                # cache: an absence there is NOT evidence a peer finished
+                # the streak — keep the local mirror authoritative until
+                # a healthy read says otherwise.
+                return scope in self._recovering
             self._recovering.pop(scope, None)
             return False
         return scope in self._recovering
@@ -269,7 +382,20 @@ class LeaseRegistry:
                 record["streak"] = streak
                 return record, ("advance", record)
 
-            verdict, record = self._store.mutate("lease_fence", scope, step)
+            try:
+                verdict, record = self._store.mutate(
+                    "lease_fence", scope, step
+                )
+            except _STORE_DOWN:
+                # Store down: keep the consecutive-streak contract alive on
+                # the LOCAL mirror so this replica's own probes still gate
+                # its own re-admission. On reconnect the shared record —
+                # still standing with its pre-outage streak — is
+                # authoritative again, so the fleet may ask the hardware
+                # for a few extra clean probes. Conservative by design:
+                # degraded mode must never re-admit EARLIER than the
+                # healthy path would.
+                return self._note_probe_local(scope, clean)
             if verdict == "absent":
                 if state is not None:
                     # A peer's probe completed the streak: mirror the
@@ -320,6 +446,13 @@ class LeaseRegistry:
             )
             return True
         # Private-store path from here: today's single-process semantics.
+        return self._note_probe_local(scope, clean)
+
+    def _note_probe_local(self, scope: str, clean: bool) -> bool:
+        """The registry-local streak step: the private-store semantics,
+        doubling as the degraded-mode fallback while a shared store is
+        unreachable."""
+        state = self._recovering.get(scope)
         if state is None:
             return False
         if not clean:
@@ -369,7 +502,11 @@ class LeaseRegistry:
             # ANY replica's /statusz sees every scope the fleet is
             # quarantining, not just the ones this process fenced.
             wall = self.walltime()
-            for scope, record in sorted(self._store.items("lease_fence").items()):
+            try:
+                fences = self._store.items("lease_fence")
+            except _STORE_DOWN:
+                fences = {}  # statusz stays serveable through an outage
+            for scope, record in sorted(fences.items()):
                 if scope in recovering or not isinstance(record, dict):
                     continue
                 since = record.get("since_wall")
@@ -389,6 +526,8 @@ class LeaseRegistry:
             "readmit_streak": self.readmit_streak,
             "fences_total": self.fences_total,
             "readmissions_total": self.readmissions_total,
+            "degraded_mint_refusals": self.degraded_mint_refusals,
+            "pending_fence_floors": dict(sorted(self._pending_floors.items())),
             "generations": dict(sorted(self._generations.items())),
             "recovering": recovering,
         }

@@ -61,9 +61,17 @@ from collections import deque
 from dataclasses import dataclass, field, replace
 
 from ..utils import tracing
-from .errors import QuotaExceededError
+from .errors import QuotaExceededError, StateStoreDegradedError
+from .state_store import STORE_UNAVAILABLE_ERRORS
 
 logger = logging.getLogger(__name__)
+
+# Everything a fleet-window store op may throw when the shared store is
+# unreachable: raw transport errors (bare store) or the wrapper's typed
+# refusal. Quota accrual fails OPEN past either — enforcement drops to
+# replica-local windows (the PR 15 N-replica bound) rather than denying
+# traffic because the bookkeeper is down.
+_STORE_DOWN = (StateStoreDegradedError, *STORE_UNAVAILABLE_ERRORS)
 
 # Denial reasons, a closed set (they label quota_denials_total and ride the
 # wire as x-quota-reason): membership is contract for dashboards and tests.
@@ -343,6 +351,153 @@ class _TenantWindow:
             self.admits.popleft()
 
 
+class _FleetWindows:
+    """Fleet-coherent accrual over the shared store — the piece that
+    closes PR 15's documented N× bound (each of N replicas granting a
+    tenant its FULL window budget).
+
+    Mechanism: per (tenant, kind) the window is a ring of coarse time
+    buckets in the store (ns=``quota_win``, key ``{label}|{kind}|{bucket}``,
+    bucket = wall // granularity, granularity = window/8). Accrual
+    publishes as pure ``incr`` deltas — commutative, so N replicas
+    publishing concurrently never lose updates AND the degraded-mode
+    journal can replay them in any order after an outage. Admission then
+    checks ``max(local, fleet)``: max, not sum, because this replica's own
+    deltas are inside both views — the fleet view can only TIGHTEN the
+    local bound, never loosen it, and a store outage degrades exactly to
+    the local bound.
+
+    Kinds: ``chip`` (chip-seconds), ``hbm`` (HBM byte-seconds), ``req``
+    (admitted requests). Quarantine/violation state and the concurrency
+    cap stay deliberately per-replica: quarantine is an ESCALATING
+    sentence keyed to local observation ordering (merging episode ladders
+    across replicas would double-sentence a single storm), and in-flight
+    counts churn far too fast for a 0.25s-coherent store view — both are
+    documented in README's degraded-mode matrix.
+
+    Coarseness: the bucketed window can over-count by up to one granule
+    versus the exact local ring — the fleet bound is conservative
+    (over-strict), never permissive.
+    """
+
+    NS = "quota_win"
+    BUCKETS = 8
+    # Store reads are throttled: admission happens per request, the items()
+    # scan is one cross-replica read — a 0.25s-stale fleet view is the same
+    # freshness class as the breaker's remote-verdict cache.
+    READ_TTL = 0.25
+
+    def __init__(self, store, *, walltime=time.time) -> None:
+        self.store = store
+        self.walltime = walltime
+        # (label, kind) -> last-published cumulative counter value.
+        self._anchors: dict[tuple[str, str], float] = {}
+        self._cache: dict = {}
+        self._cache_at = -1e9
+        self.publish_errors = 0
+
+    @staticmethod
+    def _key(label: str, kind: str, bucket: int) -> str:
+        return f"{label}|{kind}|{bucket}"
+
+    def _gran(self, window: float) -> float:
+        return max(1.0, float(window) / self.BUCKETS)
+
+    def publish_cum(
+        self, label: str, kind: str, cumulative: float, window: float
+    ) -> None:
+        """Publish a MONOTONIC cumulative counter (the ledger's
+        chip-second/HBM rows) as the delta since its last sight. The first
+        sight only anchors — history predating this process's view already
+        belongs to whoever published it."""
+        anchor = self._anchors.get((label, kind))
+        self._anchors[(label, kind)] = cumulative
+        if anchor is None or cumulative <= anchor:
+            return
+        self.add(label, kind, cumulative - anchor, window)
+
+    def add(self, label: str, kind: str, delta: float, window: float) -> None:
+        """One accrual increment into the current bucket (+ lazy
+        retirement of the bucket that aged past every window view)."""
+        gran = self._gran(window)
+        bucket = int(self.walltime() // gran)
+        try:
+            self.store.incr(self.NS, self._key(label, kind, bucket), delta)
+            self.store.delete(
+                self.NS, self._key(label, kind, bucket - self.BUCKETS - 2)
+            )
+        except _STORE_DOWN:
+            # Fail open: local enforcement carries on; the delta is lost
+            # to the FLEET view only when the store is bare (the resilient
+            # wrapper journals incr deltas and replays them on reconnect).
+            self.publish_errors += 1
+
+    def _items(self) -> dict:
+        now = self.walltime()
+        if now - self._cache_at <= self.READ_TTL:
+            return self._cache
+        self._cache_at = now  # set first: a dead store isn't re-read hot
+        try:
+            self._cache = self.store.items(self.NS)
+        except _STORE_DOWN:
+            self.publish_errors += 1
+            self._cache = {}
+        return self._cache
+
+    def _buckets(
+        self, label: str, kind: str, window: float
+    ) -> list[tuple[int, float]]:
+        gran = self._gran(window)
+        floor = int((self.walltime() - window) // gran) + 1
+        prefix = f"{label}|{kind}|"
+        out = []
+        for key, value in self._items().items():
+            if not key.startswith(prefix):
+                continue
+            tail = key[len(prefix):]
+            if not isinstance(value, (int, float)):
+                continue
+            try:
+                bucket = int(tail)
+            except ValueError:
+                continue
+            if bucket >= floor:
+                out.append((bucket, float(value)))
+        out.sort()
+        return out
+
+    def used(self, label: str, kind: str, window: float) -> float:
+        """Fleet-wide consumption of `kind` inside the window (bucketed:
+        conservative by up to one granule)."""
+        return sum(v for _, v in self._buckets(label, kind, window))
+
+    def refill_in(
+        self, label: str, kind: str, window: float, budget: float
+    ) -> float:
+        """Seconds until enough fleet buckets age out that consumption
+        fits the budget — the Retry-After contract, fleet edition."""
+        buckets = self._buckets(label, kind, window)
+        excess = sum(v for _, v in buckets) - budget
+        if excess <= 0:
+            return 0.0
+        gran = self._gran(window)
+        now = self.walltime()
+        aged = 0.0
+        for bucket, value in buckets:
+            aged += value
+            if aged >= excess:
+                return max(0.0, (bucket + 1) * gran + window - now)
+        return window
+
+    def snapshot(self) -> dict:
+        return {
+            "buckets": self.BUCKETS,
+            "read_ttl_s": self.READ_TTL,
+            "publish_errors": self.publish_errors,
+            "tracked": len(self._anchors),
+        }
+
+
 class QuotaEnforcer:
     """Admission-side quota enforcement over the usage ledger.
 
@@ -360,6 +515,7 @@ class QuotaEnforcer:
         usage=None,
         metrics=None,
         walltime=time.time,
+        store=None,
     ) -> None:
         from ..config import Config
 
@@ -369,6 +525,19 @@ class QuotaEnforcer:
         self.walltime = walltime
         self.enabled = bool(self.config.quotas_enabled) and (
             usage is not None and usage.enabled
+        )
+        # Fleet-coherent windows: engaged only when a SHARED store is
+        # wired AND the knob is on — a private store (single replica)
+        # keeps admission purely local, zero store ops on the admit path.
+        self._fleet = (
+            _FleetWindows(store, walltime=walltime)
+            if (
+                self.enabled
+                and store is not None
+                and getattr(store, "shared", False)
+                and bool(getattr(self.config, "quota_fleet_windows", True))
+            )
+            else None
         )
         if bool(self.config.quotas_enabled) and not self.enabled:
             # Quotas read exactly the ledger's counters; without metering
@@ -720,6 +889,13 @@ class QuotaEnforcer:
             sum(row.violations.values()) if row is not None else 0.0
         )
         win.observe(now, chip, violations, window, hbm_cum=hbm)
+        if self._fleet is not None:
+            # Publish this replica's accrual deltas into the fleet
+            # buckets — pure increments, so N concurrent publishers
+            # compose and the degraded journal can replay them.
+            self._fleet.publish_cum(label, "chip", chip, window)
+            if hbm > 0:
+                self._fleet.publish_cum(label, "hbm", hbm, window)
 
     def _deny(
         self,
@@ -887,11 +1063,28 @@ class QuotaEnforcer:
         remaining: float | None = None
         if policy.chip_seconds_per_window > 0:
             used = win.used_chip_seconds(now, window)
+            if self._fleet is not None:
+                # max, not sum: this replica's consumption is inside both
+                # views, so the fleet bound tightens, never double-counts.
+                used = max(
+                    used, self._fleet.used(label, "chip", window)
+                )
             remaining = max(0.0, policy.chip_seconds_per_window - used)
             if used >= policy.chip_seconds_per_window:
                 refill_at = win.budget_refill_at(
                     now, window, policy.chip_seconds_per_window
                 )
+                if self._fleet is not None:
+                    refill_at = max(
+                        refill_at,
+                        now
+                        + self._fleet.refill_in(
+                            label,
+                            "chip",
+                            window,
+                            policy.chip_seconds_per_window,
+                        ),
+                    )
                 raise self._deny(
                     label,
                     policy,
@@ -996,11 +1189,23 @@ class QuotaEnforcer:
         # takes for its own footprint to age out.
         if policy.hbm_byte_seconds_per_window > 0:
             used_hbm = win.used_hbm_byte_seconds(now, window)
+            if self._fleet is not None:
+                used_hbm = max(
+                    used_hbm, self._fleet.used(label, "hbm", window)
+                )
             hbm_budget = policy.hbm_byte_seconds_per_window
             if used_hbm >= hbm_budget:
                 refill_at = win.budget_refill_at(
                     now, window, hbm_budget, index=win.HBM
                 )
+                if self._fleet is not None:
+                    refill_at = max(
+                        refill_at,
+                        now
+                        + self._fleet.refill_in(
+                            label, "hbm", window, hbm_budget
+                        ),
+                    )
                 raise self._deny(
                     label,
                     policy,
@@ -1020,13 +1225,31 @@ class QuotaEnforcer:
         # 4) Request rate over the window.
         if policy.requests_per_window > 0:
             win.prune_admits(now, window)
-            if len(win.admits) >= policy.requests_per_window:
+            admitted = len(win.admits)
+            if self._fleet is not None:
+                admitted = max(
+                    admitted, int(self._fleet.used(label, "req", window))
+                )
+            if admitted >= policy.requests_per_window:
+                local_refill = (
+                    win.admits[0] + window - now if win.admits else 0.0
+                )
+                if self._fleet is not None:
+                    local_refill = max(
+                        local_refill,
+                        self._fleet.refill_in(
+                            label,
+                            "req",
+                            window,
+                            float(policy.requests_per_window - 1),
+                        ),
+                    )
                 raise self._deny(
                     label,
                     policy,
                     win,
                     reason="request_rate",
-                    retry_after=max(1.0, win.admits[0] + window - now),
+                    retry_after=max(1.0, local_refill),
                     detail=(
                         f"exceeded its request-rate cap "
                         f"({policy.requests_per_window} per "
@@ -1055,6 +1278,8 @@ class QuotaEnforcer:
 
         if policy.requests_per_window > 0:
             win.admits.append(now)
+            if self._fleet is not None:
+                self._fleet.add(label, "req", 1.0, window)
         win.in_flight += 1
         if policy.chip_seconds_per_window > 0:
             return QuotaVerdict(
@@ -1181,6 +1406,9 @@ class QuotaEnforcer:
             "policy_loads": self.policy_loads,
             "policy_load_errors": self.policy_load_errors,
             "denials_total": self.denials_total,
+            "fleet_windows": (
+                self._fleet.snapshot() if self._fleet is not None else None
+            ),
             "tenants": {
                 label: self._tenant_body(label, label, win)
                 for label, win in sorted(self._windows.items())

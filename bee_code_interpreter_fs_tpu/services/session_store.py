@@ -51,9 +51,18 @@ import os
 import time
 from pathlib import Path
 
+from .errors import StateStoreDegradedError
+from .state_store import STORE_UNAVAILABLE_ERRORS
 from .storage import Storage, StorageObjectNotFound
 
 logger = logging.getLogger(__name__)
+
+# Store-down signatures on the index path. save()/load() deliberately let
+# the typed degraded error PROPAGATE (hibernate/restore fails closed: a
+# checkpoint admitted against an unreachable index would fork session
+# state across replicas) — only the OBSERVATIONAL surfaces below swallow
+# it (statusz and the autoscaler signal must serve through an outage).
+_STORE_DOWN = (StateStoreDegradedError, *STORE_UNAVAILABLE_ERRORS)
 
 # StateStore namespace the record index rides (replica-coherent per PR 15).
 SESSION_NS = "session_durable"
@@ -105,6 +114,9 @@ class SessionStore:
         self.restores = 0
         self.conflicts = 0
         self.evictions = 0
+        # hibernated_by_lane() cache (autoscaler signal).
+        self._lanes_cache: dict[int, int] = {}
+        self._lanes_cached_at = -1e9
         if not enabled:
             # Kill switch: no directories, no state, every surface answers
             # empty — pre-durability behavior byte-for-byte.
@@ -136,12 +148,45 @@ class SessionStore:
     def entry_count(self) -> int:
         if not self.enabled:
             return 0
-        return len(self.state.items(SESSION_NS))
+        try:
+            return len(self.state.items(SESSION_NS))
+        except _STORE_DOWN:
+            return 0
 
     def record_keys(self) -> list[str]:
         if not self.enabled:
             return []
-        return sorted(self.state.items(SESSION_NS))
+        try:
+            return sorted(self.state.items(SESSION_NS))
+        except _STORE_DOWN:
+            return []
+
+    def hibernated_by_lane(self) -> dict[int, int]:
+        """Hibernated-session count per chip-count lane — the autoscaler's
+        explicit wake-demand signal (each parked session is a chip the
+        pool RECLAIMED, but also a wake that will want one back). Index
+        entries carry the lane their sandbox held at checkpoint time.
+        Cached briefly: the autoscale sweep ticks every ~2s per lane and
+        this is a full-index scan. Store-down serves the last-known view
+        (a stale supply signal only mis-sizes warmth, never correctness)."""
+        if not self.enabled:
+            return {}
+        now = self._clock()
+        if now - self._lanes_cached_at <= 0.5:
+            return self._lanes_cache
+        try:
+            items = self.state.items(SESSION_NS)
+        except _STORE_DOWN:
+            self._lanes_cached_at = now
+            return self._lanes_cache
+        lanes: dict[int, int] = {}
+        for entry in items.values():
+            if isinstance(entry, dict):
+                lane = int(entry.get("lane", 0) or 0)
+                lanes[lane] = lanes.get(lane, 0) + 1
+        self._lanes_cache = lanes
+        self._lanes_cached_at = now
+        return lanes
 
     # ------------------------------------------------------------------- save
 
@@ -230,11 +275,25 @@ class SessionStore:
         """The restore-path check: index entry -> record blob -> workspace
         object validation. Any missing byte evicts the record and returns
         None — the session recreates FRESH (honest seq reset), never
-        half-restores. Never raises."""
+        half-restores. The one exception that DOES propagate:
+        StateStoreDegradedError when the shared index is unreachable —
+        restoring blind (treating unreadable as absent and recreating
+        fresh) would fork the session's state the moment the checkpoint
+        reappears, so restore fails closed with the typed 503."""
         if not self.enabled:
             return None
         index_key = session_key(tenant, executor_id)
-        entry = self.state.get(SESSION_NS, index_key)
+        try:
+            entry = self.state.get(SESSION_NS, index_key)
+        except StateStoreDegradedError:
+            raise
+        except STORE_UNAVAILABLE_ERRORS as e:
+            # Bare-store deployments get the same fail-closed contract.
+            raise StateStoreDegradedError(
+                f"session restore for {index_key!r} refused: checkpoint "
+                f"index unreachable ({e})",
+                subsystem="sessions",
+            ) from e
         if not isinstance(entry, dict):
             return None
         if self.record_ttl and (
@@ -332,7 +391,11 @@ class SessionStore:
             return 0
         now = self._clock()
         dropped = 0
-        for key, entry in list(self.state.items(SESSION_NS).items()):
+        try:
+            items = list(self.state.items(SESSION_NS).items())
+        except _STORE_DOWN:
+            return 0  # sweeper survives the outage; TTLs catch up after
+        for key, entry in items:
             if not isinstance(entry, dict):
                 self.state.delete(SESSION_NS, key)
                 dropped += 1
@@ -353,9 +416,13 @@ class SessionStore:
         """Operator view (GET /statusz companion data)."""
         if not self.enabled:
             return {"enabled": False}
+        by_lane = self.hibernated_by_lane()
         return {
             "enabled": True,
             "hibernated": self.entry_count(),
+            "hibernated_by_lane": {
+                str(lane): count for lane, count in sorted(by_lane.items())
+            },
             "saves": self.saves,
             "restores": self.restores,
             "conflicts": self.conflicts,

@@ -520,6 +520,23 @@ class ExecutorMetrics:
             "sessions (chips held, no request in flight) — the cost "
             "hibernation reclaims.",
         )
+        # Store-loss resilience (services/state_store.py ResilientStateStore):
+        # every degraded-path event, by kind. `outage` fires once per
+        # healthy→degraded transition; `degraded_op` counts operations
+        # served from replica-local fallbacks (shadow/cache/journal) while
+        # the shared store is down; `refused` counts fail-closed refusals
+        # (lease mints, session restores); `journal_replay` /
+        # `journal_dropped` track the quota-accrual journal's reconciliation
+        # on reconnect. Any movement outside a chaos drill is a page.
+        self.store_degraded_ops = self.registry.counter(
+            "code_interpreter_store_degraded_ops_total",
+            "Shared-state-store degraded-path events by kind (outage = "
+            "healthy->degraded transition; degraded_op = op served from a "
+            "replica-local fallback; refused = fail-closed refusal; "
+            "journal_replay / journal_dropped = quota-journal "
+            "reconciliation on reconnect).",
+            ("event",),
+        )
         self.executor_connections_reused = self.registry.counter(
             "executor_connections_reused_total",
             "Executor HTTP dispatches served over an already-established "

@@ -30,6 +30,19 @@ Two measurements, two gates:
 
        two-replica aggregate throughput >= 1.6x single-replica
 
+3. **Store-loss drill** (ISSUE 20): THREE replicas over one RESP store
+   (the in-repo stdlib stub, run as a subprocess so it can be SIGKILLed
+   like a real store node). Mid-load the store process is killed and the
+   drive keeps going; the store is then restarted and the wrappers heal.
+   Gates — the degraded-mode invariants, checked end to end:
+
+       - every turn completes (requests keep serving degraded),
+       - zero duplicate (scope, generation) lease grants across the
+         fleet, and ZERO mints at all while the store is unreachable
+         (fail-closed fencing),
+       - quota accrual journaled during the outage reconciles into the
+         fleet windows within one window of reconnect.
+
 Usage:
     python scripts/bench_replicas.py [--repeats 30] [--turns 10]
         [--out BENCH_replicas.json] [--smoke]
@@ -39,9 +52,11 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import json
 import secrets
 import statistics
+import subprocess
 import sys
 import tempfile
 import time
@@ -57,8 +72,16 @@ from bee_code_interpreter_fs_tpu.services.backends.local import (  # noqa: E402
 from bee_code_interpreter_fs_tpu.services.code_executor import (  # noqa: E402
     CodeExecutor,
 )
+from bee_code_interpreter_fs_tpu.services.errors import (  # noqa: E402
+    StateStoreDegradedError,
+)
+from bee_code_interpreter_fs_tpu.services.quotas import (  # noqa: E402
+    _FleetWindows,
+)
 from bee_code_interpreter_fs_tpu.services.state_store import (  # noqa: E402
     InMemoryStateStore,
+    RespStateStore,
+    ResilientStateStore,
     SQLiteStateStore,
 )
 from bee_code_interpreter_fs_tpu.services.storage import Storage  # noqa: E402
@@ -281,15 +304,238 @@ async def bench_throughput(tmp: str, turns_per_worker: int) -> dict:
     }
 
 
+def _spawn_stub(port: int = 0) -> tuple[subprocess.Popen, int]:
+    """Start the RESP stub as a real subprocess (so the bench can SIGKILL
+    it like a store node dying) and block on its READY line."""
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "bee_code_interpreter_fs_tpu.services.resp_stub",
+            "--port",
+            str(port),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        cwd=str(REPO_ROOT),
+    )
+    line = (proc.stdout.readline() or "").strip()
+    if not line.startswith("READY "):
+        proc.kill()
+        raise RuntimeError(f"resp stub failed to start: {line!r}")
+    return proc, int(line.split()[1])
+
+
+# The store-loss drill's fleet-window horizon: long enough that buckets
+# accrued during the outage are still live when the post-reconnect read
+# checks them (granularity = window/8 = 7.5s >> kill-to-heal time).
+STORE_LOSS_QUOTA_WINDOW = 60.0
+STORE_LOSS_REPLICAS = 3
+
+
+async def bench_store_loss(tmp: str, turns_per_worker: int) -> dict:
+    """Leg 3: three replicas over one RESP store; SIGKILL the store
+    mid-load, keep driving, restart it, verify the degraded-mode
+    invariants (serving, fencing, quota reconciliation) end to end."""
+    stub, port = _spawn_stub()
+    url = f"redis://127.0.0.1:{port}"
+    # Short breaker cooldown so the post-restart heal lands within the
+    # drill instead of the production-tuned probe cadence.
+    stores = [
+        ResilientStateStore(
+            RespStateStore(url, op_timeout=1.0),
+            failure_threshold=2,
+            cooldown=0.75,
+        )
+        for _ in range(STORE_LOSS_REPLICAS)
+    ]
+
+    minted: list[tuple[str, int]] = []
+
+    def make_replica(index: int) -> CodeExecutor:
+        config = _config(
+            tmp,
+            f"loss-{index}",
+            executor_pod_queue_target_length=PER_REPLICA_CAP,
+            pool_autoscale_enabled=False,
+        )
+        backend = ReplicaCappedBackend(config, PER_REPLICA_CAP)
+        executor = CodeExecutor(
+            backend,
+            Storage(config.file_storage_path),
+            config,
+            state_store=stores[index],
+        )
+        # Record every fleet lease grant: the zero-double-grant gate is
+        # "no (scope, generation) pair is ever minted twice".
+        registry = executor.leases
+        inner_mint = registry.mint
+
+        def mint(scope, sandbox_id=""):
+            lease = inner_mint(scope, sandbox_id)
+            minted.append((lease.scope, lease.generation))
+            return lease
+
+        registry.mint = mint
+        return executor
+
+    replicas = [make_replica(i) for i in range(STORE_LOSS_REPLICAS)]
+    fleets = [
+        _FleetWindows(store) for store in stores
+    ]  # one per replica, as the quota enforcer holds
+
+    served_after_kill = 0
+    try:
+        # Warm every replica's full sandbox budget while the store is up.
+        await asyncio.gather(
+            *(
+                replica.execute(EXEC_SOURCE)
+                for replica in replicas
+                for _ in range(PER_REPLICA_CAP)
+            )
+        )
+        # Healthy cross-replica fencing proof: three replicas minting on
+        # ONE scope draw from the fleet counter — generations unique.
+        for replica in replicas:
+            replica.leases.mint("bench-shared-scope")
+
+        # Drive with a mid-load SIGKILL of the store process.
+        total_turns = WORKERS * turns_per_worker
+        kill_after = max(1, total_turns // 2)
+        completed = 0
+        killed = False
+
+        async def worker(index: int) -> None:
+            nonlocal completed, served_after_kill, killed
+            executor = replicas[index % len(replicas)]
+            for _ in range(turns_per_worker):
+                result = await executor.execute(
+                    EXEC_SOURCE, tenant=f"client-{index % 2}"
+                )
+                if result.exit_code != 0:
+                    raise RuntimeError(f"exec failed: {result.stderr[:400]}")
+                completed += 1
+                if killed:
+                    served_after_kill += 1
+                elif completed >= kill_after:
+                    killed = True
+                    stub.kill()  # SIGKILL: no shutdown handshake
+
+        start = time.perf_counter()
+        await asyncio.gather(*(worker(i) for i in range(WORKERS)))
+        wall = time.perf_counter() - start
+
+        # Store is dead. Mints must fail CLOSED — a partitioned replica
+        # granting off a stale counter is the one forbidden behavior.
+        refused = 0
+        for replica in replicas:
+            try:
+                replica.leases.mint("bench-shared-scope")
+            except StateStoreDegradedError:
+                refused += 1
+        # Quota accrual while the store is down: fails open locally and
+        # journals — publish_errors would mean the enforcer saw the
+        # outage instead of the wrapper absorbing it.
+        per_replica_adds, delta = 5, 1.0
+        for fleet in fleets:
+            for _ in range(per_replica_adds):
+                fleet.add(
+                    "bench-tenant", "chip_s", delta, STORE_LOSS_QUOTA_WINDOW
+                )
+        expected_accrual = STORE_LOSS_REPLICAS * per_replica_adds * delta
+        outage_health = [store.health() for store in stores]
+
+        # Restart the store on the same port and let every wrapper heal
+        # (breaker cooldown, then one good probe replays the journal).
+        stub, _ = _spawn_stub(port)
+        heal_deadline = time.monotonic() + 20.0
+        healed = False
+        while time.monotonic() < heal_deadline:
+            if all(store.probe() for store in stores):
+                healed = True
+                break
+            await asyncio.sleep(0.1)
+
+        # Reconciliation: a FRESH handle (no replica-local state) must see
+        # the full outage accrual in the fleet windows — within one
+        # window of reconnect by construction, since the buckets the
+        # journal replayed into are still the live ones.
+        raw = RespStateStore(url, op_timeout=1.0)
+        try:
+            fleet_used = _FleetWindows(raw).used(
+                "bench-tenant", "chip_s", STORE_LOSS_QUOTA_WINDOW
+            )
+        finally:
+            raw.close()
+        # Post-heal mints flow again. A fresh scope: the stub is
+        # memoryless, so the restart is also a counter wipe — production
+        # points the fleet counter at persistent storage (README), and
+        # the invariant gated here is no-mints-during-outage plus no
+        # duplicate grant ever observed.
+        for replica in replicas:
+            replica.leases.mint("bench-shared-scope-epoch2")
+    finally:
+        for replica in replicas:
+            await replica.close()
+        for store in stores:
+            with contextlib.suppress(Exception):
+                store.close()
+        with contextlib.suppress(Exception):
+            stub.kill()
+
+    no_duplicate_grants = len(minted) == len(set(minted))
+    mints_fail_closed = refused == STORE_LOSS_REPLICAS
+    reconciled = (
+        healed
+        and abs(fleet_used - expected_accrual) < 1e-6
+        and all(f.publish_errors == 0 for f in fleets)
+        and all(s.health()["journal_depth"] == 0 for s in stores)
+    )
+    return {
+        "replicas": STORE_LOSS_REPLICAS,
+        "turns": completed,
+        "wall_s": round(wall, 3),
+        "served_after_store_kill": served_after_kill,
+        "degraded_mint_refusals": refused,
+        "lease_grants": len(minted),
+        "store_outages_seen": [h["outages"] for h in outage_health],
+        "quota_accrual_expected": expected_accrual,
+        "quota_accrual_fleet_view": round(fleet_used, 6),
+        "journal_replays": [s.health()["journal_replays"] for s in stores],
+        "gate": {
+            "rule": "all turns serve through the store SIGKILL; zero "
+            "duplicate (scope, generation) grants and zero mints while "
+            "the store is down; journaled quota accrual reconciles "
+            "within one window of reconnect",
+            "served_degraded": bool(served_after_kill > 0),
+            "no_duplicate_grants": no_duplicate_grants,
+            "mints_fail_closed": mints_fail_closed,
+            "quota_reconciled": bool(reconciled),
+            "pass": bool(
+                served_after_kill > 0
+                and completed == total_turns
+                and no_duplicate_grants
+                and mints_fail_closed
+                and reconciled
+            ),
+        },
+    }
+
+
 async def run_bench(repeats: int, turns_per_worker: int) -> dict:
     tmp = tempfile.mkdtemp(prefix="bench-replicas-")
     overhead = await bench_overhead(tmp, repeats)
     throughput = await bench_throughput(tmp, turns_per_worker)
+    store_loss = await bench_store_loss(tmp, turns_per_worker)
     return {
         "overhead": overhead,
         "throughput": throughput,
+        "store_loss": store_loss,
         "gates_pass": bool(
-            overhead["gate"]["pass"] and throughput["gate"]["pass"]
+            overhead["gate"]["pass"]
+            and throughput["gate"]["pass"]
+            and store_loss["gate"]["pass"]
         ),
     }
 
@@ -312,7 +558,8 @@ def main() -> int:
     print(json.dumps(result, indent=2))
     if not result["gates_pass"]:
         print(
-            "GATE FAILED: replica scale-out (overhead or throughput)",
+            "GATE FAILED: replica scale-out "
+            "(overhead, throughput, or store-loss drill)",
             file=sys.stderr,
         )
         return 1

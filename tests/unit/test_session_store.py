@@ -78,6 +78,7 @@ async def test_save_load_round_trip(tmp_path):
     assert store.snapshot() == {
         "enabled": True,
         "hibernated": 1,
+        "hibernated_by_lane": {"4": 1},
         "saves": 1,
         "restores": 0,
         "conflicts": 0,

@@ -13,6 +13,8 @@ import pytest
 from bee_code_interpreter_fs_tpu.config import Config
 from bee_code_interpreter_fs_tpu.services.state_store import (
     InMemoryStateStore,
+    RespStateStore,
+    ResilientStateStore,
     SQLiteStateStore,
     make_state_store,
     resolve_replica_id,
@@ -99,13 +101,31 @@ def test_in_memory_private_vs_shared():
 
 
 def test_make_state_store_grammar(tmp_path):
+    # The private default is returned BARE: no resilience wrapper, no new
+    # layers — byte-for-byte the single-replica path.
+    assert isinstance(make_state_store(Config()), InMemoryStateStore)
     assert make_state_store(Config()).shared is False
     assert make_state_store(Config(state_store="memory")).shared is False
+    # Shared stores ship inside the degraded-mode wrapper by default...
     path = str(tmp_path / "s.db")
     sq = make_state_store(Config(state_store=path))
-    assert isinstance(sq, SQLiteStateStore) and sq.shared
+    assert isinstance(sq, ResilientStateStore) and sq.shared
+    assert isinstance(sq.inner, SQLiteStateStore)
     sq2 = make_state_store(Config(state_store=f"sqlite://{path}"))
-    assert isinstance(sq2, SQLiteStateStore)
+    assert isinstance(sq2.inner, SQLiteStateStore)
+    # ...and bare when the wrapper is explicitly disabled.
+    raw = make_state_store(
+        Config(state_store=path, state_store_resilient=False)
+    )
+    assert isinstance(raw, SQLiteStateStore)
+    resp = make_state_store(
+        Config(
+            state_store="redis://10.0.0.5:6379/2",
+            state_store_resilient=False,
+        )
+    )
+    assert isinstance(resp, RespStateStore)
+    assert (resp.host, resp.port, resp.db) == ("10.0.0.5", 6379, 2)
     with pytest.raises(ValueError):
         make_state_store(
             Config(state_store=str(tmp_path / "no" / "such" / "dir" / "x.db"))

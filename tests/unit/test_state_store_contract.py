@@ -1,0 +1,151 @@
+"""StateStore contract suite, parametrized over ALL THREE impls (in-memory,
+SQLite, RESP against the in-repo stub server): the cross-replica components
+are written against the interface, so every impl — including a future
+fourth — must agree on CAS atomicity under concurrent mutate, TTL lease
+expiry, incr monotonicity, and first-write-wins acquire semantics. A new
+impl earns the whole control plane by passing this file.
+"""
+
+import threading
+
+import pytest
+
+from bee_code_interpreter_fs_tpu.services.resp_stub import RespStubServer
+from bee_code_interpreter_fs_tpu.services.state_store import (
+    InMemoryStateStore,
+    RespStateStore,
+    SQLiteStateStore,
+)
+
+
+@pytest.fixture(scope="module")
+def resp_stub():
+    with RespStubServer() as url:
+        yield url
+
+
+@pytest.fixture(params=["memory", "sqlite", "resp"])
+def store(request, tmp_path):
+    """One store per impl; `factory` hands concurrency tests an extra
+    handle on the SAME backing state (a second replica, in effect)."""
+    if request.param == "memory":
+        instance = InMemoryStateStore(shared=True)
+        yield instance, lambda: instance  # dicts: one object IS the state
+    elif request.param == "sqlite":
+        path = str(tmp_path / "contract.db")
+        instance = SQLiteStateStore(path)
+        yield instance, lambda: SQLiteStateStore(path)
+        instance.close()
+    else:
+        url = request.getfixturevalue("resp_stub")
+        instance = RespStateStore(url)
+        # Module-scoped stub: scrub between tests so cases stay independent.
+        instance._cmd("FLUSHALL")
+        yield instance, lambda: RespStateStore(url)
+        instance.close()
+
+
+def test_basic_kv_contract(store):
+    s, _ = store
+    assert s.get("ns", "a") is None
+    s.put("ns", "a", {"x": 1})
+    s.put("ns", "b", [1, 2])
+    s.put("other", "a", "elsewhere")
+    assert s.get("ns", "a") == {"x": 1}
+    assert s.items("ns") == {"a": {"x": 1}, "b": [1, 2]}
+    s.delete("ns", "a")
+    assert s.get("ns", "a") is None
+    s.delete("ns", "never-existed")  # idempotent
+    assert s.get("other", "a") == "elsewhere"
+
+
+def test_incr_monotonic_and_independent(store):
+    s, _ = store
+    assert s.incr("gen", "scope") == 1.0
+    assert s.incr("gen", "scope") == 2.0
+    assert s.incr("gen", "scope", 3) == 5.0
+    assert s.incr("gen", "other") == 1.0
+    # Monotonic under interleaving with a second handle (two replicas
+    # bumping one lease-generation counter must never repeat a value).
+    _, factory = store
+    peer = factory()
+    seen = [s.incr("gen", "scope"), peer.incr("gen", "scope")]
+    assert seen == sorted(seen) and len(set(seen)) == 2
+    if peer is not s:
+        peer.close()
+
+
+def test_mutate_cas_atomic_under_concurrency(store):
+    """The CAS primitive the WFQ tags and lease floors ride: concurrent
+    read-modify-writes from many threads (through separate handles, where
+    the impl has real connections) must never lose an update."""
+    s, factory = store
+    per_thread, threads = 25, 4
+
+    def bump(current):
+        return (current or 0) + 1, None
+
+    def spin():
+        handle = factory()
+        for _ in range(per_thread):
+            handle.mutate("cas", "counter", bump)
+        if handle is not s:
+            handle.close()
+
+    workers = [threading.Thread(target=spin) for _ in range(threads)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    assert s.get("cas", "counter") == per_thread * threads
+
+
+def test_mutate_none_deletes(store):
+    s, _ = store
+    s.put("ns", "k", {"n": 1})
+    assert s.mutate("ns", "k", lambda cur: (None, cur)) == {"n": 1}
+    assert s.get("ns", "k") is None
+    assert "k" not in s.items("ns")
+
+
+def test_ttl_lease_expiry(store):
+    """put_ttl/get_live against the injectable wall clock: live inside
+    the window, None (and dropped) past it."""
+    s, _ = store
+    s.put_ttl("hb", "replica-a", {"load": 3}, 10.0, now=1000.0)
+    assert s.get_live("hb", "replica-a", now=1005.0) == {"load": 3}
+    assert s.get_live("hb", "replica-a", now=1010.0) is None
+    # Lazy expiry dropped the record — a later read inside a NEW window
+    # does not resurrect it.
+    assert s.get_live("hb", "replica-a", now=1001.0) is None
+
+
+def test_acquire_lease_first_write_wins(store):
+    """Two replicas racing one lease key: exactly one wins; re-acquire by
+    the holder extends; the loser wins only after expiry."""
+    s, factory = store
+    peer = factory()
+    assert s.acquire_lease("lock", "lane-4", "replica-a", 30.0, now=0.0)
+    assert not peer.acquire_lease("lock", "lane-4", "replica-b", 30.0, now=1.0)
+    # Holder re-acquires (extends) while the lease is live.
+    assert s.acquire_lease("lock", "lane-4", "replica-a", 30.0, now=15.0)
+    # Still extended at the original deadline...
+    assert not peer.acquire_lease("lock", "lane-4", "replica-b", 30.0, now=31.0)
+    # ...and free once the extension lapses.
+    assert peer.acquire_lease("lock", "lane-4", "replica-b", 30.0, now=46.0)
+    if peer is not s:
+        peer.close()
+
+
+def test_two_handles_share_state(store):
+    """The N-replicas-one-store contract: a second handle sees the first
+    handle's writes (trivially true in-memory; load-bearing for the
+    file/network impls)."""
+    s, factory = store
+    peer = factory()
+    s.put("ns", "k", "from-first")
+    assert peer.get("ns", "k") == "from-first"
+    peer.put("ns", "k2", "from-second")
+    assert s.items("ns") == {"k": "from-first", "k2": "from-second"}
+    if peer is not s:
+        peer.close()
